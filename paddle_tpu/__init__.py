@@ -1,0 +1,67 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's API.
+
+Built new on JAX/XLA (eager ops over jax.Array + imperative autograd tape;
+``to_static`` → jax.jit → HLO; Fleet hybrid parallelism → named-mesh sharding
+with XLA collectives over ICI/DCN). Blueprint: SURVEY.md at the repo root.
+
+Usage matches paddle::
+
+    import paddle_tpu as paddle
+    paddle.set_device('tpu')
+    x = paddle.randn([4, 8])
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    Tensor, Parameter, to_tensor, CPUPlace, TPUPlace, CUDAPlace,
+    set_device, get_device, device_count,
+    is_compiled_with_cuda, is_compiled_with_xpu,
+    bfloat16, float16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_, complex64, complex128, set_default_dtype, get_default_dtype,
+    seed, get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
+)
+from .framework import core as _core  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .ops import linalg  # noqa: F401
+from .autograd import no_grad, enable_grad, grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .autograd.pylayer import PyLayer  # noqa: F401
+from . import framework  # noqa: F401
+from .framework import tensor_patch as _tensor_patch  # noqa: F401  (side effect: methods)
+from . import autograd  # noqa: F401
+
+# subsystem namespaces (populated as the build proceeds)
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import vision  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import device  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .nn.layer import Layer  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from .flags import set_flags, get_flags  # noqa: F401
+from .jit.api import disable_static, enable_static, in_dynamic_mode  # noqa: F401
+
+
+def is_grad_enabled_():  # legacy alias
+    return is_grad_enabled()
+
+
+def check_shape_match(*a):  # placeholder for paddle.utils compat
+    pass
+
+
+def run_check():
+    """paddle.utils.run_check equivalent: verify the device works."""
+    import jax
+    x = randn([128, 128])  # noqa: F405
+    y = (x @ x).sum()
+    y.numpy()
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! {n} device(s) "
+          f"({jax.default_backend()}) available.")

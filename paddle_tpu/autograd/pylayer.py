@@ -1,0 +1,91 @@
+"""PyLayer: user-defined forward/backward (reference: Paddle's
+``python/paddle/autograd/py_layer.py`` — SURVEY.md §2.2).
+
+The custom backward is spliced into the tape as a GradNode whose "vjp" calls
+the user's ``backward`` staticmethod on Tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .tape import GradNode, is_grad_enabled, no_grad
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_not_inplace(self, *a):
+        pass
+
+    def mark_non_differentiable(self, *a):
+        pass
+
+    def set_materialize_grads(self, v):
+        self.materialize_grads = v
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (list, tuple))
+        out_list = [outputs] if single else list(outputs)
+
+        diff_inputs = [a for a in args
+                       if isinstance(a, Tensor) and not a.stop_gradient
+                       and jnp.issubdtype(a.dtype, jnp.inexact)]
+        if not is_grad_enabled() or not diff_inputs:
+            return outputs
+
+        out_meta = [(tuple(t._data.shape), t.dtype) for t in out_list]
+        _, out_tree = jax.tree.flatten(out_list)
+
+        def vjp_like(cotangents):
+            cts = [Tensor(c) for c in cotangents]
+            with no_grad():
+                grads = cls.backward(ctx, *cts) if len(cts) > 1 \
+                    else cls.backward(ctx, cts[0])
+            grads = grads if isinstance(grads, (list, tuple)) else [grads]
+            out = []
+            for a, g in zip([a for a in args if isinstance(a, Tensor)], grads):
+                if any(a is d for d in diff_inputs):
+                    out.append(None if g is None else
+                               (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(out)
+
+        edges = [(t, t._grad_node, t._out_idx) for t in diff_inputs]
+        node = GradNode(vjp_like, edges, out_meta, out_tree, cls.__name__)
+        for k, t in enumerate(out_list):
+            if jnp.issubdtype(t.dtype, jnp.inexact):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._out_idx = k
+        return outputs

@@ -1,0 +1,317 @@
+"""Imperative autograd tape over jax.vjp.
+
+This is the TPU-native replacement for Paddle's eager autograd engine
+(reference: ``paddle/fluid/eager/backward.cc`` — topological traversal with
+dependency counting and grad accumulation; ``grad_node_info.h`` GradNode graph.
+SURVEY.md §2.1/§3.1; canonical paths, unverified).
+
+Every differentiable eager op goes through :func:`apply`: we run the op's pure
+jax function under ``jax.vjp`` w.r.t. the inputs that require grad, and record
+a :class:`GradNode` holding the vjp closure. ``Tensor.backward()`` replays the
+node graph in reverse topological order with dependency counting, accumulating
+leaf ``.grad`` exactly like the reference engine.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework import dtype as dtypes
+
+_FLOAT0 = jax.dtypes.float0
+
+# ---------------------------------------------------------------------------
+# grad mode
+# ---------------------------------------------------------------------------
+
+_grad_enabled = [True]
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled[0]
+
+
+def set_grad_enabled(mode: bool):
+    class _Ctx(contextlib.AbstractContextManager):
+        def __init__(self, mode):
+            self._prev = _grad_enabled[0]
+            _grad_enabled[0] = bool(mode)
+
+        def __exit__(self, *exc):
+            _grad_enabled[0] = self._prev
+            return False
+
+    return _Ctx(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad — context manager AND decorator."""
+
+    def __enter__(self):
+        self._prev = _grad_enabled[0]
+        _grad_enabled[0] = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_enabled[0] = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _grad_enabled[0]
+        _grad_enabled[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_enabled[0] = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
+# GradNode
+# ---------------------------------------------------------------------------
+
+
+class GradNode:
+    """One recorded op. ``inputs`` are edges to the diff inputs captured at
+    record time (tensor ref, producer node at record time, producer out idx) —
+    captured eagerly so later in-place mutation of a tensor can't create a
+    self-cycle."""
+
+    __slots__ = ("vjp_fn", "edges", "out_meta", "out_tree", "name", "__weakref__")
+
+    def __init__(self, vjp_fn, edges, out_meta, out_tree, name):
+        self.vjp_fn = vjp_fn
+        self.edges = edges          # list[(Tensor, GradNode|None, int)]
+        self.out_meta = out_meta    # list[(shape, dtype)] flat output leaves
+        self.out_tree = out_tree
+        self.name = name
+
+    def __repr__(self):
+        return f"<GradNode {self.name}>"
+
+
+def _is_diff_tensor(t) -> bool:
+    return (isinstance(t, Tensor) and not t.stop_gradient
+            and jnp.issubdtype(t.dtype, jnp.inexact))
+
+
+# hooks installed by other subsystems (amp, debugging) — see paddle_tpu/amp
+_amp_cast_inputs = None
+_nan_check = False
+
+
+def apply(fn, *args, op_name: str | None = None, **kwargs):
+    """Run pure-array function ``fn`` on (possibly) Tensor args; record a tape
+    node if grad is enabled and any input requires grad. Returns Tensor(s)
+    mirroring fn's output structure."""
+    name = op_name or getattr(fn, "__name__", "op")
+    if _amp_cast_inputs is not None:
+        args = _amp_cast_inputs(name, list(args))
+    leaves, treedef = jax.tree.flatten(list(args), is_leaf=lambda x: isinstance(x, Tensor))
+    consts = [l._data if isinstance(l, Tensor) else l for l in leaves]
+    diff_idx = [i for i, l in enumerate(leaves)
+                if _is_diff_tensor(l)] if is_grad_enabled() else []
+
+    if not diff_idx:
+        out = fn(*jax.tree.unflatten(treedef, consts), **kwargs)
+        if _nan_check:
+            _check_finite(out, name)
+        return jax.tree.map(lambda v: Tensor(v), out)
+
+    def pure(*arrs):
+        cl = list(consts)
+        for i, a in zip(diff_idx, arrs):
+            cl[i] = a
+        return fn(*jax.tree.unflatten(treedef, cl), **kwargs)
+
+    primals = [consts[i] for i in diff_idx]
+    out_val, vjp_fn = jax.vjp(pure, *primals)
+    if _nan_check:
+        _check_finite(out_val, name)
+
+    out_leaves, out_tree = jax.tree.flatten(out_val)
+    out_meta = [(v.shape, v.dtype) for v in out_leaves]
+    edges = [(leaves[i], leaves[i]._grad_node, leaves[i]._out_idx) for i in diff_idx]
+    node = GradNode(vjp_fn, edges, out_meta, out_tree, name)
+
+    wrapped = []
+    for k, v in enumerate(out_leaves):
+        t = Tensor(v)
+        if jnp.issubdtype(v.dtype, jnp.inexact):
+            t.stop_gradient = False
+            t._grad_node = node
+            t._out_idx = k
+        wrapped.append(t)
+    return jax.tree.unflatten(out_tree, wrapped)
+
+
+def _check_finite(out, name):
+    """FLAGS_check_nan_inf: per-op output scan, abort with op identity
+    (reference: ``nan_inf_utils`` — SURVEY.md §5.2). Skipped under tracing."""
+    for v in jax.tree.leaves(out):
+        if isinstance(v, jax.core.Tracer):
+            return
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.inexact):
+            if not bool(jnp.all(jnp.isfinite(v))):
+                raise FloatingPointError(f"NaN/Inf found in output of op '{name}'")
+
+
+def defop(fn):
+    """Decorator: pure-array fn -> eager Tensor op."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return apply(fn, *args, op_name=fn.__name__, **kwargs)
+
+    wrapper.raw = fn
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# backward engine
+# ---------------------------------------------------------------------------
+
+
+def _zeros_cotangent(shape, dt):
+    if jnp.issubdtype(dt, jnp.inexact):
+        return jnp.zeros(shape, dt)
+    return np.zeros(shape, _FLOAT0)
+
+
+def _accum(a, b):
+    return b if a is None else a + b
+
+
+def _run_hooks(t: Tensor, g):
+    if t._grad_hooks:
+        for h in list(t._grad_hooks):
+            r = h(Tensor(g) if not isinstance(g, Tensor) else g)
+            if r is not None:
+                g = r._data if isinstance(r, Tensor) else r
+    return g
+
+
+def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
+                 capture: dict | None = None):
+    """Reverse-topological replay with dependency counting (mirrors
+    ``egr::Backward``). ``capture``: id(tensor) -> slot, used by paddle.grad;
+    when given + accumulate=False, grads are written there instead of ``.grad``."""
+    grads = grads or [None] * len(tensors)
+    # ---- seed
+    seeds = []  # (node, out_idx, grad) or leaf accumulation
+    for t, g in zip(tensors, grads):
+        if not isinstance(t, Tensor):
+            raise TypeError("backward inputs must be Tensors")
+        if g is None:
+            g = jnp.ones(t._data.shape, t.dtype)
+        elif isinstance(g, Tensor):
+            g = g._data
+        else:
+            g = jnp.asarray(g, t.dtype)
+        if t._grad_node is None:
+            if capture is not None and id(t) in capture:
+                capture[id(t)] = _accum(capture[id(t)], g)
+            elif accumulate and not t.stop_gradient:
+                t.grad = Tensor(_accum(t.grad._data if t.grad is not None else None, g))
+        else:
+            seeds.append((t._grad_node, t._out_idx, g))
+
+    if not seeds:
+        return
+
+    # ---- collect reachable graph
+    nodes = set()
+    node_objs = {}
+    stack = [s[0] for s in seeds]
+    while stack:
+        n = stack.pop()
+        if id(n) in nodes:
+            continue
+        nodes.add(id(n))
+        node_objs[id(n)] = n
+        for (_, prod, _) in n.edges:
+            if prod is not None and id(prod) not in nodes:
+                stack.append(prod)
+
+    # ---- dependency (consumer) counts among reachable nodes
+    consumers = {nid: 0 for nid in nodes}
+    for nid in nodes:
+        for (_, prod, _) in node_objs[nid].edges:
+            if prod is not None and id(prod) in nodes:
+                consumers[id(prod)] += 1
+
+    out_grads: dict[int, dict[int, Any]] = {nid: {} for nid in nodes}
+    for node, idx, g in seeds:
+        d = out_grads[id(node)]
+        d[idx] = _accum(d.get(idx), g)
+
+    ready = [node_objs[nid] for nid, c in consumers.items() if c == 0]
+    processed = 0
+    while ready:
+        n = ready.pop()
+        processed += 1
+        if n.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through node {n.name} a second time; "
+                "set retain_graph=True if you need to.")
+        got = out_grads[id(n)]
+        cot_leaves = [got.get(i, _zeros_cotangent(sh, dt))
+                      for i, (sh, dt) in enumerate(n.out_meta)]
+        cotangent = jax.tree.unflatten(n.out_tree, cot_leaves)
+        in_grads = n.vjp_fn(cotangent)
+        if not retain_graph:
+            n.vjp_fn = None
+        out_grads[id(n)] = None  # free
+        for (t, prod, pidx), g in zip(n.edges, in_grads):
+            if g is None or (hasattr(g, "dtype") and g.dtype == _FLOAT0):
+                continue
+            g = _run_hooks(t, g)
+            is_capture = capture is not None and id(t) in capture
+            if is_capture:
+                capture[id(t)] = _accum(capture[id(t)], g)
+            if prod is None or t._retain_grads:
+                if accumulate and not t.stop_gradient and not is_capture:
+                    t.grad = Tensor(_accum(t.grad._data if t.grad is not None else None, g))
+            if prod is not None and id(prod) in nodes:
+                d = out_grads[id(prod)]
+                d[pidx] = _accum(d.get(pidx), g)
+                consumers[id(prod)] -= 1
+                if consumers[id(prod)] == 0:
+                    ready.append(prod)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad — return grads of outputs w.r.t. inputs without touching
+    ``.grad``. create_graph (double grad) is not yet supported."""
+    if create_graph:
+        raise NotImplementedError("create_graph=True (higher-order grad) "
+                                  "is not supported yet in the TPU build")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+    capture = {id(t): None for t in inputs}
+    retain = True if retain_graph is None else retain_graph
+    run_backward(list(outputs), grad_outputs, retain_graph=retain,
+                 accumulate=False, capture=capture)
+    result = []
+    for t in inputs:
+        g = capture[id(t)]
+        if g is None:
+            if not allow_unused:
+                raise ValueError(
+                    "One of the differentiated Tensors appears unused in the "
+                    "graph; set allow_unused=True to return None for it.")
+            result.append(None)
+        else:
+            result.append(Tensor(g))
+    return result

@@ -1,0 +1,135 @@
+"""paddle.device (reference: ``python/paddle/device/`` — SURVEY.md §2.2).
+Streams/events are no-ops under XLA's async runtime (documented deviation:
+XLA schedules and overlaps; there is no user-visible stream)."""
+from __future__ import annotations
+
+import jax
+
+from ..framework.core import (  # noqa: F401
+    set_device, get_device, current_place, device_count, Place, CPUPlace,
+    TPUPlace, CUDAPlace, is_compiled_with_cuda, is_compiled_with_xpu,
+)
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"]
+
+
+def get_available_device():
+    return [f"{jax.default_backend()}:{i}" for i in range(jax.local_device_count())]
+
+
+def get_available_custom_device():
+    return []
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_custom_device(name="tpu"):
+    return name == "tpu"
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device is done."""
+    for d in jax.local_devices():
+        try:
+            d.synchronize_all_activity()
+        except AttributeError:
+            pass
+
+
+class Stream:
+    """Stream facade: XLA has no user streams; kept for API compat."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def set_stream(stream):
+    return stream
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class cuda:
+    """paddle.device.cuda namespace alias — maps to the accelerator."""
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        stats = jax.local_devices()[0].memory_stats() or {}
+        return stats.get("bytes_limit", 0)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def get_device_properties(device=None):
+        d = jax.local_devices()[0]
+        class Props:
+            name = str(d)
+            total_memory = (d.memory_stats() or {}).get("bytes_limit", 0)
+        return Props()

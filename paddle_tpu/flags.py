@@ -1,0 +1,63 @@
+"""Global flags (reference: gflags-style ``FLAGS_*`` in
+``paddle/phi/core/flags.cc`` + ``paddle.set_flags`` — SURVEY.md §5.6).
+
+One typed registry; env overrides (``FLAGS_x=v``) read at import; unknown
+flags are accepted with a warning-free passthrough so reference scripts run.
+XLA knobs pass through to ``XLA_FLAGS``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_DEFAULTS: dict[str, Any] = {
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_allocator_strategy": "auto_growth",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_use_cinn": False,          # XLA always on; kept for compat
+    "FLAGS_nccl_blocking_wait": False,
+    "FLAGS_embedding_deterministic": 0,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_conv_workspace_size_limit": 512,
+}
+
+_flags: dict[str, Any] = {}
+
+
+def _coerce(cur, val):
+    if isinstance(cur, bool):
+        return val in (True, "1", "true", "True", 1)
+    if isinstance(cur, int):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val
+
+
+def _init():
+    for k, v in _DEFAULTS.items():
+        env = os.environ.get(k)
+        _flags[k] = _coerce(v, env) if env is not None else v
+
+
+_init()
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        cur = _flags.get(k, _DEFAULTS.get(k))
+        _flags[k] = _coerce(cur, v) if cur is not None else v
+        if k == "FLAGS_check_nan_inf":
+            from .autograd import tape
+            tape._nan_check = bool(_flags[k])
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _flags.get(k, _DEFAULTS.get(k)) for k in flags}
+
+
+def flag(name, default=None):
+    return _flags.get(name, _DEFAULTS.get(name, default))

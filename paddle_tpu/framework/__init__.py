@@ -1,0 +1,14 @@
+from .core import (  # noqa: F401
+    Tensor, Parameter, EagerParamBase, to_tensor, Place, CPUPlace, TPUPlace,
+    CUDAPlace, set_device, get_device, current_place, device_count,
+    is_compiled_with_cuda, is_compiled_with_xpu,
+)
+from .dtype import (  # noqa: F401
+    bfloat16, float16, float32, float64, int8, int16, int32, int64, uint8,
+    bool_, complex64, complex128, set_default_dtype, get_default_dtype,
+    convert_dtype, dtype_name,
+)
+from .random import (  # noqa: F401
+    seed, get_rng_state, set_rng_state, get_cuda_rng_state, set_cuda_rng_state,
+    Generator, default_generator, next_key,
+)

@@ -1,0 +1,96 @@
+"""Dtype system.
+
+Paddle exposes dtypes both as ``paddle.float32``-style singletons and as strings
+('float32'). We map every spelling onto numpy/jax dtypes (reference:
+``paddle/phi/common/data_type.h`` — see SURVEY.md provenance banner; paths are
+canonical-upstream, unverified).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype singletons (numpy dtype objects; jax arrays report these).
+bfloat16 = jnp.bfloat16
+float16 = np.float16
+float32 = np.float32
+float64 = np.float64
+int8 = np.int8
+int16 = np.int16
+int32 = np.int32
+int64 = np.int64
+uint8 = np.uint8
+bool_ = np.bool_
+complex64 = np.complex64
+complex128 = np.complex128
+
+_STR2DTYPE = {
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float16": float16, "fp16": float16, "half": float16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "bool": bool_,
+    "complex64": complex64, "complex128": complex128,
+}
+
+_default_dtype = "float32"
+
+# Index dtype actually used at runtime: int64 narrows to int32 without jax
+# x64 (documented deviation; paddle reports int64 indices).
+INT_DTYPE = int32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = np.dtype(convert_dtype(d)).name if d is not None else "float32"
+    if np.dtype(convert_dtype(d)) not in (np.dtype(float32), np.dtype(float64), np.dtype(float16)) \
+            and convert_dtype(d) != bfloat16:
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def _narrow_64bit(t):
+    """Without jax x64, 64-bit types silently truncate; map them up front so
+    dtype queries stay consistent (documented deviation: int64→int32,
+    float64→float32 on TPU — the TPU has no fp64 ALU anyway)."""
+    import jax
+    if jax.config.jax_enable_x64:
+        return t
+    return {np.int64: int32, np.uint64: np.uint32, np.float64: float32,
+            np.complex128: complex64}.get(t, t)
+
+
+def convert_dtype(d):
+    """Normalize any dtype spelling to a numpy-compatible dtype object."""
+    if d is None:
+        return None
+    if isinstance(d, str):
+        key = d.lower()
+        if key not in _STR2DTYPE:
+            raise TypeError(f"unknown dtype {d!r}")
+        return _narrow_64bit(_STR2DTYPE[key])
+    if d is jnp.bfloat16:
+        return bfloat16
+    try:
+        t = np.dtype(d).type if np.dtype(d) != np.dtype(jnp.bfloat16) else bfloat16
+        return _narrow_64bit(t)
+    except TypeError:
+        raise TypeError(f"unknown dtype {d!r}")
+
+
+def dtype_name(d) -> str:
+    """'float32'-style name for a dtype (paddle convention)."""
+    return np.dtype(d).name
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(np.dtype(d), jnp.floating)
+
+
+def is_integer(d) -> bool:
+    return jnp.issubdtype(np.dtype(d), jnp.integer) or np.dtype(d) == np.dtype(np.bool_)

@@ -1,0 +1,67 @@
+"""paddle.save / paddle.load (reference: ``python/paddle/framework/io.py`` —
+pickle-based state_dict serialization, SURVEY.md §5.4). Tensors are stored as
+numpy arrays; nested dicts/lists preserved. A sharded/async Orbax-backed path
+for distributed checkpoints lives in ``paddle_tpu/distributed/checkpoint.py``.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core import Tensor, Parameter
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper marking arrays that were Tensors."""
+
+    def __init__(self, array, is_param, name, stop_gradient):
+        self.array = array
+        self.is_param = is_param
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.numpy()), isinstance(obj, Parameter),
+                              obj.name, obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        cls = Parameter if obj.is_param else Tensor
+        t = cls(obj.array, name=obj.name)
+        if not obj.is_param:
+            t.stop_gradient = obj.stop_gradient
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = configs.get("return_numpy", False)
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

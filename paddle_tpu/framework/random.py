@@ -1,0 +1,81 @@
+"""Global RNG.
+
+Paddle has a global seed + per-device ``Generator`` with a stateful Philox
+counter (reference: ``paddle/phi/core/generator.h``, SURVEY.md §2.1 — canonical
+paths, unverified). JAX wants explicit keys; we hide a counter-based key tree
+behind Paddle's ``seed()/get_rng_state()`` API (SURVEY.md §7.3 item 5): every
+consumer calls :func:`next_key` which folds an incrementing counter into the
+root key, so eager randomness is deterministic given ``seed()`` and call order.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful generator: (root_key, counter). fold_in per draw."""
+
+    def __init__(self, seed_: int = 0):
+        self.manual_seed(seed_)
+
+    def manual_seed(self, seed_: int):
+        self._seed = int(seed_)
+        self._root = jax.random.key(int(seed_))
+        self._counter = 0
+        return self
+
+    def next_key(self):
+        with _lock:
+            k = jax.random.fold_in(self._root, self._counter)
+            self._counter += 1
+        return k
+
+    def get_state(self):
+        return {"seed": self._seed, "counter": self._counter}
+
+    def set_state(self, state):
+        self._seed = int(state["seed"])
+        self._root = jax.random.key(self._seed)
+        self._counter = int(state["counter"])
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+
+_lock = threading.Lock()
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def seed(s: int) -> Generator:
+    """paddle.seed — reset the global generator."""
+    return _default_generator.manual_seed(s)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(state):
+    if isinstance(state, (list, tuple)):
+        state = state[0]
+    _default_generator.set_state(state)
+
+
+def get_cuda_rng_state():  # API-compat alias (no CUDA on TPU build)
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)

@@ -1,0 +1,139 @@
+"""Attach ops as Tensor methods + operator dunders.
+
+Mirrors upstream's monkey-patch scheme (``python/paddle/tensor/__init__.py``
+``monkey_patch_tensor`` — SURVEY.md §2.2): tensor methods are the same
+functions as the ``paddle.*`` free functions, with the tensor as first arg.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core import Tensor
+from .. import ops
+from ..autograd.tape import apply
+
+
+def _conv_idx(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_conv_idx(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray([i._data if isinstance(i, Tensor) else i for i in idx]) \
+            if any(isinstance(i, Tensor) for i in idx) else jnp.asarray(idx)
+    return idx
+
+
+def _getitem(self, idx):
+    idxc = _conv_idx(idx)
+    return apply(lambda a: a[idxc], self, op_name="getitem")
+
+
+def _setitem(self, idx, value):
+    idxc = _conv_idx(idx)
+    if isinstance(value, Tensor):
+        out = apply(lambda a, v: a.at[idxc].set(v.astype(a.dtype)), self, value,
+                    op_name="setitem")
+    else:
+        out = apply(lambda a: a.at[idxc].set(value), self, op_name="setitem")
+    self._replace_(out._data, out._grad_node, out._out_idx)
+
+
+def _swap(method):
+    """out-of-place op -> in-place variant mutating self."""
+
+    def inplace(self, *args, **kwargs):
+        out = method(self, *args, **kwargs)
+        return self._replace_(out._data, out._grad_node, out._out_idx)
+
+    return inplace
+
+
+def monkey_patch_tensor():
+    T = Tensor
+    # arithmetic dunders
+    T.__add__ = lambda s, o: ops.add(s, o)
+    T.__radd__ = lambda s, o: ops.add(s, o)
+    T.__sub__ = lambda s, o: ops.subtract(s, o)
+    T.__rsub__ = lambda s, o: ops.subtract(o, s) if isinstance(o, Tensor) \
+        else apply(lambda a: o - a, s, op_name="rsub")
+    T.__mul__ = lambda s, o: ops.multiply(s, o)
+    T.__rmul__ = lambda s, o: ops.multiply(s, o)
+    T.__truediv__ = lambda s, o: ops.divide(s, o)
+    T.__rtruediv__ = lambda s, o: ops.divide(o, s) if isinstance(o, Tensor) \
+        else apply(lambda a: o / a, s, op_name="rdiv")
+    T.__floordiv__ = lambda s, o: ops.floor_divide(s, o)
+    T.__mod__ = lambda s, o: ops.mod(s, o)
+    T.__pow__ = lambda s, o: ops.pow(s, o)
+    T.__rpow__ = lambda s, o: apply(lambda a: jnp.power(o, a), s, op_name="rpow")
+    T.__matmul__ = lambda s, o: ops.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: ops.matmul(o, s)
+    T.__neg__ = lambda s: ops.neg(s)
+    T.__abs__ = lambda s: ops.abs(s)
+    T.__invert__ = lambda s: ops.logical_not(s) if s.dtype == jnp.bool_ \
+        else ops.bitwise_not(s)
+    T.__and__ = lambda s, o: ops.logical_and(s, o) if s.dtype == jnp.bool_ \
+        else ops.bitwise_and(s, o)
+    T.__or__ = lambda s, o: ops.logical_or(s, o) if s.dtype == jnp.bool_ \
+        else ops.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: ops.logical_xor(s, o) if s.dtype == jnp.bool_ \
+        else ops.bitwise_xor(s, o)
+    # comparisons (return Tensors, like paddle)
+    T.__eq__ = lambda s, o: ops.equal(s, o)
+    T.__ne__ = lambda s, o: ops.not_equal(s, o)
+    T.__lt__ = lambda s, o: ops.less_than(s, o)
+    T.__le__ = lambda s, o: ops.less_equal(s, o)
+    T.__gt__ = lambda s, o: ops.greater_than(s, o)
+    T.__ge__ = lambda s, o: ops.greater_equal(s, o)
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    methods = """
+        add subtract multiply divide floor_divide mod remainder pow maximum minimum
+        fmax fmin atan2 lerp logaddexp equal not_equal greater_than greater_equal
+        less_than less_equal logical_and logical_or logical_xor logical_not
+        bitwise_and bitwise_or bitwise_xor bitwise_not
+        exp expm1 log log2 log10 log1p sqrt rsqrt square abs sign neg reciprocal
+        floor ceil round trunc frac sin cos tan asin acos atan sinh cosh tanh
+        asinh acosh atanh erf erfinv sigmoid digamma lgamma clip scale stanh
+        isnan isinf isfinite isclose allclose equal_all
+        sum mean prod max min amax amin logsumexp std var median nanmedian
+        quantile nansum nanmean count_nonzero cumsum cumprod cummax cummin
+        logcumsumexp matmul mm bmm dot inner outer addmm kron cross trace t
+        argmax argmin argsort sort topk kthvalue mode searchsorted bucketize
+        reshape flatten squeeze unsqueeze transpose moveaxis swapaxes
+        concat stack split chunk unbind unstack tile expand expand_as
+        broadcast_to flip rot90 roll repeat_interleave pad cast
+        take_along_axis put_along_axis index_select index_sample gather gather_nd
+        scatter scatter_nd_add index_add index_put masked_select masked_fill
+        masked_scatter where nonzero unique unique_consecutive
+        norm dist histogram bincount increment lcm gcd heaviside hypot
+        nan_to_num multiplex divide_no_nan tensordot
+        reshape_ squeeze_ unsqueeze_
+    """.split()
+    for name in methods:
+        fn = getattr(ops, name, None) or getattr(ops.linalg, name, None)
+        if fn is not None and not hasattr(T, name):
+            setattr(T, name, fn)
+
+    # in-place variants derived from out-of-place ops
+    for name in """add subtract multiply divide scale clip exp sqrt rsqrt
+                   reciprocal floor ceil round abs sin cos tanh sigmoid neg
+                   erfinv pow mod remainder lerp masked_fill index_put
+                   put_along_axis index_add""".split():
+        fn = getattr(ops, name, None)
+        if fn is not None and not hasattr(T, name + "_"):
+            setattr(T, name + "_", _swap(fn))
+
+    T.zero_ = _swap(lambda s: apply(lambda a: jnp.zeros_like(a), s, op_name="zero_"))
+    T.fill_ = _swap(lambda s, v: apply(lambda a: jnp.full_like(a, v), s, op_name="fill_"))
+    T.fill_diagonal_ = _swap(lambda s, v, offset=0, wrap=False: apply(
+        lambda a: a.at[jnp.arange(min(a.shape[-2:])), jnp.arange(min(a.shape[-2:]))].set(v),
+        s, op_name="fill_diagonal_"))
+    T.uniform_ = lambda s, min=-1.0, max=1.0, seed=0: s._replace_(
+        ops.uniform(s.shape, dtype=s.dtype, min=min, max=max)._data)
+    T.normal_ = lambda s, mean=0.0, std=1.0: s._replace_(
+        (ops.randn(s.shape, dtype=s.dtype) * std + mean)._data)
+
+
+monkey_patch_tensor()

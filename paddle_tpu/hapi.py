@@ -1,0 +1,172 @@
+"""paddle.Model — high-level API (reference: ``python/paddle/hapi/model.py`` —
+fit/evaluate/predict + callbacks; SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from .framework.core import Tensor
+from .framework import io as fio
+from .io import DataLoader, Dataset
+from .metric import Metric
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = metrics or []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+
+    def _unpack(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            *inputs, label = batch
+            if len(inputs) == 1:
+                return inputs[0], label
+            return inputs, label
+        return batch, None
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        out = self.network(*inputs) if isinstance(inputs, (list, tuple)) \
+            else self.network(inputs)
+        loss = self._loss(out, labels) if self._loss else out
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [loss.numpy()]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        out = self.network(*inputs) if isinstance(inputs, (list, tuple)) \
+            else self.network(inputs)
+        loss = self._loss(out, labels) if self._loss else out
+        return [loss.numpy()]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        out = self.network(*inputs) if isinstance(inputs, (list, tuple)) \
+            else self.network(inputs)
+        return [out.numpy() if isinstance(out, Tensor) else out]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
+            train_data, batch_size=batch_size, shuffle=shuffle,
+            drop_last=drop_last, num_workers=num_workers)
+        it = 0
+        for epoch in range(epochs):
+            self.network.train()
+            for m in self._metrics:
+                m.reset()
+            t0 = time.time()
+            for step, batch in enumerate(loader):
+                x, y = self._unpack(batch)
+                out = self.network(x)
+                loss = self._loss(out, y) if self._loss else out
+                loss.backward()
+                if (step + 1) % accumulate_grad_batches == 0:
+                    self._optimizer.step()
+                    self._optimizer.clear_grad()
+                for m in self._metrics:
+                    m.update(m.compute(out, y))
+                it += 1
+                if verbose and step % log_freq == 0:
+                    metr = {m.name(): m.accumulate() for m in self._metrics}
+                    print(f"Epoch {epoch + 1}/{epochs} step {step} "
+                          f"loss: {float(loss.numpy()):.4f} {metr} "
+                          f"({(time.time() - t0) / (step + 1):.3f}s/step)")
+                if num_iters is not None and it >= num_iters:
+                    return
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, f"epoch_{epoch}"))
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else DataLoader(
+            eval_data, batch_size=batch_size, num_workers=num_workers)
+        self.network.eval()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        from .autograd import no_grad
+        with no_grad():
+            for step, batch in enumerate(loader):
+                x, y = self._unpack(batch)
+                out = self.network(x)
+                if self._loss:
+                    losses.append(float(self._loss(out, y).numpy()))
+                for m in self._metrics:
+                    m.update(m.compute(out, y))
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+        result = {m.name(): m.accumulate() for m in self._metrics}
+        if losses:
+            result["loss"] = float(np.mean(losses))
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else DataLoader(
+            test_data, batch_size=batch_size, num_workers=num_workers)
+        self.network.eval()
+        outputs = []
+        from .autograd import no_grad
+        with no_grad():
+            for batch in loader:
+                x, _ = self._unpack(batch)
+                outputs.append(self.predict_batch([x])[0])
+        if stack_outputs:
+            return [np.concatenate(outputs)]
+        return [outputs]
+
+    def save(self, path, training=True):
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fio.load(opt_path))
+
+    def parameters(self):
+        return self.network.parameters()
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """paddle.summary — parameter counting table."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = p.size
+        total += n
+        if p.trainable:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<24}{'Param #':>12}"]
+    lines += [f"{r[0]:<{width}}{str(r[1]):<24}{r[2]:>12,}" for r in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,482 @@
+"""paddle.io — Dataset / DataLoader (reference: ``python/paddle/io/`` —
+SURVEY.md §2.2/§3.5: multiprocess workers + index queues + reorder + pinned
+double-buffered H2D prefetch in ``buffered_reader.cc``).
+
+TPU-native pipeline: worker processes produce numpy batches → a background
+thread converts + ``jax.device_put``s them with prefetch depth 2 (the
+buffered_reader analogue) so the accelerator never waits on host collate.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import os
+import queue
+import threading
+import multiprocessing as mp
+
+import numpy as np
+import jax
+
+from ..framework.core import Tensor
+from ..framework import random as prandom
+
+
+# ---------------------------------------------------------------------------
+# datasets
+# ---------------------------------------------------------------------------
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset has no __getitem__")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no __len__")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (list, tuple)) else [item])
+        return tuple(out)
+
+    def __len__(self):
+        return min(len(d) for d in self.datasets)
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __len__(self):
+        return self.cum[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        di = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if di == 0 else self.cum[di - 1]
+        return self.datasets[di][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    if all(isinstance(l, float) for l in lengths):
+        n = len(dataset)
+        lengths = [int(math.floor(n * f)) for f in lengths]
+        lengths[-1] += len(dataset) - sum(lengths)
+    perm = np.random.permutation(len(dataset)).tolist()
+    out = []
+    offset = 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset:offset + l]))
+        offset += l
+    return out
+
+
+# ---------------------------------------------------------------------------
+# samplers
+# ---------------------------------------------------------------------------
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(np.random.randint(0, n, self.num_samples).tolist())
+        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray([float(w) for w in weights])
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        return iter(np.random.choice(len(self.weights), self.num_samples,
+                                     replace=self.replacement, p=p).tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
+                 drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Rank-sharded batches (reference: ``python/paddle/io/dataloader/
+    batch_sampler.py`` DistributedBatchSampler — SURVEY.md §3.5)."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if num_replicas is None or rank is None:
+            from ..distributed import get_world_size, get_rank
+            num_replicas = num_replicas if num_replicas is not None else get_world_size()
+            rank = rank if rank is not None else get_rank()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = int(math.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        indices = np.arange(len(self.dataset)).tolist()
+        if self.shuffle:
+            rng = np.random.RandomState(self.epoch)
+            rng.shuffle(indices)
+        indices += indices[: (self.total_size - len(indices))]
+        indices = indices[self.local_rank:self.total_size:self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+# ---------------------------------------------------------------------------
+# collate
+# ---------------------------------------------------------------------------
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return np.stack([np.asarray(b) for b in batch])
+    if isinstance(sample, Tensor):
+        return np.stack([b.numpy() for b in batch])
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, float):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return [default_collate_fn(list(t)) for t in transposed]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return np.asarray(batch)
+
+
+def default_convert_fn(batch):
+    return batch
+
+
+def _to_device(np_batch):
+    def conv(x):
+        if isinstance(x, np.ndarray):
+            return Tensor(x)
+        return x
+    if isinstance(np_batch, (list, tuple)):
+        return [conv(b) if not isinstance(b, (list, tuple, dict))
+                else _to_device(b) for b in np_batch]
+    if isinstance(np_batch, dict):
+        return {k: _to_device(v) if isinstance(v, (list, tuple, dict)) else conv(v)
+                for k, v in np_batch.items()}
+    return conv(np_batch)
+
+
+# ---------------------------------------------------------------------------
+# worker loop
+# ---------------------------------------------------------------------------
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
+                 worker_init_fn, base_seed):
+    np.random.seed((base_seed + worker_id) % (2 ** 31))
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        bidx, indices = item
+        try:
+            samples = [dataset[i] for i in indices]
+            batch = collate_fn(samples)
+            result_queue.put((bidx, batch, None))
+        except Exception as e:  # propagate
+            import traceback
+            result_queue.put((bidx, None, f"{e}\n{traceback.format_exc()}"))
+
+
+class _MultiprocessIter:
+    """Index-queue/result-queue worker pool with in-order reassembly —
+    the ``_DataLoaderIterMultiProcess`` analogue (SURVEY.md §3.5)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.batches = list(iter(loader.batch_sampler))
+        self.n = len(self.batches)
+        ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
+                             else "spawn")
+        nw = loader.num_workers
+        self.result_queue = ctx.Queue()
+        self.index_queues = [ctx.Queue() for _ in range(nw)]
+        base_seed = int(np.random.randint(0, 2 ** 31))
+        self.workers = []
+        for w in range(nw):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self.index_queues[w], self.result_queue,
+                      loader.collate_fn, w, loader.worker_init_fn, base_seed),
+                daemon=True)
+            p.start()
+            self.workers.append(p)
+        for i, b in enumerate(self.batches):
+            self.index_queues[i % nw].put((i, b))
+        for q in self.index_queues:
+            q.put(None)
+        self._pending = {}
+        self._next = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next >= self.n:
+            self._shutdown()
+            raise StopIteration
+        while self._next not in self._pending:
+            bidx, batch, err = self.result_queue.get()
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed: {err}")
+            self._pending[bidx] = batch
+        batch = self._pending.pop(self._next)
+        self._next += 1
+        return _to_device(batch)
+
+    def _shutdown(self):
+        for p in self.workers:
+            if p.is_alive():
+                p.terminate()
+
+    def __del__(self):
+        self._shutdown()
+
+
+class _SingleProcessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self.sampler_iter = iter(loader.batch_sampler)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        indices = next(self.sampler_iter)
+        samples = [self.loader.dataset[i] for i in indices]
+        return _to_device(self.loader.collate_fn(samples))
+
+
+class _PrefetchIter:
+    """Depth-k device prefetch wrapper (buffered_reader analogue)."""
+
+    def __init__(self, inner, depth=2):
+        self.inner = inner
+        self.depth = depth
+        self.q = queue.Queue(maxsize=depth)
+        self.done = object()
+        self.err = None
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self):
+        try:
+            for item in self.inner:
+                self.q.put(item)
+        except Exception as e:
+            self.err = e
+        finally:
+            self.q.put(self.done)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is self.done:
+            if self.err:
+                raise self.err
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.num_workers = int(os.environ.get("PADDLE_TPU_NUM_WORKERS",
+                                              num_workers))
+        self.collate_fn = collate_fn or default_collate_fn
+        self.worker_init_fn = worker_init_fn
+        self.use_buffer_reader = use_buffer_reader
+        self.prefetch_factor = prefetch_factor
+        self.return_list = return_list
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            inner = self._iter_iterable()
+        elif self.num_workers > 0:
+            inner = _MultiprocessIter(self)
+        else:
+            inner = _SingleProcessIter(self)
+        if self.use_buffer_reader:
+            return _PrefetchIter(inner, self.prefetch_factor)
+        return iter(inner)
+
+    def _iter_iterable(self):
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield _to_device(self.collate_fn(batch))
+                batch = []
+        if batch and not self.drop_last:
+            yield _to_device(self.collate_fn(batch))
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    @staticmethod
+    def from_generator(*args, **kwargs):
+        raise NotImplementedError("from_generator is legacy; use Dataset")
+
+
+def get_worker_info():
+    return None

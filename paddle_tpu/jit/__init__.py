@@ -1,0 +1,132 @@
+"""paddle.jit (reference: ``python/paddle/jit/`` — SURVEY.md §2.2/§3.2).
+
+``to_static`` traces through jax.jit (see api.py). ``jit.save``/``jit.load``
+replace the ``.pdmodel`` ProgramDesc format with serialized StableHLO via
+``jax.export`` + a params file — the TPU-native inference-export path
+(SURVEY.md §7.1 M1); ``.pdmodel`` reading is explicitly out of scope.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .api import (  # noqa: F401
+    to_static, not_to_static, ignore_module, StaticFunction, InputSpec,
+    enable_static, disable_static, in_dynamic_mode, in_to_static_mode,
+    enable_to_static,
+)
+from ..framework.core import Tensor
+from ..framework import io as fio
+from ..nn.layer import Layer
+
+SUFFIX_PARAMS = ".pdiparams"
+SUFFIX_MODEL = ".pdmodel.stablehlo"
+SUFFIX_META = ".pdmeta"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — export layer for inference.
+
+    Writes: ``{path}.pdiparams`` (state dict), ``{path}.pdmodel.stablehlo``
+    (serialized jax.export artifact of the eval-mode forward, parameters as
+    runtime inputs), ``{path}.pdmeta`` (specs)."""
+    from jax import export as jexport
+
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer (function export TBD)")
+    was_training = layer.training
+    layer.eval()
+    try:
+        fwd = layer.forward
+        sf = fwd if isinstance(fwd, StaticFunction) else StaticFunction(layer)
+        if input_spec is None:
+            raise ValueError("jit.save requires input_spec")
+        specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+                 for s in input_spec]
+        example = [jnp.zeros([1 if d is None else d for d in s.shape],
+                             s.dtype or jnp.float32) for s in specs]
+        params = [p for p in layer.parameters() if p is not None]
+        bufs = [b for b in layer.buffers() if b is not None]
+
+        def infer_fn(p_arrs, b_arrs, *inputs):
+            saved = [t._data for t in params + bufs]
+            try:
+                for t, a in zip(params, p_arrs):
+                    t._data = a
+                for t, a in zip(bufs, b_arrs):
+                    t._data = a
+                from ..autograd.tape import no_grad
+                with no_grad():
+                    out = layer._dygraph_forward(*[Tensor(i) for i in inputs]) \
+                        if hasattr(layer, "_dygraph_forward") \
+                        else layer.forward(*[Tensor(i) for i in inputs])
+                return jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t,
+                                    out, is_leaf=lambda x: isinstance(x, Tensor))
+            finally:
+                for t, a in zip(params + bufs, saved):
+                    t._data = a
+
+        jitted = jax.jit(infer_fn)
+        exported = jexport.export(jitted)(
+            [p._data for p in params], [b._data for b in bufs], *example)
+        blob = exported.serialize()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path + SUFFIX_MODEL, "wb") as f:
+            f.write(blob)
+        fio.save(layer.state_dict(), path + SUFFIX_PARAMS)
+        meta = {
+            "param_names": [p.name for p in params],
+            "param_keys": [k for k, _ in layer.state_dict().items()],
+            "n_params": len(params),
+            "n_bufs": len(bufs),
+            "input_specs": [(s.shape, np.dtype(s.dtype or np.float32).name)
+                            for s in specs],
+        }
+        with open(path + SUFFIX_META, "wb") as f:
+            pickle.dump(meta, f)
+    finally:
+        if was_training:
+            layer.train()
+
+
+class TranslatedLayer(Layer):
+    """Result of jit.load: a Layer whose forward runs the exported StableHLO."""
+
+    def __init__(self, exported, params, bufs, meta):
+        super().__init__()
+        self._exported = exported
+        self._params_list = params
+        self._bufs_list = bufs
+        self._meta = meta
+        for i, p in enumerate(params):
+            self.add_parameter(f"p{i}", p)
+
+    def forward(self, *inputs):
+        arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in inputs]
+        out = self._exported.call([p._data for p in self._params_list],
+                                  [b._data for b in self._bufs_list], *arrs)
+        return jax.tree.map(Tensor, out)
+
+
+def load(path, **configs):
+    from jax import export as jexport
+    from ..framework.core import Parameter
+
+    with open(path + SUFFIX_MODEL, "rb") as f:
+        exported = jexport.deserialize(f.read())
+    with open(path + SUFFIX_META, "rb") as f:
+        meta = pickle.load(f)
+    state = fio.load(path + SUFFIX_PARAMS)
+    n_p = meta["n_params"]
+    keys = meta["param_keys"]
+    params = [Parameter(state[k]._data if isinstance(state[k], Tensor)
+                        else state[k]) for k in keys[:n_p]]
+    bufs = [Tensor(state[k]._data if isinstance(state[k], Tensor) else state[k])
+            for k in keys[n_p:n_p + meta["n_bufs"]]]
+    return TranslatedLayer(exported, params, bufs, meta)

@@ -1,0 +1,273 @@
+"""@paddle.jit.to_static — the dynamic-to-static tracer (reference: the SOT/AST
+dual path in ``python/paddle/jit/`` lowering Program IR through CINN; SURVEY.md
+§3.2). TPU-native design (SURVEY.md §7.0): **jax.jit IS the tracer** — we trace
+the eager op layer with jax tracers by swapping each Parameter/buffer's backing
+array, cache the compiled program per input-spec (shape/dtype/stop_gradient +
+training flag), and splice ONE GradNode for the whole compiled region into the
+imperative tape (via ``tape.apply``) so ``loss.backward()`` keeps working.
+Buffer mutation (BN running stats) threads through the trace as extra outputs.
+Python branching on tensor values raises under tracing → graph break → eager
+fallback, matching SOT's fallback semantics.
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+import numpy as np
+import jax
+
+from ..framework.core import Tensor
+from ..framework import dtype as dtypes
+from ..framework import random as prandom
+from ..autograd.tape import apply, no_grad
+from ..nn.layer import Layer
+
+_static_mode = [False]  # paddle.enable_static (legacy static-graph mode flag)
+_TRACING = [False]
+
+_GRAPH_BREAK_ERRORS = (
+    jax.errors.TracerBoolConversionError,
+    jax.errors.ConcretizationTypeError,
+    jax.errors.TracerArrayConversionError,
+    jax.errors.TracerIntegerConversionError,
+)
+
+
+def enable_static():
+    _static_mode[0] = True
+
+
+def disable_static():
+    _static_mode[0] = False
+
+
+def in_dynamic_mode():
+    return not _static_mode[0]
+
+
+def in_to_static_mode():
+    return _TRACING[0]
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape) if shape is not None else None
+        self.dtype = dtypes.convert_dtype(dtype) if dtype is not None else None
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _spec_key(args, kwargs, training):
+    parts = [bool(training)]
+    for a in jax.tree.leaves((args, kwargs), is_leaf=_is_tensor):
+        if isinstance(a, Tensor):
+            parts.append(("T", tuple(a._data.shape), str(a.dtype), a.stop_gradient))
+        elif isinstance(a, (int, float, str, bool, bytes, type(None))):
+            parts.append(a)
+        elif isinstance(a, np.ndarray):
+            parts.append(("A", a.shape, str(a.dtype), a.tobytes()))
+        else:
+            parts.append(("O", id(a)))
+    return tuple(parts)
+
+
+class StaticFunction:
+    """Callable produced by @to_static. One compiled program per input spec."""
+
+    def __init__(self, function, input_spec=None, instance=None, **unused):
+        self._orig_fn = function
+        self._input_spec = input_spec
+        self._instance = instance  # set when decorating an unbound method
+        self._cache = {}
+        self._bound = {}
+        if not isinstance(function, Layer):
+            functools.update_wrapper(self, function)
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        key = id(instance)
+        if key not in self._bound:
+            self._bound[key] = StaticFunction(self._orig_fn, self._input_spec,
+                                              instance=instance)
+        return self._bound[key]
+
+    # -- helpers ------------------------------------------------------------
+    def _layer(self):
+        if isinstance(self._instance, Layer):
+            return self._instance
+        if isinstance(self._orig_fn, Layer):
+            return self._orig_fn
+        own = getattr(self._orig_fn, "__self__", None)
+        return own if isinstance(own, Layer) else None
+
+    def _call_eager(self, *args, **kwargs):
+        if isinstance(self._orig_fn, Layer):
+            return self._orig_fn.forward(*args, **kwargs)
+        if self._instance is not None:
+            return self._orig_fn(self._instance, *args, **kwargs)
+        return self._orig_fn(*args, **kwargs)
+
+    def _state(self):
+        layer = self._layer()
+        if layer is None:
+            return [], []
+        return ([p for p in layer.parameters() if p is not None],
+                [b for b in layer.buffers() if b is not None])
+
+    # -- trace + compile ----------------------------------------------------
+    def _make_core(self, treedef, leaves, kwargs_static, params, bufs, sg_flags):
+        """Returns jitted core(p_arrs, b_arrs, key, t_arrs) -> (out, new_bufs).
+
+        ``leaves`` gives the static (non-Tensor) leaves; Tensor slots are None
+        and filled from t_arrs at call time.
+        """
+        static_leaves = [None if isinstance(l, Tensor) else l for l in leaves]
+        tensor_slots = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+
+        def core(p_arrs, b_arrs, key, t_arrs):
+            saved_p = [t._data for t in params]
+            saved_b = [t._data for t in bufs]
+            gen = prandom.default_generator()
+            saved_rng = (gen._root, gen._counter)
+            saved_tr = _TRACING[0]
+            _TRACING[0] = True
+            try:
+                for t, a in zip(params, p_arrs):
+                    t._data = a
+                for t, a in zip(bufs, b_arrs):
+                    t._data = a
+                gen._root = key
+                gen._counter = 0
+                new_leaves = list(static_leaves)
+                for slot, arr, sg in zip(tensor_slots, t_arrs, sg_flags):
+                    tt = Tensor(arr)
+                    tt.stop_gradient = sg
+                    new_leaves[slot] = tt
+                new_args, new_kwargs = jax.tree.unflatten(treedef, new_leaves)
+                with no_grad():
+                    out = self._call_eager(*new_args, **new_kwargs)
+                out_arrays = jax.tree.map(
+                    lambda t: t._data if isinstance(t, Tensor) else t, out,
+                    is_leaf=_is_tensor)
+                new_bufs = [t._data for t in bufs]
+                return out_arrays, new_bufs
+            finally:
+                for t, a in zip(params, saved_p):
+                    t._data = a
+                for t, a in zip(bufs, saved_b):
+                    t._data = a
+                gen._root, gen._counter = saved_rng
+                _TRACING[0] = saved_tr
+
+        return jax.jit(core)
+
+    def __call__(self, *args, **kwargs):
+        params, bufs = self._state()
+        layer = self._layer()
+        training = layer.training if layer is not None else True
+        leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+        tensor_leaves = [l for l in leaves if isinstance(l, Tensor)]
+        key = _spec_key(args, kwargs, training)
+        entry = self._cache.get(key)
+        if entry is None:
+            sg_flags = [t.stop_gradient for t in tensor_leaves]
+            core = self._make_core(treedef, leaves, kwargs, params, bufs, sg_flags)
+            entry = {"core": core, "fallback": False}
+            self._cache[key] = entry
+        if entry["fallback"]:
+            return self._call_eager(*args, **kwargs)
+
+        rng_key = prandom.next_key()
+        np_, nb_ = len(params), len(bufs)
+
+        def runner(*xs):
+            p_arrs = list(xs[:np_])
+            b_arrs = list(xs[np_:np_ + nb_])
+            t_arrs = list(xs[np_ + nb_:])
+            return entry["core"](p_arrs, b_arrs, rng_key, t_arrs)
+
+        try:
+            out_vals, new_bufs = apply(runner, *params, *bufs, *tensor_leaves,
+                                       op_name="to_static")
+        except _GRAPH_BREAK_ERRORS as e:
+            warnings.warn(
+                f"to_static: graph break ({type(e).__name__}) — falling back to "
+                f"eager for {getattr(self._orig_fn, '__name__', self._orig_fn)}")
+            entry["fallback"] = True
+            return self._call_eager(*args, **kwargs)
+
+        with no_grad():
+            for b, nb in zip(bufs, new_bufs):
+                b._data = nb._data if isinstance(nb, Tensor) else nb
+        return out_vals
+
+    # -- introspection / export --------------------------------------------
+    @property
+    def code(self):
+        import inspect
+        try:
+            return inspect.getsource(self._orig_fn)
+        except (OSError, TypeError):
+            return "<source unavailable>"
+
+    def get_concrete_program(self, *args, **kwargs):
+        """Lower to StableHLO for the given example inputs (Program analogue)."""
+        params, bufs = self._state()
+        leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
+        tensor_leaves = [l for l in leaves if isinstance(l, Tensor)]
+        sg = [t.stop_gradient for t in tensor_leaves]
+        core = self._make_core(treedef, leaves, kwargs, params, bufs, sg)
+        lowered = core.lower([p._data for p in params], [b._data for b in bufs],
+                             prandom.next_key(), [t._data for t in tensor_leaves])
+        return lowered
+
+    def rollback(self):
+        if isinstance(self._orig_fn, Layer):
+            return self._orig_fn
+        return self._orig_fn
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=None, **kwargs):
+    """@paddle.jit.to_static — decorator or functional form; accepts a Layer,
+    a function, or a bound method."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            orig_forward = fn.forward
+            sf = StaticFunction(orig_forward, input_spec)
+            fn._static_forward = sf
+            fn._dygraph_forward = orig_forward
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+def enable_to_static(flag=True):
+    pass

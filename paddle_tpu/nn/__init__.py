@@ -1,0 +1,36 @@
+"""paddle.nn (reference: ``python/paddle/nn/`` — SURVEY.md §2.2)."""
+from .layer import Layer, Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layers.common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
+    Identity, Upsample, UpsamplingNearest2D, UpsamplingBilinear2D, PixelShuffle,
+    Pad1D, Pad2D, Pad3D, ZeroPad2D, Bilinear, CosineSimilarity, Unfold,
+)
+from .layers.conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
+from .layers.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm, LayerNorm,
+    RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm, SpectralNorm,
+)
+from .layers.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, AvgPool1D, AvgPool2D, AdaptiveAvgPool1D,
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+)
+from .layers.activation import (  # noqa: F401
+    ReLU, ReLU6, GELU, Sigmoid, Tanh, Silu, Swish, Hardswish, Hardsigmoid,
+    Hardtanh, LeakyReLU, ELU, CELU, SELU, Mish, Softplus, Softshrink,
+    Hardshrink, Softsign, Tanhshrink, LogSigmoid, Softmax, LogSoftmax, Maxout,
+    GLU, PReLU, RReLU,
+)
+from .layers.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
+    BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
+    TripletMarginLoss, HingeEmbeddingLoss,
+)
+from .layers.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from . import utils  # noqa: F401
+from .clip_grad import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401

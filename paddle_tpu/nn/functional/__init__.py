@@ -1,0 +1,6 @@
+"""paddle.nn.functional (reference: ``python/paddle/nn/functional/`` —
+SURVEY.md §2.2)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403

@@ -1,0 +1,181 @@
+"""nn.functional activations (reference: ``python/paddle/nn/functional/
+activation.py`` — SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...autograd.tape import apply, defop
+
+
+@defop
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu_(x):
+    out = relu(x)
+    return x._replace_(out._data, out._grad_node, out._out_idx)
+
+
+@defop
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def gelu(x, approximate=False):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x, op_name="gelu")
+
+
+@defop
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@defop
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@defop
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@defop
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@defop
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@defop
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x, op_name="leaky_relu")
+
+
+def elu(x, alpha=1.0):
+    return apply(lambda a: jax.nn.elu(a, alpha), x, op_name="elu")
+
+
+def celu(x, alpha=1.0):
+    return apply(lambda a: jax.nn.celu(a, alpha), x, op_name="celu")
+
+
+@defop
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x,
+                     jnp.log1p(jnp.exp(beta * jnp.minimum(x, threshold / beta))) / beta)
+
+
+@defop
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+@defop
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return apply(lambda a: jax.nn.softmax(
+        a.astype(dtype) if dtype else a, axis=axis), x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply(lambda a: jax.nn.log_softmax(
+        a.astype(dtype) if dtype else a, axis=axis), x, op_name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as prandom
+
+    def fn(a):
+        g = jax.random.gumbel(prandom.next_key(), a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if not hard:
+            return y
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        return y + jax.lax.stop_gradient(y_hard - y)
+
+    return apply(fn, x, op_name="gumbel_softmax")
+
+
+@defop
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
+
+
+@defop
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def prelu(x, weight, data_format="NCHW"):
+    def fn(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return apply(fn, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True):
+    from ...framework import random as prandom
+
+    def fn(a):
+        if training:
+            slope = jax.random.uniform(prandom.next_key(), a.shape, a.dtype,
+                                       minval=lower, maxval=upper)
+        else:
+            slope = (lower + upper) / 2.0
+        return jnp.where(a >= 0, a, slope * a)
+
+    return apply(fn, x, op_name="rrelu")
+
+
+@defop
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)

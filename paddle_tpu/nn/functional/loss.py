@@ -1,0 +1,219 @@
+"""Loss functionals (reference: ``python/paddle/nn/functional/loss.py`` —
+SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...autograd.tape import apply
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def fn(logits, lab, *w):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        n_classes = logits.shape[axis]
+        if soft_label:
+            soft = lab
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            if w:
+                wshape = [1] * lp.ndim
+                wshape[axis % lp.ndim] = -1
+                soft = soft * w[0].reshape(wshape)
+            loss = -jnp.sum(soft * lp, axis=axis)
+        else:
+            idx = lab.astype(jnp.int32)
+            if idx.ndim == lp.ndim:  # [N, 1] -> [N]
+                idx = jnp.squeeze(idx, axis)
+            safe_idx = jnp.where(idx == ignore_index, 0, idx)
+            picked = jnp.take_along_axis(lp, safe_idx[..., None], axis=axis)[..., 0]
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(lp, axis=axis)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            mask = (idx != ignore_index)
+            loss = jnp.where(mask, loss, 0.0)
+            if w:
+                cw = jnp.take(w[0], safe_idx)
+                loss = loss * jnp.where(mask, cw, 0.0)
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(mask, cw, 0.0)), 1e-12)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(fn, *args, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = loss.unsqueeze(-1)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
+                         reduction=reduction, use_softmax=False)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label,
+                 op_name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label,
+                 op_name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply(fn, input, label, op_name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, y, *w):
+        p_ = jnp.clip(p, 1e-12, 1 - 1e-7)
+        loss = -(y * jnp.log(p_) + (1 - y) * jnp.log1p(-p_))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(fn, *args, op_name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with optional pos_weight
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z)))
+                                          + jnp.maximum(-z, 0))
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = (logit, label) + tuple(t for t in (weight, pos_weight) if t is not None)
+    return apply(fn, *args, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, y):
+        tgt = jnp.exp(y) if log_target else y
+        logt = y if log_target else jnp.log(jnp.maximum(y, 1e-30))
+        loss = tgt * (logt - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(fn, input, label, op_name="kl_div")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+
+    return apply(fn, x1, x2, op_name="cosine_similarity")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply(fn, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin),
+                                         reduction),
+                 input, other, label, op_name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(lambda a, y: _reduce(
+        jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label, op_name="hinge_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply(fn, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply(fn, *args, op_name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(lambda p, y: -y * jnp.log(p + epsilon)
+                 - (1 - y) * jnp.log(1 - p + epsilon), input, label, op_name="log_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss lands with the audio/speech round")

@@ -1,0 +1,161 @@
+"""Normalization functionals (reference: ``python/paddle/nn/functional/norm.py``
+— SURVEY.md §2.2). batch_norm handles running-stat updates imperatively (the
+caller passes the mutable buffer Tensors, as the reference kernels do)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...autograd.tape import apply, no_grad
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    ns = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+    axes = tuple(range(-len(ns), 0))
+
+    def fn(a, *wb):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(fn, *args, op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (paddle.incubate.nn.functional.fused_rms_norm equivalent)."""
+    def fn(a, *w):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = (x,) + ((weight,) if weight is not None else ())
+    return apply(fn, *args, op_name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats (and update running buffers imperatively)
+        def stats(a):
+            af = a.astype(jnp.float32)
+            m = jnp.mean(af, axis=reduce_axes)
+            v = jnp.var(af, axis=reduce_axes)
+            return m, v
+
+        mean_t, var_t = apply(stats, x, op_name="bn_stats")
+        with no_grad():
+            if running_mean is not None:
+                running_mean._data = (momentum * running_mean._data
+                                      + (1 - momentum) * mean_t._data).astype(running_mean.dtype)
+            if running_var is not None:
+                n = 1
+                for i in reduce_axes:
+                    n *= x.shape[i]
+                unbiased = var_t._data * (n / max(n - 1, 1))
+                running_var._data = (momentum * running_var._data
+                                     + (1 - momentum) * unbiased).astype(running_var.dtype)
+        mean_arg, var_arg = mean_t, var_t
+    else:
+        mean_arg, var_arg = running_mean, running_var
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+
+    def fn(a, m, v, *wb):
+        out = (a - m.reshape(shape).astype(a.dtype)) * \
+            jax.lax.rsqrt(v.reshape(shape).astype(jnp.float32) + epsilon).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x, mean_arg, var_arg) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(fn, *args, op_name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    axes = tuple(range(2, x.ndim))
+
+    def fn(a, *wb):
+        af = a.astype(jnp.float32)
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        v = jnp.var(af, axis=axes, keepdims=True)
+        out = ((af - m) * jax.lax.rsqrt(v + eps)).astype(a.dtype)
+        shape = [1, -1] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(fn, *args, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channels_last = data_format.endswith("C") and not data_format.startswith("NC")
+
+    def fn(a, *wb):
+        if channels_last:  # NHWC-style: channels to axis 1, norm, move back
+            return jnp.moveaxis(_core(jnp.moveaxis(a, -1, 1), *wb), 1, -1)
+        return _core(a, *wb)
+
+    def _core(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        spatial = a.shape[2:]
+        r = a.reshape(n, g, c // g, *spatial).astype(jnp.float32)
+        axes = tuple(range(2, r.ndim))
+        m = jnp.mean(r, axis=axes, keepdims=True)
+        v = jnp.var(r, axis=axes, keepdims=True)
+        out = ((r - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape).astype(a.dtype)
+        shape = [1, -1] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(fn, *args, op_name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(a):
+        sq = jnp.square(a)
+        c = a.shape[1]
+        half = size // 2
+        padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2))
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + padded[:, i:i + c]
+        return a / jnp.power(k + alpha * acc / size, beta)
+
+    return apply(fn, x, op_name="local_response_norm")

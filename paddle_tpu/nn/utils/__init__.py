@@ -1,0 +1,93 @@
+"""nn.utils (reference: ``python/paddle/nn/utils/`` — weight_norm,
+spectral_norm, vector/params helpers)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ..clip_grad import clip_grad_norm_, clip_grad_value_  # noqa: F401
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops.manipulation import concat
+    return concat([p.reshape([-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(vec._data[offset:offset + n].reshape(p._data.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize layer.weight = g * v / ||v|| (recomputed each forward
+    via a pre-hook — functional equivalent of the reference's WeightNorm)."""
+    from ...framework.core import Parameter
+
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w.ndim) if i != (dim if dim is not None else 0))
+    g_init = jnp.sqrt(jnp.sum(jnp.square(w._data), axis=axes, keepdims=True))
+    g = Parameter(g_init)
+    v = Parameter(w._data)
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        vv = lyr._parameters[name + "_v"]
+        gg = lyr._parameters[name + "_g"]
+        norm = (vv * vv).sum(axis=list(axes), keepdim=True).sqrt()
+        setattr_plain(lyr, name, gg * vv / norm)
+        return None
+
+    def setattr_plain(lyr, nm, tensor):
+        object.__setattr__(lyr, nm, tensor)
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+    w = getattr(layer, name)
+    from ...framework.core import Parameter
+    layer.add_parameter(name, Parameter(w._data))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    from ...framework.core import Parameter
+    from ...framework import random as prandom
+    import jax
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    w_mat = jnp.moveaxis(w._data, dim, 0).reshape(w._data.shape[dim], -1)
+    u = jax.random.normal(prandom.next_key(), (w_mat.shape[0],))
+    state = {"u": u / jnp.linalg.norm(u)}
+    orig = Parameter(w._data)
+    layer.add_parameter(name + "_orig", orig)
+    del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        wv = lyr._parameters[name + "_orig"]
+        mat = jnp.moveaxis(wv._data, dim, 0).reshape(wv._data.shape[dim], -1)
+        u_ = state["u"]
+        for _ in range(n_power_iterations):
+            v_ = mat.T @ u_
+            v_ = v_ / jnp.maximum(jnp.linalg.norm(v_), eps)
+            u_ = mat @ v_
+            u_ = u_ / jnp.maximum(jnp.linalg.norm(u_), eps)
+        state["u"] = u_
+        sigma = u_ @ mat @ v_
+        object.__setattr__(lyr, name, Tensor(wv._data / sigma))
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer

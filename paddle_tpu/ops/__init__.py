@@ -1,0 +1,10 @@
+"""Flat op namespace: everything paddle exposes at top level lives here.
+
+Replaces the reference's generated ``_C_ops`` + ``python/paddle/tensor/*``
+wrappers (SURVEY.md §3.1 call stack) — dispatch is the autograd tape in
+``paddle_tpu/autograd/tape.py``; kernels are jnp/lax, compiled by XLA."""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from . import linalg  # noqa: F401

@@ -1,0 +1,228 @@
+"""Tensor creation ops (reference: ``python/paddle/tensor/creation.py`` and
+``python/paddle/tensor/random.py`` — SURVEY.md §2.2; canonical paths, unverified)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor  # noqa: F401  (re-exported)
+from ..framework import dtype as dtypes
+from ..framework import random as prandom
+from ..autograd.tape import apply, defop
+from ..framework.dtype import INT_DTYPE
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return dtypes.convert_dtype(default) if default else None
+    return dtypes.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [int(shape)]
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype, dtypes.get_default_dtype())))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype, dtypes.get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = dtypes.get_default_dtype() if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return Tensor(jnp.zeros(x._data.shape, _dt(dtype) or x.dtype))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return Tensor(jnp.ones(x._data.shape, _dt(dtype) or x.dtype))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full(x._data.shape, fill_value, _dt(dtype) or x.dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (dtypes.get_default_dtype()
+                 if any(isinstance(v, float) for v in (start, end, step)) else "int64")
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype, dtypes.get_default_dtype())))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=_dt(dtype, dtypes.get_default_dtype())))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype, dtypes.get_default_dtype())))
+
+
+@defop
+def tril(x, diagonal=0):
+    return jnp.tril(x, diagonal)
+
+
+@defop
+def triu(x, diagonal=0):
+    return jnp.triu(x, diagonal)
+
+
+@defop
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, offset)
+        mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else \
+            jnp.diag(jnp.ones(x.shape[0], dtype=bool), offset)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, offset)
+
+
+@defop
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, offset)
+
+
+@defop
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = out.at[..., r, c].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@defop
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset, axis1, axis2)
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in
+            (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+def assign(x, output=None):
+    val = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output.set_value(val)
+        return output
+    return Tensor(val)
+
+
+def clone(x):
+    return x.clone()
+
+
+# -- random -----------------------------------------------------------------
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dt = _dt(dtype, dtypes.get_default_dtype())
+    key = prandom.next_key() if not seed else jax.random.key(seed)
+    return Tensor(jax.random.uniform(key, _shape(shape), dt, minval=min, maxval=max))
+
+
+def randn(shape, dtype=None, name=None):
+    dt = _dt(dtype, dtypes.get_default_dtype())
+    return Tensor(jax.random.normal(prandom.next_key(), _shape(shape), dt))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        sh = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(prandom.next_key(), sh) * s + m)
+    dt = dtypes.convert_dtype(dtypes.get_default_dtype())
+    return Tensor(jax.random.normal(prandom.next_key(), _shape(shape), dt) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(prandom.next_key(), _shape(shape), low, high,
+                                     _dt(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or dtypes.dtype_name(x.dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(prandom.next_key(), n).astype(_dt(dtype)))
+
+
+def bernoulli(x, name=None):
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(prandom.next_key(), p).astype(p.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    p = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if replacement:
+        out = jax.random.categorical(prandom.next_key(), logits,
+                                     shape=p.shape[:-1] + (num_samples,), axis=-1)
+    else:
+        # Gumbel top-k without replacement
+        g = jax.random.gumbel(prandom.next_key(), p.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(INT_DTYPE))
+
+
+def poisson(x, name=None):
+    lam = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(prandom.next_key(), lam).astype(lam.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    val = jax.random.exponential(prandom.next_key(), x._data.shape).astype(x.dtype) / lam
+    return x._replace_(val)

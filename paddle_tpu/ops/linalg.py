@@ -1,0 +1,178 @@
+"""paddle.linalg (reference: ``python/paddle/tensor/linalg.py`` — SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..autograd.tape import apply, defop
+
+
+@defop
+def norm(x, p=None, axis=None, keepdim=False):
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+    if axis is None:
+        x = x.reshape(-1)
+        return jnp.linalg.norm(x, ord=2 if p == "fro" else p)
+    if isinstance(axis, (list, tuple)):
+        return jnp.linalg.norm(x, ord="fro" if p == "fro" else p,
+                               axis=tuple(axis), keepdims=keepdim)
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+vector_norm = norm
+
+
+@defop
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+@defop
+def dist(x, y, p=2.0):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+@defop
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+@defop
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rcond=rcond, hermitian=hermitian)
+
+
+@defop
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@defop
+def slogdet(x):
+    s, l = jnp.linalg.slogdet(x)
+    return jnp.stack([s, l])
+
+
+@defop
+def cholesky(x, upper=False):
+    c = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(c, -1, -2).conj() if upper else c
+
+
+@defop
+def cholesky_solve(x, y, upper=False):
+    c = y if not upper else jnp.swapaxes(y, -1, -2)
+    return jax.scipy.linalg.cho_solve((c, True), x)
+
+
+def qr(x, mode="reduced"):
+    return apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, op_name="qr")
+
+
+def svd(x, full_matrices=False):
+    return apply(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                 x, op_name="svd")
+
+
+def eig(x):
+    arr = x.numpy() if isinstance(x, Tensor) else x
+    import numpy as np
+    w, v = np.linalg.eig(arr)
+    return Tensor(w), Tensor(v)
+
+
+def eigh(x, UPLO="L"):
+    return apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, op_name="eigh")
+
+
+@defop
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@defop
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@defop
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    out = apply(lambda a, b: jnp.linalg.lstsq(a, b, rcond=rcond)[0], x, y,
+                op_name="lstsq")
+    return (out,)
+
+
+def lu(x, pivot=True):
+    def fn(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32)
+    return apply(fn, x, op_name="lu")
+
+
+@defop
+def multi_dot(tensors):
+    return jnp.linalg.multi_dot(tensors)
+
+
+@defop
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@defop
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@defop
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@defop
+def householder_product(x, tau):
+    m, n = x.shape[-2], x.shape[-1]
+    eye = jnp.eye(m, dtype=x.dtype)
+
+    def body(i, q):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i]).at[i].set(1.0)
+        h = eye - tau[..., i] * jnp.outer(v, v)
+        return q @ h
+
+    q = eye
+    for i in range(n):
+        q = body(i, q)
+    return q[..., :, :n]
+
+
+@defop
+def pca_lowrank(x, q=None, center=True, niter=2):
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    u, s, v = jnp.linalg.svd(x, full_matrices=False)
+    k = q or min(6, *x.shape[-2:])
+    return u[..., :k], s[..., :k], jnp.swapaxes(v, -1, -2)[..., :k]

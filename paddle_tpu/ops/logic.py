@@ -1,0 +1,140 @@
+"""Comparison / logical / bitwise ops + search & sort (reference:
+``python/paddle/tensor/logic.py``, ``search.py`` — SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..autograd.tape import apply, defop
+from ..framework.dtype import INT_DTYPE
+
+
+def _binop(name, fn):
+    @defop
+    def op(x, y):
+        return fn(x, y)
+    op.__name__ = op.__qualname__ = name
+    return op
+
+
+equal = _binop("equal", jnp.equal)
+not_equal = _binop("not_equal", jnp.not_equal)
+greater_than = _binop("greater_than", jnp.greater)
+greater_equal = _binop("greater_equal", jnp.greater_equal)
+less_than = _binop("less_than", jnp.less)
+less_equal = _binop("less_equal", jnp.less_equal)
+logical_and = _binop("logical_and", jnp.logical_and)
+logical_or = _binop("logical_or", jnp.logical_or)
+logical_xor = _binop("logical_xor", jnp.logical_xor)
+bitwise_and = _binop("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binop("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binop("bitwise_xor", jnp.bitwise_xor)
+
+
+@defop
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@defop
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@defop
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+def in_dynamic_mode():
+    from ..jit.api import in_to_static_mode
+    return not in_to_static_mode()
+
+
+# -- search / sort ----------------------------------------------------------
+
+@defop
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(INT_DTYPE)
+
+
+@defop
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(INT_DTYPE)
+
+
+@defop
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(INT_DTYPE)
+
+
+@defop
+def sort(x, axis=-1, descending=False, stable=True):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def fn(a):
+        ax = (a.ndim - 1) if axis is None else axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(moved, k)
+        else:
+            v, i = jax.lax.top_k(-moved, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax).astype(INT_DTYPE)
+
+    return apply(fn, x, op_name="topk")
+
+
+@defop
+def kthvalue(x, k, axis=-1, keepdim=False):
+    v = jnp.sort(x, axis=axis)
+    i = jnp.argsort(x, axis=axis).astype(INT_DTYPE)
+    taken_v = jnp.take(v, k - 1, axis=axis)
+    taken_i = jnp.take(i, k - 1, axis=axis)
+    if keepdim:
+        taken_v = jnp.expand_dims(taken_v, axis)
+        taken_i = jnp.expand_dims(taken_i, axis)
+    return taken_v, taken_i
+
+
+@defop
+def mode(x, axis=-1, keepdim=False):
+    ax = axis % x.ndim
+    moved = jnp.moveaxis(x, ax, -1)  # [..., n]
+    eq = jnp.equal(moved[..., :, None], moved[..., None, :])
+    counts = jnp.sum(eq, axis=-1)  # [..., n] occurrences of each element
+    idx = jnp.argmax(counts, axis=-1).astype(INT_DTYPE)
+    vals = jnp.take_along_axis(moved, idx[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals = jnp.expand_dims(vals, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return vals, idx
+
+
+@defop
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else INT_DTYPE)
+
+
+@defop
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, x, side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else INT_DTYPE)

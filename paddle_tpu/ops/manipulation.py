@@ -1,0 +1,432 @@
+"""Shape/layout manipulation ops (reference: ``python/paddle/tensor/
+manipulation.py`` — SURVEY.md §2.2; canonical paths, unverified)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework import dtype as dtypes
+from ..autograd.tape import apply, defop
+from ..framework.dtype import INT_DTYPE
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    sh = _static_shape(shape)
+    return apply(lambda a: jnp.reshape(a, sh), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    return x._replace_(out._data, out._grad_node, out._out_idx)
+
+
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+@defop
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    start = start_axis % nd if nd else 0
+    stop = stop_axis % nd if nd else 0
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = None
+    if axis is not None:
+        axis = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+    return apply(lambda a: jnp.squeeze(a, ax), x, op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    return x._replace_(out._data, out._grad_node, out._out_idx)
+
+
+def unsqueeze(x, axis, name=None):
+    axis = axis if isinstance(axis, (list, tuple)) else [axis]
+    axis = tuple(int(a.item()) if isinstance(a, Tensor) else int(a) for a in axis)
+    return apply(lambda a: jnp.expand_dims(a, axis), x, op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    return x._replace_(out._data, out._grad_node, out._out_idx)
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return apply(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+
+
+@defop
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@defop
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = list(x)
+    return apply(lambda *ts: jnp.concatenate(ts, axis=axis), *tensors, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply(lambda *ts: jnp.stack(ts, axis=axis), *tensors, op_name="stack")
+
+
+def hstack(x):
+    return apply(lambda *ts: jnp.hstack(ts), *list(x), op_name="hstack")
+
+
+def vstack(x):
+    return apply(lambda *ts: jnp.vstack(ts), *list(x), op_name="vstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis % x.ndim] if hasattr(x, "ndim") else None
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        if dim % n != 0:
+            raise ValueError(
+                f"split: axis dim {dim} is not divisible by num {n}")
+        sizes = [dim // n] * n
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if any(s == -1 for s in sizes):
+            rest = dim - builtins_sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(a):
+        return tuple(jax.lax.slice_in_dim(a, o, o + s, axis=axis % a.ndim)
+                     for o, s in zip(offsets, sizes))
+
+    return list(apply(fn, x, op_name="split"))
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0):
+    n = x.shape[axis % x.ndim]
+
+    def fn(a):
+        return tuple(jnp.squeeze(jax.lax.slice_in_dim(a, i, i + 1, axis=axis % a.ndim),
+                                 axis % a.ndim) for i in range(n))
+
+    return list(apply(fn, x, op_name="unbind"))
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+@defop
+def tile(x, repeat_times):
+    rt = tuple(int(r) for r in repeat_times)
+    if len(rt) > x.ndim:
+        x = jnp.reshape(x, (1,) * (len(rt) - x.ndim) + x.shape)
+    return jnp.tile(x, rt)
+
+
+def expand(x, shape, name=None):
+    sh = _static_shape(shape)
+    sh = tuple(x.shape[i - (len(sh) - x.ndim)] if s == -1 else s for i, s in enumerate(sh))
+    return apply(lambda a: jnp.broadcast_to(a, sh), x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs):
+    arrs = [t._data for t in inputs]
+    sh = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [expand(t, sh) for t in inputs]
+
+
+@defop
+def flip(x, axis):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return jnp.flip(x, ax)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return apply(lambda a: jnp.rot90(a, k, axes), x, op_name="rot90")
+
+
+@defop
+def roll(x, shifts, axis=None):
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else shifts
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.roll(x, sh, ax)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = repeats._data
+        total = int(repeats.sum())
+        return apply(lambda a: jnp.repeat(a, repeats, axis=axis, total_repeat_length=total),
+                     x, op_name="repeat_interleave")
+    return apply(lambda a: jnp.repeat(a, repeats, axis=axis), x, op_name="repeat_interleave")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pad applies to the last len(pad)//2 spatial dims,
+        # ordered from the last dim backwards: [left, right, top, bottom, ...]
+        width = [(0, 0)] * nd
+        np_ = len(pad) // 2
+        if mode == "constant" and len(pad) % 2 == 0 and nd >= np_:
+            for i in range(np_):
+                width[nd - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+        else:
+            for i in range(np_):
+                width[nd - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    kw = {"constant_values": value} if jmode == "constant" else {}
+    return apply(lambda a: jnp.pad(a, width, mode=jmode, **kw), x, op_name="pad")
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def numel(x):
+    return Tensor(jnp.asarray(x.size, INT_DTYPE))
+
+
+@defop
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@defop
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def tensordot(x, y, axes=2):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y, op_name="tensordot")
+
+
+@defop
+def take_along_axis(arr, indices, axis, broadcast=True):
+    idx = indices
+    if broadcast:
+        dst = list(arr.shape)
+        dst[axis] = idx.shape[axis]
+        idx = jnp.broadcast_to(idx, tuple(dst))
+    return jnp.take_along_axis(arr, idx, axis=axis)
+
+
+@defop
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True):
+    vals = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape) \
+        if not hasattr(values, "shape") or values.shape != indices.shape else values
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, vals, axis=axis, inplace=False)
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(arr.ndim)])
+           for d, s in enumerate(indices.shape)]
+    idx[axis] = indices
+    if reduce in ("add", "sum"):
+        return arr.at[tuple(idx)].add(vals)
+    if reduce in ("mul", "multiply"):
+        return arr.at[tuple(idx)].multiply(vals)
+    if reduce == "amax":
+        return arr.at[tuple(idx)].max(vals)
+    if reduce == "amin":
+        return arr.at[tuple(idx)].min(vals)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+@defop
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@defop
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@defop
+def gather(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1) if index.ndim > 1 else index, axis=axis)
+
+
+@defop
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@defop
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle: overwrite=False means accumulate — but zero out first occurrence sems:
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@defop
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    z = Tensor(jnp.zeros(_static_shape(shape), updates.dtype))
+    return scatter_nd_add(z, index, updates)
+
+
+@defop
+def index_add(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+@defop
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(i for i in indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+@defop
+def masked_select(x, mask):
+    # dynamic-shaped output: eager-only op (cannot jit); fine for API parity
+    return x[mask]
+
+
+@defop
+def masked_fill(x, mask, value):
+    v = value if not hasattr(value, "shape") else value
+    return jnp.where(mask, v, x)
+
+
+@defop
+def masked_scatter(x, mask, value):
+    flat_val = value.reshape(-1)
+    cnt = jnp.cumsum(mask.reshape(-1).astype(jnp.int32)) - 1
+    gathered = flat_val[jnp.clip(cnt, 0, flat_val.shape[0] - 1)].reshape(x.shape)
+    return jnp.where(mask, gathered, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where")
+
+
+def nonzero(x, as_tuple=False):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    nz = jnp.nonzero(arr)  # eager-only (dynamic shape)
+    if as_tuple:
+        return tuple(Tensor(n.reshape(-1, 1).astype(INT_DTYPE)) for n in nz)
+    return Tensor(jnp.stack(nz, axis=1).astype(INT_DTYPE))
+
+
+def slice(input, axes, starts, ends):
+    idx = [builtins_slice(None)] * input.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st.item()) if isinstance(st, Tensor) else int(st)
+        en = int(en.item()) if isinstance(en, Tensor) else int(en)
+        idx[ax] = builtins_slice(st, en)
+    return apply(lambda a: a[tuple(idx)], input, op_name="slice")
+
+
+def builtins_slice(*args):
+    import builtins
+    return builtins.slice(*args)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [builtins_slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        idx[ax] = builtins_slice(int(st), int(en), int(sr))
+    return apply(lambda a: a[tuple(idx)], x, op_name="strided_slice")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(a):
+        size = (index_num + nshards - 1) // nshards
+        lo = shard_id * size
+        in_shard = (a >= lo) & (a < lo + size)
+        return jnp.where(in_shard, a - lo, ignore_value)
+    return apply(fn, input, op_name="shard_index")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    res = jnp.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    if axis is not None:
+        raise NotImplementedError
+    flat = arr.reshape(-1)
+    keep = np.ones(flat.shape[0], dtype=bool)
+    keep[1:] = flat[1:] != flat[:-1]
+    out = [Tensor(flat[keep])]
+    if return_inverse:
+        out.append(Tensor(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        out.append(Tensor(np.diff(np.append(idx, flat.shape[0]))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32),
+                 x, op_name="one_hot")

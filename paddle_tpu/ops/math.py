@@ -1,0 +1,492 @@
+"""Elementwise math + reductions (reference: ``python/paddle/tensor/math.py``,
+``stat.py``, ``ops.py`` — SURVEY.md §2.2; canonical paths, unverified).
+
+Every op is a thin pure-jnp function wrapped by the autograd dispatcher
+(:func:`paddle_tpu.autograd.tape.defop`); XLA does the fusion."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework import dtype as dtypes
+from ..autograd.tape import apply, defop
+from ..framework.dtype import INT_DTYPE
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+
+@defop
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@defop
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@defop
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@defop
+def divide(x, y):
+    return jnp.true_divide(x, y)
+
+
+@defop
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@defop
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+floor_mod = mod
+
+
+@defop
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@defop
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@defop
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@defop
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@defop
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@defop
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@defop
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@defop
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@defop
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@defop
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@defop
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@defop
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@defop
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@defop
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def divide_no_nan(x, y):
+    return apply(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(b == 0, 1.0, b)),
+                 x, y, op_name="divide_no_nan")
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+
+def _unary(name, fn):
+    @defop
+    def op(x):
+        return fn(x)
+    op.__name__ = op.__qualname__ = name
+    return op
+
+
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+neg = _unary("neg", jnp.negative)
+negative = neg
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda x: x - jnp.trunc(x))
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logit = _unary("logit", jax.scipy.special.logit)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+i0 = _unary("i0", jax.scipy.special.i0)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+
+
+@defop
+def clip(x, min=None, max=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return jnp.clip(x, mn, mx)
+
+
+@defop
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@defop
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@defop
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # [n, batch, ...]
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+@defop
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop
+def trapezoid(y, x=None, dx=None, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=1.0 if dx is None and x is None else dx, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+@defop
+def sum(x, axis=None, dtype=None, keepdim=False):
+    dt = dtypes.convert_dtype(dtype) if dtype else None
+    return jnp.sum(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@defop
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def prod(x, axis=None, keepdim=False, dtype=None):
+    dt = dtypes.convert_dtype(dtype) if dtype else None
+    return jnp.prod(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@defop
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@defop
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=_axis(axis),
+                      dtype=dtypes.convert_dtype(dtype) if dtype else None,
+                      keepdims=keepdim)
+
+
+@defop
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim).astype(INT_DTYPE)
+
+
+@defop
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=int(axis),
+                      dtype=dtypes.convert_dtype(dtype) if dtype else None)
+
+
+@defop
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=int(dim),
+                       dtype=dtypes.convert_dtype(dtype) if dtype else None)
+
+
+def _cum_extreme(x, axis, is_max):
+    """(values, indices) running max/min via pairwise associative scan;
+    ties keep the earliest index (paddle/torch semantics)."""
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    idx_shape = [1] * x.ndim
+    idx_shape[axis] = x.shape[axis]
+    idx = jnp.broadcast_to(
+        jnp.arange(x.shape[axis]).reshape(idx_shape), x.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = (bv > av) if is_max else (bv < av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    vals, inds = jax.lax.associative_scan(combine, (x, idx), axis=axis)
+    return vals, inds.astype(INT_DTYPE)
+
+
+@defop
+def cummax(x, axis=None):
+    return _cum_extreme(x, axis, True)
+
+
+@defop
+def cummin(x, axis=None):
+    return _cum_extreme(x, axis, False)
+
+
+@defop
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.log(jnp.cumsum(jnp.exp(x - jax.lax.stop_gradient(jnp.max(x))), axis=axis)) \
+        + jax.lax.stop_gradient(jnp.max(x))
+
+
+# ---------------------------------------------------------------------------
+# matrix
+# ---------------------------------------------------------------------------
+
+@defop
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim >= 2 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim >= 2 else y
+    return jnp.matmul(x, y)
+
+
+mm = matmul
+
+
+@defop
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@defop
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@defop
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@defop
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@defop
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else (x.ndim - 1 if x.shape[-1] == 3 else
+                                 next(i for i, s in enumerate(x.shape) if s == 3))
+    return jnp.cross(x, y, axis=ax)
+
+
+@defop
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset, axis1, axis2)
+
+
+@defop
+def t(x):
+    return x.T if x.ndim <= 2 else jnp.swapaxes(x, -1, -2)
+
+
+def einsum(equation, *operands):
+    return apply(lambda *ops: jnp.einsum(equation, *ops), *operands, op_name="einsum")
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+@defop
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@defop
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@defop
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y, op_name="isclose")
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                 x, y, op_name="allclose")
+
+
+def equal_all(x, y):
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y, op_name="equal_all")
+
+
+@defop
+def histogram(x, bins=100, min=0, max=0):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    h, _ = jnp.histogram(x, bins=bins, range=rng)
+    return h.astype(INT_DTYPE)
+
+
+@defop
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+@defop
+def increment(x, value=1.0):
+    return x + value

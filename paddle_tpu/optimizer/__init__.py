@@ -1,0 +1,403 @@
+"""paddle.optimizer (reference: ``python/paddle/optimizer/`` — SURVEY.md §2.2:
+Optimizer base with param groups, grad clip, regularizer; SGD/Momentum/Adam/
+AdamW/... with multi_precision master weights).
+
+Each optimizer exposes a *functional core* — ``_init_slots(p)`` and
+``_apply(p, g, slots, lr, t)`` on raw jnp arrays — used both by the eager
+``step()`` (mutating Tensors in place, Paddle semantics) and by the jitted
+whole-tree train step in ``paddle_tpu/parallel/engine.py`` (the perf path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, Parameter
+from ..autograd.tape import no_grad
+from . import lr as lr_mod
+from .lr import LRScheduler
+from ..nn.clip_grad import ClipGradBase
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self.regularization = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._slots: dict[int, dict] = {}
+        self._step_t: dict[int, int] = {}
+        self._name = name
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state --------------------------------------------------------------
+    def _wd_coeff(self, param):
+        wd = self.regularization
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "_coeff"):  # L2Decay object
+            return float(wd._coeff)
+        return float(wd)
+
+    def _get_slots(self, p: Parameter):
+        key = id(p)
+        if key not in self._slots:
+            slots = self._init_slots(p._data)
+            if self._multi_precision and p.dtype in (jnp.float16, jnp.bfloat16):
+                slots["master"] = p._data.astype(jnp.float32)
+            self._slots[key] = slots
+            self._step_t[key] = 0
+        return self._slots[key]
+
+    # -- functional core (override per optimizer) ---------------------------
+    def _init_slots(self, p):
+        return {}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        raise NotImplementedError
+
+    # -- the eager step ------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if p.grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            group_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            slots = self._get_slots(p)
+            self._step_t[id(p)] += 1
+            t = self._step_t[id(p)]
+            wd = self._wd_coeff(p) if getattr(p, "regularizer", None) is None \
+                else float(getattr(p.regularizer, "_coeff", 0.0))
+            g_arr = g._data
+            if "master" in slots:
+                p_arr = slots["master"]
+                g_arr = g_arr.astype(jnp.float32)
+            else:
+                p_arr = p._data
+            new_p, new_slots = self._apply(p_arr, g_arr, slots, group_lr, t, wd)
+            if "master" in slots:
+                new_slots["master"] = new_p
+                p._data = new_p.astype(p.dtype)
+            else:
+                p._data = new_p
+            self._slots[id(p)] = new_slots
+        return None
+
+    minimize = None  # set below
+
+    def _minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    # -- checkpointing -------------------------------------------------------
+    def state_dict(self):
+        out = {}
+        for p in self._parameter_list:
+            key = id(p)
+            if key in self._slots:
+                for sname, arr in self._slots[key].items():
+                    out[f"{p.name}_{sname}"] = Tensor(arr)
+                out[f"{p.name}_step"] = self._step_t[key]
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        if "LR_Scheduler" in state and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        for p in self._parameter_list:
+            slots = self._get_slots(p)
+            for sname in list(slots):
+                k = f"{p.name}_{sname}"
+                if k in state:
+                    v = state[k]
+                    slots[sname] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+            k = f"{p.name}_step"
+            if k in state:
+                self._step_t[id(p)] = int(state[k])
+
+    set_dict = set_state_dict
+
+
+Optimizer.minimize = Optimizer._minimize
+
+
+class SGD(Optimizer):
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p, dtype=jnp.float32)
+                if p.dtype in (jnp.float16, jnp.bfloat16) else jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            p = p - lr * (g + self._momentum * v)
+        else:
+            p = p - lr * v
+        return p, {**slots, "velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, p):
+        f32 = p.dtype in (jnp.float16, jnp.bfloat16)
+        z = jnp.zeros_like(p, dtype=jnp.float32) if f32 else jnp.zeros_like(p)
+        return {"moment1": z, "moment2": z}
+
+    def _decoupled(self):
+        return False
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd and not self._decoupled():
+            g = g + wd * p
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        if wd and self._decoupled():
+            p = p * (1 - lr * wd)
+        p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p, {**slots, "moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decoupled(self):
+        return True
+
+    @no_grad()
+    def step(self):
+        if self._apply_decay_param_fun is not None:
+            # temporarily zero decay for excluded params via regularizer override
+            saved = {}
+            for p in self._parameter_list:
+                if not self._apply_decay_param_fun(p.name):
+                    saved[id(p)] = p.regularizer
+                    p.regularizer = _ZeroDecay()
+            try:
+                super().step()
+            finally:
+                for p in self._parameter_list:
+                    if id(p) in saved:
+                        p.regularizer = saved[id(p)]
+        else:
+            super().step()
+
+
+class _ZeroDecay:
+    _coeff = 0.0
+
+
+class Adamax(Adam):
+    def _init_slots(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g))
+        p = p - lr / (1 - self._beta1 ** t) * m / (u + self._epsilon)
+        return p, {**slots, "moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        acc = slots["moment"] + g * g
+        p = p - lr * g / (jnp.sqrt(acc) + self._epsilon)
+        return p, {**slots, "moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _init_slots(self, p):
+        return {"mean_square": jnp.zeros_like(p), "mean_grad": jnp.zeros_like(p),
+                "momentum": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            mg = slots["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        return p - mom, {**slots, "mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_slots(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p),
+                "avg_squared_update": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * g * g
+        update = g * jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * update * update
+        return p - lr * update, {**slots, "avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._excluded_now = set()
+        self._current_param = None
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    @no_grad()
+    def step(self):
+        # resolve exclude_from_weight_decay_fn per parameter before updates
+        if self._exclude_fn is not None:
+            self._excluded_now = {id(p) for p in self._parameter_list
+                                  if self._exclude_fn(p)}
+        else:
+            self._excluded_now = set()
+        self._current_param = None
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if p.grad is not None and p.trainable]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        for p, g in params_grads:
+            self._current_param = p
+            slots = self._get_slots(p)
+            self._step_t[id(p)] += 1
+            new_p, new_slots = self._apply(p._data, g._data, slots, lr,
+                                           self._step_t[id(p)], 0.0)
+            p._data = new_p
+            self._slots[id(p)] = new_slots
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * g * g
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        wd_coeff = 0.0 if (self._current_param is not None
+                           and id(self._current_param) in self._excluded_now) \
+            else self._lamb_wd
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd_coeff * p
+        w_norm = jnp.linalg.norm(p)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {**slots, "moment1": m, "moment2": v}
+
+
+# regularizers (paddle.regularizer)
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = coeff

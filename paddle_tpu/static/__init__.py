@@ -1,0 +1,121 @@
+"""paddle.static facade (reference: ``python/paddle/static/`` — SURVEY.md §2.2).
+
+TPU-native design (SURVEY.md §7.0): the static graph Program is a facade over
+a traced+lowered jax function — no ProgramDesc protobuf. ``Executor.run`` is
+feed/fetch over compiled calls. The dygraph ``to_static`` path (paddle_tpu/jit)
+is the primary compile path; this module exists for API-surface compatibility
+with static-mode scripts and grows as static-mode features are ported.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..jit.api import InputSpec  # noqa: F401
+from ..framework.core import Tensor, current_place, CPUPlace, TPUPlace, CUDAPlace  # noqa: F401
+
+
+class Program:
+    """Facade: records data() placeholders and a traced fn when compiled."""
+
+    def __init__(self):
+        self._inputs = []
+        self._fetch = []
+        self.random_seed = None
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        return copy.copy(self)
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program():
+    return _default_main[0]
+
+
+def default_startup_program():
+    return _default_startup[0]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_m, prev_s = _default_main[0], _default_startup[0]
+    _default_main[0] = main_program
+    if startup_program is not None:
+        _default_startup[0] = startup_program
+    try:
+        yield
+    finally:
+        _default_main[0], _default_startup[0] = prev_m, prev_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    spec = InputSpec(shape, dtype, name)
+    default_main_program()._inputs.append(spec)
+    return spec
+
+
+class Executor:
+    """Static executor facade: run(feed, fetch_list) executes the fetches'
+    traced computation. In this build, static programs are built by running
+    eager code under ``paddle.enable_static()`` compatibility shims; prefer
+    ``@to_static``. run() accepts callables or Tensors as fetch targets."""
+
+    def __init__(self, place=None):
+        self.place = place or current_place()
+
+    def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
+        outs = []
+        for f in (fetch_list or []):
+            if callable(f):
+                out = f(**(feed or {}))
+            else:
+                out = f
+            if isinstance(out, Tensor):
+                outs.append(out.numpy() if return_numpy else out)
+            else:
+                outs.append(out)
+        return outs
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+
+class BuildStrategy:
+    def __init__(self):
+        self.build_cinn_pass = False
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
+    raise NotImplementedError(
+        "static save_inference_model: use paddle.jit.save (StableHLO export)")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError(
+        "static load_inference_model: use paddle.jit.load")
+
+
+def name_scope(prefix=None):
+    return contextlib.nullcontext()
+
+
+class nn:
+    @staticmethod
+    def fc(x, size, **kw):
+        raise NotImplementedError("static.nn: use paddle.nn.Linear")

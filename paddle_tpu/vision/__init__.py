@@ -1,0 +1,12 @@
+"""paddle.vision (reference: ``python/paddle/vision/`` — SURVEY.md §2.2)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+
+
+def set_image_backend(backend):
+    pass
+
+
+def get_image_backend():
+    return "numpy"

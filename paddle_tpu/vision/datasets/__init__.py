@@ -1,0 +1,163 @@
+"""paddle.vision.datasets (reference: ``python/paddle/vision/datasets/`` —
+Cifar10/100, MNIST, Flowers; SURVEY.md §2.2).
+
+Zero-egress environment: loaders read standard local archive layouts if
+present (``download=True`` raises a clear error when files are missing) and a
+``FakeData`` dataset provides deterministic synthetic data for tests/benches.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+_DEFAULT_ROOT = os.path.expanduser("~/.cache/paddle/dataset")
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification dataset."""
+
+    def __init__(self, size=256, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        rng = np.random.RandomState(seed)
+        self.images = rng.randint(0, 256, (size,) + self.image_shape[1:] +
+                                  (self.image_shape[0],), dtype=np.uint8)
+        self.labels = rng.randint(0, num_classes, (size,), dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = np.transpose(img.astype(np.float32) / 255.0, (2, 0, 1))
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return self.size
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the standard ``cifar-10-python.tar.gz`` / extracted
+    ``cifar-10-batches-py`` layout under ``data_file`` or the default cache."""
+
+    MEAN = [0.4914, 0.4822, 0.4465]
+    STD = [0.2470, 0.2435, 0.2616]
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        data, labels = self._load(data_file)
+        self.data = data
+        self.labels = labels
+
+    def _candidate_paths(self, data_file):
+        cands = []
+        if data_file:
+            cands.append(data_file)
+        cands += [
+            os.path.join(_DEFAULT_ROOT, "cifar", "cifar-10-python.tar.gz"),
+            os.path.join(_DEFAULT_ROOT, "cifar-10-python.tar.gz"),
+            os.path.join(_DEFAULT_ROOT, "cifar", "cifar-10-batches-py"),
+        ]
+        return cands
+
+    def _load(self, data_file):
+        batches = [f"data_batch_{i}" for i in range(1, 6)] \
+            if self.mode == "train" else ["test_batch"]
+        for path in self._candidate_paths(data_file):
+            if not path or not os.path.exists(path):
+                continue
+            if path.endswith(".tar.gz"):
+                data, labels = [], []
+                with tarfile.open(path) as tf:
+                    for b in batches:
+                        f = tf.extractfile(f"cifar-10-batches-py/{b}")
+                        d = pickle.load(f, encoding="bytes")
+                        data.append(d[b"data"])
+                        labels.extend(d[b"labels"])
+                return (np.concatenate(data).reshape(-1, 3, 32, 32),
+                        np.asarray(labels, np.int64))
+            if os.path.isdir(path):
+                data, labels = [], []
+                for b in batches:
+                    with open(os.path.join(path, b), "rb") as f:
+                        d = pickle.load(f, encoding="bytes")
+                    data.append(d[b"data"])
+                    labels.extend(d[b"labels"])
+                return (np.concatenate(data).reshape(-1, 3, 32, 32),
+                        np.asarray(labels, np.int64))
+        raise FileNotFoundError(
+            "CIFAR-10 archive not found locally and downloads are disabled in "
+            "this environment; place cifar-10-python.tar.gz under "
+            f"{_DEFAULT_ROOT}/cifar/ or use vision.datasets.FakeData")
+
+    def __getitem__(self, idx):
+        img = np.transpose(self.data[idx], (1, 2, 0))  # HWC uint8
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = np.transpose(img.astype(np.float32) / 255.0, (2, 0, 1))
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    def _load(self, data_file):
+        fname = "train" if self.mode == "train" else "test"
+        for path in [data_file,
+                     os.path.join(_DEFAULT_ROOT, "cifar", "cifar-100-python.tar.gz")]:
+            if not path or not os.path.exists(path):
+                continue
+            with tarfile.open(path) as tf:
+                f = tf.extractfile(f"cifar-100-python/{fname}")
+                d = pickle.load(f, encoding="bytes")
+            return (d[b"data"].reshape(-1, 3, 32, 32),
+                    np.asarray(d[b"fine_labels"], np.int64))
+        raise FileNotFoundError("CIFAR-100 archive not found locally")
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.transform = transform
+        prefix = "train" if mode == "train" else "t10k"
+        root = os.path.join(_DEFAULT_ROOT, "mnist")
+        image_path = image_path or os.path.join(root, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(root, f"{prefix}-labels-idx1-ubyte.gz")
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise FileNotFoundError(
+                f"MNIST files not found at {root}; downloads disabled — "
+                "use vision.datasets.FakeData for synthetic data")
+        with gzip.open(image_path, "rb") as f:
+            buf = f.read()
+            self.images = np.frombuffer(buf, np.uint8, offset=16).reshape(-1, 28, 28)
+        with gzip.open(label_path, "rb") as f:
+            buf = f.read()
+            self.labels = np.frombuffer(buf, np.uint8, offset=8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, int(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass

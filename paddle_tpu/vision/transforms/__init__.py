@@ -1,0 +1,266 @@
+"""paddle.vision.transforms (reference: ``python/paddle/vision/transforms/`` —
+numpy/HWC-based preprocessing; SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+def _to_hwc_array(img):
+    if isinstance(img, Tensor):
+        return img.numpy()
+    return np.asarray(img)
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.astype(np.float32)
+        if arr.max() > 1.0 + 1e-6 or arr.dtype == np.uint8:
+            arr = arr / 255.0
+        if self.data_format == "CHW":
+            arr = np.transpose(arr, (2, 0, 1))
+        return Tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        is_tensor = isinstance(img, Tensor)
+        arr = img.numpy() if is_tensor else np.asarray(img, np.float32)
+        shape = [-1, 1, 1] if self.data_format == "CHW" else [1, 1, -1]
+        arr = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return Tensor(arr.astype(np.float32)) if is_tensor else arr.astype(np.float32)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        import jax
+        import jax.numpy as jnp
+        squeeze = arr.ndim == 2
+        if squeeze:
+            arr = arr[:, :, None]
+        method = {"bilinear": "linear", "nearest": "nearest",
+                  "bicubic": "cubic"}.get(self.interpolation, "linear")
+        out = jax.image.resize(jnp.asarray(arr, jnp.float32),
+                               (self.size[0], self.size[1], arr.shape[2]), method)
+        out = np.asarray(out)
+        if arr.dtype == np.uint8:
+            out = np.clip(out, 0, 255).astype(np.uint8)
+        return out[:, :, 0] if squeeze else out
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else (self.padding,) * 4
+            if len(p) == 2:
+                p = (p[0], p[1], p[0], p[1])
+            pads = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pads)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return arr[i:i + th, j:j + tw]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(_to_hwc_array(img)[:, ::-1])
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(_to_hwc_array(img)[::-1])
+        return img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                return self._resize(arr[i:i + th, j:j + tw])
+        return self._resize(CenterCrop(min(h, w))(arr))
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return np.transpose(arr, self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img).astype(np.float32)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(arr * f, 0, 255).astype(np.uint8)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.brightness = brightness
+        self.contrast = contrast
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img).astype(np.float32)
+        if self.brightness:
+            arr = arr * np.random.uniform(max(0, 1 - self.brightness),
+                                          1 + self.brightness)
+        if self.contrast:
+            mean = arr.mean()
+            arr = (arr - mean) * np.random.uniform(max(0, 1 - self.contrast),
+                                                   1 + self.contrast) + mean
+        return np.clip(arr, 0, 255).astype(np.uint8)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        p = padding if isinstance(padding, (list, tuple)) else (padding,) * 4
+        if len(p) == 2:
+            p = (p[0], p[1], p[0], p[1])
+        self.p = p
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        pads = [(self.p[1], self.p[3]), (self.p[0], self.p[2])] + \
+            [(0, 0)] * (arr.ndim - 2)
+        return np.pad(arr, pads, constant_values=self.fill)
+
+
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return np.ascontiguousarray(_to_hwc_array(img)[:, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(_to_hwc_array(img)[::-1])
+
+
+def crop(img, top, left, height, width):
+    return _to_hwc_array(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)

@@ -1,0 +1,32 @@
+"""Test config: force a CPU backend with 8 virtual devices BEFORE any backend
+initialization, so distributed tests can build a [dp, pp, sharding, sep, mp]
+mesh without TPU hardware (SURVEY.md §4 takeaway 4).
+
+Note: this environment's sitecustomize registers an 'axon' TPU plugin and
+programmatically sets jax_platforms='axon,cpu'; a plain JAX_PLATFORMS env var
+is NOT enough — we must override via jax.config before the first dispatch,
+otherwise every test process tries to claim the single TPU tunnel."""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# full-precision matmuls for numeric parity checks (the perf path uses the
+# backend default — bf16 passes on TPU MXU)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+    paddle.seed(102)
+    np.random.seed(102)
+    yield

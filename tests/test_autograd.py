@@ -1,0 +1,197 @@
+"""Autograd tape semantics vs analytic/numeric references (the OpTest
+check_grad analogue — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def _leaf(val):
+    t = paddle.to_tensor(val)
+    t.stop_gradient = False
+    return t
+
+
+def test_simple_backward():
+    x = _leaf([1.0, 2.0, 3.0])
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_chain_and_accumulation():
+    x = _leaf([2.0])
+    y = x * 3.0
+    z1 = y * y      # dz1/dx = 18x = 36
+    z2 = y + 1.0    # dz2/dx = 3
+    (z1 + z2).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [39.0])
+
+
+def test_stop_gradient_blocks():
+    x = _leaf([1.0])
+    w = paddle.to_tensor([5.0])  # stop_gradient=True
+    y = x * w
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    assert w.grad is None
+
+
+def test_backward_twice_raises():
+    x = _leaf([1.0])
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_grad_accumulates_across_backwards():
+    x = _leaf([1.0])
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_matmul_grad_matches_numeric():
+    rng = np.random.RandomState(0)
+    a_np = rng.randn(3, 4).astype(np.float32)
+    b_np = rng.randn(4, 2).astype(np.float32)
+    a, b = _leaf(a_np), _leaf(b_np)
+    loss = paddle.matmul(a, b).sum()
+    loss.backward()
+    # analytic: dL/da = ones @ b.T
+    np.testing.assert_allclose(a.grad.numpy(),
+                               np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(),
+                               a_np.T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_paddle_grad_api():
+    x = _leaf([3.0])
+    y = _leaf([4.0])
+    z = x * x * y
+    gx, gy = paddle.grad(z, [x, y])
+    np.testing.assert_allclose(gx.numpy(), [24.0])
+    np.testing.assert_allclose(gy.numpy(), [9.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_grad_unused_input():
+    x = _leaf([1.0])
+    y = _leaf([1.0])
+    z = x * 2
+    with pytest.raises(ValueError):
+        paddle.grad(z, [x, y], allow_unused=False)
+    gs = paddle.grad(z, [x, y], allow_unused=True)
+    assert gs[1] is None
+
+
+def test_no_grad_context():
+    x = _leaf([1.0])
+    with paddle.no_grad():
+        y = x * x
+    assert y._grad_node is None
+
+    @paddle.no_grad()
+    def f(t):
+        return t * 2
+
+    assert f(x)._grad_node is None
+
+
+def test_hooks():
+    x = _leaf([1.0])
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_retain_grads_intermediate():
+    x = _leaf([2.0])
+    y = x * 3
+    y.retain_grads()
+    z = y * y
+    z.backward()
+    np.testing.assert_allclose(y.grad.numpy(), [12.0])
+
+
+def test_indexing_grad():
+    x = _leaf(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = x[0].sum() + 2 * x[1, 2]
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 1, 1], [0, 0, 2]])
+
+
+def test_setitem_grad():
+    x = _leaf(np.zeros(3, np.float32))
+    v = _leaf([5.0])
+    x[1] = v[0] * 2
+    x.sum().backward()
+    np.testing.assert_allclose(v.grad.numpy(), [2.0])
+
+
+def test_concat_split_grad():
+    a = _leaf([1.0, 2.0])
+    b = _leaf([3.0])
+    c = paddle.concat([a, b])
+    (c * paddle.to_tensor([1.0, 2.0, 3.0])).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [1, 2])
+    np.testing.assert_allclose(b.grad.numpy(), [3])
+    x = _leaf(np.arange(6, dtype=np.float32))
+    parts = paddle.split(x, 3)
+    parts[1].sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 0, 1, 1, 0, 0])
+
+
+def test_topk_mixed_output_grad():
+    x = _leaf([1.0, 9.0, 3.0, 7.0])
+    vals, idx = paddle.topk(x, 2)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0, 1, 0, 1])
+    assert idx.stop_gradient
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = _leaf([3.0])
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [6.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_inplace_autograd():
+    x = _leaf([1.0, 2.0])
+    w = _leaf([3.0, 4.0])
+    y = x * w
+    y.add_(x)          # y = x*w + x, in-place on y
+    y.sum().backward()
+    np.testing.assert_allclose(w.grad.numpy(), [1.0, 2.0])
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 5.0])
+
+
+def test_broadcast_grad():
+    x = _leaf(np.ones((3, 1), np.float32))
+    y = _leaf(np.ones((1, 4), np.float32))
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 1), 4.0))
+    np.testing.assert_allclose(y.grad.numpy(), np.full((1, 4), 3.0))

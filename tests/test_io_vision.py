@@ -1,0 +1,119 @@
+"""DataLoader / sampler / transforms / vision models (SURVEY.md §3.5)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import io
+from paddle_tpu.vision import datasets, transforms, models
+
+
+class _SquareDataset(io.Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.float32([i * i])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_single_process():
+    dl = io.DataLoader(_SquareDataset(), batch_size=4, shuffle=False,
+                       drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert isinstance(x, paddle.Tensor)
+    assert x.shape == [4, 1]
+    np.testing.assert_allclose(x.numpy().ravel(), [0, 1, 2, 3])
+
+
+def test_dataloader_shuffle_and_drop_last():
+    dl = io.DataLoader(_SquareDataset(10), batch_size=3, shuffle=True,
+                       drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    seen = np.concatenate([b[0].numpy().ravel() for b in batches])
+    assert len(set(seen.tolist())) == 9
+
+
+def test_dataloader_multiprocess():
+    dl = io.DataLoader(_SquareDataset(16), batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    allx = np.sort(np.concatenate([b[0].numpy().ravel() for b in batches]))
+    np.testing.assert_allclose(allx, np.arange(16))
+
+
+def test_batch_sampler_and_distributed():
+    ds = _SquareDataset(10)
+    bs = io.BatchSampler(ds, batch_size=4)
+    assert len(bs) == 3
+    dbs = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=0)
+    idx0 = [i for b in dbs for i in b]
+    dbs1 = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=2, rank=1)
+    idx1 = [i for b in dbs1 for i in b]
+    assert set(idx0) | set(idx1) == set(range(10))
+    assert not (set(idx0) & set(idx1))
+
+
+def test_tensor_dataset_and_subset():
+    td = io.TensorDataset([paddle.arange(10, dtype="float32"),
+                           paddle.arange(10, dtype="float32") * 2])
+    x, y = td[3]
+    assert float(x) == 3 and float(y) == 6
+    sub = io.Subset(td, [1, 5])
+    assert float(sub[1][0]) == 5
+    a, b = io.random_split(td, [0.5, 0.5])
+    assert len(a) == 5 and len(b) == 5
+
+
+def test_iterable_dataset():
+    class Gen(io.IterableDataset):
+        def __iter__(self):
+            for i in range(7):
+                yield np.float32([i])
+
+    dl = io.DataLoader(Gen(), batch_size=3)
+    sizes = [b.shape[0] for b in dl]
+    assert sizes == [3, 3, 1]
+
+
+def test_transforms_pipeline():
+    tr = transforms.Compose([
+        transforms.Resize(40),
+        transforms.RandomCrop(32),
+        transforms.RandomHorizontalFlip(0.5),
+        transforms.ToTensor(),
+        transforms.Normalize([0.5] * 3, [0.5] * 3),
+    ])
+    img = np.random.randint(0, 255, (32, 32, 3), np.uint8)
+    out = tr(img)
+    assert out.shape == [3, 32, 32]
+    assert -1.1 <= float(out.min()) and float(out.max()) <= 1.1
+
+
+def test_fakedata_and_lenet_forward():
+    ds = datasets.FakeData(size=8, image_shape=(1, 28, 28))
+    dl = io.DataLoader(ds, batch_size=4)
+    x, y = next(iter(dl))
+    net = models.LeNet()
+    out = net(x)
+    assert out.shape == [4, 10]
+
+
+def test_resnet18_forward_shapes():
+    net = models.resnet18(num_classes=10)
+    net.eval()
+    x = paddle.randn([2, 3, 32, 32])
+    out = net(x)
+    assert out.shape == [2, 10]
+    n_params = sum(p.size for p in net.parameters())
+    assert 11_000_000 < n_params < 12_000_000  # ~11.2M like the reference
+
+
+def test_resnet50_param_count():
+    net = models.resnet50(num_classes=1000)
+    n = sum(p.size for p in net.parameters())
+    assert 25_000_000 < n < 26_000_000  # 25.5M matches torchvision/paddle

@@ -1,0 +1,236 @@
+"""nn.Layer machinery + layer forward/backward numerics (SURVEY.md §4
+API/layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rng = np.random.RandomState(0)
+
+
+def test_layer_registration():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.register_buffer("counter", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    assert len(net.parameters()) == 4
+    assert len(list(net.named_buffers())) == 1
+    assert len(net.sublayers()) == 2
+    sd = net.state_dict()
+    assert "counter" in sd and "fc1.weight" in sd
+
+
+def test_state_dict_roundtrip():
+    net1 = nn.Linear(3, 3)
+    net2 = nn.Linear(3, 3)
+    net2.set_state_dict(net1.state_dict())
+    np.testing.assert_allclose(net1.weight.numpy(), net2.weight.numpy())
+
+
+def test_train_eval_mode():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    assert net.training
+    net.eval()
+    assert not net[1].training
+    x = paddle.ones([4, 2])
+    y1 = net(x)
+    y2 = net(x)
+    np.testing.assert_allclose(y1.numpy(), y2.numpy())  # dropout off in eval
+
+
+def test_linear_matches_numpy():
+    fc = nn.Linear(4, 3)
+    x = rng.randn(5, 4).astype(np.float32)
+    out = fc(paddle.to_tensor(x))
+    ref = x @ fc.weight.numpy() + fc.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_matches_scipy_style():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    out = conv(paddle.to_tensor(x))
+    assert out.shape == [1, 3, 5, 5]
+    # numpy reference conv at one output position
+    w = conv.weight.numpy()
+    b = conv.bias.numpy()
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    ref_center = (xp[0, :, 1:4, 1:4] * w[1]).sum() + b[1]
+    np.testing.assert_allclose(out.numpy()[0, 1, 1, 1], ref_center, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv_grouped_and_stride():
+    conv = nn.Conv2D(4, 4, 3, stride=2, padding=1, groups=2)
+    out = conv(paddle.ones([2, 4, 8, 8]))
+    assert out.shape == [2, 4, 4, 4]
+
+
+def test_batchnorm_stats_update():
+    bn = nn.BatchNorm2D(3, momentum=0.9)
+    x = paddle.to_tensor(rng.randn(4, 3, 2, 2).astype(np.float32) * 2 + 5)
+    bn.train()
+    out = bn(x)
+    # output normalized per-channel
+    np.testing.assert_allclose(out.numpy().mean((0, 2, 3)), 0, atol=1e-5)
+    assert not np.allclose(bn._mean.numpy(), 0)  # running stats moved
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [4, 3, 2, 2]
+
+
+def test_layernorm_and_groupnorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.to_tensor(rng.randn(2, 4, 8).astype(np.float32))
+    y = ln(x)
+    np.testing.assert_allclose(y.numpy().mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.numpy().std(-1), 1, atol=1e-2)
+    gn = nn.GroupNorm(2, 4)
+    z = gn(paddle.to_tensor(rng.randn(2, 4, 3, 3).astype(np.float32)))
+    assert z.shape == [2, 4, 3, 3]
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    y = rn(x)
+    ref = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor([0, 3, 5])
+    out = emb(idx)
+    assert out.shape == [3, 4]
+    np.testing.assert_allclose(out.numpy()[0], 0)
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2)(x)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2)(x)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    aap = nn.AdaptiveAvgPool2D(1)(x)
+    np.testing.assert_allclose(aap.numpy()[0, 0, 0, 0], 7.5)
+
+
+def test_activations():
+    x = paddle.to_tensor([-2.0, 0.0, 2.0])
+    np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+    np.testing.assert_allclose(nn.functional.gelu(x).numpy(),
+                               [-0.0455, 0.0, 1.9545], atol=1e-3)
+    np.testing.assert_allclose(nn.Sigmoid()(x).numpy(),
+                               1 / (1 + np.exp([2.0, 0, -2.0])), rtol=1e-5)
+    np.testing.assert_allclose(nn.functional.softmax(x).numpy().sum(), 1.0,
+                               rtol=1e-6)
+
+
+def test_cross_entropy_matches_numpy():
+    logits = rng.randn(4, 5).astype(np.float32)
+    labels = np.array([0, 2, 4, 1])
+    loss = nn.functional.cross_entropy(paddle.to_tensor(logits),
+                                       paddle.to_tensor(labels))
+    # numpy ref
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = rng.randn(4, 5).astype(np.float32)
+    labels = np.array([0, -100, 4, -100])
+    loss = nn.functional.cross_entropy(paddle.to_tensor(logits),
+                                       paddle.to_tensor(labels),
+                                       ignore_index=-100)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[[0, 2], [0, 4]]).mean()
+    np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+    soft = np.full((4, 5), 0.2, np.float32)
+    l2 = nn.functional.cross_entropy(paddle.to_tensor(logits),
+                                     paddle.to_tensor(soft), soft_label=True)
+    assert np.isfinite(float(l2))
+
+
+def test_losses():
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([1.5, 1.0])
+    np.testing.assert_allclose(nn.MSELoss()(x, y).numpy(), (0.25 + 1.0) / 2)
+    np.testing.assert_allclose(nn.L1Loss()(x, y).numpy(), 0.75)
+    z = paddle.to_tensor([0.3, 0.8])
+    l = paddle.to_tensor([0.0, 1.0])
+    ref = -(np.log(1 - 0.3) + np.log(0.8)) / 2
+    np.testing.assert_allclose(nn.BCELoss()(z, l).numpy(), ref, rtol=1e-5)
+
+
+def test_mha_and_transformer_encoder():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(rng.randn(2, 5, 16).astype(np.float32))
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    out2 = enc(x)
+    assert out2.shape == [2, 5, 16]
+    # layers are deep-copied, not shared
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_sdpa_causal():
+    q = paddle.to_tensor(rng.randn(1, 4, 2, 8).astype(np.float32))
+    out = nn.functional.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [1, 4, 2, 8]
+    # first position attends only to itself -> equals v[0]
+    np.testing.assert_allclose(out.numpy()[0, 0], q.numpy()[0, 0], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_weight_norm_and_clip():
+    fc = nn.Linear(3, 3)
+    nn.utils.weight_norm(fc, "weight")
+    x = paddle.ones([1, 3])
+    _ = fc(x)
+    assert "weight_g" in dict(fc.named_parameters().__iter__() if False else
+                              [(n, p) for n, p in fc.named_parameters()])
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    p = paddle.Parameter(np.ones(4, np.float32))
+    g = paddle.to_tensor(np.full(4, 10.0, np.float32))
+    (p2, g2), = clip([(p, g)])
+    np.testing.assert_allclose(np.linalg.norm(g2.numpy()), 1.0, rtol=1e-5)
+
+
+def test_sequential_layerlist():
+    seq = nn.Sequential(nn.Linear(2, 3), nn.ReLU(), nn.Linear(3, 1))
+    assert len(seq) == 3
+    out = seq(paddle.ones([1, 2]))
+    assert out.shape == [1, 1]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(nn.Sequential(*ll)(paddle.ones([1, 2])).shape) == 2
+
+
+def test_forward_hooks():
+    fc = nn.Linear(2, 2)
+    calls = []
+    h = fc.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    fc(paddle.ones([1, 2]))
+    assert calls == [1]
+    h.remove()
+    fc(paddle.ones([1, 2]))
+    assert calls == [1]

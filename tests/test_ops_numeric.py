@@ -1,0 +1,136 @@
+"""Op numeric parity vs numpy (the OpTest check_output analogue — SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(42)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("exp", np.exp), ("log1p", np.log1p), ("sqrt", np.sqrt),
+    ("tanh", np.tanh), ("floor", np.floor), ("ceil", np.ceil),
+    ("abs", np.abs), ("square", np.square),
+])
+def test_unary(op, npop):
+    x = np.abs(rng.randn(3, 4).astype(np.float32)) + 0.1
+    out = getattr(paddle, op)(t(x))
+    np.testing.assert_allclose(out.numpy(), npop(x), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+])
+def test_binary(op, npop):
+    x = rng.randn(3, 4).astype(np.float32)
+    y = rng.randn(3, 4).astype(np.float32) + 2.0
+    out = getattr(paddle, op)(t(x), t(y))
+    np.testing.assert_allclose(out.numpy(), npop(x, y), rtol=1e-5, atol=1e-6)
+
+
+def test_reductions():
+    x = rng.randn(3, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.sum(t(x), axis=1).numpy(),
+                               x.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t(x), axis=[0, 2]).numpy(),
+                               x.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t(x), axis=-1, keepdim=True).numpy(),
+                               x.max(-1, keepdims=True), rtol=1e-5)
+    np.testing.assert_allclose(paddle.std(t(x)).numpy(), x.std(ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(paddle.logsumexp(t(x), axis=0).numpy(),
+                               np.log(np.exp(x).sum(0)), rtol=1e-4)
+    np.testing.assert_allclose(paddle.cumsum(t(x), axis=1).numpy(),
+                               np.cumsum(x, 1), rtol=1e-4)
+
+
+def test_matmul_shapes():
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(2, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.matmul(t(a), t(b.swapaxes(1, 2)), transpose_y=True).numpy(),
+        a @ b, rtol=1e-4)
+    np.testing.assert_allclose(
+        paddle.einsum("bij,bjk->bik", t(a), t(b)).numpy(), a @ b, rtol=1e-4)
+
+
+def test_manipulation():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    assert paddle.reshape(t(x), [6, 4]).shape == [6, 4]
+    assert paddle.flatten(t(x), 1).shape == [2, 12]
+    assert paddle.transpose(t(x), [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.unsqueeze(t(x), [0, -1]).shape == [1, 2, 3, 4, 1]
+    assert paddle.squeeze(paddle.ones([1, 3, 1])).shape == [3]
+    np.testing.assert_allclose(paddle.flip(t(x), [1]).numpy(), x[:, ::-1])
+    np.testing.assert_allclose(paddle.roll(t(x), 1, 0).numpy(), np.roll(x, 1, 0))
+    np.testing.assert_allclose(paddle.tile(t([1.0, 2.0]), [2, 2]).numpy(),
+                               np.tile([1, 2], (2, 2)))
+    np.testing.assert_allclose(
+        paddle.expand(t(np.ones((1, 3))), [4, 3]).numpy(), np.ones((4, 3)))
+    np.testing.assert_allclose(
+        paddle.pad(t(np.ones((2, 2))), [1, 1, 1, 1]).numpy(),
+        np.pad(np.ones((2, 2)), 1))
+
+
+def test_gather_scatter():
+    x = np.arange(10, dtype=np.float32)
+    idx = np.array([2, 5, 7])
+    np.testing.assert_allclose(paddle.gather(t(x), paddle.to_tensor(idx)).numpy(),
+                               x[idx])
+    out = paddle.scatter(t(np.zeros(5)), paddle.to_tensor([1, 3]),
+                         t([10.0, 20.0]))
+    np.testing.assert_allclose(out.numpy(), [0, 10, 0, 20, 0])
+    x2 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    i2 = np.array([[0, 1], [2, 0], [1, 3]])
+    np.testing.assert_allclose(
+        paddle.take_along_axis(t(x2), paddle.to_tensor(i2), 1).numpy(),
+        np.take_along_axis(x2, i2, 1))
+
+
+def test_where_sort_search():
+    x = rng.randn(4, 5).astype(np.float32)
+    cond = x > 0
+    np.testing.assert_allclose(
+        paddle.where(paddle.to_tensor(cond), t(x), t(-x)).numpy(),
+        np.where(cond, x, -x))
+    np.testing.assert_allclose(paddle.sort(t(x), axis=1).numpy(), np.sort(x, 1))
+    np.testing.assert_array_equal(paddle.argsort(t(x), axis=1).numpy(),
+                                  np.argsort(x, 1))
+    np.testing.assert_array_equal(paddle.argmax(t(x), axis=1).numpy(),
+                                  np.argmax(x, 1))
+    v, i = paddle.topk(t([1.0, 5.0, 3.0]), 2)
+    np.testing.assert_allclose(v.numpy(), [5, 3])
+    np.testing.assert_array_equal(i.numpy(), [1, 2])
+
+
+def test_logic_ops():
+    a = t([1.0, 2.0, 3.0])
+    b = t([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    np.testing.assert_array_equal(
+        paddle.logical_and(a > 1, b > 1).numpy(), [False, True, True])
+    assert bool(paddle.allclose(a, a))
+    assert not bool(paddle.equal_all(a, b))
+
+
+def test_linalg():
+    x = rng.randn(4, 4).astype(np.float32)
+    spd = x @ x.T + 4 * np.eye(4, dtype=np.float32)
+    np.testing.assert_allclose(paddle.linalg.inv(t(spd)).numpy(),
+                               np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.norm(t(x)).numpy(),
+                               np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(paddle.linalg.det(t(spd)).numpy(),
+                               np.linalg.det(spd), rtol=1e-3)
+    c = paddle.linalg.cholesky(t(spd))
+    np.testing.assert_allclose((c @ c.T).numpy(), spd, rtol=1e-3, atol=1e-3)
+
+
+def test_one_hot_and_embedding_ops():
+    oh = paddle.one_hot(paddle.to_tensor([0, 2]), 3)
+    np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])

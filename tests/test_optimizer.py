@@ -1,0 +1,151 @@
+"""Optimizers: update math vs hand-rolled numpy + end-to-end convergence
+(SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _param(val):
+    return paddle.Parameter(np.asarray(val, np.float32))
+
+
+def _set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, np.float32))
+
+
+def test_sgd_step():
+    p = _param([1.0, 2.0])
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    _set_grad(p, [1.0, 1.0])
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.9, 1.9], rtol=1e-6)
+
+
+def test_momentum_matches_numpy():
+    p = _param([1.0])
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    v = 0.0
+    x = 1.0
+    for g in [1.0, 0.5, 0.25]:
+        _set_grad(p, [g])
+        opt.step()
+        v = 0.9 * v + g
+        x = x - 0.1 * v
+    np.testing.assert_allclose(p.numpy(), [x], rtol=1e-6)
+
+
+def test_adam_matches_numpy():
+    p = _param([1.0])
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    m = v = 0.0
+    x = 1.0
+    for t, g in enumerate([1.0, -0.5, 0.3], 1):
+        _set_grad(p, [g])
+        opt.step()
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        x = x - 0.01 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), [x], rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = _param([1.0])
+    opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.1, parameters=[p])
+    _set_grad(p, [0.0])
+    opt.step()
+    # zero grad -> only decay applies: p *= (1 - lr*wd)
+    np.testing.assert_allclose(p.numpy(), [1.0 * (1 - 0.1 * 0.1)], rtol=1e-5)
+
+
+def test_grad_clip_in_optimizer():
+    p = _param(np.ones(4))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p],
+                        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    _set_grad(p, np.full(4, 10.0))
+    opt.step()
+    # clipped grad has norm 1 -> each entry 0.5
+    np.testing.assert_allclose(p.numpy(), 1 - 0.5, rtol=1e-4)
+
+
+def test_multi_precision_master_weights():
+    p = paddle.Parameter(np.ones(3, np.float32))
+    p._data = p._data.astype("bfloat16")
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=[p],
+                          multi_precision=True)
+    _set_grad(p, np.full(3, 1e-3))
+    opt.step()
+    slots = opt._slots[id(p)]
+    assert "master" in slots
+    assert str(slots["master"].dtype) == "float32"
+    assert str(np.dtype(p.dtype)) == "bfloat16" or "bfloat16" in str(p.dtype)
+
+
+def test_lr_schedulers():
+    lr = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(lr())
+        lr.step()
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    warm = optimizer.lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    v0 = warm()
+    warm.step()
+    warm.step()
+    assert v0 == 0.0 and abs(warm() - 0.05) < 1e-6
+
+    cos = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    cos.step(5)
+    np.testing.assert_allclose(cos(), 0.5, atol=1e-6)
+
+    noam = optimizer.lr.NoamDecay(d_model=512, warmup_steps=100)
+    assert noam() > 0
+
+
+def test_scheduler_in_optimizer():
+    p = _param([1.0])
+    sched = optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+    opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+    _set_grad(p, [1.0])
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.9], rtol=1e-6)
+    sched.step()
+    _set_grad(p, [1.0])
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.89], rtol=1e-5)
+
+
+def test_optimizer_state_dict_roundtrip():
+    p = _param([1.0, 2.0])
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    _set_grad(p, [1.0, 1.0])
+    opt.step()
+    state = opt.state_dict()
+    p2 = _param([1.0, 2.0])
+    p2.name = p.name
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=[p2])
+    opt2.set_state_dict(state)
+    np.testing.assert_allclose(opt2._slots[id(p2)]["moment1"],
+                               opt._slots[id(p)]["moment1"])
+    assert opt2._step_t[id(p2)] == 1
+
+
+def test_regression_convergence():
+    paddle.seed(0)
+    net = nn.Linear(3, 1)
+    opt = optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    w_true = np.array([[1.0], [-2.0], [0.5]], np.float32)
+    rng = np.random.RandomState(0)
+    for _ in range(150):
+        x = rng.randn(32, 3).astype(np.float32)
+        y = x @ w_true
+        pred = net(paddle.to_tensor(x))
+        loss = nn.functional.mse_loss(pred, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(net.weight.numpy(), w_true, atol=0.05)

@@ -1,0 +1,100 @@
+"""Regressions for code-review findings (round 1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+
+
+def test_split_non_divisible_raises():
+    x = paddle.ones([5, 2])
+    with pytest.raises(ValueError):
+        paddle.split(x, 2, axis=0)
+
+
+def test_dropout_downscale_in_infer():
+    x = paddle.ones([100])
+    out = F.dropout(x, p=0.4, training=False, mode="downscale_in_infer")
+    np.testing.assert_allclose(out.numpy(), 0.6, rtol=1e-6)
+    out_train = F.dropout(x, p=0.4, training=True, mode="downscale_in_infer")
+    vals = set(np.round(np.unique(out_train.numpy()), 4).tolist())
+    assert vals <= {0.0, 1.0}  # no upscaling in train for this mode
+
+
+def test_maxpool_ceil_mode():
+    x = paddle.to_tensor(np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5))
+    out_floor = F.max_pool2d(x, 2, stride=2, ceil_mode=False)
+    assert out_floor.shape == [1, 1, 2, 2]
+    out_ceil = F.max_pool2d(x, 2, stride=2, ceil_mode=True)
+    assert out_ceil.shape == [1, 1, 3, 3]
+    np.testing.assert_allclose(out_ceil.numpy()[0, 0, 2], [21, 23, 24])
+
+
+def test_avgpool_ceil_mode_counts_real_elements():
+    x = paddle.ones([1, 1, 5, 5])
+    out = F.avg_pool2d(x, 2, stride=2, ceil_mode=True)
+    assert out.shape == [1, 1, 3, 3]
+    # partial windows average only real elements -> still 1.0
+    np.testing.assert_allclose(out.numpy(), 1.0, rtol=1e-6)
+
+
+def test_group_norm_nhwc():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 3, 4).astype(np.float32)  # NHWC, C=4
+    out = F.group_norm(paddle.to_tensor(x), 2, data_format="NHWC")
+    ref = F.group_norm(paddle.to_tensor(np.transpose(x, (0, 3, 1, 2))), 2,
+                       data_format="NCHW")
+    np.testing.assert_allclose(out.numpy(),
+                               np.transpose(ref.numpy(), (0, 2, 3, 1)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lamb_exclude_from_weight_decay():
+    p1 = paddle.Parameter(np.ones(3, np.float32))
+    p2 = paddle.Parameter(np.ones(3, np.float32))
+    p2.name = "norm_weight"
+    opt = optimizer.Lamb(learning_rate=0.1, lamb_weight_decay=0.5,
+                         parameters=[p1, p2],
+                         exclude_from_weight_decay_fn=lambda p: "norm" in p.name)
+    p1.grad = paddle.zeros([3])
+    p2.grad = paddle.zeros([3])
+    opt.step()
+    # p1 decays (update = wd*p scaled by trust ratio), p2 does not move
+    assert not np.allclose(p1.numpy(), 1.0)
+    np.testing.assert_allclose(p2.numpy(), 1.0)
+
+
+def test_cummax_returns_indices():
+    x = paddle.to_tensor([3.0, 1.0, 4.0, 4.0, 2.0])
+    vals, idx = paddle.cummax(x, axis=0)
+    np.testing.assert_allclose(vals.numpy(), [3, 3, 4, 4, 4])
+    np.testing.assert_array_equal(idx.numpy(), [0, 0, 2, 2, 2])  # earliest tie
+    vals2, idx2 = paddle.cummin(x, axis=0)
+    np.testing.assert_allclose(vals2.numpy(), [3, 1, 1, 1, 1])
+    np.testing.assert_array_equal(idx2.numpy(), [0, 1, 1, 1, 1])
+
+
+def test_cross_entropy_soft_label_with_weight():
+    logits = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    soft = paddle.to_tensor(np.array([[1, 0, 0], [0, 0, 1]], np.float32))
+    w = paddle.to_tensor(np.array([2.0, 1.0, 0.0], np.float32))
+    loss = F.cross_entropy(logits, soft, weight=w, soft_label=True,
+                           reduction="none")
+    # uniform logits -> lp = log(1/3); weighted: row0: -2*lp, row1: -0*lp
+    lp = np.log(1 / 3)
+    np.testing.assert_allclose(loss.numpy(), [-2 * lp, 0.0], rtol=1e-5)
+
+
+def test_interpolate_align_corners():
+    x = paddle.to_tensor(np.array([[[[0.0, 1.0], [2.0, 3.0]]]], np.float32))
+    out = F.interpolate(x, size=(3, 3), mode="bilinear", align_corners=True)
+    # corners preserved exactly; center = mean
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out.numpy()[0, 0, 2, 2], 3.0, atol=1e-6)
+    np.testing.assert_allclose(out.numpy()[0, 0, 1, 1], 1.5, atol=1e-6)
+    # 2->4: align_corners grid {0,1/3,2/3,1} differs from half-pixel grid
+    out_ac = F.interpolate(x, size=(4, 4), mode="bilinear", align_corners=True)
+    out_hp = F.interpolate(x, size=(4, 4), mode="bilinear", align_corners=False)
+    np.testing.assert_allclose(out_ac.numpy()[0, 0, 3, 3], 3.0, atol=1e-6)
+    assert not np.allclose(out_ac.numpy(), out_hp.numpy())

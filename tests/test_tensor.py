@@ -1,0 +1,123 @@
+"""Tensor semantics: creation, dtype, mutation, indexing, repr.
+Mirrors the reference's tensor API tests (SURVEY.md §4 op unit tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    assert str(np.dtype(x.dtype)) == "float32"
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_stop_gradient():
+    x = paddle.to_tensor([1.0])
+    assert x.stop_gradient is True
+    p = paddle.Parameter(np.zeros([3]))
+    assert p.stop_gradient is False
+
+
+def test_dtype_conversion():
+    x = paddle.to_tensor([1, 2, 3])
+    assert str(np.dtype(x.dtype)) == "int64" or str(np.dtype(x.dtype)) == "int32"
+    y = x.astype("float32")
+    assert str(np.dtype(y.dtype)) == "float32"
+    z = x.cast("float16")
+    assert str(np.dtype(z.dtype)) == "float16"
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 - a).numpy(), [1, 0])
+    np.testing.assert_allclose((2.0 / a).numpy(), [2, 1])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+
+
+def test_comparison_returns_tensor():
+    a = paddle.to_tensor([1.0, 5.0])
+    b = paddle.to_tensor([2.0, 2.0])
+    lt = a < b
+    assert isinstance(lt, paddle.Tensor)
+    np.testing.assert_array_equal(lt.numpy(), [True, False])
+
+
+def test_getitem_setitem():
+    x = paddle.arange(12, dtype="float32").reshape([3, 4])
+    np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(x[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(x[1:, 2:].numpy(), [[6, 7], [10, 11]])
+    x[0, 0] = 100.0
+    assert float(x[0, 0]) == 100.0
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(x[idx].numpy()[1], [8, 9, 10, 11])
+
+
+def test_inplace_ops():
+    x = paddle.ones([3])
+    x.add_(paddle.ones([3]))
+    np.testing.assert_allclose(x.numpy(), [2, 2, 2])
+    x.scale_(0.5)
+    np.testing.assert_allclose(x.numpy(), [1, 1, 1])
+    x.zero_()
+    np.testing.assert_allclose(x.numpy(), [0, 0, 0])
+    x.fill_(7.0)
+    np.testing.assert_allclose(x.numpy(), [7, 7, 7])
+
+
+def test_item_and_scalars():
+    x = paddle.to_tensor(3.5)
+    assert float(x) == 3.5
+    assert x.item() == 3.5
+    with pytest.raises(ValueError):
+        bool(paddle.ones([2]))
+
+
+def test_set_value_and_clone():
+    x = paddle.ones([2, 2])
+    y = x.clone()
+    x.set_value(np.zeros([2, 2], np.float32))
+    np.testing.assert_allclose(x.numpy(), 0)
+    np.testing.assert_allclose(y.numpy(), 1)
+
+
+def test_creation_ops():
+    np.testing.assert_allclose(paddle.zeros([2, 3]).numpy(), np.zeros([2, 3]))
+    np.testing.assert_allclose(paddle.full([2], 5.0).numpy(), [5, 5])
+    np.testing.assert_allclose(paddle.arange(0, 10, 2).numpy(), [0, 2, 4, 6, 8])
+    np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                               [0, 0.25, 0.5, 0.75, 1.0])
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    t = paddle.tril(paddle.ones([3, 3]))
+    np.testing.assert_allclose(t.numpy(), np.tril(np.ones([3, 3])))
+
+
+def test_random_deterministic_given_seed():
+    paddle.seed(7)
+    a = paddle.randn([4])
+    paddle.seed(7)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    r = paddle.uniform([1000], min=0.0, max=1.0)
+    assert 0.0 <= float(r.min()) and float(r.max()) <= 1.0
+    p = paddle.randperm(10)
+    assert sorted(p.tolist()) == list(range(10))
+
+
+def test_rng_state_roundtrip():
+    paddle.seed(3)
+    _ = paddle.randn([2])
+    st = paddle.get_rng_state()
+    a = paddle.randn([2])
+    paddle.set_rng_state(st)
+    b = paddle.randn([2])
+    np.testing.assert_allclose(a.numpy(), b.numpy())

@@ -1,0 +1,136 @@
+"""M0 exit: end-to-end dygraph training (ResNet on synthetic CIFAR-shaped
+data), checkpoints, hapi Model — SURVEY.md §7.1 M0."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, io
+from paddle_tpu.vision import datasets, models
+
+
+def test_resnet18_overfits_small_batch():
+    paddle.seed(0)
+    net = models.resnet18(num_classes=4)
+    opt = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                             parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    x = paddle.randn([8, 3, 32, 32])
+    y = paddle.to_tensor(np.array([0, 1, 2, 3] * 2))
+    net.train()
+    losses = []
+    for _ in range(8):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_dataloader_training_loop_mlp():
+    paddle.seed(1)
+    ds = datasets.FakeData(size=64, image_shape=(3, 8, 8), num_classes=3)
+    dl = io.DataLoader(ds, batch_size=16, shuffle=True)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(192, 32), nn.ReLU(),
+                        nn.Linear(32, 3))
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    first = last = None
+    for epoch in range(4):
+        for x, y in dl:
+            loss = loss_fn(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+            last = float(loss)
+    assert last < first
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = models.LeNet()
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    x = paddle.randn([2, 1, 28, 28])
+    nn.functional.cross_entropy(net(x), paddle.to_tensor([1, 2])).backward()
+    opt.step()
+    opt.clear_grad()
+    p = str(tmp_path / "ckpt")
+    paddle.save(net.state_dict(), p + ".pdparams")
+    paddle.save(opt.state_dict(), p + ".pdopt")
+
+    net2 = models.LeNet()
+    net2.set_state_dict(paddle.load(p + ".pdparams"))
+    for (n1, p1), (n2, p2) in zip(net.named_parameters(), net2.named_parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy())
+    opt2 = optimizer.AdamW(learning_rate=1e-3, parameters=net2.parameters())
+    opt2.set_state_dict(paddle.load(p + ".pdopt"))
+    out1 = net(x).numpy()
+    out2 = net2(x).numpy()
+    np.testing.assert_allclose(out1, out2, rtol=1e-5)
+
+
+def test_save_load_nested_object(tmp_path):
+    obj = {"a": paddle.ones([2]), "b": [paddle.zeros([1]), 3], "c": "str"}
+    p = str(tmp_path / "obj.pkl")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["a"].numpy(), [1, 1])
+    assert loaded["b"][1] == 3 and loaded["c"] == "str"
+
+
+def test_hapi_model_fit_eval():
+    paddle.seed(2)
+    ds = datasets.FakeData(size=32, image_shape=(1, 12, 12), num_classes=2)
+    net = nn.Sequential(nn.Flatten(), nn.Linear(144, 2))
+    model = paddle.Model(net)
+    from paddle_tpu.metric import Accuracy
+    model.prepare(optimizer.Adam(learning_rate=0.01,
+                                 parameters=net.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    model.fit(ds, batch_size=8, epochs=1, verbose=0)
+    res = model.evaluate(ds, batch_size=8, verbose=0)
+    assert "acc" in res and "loss" in res
+
+
+def test_amp_autocast_and_scaler():
+    paddle.seed(3)
+    net = nn.Linear(8, 8)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+    x = paddle.randn([4, 8])
+    with paddle.amp.auto_cast(level="O1"):
+        out = net(x)
+        # matmul ran in fp16 under O1
+        assert str(np.dtype(out.dtype)) == "float16"
+        loss = out.astype("float32").mean()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    w_before = net.weight.numpy().copy()
+    scaler.step(opt)
+    opt.clear_grad()
+    assert not np.allclose(net.weight.numpy(), w_before)
+
+
+def test_amp_scaler_skips_on_inf():
+    net = nn.Linear(2, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    net.weight.grad = paddle.to_tensor(np.full((2, 2), np.inf, np.float32))
+    net.bias.grad = paddle.zeros([2])
+    w0 = net.weight.numpy().copy()
+    scaler.step(opt)
+    np.testing.assert_allclose(net.weight.numpy(), w0)  # step skipped
+    assert scaler.get_scale_ratio() == 2.0  # halved
+
+
+def test_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(FloatingPointError):
+            _ = paddle.log(x - 1.0)  # log(-1) -> nan
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
