@@ -1,0 +1,70 @@
+"""Benchmark: ResNet-50 / CIFAR-10 training throughput (BASELINE.json config 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is null — the reference mount is empty and BASELINE.json
+records no published numbers (SURVEY.md §6); this run IS the baseline.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu.framework.functional import FunctionalModule
+
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    paddle.seed(0)
+    model = resnet50(num_classes=10)
+    model.train()
+    fm = FunctionalModule(model, training=True)
+    p_arrs = fm.param_arrays()
+    b_arrs = fm.buffer_arrays()
+    key = fm.next_key()
+
+    x = jnp.ones((batch, 3, 32, 32), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+
+    def train_step(p_arrs, b_arrs, key, x, y):
+        def loss_fn(ps):
+            logits, new_b = fm(ps, b_arrs, key, x)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            loss = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+            return loss, new_b
+
+        (loss, new_b), grads = jax.value_and_grad(loss_fn, has_aux=True)(p_arrs)
+        new_p = [p - 0.05 * g for p, g in zip(p_arrs, grads)]
+        return loss, new_p, new_b
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # warmup / compile
+    loss, p_arrs, b_arrs = step(p_arrs, b_arrs, key, x, y)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, p_arrs, b_arrs = step(p_arrs, b_arrs, key, x, y)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_cifar10_train_throughput",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

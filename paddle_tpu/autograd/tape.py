@@ -222,6 +222,9 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
             elif accumulate and not t.stop_gradient:
                 t.grad = Tensor(_accum(t.grad._data if t.grad is not None else None, g))
         else:
+            if accumulate and t._retain_grads and not t.stop_gradient:
+                # a non-leaf backward root with retain_grads gets the seed grad
+                t.grad = Tensor(_accum(t.grad._data if t.grad is not None else None, g))
             seeds.append((t._grad_node, t._out_idx, g))
 
     if not seeds:

@@ -1,0 +1,124 @@
+"""Functionalize an eager ``nn.Layer`` into a pure JAX function.
+
+This is the single bridge between the imperative Paddle-style world (mutable
+Tensors, ``Layer`` objects, hidden RNG state) and the functional JAX world
+(pure pytree-in/pytree-out functions that ``jax.jit`` / ``jax.grad`` /
+``pjit`` can transform). Everything that compiles a whole model — ``@to_static``
+(jit/api.py), the distributed train-step engine (distributed/engine.py), the
+pipeline-parallel scheduler, and ``__graft_entry__`` — goes through here.
+
+Reference analogue: the dygraph→static Program capture of
+``python/paddle/jit/dy2static/program_translator.py`` (SURVEY.md §3.2) — but
+instead of building a Program IR we temporarily swap each Parameter/buffer's
+backing ``jax.Array`` for a tracer and let JAX trace the eager op layer
+directly (SURVEY.md §7.0: "jax.jit IS the tracer").
+"""
+from __future__ import annotations
+
+import jax
+
+from .core import Tensor
+from . import random as prandom
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+class FunctionalModule:
+    """Pure-function view of a Layer.
+
+    ``fm = FunctionalModule(layer)`` then
+    ``out, new_bufs = fm(p_arrs, b_arrs, rng_key, *args, **kwargs)``
+
+    - ``p_arrs`` / ``b_arrs``: lists of raw arrays matching ``fm.params`` /
+      ``fm.buffers`` order (swap-in happens under the hood).
+    - ``rng_key``: a jax PRNG key seeding this call's op-level randomness
+      (dropout etc.); pass ``fm.next_key()`` eagerly, or thread a key in jit.
+    - Tensor leaves in ``args``/``kwargs`` are passed through as arrays;
+      raw jax arrays are also accepted.
+    - Returns the forward output with Tensors unwrapped to arrays, plus the
+      post-call buffer arrays (BN running stats etc.) so state updates thread
+      through jit functionally.
+
+    The call is pure in the JAX sense: no tape recording (autograd comes from
+    ``jax.grad`` over this function), layer state restored afterwards.
+    """
+
+    def __init__(self, layer, method=None, training=None):
+        self.layer = layer
+        self._method = method or (layer.forward if hasattr(layer, "forward") else layer)
+        self.params = [p for p in layer.parameters() if p is not None]
+        self.buffers = [b for b in layer.buffers() if b is not None]
+        self._training = training
+
+    # -- state accessors -----------------------------------------------------
+    def param_arrays(self):
+        return [p._data for p in self.params]
+
+    def buffer_arrays(self):
+        return [b._data for b in self.buffers]
+
+    def next_key(self):
+        return prandom.next_key()
+
+    # -- the pure call -------------------------------------------------------
+    def __call__(self, p_arrs, b_arrs, rng_key, *args, **kwargs):
+        from ..autograd.tape import no_grad
+        from ..jit import api as jit_api
+
+        saved_p = [t._data for t in self.params]
+        saved_b = [t._data for t in self.buffers]
+        sublayers = (list(self.layer.sublayers(include_self=True))
+                     if hasattr(self.layer, "sublayers") else [])
+        saved_train = [l.training for l in sublayers]
+        gen = prandom.default_generator()
+        saved_rng = (gen._root, gen._counter)
+        saved_tracing = jit_api._TRACING[0]
+        jit_api._TRACING[0] = True
+        try:
+            for t, a in zip(self.params, p_arrs):
+                t._data = a
+            for t, a in zip(self.buffers, b_arrs):
+                t._data = a
+            if self._training is not None:
+                for l in sublayers:
+                    l.training = self._training
+            gen._root = rng_key
+            gen._counter = 0
+
+            def wrap(x):
+                if isinstance(x, Tensor):
+                    return x
+                if isinstance(x, (jax.Array, jax.core.Tracer)):
+                    return Tensor(x)
+                return x
+
+            w_args, w_kwargs = jax.tree.map(wrap, (args, kwargs),
+                                            is_leaf=_is_tensor)
+            with no_grad():
+                out = self._method(*w_args, **w_kwargs)
+            out_arrays = jax.tree.map(
+                lambda t: t._data if isinstance(t, Tensor) else t, out,
+                is_leaf=_is_tensor)
+            new_b = [t._data for t in self.buffers]
+            return out_arrays, new_b
+        finally:
+            for t, a in zip(self.params, saved_p):
+                t._data = a
+            for t, a in zip(self.buffers, saved_b):
+                t._data = a
+            if self._training is not None:
+                for l, tr in zip(sublayers, saved_train):
+                    l.training = tr
+            gen._root, gen._counter = saved_rng
+            jit_api._TRACING[0] = saved_tracing
+
+    # -- write-back ----------------------------------------------------------
+    def update_params(self, p_arrs):
+        for t, a in zip(self.params, p_arrs):
+            t._data = a
+
+    def update_buffers(self, b_arrs):
+        for t, a in zip(self.buffers, b_arrs):
+            t._data = a

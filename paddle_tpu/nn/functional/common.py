@@ -147,6 +147,9 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     dn = ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "HIO", "NHC")
 
     def fn(a, w, *b):
+        if data_format != "NCL":
+            # weights come in Paddle [out, in, k] layout; lax expects HIO here
+            w = jnp.transpose(w, (2, 1, 0))
         out = lax.conv_general_dilated(a, w, window_strides=strides, padding=pad,
                                        rhs_dilation=dil, dimension_numbers=dn,
                                        feature_group_count=groups)
@@ -407,6 +410,21 @@ def _bilinear_align_corners(a, oh, ow):
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    # 3-D (NCL/NWC) input: treat length as W with a singleton H, then squeeze.
+    if x.ndim == 3:
+        from ..layer import Layer  # noqa: F401  (no cycle; keep import local)
+        chan_last = data_format in ("NWC", "NLC")
+        xs = x.unsqueeze(2) if not chan_last else x.unsqueeze(1)
+        size2 = [1, int(size[0] if isinstance(size, (list, tuple)) else size)] \
+            if size is not None else None
+        sf = scale_factor
+        if sf is not None:
+            sf = [1, sf[0] if isinstance(sf, (list, tuple)) else sf]
+        mode2 = "bilinear" if mode == "linear" else mode
+        out = interpolate(xs, size2, sf, mode2, align_corners, align_mode,
+                          "NCHW" if not chan_last else "NHWC")
+        return out.squeeze(2) if not chan_last else out.squeeze(1)
+
     def fn(a):
         n, c, h, w = a.shape if data_format == "NCHW" else \
             (a.shape[0], a.shape[3], a.shape[1], a.shape[2])

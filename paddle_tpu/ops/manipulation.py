@@ -215,12 +215,8 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
         # ordered from the last dim backwards: [left, right, top, bottom, ...]
         width = [(0, 0)] * nd
         np_ = len(pad) // 2
-        if mode == "constant" and len(pad) % 2 == 0 and nd >= np_:
-            for i in range(np_):
-                width[nd - 1 - i] = (pad[2 * i], pad[2 * i + 1])
-        else:
-            for i in range(np_):
-                width[nd - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+        for i in range(np_):
+            width[nd - 1 - i] = (pad[2 * i], pad[2 * i + 1])
     jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
              "circular": "wrap"}[mode]
     kw = {"constant_values": value} if jmode == "constant" else {}
