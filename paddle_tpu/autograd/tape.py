@@ -107,6 +107,29 @@ def _is_diff_tensor(t) -> bool:
 _amp_cast_inputs = None
 _nan_check = False
 
+# callbacks fired once after a top-level backward() finishes (DataParallel
+# grad sync uses this — the analogue of the reference reducer's
+# post-backward allreduce flush, ``paddle/fluid/imperative/reducer.cc``).
+# Thread-local: each simulated rank (distributed/simulator.py) registers and
+# fires only its own callbacks.
+import threading as _threading
+
+_post_backward_tls = _threading.local()
+
+
+def register_post_backward_callback(cb):
+    lst = getattr(_post_backward_tls, "callbacks", None)
+    if lst is None:
+        lst = _post_backward_tls.callbacks = []
+    lst.append(cb)
+    return cb
+
+
+def unregister_post_backward_callback(cb):
+    lst = getattr(_post_backward_tls, "callbacks", None)
+    if lst and cb in lst:
+        lst.remove(cb)
+
 
 def apply(fn, *args, op_name: str | None = None, **kwargs):
     """Run pure-array function ``fn`` on (possibly) Tensor args; record a tape
@@ -289,6 +312,10 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
                 consumers[id(prod)] -= 1
                 if consumers[id(prod)] == 0:
                     ready.append(prod)
+
+    if accumulate:
+        for cb in list(getattr(_post_backward_tls, "callbacks", ())):
+            cb()
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

@@ -1,0 +1,38 @@
+"""paddle.distributed — TPU-native distributed stack (SURVEY.md §2.3, §7).
+
+Perf path: named global mesh [dp, pp, sharding, sep, mp] + sharding
+annotations; XLA emits collectives over ICI/DCN (mesh.py, fleet/).
+Compat path: imperative per-rank collectives (collective.py) over the thread
+simulator or the multi-host coordinator.
+"""
+from __future__ import annotations
+
+from .parallel_env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized, ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, destroy_process_group,
+    all_reduce, all_gather, all_gather_object, reduce_scatter,
+    alltoall, alltoall_single, broadcast, broadcast_object_list,
+    reduce, scatter, barrier, send, recv, isend, irecv,
+    P2POp, batch_isend_irecv, stream,
+)
+from .parallel import DataParallel, shard_tensor_on_axis  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import mesh  # noqa: F401
+from .mesh import init_mesh, get_mesh, HYBRID_AXES  # noqa: F401
+from . import simulator  # noqa: F401
+
+# fleet namespace (hybrid parallelism facade)
+from . import fleet  # noqa: F401
+
+# communication subpackage alias (paddle.distributed.communication.*)
+from . import collective as communication  # noqa: F401
+
+
+def get_backend():
+    return "xla"
+
+
+def is_available():
+    return True

@@ -1,0 +1,379 @@
+"""Imperative collective API (reference: ``python/paddle/distributed/
+communication/*`` — all_reduce/all_gather/reduce_scatter/alltoall/broadcast/
+scatter/reduce/send/recv/barrier over ProcessGroupNCCL, SURVEY.md §2.3/§5.8).
+
+TPU-native execution tiers (SURVEY.md §7.0 "NCCL ProcessGroups → compat
+layer"):
+
+1. **Inside jit / sharded arrays** — the perf path never calls these: XLA's
+   SPMD partitioner emits collectives from sharding annotations; fleet layers
+   use shardings, not this API.
+2. **Thread simulator** (same-host per-rank tests, simulator.py): rendezvous
+   exchange on numpy values — the analogue of the reference's multi-process
+   single-host test mode.
+3. **Multi-host eager** (one process per host): cross-process gather via the
+   jax coordinator (``multihost_utils``-style), correctness path for the rare
+   eager collective outside jit.
+4. **World size 1**: identity semantics.
+
+Paddle semantics preserved: collectives mutate ``tensor`` in place and return
+a task object with ``.wait()``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from . import simulator
+from .parallel_env import get_rank, get_world_size
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda vs: np.sum(vs, axis=0),
+    ReduceOp.MAX: lambda vs: np.max(vs, axis=0),
+    ReduceOp.MIN: lambda vs: np.min(vs, axis=0),
+    ReduceOp.PROD: lambda vs: np.prod(vs, axis=0),
+    ReduceOp.AVG: lambda vs: np.mean(vs, axis=0),
+}
+
+
+class Group:
+    """A communication group ≡ a subset of ranks; when created by the fleet
+    topology it is axis-aligned (``axis`` = the mesh axis it spans)."""
+
+    _next_id = [0]
+
+    def __init__(self, ranks=None, axis=None, name=None):
+        world = get_world_size()
+        self.ranks = list(ranks) if ranks is not None else list(range(world))
+        self.axis = axis
+        Group._next_id[0] += 1
+        self.id = Group._next_id[0]
+        self.name = name or f"group_{self.id}"
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        """This rank's index within the group (-1 if not a member)."""
+        return self.get_group_rank(get_rank())
+
+    def get_group_rank(self, global_rank):
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    def is_member(self):
+        return get_rank() in self.ranks
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks}, axis={self.axis})"
+
+
+_default_group: Group | None = None
+
+
+def _get_default_group() -> Group:
+    w = simulator.active_world()
+    if w is not None:
+        # one default group per simulated world (stored on it — ids of dead
+        # worlds get reused by the allocator, so no external cache)
+        g = getattr(w, "_default_group", None)
+        if g is None:
+            g = w._default_group = Group(list(range(w.nprocs)))
+        return g
+    global _default_group
+    if _default_group is None or _default_group.nranks != get_world_size():
+        _default_group = Group(list(range(get_world_size())))
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    return Group(ranks if ranks is not None else list(range(get_world_size())))
+
+
+def get_group(gid=0):
+    return _get_default_group()
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None
+
+
+class _Task:
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+# ---------------------------------------------------------------------------
+# core exchange
+# ---------------------------------------------------------------------------
+
+
+def _exchange(kind: str, value, group: Group):
+    """All ranks in ``group`` deposit ``value``; returns {group_rank: value}."""
+    w = simulator.active_world()
+    if w is not None:
+        rank = simulator.current_rank()
+        # group identity = its rank set (each rank constructs its own Group
+        # object; ids differ but the ranks tuple is the collective's name)
+        tag = w.next_tag(kind, tuple(group.ranks))
+        got = w.rendezvous.exchange(tag, rank, value, tuple(group.ranks))
+        return {group.get_group_rank(r): v for r, v in got.items()}
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(value)
+        return {group.get_group_rank(r): gathered[r]
+                for r in group.ranks}
+    return {0: value}
+
+
+def _np(tensor):
+    return np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
+
+
+def _write_back(tensor: Tensor, arr):
+    tensor._data = jnp.asarray(np.asarray(arr), dtype=tensor.dtype)
+    return tensor
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+
+# legacy integer enum values (reference core.ReduceOp): 0=SUM 1=MAX 2=MIN 3=PROD 4=AVG
+_LEGACY_OPS = {0: ReduceOp.SUM, 1: ReduceOp.MAX, 2: ReduceOp.MIN,
+               3: ReduceOp.PROD, 4: ReduceOp.AVG}
+
+
+def _reduce_fn(op):
+    if isinstance(op, int):
+        op = _LEGACY_OPS.get(op, op)
+    if op not in _REDUCERS:
+        raise ValueError(f"unknown ReduceOp {op!r}")
+    return _REDUCERS[op]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=False):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        return _Task()
+    got = _exchange("all_reduce", _np(tensor), group)
+    vals = [got[i] for i in range(group.nranks)]
+    _write_back(tensor, _reduce_fn(op)(vals))
+    return _Task()
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        tensor_list.append(Tensor(tensor._data) if isinstance(tensor, Tensor) else Tensor(tensor))
+        return _Task()
+    got = _exchange("all_gather", _np(tensor), group)
+    for i in range(group.nranks):
+        tensor_list.append(Tensor(jnp.asarray(got[i])))
+    return _Task()
+
+
+def all_gather_object(object_list, obj, group=None):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        object_list.append(obj)
+        return
+    got = _exchange("all_gather_object", obj, group)
+    for i in range(group.nranks):
+        object_list.append(got[i])
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        src = tensor_list[0]
+        _write_back(tensor, _np(src))
+        return _Task()
+    stacked = np.stack([_np(t) for t in tensor_list])  # [nranks, ...] local inputs
+    got = _exchange("reduce_scatter", stacked, group)
+    all_stacked = [got[i] for i in range(group.nranks)]  # per-rank [nranks, ...]
+    mine = group.rank
+    reduced = _reduce_fn(op)([s[mine] for s in all_stacked])
+    _write_back(tensor, reduced)
+    return _Task()
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
+        return _Task()
+    stacked = np.stack([_np(t) for t in in_tensor_list])
+    got = _exchange("alltoall", stacked, group)
+    mine = group.rank
+    for i in range(group.nranks):
+        out_tensor_list.append(Tensor(jnp.asarray(got[i][mine])))
+    return _Task()
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    group = group or _get_default_group()
+    n = group.nranks
+    if n == 1:
+        _write_back(out_tensor, _np(in_tensor))
+        return _Task()
+    arr = _np(in_tensor)
+    splits = in_split_sizes or [arr.shape[0] // n] * n
+    offs = np.cumsum([0] + list(splits))
+    chunks = [arr[offs[i]:offs[i + 1]] for i in range(n)]
+    got = _exchange("alltoall_single", chunks, group)
+    mine = group.rank
+    out = np.concatenate([got[i][mine] for i in range(n)], axis=0)
+    _write_back(out_tensor, out)
+    return _Task()
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        return _Task()
+    got = _exchange("broadcast", _np(tensor), group)
+    src_group_rank = group.get_group_rank(src) if src in group.ranks else src
+    _write_back(tensor, got[src_group_rank])
+    return _Task()
+
+
+def broadcast_object_list(object_list, src, group=None):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        return
+    got = _exchange("broadcast_object_list", list(object_list), group)
+    src_group_rank = group.get_group_rank(src) if src in group.ranks else src
+    object_list[:] = got[src_group_rank]
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        return _Task()
+    got = _exchange("reduce", _np(tensor), group)
+    if get_rank() == dst:
+        vals = [got[i] for i in range(group.nranks)]
+        _write_back(tensor, _reduce_fn(op)(vals))
+    return _Task()
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        if tensor_list:
+            _write_back(tensor, _np(tensor_list[0]))
+        return _Task()
+    payload = [_np(t) for t in tensor_list] if tensor_list else None
+    got = _exchange("scatter", payload, group)
+    src_group_rank = group.get_group_rank(src) if src in group.ranks else src
+    chunks = got[src_group_rank]
+    _write_back(tensor, chunks[group.rank])
+    return _Task()
+
+
+def barrier(group=None):
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        return
+    _exchange("barrier", None, group)
+
+
+# ---------------------------------------------------------------------------
+# point-to-point
+# ---------------------------------------------------------------------------
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    w = simulator.active_world()
+    if w is None:
+        raise RuntimeError("send/recv outside simulation requires multi-host "
+                           "launch (p2p rides the pp/sep mesh axes inside jit)")
+    group = group or _get_default_group()
+    gkey = tuple(group.ranks)  # group identity = rank set (ids differ per rank)
+    seq = w.next_tag("p2p_send", (gkey, simulator.current_rank(), dst))[2]
+    w.rendezvous.put((gkey, simulator.current_rank(), dst, seq), _np(tensor))
+    return _Task()
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    w = simulator.active_world()
+    if w is None:
+        raise RuntimeError("send/recv outside simulation requires multi-host launch")
+    group = group or _get_default_group()
+    gkey = tuple(group.ranks)
+    seq = w.next_tag("p2p_recv", (gkey, src, simulator.current_rank()))[2]
+    val = w.rendezvous.get((gkey, src, simulator.current_rank(), seq))
+    _write_back(tensor, val)
+    return _Task()
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Reference: ``ProcessGroupNCCL::batch_isend_irecv`` — here sends are
+    deposited first, then recvs drained, so matched pairs can't deadlock."""
+    tasks = []
+    for p in p2p_op_list:
+        if p.op in (send, isend):
+            tasks.append(send(p.tensor, p.peer, p.group))
+    for p in p2p_op_list:
+        if p.op in (recv, irecv):
+            tasks.append(recv(p.tensor, p.peer, p.group))
+    return tasks
+
+
+# low-level "stream" namespace compat (paddle.distributed.stream.*)
+class stream:
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

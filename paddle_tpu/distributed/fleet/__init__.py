@@ -1,0 +1,127 @@
+"""paddle.distributed.fleet — the hybrid-parallel facade (reference:
+``python/paddle/distributed/fleet/fleet.py`` — ``fleet.init(is_collective,
+strategy)``, ``distributed_model()`` wrapping the model per strategy,
+``distributed_optimizer()``; SURVEY.md §2.3 "Fleet facade", §3.4).
+
+TPU-native: ``init`` builds the global device mesh from the strategy's
+hybrid degrees (mesh axes [dp, pp, sharding, sep, mp]) — the reference's
+per-axis NCCL group creation becomes mesh construction; everything else is
+sharding annotations the wrapped layers/optimizers already carry.
+"""
+from __future__ import annotations
+
+from .distributed_strategy import DistributedStrategy
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+from .hybrid_parallel_optimizer import HybridParallelOptimizer
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    PipelineLayer, LayerDesc, SharedLayerDesc, PipelineParallel,
+    TensorParallel, ShardingParallel, ColumnParallelLinear, RowParallelLinear,
+    VocabParallelEmbedding, ParallelCrossEntropy, get_rng_state_tracker,
+)
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
+from .. import mesh as mesh_mod
+from ..parallel import DataParallel
+from ..parallel_env import init_parallel_env, get_rank, get_world_size
+
+# module-level fleet state (the reference Fleet singleton)
+_strategy: DistributedStrategy | None = None
+_initialized = [False]
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    global _strategy
+    _strategy = strategy or DistributedStrategy()
+    init_parallel_env()
+    degrees = _strategy.degrees()
+    mesh_mod.init_mesh(degrees)
+    set_hybrid_communicate_group(None)
+    set_hybrid_communicate_group(HybridCommunicateGroup())
+    _initialized[0] = True
+    return
+
+
+def is_initialized():
+    return _initialized[0]
+
+
+def get_strategy() -> DistributedStrategy:
+    return _strategy or DistributedStrategy()
+
+
+def distributed_model(model):
+    """Wrap per strategy: PipelineLayer → PipelineParallel; mp-only →
+    TensorParallel; dp → DataParallel (mesh input sharding). Reference
+    precedence: pp > sharding > mp > dp."""
+    strategy = get_strategy()
+    hcg = get_hybrid_communicate_group()
+    d = strategy.degrees()
+    if isinstance(model, PipelineLayer) or (
+            hasattr(model, "_layers") and isinstance(getattr(model, "_layers", None),
+                                                     PipelineLayer)):
+        return PipelineParallel(model, hcg, strategy)
+    if d["pp"] > 1:
+        raise TypeError("pp_degree > 1 requires the model to be a PipelineLayer")
+    if d["mp"] > 1 and d["dp"] == 1:
+        return TensorParallel(model, hcg, strategy)
+    if d["dp"] > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    return HybridParallelOptimizer(optimizer, get_hybrid_communicate_group(),
+                                   strategy or get_strategy())
+
+
+# -- worker topology helpers (reference Fleet API) ---------------------------
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_endpoints(to_string=False):
+    from ..parallel_env import ParallelEnv
+    eps = ParallelEnv().trainer_endpoints
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+# -- parameter-server mode: explicitly out of TPU scope (SURVEY.md §7.4) -----
+def _ps_stub(name):
+    def fn(*a, **k):
+        raise NotImplementedError(
+            f"fleet.{name} belongs to parameter-server mode, which is not in "
+            "the TPU build (SURVEY.md §7.4); use collective mode")
+    return fn
+
+
+init_worker = _ps_stub("init_worker")
+init_server = _ps_stub("init_server")
+run_server = _ps_stub("run_server")
+stop_worker = _ps_stub("stop_worker")
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *a, **k):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=True, **kwargs):
+        self._is_collective = is_collective

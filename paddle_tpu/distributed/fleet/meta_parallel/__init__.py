@@ -1,0 +1,50 @@
+"""fleet.meta_parallel (reference: ``python/paddle/distributed/fleet/
+meta_parallel/__init__.py``): hybrid-parallel model wrappers + mp/pp layers."""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: F401
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .sharding import (  # noqa: F401
+    DygraphShardingOptimizer, GroupShardedOptimizerStage2,
+    GroupShardedStage2, GroupShardedStage3,
+)
+from .random import get_rng_state_tracker, RNGStatesTracker, model_parallel_random_seed  # noqa: F401
+from ...parallel import DataParallel  # noqa: F401
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, state, *a, **k):
+        return self._layers.set_state_dict(state, *a, **k)
+
+
+class TensorParallel(_MetaParallelBase):
+    """mp wrapper: in the reference this broadcasts mp params within the mp
+    group; in mesh mode mp params already carry their shardings — nothing to
+    sync (single source of truth)."""
+
+
+class ShardingParallel(_MetaParallelBase):
+    """sharding-group wrapper (reference: syncs params in the sharding group)."""

@@ -1,0 +1,191 @@
+"""Megatron-style tensor-parallel layers (reference:
+``python/paddle/distributed/fleet/layers/mpu/mp_layers.py`` —
+``VocabParallelEmbedding``, ``ColumnParallelLinear``, ``RowParallelLinear``,
+``ParallelCrossEntropy``; and ``mp_ops.py`` ``_c_identity``/``_c_split``/
+``_mp_allreduce``/``_c_softmax_with_cross_entropy``; SURVEY.md §2.3 "TP/MP").
+
+TPU-native (SURVEY.md §7.1 M4): the reference implements TP with explicit
+collective ops — identity-fwd/allreduce-bwd around column layers,
+allreduce-fwd/identity-bwd after row layers, a masked lookup + allreduce for
+the vocab-parallel embedding, and a dedicated vocab-parallel softmax-CE
+kernel. Here each layer simply *shards its weight over the mp mesh axis*
+(column → P(None, 'mp'), row → P('mp', None), vocab → P('mp', None)) and
+computes with plain ops: XLA's SPMD partitioner derives exactly those
+collectives (partial-sum matmul → psum; sharded-vocab gather → masked
+lookup + psum), fused into the surrounding program. Losses are numerically
+identical to the unsharded model — the parity contract the reference tests
+via ``hybrid_parallel_mp_layers.py``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor
+from ....autograd.tape import apply
+from ....nn.layer import Layer
+from ....nn import functional as F
+from ....nn.initializer import XavierUniform, Constant
+from ... import mesh as mesh_mod
+
+
+def _place_param(p, spec):
+    """Shard a parameter over the global mesh; records the spec for the
+    train-step engine (engine.py) and checkpointing."""
+    p._sharding_spec = tuple(spec)
+    mesh = mesh_mod.get_mesh()
+    if len(mesh.devices.flat) > 1 and not isinstance(p._data, jax.core.Tracer):
+        p._data = jax.device_put(p._data, mesh_mod.sharding(*spec))
+    return p
+
+
+def reshard(x, *spec):
+    """Differentiable resharding of a Tensor over the mesh (device_put on
+    concrete arrays, with_sharding_constraint under tracing)."""
+    sh = mesh_mod.sharding(*spec)
+
+    def fn(a):
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sh)
+        return jax.device_put(a, sh)
+
+    return apply(fn, x, op_name="reshard")
+
+
+def mp_degree():
+    return mesh_mod.axis_size("mp")
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on the output (column) dim over 'mp'."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.world_size = mp_degree()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        _place_param(self.weight, (None, "mp"))
+        self.weight.is_distributed = self.world_size > 1
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _place_param(self.bias, ("mp",))
+            self.bias.is_distributed = self.world_size > 1
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output and self.world_size > 1:
+            y = reshard(y, *([None] * y.ndim))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on the input (row) dim over 'mp'; the matmul's
+    partial sums are combined by an XLA-inserted psum (the reference's
+    explicit ``mp_allreduce``)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.world_size = mp_degree()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=XavierUniform())
+        _place_param(self.weight, ("mp", None))
+        self.weight.is_distributed = self.world_size > 1
+        if has_bias:
+            # bias applies after the reduction → replicated
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _place_param(self.bias, (None,))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if not self.input_is_parallel and self.world_size > 1:
+            # split the contraction dim over mp (reference _c_split)
+            spec = [None] * x.ndim
+            spec[-1] = "mp"
+            x = reshard(x, *spec)
+        y = F.linear(x, self.weight, None)
+        if self.world_size > 1:
+            y = reshard(y, *([None] * y.ndim))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Weight [vocab, dim] sharded on the vocab dim over 'mp'. The sharded
+    gather lowers to the reference's masked-lookup + psum (``c_embedding``)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.world_size = mp_degree()
+        from ....nn.initializer import Normal
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0))
+        _place_param(self.weight, ("mp", None))
+        self.weight.is_distributed = self.world_size > 1
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross-entropy over mp-sharded logits (reference
+    ``c_softmax_with_cross_entropy``: avoids materialising the full logits;
+    here the sharded logsumexp/gather keep the vocab dim sharded and XLA
+    reduces partial max/sum over mp)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        def fn(logits, lab):
+            lse = jax.scipy.special.logsumexp(
+                logits.astype(jnp.float32), axis=-1, keepdims=True)
+            logp = logits.astype(jnp.float32) - lse
+            lab2 = lab if lab.ndim == logp.ndim else lab[..., None]
+            picked = jnp.take_along_axis(logp, lab2.astype(jnp.int32), axis=-1)
+            loss = -picked
+            if self.ignore_index >= 0:
+                loss = jnp.where(lab2 == self.ignore_index, 0.0, loss)
+            return loss
+
+        return apply(fn, input, label, op_name="parallel_cross_entropy")
+
+
+# functional mp_ops compat (reference mpu/mp_ops.py)
+def _c_identity(x, group=None):
+    return x
+
+
+def _c_concat(x, group=None):
+    return reshard(x, *([None] * x.ndim))
+
+
+def _c_split(x, group=None):
+    spec = [None] * x.ndim
+    spec[-1] = "mp"
+    return reshard(x, *spec)
+
+
+def _mp_allreduce(x, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    return reshard(x, *([None] * x.ndim))

@@ -1,0 +1,92 @@
+"""Pipeline-parallel training driver (reference: ``python/paddle/distributed/
+fleet/meta_parallel/pipeline_parallel.py`` — ``PipelineParallel.train_batch``
+runs the 1F1B schedule: warmup forwards, steady 1F1B, cooldown, with p2p
+activation exchange per micro-batch via ``batch_isend_irecv``; SURVEY.md
+§3.4).
+
+TPU-native: a single controller holds all stages, so the p2p exchange
+degenerates to a local hand-off and the schedule's *numerics* reduce to
+micro-batch gradient accumulation — which this class implements exactly
+(same losses as the reference schedule, the parity contract of
+``hybrid_parallel_pp_*`` tests). The *overlap* the 1F1B schedule exists for
+is recovered on TPU by the jitted shard_map+ppermute pipeline in
+``paddle_tpu/distributed/engine.py`` (SURVEY.md §7.1 M4, §7.3 item 2) — XLA
+schedules compute/ICI-transfer overlap there; no hand-written warmup/
+cooldown bookkeeping is needed in the runtime.
+"""
+from __future__ import annotations
+
+from ....framework.core import Tensor
+from ....nn.layer import Layer
+from ....autograd.tape import no_grad
+from .pp_layers import PipelineLayer
+
+
+def _split_micro(data, n):
+    """Split a (possibly nested) batch into n micro-batches along dim 0."""
+    if isinstance(data, (list, tuple)):
+        parts = [_split_micro(d, n) for d in data]
+        return [type(data)(p[i] for p in parts) for i in range(n)]
+    if isinstance(data, Tensor):
+        b = data.shape[0]
+        if b % n != 0:
+            raise ValueError(f"batch size {b} not divisible by accumulate_steps {n}")
+        mb = b // n
+        return [data[i * mb:(i + 1) * mb] for i in range(n)]
+    return [data] * n
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel expects a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pp_cfg = (strategy.hybrid_configs.get("pp_configs", {})
+                  if strategy is not None else {})
+        self.accumulate_steps = int(pp_cfg.get("accumulate_steps", 1))
+        self.schedule_mode = pp_cfg.get("schedule_mode", "1F1B")
+        self.num_stages = layers._num_stages
+        self._loss_fn = layers._loss_fn
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """One full pipelined step: micro-batch accumulation + optimizer step.
+        ``data`` = [inputs, labels] (reference contract)."""
+        inputs, labels = data
+        n = self.accumulate_steps
+        micro_in = _split_micro(inputs, n)
+        micro_lb = _split_micro(labels, n)
+
+        total_loss = None
+        for x, y in zip(micro_in, micro_lb):
+            out = self._layers(x)
+            loss = self._loss_fn(out, y) if self._loss_fn is not None else out
+            scaled = loss / n
+            if scaler is not None:
+                scaled = scaler.scale(scaled)
+            scaled.backward()
+            with no_grad():
+                total_loss = loss if total_loss is None else total_loss + loss
+
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        with no_grad():
+            return total_loss / n
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        with no_grad():
+            out = self._layers(inputs)
+            if compute_loss and self._loss_fn is not None:
+                return self._loss_fn(out, labels)
+            return out

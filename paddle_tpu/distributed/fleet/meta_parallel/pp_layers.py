@@ -1,0 +1,135 @@
+"""Pipeline model description (reference: ``python/paddle/distributed/fleet/
+meta_parallel/parallel_layers/pp_layers.py`` — ``PipelineLayer`` partitions a
+layer list into stages (uniform or by-parameter-count), ``LayerDesc`` defers
+construction, ``SharedLayerDesc`` ties weights (embeddings) across stages;
+SURVEY.md §2.3 "PP").
+
+TPU-native: a single controller owns every stage, so "stage placement" is a
+*sharding decision*, not process placement — the jitted engine
+(distributed/engine.py) stacks homogeneous stage weights on a leading pp-
+sharded axis and pipelines microbatches with ``ppermute`` (SURVEY.md §7.1
+M4); eagerly, stages just run in order. Tied weights are literally the same
+Parameter object — no tied-grad allreduce needed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....nn.layer import Layer, Sequential
+from ... import mesh as mesh_mod
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a paddle.nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose weights are shared across pipeline stages (tied
+    embeddings). ``shared_weight_attr`` names the tied parameter."""
+
+    def __init__(self, key, layer_cls, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._num_stages = num_stages or mesh_mod.axis_size("pp")
+        self._seg_method = seg_method
+        self.layers_desc = list(layers)
+        self._shared_layers = {}  # key -> first-built instance
+        built = []
+        for d in self.layers_desc:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared_layers:
+                    first = self._shared_layers[d.layer_name]
+                    inst = d.build_layer()
+                    # tie: point the shared parameter at the SAME object
+                    shared_p = getattr(first, d.shared_weight_attr)
+                    setattr(inst, d.shared_weight_attr, shared_p)
+                    inst._shared_forward = d.forward_func
+                    built.append(inst)
+                else:
+                    inst = d.build_layer()
+                    inst._shared_forward = d.forward_func
+                    self._shared_layers[d.layer_name] = inst
+                    built.append(inst)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            elif callable(d):
+                built.append(_FnLayer(d))
+            else:
+                raise TypeError(f"unsupported pipeline entry {d!r}")
+        self.run_function = built
+        for i, l in enumerate(built):
+            self.add_sublayer(str(i), l)
+        self.segment_parts = self._segment(len(built), self._num_stages)
+
+    # -- stage partition -----------------------------------------------------
+    def _segment(self, n_layers, n_stages):
+        if self._seg_method == "uniform" or not self._seg_method.startswith("layer:"):
+            # balanced contiguous split (reference: uniform / by-params)
+            base = n_layers // n_stages
+            rem = n_layers % n_stages
+            parts = [0]
+            for s in range(n_stages):
+                parts.append(parts[-1] + base + (1 if s < rem else 0))
+            return parts
+        # "layer:ClassName" — cut before each layer of the named class
+        cls_name = self._seg_method.split(":", 1)[1]
+        marks = [i for i, l in enumerate(self.run_function)
+                 if type(l).__name__ == cls_name]
+        if len(marks) < n_stages:
+            raise ValueError(f"only {len(marks)} {cls_name} layers for "
+                             f"{n_stages} stages")
+        chunks = np.array_split(marks, n_stages)
+        parts = [0] + [int(c[0]) for c in chunks[1:]] + [n_layers]
+        return parts
+
+    def get_stage_layers(self, stage_id):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
+        return self.run_function[lo:hi]
+
+    def stage_of_layer(self, idx):
+        for s in range(self._num_stages):
+            if self.segment_parts[s] <= idx < self.segment_parts[s + 1]:
+                return s
+        return self._num_stages - 1
+
+    # -- forward (runs every stage; the pipelined schedule lives in
+    #    PipelineParallel.train_batch / the jitted engine) -------------------
+    def forward(self, x, chunk_id=None):
+        for l in self.run_function:
+            fwd = getattr(l, "_shared_forward", None)
+            x = fwd(l, x) if fwd is not None else l(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)

@@ -1,0 +1,77 @@
+"""RNG state tracking across model-parallel regions (reference:
+``python/paddle/distributed/fleet/layers/mpu/random.py`` —
+``RNGStatesTracker`` keeps named per-group RNG states so dropout inside the
+mp region differs per rank while dropout outside is identical; SURVEY.md
+§2.3 "TP/MP").
+
+TPU-native: JAX keys are explicit, so a "state" is a (root_key, counter)
+pair in the hidden default generator (framework/random.py). ``rng_state``
+swaps in a named state derived by folding the axis index into the seed —
+in mesh mode the fold happens automatically when dropout's key feeds a
+sharded op, so the tracker mainly preserves the reference's determinism
+contract: same name → same key sequence.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....framework import random as prandom
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = (jax.random.key(seed), 0)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        gen = prandom.default_generator()
+        orig = (gen._root, gen._counter)
+        gen._root, gen._counter = self.states_[name]
+        try:
+            yield
+        finally:
+            self.states_[name] = (gen._root, gen._counter)
+            gen._root, gen._counter = orig
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+    seed = seed if seed is not None else pyrandom.randint(0, 2 ** 31 - 1)
+    global_seed = seed
+    local_seed = seed + 1024  # offset per reference convention (mp-rank fold
+    # is implicit in mesh mode — sharded dropout masks differ per shard)
+    _RNG_STATE_TRACKER.reset()
+    prandom.seed(global_seed)
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)

@@ -1,0 +1,153 @@
+"""ZeRO-style sharded training (reference: stage-1
+``dygraph_sharding_optimizer.py``; stage-2 ``group_sharded_stage2.py`` +
+``group_sharded_optimizer_stage2.py``; stage-3 ``group_sharded_stage3.py``;
+SURVEY.md §2.3).
+
+TPU-native (SURVEY.md §7.1 M4): ZeRO's manual machinery — per-rank param
+ownership tables, reduce-scatter hooks in backward, pre-forward allgather +
+post-use release — is exactly what XLA's SPMD partitioner derives from a
+*sharding annotation on the state*:
+
+* stage 1/2: optimizer slots (and grads, inside the jitted step) carry a
+  sharding over the 'sharding' axis → XLA emits reduce-scatter for grads and
+  keeps moment math local to the owner shard.
+* stage 3: the parameters themselves are sharded at rest; every use inside
+  a step triggers an allgather XLA schedules (and frees) itself.
+
+Eagerly this module places arrays with those shardings (correctness +
+memory at rest); the jitted engine threads the same specs through
+``jit`` in/out shardings for the perf path.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....framework.core import Parameter
+from ... import mesh as mesh_mod
+
+
+def shard_spec_for(shape, axis="sharding"):
+    """Shard the largest dim divisible by the axis size; else replicate."""
+    n = mesh_mod.axis_size(axis)
+    if n <= 1:
+        return None
+    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for d in dims:
+        if shape[d] % n == 0 and shape[d] >= n:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return tuple(spec)
+    return None
+
+
+def _place(arr, spec):
+    if spec is None or isinstance(arr, jax.core.Tracer):
+        return arr
+    return jax.device_put(arr, mesh_mod.sharding(*spec))
+
+
+class DygraphShardingOptimizer:
+    """Stage 1: optimizer-state sharding. Wraps an inner Optimizer; slots are
+    placed sharded over the 'sharding' axis after creation (reference: each
+    rank updates its shard then broadcasts — here the broadcast is XLA's)."""
+
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._sharded = set()
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def _shard_new_slots(self):
+        for p in self._inner_opt._parameter_list:
+            key = id(p)
+            slots = self._inner_opt._slots.get(key)
+            if slots is None or key in self._sharded:
+                continue
+            for name, arr in slots.items():
+                spec = shard_spec_for(arr.shape)
+                slots[name] = _place(arr, spec)
+            self._sharded.add(key)
+
+    def step(self):
+        self._inner_opt.step()
+        self._shard_new_slots()
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self._inner_opt.clear_grad()
+        return None, None
+
+
+class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
+    """Stage 2 = stage 1 + grad sharding. Eagerly grads live transiently; the
+    reduce-scatter happens inside the jitted step (engine.py threads grad
+    shardings); the eager wrapper additionally places grads sharded before
+    the update to bound peak memory."""
+
+    def step(self):
+        for p in self._inner_opt._parameter_list:
+            if p.grad is not None:
+                spec = shard_spec_for(p.grad._data.shape)
+                p.grad._data = _place(p.grad._data, spec)
+        super().step()
+
+
+class GroupShardedStage2:
+    """Model wrapper for stage 2 (API parity with ``GroupShardedStage2``).
+    Forward delegates; grads/opt-state sharding is the optimizer wrapper's
+    job."""
+
+    def __init__(self, layer, optimizer, group=None, sync_buffers=False,
+                 buffer_max_size=2 ** 23, auto_refresh_trainable=True,
+                 device="tpu", dp_group=None):
+        self._layer = layer
+        self._optimizer = optimizer
+
+    def __call__(self, *a, **k):
+        return self._layer(*a, **k)
+
+    def __getattr__(self, item):
+        return getattr(self._layer, item)
+
+
+class GroupShardedStage3:
+    """Stage 3 / FSDP: parameters sharded at rest over the 'sharding' axis.
+    Every eager/jitted use allgathers on demand (XLA inserts + frees);
+    ``state_dict`` gathers transparently via device_get."""
+
+    def __init__(self, layer, optimizer=None, group=None, sync_buffers=False,
+                 device="tpu", segment_size=2 ** 20, pertrain_sync_models=True,
+                 offload=False, sync_comm=False, dp_group=None,
+                 exclude_layer=None):
+        self._layer = layer
+        self._optimizer = optimizer
+        self._offload = offload
+        for p in layer.parameters():
+            if p is None:
+                continue
+            spec = shard_spec_for(p._data.shape)
+            if spec is not None:
+                p._sharding_spec = spec
+                p._data = _place(p._data, spec)
+                p.is_distributed = True
+        if offload:
+            # host-memory sharding: params live on CPU between uses
+            cpu = jax.devices("cpu")[0]
+            for p in layer.parameters():
+                if p is not None:
+                    p._data = jax.device_put(p._data, cpu)
+
+    def __call__(self, *a, **k):
+        return self._layer(*a, **k)
+
+    def forward(self, *a, **k):
+        return self._layer(*a, **k)
+
+    def __getattr__(self, item):
+        return getattr(self._layer, item)
+
+    def get_all_parameters(self, convert2cpu=False):
+        return list(self._layer.parameters())

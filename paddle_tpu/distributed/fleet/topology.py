@@ -1,0 +1,180 @@
+"""Hybrid topology (reference: ``python/paddle/distributed/fleet/base/
+topology.py`` — ``CommunicateTopology`` N-D rank mesh in order
+[dp, pp, sharding, sep, mp] + ``HybridCommunicateGroup`` creating one NCCL
+group per axis; SURVEY.md §2.3 "Hybrid composition").
+
+TPU-native: the topology IS the jax mesh (mesh.py). A "comm group per axis"
+degenerates to a named mesh axis — collectives on it are emitted by XLA from
+shardings. This class keeps the reference's coordinate math and getters for
+API parity (model code asks it for world sizes / groups), with ranks meaning
+*device* coordinates in the single-controller mesh.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+from .. import mesh as mesh_mod
+from ..collective import Group
+from ..parallel_env import get_rank
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or
+                                    ["data", "pipe", "sharding", "sep", "model"])
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self._coord2rank = {}
+        self._rank2coord = {}
+        for rank, coord in enumerate(itertools.product(*[range(d) for d in self._dims])):
+            self._coord2rank[coord] = rank
+            self._rank2coord[rank] = coord
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for coord, r in self._coord2rank.items()
+                      if coord[axis] == index)
+
+    def get_comm_list(self, axis_name):
+        """List of rank-lists, one per communicator along ``axis_name``."""
+        axis = self._parallel_names.index(axis_name)
+        others = [range(d) for i, d in enumerate(self._dims) if i != axis]
+        comm_list = []
+        for fixed in itertools.product(*others):
+            ranks = []
+            for i in range(self._dims[axis]):
+                coord = list(fixed)
+                coord.insert(axis, i)
+                ranks.append(self._coord2rank[tuple(coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+
+# axis-name translation: reference parallel names -> mesh axis names
+_NAME2AXIS = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+              "sep": "sep", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    """Per-axis groups + coordinate getters. In mesh mode "my rank" is the
+    process rank (0 in single-controller); world sizes come from the mesh."""
+
+    def __init__(self, topology: CommunicateTopology | None = None):
+        if topology is None:
+            m = mesh_mod.get_mesh()
+            dims = [int(m.shape.get(ax, 1)) for ax in mesh_mod.HYBRID_AXES]
+            topology = CommunicateTopology(dims=dims)
+        self._topo = topology
+        self.nranks = topology.world_size()
+        self.global_rank = max(get_rank(), 0)
+        coord = self._topo.get_coord(self.global_rank)
+        names = self._topo.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+        self._groups = {}
+        for name in names:
+            axis = _NAME2AXIS.get(name, name)
+            # the group containing this rank along `name`
+            for ranks in self._topo.get_comm_list(name):
+                if self.global_rank in ranks:
+                    self._groups[name] = Group(ranks, axis=axis, name=f"{name}_group")
+                    break
+
+    # -- degrees -------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._topo.get_dim("data")
+
+    def get_model_parallel_world_size(self):
+        return self._topo.get_dim("model")
+
+    def get_pipe_parallel_world_size(self):
+        return self._topo.get_dim("pipe")
+
+    def get_sharding_parallel_world_size(self):
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self._topo.get_dim("sep")
+
+    # -- my coordinates ------------------------------------------------------
+    def get_data_parallel_rank(self):
+        return self._coord["data"]
+
+    def get_model_parallel_rank(self):
+        return self._coord["model"]
+
+    def get_stage_id(self):
+        return self._coord["pipe"]
+
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sep_parallel_rank(self):
+        return self._coord["sep"]
+
+    # -- groups --------------------------------------------------------------
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a, **k):
+        return Group(list(range(self.nranks)), name="check_group")
+
+    def get_data_parallel_group_src_rank(self):
+        return self._groups["data"].ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self._groups["model"].ranks[0]
+
+    # pipeline neighbours (used by p2p schedules)
+    def get_p2p_groups(self):
+        return None
+
+    @property
+    def topology(self):
+        return self._topo
+
+
+_hcg: HybridCommunicateGroup | None = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    global _hcg
+    if _hcg is None:
+        _hcg = HybridCommunicateGroup()
+    return _hcg

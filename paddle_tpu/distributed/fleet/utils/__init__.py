@@ -1,0 +1,62 @@
+"""fleet.utils — recompute + sequence parallel helpers (reference:
+``python/paddle/distributed/fleet/utils/__init__.py``)."""
+from __future__ import annotations
+
+import jax
+
+from ....framework.core import Tensor
+from ....autograd.tape import apply, no_grad
+from . import sequence_parallel_utils  # noqa: F401
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def recompute(function, *args, **kwargs):
+    """Activation recompute (reference: ``paddle.distributed.fleet.utils.
+    recompute`` → re-forward in backward; SURVEY.md §7.1 M4 "recompute ≡
+    jax.checkpoint").
+
+    Under a jit trace (to_static / the distributed engine) this wraps the
+    call in ``jax.checkpoint`` — residuals are dropped and re-computed in
+    backward, with params correctly differentiated through the closure
+    tracers. In pure eager mode it runs normally (eager JAX holds vjp
+    residuals per-op; the memory win belongs to the compiled path, which is
+    also where the reference uses recompute for real training).
+    """
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    leaves, treedef = jax.tree.flatten(list(args), is_leaf=_is_tensor)
+    tracing = any(isinstance(l._data if isinstance(l, Tensor) else l,
+                             jax.core.Tracer) for l in leaves)
+    if not tracing:
+        return function(*args, **kwargs)
+
+    tensor_slots = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    static_leaves = [None if isinstance(l, Tensor) else l for l in leaves]
+    sg_flags = [leaves[i].stop_gradient for i in tensor_slots]
+
+    @jax.checkpoint
+    def pure(*arrs):
+        new_leaves = list(static_leaves)
+        for slot, a, sg in zip(tensor_slots, arrs, sg_flags):
+            t = Tensor(a)
+            t.stop_gradient = sg
+            new_leaves[slot] = t
+        new_args = jax.tree.unflatten(treedef, new_leaves)
+        with no_grad():
+            out = function(*new_args, **kwargs)
+        return jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t,
+                            out, is_leaf=_is_tensor)
+
+    arrs = [leaves[i]._data for i in tensor_slots]
+    out = pure(*arrs)
+    return jax.tree.map(lambda a: Tensor(a) if isinstance(
+        a, (jax.Array, jax.core.Tracer)) else a, out)
+
+
+class HybridParallelInferenceHelper:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("static-mode hybrid inference helper is not "
+                                  "in the TPU build; use jit + AOT lowering")

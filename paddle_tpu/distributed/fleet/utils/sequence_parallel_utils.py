@@ -1,0 +1,122 @@
+"""Megatron-style sequence parallelism (reference: ``python/paddle/
+distributed/fleet/utils/sequence_parallel_utils.py`` — ``ScatterOp``/
+``GatherOp``/``AllGatherOp``/``ReduceScatterOp`` on the seq dim,
+``ColumnSequenceParallelLinear``/``RowSequenceParallelLinear``,
+``mark_as_sequence_parallel_parameter`` + grad-allreduce hooks for
+seq-parallel params (LayerNorm); SURVEY.md §5.7 mechanism 1).
+
+TPU-native (SURVEY.md §5.7 "TPU-native plan"): SP ≡ sharding the sequence
+axis of activations over the 'mp' mesh axis. The reference's four explicit
+collectives (AG before column-linear, RS after row-linear, scatter/gather at
+region boundaries) are the lowering XLA derives from resharding between
+``P('mp', ...)`` (seq sharded) and contraction with mp-sharded weights — so
+each Op here is a differentiable reshard, and the LN-param grad-allreduce
+hook is unnecessary (grads of replicated params are psum'd by GSPMD).
+
+Convention: activations are [s, b, h] inside the SP region (reference
+convention), seq dim = 0.
+"""
+from __future__ import annotations
+
+from ..meta_parallel.mp_layers import (
+    reshard, ColumnParallelLinear, RowParallelLinear, mp_degree,
+)
+
+
+def _seq_spec(x, axis):
+    spec = [None] * x.ndim
+    spec[0] = axis
+    return spec
+
+
+class ScatterOp:
+    """Split the seq dim over mp (fwd scatter / bwd gather)."""
+
+    @staticmethod
+    def apply(x):
+        if mp_degree() <= 1:
+            return x
+        return reshard(x, *_seq_spec(x, "mp"))
+
+
+class GatherOp:
+    """Gather the seq dim (fwd allgather / bwd scatter)."""
+
+    @staticmethod
+    def apply(x):
+        if mp_degree() <= 1:
+            return x
+        return reshard(x, *([None] * x.ndim))
+
+
+class AllGatherOp(GatherOp):
+    """AG before a column-parallel matmul (bwd reduce-scatter)."""
+
+
+class ReduceScatterOp:
+    """RS after a row-parallel matmul (bwd allgather)."""
+
+    @staticmethod
+    def apply(x):
+        if mp_degree() <= 1:
+            return x
+        return reshard(x, *_seq_spec(x, "mp"))
+
+
+def scatter(x):
+    return ScatterOp.apply(x)
+
+
+def all_gather(x):
+    return AllGatherOp.apply(x)
+
+
+def reduce_scatter(x):
+    return ReduceScatterOp.apply(x)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter):
+    return getattr(parameter, "sequence_parallel", False)
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """No-op in mesh mode: grads of replicated (seq-parallel) params are
+    already globally reduced by the SPMD partitioner. Kept for API parity."""
+    return
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear fed by seq-sharded activations: AG(seq) then
+    matmul against the column-sharded weight (XLA derives the AG from the
+    reshard)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, gather_output=gather_output,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose output is reduce-scattered onto the seq dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr=weight_attr,
+                         has_bias=has_bias, input_is_parallel=input_is_parallel,
+                         mp_group=mp_group, name=name)
+
+    def forward(self, x):
+        y = super().forward(x)
+        return ReduceScatterOp.apply(y)

@@ -1,0 +1,95 @@
+"""``python -m paddle_tpu.distributed.launch`` — the job launcher CLI
+(reference: ``python/paddle/distributed/launch/main.py`` — CollectiveController
+builds Pod/Containers, sets PADDLE_TRAINER_* env per rank, spawns one process
+per device; elastic restart via master watchdog, SURVEY.md §3.4/§5.3).
+
+TPU-native differences:
+* One worker process per **host**, not per chip — a JAX process drives every
+  local chip; ranks = hosts. ``--nnodes``/``--master`` wire up
+  ``jax.distributed.initialize`` through the PADDLE_* env compat shim
+  (parallel_env.py).
+* ``--run_mode=elastic`` gives checkpoint-restart supervision: on a nonzero
+  exit the worker is relaunched (TPU preemption/halt recovery model,
+  SURVEY.md §5.3 "TPU equivalent"), up to ``--max_restarts``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="coordinator ip:port (defaults to first endpoint)")
+    p.add_argument("--nnodes", type=int, default=1, help="number of hosts")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this host's rank (default: env PADDLE_TRAINER_ID or 0)")
+    p.add_argument("--devices", "--gpus", "--xpus", default=None,
+                   help="accepted for reference-CLI compat; a TPU host process "
+                        "always drives all local chips")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--run_mode", default="collective",
+                   choices=["collective", "elastic"])
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args):
+    env = dict(os.environ)
+    rank = args.rank if args.rank is not None else int(env.get("PADDLE_TRAINER_ID", 0))
+    master = args.master or env.get("PADDLE_MASTER") or "127.0.0.1:6170"
+    endpoints = env.get("PADDLE_TRAINER_ENDPOINTS")
+    if not endpoints:
+        host, _, port = master.partition(":")
+        endpoints = ",".join(f"{host}:{int(port or 6170) + i}"
+                             for i in range(args.nnodes))
+    eps = endpoints.split(",")
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(args.nnodes),
+        "PADDLE_TRAINER_ENDPOINTS": endpoints,
+        "PADDLE_CURRENT_ENDPOINT": eps[rank % len(eps)],
+        "PADDLE_JOB_ID": args.job_id,
+    })
+    return env, rank
+
+
+def launch_main(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    env, rank = _worker_env(args)
+    os.makedirs(args.log_dir, exist_ok=True)
+    log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+    cmd = [sys.executable, args.training_script] + args.training_script_args
+
+    restarts = 0
+    while True:
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=logf)
+            try:
+                code = proc.wait()
+            except KeyboardInterrupt:
+                proc.send_signal(signal.SIGTERM)
+                proc.wait()
+                return 130
+        if code == 0:
+            return 0
+        if args.run_mode != "elastic" or restarts >= args.max_restarts:
+            print(f"worker rank {rank} exited with code {code} "
+                  f"(log: {log_path})", file=sys.stderr)
+            return code
+        restarts += 1
+        print(f"[elastic] worker failed (code {code}); restart "
+              f"{restarts}/{args.max_restarts}", file=sys.stderr)
+        time.sleep(1.0)
+
+
+if __name__ == "__main__":
+    sys.exit(launch_main())

@@ -1,0 +1,90 @@
+"""Global device mesh — the TPU-native backbone of the distributed stack.
+
+Reference analogue: ``HybridCommunicateGroup``'s N-D rank mesh in axis order
+[dp, pp, sharding, sep, mp] (``python/paddle/distributed/fleet/base/topology.py``,
+SURVEY.md §2.3) — but instead of a rank-coordinate bookkeeping object backed by
+NCCL comm rings, the mesh IS a ``jax.sharding.Mesh``: every parallelism axis is
+a named mesh axis, shardings are ``NamedSharding``/``PartitionSpec`` over those
+axes, and XLA emits the collectives over ICI/DCN (SURVEY.md §7.0).
+
+Axis order convention matches the reference: mp innermost (fastest links —
+on a TPU torus, the last mesh axis maps to the tightest ICI ring), dp
+outermost.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# canonical hybrid axis order (reference: fixed order [dp, pp, sharding, sep, mp])
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+_global_mesh: Mesh | None = None
+
+
+def init_mesh(degrees: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build (and install) the global mesh from parallelism degrees.
+
+    ``degrees`` maps axis name -> size; unspecified hybrid axes get 1. A
+    remainder of devices is folded into dp. With no args: 1-D dp mesh over
+    all devices.
+    """
+    global _global_mesh
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    degrees = dict(degrees or {})
+    sizes = [int(degrees.get(ax, 1)) for ax in HYBRID_AXES]
+    prod = int(np.prod([s for s in sizes if s > 0]))
+    if n % max(prod, 1) != 0:
+        raise ValueError(f"device count {n} not divisible by degree product {prod} "
+                         f"({dict(zip(HYBRID_AXES, sizes))})")
+    # fold leftover devices into dp (paddle: dp_degree inferred from world size)
+    if degrees.get("dp") in (None, -1):
+        sizes[0] = n // (prod // max(sizes[0], 1)) if sizes[0] > 0 else n // prod
+    prod = int(np.prod(sizes))
+    if prod != n:
+        raise ValueError(f"degrees {dict(zip(HYBRID_AXES, sizes))} use {prod} "
+                         f"devices, but {n} are available")
+    arr = np.array(devices).reshape(sizes)
+    _global_mesh = Mesh(arr, HYBRID_AXES)
+    return _global_mesh
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Mesh:
+    if _global_mesh is None:
+        init_mesh()
+    return _global_mesh
+
+
+def has_mesh() -> bool:
+    return _global_mesh is not None
+
+
+def reset_mesh():
+    global _global_mesh
+    _global_mesh = None
+
+
+def axis_size(name: str) -> int:
+    m = get_mesh()
+    return int(m.shape[name]) if name in m.shape else 1
+
+
+def axis_index(name: str):
+    """Trace-time index along a mesh axis (inside shard_map)."""
+    return jax.lax.axis_index(name)
+
+
+def sharding(*spec) -> NamedSharding:
+    """NamedSharding over the global mesh for a PartitionSpec."""
+    return NamedSharding(get_mesh(), PartitionSpec(*spec))
+
+
+def replicated() -> NamedSharding:
+    return NamedSharding(get_mesh(), PartitionSpec())

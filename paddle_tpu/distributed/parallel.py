@@ -1,0 +1,137 @@
+"""DataParallel (reference: ``python/paddle/distributed/parallel.py`` +
+the C++ reducer ``paddle/fluid/imperative/reducer.cc`` — grad bucketing with
+allreduce overlapped in backward, ``no_sync``, SURVEY.md §2.3 "DP").
+
+TPU-native: two execution modes.
+
+* **Mesh mode** (single-controller SPMD, the perf path): parameters stay
+  replicated over the global mesh; ``forward`` shards batch inputs on the dp
+  axis. Every eager op then runs data-parallel under GSPMD, and gradient
+  reduction is inserted by XLA — no reducer, no buckets, no explicit
+  allreduce (why: grads of replicated params w.r.t. dp-sharded activations
+  are psum'd by the partitioner automatically; bucketing exists in the
+  reference only to amortise NCCL launch overhead, which has no analogue
+  here).
+* **Simulated/multi-process per-rank mode**: classic Paddle semantics — a
+  post-backward callback averages each parameter's grad over the dp group
+  (the reducer flush), disabled inside ``no_sync()``.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..framework.core import Tensor
+from ..nn.layer import Layer
+from ..autograd import tape
+from . import simulator
+from . import mesh as mesh_mod
+from . import collective
+from .parallel_env import init_parallel_env, get_rank, get_world_size  # noqa: F401
+
+
+def shard_tensor_on_axis(t: Tensor, axis: str, dim: int = 0) -> Tensor:
+    """Reshard a tensor over a mesh axis along ``dim`` (mesh mode)."""
+    mesh = mesh_mod.get_mesh()
+    if axis not in mesh.shape or mesh.shape[axis] == 1:
+        return t
+    spec = [None] * t.ndim
+    spec[dim] = axis
+    t._data = jax.device_put(t._data, NamedSharding(mesh, PartitionSpec(*spec)))
+    return t
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._grad_sync_enabled = True
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+        self._sim_mode = simulator.in_simulation() or jax.process_count() > 1
+        if self._sim_mode:
+            if self.group is None:
+                self.group = collective._get_default_group()
+            # weak self-ref: a discarded DataParallel must not keep syncing
+            # (or keep alive) its model from the thread's callback list
+            import weakref
+            ref = weakref.ref(self)
+
+            def _cb():
+                dp = ref()
+                if dp is None:
+                    tape.unregister_post_backward_callback(_cb)
+                    return
+                dp._sync_gradients()
+
+            self._cb = tape.register_post_backward_callback(_cb)
+        else:
+            # mesh mode: ensure params are replicated over the mesh so that
+            # dp-sharded activations trigger GSPMD grad reduction
+            if mesh_mod.has_mesh() and len(mesh_mod.get_mesh().devices.flat) > 1:
+                repl = mesh_mod.replicated()
+                with tape.no_grad():
+                    for p in layers.parameters():
+                        if p is not None and not isinstance(p._data, jax.core.Tracer):
+                            if getattr(p, "_sharding_spec", None) is None:
+                                p._data = jax.device_put(p._data, repl)
+
+    def forward(self, *inputs, **kwargs):
+        if not self._sim_mode and mesh_mod.has_mesh():
+            inputs = tuple(
+                shard_tensor_on_axis(x, "dp", 0) if isinstance(x, Tensor) and x.ndim > 0
+                else x
+                for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    # -- per-rank grad sync (simulated / multi-process) ----------------------
+    def _sync_gradients(self):
+        if not self._grad_sync_enabled or not self._sim_mode:
+            return
+        for p in self._layers.parameters():
+            if p is not None and p.grad is not None and p.trainable:
+                collective.all_reduce(p.grad, op=collective.ReduceOp.AVG,
+                                      group=self.group)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Skip grad sync inside (grad accumulation); reference ``no_sync``."""
+        prev = self._grad_sync_enabled
+        self._grad_sync_enabled = False
+        try:
+            yield
+        finally:
+            self._grad_sync_enabled = prev
+
+    # -- delegation ----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        self.training = True
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        self.training = False
+        return self
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        self._sync_gradients()

@@ -1,0 +1,113 @@
+"""Process/topology bootstrap (reference: ``python/paddle/distributed/parallel.py``
+``init_parallel_env`` + ``ParallelEnv``, env vars ``PADDLE_TRAINER_ID`` /
+``PADDLE_TRAINERS_NUM`` / ``PADDLE_TRAINER_ENDPOINTS`` set by launch —
+SURVEY.md §2.3 "Env/topology bootstrap").
+
+TPU-native: rendezvous is ``jax.distributed.initialize`` (coordinator service)
+instead of TCPStore; one process per *host* (TPU convention), not per chip.
+The same PADDLE_* env names are honoured as a compat shim. Under the thread
+simulator (simulator.py), rank/world come from the simulated context.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from . import simulator
+from . import mesh as mesh_mod
+
+_initialized = [False]
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def init_parallel_env():
+    """Initialize the distributed context. Safe to call more than once.
+
+    Multi-host: if PADDLE_TRAINERS_NUM > 1 (or JAX coordinator env present),
+    calls ``jax.distributed.initialize`` using PADDLE_* env as the compat
+    source; then installs the default global mesh.
+    """
+    if _initialized[0] or simulator.in_simulation():
+        return ParallelEnv()
+    nranks = _env_int("PADDLE_TRAINERS_NUM", 1)
+    if nranks > 1 and not jax._src.distributed.global_state.client:  # noqa: SLF001
+        rank = _env_int("PADDLE_TRAINER_ID", 0)
+        endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        coordinator = endpoints.split(",")[0] if endpoints else None
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=nranks,
+            process_id=rank,
+        )
+    if not mesh_mod.has_mesh():
+        mesh_mod.init_mesh()
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    r = simulator.current_rank()
+    if r is not None:
+        if group is not None:
+            return group.get_group_rank(r)
+        return r
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    w = simulator.active_world()
+    if w is not None:
+        return group.nranks if group is not None else w.nprocs
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized[0] or simulator.in_simulation()
+
+
+class ParallelEnv:
+    """paddle.distributed.ParallelEnv — rank/world/device view."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def device_id(self):
+        return _env_int("FLAGS_selected_tpus", 0)
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]

@@ -1,0 +1,50 @@
+"""paddle.distributed.sharding — group_sharded user API (reference:
+``python/paddle/distributed/sharding/group_sharded.py`` —
+``group_sharded_parallel(model, optimizer, level='os'|'os_g'|'p_g_os',
+offload=...)`` and ``save_group_sharded_model``; SURVEY.md §2.3 "Sharding
+stage 3")."""
+from __future__ import annotations
+
+import os
+
+from ..fleet.meta_parallel.sharding import (
+    DygraphShardingOptimizer, GroupShardedOptimizerStage2,
+    GroupShardedStage2, GroupShardedStage3,
+)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """level: 'os' (stage 1), 'os_g' (stage 2), 'p_g_os' (stage 3)."""
+    if level == "os":
+        opt = DygraphShardingOptimizer(optimizer)
+        return model, opt, scaler
+    if level == "os_g":
+        opt = GroupShardedOptimizerStage2(optimizer)
+        wrapped = GroupShardedStage2(model, opt, group=group,
+                                     sync_buffers=sync_buffers,
+                                     buffer_max_size=buffer_max_size,
+                                     dp_group=dp_group)
+        return wrapped, opt, scaler
+    if level == "p_g_os":
+        opt = GroupShardedOptimizerStage2(optimizer)
+        wrapped = GroupShardedStage3(model, opt, group=group,
+                                     sync_buffers=sync_buffers,
+                                     segment_size=segment_size, offload=offload,
+                                     dp_group=dp_group, exclude_layer=exclude_layer)
+        return wrapped, opt, scaler
+    raise ValueError(f"unknown group_sharded level {level!r} "
+                     "(expected 'os', 'os_g', or 'p_g_os')")
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Gather shards and save (rank 0 semantics; gathering is implicit —
+    ``state_dict`` reads global arrays)."""
+    from ...framework.io import save
+    inner = getattr(model, "_layer", model)
+    os.makedirs(output, exist_ok=True)
+    save(inner.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

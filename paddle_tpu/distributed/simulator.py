@@ -1,0 +1,147 @@
+"""Thread-rank simulator: per-rank SPMD semantics in one process.
+
+The reference tests distributed code by spawning N processes on one host with
+TCP rendezvous (SURVEY.md §4, "multi-node is simulated by multi-process on one
+host"). On TPU the perf path is single-controller SPMD (mesh + shardings), so
+per-rank *processes* are unnecessary — but the imperative collective API
+(``dist.all_reduce`` on same-shape per-rank tensors) still needs per-rank
+execution contexts for API/test parity. This module provides them as threads:
+``spawn(fn, nprocs=N)`` runs ``fn`` in N threads, each with a thread-local
+rank; collectives rendezvous through an in-memory exchange (the TCPStore
+analogue, reference ``paddle/fluid/distributed/store/tcp_store.cc``).
+
+Real multi-host jobs don't use this: ``launch`` starts one process per host
+and collectives run over the global mesh (see collective.py multihost path).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable
+
+_tls = threading.local()
+
+
+class _Rendezvous:
+    """Blocking all-to-all meeting point, one slot list per (tag, round)."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self._cond = threading.Condition()
+        self._slots: dict[Any, dict[int, Any]] = {}
+        self._done: dict[Any, int] = {}
+        self.failed = False  # set when any rank dies; unblocks waiters
+
+    def exchange(self, tag, rank: int, value, participants: tuple[int, ...]):
+        """Deposit ``value`` for ``rank``; block until every participant has
+        deposited; return {rank: value} for the full group."""
+        n = len(participants)
+        with self._cond:
+            slot = self._slots.setdefault(tag, {})
+            slot[rank] = value
+            if len(slot) == n:
+                self._cond.notify_all()
+            else:
+                self._cond.wait_for(
+                    lambda: self.failed or len(self._slots.get(tag, {})) == n,
+                    timeout=60)
+                if self.failed:
+                    raise RuntimeError(
+                        f"collective '{tag}' aborted: a peer rank failed")
+                if len(self._slots.get(tag, {})) != n:
+                    raise TimeoutError(
+                        f"collective '{tag}' timed out: "
+                        f"{sorted(self._slots.get(tag, {}))} of {participants}")
+            result = dict(self._slots[tag])
+            # last reader cleans the slot
+            self._done[tag] = self._done.get(tag, 0) + 1
+            if self._done[tag] == n:
+                del self._slots[tag]
+                del self._done[tag]
+            return result
+
+    def put(self, tag, value):
+        with self._cond:
+            self._slots.setdefault(("p2p", tag), {})[0] = value
+            self._cond.notify_all()
+
+    def get(self, tag):
+        key = ("p2p", tag)
+        with self._cond:
+            self._cond.wait_for(lambda: key in self._slots, timeout=120)
+            if key not in self._slots:
+                raise TimeoutError(f"recv '{tag}' timed out")
+            v = self._slots.pop(key)[0]
+            return v
+
+
+class SimWorld:
+    """One simulated job: world size, rendezvous, per-group op counters."""
+
+    def __init__(self, nprocs: int):
+        self.nprocs = nprocs
+        self.rendezvous = _Rendezvous(nprocs)
+        self._counter_lock = threading.Lock()
+
+    def next_tag(self, kind: str, group_key):
+        # per-thread per-group sequence number keeps concurrent collectives
+        # on the same group correctly paired across ranks
+        seqs = getattr(_tls, "seqs", None)
+        if seqs is None:
+            seqs = _tls.seqs = {}
+        k = (kind, group_key)
+        seqs[k] = seqs.get(k, 0) + 1
+        return (kind, group_key, seqs[k])
+
+
+_active_world: SimWorld | None = None
+
+
+def active_world() -> SimWorld | None:
+    return _active_world if getattr(_tls, "rank", None) is not None else None
+
+
+def current_rank() -> int | None:
+    return getattr(_tls, "rank", None)
+
+
+def in_simulation() -> bool:
+    return current_rank() is not None
+
+
+def run(fn: Callable, nprocs: int, args=(), propagate=True):
+    """Run ``fn(*args)`` on ``nprocs`` simulated ranks; returns list of per-rank
+    return values. Exceptions in any rank re-raise in the caller."""
+    global _active_world
+    if _active_world is not None and in_simulation():
+        raise RuntimeError("nested spawn() inside a simulated rank")
+    world = SimWorld(nprocs)
+    _active_world = world
+    results: list[Any] = [None] * nprocs
+    errors: list[BaseException | None] = [None] * nprocs
+
+    def worker(rank):
+        _tls.rank = rank
+        _tls.seqs = {}
+        try:
+            results[rank] = fn(*args)
+        except BaseException as e:  # noqa: BLE001 — reported to caller
+            errors[rank] = e
+            # unblock peers waiting on this rank
+            with world.rendezvous._cond:
+                world.rendezvous.failed = True
+                world.rendezvous._cond.notify_all()
+        finally:
+            _tls.rank = None
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(nprocs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    _active_world = None
+    if propagate:
+        for r, e in enumerate(errors):
+            if e is not None:
+                raise RuntimeError(f"simulated rank {r} failed: {e!r}") from e
+    return results

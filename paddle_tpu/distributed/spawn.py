@@ -1,0 +1,28 @@
+"""paddle.distributed.spawn (reference: ``python/paddle/distributed/spawn.py``
+— per-device child processes with env rendezvous; SURVEY.md §4 pattern (1) for
+distributed unit tests).
+
+TPU-native: per-rank *threads* via the simulator (simulator.py) — the single
+JAX process owns all devices, so per-rank OS processes would fight over the
+backend; threads give the same per-rank SPMD semantics for the imperative
+collective API while the mesh path needs no ranks at all.
+"""
+from __future__ import annotations
+
+from . import simulator
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    import jax
+    if nprocs in (-1, None):
+        nprocs = jax.local_device_count()
+    results = simulator.run(func, nprocs, args=args)
+
+    class _Context:
+        def __init__(self, results):
+            self.results = results
+
+        def join(self):
+            return True
+
+    return _Context(results)

@@ -15,6 +15,8 @@ directly (SURVEY.md §7.0: "jax.jit IS the tracer").
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
 
 from .core import Tensor
@@ -23,6 +25,49 @@ from . import random as prandom
 
 def _is_tensor(x):
     return isinstance(x, Tensor)
+
+
+@contextlib.contextmanager
+def swap_state(params, buffers, p_arrs, b_arrs, rng_key, layer=None,
+               training=None):
+    """Swap parameter/buffer backing arrays for (possibly traced) ``p_arrs``/
+    ``b_arrs``, seed the hidden RNG from ``rng_key``, raise the tracing flag,
+    optionally force ``training`` on every sublayer — and restore everything
+    on exit. The single primitive under FunctionalModule and @to_static."""
+    from ..autograd.tape import no_grad
+    from ..jit import api as jit_api
+
+    saved_p = [t._data for t in params]
+    saved_b = [t._data for t in buffers]
+    sublayers = (list(layer.sublayers(include_self=True))
+                 if layer is not None and hasattr(layer, "sublayers") else [])
+    saved_train = [l.training for l in sublayers]
+    gen = prandom.default_generator()
+    saved_rng = (gen._root, gen._counter)
+    saved_tracing = jit_api._TRACING[0]
+    jit_api._TRACING[0] = True
+    try:
+        for t, a in zip(params, p_arrs):
+            t._data = a
+        for t, a in zip(buffers, b_arrs):
+            t._data = a
+        if training is not None:
+            for l in sublayers:
+                l.training = training
+        gen._root = rng_key
+        gen._counter = 0
+        with no_grad():
+            yield
+    finally:
+        for t, a in zip(params, saved_p):
+            t._data = a
+        for t, a in zip(buffers, saved_b):
+            t._data = a
+        if training is not None:
+            for l, tr in zip(sublayers, saved_train):
+                l.training = tr
+        gen._root, gen._counter = saved_rng
+        jit_api._TRACING[0] = saved_tracing
 
 
 class FunctionalModule:
@@ -64,29 +109,9 @@ class FunctionalModule:
 
     # -- the pure call -------------------------------------------------------
     def __call__(self, p_arrs, b_arrs, rng_key, *args, **kwargs):
-        from ..autograd.tape import no_grad
-        from ..jit import api as jit_api
-
-        saved_p = [t._data for t in self.params]
-        saved_b = [t._data for t in self.buffers]
-        sublayers = (list(self.layer.sublayers(include_self=True))
-                     if hasattr(self.layer, "sublayers") else [])
-        saved_train = [l.training for l in sublayers]
-        gen = prandom.default_generator()
-        saved_rng = (gen._root, gen._counter)
-        saved_tracing = jit_api._TRACING[0]
-        jit_api._TRACING[0] = True
-        try:
-            for t, a in zip(self.params, p_arrs):
-                t._data = a
-            for t, a in zip(self.buffers, b_arrs):
-                t._data = a
-            if self._training is not None:
-                for l in sublayers:
-                    l.training = self._training
-            gen._root = rng_key
-            gen._counter = 0
-
+        with swap_state(self.params, self.buffers, p_arrs, b_arrs, rng_key,
+                        layer=self.layer if hasattr(self.layer, "sublayers") else None,
+                        training=self._training):
             def wrap(x):
                 if isinstance(x, Tensor):
                     return x
@@ -96,23 +121,12 @@ class FunctionalModule:
 
             w_args, w_kwargs = jax.tree.map(wrap, (args, kwargs),
                                             is_leaf=_is_tensor)
-            with no_grad():
-                out = self._method(*w_args, **w_kwargs)
+            out = self._method(*w_args, **w_kwargs)
             out_arrays = jax.tree.map(
                 lambda t: t._data if isinstance(t, Tensor) else t, out,
                 is_leaf=_is_tensor)
             new_b = [t._data for t in self.buffers]
             return out_arrays, new_b
-        finally:
-            for t, a in zip(self.params, saved_p):
-                t._data = a
-            for t, a in zip(self.buffers, saved_b):
-                t._data = a
-            if self._training is not None:
-                for l, tr in zip(sublayers, saved_train):
-                    l.training = tr
-            gen._root, gen._counter = saved_rng
-            jit_api._TRACING[0] = saved_tracing
 
     # -- write-back ----------------------------------------------------------
     def update_params(self, p_arrs):

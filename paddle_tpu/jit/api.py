@@ -138,39 +138,20 @@ class StaticFunction:
         tensor_slots = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
 
         def core(p_arrs, b_arrs, key, t_arrs):
-            saved_p = [t._data for t in params]
-            saved_b = [t._data for t in bufs]
-            gen = prandom.default_generator()
-            saved_rng = (gen._root, gen._counter)
-            saved_tr = _TRACING[0]
-            _TRACING[0] = True
-            try:
-                for t, a in zip(params, p_arrs):
-                    t._data = a
-                for t, a in zip(bufs, b_arrs):
-                    t._data = a
-                gen._root = key
-                gen._counter = 0
+            from ..framework.functional import swap_state
+            with swap_state(params, bufs, p_arrs, b_arrs, key):
                 new_leaves = list(static_leaves)
                 for slot, arr, sg in zip(tensor_slots, t_arrs, sg_flags):
                     tt = Tensor(arr)
                     tt.stop_gradient = sg
                     new_leaves[slot] = tt
                 new_args, new_kwargs = jax.tree.unflatten(treedef, new_leaves)
-                with no_grad():
-                    out = self._call_eager(*new_args, **new_kwargs)
+                out = self._call_eager(*new_args, **new_kwargs)
                 out_arrays = jax.tree.map(
                     lambda t: t._data if isinstance(t, Tensor) else t, out,
                     is_leaf=_is_tensor)
                 new_bufs = [t._data for t in bufs]
                 return out_arrays, new_bufs
-            finally:
-                for t, a in zip(params, saved_p):
-                    t._data = a
-                for t, a in zip(bufs, saved_b):
-                    t._data = a
-                gen._root, gen._counter = saved_rng
-                _TRACING[0] = saved_tr
 
         return jax.jit(core)
 

@@ -137,13 +137,14 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 @defop
 def maxout(x, groups, axis=1):
-    # out_{s*i+j} = max_k x_{g*s*i + s*k + j}, s = c/groups: channel dim splits
-    # as (groups, c//groups) with the max over the groups factor.
+    # phi MaxOutFunctor: output channel i = max over CONSECUTIVE input
+    # channels {i*groups + k}, so the channel dim splits as
+    # (c//groups, groups) with the max over the inner factor.
     c = x.shape[axis]
     new_shape = list(x.shape)
-    new_shape[axis] = groups
-    new_shape.insert(axis + 1, c // groups)
-    return jnp.max(jnp.reshape(x, new_shape), axis=axis)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
 
 
 @defop

@@ -412,7 +412,6 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW", name=None):
     # 3-D (NCL/NWC) input: treat length as W with a singleton H, then squeeze.
     if x.ndim == 3:
-        from ..layer import Layer  # noqa: F401  (no cycle; keep import local)
         chan_last = data_format in ("NWC", "NLC")
         xs = x.unsqueeze(2) if not chan_last else x.unsqueeze(1)
         size2 = [1, int(size[0] if isinstance(size, (list, tuple)) else size)] \

@@ -1,0 +1,455 @@
+"""Distributed-core tests (SURVEY.md §4: collective tests per-rank with loss
+parity vs a single-process oracle; hybrid mp/pp/sharding parity tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+# ---------------------------------------------------------------------------
+# imperative collectives (thread-rank simulator)
+# ---------------------------------------------------------------------------
+
+
+class TestCollectives:
+    def test_all_reduce_sum(self):
+        def worker():
+            r = dist.get_rank()
+            t = paddle.to_tensor(np.full((2, 3), float(r + 1), "float32"))
+            dist.all_reduce(t)
+            return t.numpy()
+
+        res = dist.spawn(worker, nprocs=4).results
+        for v in res:
+            np.testing.assert_allclose(v, 10.0)
+
+    def test_all_reduce_max_and_group(self):
+        def worker():
+            r = dist.get_rank()
+            g = dist.new_group([0, 2])
+            t = paddle.to_tensor(np.array([float(r)], "float32"))
+            if r in (0, 2):
+                dist.all_reduce(t, op=dist.ReduceOp.MAX, group=g)
+            return t.numpy()[0]
+
+        res = dist.spawn(worker, nprocs=4).results
+        assert res[0] == 2.0 and res[2] == 2.0
+        assert res[1] == 1.0 and res[3] == 3.0
+
+    def test_all_gather(self):
+        def worker():
+            r = dist.get_rank()
+            out = []
+            dist.all_gather(out, paddle.to_tensor(np.array([r], "float32")))
+            return [t.numpy()[0] for t in out]
+
+        res = dist.spawn(worker, nprocs=3).results
+        for v in res:
+            assert v == [0.0, 1.0, 2.0]
+
+    def test_reduce_scatter(self):
+        def worker():
+            r = dist.get_rank()
+            parts = [paddle.to_tensor(np.full((2,), float(r + 10 * i), "float32"))
+                     for i in range(2)]
+            out = paddle.zeros([2])
+            dist.reduce_scatter(out, parts)
+            return out.numpy()[0]
+
+        res = dist.spawn(worker, nprocs=2).results
+        # rank0 gets sum of parts[0] over ranks = 0+1; rank1: 10+11
+        assert res[0] == 1.0 and res[1] == 21.0
+
+    def test_alltoall(self):
+        def worker():
+            r = dist.get_rank()
+            ins = [paddle.to_tensor(np.array([r * 10 + i], "float32"))
+                   for i in range(2)]
+            outs = []
+            dist.alltoall(outs, ins)
+            return [t.numpy()[0] for t in outs]
+
+        res = dist.spawn(worker, nprocs=2).results
+        assert res[0] == [0.0, 10.0]
+        assert res[1] == [1.0, 11.0]
+
+    def test_broadcast_scatter(self):
+        def worker():
+            r = dist.get_rank()
+            t = paddle.to_tensor(np.array([float(r)], "float32"))
+            dist.broadcast(t, src=1)
+            parts = [paddle.to_tensor(np.array([7.0 + i], "float32"))
+                     for i in range(2)] if r == 0 else None
+            s = paddle.zeros([1])
+            dist.scatter(s, parts, src=0)
+            return t.numpy()[0], s.numpy()[0]
+
+        res = dist.spawn(worker, nprocs=2).results
+        assert [v[0] for v in res] == [1.0, 1.0]
+        assert [v[1] for v in res] == [7.0, 8.0]
+
+    def test_send_recv(self):
+        def worker():
+            r = dist.get_rank()
+            if r == 0:
+                dist.send(paddle.to_tensor(np.array([42.0], "float32")), dst=1)
+                return 0.0
+            t = paddle.zeros([1])
+            dist.recv(t, src=0)
+            return t.numpy()[0]
+
+        res = dist.spawn(worker, nprocs=2).results
+        assert res[1] == 42.0
+
+    def test_barrier_and_object_gather(self):
+        def worker():
+            dist.barrier()
+            objs = []
+            dist.all_gather_object(objs, {"rank": dist.get_rank()})
+            return [o["rank"] for o in objs]
+
+        res = dist.spawn(worker, nprocs=3).results
+        for v in res:
+            assert v == [0, 1, 2]
+
+    def test_world_size_rank_outside_spawn(self):
+        assert dist.get_world_size() == 1
+        assert dist.get_rank() == 0
+        # world-size-1 collectives are identities
+        t = paddle.to_tensor(np.array([3.0], "float32"))
+        dist.all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), [3.0])
+
+
+# ---------------------------------------------------------------------------
+# mesh-mode tensor parallelism: parity vs unsharded oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def mp2_mesh():
+    strat = dist.fleet.DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    dist.fleet.init(is_collective=True, strategy=strat)
+    yield
+    dist.mesh.reset_mesh()
+
+
+class TestTensorParallel:
+    def test_column_row_linear_parity(self, mp2_mesh):
+        from paddle_tpu.distributed.fleet import (ColumnParallelLinear,
+                                                  RowParallelLinear)
+        paddle.seed(11)
+        col = ColumnParallelLinear(8, 16, gather_output=False)
+        row = RowParallelLinear(16, 8, input_is_parallel=True)
+        dense1 = nn.Linear(8, 16)
+        dense2 = nn.Linear(16, 8)
+        dense1.weight.set_value(col.weight)
+        dense1.bias.set_value(col.bias)
+        dense2.weight.set_value(row.weight)
+        dense2.bias.set_value(row.bias)
+
+        x = paddle.randn([4, 8])
+        x.stop_gradient = False
+        x2 = paddle.to_tensor(x.numpy())
+        x2.stop_gradient = False
+
+        y_mp = row(F.relu(col(x)))
+        y_ref = dense2(F.relu(dense1(x2)))
+        np.testing.assert_allclose(y_mp.numpy(), y_ref.numpy(), rtol=1e-5, atol=1e-5)
+
+        y_mp.sum().backward()
+        y_ref.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), x2.grad.numpy(), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(col.weight.grad.numpy(),
+                                   dense1.weight.grad.numpy(), rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(row.weight.grad.numpy(),
+                                   dense2.weight.grad.numpy(), rtol=1e-5, atol=1e-5)
+
+    def test_vocab_parallel_embedding_parity(self, mp2_mesh):
+        from paddle_tpu.distributed.fleet import VocabParallelEmbedding
+        paddle.seed(12)
+        vpe = VocabParallelEmbedding(32, 8)
+        ref = nn.Embedding(32, 8)
+        ref.weight.set_value(vpe.weight)
+        ids = paddle.to_tensor(np.array([[1, 5, 31], [0, 2, 7]], "int32"))
+        np.testing.assert_allclose(vpe(ids).numpy(), ref(ids).numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_parallel_cross_entropy_parity(self, mp2_mesh):
+        from paddle_tpu.distributed.fleet import ParallelCrossEntropy
+        paddle.seed(13)
+        logits = paddle.randn([4, 32])
+        logits.stop_gradient = False
+        labels = paddle.to_tensor(np.array([1, 5, 0, 31], "int32"))
+        pce = ParallelCrossEntropy()
+        loss = pce(logits, labels)
+        ref = F.cross_entropy(paddle.to_tensor(logits.numpy()), labels,
+                              reduction="none")
+        np.testing.assert_allclose(loss.numpy().ravel(), ref.numpy().ravel(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sequence_parallel_ops(self, mp2_mesh):
+        from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils as spu
+        x = paddle.randn([8, 2, 4])  # [s, b, h]
+        x.stop_gradient = False
+        y = spu.GatherOp.apply(spu.ScatterOp.apply(x))
+        np.testing.assert_allclose(y.numpy(), x.numpy(), rtol=1e-6)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones_like(x.numpy()))
+
+    def test_sequence_parallel_linear_parity(self, mp2_mesh):
+        from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (
+            ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,
+            GatherOp)
+        paddle.seed(14)
+        col = ColumnSequenceParallelLinear(8, 16, gather_output=False)
+        row = RowSequenceParallelLinear(16, 8, input_is_parallel=True)
+        d1, d2 = nn.Linear(8, 16), nn.Linear(16, 8)
+        d1.weight.set_value(col.weight)
+        d1.bias.set_value(col.bias)
+        d2.weight.set_value(row.weight)
+        d2.bias.set_value(row.bias)
+        x = paddle.randn([8, 2, 8])
+        y_sp = GatherOp.apply(row(col(ScatterOp.apply(x))))
+        y_ref = d2(d1(x))
+        np.testing.assert_allclose(y_sp.numpy(), y_ref.numpy(), rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# DataParallel (mesh mode) parity vs single-device oracle
+# ---------------------------------------------------------------------------
+
+
+class TestDataParallelMesh:
+    def test_dp_training_parity(self):
+        def build_and_train(wrap_dp):
+            dist.mesh.reset_mesh()
+            if wrap_dp:
+                dist.init_mesh({"dp": 8})
+            paddle.seed(21)
+            model = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+            if wrap_dp:
+                model = dist.DataParallel(model)
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=model.parameters())
+            rng = np.random.RandomState(0)
+            losses = []
+            for _ in range(5):
+                x = paddle.to_tensor(rng.randn(16, 4).astype("float32"))
+                y = paddle.to_tensor(rng.randn(16, 2).astype("float32"))
+                loss = ((model(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            dist.mesh.reset_mesh()
+            return losses
+
+        ref = build_and_train(False)
+        got = build_and_train(True)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_dp_simulated_grad_sync(self):
+        def worker():
+            paddle.seed(5)
+            model = dist.DataParallel(nn.Linear(3, 1, bias_attr=False))
+            r = dist.get_rank()
+            x = paddle.to_tensor(np.full((2, 3), float(r + 1), "float32"))
+            loss = model(x).sum()
+            loss.backward()
+            return model._layers.weight.grad.numpy().copy()
+
+        res = dist.spawn(worker, nprocs=2).results
+        # grads averaged: each rank's local grad is 2*(r+1) per weight elem
+        np.testing.assert_allclose(res[0], res[1])
+        np.testing.assert_allclose(res[0], np.full((3, 1), 3.0))
+
+    def test_dp_no_sync(self):
+        def worker():
+            model = dist.DataParallel(nn.Linear(3, 1, bias_attr=False))
+            r = dist.get_rank()
+            x = paddle.to_tensor(np.full((2, 3), float(r + 1), "float32"))
+            with model.no_sync():
+                model(x).sum().backward()
+            return model._layers.weight.grad.numpy().copy()
+
+        res = dist.spawn(worker, nprocs=2).results
+        np.testing.assert_allclose(res[0], np.full((3, 1), 2.0))
+        np.testing.assert_allclose(res[1], np.full((3, 1), 4.0))
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallelism: schedule parity vs plain grad accumulation
+# ---------------------------------------------------------------------------
+
+
+class TestPipeline:
+    def _build(self):
+        from paddle_tpu.distributed.fleet import PipelineLayer, LayerDesc
+        paddle.seed(31)
+        return PipelineLayer(
+            layers=[
+                LayerDesc(nn.Linear, 4, 8),
+                LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 8, 8),
+                LayerDesc(nn.ReLU),
+                LayerDesc(nn.Linear, 8, 2),
+            ],
+            num_stages=2,
+            loss_fn=nn.MSELoss(),
+        )
+
+    def test_stage_partition(self):
+        pl = self._build()
+        assert pl.segment_parts == [0, 3, 5]
+        assert len(pl.get_stage_layers(0)) == 3
+        assert len(pl.get_stage_layers(1)) == 2
+
+    def test_train_batch_parity(self):
+        strat = dist.fleet.DistributedStrategy()
+        strat.hybrid_configs = {"pp_degree": 2, "dp_degree": 4,
+                                "pp_configs": {"accumulate_steps": 4}}
+        dist.fleet.init(is_collective=True, strategy=strat)
+        try:
+            pl = self._build()
+            model = dist.fleet.distributed_model(pl)
+            opt = dist.fleet.distributed_optimizer(
+                paddle.optimizer.SGD(learning_rate=0.05,
+                                     parameters=pl.parameters()))
+
+            # oracle: same weights, plain full-batch step
+            paddle.seed(31)
+            ref = self._build()
+            ref_opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                           parameters=ref.parameters())
+            loss_fn = nn.MSELoss()
+
+            rng = np.random.RandomState(1)
+            for _ in range(3):
+                x = paddle.to_tensor(rng.randn(8, 4).astype("float32"))
+                y = paddle.to_tensor(rng.randn(8, 2).astype("float32"))
+                pp_loss = model.train_batch([x, y], opt)
+                ref_loss = loss_fn(ref(x), y)
+                ref_loss.backward()
+                ref_opt.step()
+                ref_opt.clear_grad()
+                # micro-batched mean-of-means == full-batch mean for equal splits
+                np.testing.assert_allclose(float(pp_loss), float(ref_loss),
+                                           rtol=1e-5, atol=1e-6)
+        finally:
+            dist.mesh.reset_mesh()
+
+    def test_shared_layer_desc_ties_weights(self):
+        from paddle_tpu.distributed.fleet import (PipelineLayer, LayerDesc,
+                                                  SharedLayerDesc)
+        paddle.seed(32)
+        pl = PipelineLayer(
+            layers=[
+                SharedLayerDesc("embed", nn.Embedding, 16, 8),
+                LayerDesc(nn.Linear, 8, 8),
+                SharedLayerDesc("embed", nn.Embedding, 16, 8,
+                                forward_func=lambda l, x: x @ l.weight.T),
+            ],
+            num_stages=1, loss_fn=nn.MSELoss())
+        embeds = [l for l in pl.run_function if isinstance(l, nn.Embedding)]
+        assert len(embeds) == 2
+        assert embeds[0].weight is embeds[1].weight
+
+
+# ---------------------------------------------------------------------------
+# group sharded (ZeRO stages)
+# ---------------------------------------------------------------------------
+
+
+class TestGroupSharded:
+    def test_stage3_param_sharding_and_training(self):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        dist.mesh.reset_mesh()
+        dist.init_mesh({"sharding": 8})
+        try:
+            paddle.seed(41)
+            model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+            opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                         parameters=model.parameters())
+            model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os")
+            specs = [p._sharding_spec for p in model.parameters()
+                     if p._sharding_spec is not None]
+            assert specs, "no parameter got a sharding spec"
+
+            rng = np.random.RandomState(2)
+            x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+            y = paddle.to_tensor(rng.randn(16, 2).astype("float32"))
+            losses = []
+            for _ in range(8):
+                loss = ((model(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                losses.append(float(loss))
+            assert losses[-1] < losses[0] * 0.9
+        finally:
+            dist.mesh.reset_mesh()
+
+    def test_stage1_optimizer_state_sharded(self):
+        dist.mesh.reset_mesh()
+        dist.init_mesh({"sharding": 8})
+        try:
+            from paddle_tpu.distributed.sharding import group_sharded_parallel
+            paddle.seed(42)
+            model = nn.Linear(8, 16)
+            opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=model.parameters())
+            model, opt, _ = group_sharded_parallel(model, opt, level="os")
+            x = paddle.randn([4, 8])
+            ((model(x)) ** 2).mean().backward()
+            opt.step()
+            opt.clear_grad()
+            # slots exist and are sharded over the sharding axis
+            slots = opt._inner_opt._slots[id(model.weight)]
+            sh = slots["moment1"].sharding
+            assert "sharding" in str(sh.spec), sh
+        finally:
+            dist.mesh.reset_mesh()
+
+
+# ---------------------------------------------------------------------------
+# recompute
+# ---------------------------------------------------------------------------
+
+
+class TestRecompute:
+    def test_recompute_parity_under_jit(self):
+        from paddle_tpu.distributed.fleet.utils import recompute
+        paddle.seed(51)
+        inner = nn.Sequential(nn.Linear(4, 32), nn.ReLU(), nn.Linear(32, 4))
+
+        class Net(nn.Layer):
+            def __init__(self, use_rc):
+                super().__init__()
+                self.inner = inner
+                self.head = nn.Linear(4, 2)
+                self.use_rc = use_rc
+
+            def forward(self, x):
+                h = recompute(self.inner, x) if self.use_rc else self.inner(x)
+                return self.head(h)
+
+        net_rc = Net(True)
+        net_plain = Net(False)
+        net_plain.head = net_rc.head
+
+        x = paddle.randn([4, 4])
+        ref = net_plain(x)
+
+        st = paddle.jit.to_static(net_rc)
+        out = st(x)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5, atol=1e-6)
+
+        # grads flow through the recomputed region
+        loss = st(x).sum()
+        loss.backward()
+        assert inner[0].weight.grad is not None
