@@ -128,6 +128,36 @@ class FunctionalModule:
             new_b = [t._data for t in self.buffers]
             return out_arrays, new_b
 
+    # -- sharding ------------------------------------------------------------
+    def param_specs(self, rules=None, fsdp_axis=None, fsdp_size=1):
+        """PartitionSpec per param (in ``self.params`` order) from an ordered
+        ``(name-regex, spec-tuple)`` rule list (first match wins; see
+        ``paddle_tpu.models.*.sharding_rules``). With ``fsdp_axis`` set
+        (ZeRO-3 / sharding stage-3), each param's first dimension that is not
+        already sharded and is divisible by ``fsdp_size`` is additionally
+        sharded on that axis."""
+        import re
+        from jax.sharding import PartitionSpec as P
+
+        named = [(n, p) for n, p in self.layer.named_parameters()
+                 if p is not None]
+        assert [id(p) for _, p in named] == [id(p) for p in self.params]
+        specs = []
+        for name, p in named:
+            spec = ()
+            for pat, s in (rules or []):
+                if re.search(pat, name):
+                    spec = tuple(s)
+                    break
+            spec = list(spec) + [None] * (len(p.shape) - len(spec))
+            if fsdp_axis is not None and fsdp_size > 1:
+                for d, (sz, ax) in enumerate(zip(p.shape, spec)):
+                    if ax is None and sz % fsdp_size == 0 and sz >= fsdp_size:
+                        spec[d] = fsdp_axis
+                        break
+            specs.append(P(*spec))
+        return specs
+
     # -- write-back ----------------------------------------------------------
     def update_params(self, p_arrs):
         for t, a in zip(self.params, p_arrs):

@@ -1,0 +1,190 @@
+"""BERT / ERNIE encoder family (reference behavior: PaddleNLP
+``transformers/bert/modeling.py`` and ``transformers/ernie/modeling.py`` —
+the `@to_static` fine-tune benchmark is ERNIE-3.0 / BERT-base,
+BASELINE.json configs[1]).
+
+ERNIE shares BERT's architecture (token/position/segment embeddings +
+post-LN transformer encoder + pooler); upstream differences are pretraining
+data/objectives, so here ``Ernie*`` subclasses ``Bert*`` with ERNIE default
+sizes.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..nn.layers.common import Linear, Embedding, Dropout
+from ..nn.layers.norm import LayerNorm
+from ..nn.layers.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..ops import math as pmath
+from ..ops import creation as C
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, layer_norm_eps=1e-12,
+                 num_labels=2, **kwargs):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.num_labels = num_labels
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny(**kw):
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("max_position_embeddings", 128)
+    return BertConfig(**kw)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=init)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size,
+                                               weight_attr=init)
+        self.layer_norm = LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        if position_ids is None:
+            position_ids = C.arange(0, input_ids.shape[1], dtype="int64")
+        emb = (self.word_embeddings(input_ids) +
+               self.position_embeddings(position_ids))
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.dense = Linear(config.hidden_size, config.hidden_size,
+                            weight_attr=Normal(0.0, config.initializer_range))
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            act_dropout=0.0, normalize_before=False)
+        self.encoder = TransformerEncoder(enc_layer, config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and len(attention_mask.shape) == 2:
+            # [b, s] pad mask -> additive [b, 1, 1, s]
+            am = attention_mask
+            attention_mask = (
+                (1.0 - am.astype("float32")) * -1e4).unsqueeze(1).unsqueeze(1)
+        hidden = self.embeddings(input_ids, token_type_ids, position_ids)
+        hidden = self.encoder(hidden, attention_mask)
+        return hidden, self.pooler(hidden)
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, config.num_labels,
+                                 weight_attr=Normal(0.0,
+                                                    config.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, labels), logits
+
+
+class BertForPretraining(Layer):
+    """MLM head (weight-tied decoder) + NSP head."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.bert = BertModel(config)
+        init = Normal(0.0, config.initializer_range)
+        self.transform = Linear(config.hidden_size, config.hidden_size,
+                                weight_attr=init)
+        self.transform_norm = LayerNorm(config.hidden_size,
+                                        config.layer_norm_eps)
+        self.mlm_bias = self.create_parameter([config.vocab_size],
+                                              is_bias=True)
+        self.nsp = Linear(config.hidden_size, 2, weight_attr=init)
+
+    def forward(self, input_ids, token_type_ids=None, masked_lm_labels=None,
+                next_sentence_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        mlm_logits = pmath.matmul(
+            h, self.bert.embeddings.word_embeddings.weight,
+            transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        if masked_lm_labels is None:
+            return mlm_logits, nsp_logits
+        loss = F.cross_entropy(
+            mlm_logits.reshape([-1, mlm_logits.shape[-1]]),
+            masked_lm_labels.reshape([-1]), ignore_index=-100)
+        if next_sentence_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits,
+                                          next_sentence_labels.reshape([-1]))
+        return loss, mlm_logits, nsp_logits
+
+
+class ErnieConfig(BertConfig):
+    def __init__(self, **kwargs):
+        kwargs.setdefault("vocab_size", 40000)
+        kwargs.setdefault("type_vocab_size", 4)
+        super().__init__(**kwargs)
+
+
+class ErnieModel(BertModel):
+    pass
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    def __init__(self, config):
+        super().__init__(config)
+        self.ernie = self.bert

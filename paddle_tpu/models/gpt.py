@@ -1,0 +1,170 @@
+"""GPT model family (reference behavior: PaddleNLP GPT ``modeling.py`` /
+``modeling_pp.py`` — learned positions, pre-LN blocks, GeLU MLP, tied
+embeddings; the Fleet hybrid benchmark config is GPT-3-1.3B dp+mp+pp with
+sharding stage-2, BASELINE.json configs[3]).
+
+Same TPU-first shape as ``llama.py``: plain layers + ``sharding_rules()``.
+``GPTForCausalLM.to_pipeline_layer()`` re-expresses the model as a
+``PipelineLayer`` LayerDesc list for the PP engine (reference:
+``GPTForCausalLMPipe`` built on ``pp_layers.PipelineLayer``).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Linear, Embedding, Dropout
+from ..nn.layers.norm import LayerNorm
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..ops import math as pmath
+from .llama import LlamaPretrainingCriterion
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=None, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 layer_norm_epsilon=1e-5, initializer_range=0.02,
+                 use_recompute=False, **kwargs):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.initializer_range = initializer_range
+        self.use_recompute = use_recompute
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+def gpt3_1p3b(**kw):
+    """GPT-3 1.3B (BASELINE.json configs[3] hybrid benchmark)."""
+    return GPTConfig(vocab_size=50304, hidden_size=2048,
+                     num_hidden_layers=24, num_attention_heads=16,
+                     max_position_embeddings=2048, **kw)
+
+
+def gpt_tiny(**kw):
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("max_position_embeddings", 128)
+    return GPTConfig(**kw)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        init = Normal(0.0, config.initializer_range)
+        self.qkv_proj = Linear(h, 3 * h, weight_attr=init)
+        self.out_proj = Linear(h, h, weight_attr=init)
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, hidden):
+        b, s, h = hidden.shape
+        qkv = self.qkv_proj(hidden).reshape(
+            [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout_p,
+            training=self.training)
+        return self.out_proj(out.reshape([b, s, h]))
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        init = Normal(0.0, config.initializer_range)
+        self.norm1 = LayerNorm(h, config.layer_norm_epsilon)
+        self.self_attn = GPTAttention(config)
+        self.norm2 = LayerNorm(h, config.layer_norm_epsilon)
+        self.linear1 = Linear(h, config.intermediate_size, weight_attr=init)
+        self.linear2 = Linear(config.intermediate_size, h, weight_attr=init)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, hidden):
+        hidden = hidden + self.dropout(self.self_attn(self.norm1(hidden)))
+        ff = self.linear2(F.gelu(self.linear1(self.norm2(hidden)),
+                                 approximate=True))
+        return hidden + self.dropout(ff)
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, config):
+        super().__init__()
+        init = Normal(0.0, config.initializer_range)
+        self.word_embeddings = Embedding(config.vocab_size,
+                                         config.hidden_size, weight_attr=init)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size,
+                                             weight_attr=init)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, position_ids=None):
+        from ..ops import creation as C
+        if position_ids is None:
+            position_ids = C.arange(0, input_ids.shape[1], dtype="int64")
+        return self.dropout(self.word_embeddings(input_ids) +
+                            self.position_embeddings(position_ids))
+
+
+class GPTModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.decoder = LayerList(
+            [GPTDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.final_norm = LayerNorm(config.hidden_size,
+                                    config.layer_norm_epsilon)
+
+    def forward(self, input_ids, position_ids=None):
+        hidden = self.embeddings(input_ids, position_ids)
+        for layer in self.decoder:
+            hidden = layer(hidden)
+        return self.final_norm(hidden)
+
+
+class GPTForCausalLM(Layer):
+    """Tied lm_head (logits = hidden @ word_embeddings.T) — the reference's
+    ``SharedLayerDesc`` tied-embedding case in pipeline mode."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        self.criterion = LlamaPretrainingCriterion()
+
+    def forward(self, input_ids, labels=None, position_ids=None):
+        hidden = self.gpt(input_ids, position_ids)
+        logits = pmath.matmul(
+            hidden, self.gpt.embeddings.word_embeddings.weight,
+            transpose_y=True)
+        if labels is None:
+            return logits
+        return self.criterion(logits, labels), logits
+
+    @staticmethod
+    def sharding_rules():
+        mp = "mp"
+        return [
+            (r"word_embeddings\.weight$", (mp, None)),
+            (r"qkv_proj\.weight$", (None, mp)),
+            (r"qkv_proj\.bias$", (mp,)),
+            (r"out_proj\.weight$", (mp, None)),
+            (r"linear1\.weight$", (None, mp)),
+            (r"linear1\.bias$", (mp,)),
+            (r"linear2\.weight$", (mp, None)),
+            (r".*", ()),
+        ]

@@ -1,0 +1,224 @@
+"""Llama model family (reference behavior: PaddleNLP ``modeling.py`` for
+Llama — RMSNorm pre-norm, RoPE, GQA, SwiGLU MLP, untied lm_head; the north
+star config is Llama-3-8B pretrain, BASELINE.json configs[4]).
+
+TPU-first design: the model is plain eager layers; parallelism is NOT baked
+into the module graph (no Column/RowParallelLinear forks). Instead
+``sharding_rules()`` maps parameter names to PartitionSpecs over the hybrid
+mesh axes, and the train-step engine / ``dryrun_multichip`` place the params
+— XLA SPMD then derives exactly the Megatron collectives the reference
+implements by hand in ``fleet/layers/mpu/mp_layers.py`` (SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Linear, Embedding
+from ..nn.layers.norm import RMSNorm
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..ops import fused as fused_ops
+from ..ops import math as pmath
+from ..autograd.tape import apply
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=4096,
+                 intermediate_size=11008, num_hidden_layers=32,
+                 num_attention_heads=32, num_key_value_heads=None,
+                 max_position_embeddings=4096, rms_norm_eps=1e-5,
+                 rope_theta=10000.0, initializer_range=0.02,
+                 tie_word_embeddings=False, use_recompute=False,
+                 recompute_granularity="full", sequence_parallel=False,
+                 dtype="float32", **kwargs):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.initializer_range = initializer_range
+        self.tie_word_embeddings = tie_word_embeddings
+        self.use_recompute = use_recompute
+        self.recompute_granularity = recompute_granularity
+        self.sequence_parallel = sequence_parallel
+        self.dtype = dtype
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def llama3_8b(**kw):
+    """Llama-3-8B (north star, BASELINE.json configs[4])."""
+    return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                       intermediate_size=14336, num_hidden_layers=32,
+                       num_attention_heads=32, num_key_value_heads=8,
+                       max_position_embeddings=8192, rms_norm_eps=1e-5,
+                       rope_theta=500000.0, **kw)
+
+
+def llama_tiny(**kw):
+    """CI-sized config exercising GQA + RoPE + SwiGLU."""
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 176)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("num_key_value_heads", 2)
+    kw.setdefault("max_position_embeddings", 128)
+    return LlamaConfig(**kw)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, config):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        init = Normal(0.0, config.initializer_range)
+        self.gate_proj = Linear(h, m, weight_attr=init, bias_attr=False)
+        self.up_proj = Linear(h, m, weight_attr=init, bias_attr=False)
+        self.down_proj = Linear(m, h, weight_attr=init, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(
+            fused_ops.fused_swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        init = Normal(0.0, config.initializer_range)
+        self.q_proj = Linear(h, self.num_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.k_proj = Linear(h, self.num_kv_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.v_proj = Linear(h, self.num_kv_heads * self.head_dim,
+                             weight_attr=init, bias_attr=False)
+        self.o_proj = Linear(self.num_heads * self.head_dim, h,
+                             weight_attr=init, bias_attr=False)
+        self._cos, self._sin = fused_ops.rope_freqs(
+            self.head_dim, config.max_position_embeddings, config.rope_theta)
+
+    def forward(self, hidden, attn_mask=None, position_ids=None):
+        b, s, _ = hidden.shape
+        q = self.q_proj(hidden).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q, k, _ = fused_ops.fused_rotary_position_embedding(
+            q, k, sin=self._sin, cos=self._cos, position_ids=position_ids)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+            training=self.training)
+        return self.o_proj(out.reshape([b, s, self.num_heads * self.head_dim]))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+
+    def forward(self, hidden, attn_mask=None, position_ids=None):
+        hidden = hidden + self.self_attn(self.input_layernorm(hidden),
+                                         attn_mask, position_ids)
+        return hidden + self.mlp(self.post_attention_layernorm(hidden))
+
+
+class LlamaModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=Normal(0.0, config.initializer_range))
+        self.layers = LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None):
+        hidden = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            hidden = layer(hidden, attn_mask, position_ids)
+        return self.norm(hidden)
+
+
+class LlamaPretrainingCriterion(Layer):
+    """Causal-LM loss; mean over non-ignored tokens (ignore_index=-100).
+    Computed in fp32 regardless of model dtype (reference: vocab-parallel
+    softmax-CE kernel accumulates in fp32)."""
+
+    def __init__(self, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, labels):
+        ign = self.ignore_index
+
+        def fn(lg, lb):
+            import jax
+            lg = lg.astype(jnp.float32)
+            logp = lg - jax.nn.logsumexp(lg, axis=-1, keepdims=True)
+            valid = lb != ign
+            lb_safe = jnp.where(valid, lb, 0)
+            tok = jnp.take_along_axis(logp, lb_safe[..., None], axis=-1)[..., 0]
+            tok = jnp.where(valid, tok, 0.0)
+            return -tok.sum() / jnp.maximum(valid.sum(), 1)
+
+        return apply(fn, logits, labels, op_name="causal_lm_loss")
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=Normal(0.0, config.initializer_range),
+                                  bias_attr=False)
+        self.criterion = LlamaPretrainingCriterion()
+
+    def forward(self, input_ids, labels=None, attn_mask=None,
+                position_ids=None):
+        hidden = self.llama(input_ids, attn_mask, position_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = pmath.matmul(hidden, self.llama.embed_tokens.weight,
+                                  transpose_y=True)
+        if labels is None:
+            return logits
+        return self.criterion(logits, labels), logits
+
+    @staticmethod
+    def sharding_rules():
+        """(param-name regex, PartitionSpec tuple) over hybrid mesh axes.
+        Megatron TP: column-parallel q/k/v/gate/up + lm_head, row-parallel
+        o/down, vocab-parallel embedding. The 'sharding' (ZeRO/FSDP) axis is
+        composed on top by the engine (stage>=3 shards dim 0 residually)."""
+        mp = "mp"
+        return [
+            (r"embed_tokens\.weight$", (mp, None)),
+            (r"(q_proj|k_proj|v_proj|gate_proj|up_proj)\.weight$", (None, mp)),
+            (r"(o_proj|down_proj)\.weight$", (mp, None)),
+            (r"lm_head\.weight$", (None, mp)),
+            (r".*", ()),   # norms etc. replicated
+        ]

@@ -518,7 +518,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         qt = jnp.swapaxes(q, 1, 2)
         kt = jnp.swapaxes(k, 1, 2)
         vt = jnp.swapaxes(v, 1, 2)
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+        if kt.shape[1] != qt.shape[1]:
+            # GQA: grouped einsum — no materialized K/V repeats
+            rep = qt.shape[1] // kt.shape[1]
+            qg = qt.reshape(qt.shape[0], kt.shape[1], rep, *qt.shape[2:])
+            logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kt) * scale
+            logits = logits.reshape(qt.shape[0], qt.shape[1],
+                                    *logits.shape[3:])
+        else:
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
         if is_causal:
             sq, sk = logits.shape[-2], logits.shape[-1]
             causal = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
@@ -533,7 +541,14 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         if dk is not None:
             keep = jax.random.bernoulli(dk, 1.0 - dropout_p, probs.shape)
             probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
-        out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+        if vt.shape[1] != qt.shape[1]:
+            rep = qt.shape[1] // vt.shape[1]
+            pg = probs.reshape(probs.shape[0], vt.shape[1], rep,
+                               *probs.shape[2:])
+            out = jnp.einsum("bhgqk,bhkd->bhgqd", pg, vt)
+            out = out.reshape(probs.shape[0], qt.shape[1], *out.shape[3:])
+        else:
+            out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
         return jnp.swapaxes(out, 1, 2)
 
     args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())

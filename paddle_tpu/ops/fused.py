@@ -1,0 +1,92 @@
+"""Fused transformer ops (reference: ``paddle/phi/kernels/fusion/`` —
+``fused_rope``, ``fused_rms_norm``, ``fused_swiglu``; Python surface
+``paddle.incubate.nn.functional``, SURVEY.md §2.1/§2.2 "Incubate").
+
+TPU-native: each "fused" op is expressed as plain jax.numpy — XLA fuses the
+elementwise chains into the surrounding matmuls (SURVEY.md §7.0: the CUDA
+fusion tier maps to XLA fusion + Pallas for the rest), so there is nothing to
+hand-fuse here except keeping the ops in one traced region.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import apply
+
+
+def rope_freqs(head_dim, max_position, base=10000.0, dtype=jnp.float32):
+    """Precompute RoPE cos/sin tables of shape [max_position, head_dim]."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_position, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                      # [S, D/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, D] (neox layout)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def _rotate_half(x):
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """paddle.incubate.nn.functional.fused_rotary_position_embedding.
+
+    q/k/v layout [batch, seq, heads, head_dim]; cos/sin [max_pos, head_dim]
+    (or broadcastable). Returns rotated (q, k, v) — entries None where the
+    input was None.
+    """
+    def rot(x, cs, sn, pos):
+        if x is None:
+            return None
+        s = x.shape[1]
+        if pos is not None:
+            cs = jnp.take(cs, pos, axis=0)      # [b, s, d] or [s, d]
+            sn = jnp.take(sn, pos, axis=0)
+        else:
+            cs, sn = cs[:s], sn[:s]
+        cs = jnp.expand_dims(cs, -2)             # [.., s, 1, d]
+        sn = jnp.expand_dims(sn, -2)
+        while cs.ndim < x.ndim:                  # prepend batch dims
+            cs, sn = cs[None], sn[None]
+        if use_neox_rotary_style:
+            return x * cs + _rotate_half(x) * sn
+        # GPT-J interleaved style
+        x1, x2 = x[..., ::2], x[..., 1::2]
+        c2, s2 = cs[..., ::2], sn[..., ::2]
+        o1 = x1 * c2 - x2 * s2
+        o2 = x2 * c2 + x1 * s2
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+
+    def fn(*ts):
+        it = iter(ts)
+        qq = next(it)
+        kk = next(it) if k is not None else None
+        vv = next(it) if v is not None else None
+        return tuple(x for x in (
+            rot(qq, cos, sin, position_ids),
+            rot(kk, cos, sin, position_ids),
+            vv) if x is not None)
+
+    args = [t for t in (q, k, v) if t is not None]
+    out = apply(fn, *args, op_name="fused_rope")
+    out = list(out) if isinstance(out, (tuple, list)) else [out]
+    res = []
+    for t in (q, k, v):
+        res.append(out.pop(0) if t is not None else None)
+    return tuple(res)
+
+
+def fused_swiglu(x, gate=None):
+    """swiglu(x, gate) = silu(x) * gate (paddle.incubate fused_swiglu)."""
+    if gate is None:
+        def fn(a):
+            u, g = jnp.split(a, 2, axis=-1)
+            return jnp.asarray(jax_silu(u) * g, a.dtype)
+        return apply(fn, x, op_name="fused_swiglu")
+    return apply(lambda a, g: jax_silu(a) * g, x, gate, op_name="fused_swiglu")
+
+
+def jax_silu(a):
+    return a * (1.0 / (1.0 + jnp.exp(-a)))
