@@ -7,6 +7,7 @@ import jax
 from ....framework.core import Tensor
 from ....autograd.tape import apply, no_grad
 from . import sequence_parallel_utils  # noqa: F401
+from .ring_attention import ring_attention, RingFlashAttention  # noqa: F401
 
 
 def _is_tensor(x):

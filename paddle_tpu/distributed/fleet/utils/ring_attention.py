@@ -1,0 +1,65 @@
+"""Context-parallel (ring) attention — user-facing layer.
+
+Reference analogue: PaddleNLP ``RingFlashAttention`` over the sep/cp comm
+group (SURVEY.md §2.3 "CP / ring attention"); core Paddle contributes the
+group + p2p + FA2 softmax_lse. Here the core contribution is
+``paddle_tpu.ops.pallas.ring_flash_attention`` (Pallas FA kernel + ppermute KV
+rotation), and this module binds it to the global hybrid mesh's 'sep' axis so
+it drops into a GSPMD-jitted train step: every other mesh axis stays in
+"auto" sharding mode — only 'sep' is manual inside the shard_map region.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ... import mesh as mesh_mod
+from ....ops.pallas.ring_attention import ring_flash_attention
+from ....autograd.tape import apply
+from ....framework.core import Tensor
+
+
+def ring_attention(q, k, v, causal=True, seq_axis="sep", mesh=None,
+                   interpret=None, use_kernel=True):
+    """Ring flash attention over the mesh's ``seq_axis``.
+
+    q/k/v: jax arrays (or Tensors), paddle layout [b, s, h, d], with the seq
+    dim sharded over ``seq_axis``. Works eagerly and under jit: the shard_map
+    region binds only ``seq_axis``; remaining mesh axes are auto-sharded by
+    GSPMD around it.
+    """
+    mesh = mesh or mesh_mod.get_mesh()
+    n = int(mesh.shape[seq_axis]) if seq_axis in mesh.shape else 1
+
+    def jfn(qa, ka, va):
+        if n == 1:
+            from ....ops.pallas.flash_attention import (
+                flash_attention, mha_reference)
+            import jax.numpy as jnp
+            if use_kernel:
+                return flash_attention(qa, ka, va, causal=causal,
+                                       interpret=interpret)
+            out = mha_reference(jnp.swapaxes(qa, 1, 2), jnp.swapaxes(ka, 1, 2),
+                                jnp.swapaxes(va, 1, 2), causal=causal)
+            return jnp.swapaxes(out, 1, 2)
+        spec = P(None, seq_axis, None, None)
+        inner = functools.partial(
+            ring_flash_attention, axis_name=seq_axis, causal=causal,
+            axis_size=n, interpret=interpret, use_kernel=use_kernel)
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={seq_axis}, check_vma=False)(qa, ka, va)
+
+    if isinstance(q, Tensor):
+        return apply(jfn, q, k, v, op_name="ring_attention")
+    return jfn(q, k, v)
+
+
+class RingFlashAttention:
+    """PaddleNLP-compatible facade: ``RingFlashAttention.apply(q, k, v)``."""
+
+    @staticmethod
+    def apply(q, k, v, causal=True, seq_axis="sep", **kw):
+        return ring_attention(q, k, v, causal=causal, seq_axis=seq_axis, **kw)

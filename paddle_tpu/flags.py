@@ -20,6 +20,7 @@ _DEFAULTS: dict[str, Any] = {
     "FLAGS_embedding_deterministic": 0,
     "FLAGS_max_inplace_grad_add": 0,
     "FLAGS_conv_workspace_size_limit": 512,
+    "FLAGS_use_flash_attention": True,   # Pallas FA kernel in sdpa (TPU only)
 }
 
 _flags: dict[str, Any] = {}

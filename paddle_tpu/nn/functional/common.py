@@ -506,10 +506,29 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """paddle.nn.functional.scaled_dot_product_attention.
 
     Layout [batch, seq, heads, head_dim] (paddle flash-attn convention —
-    reference wires FA2 as a phi kernel, SURVEY.md §2.1). On TPU this lowers
-    to XLA fused attention; a Pallas flash-attention kernel is wired in
-    ``paddle_tpu/ops/pallas_ops.py`` when shapes allow.
+    reference wires FA2 as a phi kernel, SURVEY.md §2.1). Causal/full attention
+    without mask/dropout dispatches to the Pallas flash-attention kernel
+    (``paddle_tpu/ops/pallas/flash_attention.py``) on TPU — FA2's phi-kernel
+    role; gate with FLAGS_use_flash_attention. Masked/dropout paths use XLA.
     """
+    from ...flags import flag as _flag
+    use_flash = (_flag("FLAGS_use_flash_attention", True)
+                 and attn_mask is None
+                 and (dropout_p == 0.0 or not training)
+                 and jax.default_backend() == "tpu"
+                 and query.shape[1] >= 128 and query.shape[-1] % 64 == 0)
+    if use_flash:
+        from ...ops.pallas import flash_attention as _fa
+        # bottom-right causal alignment when sq != sk (KV-cache decode):
+        # local query i sits at global position (sk - sq) + i
+        q_off = key.shape[1] - query.shape[1]
+
+        def flash_fn(q, k, v):
+            return _fa(q, k, v, causal=is_causal, q_offset=q_off,
+                       interpret=False)
+
+        return apply(flash_fn, query, key, value, op_name="flash_attn")
+
     dk = prandom.next_key() if (dropout_p > 0.0 and training) else None
 
     def fn(q, k, v, *mask):

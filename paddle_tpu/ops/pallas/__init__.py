@@ -1,0 +1,8 @@
+"""Pallas (Mosaic) TPU kernels — the TPU-native analogue of the reference's
+CUDA fusion tier (`paddle/phi/kernels/gpu/flash_attn_*`, `fusion/`;
+SURVEY.md §7.0: "CUDA-kernel components map to Pallas").
+"""
+from .flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_with_lse, mha_reference,
+)
+from .ring_attention import ring_flash_attention  # noqa: F401

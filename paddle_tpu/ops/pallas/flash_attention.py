@@ -1,0 +1,477 @@
+"""Flash attention as Pallas TPU kernels (fwd + bwd).
+
+Reference analogue: the FA2 CUDA kernels Paddle vendors and wires as phi
+kernels (``paddle/phi/kernels/gpu/flash_attn_kernel``, ``third_party/flashattn``
+— SURVEY.md §2.1), surfaced through
+``paddle.nn.functional.scaled_dot_product_attention``. On TPU the same tiling
+idea maps onto Pallas/Mosaic: the grid iterates KV blocks sequentially per
+(batch, head, Q-block) with online-softmax state (m, l, acc) carried in VMEM
+scratch, so logits are never materialized in HBM — O(seq) memory like FA2.
+
+Extras beyond a plain FA port, needed by the ring-attention (context-parallel)
+layer (SURVEY.md §5.7):
+
+* ``q_offset`` / ``kv_offset`` runtime scalars (SMEM) give each block's global
+  position, so causal masking stays exact when Q and KV are shards of a longer
+  sequence rotating around the 'sep'/cp mesh axis.
+* the forward also returns the per-row logsumexp (``lse``) so partial results
+  from different KV shards merge with the standard online-softmax combine —
+  the same contract FA2 exposes via ``softmax_lse`` for PaddleNLP's
+  ``RingFlashAttention``.
+
+Layouts: public API is Paddle's flash-attn layout ``[batch, seq, heads, dim]``;
+kernels run in ``[batch, heads, seq, dim]``. GQA is supported by mapping each
+query head to its KV group in the BlockSpec index map (no materialized
+repeats).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(-1e30)   # large-negative instead of -inf: keeps exp()/where() NaN-free
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+# ---------------------------------------------------------------------------
+# Reference (pure XLA) — also the numerical oracle for tests
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, causal=True, sm_scale=None, q_offset=0,
+                  kv_offset=0, with_lse=False):
+    """Plain-XLA attention in kernel layout [b, h, s, d] (GQA-aware).
+
+    Returns ``out`` or ``(out, lse)``; lse is fp32 [b, h, sq].
+    """
+    b, hq, sq, d = q.shape
+    hk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if hk != hq:
+        k = jnp.repeat(k, hq // hk, axis=1)
+        v = jnp.repeat(v, hq // hk, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if causal:
+        qi = jnp.arange(sq)[:, None] + q_offset
+        ki = jnp.arange(k.shape[2])[None, :] + kv_offset
+        logits = jnp.where(qi >= ki, logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    dead = m <= NEG_INF          # fully-masked row: zero output (kernel contract)
+    p = jnp.where(dead, 0.0, jnp.exp(logits - m))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)) / jnp.maximum(l, 1e-30)
+    out = out.astype(q.dtype)
+    if not with_lse:
+        return out
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    lse = jnp.where(l[..., 0] <= 1e-30, NEG_INF, lse)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q, block_k,
+                kv_blocks, kv_len):
+    i = pl.program_id(2)          # q block
+    j = pl.program_id(3)          # kv block (sequential)
+    q_off = off_ref[0]
+    kv_off = off_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # global positions of this tile's rows/cols
+    q_ids = q_off + i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_local = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    k_ids = kv_off + k_local
+
+    # skip tiles that are entirely in the causal future
+    run = True
+    if causal:
+        first_q = q_off + i * block_q
+        last_q = first_q + block_q - 1
+        first_k = kv_off + j * block_k
+        run = last_q >= first_k
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        mask = k_local < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, q_ids >= k_ids)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                       # (bq, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # fully-masked rows -> 0
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_ref[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == kv_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[...] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
+        lse = jnp.where(l <= 1e-30, NEG_INF, lse)
+        # lane-replicated (block_q, 128) store: Mosaic needs >=(8,128) tiles
+        lse_ref[0, 0] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd(q, k, v, causal, sm_scale, q_offset, kv_offset, block_q, block_k,
+         interpret):
+    b, hq, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    group = hq // hk
+    block_q = min(block_q, max(sq, 8))
+    block_k = min(block_k, max(sk, 8))
+    sq_pad = _cdiv(sq, block_q) * block_q
+    sk_pad = _cdiv(sk, block_k) * block_k
+    if sq_pad != sq:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_pad - sq), (0, 0)))
+    if sk_pad != sk:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, sk_pad - sk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, sk_pad - sk), (0, 0)))
+    q_blocks = sq_pad // block_q
+    kv_blocks = sk_pad // block_k
+    offs = jnp.asarray(
+        jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                   jnp.asarray(kv_offset, jnp.int32)]), jnp.int32)
+
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, kv_blocks=kv_blocks, kv_len=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b, hq, q_blocks, kv_blocks),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h, i, j: (b_, h // group, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h, i, j: (b_, h // group, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 128),
+                         lambda b_, h, i, j: (b_, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, sq_pad, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v)
+    return out[:, :, :sq], lse[:, :, :sq, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (FA2-style recompute; dq pass + dk/dv pass)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, acc_ref, *, sm_scale, causal, block_q, block_k,
+                   kv_blocks, kv_len):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    q_off = off_ref[0]
+    kv_off = off_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        run = (q_off + i * block_q + block_q - 1) >= (kv_off + j * block_k)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        q_ids = q_off + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_local = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_local < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, q_ids >= (kv_off + k_local))
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(j == kv_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
+                    block_q, block_k, q_blocks, kv_len):
+    j = pl.program_id(2)          # kv block
+    i = pl.program_id(3)          # q block (sequential)
+    q_off = off_ref[0]
+    kv_off = off_ref[1]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        run = (q_off + i * block_q + block_q - 1) >= (kv_off + j * block_k)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * sm_scale
+        q_ids = q_off + i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_local = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_local < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, q_ids >= (kv_off + k_local))
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)         # (bq, bk)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(i == q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse, offs = res
+    do, g_lse = g
+    b, hq, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    group = hq // hk
+    block_q = min(block_q, max(sq, 8))
+    block_k = min(block_k, max(sk, 8))
+    sq_pad = _cdiv(sq, block_q) * block_q
+    sk_pad = _cdiv(sk, block_k) * block_k
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # lse is a differentiable output (ring merge uses it): dlse/ds_j = p_j, so
+    # its cotangent folds into the delta term of ds = p*(dp - delta)
+    if g_lse is not None and getattr(g_lse, "dtype", None) != jax.dtypes.float0:
+        delta = delta - g_lse.astype(jnp.float32)
+
+    def padq(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, sq_pad - sq)) +
+                       (((0, 0),) if x.ndim == 4 else ())) if sq_pad != sq else x
+
+    def padk(x):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, sk_pad - sk), (0, 0))) \
+            if sk_pad != sk else x
+
+    qp, dop = padq(q), padq(do)
+    # padded q rows: lse = +inf so p = exp(s - inf) = 0 (NEG_INF would explode)
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, sq_pad - sq)),
+                   constant_values=jnp.inf) if sq_pad != sq else lse
+    deltap = padq(delta)
+    # lane-replicated (…, 128) layout for per-row scalars (Mosaic tiling)
+    lsep = jnp.broadcast_to(lsep[..., None], (*lsep.shape, 128))
+    deltap = jnp.broadcast_to(deltap[..., None], (*deltap.shape, 128))
+    kp, vp = padk(k), padk(v)
+    q_blocks = sq_pad // block_q
+    kv_blocks = sk_pad // block_k
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h, i, j: (b_, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d),
+                           lambda b_, h, i, j: (b_, h // group, j, 0))
+    row_spec = pl.BlockSpec((1, 1, block_q, 128),
+                            lambda b_, h, i, j: (b_, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          kv_blocks=kv_blocks, kv_len=sk),
+        grid=(b, hq, q_blocks, kv_blocks),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, hq, sq_pad, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(offs, qp, kp, vp, dop, lsep, deltap)[0][:, :, :sq]
+
+    # dk/dv per *query* head (grid over full hq), then reduce over the GQA group
+    kv_q_spec = pl.BlockSpec((1, 1, block_k, d),
+                             lambda b_, h, j, i: (b_, h // group, j, 0))
+    q_spec2 = pl.BlockSpec((1, 1, block_q, d), lambda b_, h, j, i: (b_, h, i, 0))
+    row_spec2 = pl.BlockSpec((1, 1, block_q, 128),
+                             lambda b_, h, j, i: (b_, h, i, 0))
+    dkv_out_spec = pl.BlockSpec((1, 1, block_k, d),
+                                lambda b_, h, j, i: (b_, h, j, 0))
+    dk_full, dv_full = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          q_blocks=q_blocks, kv_len=sk),
+        grid=(b, hq, kv_blocks, q_blocks),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  q_spec2, kv_q_spec, kv_q_spec, q_spec2, row_spec2, row_spec2],
+        out_specs=[dkv_out_spec, dkv_out_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, hq, sk_pad, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, hq, sk_pad, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(offs, qp, kp, vp, dop, lsep, deltap)
+    dk_full = dk_full[:, :, :sk]
+    dv_full = dv_full[:, :, :sk]
+    if group > 1:
+        dk = dk_full.reshape(b, hk, group, sk, d).sum(axis=2)
+        dv = dv_full.reshape(b, hk, group, sk, d).sum(axis=2)
+    else:
+        dk, dv = dk_full, dv_full
+    d_offs = np.zeros(offs.shape, dtype=jax.dtypes.float0)  # int input: float0 cotangent
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), d_offs)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (kernel layout [b, h, s, d])
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, offs, causal, sm_scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, sm_scale, offs[0], offs[1],
+                  block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, offs, causal, sm_scale, block_q, block_k,
+                    interpret):
+    out, lse = _fwd(q, k, v, causal, sm_scale, offs[0], offs[1],
+                    block_q, block_k, interpret)
+    return out, (q, k, v, out, lse, offs)
+
+
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, g):
+    return _bwd(causal, sm_scale, block_q, block_k, interpret, res, (g, None))
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_with_lse(q, k, v, offs, causal, sm_scale, block_q, block_k,
+                    interpret):
+    return _fwd(q, k, v, causal, sm_scale, offs[0], offs[1], block_q, block_k,
+                interpret)
+
+
+def _flash_lse_fwd_rule(q, k, v, offs, causal, sm_scale, block_q, block_k,
+                        interpret):
+    out, lse = _fwd(q, k, v, causal, sm_scale, offs[0], offs[1],
+                    block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse, offs)
+
+
+_flash_with_lse.defvjp(_flash_lse_fwd_rule, _bwd)
+
+
+def _default_interpret():
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention(q, k, v, causal=True, sm_scale=None, q_offset=0,
+                    kv_offset=0, block_q=DEFAULT_BLOCK_Q,
+                    block_k=DEFAULT_BLOCK_K, interpret=None, kernel_layout=False):
+    """Flash attention. Layout [b, s, h, d] (paddle flash-attn convention) or
+    [b, h, s, d] with ``kernel_layout=True``. Differentiable (custom VJP with
+    FA2-style blockwise recompute)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _default_interpret()
+    if not kernel_layout:
+        q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
+    out = _flash(q, k, v, offs, causal, sm_scale, block_q, block_k, interpret)
+    if not kernel_layout:
+        out = jnp.swapaxes(out, 1, 2)
+    return out
+
+
+def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None, q_offset=0,
+                             kv_offset=0, block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K, interpret=None):
+    """Kernel-layout [b, h, s, d] flash attention returning (out, lse) for
+    online-softmax merging across KV shards (ring attention)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = _default_interpret()
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
+    return _flash_with_lse(q, k, v, offs, causal, sm_scale, block_q, block_k,
+                           interpret)
