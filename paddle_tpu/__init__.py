@@ -42,6 +42,7 @@ from . import jit  # noqa: F401
 from . import static  # noqa: F401
 from . import device  # noqa: F401
 from . import distributed  # noqa: F401
+from . import incubate  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .nn.layer import Layer  # noqa: F401
 from .hapi import Model, summary  # noqa: F401

@@ -1,0 +1,17 @@
+"""paddle.incubate (reference: ``python/paddle/incubate/`` — fused ops API,
+MoE, extra optimizers; SURVEY.md §2.2 "Incubate")."""
+from __future__ import annotations
+
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
+from . import optimizer  # noqa: F401
+from ..distributed.fleet.utils import recompute as _recompute  # noqa: F401
+
+
+def identity_loss(x, reduction="none"):
+    from ..ops import math as pmath
+    if reduction in ("mean",):
+        return pmath.mean(x)
+    if reduction in ("sum",):
+        return pmath.sum(x)
+    return x

@@ -1,0 +1,258 @@
+"""Mixture-of-Experts with expert parallelism (reference:
+``python/paddle/incubate/distributed/models/moe/`` — ``MoELayer`` with
+``NaiveGate``/``SwitchGate``/``GShardGate``, dispatch via the
+``global_scatter``/``global_gather`` all-to-all collective ops; SURVEY.md
+§2.3 "EP").
+
+TPU-native design: the reference's scatter/gather pair is an explicit NCCL
+all-to-all moving each token to its expert's rank. Here dispatch is the
+GShard einsum formulation — tokens → one-hot dispatch/combine tensors →
+``[experts, capacity, d]`` batches — with the expert dim sharded over a mesh
+axis (default 'dp': expert parallelism over the data-parallel group, the
+reference's default ep group). XLA's SPMD partitioner lowers the resharding
+of the expert dim to exactly that all-to-all over ICI. Experts are a single
+stacked-weight FFN (``[E, d, d_hidden]`` einsum) so the per-expert matmuls
+stay batched on the MXU instead of a Python loop over small matmuls.
+
+Static shapes: capacity ``C = ceil(tokens * cap_factor * top_k / E)`` bounds
+each expert's batch; overflow tokens are dropped (combine weight 0), matching
+the reference's capacity semantics.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .....framework.core import Tensor
+from .....autograd.tape import apply, no_grad
+from .....nn.layer import Layer, LayerList
+from .....nn.initializer import XavierUniform
+from ..... import flags  # noqa: F401
+from .....distributed import mesh as mesh_mod
+
+__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ExpertFFN"]
+
+
+# ---------------------------------------------------------------------------
+# Gates
+# ---------------------------------------------------------------------------
+
+class BaseGate(Layer):
+    def __init__(self, d_model, num_experts, top_k):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.weight = self.create_parameter(
+            [d_model, num_experts], default_initializer=XavierUniform())
+        self.loss = None          # aux load-balance loss (Tensor) after fwd
+
+    def gate_logits(self, x):
+        from .....ops import math as pmath
+        return pmath.matmul(x, self.weight)
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k softmax gate, no aux loss (reference NaiveGate)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=None, top_k=2,
+                 num_experts=None, **kw):
+        e = num_experts if num_experts is not None else (
+            (num_expert or 1) * (world_size or 1))
+        super().__init__(d_model, e, top_k)
+
+    def aux_loss(self, probs, dispatch_frac):
+        return None
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with GShard load-balance aux loss:
+    ``E * mean(probs_e) · mean(frac_dispatched_e)`` summed over experts."""
+
+    def __init__(self, d_model, num_expert=None, world_size=None, top_k=2,
+                 balance_loss_weight=1.0, **kw):
+        super().__init__(d_model, num_expert, world_size, top_k, **kw)
+        self.balance_loss_weight = balance_loss_weight
+
+    def aux_loss(self, probs, dispatch_frac):
+        e = self.num_experts
+        return self.balance_loss_weight * e * jnp.sum(
+            jnp.mean(probs, axis=0) * dispatch_frac)
+
+
+class SwitchGate(GShardGate):
+    """Top-1 switch-transformer gate (same aux-loss form, k=1)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=None, top_k=1,
+                 **kw):
+        super().__init__(d_model, num_expert, world_size, top_k=1, **kw)
+
+
+GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+
+# ---------------------------------------------------------------------------
+# Experts
+# ---------------------------------------------------------------------------
+
+class ExpertFFN(Layer):
+    """All experts' FFNs as stacked weights [E, d, dh]/[E, dh, d] — one
+    batched einsum per projection (MXU-friendly), expert dim shardable."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.w1 = self.create_parameter([num_experts, d_model, d_hidden],
+                                        default_initializer=XavierUniform())
+        self.b1 = self.create_parameter([num_experts, 1, d_hidden],
+                                        is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_hidden, d_model],
+                                        default_initializer=XavierUniform())
+        self.b2 = self.create_parameter([num_experts, 1, d_model],
+                                        is_bias=True)
+        self.activation = activation
+
+    def forward_arrays(self, x, w1, b1, w2, b2):
+        """x: [E, C, d] (jax arrays; called inside the MoE apply region)."""
+        h = jnp.einsum("ecd,edh->ech", x, w1) + b1
+        h = jax.nn.gelu(h) if self.activation == "gelu" else jax.nn.relu(h)
+        return jnp.einsum("ech,ehd->ecd", h, w2) + b2
+
+
+# ---------------------------------------------------------------------------
+# MoE layer
+# ---------------------------------------------------------------------------
+
+class MoELayer(Layer):
+    """paddle.incubate.distributed.models.moe.MoELayer.
+
+    Args (reference-compatible subset): ``d_model``, ``experts`` (a LayerList
+    of per-expert Layers — looped; or None to use the fused ``ExpertFFN``),
+    ``gate`` (name or Layer), ``top_k``, ``capacity_factor``; plus TPU-native
+    ``num_experts``/``d_hidden`` for the fused path and ``ep_axis`` (mesh axis
+    carrying the expert dim; default 'dp' = reference's default ep group).
+    ``forward`` returns the combined output; the gate's aux loss is in
+    ``self.aux_loss`` (add it to the training loss).
+    """
+
+    def __init__(self, d_model=None, experts=None, gate="gshard", top_k=2,
+                 capacity_factor=1.25, num_experts=None, d_hidden=None,
+                 ep_axis="dp", moe_group=None, mp_group=None, **kw):
+        super().__init__()
+        if isinstance(gate, dict):      # reference passes a config dict
+            top_k = gate.get("top_k", top_k)
+            gate = gate.get("type", "gshard")
+        self.d_model = d_model
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.ep_axis = ep_axis
+        if experts is not None:
+            self.experts = experts if isinstance(experts, LayerList) \
+                else LayerList(list(experts))
+            self.num_experts = len(self.experts)
+            self.fused = None
+        else:
+            assert num_experts and d_hidden, \
+                "fused MoE needs num_experts + d_hidden"
+            self.num_experts = num_experts
+            self.fused = ExpertFFN(num_experts, d_model, d_hidden)
+            self.experts = None
+        if isinstance(gate, str):
+            self.gate = GATES[gate](d_model, num_experts=self.num_experts,
+                                    top_k=top_k)
+        else:
+            self.gate = gate
+        self.aux_loss = None
+
+    # -- dispatch plan (pure jnp; shapes static) ----------------------------
+    def _plan(self, logits, capacity):
+        """logits [S, E] → dispatch [S, E, C] one-hot, combine [S, E, C]."""
+        s, e = logits.shape
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        _, top_idx = jax.lax.top_k(probs, self.top_k)        # [S, k]
+        # one-hot per choice: [k, S, E]
+        choice = jax.nn.one_hot(top_idx.T, e, dtype=jnp.float32)
+        # position of each (choice, token) within its expert queue — cumsum
+        # ordered by choice rank then token index (reference: gshard ordering)
+        flat = choice.reshape(-1, e)                          # [k*S, E]
+        pos = jnp.cumsum(flat, axis=0) - flat                 # rank in queue
+        pos = jnp.sum(pos * flat, axis=-1)                    # [k*S]
+        keep = (pos < capacity) & (jnp.sum(flat, -1) > 0)
+        pos = pos.astype(jnp.int32)
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity,
+                                dtype=jnp.float32)            # [k*S, C]
+        disp = flat[:, :, None] * pos_oh[:, None, :]          # [k*S, E, C]
+        disp = disp.reshape(self.top_k, s, e, capacity).sum(0)
+        gate_w = jnp.sum(choice.reshape(self.top_k, s, e) *
+                         probs[None], axis=-1)                # [k, S]
+        # per-token weight to each chosen expert (top-k indices are distinct,
+        # so summing over k is exact), normalized over the token's top-k
+        w = jnp.einsum("ks,kse->se", gate_w,
+                       choice.reshape(self.top_k, s, e))      # [S, E]
+        w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-9)
+        combine = disp * w[:, :, None]
+        return probs, disp, combine
+
+    def forward(self, x):
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        s = 1
+        for n in orig_shape[:-1]:
+            s *= n
+        e = self.num_experts
+        capacity = max(1, math.ceil(s * self.capacity_factor * self.top_k / e))
+        ep = self.ep_axis if (mesh_mod.has_mesh()
+                              and mesh_mod.axis_size(self.ep_axis) > 1) else None
+
+        gate_w = self.gate.weight
+        if self.fused is not None:
+            f = self.fused
+
+            def fn(xa, gw, w1, b1, w2, b2):
+                tok = xa.reshape(s, d)
+                logits = tok.astype(jnp.float32) @ gw.astype(jnp.float32)
+                probs, disp, combine = self._plan(logits, capacity)
+                expert_in = jnp.einsum("sec,sd->ecd", disp, tok)
+                if ep:
+                    expert_in = jax.lax.with_sharding_constraint(
+                        expert_in, mesh_mod.sharding(ep, None, None)) \
+                        if isinstance(xa, jax.core.Tracer) else expert_in
+                expert_out = f.forward_arrays(expert_in, w1, b1, w2, b2)
+                out = jnp.einsum("sec,ecd->sd", combine, expert_out)
+                frac = jnp.mean(disp.sum(-1), axis=0)        # [E] dispatched frac
+                aux = self.gate.aux_loss(probs, frac)
+                return (out.reshape(orig_shape).astype(xa.dtype),
+                        (aux if aux is not None else jnp.zeros((), jnp.float32)))
+
+            out, aux = apply(fn, x, gate_w, f.w1, f.b1, f.w2, f.b2,
+                             op_name="moe")
+        else:
+            # reference-style per-expert Layer list (python loop; CPU/debug)
+            def fn(xa, gw):
+                tok = xa.reshape(s, d)
+                logits = tok.astype(jnp.float32) @ gw.astype(jnp.float32)
+                probs, disp, combine = self._plan(logits, capacity)
+                expert_in = jnp.einsum("sec,sd->ecd", disp, tok)
+                frac = jnp.mean(disp.sum(-1), axis=0)
+                aux = self.gate.aux_loss(probs, frac)
+                return (expert_in, combine,
+                        aux if aux is not None else jnp.zeros((), jnp.float32))
+
+            expert_in, combine, aux = apply(fn, x, gate_w, op_name="moe_dispatch")
+            outs = []
+            for i, exp in enumerate(self.experts):
+                outs.append(exp(expert_in[i]))
+            from .....ops import manipulation as manip
+            expert_out = manip.stack(outs, axis=0)
+
+            def comb(c, eo, xa):
+                o = jnp.einsum("sec,ecd->sd", c, eo)
+                return o.reshape(orig_shape).astype(xa.dtype)
+
+            out = apply(comb, combine, expert_out, x, op_name="moe_combine")
+
+        self.aux_loss = aux
+        self.gate.loss = aux
+        return out

@@ -1,0 +1,23 @@
+"""paddle.incubate.nn — fused transformer blocks (reference:
+``python/paddle/incubate/nn/`` → phi fusion kernels; SURVEY.md §2.2).
+On TPU the "fused" layers are regular composed ops — XLA fuses the chains
+(SURVEY.md §7.0) — so these classes exist for API parity and route to the
+same code paths the plain layers use.
+"""
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from ...nn.layers.transformer import TransformerEncoderLayer as _TEL
+
+
+class FusedTransformerEncoderLayer(_TEL):
+    """API-compatible with paddle.incubate.nn.FusedTransformerEncoderLayer;
+    on TPU the plain encoder layer already compiles to fused HLO."""
+
+
+class FusedMultiHeadAttention(object):
+    def __init__(self, *a, **kw):
+        from ...nn.layers.transformer import MultiHeadAttention
+        raise NotImplementedError(
+            "Use paddle.nn.MultiHeadAttention — XLA emits the fused kernel; "
+            "the separate fused layer exists only for CUDA in the reference")

@@ -1,0 +1,47 @@
+"""paddle.incubate.nn.functional — fused-op API surface (reference:
+``fused_rotary_position_embedding``, ``fused_rms_norm``, ``swiglu``,
+``fused_multi_head_attention``; phi fusion kernels, SURVEY.md §2.1/§2.2)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.fused import (  # noqa: F401
+    fused_rotary_position_embedding, fused_swiglu, rope_freqs,
+)
+from ....autograd.tape import apply
+
+swiglu = fused_swiglu
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-5,
+                   begin_norm_axis=-1, **kw):
+    """RMSNorm (fused on GPU in the reference; XLA fuses it here)."""
+    def fn(a, w, *b):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        out = a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype) * w
+        if b:
+            out = out + b[0]
+        return out
+
+    args = (x, norm_weight) + ((norm_bias,) if norm_bias is not None else ())
+    return apply(fn, *args, op_name="fused_rms_norm")
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, **kw):
+    from ....nn import functional as F
+    return F.layer_norm(x, x.shape[-1:], weight=norm_weight, bias=norm_bias,
+                        epsilon=epsilon)
+
+
+def fused_multi_head_attention(*a, **kw):
+    raise NotImplementedError(
+        "fused_multi_head_attention: use paddle.nn.functional."
+        "scaled_dot_product_attention (Pallas flash kernel on TPU)")
+
+
+def fused_feedforward(*a, **kw):
+    raise NotImplementedError(
+        "fused_feedforward: compose Linear+activation — XLA fuses the chain")

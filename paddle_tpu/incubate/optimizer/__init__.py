@@ -1,0 +1,96 @@
+"""paddle.incubate.optimizer — LookAhead / ModelAverage (reference:
+``python/paddle/incubate/optimizer/``; SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.core import Tensor
+from ...autograd.tape import no_grad
+
+
+class LookAhead:
+    """Lookahead wrapper: every k steps, slow weights move toward fast
+    weights by alpha and fast weights are reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k:
+            return
+        with no_grad():
+            for p in self._parameter_list:
+                if p is None:
+                    continue
+                slow = self._slow.get(id(p))
+                if slow is None:
+                    slow = np.asarray(p.numpy())
+                fast = np.asarray(p.numpy())
+                slow = slow + self.alpha * (fast - slow)
+                self._slow[id(p)] = slow
+                p.set_value(slow)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_slow"] = {k: v for k, v in self._slow.items()}
+        sd["lookahead_step"] = self._step
+        return sd
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Maintains a running average of parameters; ``apply()`` swaps averaged
+    weights in (for eval), ``restore()`` swaps the training weights back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000, **kw):
+        self._params = list(parameters or [])
+        self._sum = {id(p): np.zeros(p.shape, np.float64) for p in self._params}
+        self._cnt = 0
+        self._backup = None
+
+    def step(self):
+        for p in self._params:
+            self._sum[id(p)] += np.asarray(p.numpy(), np.float64)
+        self._cnt += 1
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): np.asarray(p.numpy()) for p in self._params}
+        with no_grad():
+            for p in self._params:
+                avg = self._sum[id(p)] / max(self._cnt, 1)
+                p.set_value(avg.astype(np.asarray(p.numpy()).dtype))
+
+        class _Ctx:
+            def __enter__(s):
+                return s
+
+            def __exit__(s, *a):
+                if need_restore:
+                    self.restore()
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        if self._backup:
+            with no_grad():
+                for p in self._params:
+                    p.set_value(self._backup[id(p)])
+            self._backup = None
