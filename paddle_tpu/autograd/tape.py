@@ -103,9 +103,10 @@ def _is_diff_tensor(t) -> bool:
             and jnp.issubdtype(t.dtype, jnp.inexact))
 
 
-# hooks installed by other subsystems (amp, debugging) — see paddle_tpu/amp
+# hooks installed by other subsystems (amp, debugging, profiler)
 _amp_cast_inputs = None
 _nan_check = False
+_profiler = None     # paddle_tpu.profiler.Profiler when recording
 
 # callbacks fired once after a top-level backward() finishes (DataParallel
 # grad sync uses this — the analogue of the reference reducer's
@@ -136,6 +137,17 @@ def apply(fn, *args, op_name: str | None = None, **kwargs):
     node if grad is enabled and any input requires grad. Returns Tensor(s)
     mirroring fn's output structure."""
     name = op_name or getattr(fn, "__name__", "op")
+    if _profiler is not None and _profiler._recording:
+        import time as _time
+        _t0 = _time.perf_counter()
+        try:
+            return _apply_inner(fn, name, args, kwargs)
+        finally:
+            _profiler._record_op(name, _time.perf_counter() - _t0)
+    return _apply_inner(fn, name, args, kwargs)
+
+
+def _apply_inner(fn, name, args, kwargs):
     if _amp_cast_inputs is not None:
         args = _amp_cast_inputs(name, list(args))
     leaves, treedef = jax.tree.flatten(list(args), is_leaf=lambda x: isinstance(x, Tensor))

@@ -26,6 +26,10 @@ from . import simulator  # noqa: F401
 # fleet namespace (hybrid parallelism facade)
 from . import fleet  # noqa: F401
 
+# sharded/async checkpoint (paddle.distributed.checkpoint)
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+
 # communication subpackage alias (paddle.distributed.communication.*)
 from . import collective as communication  # noqa: F401
 

@@ -1,0 +1,316 @@
+"""paddle.profiler (reference: ``python/paddle/profiler/profiler.py`` —
+``Profiler(targets, scheduler, on_trace_ready)``, ``make_scheduler`` step
+windows, ``RecordEvent`` annotations, chrome-trace export, summary tables,
+``benchmark()`` ips timer; C++ side host tracer + CUPTI — SURVEY.md §5.1).
+
+TPU-native: device/kernel timelines come from ``jax.profiler`` (XPlane →
+TensorBoard/Perfetto — the CUPTI analogue); host-side per-op wall times come
+from the eager tape's dispatch hook, giving the op summary table without a
+native tracer. ``RecordEvent`` maps to ``jax.profiler.TraceAnnotation`` so
+user annotations show up inside the device trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = [
+    "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
+    "export_chrome_tracing", "export_protobuf", "RecordEvent", "load_profiler_result",
+    "benchmark",
+]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1          # alias: the accelerator
+    TPU = 1
+    CUSTOM_DEVICE = 2
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed, ready, record, repeat=0, skip_first=0):
+    """Step-window state machine (reference ``make_scheduler``): per cycle,
+    ``closed`` steps off, ``ready`` steps warming, ``record`` steps on;
+    repeated ``repeat`` times (0 = forever), after ``skip_first`` steps."""
+    cycle = closed + ready + record
+    assert cycle > 0
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def _default_scheduler(step):
+    return ProfilerState.RECORD
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready callback: dump the collected host-op summary as a
+    chrome-tracing JSON next to the jax xplane dump."""
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.pt.trace.json")
+        events = []
+        t = 0
+        for op, (cnt, total) in sorted(prof._op_stats.items()):
+            events.append({"name": op, "ph": "X", "pid": 0, "tid": 0,
+                           "ts": t, "dur": max(total * 1e6, 1),
+                           "args": {"calls": cnt}})
+            t += max(total * 1e6, 1)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "displayTimeUnit": "ms"}, f)
+        prof._exported_path = path
+    return handler
+
+
+def export_protobuf(dir_name, worker_name=None):
+    return export_chrome_tracing(dir_name, worker_name)
+
+
+class RecordEvent:
+    """User annotation: shows in the device trace via TraceAnnotation and in
+    the host op table. Usable as context manager or begin()/end()."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        prof = Profiler._current
+        if prof is not None and prof._recording:
+            prof._open_events.append(self)
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        prof = Profiler._current
+        if prof is not None and prof._recording and self._t0 is not None:
+            dt = time.perf_counter() - self._t0
+            cnt, total = prof._op_stats[f"user::{self.name}"]
+            prof._op_stats[f"user::{self.name}"] = (cnt + 1, total + dt)
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *a):
+        self.end()
+
+
+class Profiler:
+    """paddle.profiler.Profiler facade.
+
+    with Profiler(targets=[ProfilerTarget.CPU, ProfilerTarget.GPU],
+                  scheduler=make_scheduler(closed=1, ready=1, record=2),
+                  on_trace_ready=export_chrome_tracing('./log')) as p:
+        for batch in loader:
+            train_step(batch)
+            p.step()
+    p.summary()
+    """
+
+    _current = None
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, emit_nvtx=False):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            lo, hi = scheduler
+            self._scheduler = make_scheduler(closed=lo, ready=0,
+                                             record=hi - lo, repeat=1)
+        else:
+            self._scheduler = _default_scheduler
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self.targets = targets or [ProfilerTarget.CPU]
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._recording = False
+        self._op_stats = defaultdict(lambda: (0, 0.0))
+        self._open_events = []
+        self._step_times = []
+        self._t_step = None
+        self._jax_tracing = False
+        self._trace_dir = None
+        self._exported_path = None
+
+    # -- tape hook ----------------------------------------------------------
+    def _record_op(self, op_name, dt):
+        cnt, total = self._op_stats[op_name]
+        self._op_stats[op_name] = (cnt + 1, total + dt)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        Profiler._current = self
+        from ..autograd import tape
+        tape._profiler = self
+        self._transition(self._scheduler(self._step))
+        self._t_step = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._stop_recording()
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        from ..autograd import tape
+        tape._profiler = None
+        Profiler._current = None
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t_step is not None:
+            self._step_times.append((now - self._t_step, num_samples))
+        self._t_step = now
+        self._step += 1
+        new = self._scheduler(self._step)
+        if (new != self._state):
+            ret = self._state == ProfilerState.RECORD_AND_RETURN
+            self._transition(new)
+            if ret and self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def _transition(self, new):
+        rec_states = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        was = self._state in rec_states
+        want = new in rec_states
+        if want and not was:
+            self._start_recording()
+        elif was and not want:
+            self._stop_recording()
+        self._state = new
+
+    def _start_recording(self):
+        self._recording = True
+        if not self._timer_only and any(t != ProfilerTarget.CPU
+                                        for t in self.targets):
+            self._trace_dir = os.environ.get("PADDLE_PROFILER_XPLANE_DIR",
+                                             "/tmp/paddle_tpu_xplane")
+            try:
+                jax.profiler.start_trace(self._trace_dir)
+                self._jax_tracing = True
+            except (RuntimeError, ValueError):
+                self._jax_tracing = False
+
+    def _stop_recording(self):
+        self._recording = False
+        if self._jax_tracing:
+            try:
+                jax.profiler.stop_trace()
+            except (RuntimeError, ValueError):
+                pass
+            self._jax_tracing = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+    # -- reporting ----------------------------------------------------------
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        lines = ["-" * 64,
+                 f"{'Name':<36}{'Calls':>8}{'Total(' + time_unit + ')':>14}",
+                 "-" * 64]
+        for op, (cnt, total) in sorted(self._op_stats.items(),
+                                       key=lambda kv: -kv[1][1]):
+            lines.append(f"{op:<36}{cnt:>8}{total * unit:>14.3f}")
+        if self._step_times:
+            times = [t for t, _ in self._step_times]
+            lines.append("-" * 64)
+            lines.append(f"steps: {len(times)}  avg step "
+                         f"{sum(times) / len(times) * unit:.3f}{time_unit}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    @property
+    def averages(self):
+        return {op: total / max(cnt, 1)
+                for op, (cnt, total) in self._op_stats.items()}
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+class _Benchmark:
+    """paddle.profiler.utils benchmark timer — reports ips (reference:
+    Profiler.timer_only path / hapi ips metric)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._t0 = None
+        self._samples = 0
+        self._steps = 0
+        self._elapsed = 0.0
+
+    def begin(self):
+        self.reset()
+        self._t0 = time.perf_counter()
+
+    def step(self, num_samples=None):
+        self._steps += 1
+        if num_samples:
+            self._samples += num_samples
+
+    def end(self):
+        if self._t0 is not None:
+            self._elapsed = time.perf_counter() - self._t0
+
+    def ips(self):
+        if not self._elapsed:
+            self.end()
+        denom = self._elapsed or 1e-9
+        return (self._samples or self._steps) / denom
+
+    def step_info(self, unit="samples"):
+        return f"ips: {self.ips():.2f} {unit}/s"
+
+
+_benchmark = _Benchmark()
+
+
+def benchmark():
+    return _benchmark

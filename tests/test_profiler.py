@@ -1,0 +1,69 @@
+"""Profiler facade tests (reference: paddle.profiler — SURVEY.md §5.1)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.profiler import (
+    Profiler, ProfilerTarget, ProfilerState, make_scheduler,
+    export_chrome_tracing, RecordEvent, benchmark,
+)
+
+
+def test_make_scheduler_windows():
+    sch = make_scheduler(closed=1, ready=1, record=2, repeat=1, skip_first=1)
+    states = [sch(i) for i in range(7)]
+    assert states[0] == ProfilerState.CLOSED          # skip_first
+    assert states[1] == ProfilerState.CLOSED
+    assert states[2] == ProfilerState.READY
+    assert states[3] == ProfilerState.RECORD
+    assert states[4] == ProfilerState.RECORD_AND_RETURN
+    assert states[5] == ProfilerState.CLOSED          # repeat exhausted
+    assert states[6] == ProfilerState.CLOSED
+
+
+def test_profiler_records_ops_and_steps(tmp_path):
+    traces = str(tmp_path / "traces")
+    with Profiler(targets=[ProfilerTarget.CPU],
+                  on_trace_ready=export_chrome_tracing(traces)) as p:
+        x = paddle.randn([32, 32])
+        for _ in range(3):
+            y = (x @ x).sum()
+            with RecordEvent("custom_region"):
+                _ = y + 1
+            p.step()
+    assert p._op_stats, "no ops recorded"
+    ops = dict(p._op_stats)
+    assert any("matmul" in k for k in ops), ops.keys()
+    assert "user::custom_region" in ops
+    assert len(p._step_times) == 3
+    out = p.summary()
+    assert "matmul" in out
+    # chrome trace written and valid json
+    files = os.listdir(traces)
+    assert files
+    with open(os.path.join(traces, files[0])) as f:
+        data = json.load(f)
+    assert data["traceEvents"]
+
+
+def test_profiler_scheduler_gates_recording():
+    sch = make_scheduler(closed=1, ready=0, record=1, repeat=2)
+    with Profiler(targets=[ProfilerTarget.CPU], scheduler=sch) as p:
+        x = paddle.randn([8])
+        for i in range(4):
+            _ = x + i          # recorded only during RECORD windows
+            p.step()
+    total_calls = sum(c for c, _ in p._op_stats.values())
+    assert 0 < total_calls < 8   # strictly fewer than if always recording
+
+
+def test_benchmark_ips():
+    b = benchmark()
+    b.begin()
+    for _ in range(5):
+        b.step(num_samples=10)
+    b.end()
+    assert b.ips() > 0
+    assert "ips" in b.step_info()
