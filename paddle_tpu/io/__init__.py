@@ -301,6 +301,10 @@ def _to_device(np_batch):
 
 def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
                  worker_init_fn, base_seed):
+    if isinstance(result_queue, tuple) and result_queue[0] == "shm":
+        # native shared-memory transport (io/native/shm_queue.cpp)
+        from .native import ShmQueue
+        result_queue = ShmQueue(result_queue[1])
     np.random.seed((base_seed + worker_id) % (2 ** 31))
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
@@ -329,14 +333,30 @@ class _MultiprocessIter:
         ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
                              else "spawn")
         nw = loader.num_workers
-        self.result_queue = ctx.Queue()
+        # native shared-memory result transport (reference: shared-mem
+        # tensor blobs + C++ blocking queue — SURVEY.md §3.5); fall back to
+        # multiprocessing.Queue when the native lib can't build
+        self._shm = None
+        worker_result = None
+        if loader.use_shared_memory:
+            from . import native
+            if native.available():
+                qname = f"ptq_{os.getpid()}_{id(self)}"
+                self._shm = native.ShmQueue(
+                    qname, create=True,
+                    slots=max(2 * nw, loader.prefetch_factor * nw))
+                self.result_queue = self._shm
+                worker_result = ("shm", qname)
+        if worker_result is None:
+            self.result_queue = ctx.Queue()
+            worker_result = self.result_queue
         self.index_queues = [ctx.Queue() for _ in range(nw)]
         base_seed = int(np.random.randint(0, 2 ** 31))
         self.workers = []
         for w in range(nw):
             p = ctx.Process(
                 target=_worker_loop,
-                args=(loader.dataset, self.index_queues[w], self.result_queue,
+                args=(loader.dataset, self.index_queues[w], worker_result,
                       loader.collate_fn, w, loader.worker_init_fn, base_seed),
                 daemon=True)
             p.start()
@@ -356,7 +376,14 @@ class _MultiprocessIter:
             self._shutdown()
             raise StopIteration
         while self._next not in self._pending:
-            bidx, batch, err = self.result_queue.get()
+            try:
+                bidx, batch, err = self.result_queue.get(timeout=5)
+            except (TimeoutError, queue.Empty):
+                if not any(p.is_alive() for p in self.workers):
+                    self._shutdown()
+                    raise RuntimeError(
+                        "DataLoader workers exited unexpectedly")
+                continue
             if err is not None:
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
@@ -369,6 +396,9 @@ class _MultiprocessIter:
         for p in self.workers:
             if p.is_alive():
                 p.terminate()
+        if self._shm is not None:
+            self._shm.close()
+            self._shm = None
 
     def __del__(self):
         self._shutdown()
@@ -433,6 +463,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.worker_init_fn = worker_init_fn
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = prefetch_factor
         self.return_list = return_list
         self._iterable_mode = isinstance(dataset, IterableDataset)

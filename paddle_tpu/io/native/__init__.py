@@ -1,0 +1,144 @@
+"""Native (C++) DataLoader transport — builds and wraps shm_queue.cpp.
+
+The reference keeps its DataLoader hot path native (``blocking_queue.h`` +
+shared-memory tensor blobs + ``buffered_reader.cc``; SURVEY.md §2.1/§3.5);
+this is the TPU-build equivalent: a POSIX shared-memory blocking ring queue
+compiled with g++ at first use (ctypes ABI — no pybind11 in the image) and a
+Python ``ShmQueue`` wrapper speaking pickled numpy batches. Falls back to
+``multiprocessing.Queue`` transparently when the toolchain or /dev/shm is
+unavailable (``available()`` is the gate).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import threading
+
+_LIB = None
+_LIB_ERR = None
+_BUILD_LOCK = threading.Lock()
+
+
+def _build_lib():
+    src = os.path.join(os.path.dirname(__file__), "shm_queue.cpp")
+    build_dir = os.path.join(tempfile.gettempdir(),
+                             f"paddle_tpu_native_{os.getuid()}")
+    os.makedirs(build_dir, exist_ok=True)
+    so = os.path.join(build_dir, "libshmqueue.so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        cmd = ["g++", "-O2", "-shared", "-fPIC", src, "-o", so + ".tmp",
+               "-lrt", "-pthread"]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(so + ".tmp", so)
+    return so
+
+
+def _load():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None or _LIB_ERR is not None:
+            return _LIB
+        try:
+            lib = ctypes.CDLL(_build_lib())
+            lib.shmq_create.restype = ctypes.c_void_p
+            lib.shmq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_uint64]
+            lib.shmq_open.restype = ctypes.c_void_p
+            lib.shmq_open.argtypes = [ctypes.c_char_p]
+            lib.shmq_push.restype = ctypes.c_int
+            lib.shmq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_int]
+            lib.shmq_pop.restype = ctypes.c_int64
+            lib.shmq_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_uint64, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+            for f in ("shmq_slot_bytes", "shmq_size", "shmq_pushed",
+                      "shmq_popped"):
+                getattr(lib, f).restype = ctypes.c_uint64
+                getattr(lib, f).argtypes = [ctypes.c_void_p]
+            lib.shmq_close.argtypes = [ctypes.c_void_p]
+            _LIB = lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            _LIB_ERR = e
+            _LIB = None
+    return _LIB
+
+
+def available() -> bool:
+    return sys.platform == "linux" and _load() is not None
+
+
+class ShmQueue:
+    """Blocking shared-memory queue of pickled python objects.
+
+    Parent: ``ShmQueue(name, create=True)``; workers: ``ShmQueue(name)``.
+    """
+
+    DEFAULT_SLOTS = 8
+    DEFAULT_SLOT_BYTES = 64 << 20     # tmpfs pages are lazy — virtual only
+
+    def __init__(self, name, create=False, slots=DEFAULT_SLOTS,
+                 slot_bytes=DEFAULT_SLOT_BYTES):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native shm queue unavailable: {_LIB_ERR}")
+        self._lib = lib
+        self.name = name if name.startswith("/") else "/" + name
+        bname = self.name.encode()
+        self._h = (lib.shmq_create(bname, slots, slot_bytes) if create
+                   else lib.shmq_open(bname))
+        if not self._h:
+            raise RuntimeError(f"shmq_{'create' if create else 'open'} failed "
+                               f"for {self.name}")
+        self._recv_buf = ctypes.create_string_buffer(1 << 20)
+
+    def put(self, obj, timeout=None):
+        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        to_ms = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.shmq_push(self._h, blob, len(blob), to_ms)
+        if rc == -1:
+            raise TimeoutError(f"ShmQueue.put timed out ({self.name})")
+        if rc == -2:
+            raise ValueError(f"batch of {len(blob)} bytes exceeds slot size "
+                             f"{self._lib.shmq_slot_bytes(self._h)}")
+        return True
+
+    def get(self, timeout=None):
+        to_ms = -1 if timeout is None else int(timeout * 1000)
+        need = ctypes.c_uint64(0)
+        while True:
+            n = self._lib.shmq_pop(self._h, self._recv_buf,
+                                   len(self._recv_buf), to_ms,
+                                   ctypes.byref(need))
+            if n == -1:
+                raise TimeoutError(f"ShmQueue.get timed out ({self.name})")
+            if n == -3:
+                self._recv_buf = ctypes.create_string_buffer(
+                    int(need.value))
+                continue
+            return pickle.loads(self._recv_buf.raw[:n])
+
+    def qsize(self):
+        return int(self._lib.shmq_size(self._h))
+
+    def stats(self):
+        return {"pushed": int(self._lib.shmq_pushed(self._h)),
+                "popped": int(self._lib.shmq_popped(self._h))}
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.shmq_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

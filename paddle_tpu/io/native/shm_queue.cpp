@@ -1,0 +1,193 @@
+// Shared-memory blocking ring queue for the DataLoader hot path.
+//
+// Reference analogue: paddle/fluid/operators/reader/blocking_queue.h (the
+// C++ bounded queue between DataLoader workers and the consumer) plus the
+// shared-memory LoDTensor blobs of the multiprocess DataLoader
+// (SURVEY.md §3.5). TPU-native: worker processes serialize numpy batches
+// into fixed-size slots of a POSIX shm segment; the trainer process pops
+// without the multiprocessing.Queue pipe/socket copy. Multi-producer /
+// multi-consumer safe via process-shared POSIX semaphores; slot pages are
+// tmpfs-lazy so generous slot sizes cost no physical memory until used.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+//
+// Build: g++ -O2 -shared -fPIC shm_queue.cpp -o libshmqueue.so -lrt -pthread
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <semaphore.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Ctrl {
+  uint64_t magic;
+  uint64_t slots;
+  uint64_t slot_bytes;
+  uint64_t head;   // next slot to write (producers)
+  uint64_t tail;   // next slot to read (consumers)
+  sem_t free_sem;  // counts empty slots
+  sem_t item_sem;  // counts filled slots
+  sem_t pmu;       // producer mutex
+  sem_t cmu;       // consumer mutex
+  uint64_t pushed; // stats
+  uint64_t popped;
+};
+
+constexpr uint64_t kMagic = 0x70616464746f7571ULL;  // "paddtouq"
+
+struct Handle {
+  Ctrl* ctrl;
+  uint8_t* data;   // slots * (8 + slot_bytes)
+  uint64_t map_len;
+  int fd;
+  bool owner;
+  char name[128];
+};
+
+uint64_t slot_stride(const Ctrl* c) { return 8 + c->slot_bytes; }
+
+int timed_wait(sem_t* s, int timeout_ms) {
+  if (timeout_ms < 0) {
+    while (sem_wait(s) == -1 && errno == EINTR) {}
+    return 0;
+  }
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_sec += timeout_ms / 1000;
+  ts.tv_nsec += (long)(timeout_ms % 1000) * 1000000L;
+  if (ts.tv_nsec >= 1000000000L) { ts.tv_sec += 1; ts.tv_nsec -= 1000000000L; }
+  while (true) {
+    if (sem_timedwait(s, &ts) == 0) return 0;
+    if (errno == EINTR) continue;
+    return -1;  // ETIMEDOUT
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shmq_create(const char* name, uint64_t slots, uint64_t slot_bytes) {
+  shm_unlink(name);  // stale segment from a crashed run
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t len = sizeof(Ctrl) + slots * (8 + slot_bytes);
+  if (ftruncate(fd, (off_t)len) != 0) { close(fd); shm_unlink(name); return nullptr; }
+  void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) { close(fd); shm_unlink(name); return nullptr; }
+  Ctrl* c = (Ctrl*)mem;
+  c->slots = slots;
+  c->slot_bytes = slot_bytes;
+  c->head = c->tail = 0;
+  c->pushed = c->popped = 0;
+  sem_init(&c->free_sem, 1, (unsigned)slots);
+  sem_init(&c->item_sem, 1, 0);
+  sem_init(&c->pmu, 1, 1);
+  sem_init(&c->cmu, 1, 1);
+  c->magic = kMagic;
+  Handle* h = new Handle();
+  h->ctrl = c;
+  h->data = (uint8_t*)mem + sizeof(Ctrl);
+  h->map_len = len;
+  h->fd = fd;
+  h->owner = true;
+  strncpy(h->name, name, sizeof(h->name) - 1);
+  return h;
+}
+
+void* shmq_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) { close(fd); return nullptr; }
+  Ctrl* c = (Ctrl*)mem;
+  if (c->magic != kMagic) { munmap(mem, (size_t)st.st_size); close(fd); return nullptr; }
+  Handle* h = new Handle();
+  h->ctrl = c;
+  h->data = (uint8_t*)mem + sizeof(Ctrl);
+  h->map_len = (uint64_t)st.st_size;
+  h->fd = fd;
+  h->owner = false;
+  strncpy(h->name, name, sizeof(h->name) - 1);
+  return h;
+}
+
+// 0 ok; -1 timeout; -2 payload larger than slot
+int shmq_push(void* hv, const void* buf, uint64_t len, int timeout_ms) {
+  Handle* h = (Handle*)hv;
+  Ctrl* c = h->ctrl;
+  if (len > c->slot_bytes) return -2;
+  if (timed_wait(&c->free_sem, timeout_ms) != 0) return -1;
+  timed_wait(&c->pmu, -1);
+  uint64_t slot = c->head % c->slots;
+  c->head++;
+  uint8_t* p = h->data + slot * slot_stride(c);
+  sem_post(&c->pmu);
+  memcpy(p, &len, 8);
+  memcpy(p + 8, buf, len);
+  __sync_synchronize();
+  c->pushed++;
+  sem_post(&c->item_sem);
+  return 0;
+}
+
+// >=0: payload length; -1 timeout; -3 caller buffer too small (len returned
+// via *need)
+int64_t shmq_pop(void* hv, void* out, uint64_t cap, int timeout_ms,
+                 uint64_t* need) {
+  Handle* h = (Handle*)hv;
+  Ctrl* c = h->ctrl;
+  if (timed_wait(&c->item_sem, timeout_ms) != 0) return -1;
+  timed_wait(&c->cmu, -1);
+  uint64_t slot = c->tail % c->slots;
+  uint8_t* p = h->data + slot * slot_stride(c);
+  uint64_t len;
+  memcpy(&len, p, 8);
+  if (len > cap) {
+    // leave item in place for a retry with a bigger buffer
+    if (need) *need = len;
+    sem_post(&c->cmu);
+    sem_post(&c->item_sem);
+    return -3;
+  }
+  memcpy(out, p + 8, len);
+  c->tail++;
+  c->popped++;
+  sem_post(&c->cmu);
+  sem_post(&c->free_sem);
+  return (int64_t)len;
+}
+
+uint64_t shmq_slot_bytes(void* hv) { return ((Handle*)hv)->ctrl->slot_bytes; }
+uint64_t shmq_size(void* hv) {
+  Ctrl* c = ((Handle*)hv)->ctrl;
+  int v = 0;
+  sem_getvalue(&c->item_sem, &v);
+  return (uint64_t)(v < 0 ? 0 : v);
+}
+uint64_t shmq_pushed(void* hv) { return ((Handle*)hv)->ctrl->pushed; }
+uint64_t shmq_popped(void* hv) { return ((Handle*)hv)->ctrl->popped; }
+
+void shmq_close(void* hv) {
+  Handle* h = (Handle*)hv;
+  bool owner = h->owner;
+  char name[128];
+  strncpy(name, h->name, sizeof(name));
+  munmap((void*)h->ctrl, h->map_len);
+  close(h->fd);
+  delete h;
+  if (owner) shm_unlink(name);
+}
+
+}  // extern "C"
