@@ -30,6 +30,13 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 
+# semi-auto parallelism (paddle.distributed.auto_parallel + top-level API)
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, dtensor_from_fn,
+    shard_optimizer,
+)
+
 # communication subpackage alias (paddle.distributed.communication.*)
 from . import collective as communication  # noqa: F401
 

@@ -1,0 +1,247 @@
+"""Semi-automatic parallelism API (reference:
+``python/paddle/distributed/auto_parallel/`` — 3.0 dygraph flavor:
+``ProcessMesh``, placements ``Shard(d)``/``Replicate``/``Partial``,
+``shard_tensor``, ``dtensor_from_fn``, ``reshard``, ``shard_optimizer``;
+SURVEY.md §2.3 "Auto-parallel").
+
+TPU-native: the reference's completion/partitioner pipeline (propagate
+dist-attrs through a static Program, split per rank, insert collectives) IS
+XLA's GSPMD propagation — users annotate a few tensors, the partitioner
+infers the rest. So here ``ProcessMesh`` wraps ``jax.sharding.Mesh``,
+placements translate to ``PartitionSpec`` dims, ``shard_tensor`` is a
+``device_put``/``with_sharding_constraint``, and everything between the
+annotations is completed by the XLA SPMD partitioner at jit time.
+``Partial(sum)`` (pending-reduction values) has no public NamedSharding
+form — it exists transiently inside XLA; the API accepts it for parity and
+materializes the reduced (replicated) value.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...framework.core import Tensor, Parameter
+from ...autograd.tape import apply
+from .. import mesh as mesh_mod
+
+__all__ = [
+    "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+    "dtensor_from_fn", "reshard", "shard_optimizer", "get_mesh", "set_mesh",
+]
+
+
+# ---------------------------------------------------------------------------
+# placements
+# ---------------------------------------------------------------------------
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Shard tensor dim ``dim`` along this mesh axis."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending reduction along this mesh axis (reference ``Partial``)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+# ---------------------------------------------------------------------------
+# ProcessMesh
+# ---------------------------------------------------------------------------
+
+class ProcessMesh:
+    """N-D mesh of ranks with named dims (reference ProcessMesh). Ranks index
+    into ``jax.devices()``; the jax Mesh is built lazily."""
+
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+        else:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._ranks = arr
+        self.dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(arr.ndim)]
+        assert len(self.dim_names) == arr.ndim
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return list(self._ranks.shape)
+
+    @property
+    def process_ids(self):
+        return self._ranks.flatten().tolist()
+
+    @property
+    def ndim(self):
+        return self._ranks.ndim
+
+    def get_dim_size(self, name):
+        return self._ranks.shape[self.dim_names.index(name)]
+
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            dev_arr = np.vectorize(lambda r: devs[r % len(devs)])(self._ranks)
+            self._jax_mesh = Mesh(dev_arr, tuple(self.dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, o):
+        return (isinstance(o, ProcessMesh)
+                and np.array_equal(o._ranks, self._ranks)
+                and o.dim_names == self.dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names},"
+                f" process_ids={self.process_ids})")
+
+
+_auto_mesh: ProcessMesh | None = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _auto_mesh
+    _auto_mesh = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _auto_mesh
+
+
+# ---------------------------------------------------------------------------
+# shard / reshard
+# ---------------------------------------------------------------------------
+
+def _to_named_sharding(mesh: ProcessMesh, placements):
+    placements = list(placements)
+    while len(placements) < mesh.ndim:
+        placements.append(Replicate())
+    ndim_map = {}
+    for axis_name, pl in zip(mesh.dim_names, placements):
+        if isinstance(pl, Shard):
+            d = pl.dim
+            if d in ndim_map:         # two axes shard the same tensor dim
+                prev = ndim_map[d]
+                ndim_map[d] = (prev if isinstance(prev, tuple)
+                               else (prev,)) + (axis_name,)
+            else:
+                ndim_map[d] = axis_name
+    return mesh.jax_mesh(), ndim_map
+
+
+def _spec_for(ndim, ndim_map):
+    return PartitionSpec(*[ndim_map.get(i) for i in range(ndim)])
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=None):
+    """Place a Tensor (or array-like) on the mesh per ``placements`` (one per
+    mesh dim). Returns a Tensor whose ``.placements``/``.process_mesh``
+    mirror the reference dist-tensor attributes."""
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    jmesh, ndim_map = _to_named_sharding(mesh, placements)
+    sh = NamedSharding(jmesh, _spec_for(t.ndim, ndim_map))
+
+    def fn(a):
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sh)
+        return jax.device_put(a, sh)
+
+    out = apply(fn, t, op_name="shard_tensor")
+    if isinstance(t, Parameter):
+        out2 = Parameter(out._data, name=t.name)
+        out2.stop_gradient = t.stop_gradient
+        out = out2
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    if stop_gradient is not None:
+        out.stop_gradient = stop_gradient
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements):
+    """Transfer to a (possibly different) mesh/placement layout — the
+    reference inserts comm ops; XLA derives them from the device_put."""
+    return shard_tensor(dist_tensor, mesh, placements)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Shard optimizer states like their parameters (reference
+    ``shard_optimizer``). States created as ``zeros_like(param)`` inherit
+    the param's sharding automatically under jax; this re-places any states
+    that already exist and marks the optimizer so checkpoints record specs."""
+    params = [p for p in getattr(optimizer, "_parameter_list", []) or []
+              if p is not None]
+    accs = getattr(optimizer, "_accumulators", None)
+    if accs:
+        by_name = {p.name: p for p in params}
+        for acc_dict in accs.values():
+            for pname, acc in acc_dict.items():
+                p = by_name.get(pname)
+                if p is None or not isinstance(p._data, jax.Array):
+                    continue
+                if isinstance(acc._data, jax.Array) \
+                        and acc._data.shape == p._data.shape:
+                    acc._data = jax.device_put(acc._data, p._data.sharding)
+    optimizer._auto_parallel_sharded = True
+    return optimizer

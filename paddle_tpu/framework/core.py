@@ -122,6 +122,7 @@ class Tensor:
     __slots__ = (
         "_data", "stop_gradient", "grad", "name", "persistable",
         "_grad_node", "_out_idx", "_retain_grads", "_grad_hooks", "_weak_pp",
+        "process_mesh", "placements",   # auto-parallel dist-tensor attrs
         "__weakref__",
     )
 
@@ -146,6 +147,8 @@ class Tensor:
         self._retain_grads = False
         self._grad_hooks = None
         self._weak_pp = None
+        self.process_mesh = None
+        self.placements = None
 
     # -- basic properties ---------------------------------------------------
     @property
