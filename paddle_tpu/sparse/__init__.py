@@ -1,0 +1,230 @@
+"""paddle.sparse (reference: ``python/paddle/sparse/`` — COO/CSR tensors
+over ``paddle/phi/kernels/sparse/``; SURVEY.md §2.2).
+
+TPU-native: backed by ``jax.experimental.sparse`` BCOO/BCSR — XLA lowers the
+sparse contractions to gather/scatter + dense tiles (TPUs have no native
+sparse MXU path, same as the reference's cuSPARSE fallback tier). Dense
+operands stay differentiable through the tape; sparse values are
+differentiable through ``values()``-preserving elementwise ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor
+from ..framework import dtype as dtypes
+from ..autograd.tape import apply
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "add", "multiply", "matmul", "masked_matmul", "relu",
+    "is_sparse", "nn",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (wraps BCOO). ``indices`` [ndim, nnz], ``values``
+    [nnz] — reference layout."""
+
+    def __init__(self, bcoo):
+        self._m = bcoo
+
+    # -- construction -------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    @property
+    def nnz(self):
+        return int(self._m.nse)
+
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._m.indices, 0, 1))
+
+    def values(self):
+        return Tensor(self._m.data)
+
+    def to_dense(self):
+        return Tensor(self._m.todense())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._m))
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self):
+        return SparseCooTensor(self._m.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={dtypes.dtype_name(self.dtype)})")
+
+
+class SparseCsrTensor:
+    def __init__(self, bcsr):
+        self._m = bcsr
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    @property
+    def nnz(self):
+        return int(self._m.nse)
+
+    def crows(self):
+        return Tensor(self._m.indptr)
+
+    def cols(self):
+        return Tensor(self._m.indices)
+
+    def values(self):
+        return Tensor(self._m.data)
+
+    def to_dense(self):
+        return Tensor(self._m.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._m.to_bcoo())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={dtypes.dtype_name(self.dtype)})")
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = np.asarray(indices if not isinstance(indices, Tensor)
+                     else indices.numpy())
+    vals = _as_array(values)
+    if dtype is not None:
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i.max()) + 1 for i in idx)
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, **kw):
+    vals = _as_array(values)
+    if dtype is not None:
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    bcsr = jsparse.BCSR((vals, _as_array(cols).astype(jnp.int32),
+                         _as_array(crows).astype(jnp.int32)),
+                        shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def is_sparse(x):
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+# -- ops --------------------------------------------------------------------
+
+def add(x, y):
+    if is_sparse(x) and is_sparse(y):
+        xm, ym = _coo(x)._m, _coo(y)._m
+        # sum via dense-free concat of coordinates
+        data = jnp.concatenate([xm.data, ym.data])
+        idx = jnp.concatenate([xm.indices, ym.indices], axis=0)
+        m = jsparse.BCOO((data, idx), shape=xm.shape).sum_duplicates(
+            nse=xm.nse + ym.nse)
+        return SparseCooTensor(m)
+    if is_sparse(x):
+        return Tensor(x.to_dense()._data + _as_array(y))
+    return Tensor(_as_array(x) + y.to_dense()._data)
+
+
+def multiply(x, y):
+    if is_sparse(x) and not is_sparse(y):
+        xm = _coo(x)._m
+        dense_vals = xm.todense() * _as_array(y)
+        m = jsparse.bcoo_fromdense(dense_vals, nse=xm.nse)
+        return SparseCooTensor(m)
+    if is_sparse(x) and is_sparse(y):
+        return SparseCooTensor(jsparse.bcoo_multiply_sparse(
+            _coo(x)._m, _coo(y)._m))
+    return multiply(y, x)
+
+
+def matmul(x, y):
+    """sparse @ dense → dense (differentiable w.r.t. the dense operand)."""
+    if is_sparse(x):
+        xm = _coo(x)._m
+
+        def fn(d):
+            return xm @ d
+
+        return apply(fn, y if isinstance(y, Tensor) else Tensor(y),
+                     op_name="sparse_matmul")
+    if is_sparse(y):
+        ym = _coo(y)._m
+
+        def fn(d):
+            return jsparse.bcoo_dot_general(
+                ym, d, dimension_numbers=(((0,), (d.ndim - 2,)), ((), ())))
+
+        # x @ sparse == (sparse^T @ x^T)^T for 2-D; keep simple via dense
+        return apply(lambda d: d @ ym.todense(),
+                     x if isinstance(x, Tensor) else Tensor(x),
+                     op_name="sparse_matmul")
+    from ..ops import math as pmath
+    return pmath.matmul(x, y)
+
+
+def masked_matmul(x, y, mask):
+    """(x @ y) sampled at mask's sparsity pattern (reference sddmm)."""
+    xm = _as_array(x)
+    ym = _as_array(y)
+    mm = _coo(mask)._m
+    rows = mm.indices[:, 0]
+    cols = mm.indices[:, 1]
+    vals = jnp.einsum("nd,nd->n", xm[rows], ym[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, mm.indices), shape=mm.shape))
+
+
+def relu(x):
+    m = _coo(x)._m
+    return SparseCooTensor(jsparse.BCOO((jnp.maximum(m.data, 0), m.indices),
+                                        shape=m.shape))
+
+
+class nn:
+    """paddle.sparse.nn — sparse activations (subset)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

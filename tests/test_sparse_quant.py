@@ -1,0 +1,122 @@
+"""Sparse + quantization tests (reference: paddle.sparse /
+paddle.quantization — SURVEY.md §2.2)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.quantization import (
+    QuantConfig, QAT, PTQ, FakeQuanterWithAbsMaxObserver, AbsmaxObserver,
+    convert, fake_quant,
+)
+
+
+# ---------------------------------------------------------------------------
+# sparse
+# ---------------------------------------------------------------------------
+
+def test_coo_roundtrip():
+    idx = [[0, 1, 2], [1, 2, 0]]
+    vals = [1.0, 2.0, 3.0]
+    st = sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+    assert st.is_sparse_coo() and st.nnz == 3
+    dense = st.to_dense().numpy()
+    ref = np.zeros((3, 3), np.float32)
+    ref[0, 1], ref[1, 2], ref[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, ref)
+    np.testing.assert_allclose(st.values().numpy(), vals)
+    assert st.indices().shape == [2, 3]
+
+
+def test_csr_roundtrip_and_convert():
+    st = sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 2, 0], [1.0, 2.0, 3.0],
+                                  shape=[3, 3])
+    assert st.is_sparse_csr() and st.nnz == 3
+    coo = st.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), st.to_dense().numpy())
+
+
+def test_sparse_add_multiply_relu():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, -2.0], [2, 2])
+    b = sparse.sparse_coo_tensor([[0, 1], [0, 0]], [5.0, 1.0], [2, 2])
+    s = sparse.add(a, b)
+    np.testing.assert_allclose(s.to_dense().numpy(),
+                               [[6.0, 0.0], [1.0, -2.0]])
+    r = sparse.relu(a)
+    np.testing.assert_allclose(r.to_dense().numpy(), [[1.0, 0.0], [0.0, 0.0]])
+
+
+def test_sparse_matmul_grad():
+    a = sparse.sparse_coo_tensor([[0, 0, 1], [0, 1, 1]], [1.0, 2.0, 3.0],
+                                 [2, 2])
+    x = paddle.to_tensor(np.eye(2, dtype=np.float32), stop_gradient=False)
+    out = sparse.matmul(a, x)
+    np.testing.assert_allclose(out.numpy(), [[1.0, 2.0], [0.0, 3.0]])
+    out.sum().backward()
+    assert x.grad is not None
+    # d(sum(A@X))/dX = A^T @ ones
+    np.testing.assert_allclose(x.grad.numpy(), [[1.0, 1.0], [5.0, 5.0]])
+
+
+def test_masked_matmul():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+    y = paddle.to_tensor(np.ones((2, 2), np.float32))
+    mask = sparse.sparse_coo_tensor([[0, 1], [1, 0]], [1.0, 1.0], [2, 2])
+    out = sparse.masked_matmul(x, y, mask)
+    dense = out.to_dense().numpy()
+    full = x.numpy() @ y.numpy()
+    assert dense[0, 1] == full[0, 1] and dense[1, 0] == full[1, 0]
+    assert dense[0, 0] == 0 and dense[1, 1] == 0
+
+
+# ---------------------------------------------------------------------------
+# quantization
+# ---------------------------------------------------------------------------
+
+def test_fake_quant_ste():
+    x = paddle.to_tensor(np.linspace(-2, 2, 9, dtype=np.float32),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(np.float32(1.0))
+    out = fake_quant(x, scale, 8)
+    # quantized to 1/127 grid within [-1, 1], clipped outside
+    assert abs(float(out.numpy().max()) - 1.0) < 1e-6
+    out.sum().backward()
+    g = x.grad.numpy()
+    inside = np.abs(x.numpy()) <= 1.0
+    np.testing.assert_allclose(g[inside], 1.0)
+    np.testing.assert_allclose(g[~inside], 0.0)
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    model = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 4))
+    q = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                    weight=FakeQuanterWithAbsMaxObserver())
+    qmodel = QAT(q).quantize(model)
+    from paddle_tpu.quantization import QuantedLinear
+    assert isinstance(qmodel._sub_layers["0"], QuantedLinear)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=qmodel.parameters())
+    x = paddle.randn([4, 8])
+    losses = []
+    for _ in range(5):
+        loss = (qmodel(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_convert_int8():
+    paddle.seed(1)
+    model = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+    q = QuantConfig(activation=None, weight=AbsmaxObserver())
+    qmodel = PTQ(q).quantize(model)
+    ref_w = qmodel._sub_layers["0"].inner.weight.numpy().copy()
+    convert(qmodel)
+    lin = qmodel._sub_layers["0"]
+    assert lin.int8_weight.dtype == np.int8
+    deq = lin.int8_weight.astype(np.float32) * (lin.weight_scale / 127.0)
+    assert np.abs(deq - ref_w).max() <= lin.weight_scale / 127.0 + 1e-6
