@@ -228,24 +228,4 @@ class GradScaler:
         self._bad_steps = state.get("bad_steps", 0)
 
 
-class debugging:
-    """paddle.amp.debugging subset: tensor checks (SURVEY.md §5.2)."""
-
-    @staticmethod
-    def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
-        import jax
-        bad = bool(jnp.any(~jnp.isfinite(tensor._data)))
-        if bad:
-            raise FloatingPointError(
-                f"NaN/Inf detected in {op_type}:{var_name or tensor.name}")
-        return tensor
-
-    @staticmethod
-    def enable_tensor_checker(*a, **k):
-        from ..autograd import tape
-        tape._nan_check = True
-
-    @staticmethod
-    def disable_tensor_checker(*a, **k):
-        from ..autograd import tape
-        tape._nan_check = False
+from . import debugging  # noqa: F401,E402  (full module: paddle.amp.debugging)

@@ -1,0 +1,89 @@
+"""paddle.amp.debugging (reference: ``python/paddle/amp/debugging.py`` —
+tensor checker utilities + the ``FLAGS_check_nan_inf`` per-op scan in
+``nan_inf_utils``; SURVEY.md §5.2).
+
+TPU-native: XLA is value-semantic so there are no data races to detect; the
+useful guards are NaN/Inf detection — per-op (eager tape hook via
+``FLAGS_check_nan_inf``) and under jit (``jax_debug_nans``).
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .. import flags as _flags
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+def enable_tensor_checker(checker_config=None):
+    """Turn on the per-op NaN/Inf scan (eager tape) + jit-time debug_nans."""
+    _flags.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        jax.config.update("jax_debug_nans", True)
+    except Exception:
+        pass
+
+
+def disable_tensor_checker():
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+    try:
+        jax.config.update("jax_debug_nans", False)
+    except Exception:
+        pass
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Scan one tensor; raises on NaN/Inf with identity info (reference
+    behavior of the per-op checker)."""
+    arr = tensor._data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if isinstance(arr, jax.core.Tracer):
+        return tensor
+    if jnp.issubdtype(arr.dtype, jnp.inexact):
+        finite = bool(jnp.all(jnp.isfinite(arr)))
+        if not finite:
+            n_nan = int(jnp.isnan(arr).sum())
+            n_inf = int(jnp.isinf(arr).sum())
+            raise FloatingPointError(
+                f"check_numerics: op={op_type or '?'} var="
+                f"{var_name or getattr(tensor, 'name', '?')} has "
+                f"{n_nan} NaN / {n_inf} Inf values")
+    return tensor
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Count ops dispatched inside the region (reference collects per-dtype
+    op stats for AMP debugging) — uses the profiler tape hook."""
+    from ..profiler import Profiler, ProfilerTarget
+    p = Profiler(targets=[ProfilerTarget.CPU], timer_only=True)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError(
+        "compare_accuracy needs the static dump pipeline; use "
+        "check_numerics / enable_tensor_checker in the TPU build")

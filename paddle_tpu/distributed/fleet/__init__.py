@@ -24,6 +24,7 @@ from .meta_parallel import (  # noqa: F401
 )
 from . import utils  # noqa: F401
 from .utils import recompute  # noqa: F401
+from . import elastic  # noqa: F401  (ElasticManager + TrainingSupervisor)
 from .. import mesh as mesh_mod
 from ..parallel import DataParallel
 from ..parallel_env import init_parallel_env, get_rank, get_world_size

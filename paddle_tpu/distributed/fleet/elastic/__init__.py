@@ -1,0 +1,164 @@
+"""Elastic training (reference: ``python/paddle/distributed/fleet/elastic/``
+— ``ElasticManager``: etcd-backed membership with np range ``min:max``,
+heartbeat keys with TTL, watch → rebuild endpoints → relaunch trainers;
+SURVEY.md §5.3).
+
+TPU-native: the etcd server is replaced by a pluggable KV store — default a
+shared-filesystem directory (``file://``), which is what multi-host TPU pods
+have (GCS/NFS); heartbeats are timestamp files with TTL. The relaunch action
+is the launcher's checkpoint-restart loop (launch/main.py --run_mode=elastic)
+plus ``TrainingSupervisor`` for in-process resume.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .supervisor import TrainingSupervisor, CheckpointManager  # noqa: F401
+
+ELASTIC_EXIT_CODE = 101      # reference: trainers exit with this on scale event
+
+
+class FileKVStore:
+    """KV + TTL heartbeat store on a shared filesystem (etcd stand-in)."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self.root, key.strip("/").replace("/", "__"))
+
+    def put(self, key, value):
+        with open(self._path(key), "w") as f:
+            json.dump({"value": value, "ts": time.time()}, f)
+
+    def get(self, key):
+        try:
+            with open(self._path(key)) as f:
+                return json.load(f)["value"]
+        except (OSError, ValueError):
+            return None
+
+    def delete(self, key):
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+    def keys(self, prefix=""):
+        pfx = prefix.strip("/").replace("/", "__")
+        out = []
+        for name in os.listdir(self.root):
+            if name.startswith(pfx):
+                out.append(name.replace("__", "/"))
+        return out
+
+    def age(self, key):
+        try:
+            with open(self._path(key)) as f:
+                return time.time() - json.load(f)["ts"]
+        except (OSError, ValueError):
+            return None
+
+
+def _make_store(server):
+    if server is None:
+        server = os.environ.get("PADDLE_ELASTIC_SERVER")
+    if server is None:
+        raise ValueError("elastic needs a server (file:///shared/dir)")
+    if server.startswith("file://"):
+        return FileKVStore(server[len("file://"):])
+    raise NotImplementedError(f"elastic store scheme not supported: {server} "
+                              "(TPU build supports file:// shared storage)")
+
+
+class ElasticManager:
+    """Membership manager for one host (reference ElasticManager semantics:
+    register, heartbeat with TTL, detect world change within [min_np, max_np],
+    signal relaunch)."""
+
+    def __init__(self, server=None, job_id=None, np=None, host=None,
+                 heartbeat_interval=1.0, ttl=5.0):
+        self.store = _make_store(server)
+        self.job_id = job_id or os.environ.get("PADDLE_ELASTIC_JOB_ID",
+                                               "default")
+        np_spec = str(np if np is not None
+                      else os.environ.get("PADDLE_ELASTIC_NP", "1"))
+        if ":" in np_spec:
+            lo, hi = np_spec.split(":")
+            self.min_np, self.max_np = int(lo), int(hi)
+        else:
+            self.min_np = self.max_np = int(np_spec)
+        self.host = host or os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                           f"127.0.0.1:{os.getpid()}")
+        self.heartbeat_interval = heartbeat_interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self._last_world = None
+
+    # -- membership ---------------------------------------------------------
+    def _node_key(self, host=None):
+        return f"{self.job_id}/nodes/{(host or self.host).replace(':', '_')}"
+
+    def register(self):
+        self.store.put(self._node_key(), self.host)
+        self._last_world = self.hosts()
+
+    def deregister(self):
+        self.store.delete(self._node_key())
+
+    def heartbeat(self):
+        self.store.put(self._node_key(), self.host)
+
+    def start(self):
+        self.register()
+
+        def beat():
+            while not self._stop.wait(self.heartbeat_interval):
+                self.heartbeat()
+
+        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=2)
+        self.deregister()
+
+    def hosts(self):
+        """Live hosts (heartbeat within TTL), sorted for determinism."""
+        out = []
+        for key in self.store.keys(f"{self.job_id}/nodes/"):
+            age = self.store.age(key)
+            val = self.store.get(key)
+            if val is not None and age is not None and age <= self.ttl:
+                out.append(val)
+        return sorted(out)
+
+    # -- scale detection ----------------------------------------------------
+    def world_changed(self):
+        cur = self.hosts()
+        changed = cur != self._last_world
+        return changed, cur
+
+    def should_scale(self):
+        """(scale_needed, healthy) — healthy iff live hosts within range."""
+        cur = self.hosts()
+        healthy = self.min_np <= len(cur) <= self.max_np
+        changed = cur != self._last_world
+        return changed and healthy, healthy
+
+    def accept_world(self):
+        """After relaunch: the current membership becomes the baseline and
+        new endpoints env is produced for the launcher."""
+        cur = self.hosts()
+        self._last_world = cur
+        return {
+            "PADDLE_TRAINERS_NUM": str(len(cur)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(cur),
+        }

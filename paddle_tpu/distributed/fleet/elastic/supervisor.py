@@ -1,0 +1,89 @@
+"""Checkpoint-restart supervision (SURVEY.md §5.3 "TPU equivalent": slice
+failure → restart loop + checkpoint-resume + deterministic data skip).
+
+The reference recovers NCCL-job failures by killing and relaunching trainers
+from the launcher; on TPU the same supervisor drives in-process retry with
+state restored from the latest complete checkpoint.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+
+from ....framework import io as fio
+
+
+class CheckpointManager:
+    """Step-tagged checkpoints with atomic completion marker + retention."""
+
+    def __init__(self, directory, keep=3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _dir(self, step):
+        return os.path.join(self.directory, f"step_{step}")
+
+    def save(self, step, state):
+        d = self._dir(step)
+        tmp = d + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        fio.save(state, os.path.join(tmp, "state.pdz"))
+        os.replace(tmp, d)                      # atomic completion
+        self._retain()
+        return d
+
+    def steps(self):
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_") and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name.split("_", 1)[1]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_step(self):
+        s = self.steps()
+        return s[-1] if s else None
+
+    def load(self, step=None):
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None, None
+        return step, fio.load(os.path.join(self._dir(step), "state.pdz"))
+
+    def _retain(self):
+        for s in self.steps()[:-self.keep]:
+            shutil.rmtree(self._dir(s), ignore_errors=True)
+
+
+class TrainingSupervisor:
+    """Run a training fn with automatic restart-from-checkpoint.
+
+    ``fn(start_step, state, ckpt_manager)`` should periodically
+    ``ckpt.save(step, state)`` and may raise on failure; the supervisor
+    reloads the latest checkpoint and re-invokes, up to ``max_restarts``.
+    """
+
+    def __init__(self, checkpoint_dir, max_restarts=3, keep=3,
+                 backoff_seconds=0.0):
+        self.ckpt = CheckpointManager(checkpoint_dir, keep=keep)
+        self.max_restarts = max_restarts
+        self.backoff_seconds = backoff_seconds
+        self.restarts = 0
+
+    def run(self, fn):
+        while True:
+            step, state = self.ckpt.load()
+            try:
+                return fn(0 if step is None else step, state, self.ckpt)
+            except Exception:
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    raise
+                if self.backoff_seconds:
+                    time.sleep(self.backoff_seconds)

@@ -143,11 +143,10 @@ def divide_no_nan(x, y):
 # ---------------------------------------------------------------------------
 
 def _unary(name, fn):
-    @defop
     def op(x):
         return fn(x)
-    op.__name__ = op.__qualname__ = name
-    return op
+    op.__name__ = op.__qualname__ = name   # before defop closes over it
+    return defop(op)
 
 
 exp = _unary("exp", jnp.exp)

@@ -1,0 +1,121 @@
+"""Elastic membership + checkpoint-restart supervision tests
+(reference: fleet.elastic ElasticManager semantics; SURVEY.md §5.3)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, FileKVStore, TrainingSupervisor, CheckpointManager,
+)
+
+
+def _mgr(tmp_path, host, np_spec="1:4", ttl=0.5):
+    return ElasticManager(server=f"file://{tmp_path}/kv", job_id="j1",
+                          np=np_spec, host=host, ttl=ttl,
+                          heartbeat_interval=0.1)
+
+
+def test_membership_register_and_scale_detect(tmp_path):
+    a = _mgr(tmp_path, "10.0.0.1:8000")
+    b = _mgr(tmp_path, "10.0.0.2:8000")
+    a.register()
+    assert a.hosts() == ["10.0.0.1:8000"]
+    changed, cur = a.world_changed()
+    assert not changed
+
+    b.register()                       # scale-out event
+    changed, cur = a.world_changed()
+    assert changed and len(cur) == 2
+    scale, healthy = a.should_scale()
+    assert scale and healthy
+
+    env = a.accept_world()
+    assert env["PADDLE_TRAINERS_NUM"] == "2"
+    assert "10.0.0.2:8000" in env["PADDLE_TRAINER_ENDPOINTS"]
+    changed, _ = a.world_changed()
+    assert not changed                 # baseline accepted
+
+
+def test_heartbeat_ttl_expiry(tmp_path):
+    a = _mgr(tmp_path, "h1:1", ttl=0.3)
+    b = _mgr(tmp_path, "h2:1", ttl=0.3)
+    a.start()                          # heartbeating
+    b.register()                       # one-shot: will expire
+    a.accept_world()
+    time.sleep(0.6)
+    hosts = a.hosts()
+    assert hosts == ["h1:1"]           # b expired, a kept alive by heartbeat
+    changed, _ = a.world_changed()
+    assert changed                     # scale-in detected
+    a.stop()
+    assert a.hosts() == []             # deregistered
+
+
+def test_np_range_health(tmp_path):
+    a = _mgr(tmp_path, "h1:1", np_spec="2:3")
+    a.register()
+    _, healthy = a.should_scale()
+    assert not healthy                 # 1 < min_np=2
+
+
+def test_checkpoint_manager_retention_and_atomicity(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    for s in (10, 20, 30):
+        cm.save(s, {"w": paddle.to_tensor(np.full(3, s, np.float32))})
+    assert cm.steps() == [20, 30]      # retention pruned step 10
+    step, state = cm.load()
+    assert step == 30
+    np.testing.assert_allclose(state["w"].numpy(), 30.0)
+
+
+def test_supervisor_restarts_from_checkpoint(tmp_path):
+    sup = TrainingSupervisor(str(tmp_path / "ck"), max_restarts=3)
+    attempts = []
+
+    def train(start_step, state, ckpt):
+        w = state["w"].numpy() if state else np.zeros(2, np.float32)
+        attempts.append(start_step)
+        for step in range(start_step + 1, 6):
+            w = w + 1
+            ckpt.save(step, {"w": paddle.to_tensor(w)})
+            if step == 3 and len(attempts) == 1:
+                raise RuntimeError("simulated TPU halt")
+        return w
+
+    out = sup.run(train)
+    # first attempt died at step 3; second resumed from 3 and finished
+    assert attempts == [0, 3]
+    np.testing.assert_allclose(out, 5.0)
+    assert sup.restarts == 1
+
+
+def test_supervisor_gives_up(tmp_path):
+    sup = TrainingSupervisor(str(tmp_path / "ck"), max_restarts=1)
+
+    def always_fail(start_step, state, ckpt):
+        raise RuntimeError("permafail")
+
+    with pytest.raises(RuntimeError, match="permafail"):
+        sup.run(always_fail)
+    assert sup.restarts == 2
+
+
+def test_amp_debugging_checker():
+    from paddle_tpu.amp import debugging as dbg
+    t = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+    with pytest.raises(FloatingPointError, match="NaN"):
+        dbg.check_numerics(t, op_type="test_op", var_name="t")
+    ok = paddle.to_tensor(np.ones(3, np.float32))
+    assert dbg.check_numerics(ok) is ok
+
+    # FLAGS_check_nan_inf per-op scan via flags
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        with pytest.raises(FloatingPointError, match="log"):
+            paddle.log(x - 1.0)        # log(-1) -> nan
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
