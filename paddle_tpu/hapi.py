@@ -65,13 +65,24 @@ class Model:
         loader = train_data if isinstance(train_data, DataLoader) else DataLoader(
             train_data, batch_size=batch_size, shuffle=shuffle,
             drop_last=drop_last, num_workers=num_workers)
+        from .callbacks import CallbackList, EarlyStopping
+        cbs = CallbackList(callbacks, model=self,
+                           params={"epochs": epochs, "batch_size": batch_size,
+                                   "verbose": verbose})
+        for c in cbs.callbacks:     # early-stop best-model dir
+            if isinstance(c, EarlyStopping) and c.save_dir is None:
+                c.save_dir = save_dir
+        cbs.on_train_begin({})
         it = 0
         for epoch in range(epochs):
             self.network.train()
             for m in self._metrics:
                 m.reset()
+            cbs.on_epoch_begin(epoch, {})
             t0 = time.time()
+            logs = {}
             for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step, {})
                 x, y = self._unpack(batch)
                 out = self.network(x)
                 loss = self._loss(out, y) if self._loss else out
@@ -82,17 +93,28 @@ class Model:
                 for m in self._metrics:
                     m.update(m.compute(out, y))
                 it += 1
+                logs = {"loss": float(loss.numpy())}
+                logs.update({m.name(): m.accumulate() for m in self._metrics})
+                cbs.on_train_batch_end(step, logs)
                 if verbose and step % log_freq == 0:
                     metr = {m.name(): m.accumulate() for m in self._metrics}
                     print(f"Epoch {epoch + 1}/{epochs} step {step} "
                           f"loss: {float(loss.numpy()):.4f} {metr} "
                           f"({(time.time() - t0) / (step + 1):.3f}s/step)")
                 if num_iters is not None and it >= num_iters:
+                    cbs.on_train_end(logs)
                     return
+            cbs.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size, verbose=verbose)
+                cbs.on_eval_begin({})
+                ev = self.evaluate(eval_data, batch_size=batch_size,
+                                   verbose=verbose)
+                cbs.on_eval_end(ev)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(os.path.join(save_dir, f"epoch_{epoch}"))
+            if cbs.stop_training:
+                break
+        cbs.on_train_end({})
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):

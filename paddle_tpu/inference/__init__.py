@@ -1,0 +1,146 @@
+"""paddle.inference (reference: ``paddle/fluid/inference/`` —
+``AnalysisPredictor``: load pdmodel → IR fusion passes → run; Python surface
+``Config``/``create_predictor``/zero-copy handles; SURVEY.md §2.1 "Inference
+engine", §3.6).
+
+TPU-native: the saved artifact is serialized StableHLO (paddle.jit.save) —
+already fused/optimized by XLA at export; the predictor deserializes and
+executes the AOT program. The reference's IR-fusion pass pipeline and
+TensorRT engine have no role: XLA is both. Zero-copy IO maps to device
+arrays held on the handle until copy_to_cpu().
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from ..framework.core import Tensor
+
+
+class Config:
+    """paddle_infer.Config(prog_file, params_file) or Config(model_dir)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and params_file is None \
+                and os.path.isdir(prog_file):
+            # model_dir flavor: find the single prefix inside
+            cands = [f[: -len(".pdmodel.stablehlo")]
+                     for f in os.listdir(prog_file)
+                     if f.endswith(".pdmodel.stablehlo")]
+            if not cands:
+                raise FileNotFoundError(
+                    f"no .pdmodel.stablehlo in {prog_file}")
+            self.prefix = os.path.join(prog_file, cands[0])
+        else:
+            # accept either the exported prefix or the model file path
+            p = prog_file or ""
+            for suf in (".pdmodel.stablehlo", ".pdmodel"):
+                if p.endswith(suf):
+                    p = p[: -len(suf)]
+            self.prefix = p
+        self._use_tpu = True
+        self.mem_opt = True
+
+    # knobs kept for API compat (XLA supersedes them)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def enable_memory_optim(self):
+        self.mem_opt = True
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        raise NotImplementedError(
+            "TensorRT is CUDA-only; the TPU build runs XLA-compiled "
+            "StableHLO (already fused)")
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class _IOHandle:
+    """Zero-copy style IO handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        pass
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        v = self._value
+        if isinstance(v, jax.Array):
+            return np.asarray(jax.device_get(v))
+        return np.asarray(v)
+
+    def share_external_data(self, arr):
+        self.copy_from_cpu(np.asarray(arr))
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        self._layer = jit_load(config.prefix)
+        n_inputs = len(self._layer._meta.get("input_specs", [])) or 1
+        self._inputs = [_IOHandle(f"input_{i}") for i in range(n_inputs)]
+        self._outputs = []
+
+    def get_input_names(self):
+        return [h.name for h in self._inputs]
+
+    def get_input_handle(self, name):
+        for h in self._inputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+    def run(self, inputs=None):
+        if inputs is not None:          # list-of-arrays convenience form
+            for h, a in zip(self._inputs, inputs):
+                h.copy_from_cpu(np.asarray(a))
+        args = [Tensor(h._value) for h in self._inputs]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            h = _IOHandle(f"output_{i}")
+            h._value = o._data if isinstance(o, Tensor) else o
+            self._outputs.append(h)
+        if inputs is not None:
+            return [h.copy_to_cpu() for h in self._outputs]
+        return True
+
+    def get_output_names(self):
+        return [h.name for h in self._outputs] or ["output_0"]
+
+    def get_output_handle(self, name):
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version():
+    import paddle_tpu
+    return paddle_tpu.__version__
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Int8 = 2

@@ -1,0 +1,13 @@
+"""paddle.onnx (reference: thin ``paddle.onnx.export`` delegating to the
+external paddle2onnx package; SURVEY.md §2.2). The TPU build's portable
+export format is serialized StableHLO (``paddle.jit.save``) — ONNX export
+would need paddle2onnx, which is not in the image."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "paddle.onnx.export requires the external paddle2onnx package (not "
+        "in the TPU build). Use paddle.jit.save(layer, path, input_spec) — "
+        "serialized StableHLO is the portable inference format here; "
+        "paddle.inference.create_predictor loads it.")

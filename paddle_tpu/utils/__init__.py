@@ -1,0 +1,66 @@
+"""paddle.utils (reference: ``python/paddle/utils/`` — download cache,
+cpp_extension, deprecations; SURVEY.md §2.2)."""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+
+__all__ = ["run_check", "get_weights_path_from_url", "download",
+           "cpp_extension", "deprecated", "try_import"]
+
+
+def run_check():
+    import paddle_tpu
+    return paddle_tpu.run_check()
+
+
+_WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle_tpu/weights")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Reference: download+cache pretrained weights. Zero-egress build:
+    resolves only from the local cache; a missing file raises with the
+    expected cache path so users can place weights manually."""
+    fname = os.path.basename(url)
+    path = os.path.join(_WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        if md5sum:
+            with open(path, "rb") as f:
+                if hashlib.md5(f.read()).hexdigest() != md5sum:
+                    raise IOError(f"md5 mismatch for cached {path}")
+        return path
+    raise IOError(
+        f"no network egress in the TPU build: place the weights file at "
+        f"{path} (wanted {url})")
+
+
+class download:
+    get_weights_path_from_url = staticmethod(get_weights_path_from_url)
+
+
+class cpp_extension:
+    """Reference: JIT-compile CUDA/C++ custom ops. The TPU analogue for
+    device kernels is Pallas (paddle_tpu/ops/pallas); host-side C++ builds
+    via the same g++ path the native DataLoader uses (io/native)."""
+
+    @staticmethod
+    def load(name=None, sources=None, **kw):
+        raise NotImplementedError(
+            "custom device kernels on TPU are Pallas kernels "
+            "(see paddle_tpu/ops/pallas); host-side C++ extensions build "
+            "via ctypes like paddle_tpu/io/native")
+
+
+def deprecated(update_to="", since="", reason=""):
+    def wrap(fn):
+        return fn
+    return wrap
+
+
+def try_import(name, err_msg=None):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(err_msg or str(e))

@@ -1,0 +1,129 @@
+"""Inference predictor + hapi callbacks tests (SURVEY.md §2.1 inference,
+§2.2 hapi)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.callbacks import (
+    Callback, EarlyStopping, ModelCheckpoint, LRScheduler, LogWriterCallback,
+)
+
+
+def _export_model(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    prefix = str(tmp_path / "m" / "model")
+    paddle.jit.save(net, prefix,
+                    input_spec=[paddle.jit.InputSpec([1, 4], "float32")])
+    return net, prefix
+
+
+def test_predictor_matches_eager(tmp_path):
+    net, prefix = _export_model(tmp_path)
+    x = np.random.default_rng(0).normal(size=(1, 4)).astype(np.float32)
+    net.eval()
+    ref = net(paddle.to_tensor(x)).numpy()
+
+    config = Config(prefix + ".pdmodel")
+    pred = create_predictor(config)
+    names = pred.get_input_names()
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_model_dir_and_list_form(tmp_path):
+    net, prefix = _export_model(tmp_path)
+    x = np.zeros((1, 4), np.float32)
+    pred = create_predictor(Config(os.path.dirname(prefix)))
+    outs = pred.run([x])
+    assert outs[0].shape == (1, 2)
+
+
+class _Probe(Callback):
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def on_train_begin(self, logs=None):
+        self.events.append("train_begin")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.events.append(f"epoch_begin_{epoch}")
+
+    def on_train_batch_end(self, step, logs=None):
+        self.events.append("batch")
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.events.append(f"epoch_end_{epoch}")
+
+    def on_train_end(self, logs=None):
+        self.events.append("train_end")
+
+
+def _fit(callbacks, tmp_path, epochs=3, with_eval=False):
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(1)
+    x = paddle.randn([16, 4])
+    y = paddle.randn([16, 1])
+    ds = TensorDataset([x, y])
+    model = paddle.Model(paddle.nn.Linear(4, 1))
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=0.01, parameters=model.parameters()),
+        loss=paddle.nn.MSELoss())
+    model.fit(ds, eval_data=ds if with_eval else None, batch_size=8,
+              epochs=epochs, verbose=0, callbacks=callbacks,
+              save_dir=str(tmp_path / "save") if with_eval else None)
+    return model
+
+
+def test_callback_hooks_fire(tmp_path):
+    probe = _Probe()
+    _fit([probe], tmp_path, epochs=2)
+    assert probe.events[0] == "train_begin"
+    assert probe.events[-1] == "train_end"
+    assert "epoch_begin_0" in probe.events and "epoch_end_1" in probe.events
+    assert probe.events.count("batch") == 4      # 2 epochs × 2 steps
+
+
+def test_early_stopping_stops(tmp_path):
+    # mode='max' on a decreasing loss: every eval is "worse" -> stops after
+    # patience epochs
+    es = EarlyStopping(monitor="loss", mode="max", patience=1,
+                       save_best_model=False)
+    probe = _Probe()
+    _fit([es, probe], tmp_path, epochs=10, with_eval=True)
+    n_epochs = len([e for e in probe.events if e.startswith("epoch_end")])
+    assert n_epochs < 10                         # stopped early
+
+
+def test_model_checkpoint_and_logwriter(tmp_path):
+    mc = ModelCheckpoint(save_freq=1, save_dir=str(tmp_path / "ck"))
+    lw = LogWriterCallback(log_dir=str(tmp_path / "vdl"))
+    _fit([mc, lw], tmp_path, epochs=1)
+    assert os.path.exists(str(tmp_path / "ck" / "epoch_0.pdparams"))
+    assert os.path.exists(str(tmp_path / "ck" / "final.pdparams"))
+    lines = open(str(tmp_path / "vdl" / "metrics.jsonl")).read().splitlines()
+    assert len(lines) == 2
+    assert "loss" in lines[0]
+
+
+def test_lr_scheduler_callback(tmp_path):
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(2)
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                          gamma=0.5)
+    model = paddle.Model(paddle.nn.Linear(4, 1))
+    model.prepare(optimizer=paddle.optimizer.SGD(
+        learning_rate=sched, parameters=model.parameters()),
+        loss=paddle.nn.MSELoss())
+    ds = TensorDataset([paddle.randn([8, 4]), paddle.randn([8, 1])])
+    model.fit(ds, batch_size=4, epochs=1, verbose=0,
+              callbacks=[LRScheduler(by_step=True)])
+    assert sched.last_lr < 0.1
