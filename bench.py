@@ -1,8 +1,14 @@
-"""Benchmark: ResNet-50 / CIFAR-10 training throughput (BASELINE.json config 1).
+"""Benchmark driver. Default: ResNet-50 / CIFAR-10 training throughput
+(BASELINE.json config 1). ``BENCH_MODEL=llama`` benches the flagship
+Llama train step (tokens/sec).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` is null — the reference mount is empty and BASELINE.json
 records no published numbers (SURVEY.md §6); this run IS the baseline.
+
+``BENCH_AMP=1`` (default on TPU) uses the reference's AMP-O2 recipe mapped
+to TPU: fp32 master params, bf16 compute (cast at step entry) — the MXU's
+native dtype.
 """
 from __future__ import annotations
 
@@ -12,7 +18,13 @@ import sys
 import time
 
 
-def main():
+def _amp_enabled():
+    import jax
+    default = "1" if jax.default_backend() == "tpu" else "0"
+    return os.environ.get("BENCH_AMP", default) == "1"
+
+
+def bench_resnet():
     import jax
     import jax.numpy as jnp
 
@@ -22,6 +34,7 @@ def main():
 
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
+    amp = _amp_enabled()
 
     paddle.seed(0)
     model = resnet50(num_classes=10)
@@ -31,24 +44,25 @@ def main():
     b_arrs = fm.buffer_arrays()
     key = fm.next_key()
 
-    x = jnp.ones((batch, 3, 32, 32), jnp.float32)
+    x = jnp.ones((batch, 3, 32, 32),
+                 jnp.bfloat16 if amp else jnp.float32)
     y = jnp.zeros((batch,), jnp.int32)
 
     def train_step(p_arrs, b_arrs, key, x, y):
         def loss_fn(ps):
-            logits, new_b = fm(ps, b_arrs, key, x)
+            cps = [a.astype(jnp.bfloat16) if amp and a.dtype == jnp.float32
+                   else a for a in ps]
+            logits, new_b = fm(cps, b_arrs, key, x)
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             loss = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
             return loss, new_b
 
         (loss, new_b), grads = jax.value_and_grad(loss_fn, has_aux=True)(p_arrs)
-        new_p = [p - 0.05 * g for p, g in zip(p_arrs, grads)]
+        new_p = [p - 0.05 * g.astype(p.dtype) for p, g in zip(p_arrs, grads)]
         return loss, new_p, new_b
 
     step = jax.jit(train_step, donate_argnums=(0, 1))
-
-    # warmup / compile
-    loss, p_arrs, b_arrs = step(p_arrs, b_arrs, key, x, y)
+    loss, p_arrs, b_arrs = step(p_arrs, b_arrs, key, x, y)   # compile
     loss.block_until_ready()
 
     t0 = time.perf_counter()
@@ -56,14 +70,77 @@ def main():
         loss, p_arrs, b_arrs = step(p_arrs, b_arrs, key, x, y)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
-
-    ips = batch * steps / dt
-    print(json.dumps({
+    return {
         "metric": "resnet50_cifar10_train_throughput",
-        "value": round(ips, 2),
+        "value": round(batch * steps / dt, 2),
         "unit": "images/sec",
         "vs_baseline": None,
-    }))
+    }
+
+
+def bench_llama():
+    """Flagship single-chip Llama train-step bench (tokens/sec); exercises
+    the Pallas flash-attention path + AMP master weights."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.framework.functional import FunctionalModule
+
+    batch = int(os.environ.get("BENCH_BATCH", "4"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    amp = _amp_enabled()
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                      intermediate_size=2816, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=max(2048, seq))
+    model = LlamaForCausalLM(cfg)
+    model.train()
+    fm = FunctionalModule(model, training=True)
+    p_arrs = fm.param_arrays()
+    key = fm.next_key()
+    import numpy as np
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+
+    def train_step(p_arrs, key, ids, labels):
+        def loss_fn(ps):
+            cps = [a.astype(jnp.bfloat16) if amp and a.dtype == jnp.float32
+                   else a for a in ps]
+            (loss, _), _ = fm(cps, [], key, ids, labels=labels)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(p_arrs)
+        new_p = [p - 1e-4 * g.astype(p.dtype) for p, g in zip(p_arrs, grads)]
+        return loss, new_p
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    loss, p_arrs = step(p_arrs, key, ids, labels)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, p_arrs = step(p_arrs, key, ids, labels)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "llama_1b_train_tokens_per_sec",
+        "value": round(batch * seq * steps / dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+    }
+
+
+def main():
+    mode = os.environ.get("BENCH_MODEL", "resnet")
+    out = bench_llama() if mode == "llama" else bench_resnet()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -121,7 +121,9 @@ class EarlyStopping(Callback):
         if mode == "auto":
             mode = "max" if "acc" in monitor else "min"
         self.mode = mode
-        self.best = None
+        # reference semantics: baseline seeds `best` — the metric must beat
+        # it within `patience` evals or training stops
+        self.best = baseline
         self.wait = 0
         self.stop_training = False
         self.save_dir = None
@@ -175,15 +177,22 @@ class LRScheduler(Callback):
 
 
 class LogWriterCallback(Callback):
-    """JSONL metrics writer (VisualDL stand-in)."""
+    """JSONL metrics writer (VisualDL stand-in). File opens lazily on
+    train begin so one instance survives multiple fit() calls."""
 
     def __init__(self, log_dir="./vdl_log"):
         super().__init__()
         self.log_dir = log_dir
-        os.makedirs(log_dir, exist_ok=True)
-        self._f = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        self._f = None
+
+    def on_train_begin(self, logs=None):
+        if self._f is None or self._f.closed:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._f = open(os.path.join(self.log_dir, "metrics.jsonl"), "a")
 
     def on_train_batch_end(self, step, logs=None):
+        if self._f is None or self._f.closed:
+            return
         rec = {"step": step}
         for k, v in (logs or {}).items():
             try:
@@ -194,4 +203,5 @@ class LogWriterCallback(Callback):
         self._f.flush()
 
     def on_train_end(self, logs=None):
-        self._f.close()
+        if self._f is not None and not self._f.closed:
+            self._f.close()

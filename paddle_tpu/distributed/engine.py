@@ -1,0 +1,98 @@
+"""Jitted SPMD pipeline engine (reference: the 1F1B / interleaved schedules
+of ``python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py`` +
+the p2p activation exchange in ``pp_utils/p2p_communication.py``; SURVEY.md
+§2.3 "PP", §3.4, §7.1 M4, §7.3 item 2).
+
+TPU-native design: instead of per-rank processes exchanging tensors with
+``batch_isend_irecv``, the whole pipeline is ONE jitted SPMD program over the
+'pp' mesh axis:
+
+* every stage's weights are the same pytree stacked on a leading axis,
+  sharded ``P('pp')`` — each device holds its stage's slice;
+* a ``lax.scan`` over ``n_micro + n_stages - 1`` ticks runs the classic
+  skewed schedule: at tick ``t`` the device at stage ``s`` works on
+  microbatch ``t - s`` (masked during the bubble), then hands its activation
+  to stage ``s+1`` with ``lax.ppermute`` — the ICI neighbor exchange;
+* the backward pass is ``jax.grad`` through the scan: the transpose of
+  ``ppermute`` is the reverse rotation, so XLA derives the cooldown
+  backward schedule and overlaps transfers with compute automatically.
+
+Constraint (same as the reference's p2p tensor-meta contract): every stage
+maps activations to the same shape/dtype. Bubble fraction matches 1F1B:
+``(S-1) / (M + S-1)`` for S stages, M microbatches.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp"):
+    """Per-device pipelined runner (call inside shard_map over ``axis_name``).
+
+    ``stage_fn(stage_params, x) -> y`` applies ONE stage (y.shape == x.shape).
+    The returned ``run(stacked_params, micro_inputs)`` expects the local pp
+    shard of the [S, ...]-stacked params (leading dim 1) and replicated
+    ``micro_inputs`` [M, mb, ...]; it returns the last stage's outputs
+    [M, mb, ...], broadcast to every pp rank.
+    """
+
+    def run(stacked_params, micro_inputs):
+        params = jax.tree.map(lambda a: a[0], stacked_params)
+        stage = jax.lax.axis_index(axis_name)
+        m = micro_inputs.shape[0]
+        ticks = m + n_stages - 1
+        perm = [(i, i + 1) for i in range(n_stages - 1)]
+        act_shape = micro_inputs.shape[1:]
+        act_dtype = micro_inputs.dtype
+        is_last = stage == n_stages - 1
+
+        def tick(carry, t):
+            recv, out_buf = carry
+            idx = t - stage                     # my microbatch this tick
+            active = jnp.logical_and(idx >= 0, idx < m)
+            feed = micro_inputs[jnp.clip(t, 0, m - 1)]
+            x = jnp.where(stage == 0, feed, recv)
+            y = stage_fn(params, x)
+            y = jnp.where(active, y, jnp.zeros_like(y))
+            slot = jnp.clip(idx, 0, m - 1)
+            write = jnp.logical_and(active, is_last)
+            out_buf = jnp.where(write, out_buf.at[slot].set(y), out_buf)
+            recv_next = jax.lax.ppermute(y, axis_name, perm)
+            return (recv_next, out_buf), None
+
+        out_buf = jnp.zeros((m,) + act_shape, act_dtype)
+        recv0 = jnp.zeros(act_shape, act_dtype)
+        (_, out_buf), _ = jax.lax.scan(tick, (recv0, out_buf),
+                                       jnp.arange(ticks))
+        # only the last stage wrote non-zeros; broadcast across pp ranks
+        return jax.lax.psum(out_buf, axis_name)
+
+    return run
+
+
+def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
+                     axis_name="pp", n_stages=None):
+    """Pipelined forward over the global mesh's pp axis (differentiable,
+    jit-compatible).
+
+    ``stacked_params``: pytree, leaves stacked [S, ...] (stage dim first).
+    ``micro_inputs``: [M, mb, ...].
+    """
+    from . import mesh as mesh_mod
+    mesh = mesh or mesh_mod.get_mesh()
+    n_stages = n_stages or int(mesh.shape[axis_name])
+    if n_stages == 1:
+        params = jax.tree.map(lambda a: a[0], stacked_params)
+        return jax.vmap(lambda x: stage_fn(params, x))(micro_inputs)
+    n_micro = int(micro_inputs.shape[0])
+    run = pipeline_spmd(stage_fn, n_stages, n_micro, axis_name)
+    p_specs = jax.tree.map(lambda a: P(axis_name), stacked_params)
+    mapped = jax.shard_map(
+        run, mesh=mesh, in_specs=(p_specs, P()), out_specs=P(),
+        axis_names={axis_name}, check_vma=False)
+    # axes outside axis_name stay in "auto" sharding mode, which shard_map
+    # only supports under jit — so compile here; callers' outer jit still
+    # fuses through (nested jit is inlined)
+    return jax.jit(mapped)(stacked_params, micro_inputs)

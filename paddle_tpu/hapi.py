@@ -81,8 +81,13 @@ class Model:
             cbs.on_epoch_begin(epoch, {})
             t0 = time.time()
             logs = {}
+            have_cbs = bool(cbs.callbacks)
+            from .callbacks import ProgBarLogger
+            own_print = verbose and not any(
+                isinstance(c, ProgBarLogger) for c in cbs.callbacks)
             for step, batch in enumerate(loader):
-                cbs.on_train_batch_begin(step, {})
+                if have_cbs:
+                    cbs.on_train_batch_begin(step, {})
                 x, y = self._unpack(batch)
                 out = self.network(x)
                 loss = self._loss(out, y) if self._loss else out
@@ -93,15 +98,20 @@ class Model:
                 for m in self._metrics:
                     m.update(m.compute(out, y))
                 it += 1
-                logs = {"loss": float(loss.numpy())}
-                logs.update({m.name(): m.accumulate() for m in self._metrics})
-                cbs.on_train_batch_end(step, logs)
-                if verbose and step % log_freq == 0:
+                # logs force a device sync (loss.numpy()) — only when someone
+                # consumes them, to keep async dispatch pipelined on TPU
+                if have_cbs:
+                    logs = {"loss": float(loss.numpy())}
+                    logs.update({m.name(): m.accumulate()
+                                 for m in self._metrics})
+                    cbs.on_train_batch_end(step, logs)
+                if own_print and step % log_freq == 0:
                     metr = {m.name(): m.accumulate() for m in self._metrics}
                     print(f"Epoch {epoch + 1}/{epochs} step {step} "
                           f"loss: {float(loss.numpy()):.4f} {metr} "
                           f"({(time.time() - t0) / (step + 1):.3f}s/step)")
                 if num_iters is not None and it >= num_iters:
+                    cbs.on_epoch_end(epoch, logs)
                     cbs.on_train_end(logs)
                     return
             cbs.on_epoch_end(epoch, logs)
