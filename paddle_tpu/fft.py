@@ -1,0 +1,74 @@
+"""paddle.fft (reference: ``python/paddle/fft.py`` — FFT API over phi fft
+kernels (cuFFT/pocketfft); SURVEY.md §2.2 tensor-ops surface).
+
+TPU-native: ``jnp.fft`` lowers to XLA's FFT HLO. All functions are
+differentiable through the tape.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .autograd.tape import apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return {"backward": "backward", "forward": "forward", "ortho": "ortho",
+            None: "backward"}[norm]
+
+
+def _wrap1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)), x,
+                     op_name=jfn.__name__)
+    return op
+
+
+def _wrapn(jfn, axes_default=None):
+    def op(x, s=None, axes=axes_default, norm="backward", name=None):
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x,
+                     op_name=jfn.__name__)
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+fft2 = _wrapn(jnp.fft.fft2, (-2, -1))
+ifft2 = _wrapn(jnp.fft.ifft2, (-2, -1))
+rfft2 = _wrapn(jnp.fft.rfft2, (-2, -1))
+irfft2 = _wrapn(jnp.fft.irfft2, (-2, -1))
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .framework.core import Tensor
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                 op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                 op_name="ifftshift")

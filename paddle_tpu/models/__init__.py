@@ -15,6 +15,8 @@ from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b, gpt_tiny
 from .bert import (BertConfig, BertModel, BertForSequenceClassification,
                    BertForPretraining, ErnieConfig, ErnieModel,
                    ErnieForSequenceClassification, bert_base, bert_tiny)
+from .ppyoloe import (PPYOLOE, DetectionLoss, ppyoloe_lite, CSPBackbone,
+                      FPNNeck, ETHead)
 
 __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
@@ -23,4 +25,6 @@ __all__ = [
     "BertConfig", "BertModel", "BertForSequenceClassification",
     "BertForPretraining", "ErnieConfig", "ErnieModel",
     "ErnieForSequenceClassification", "bert_base", "bert_tiny",
+    "PPYOLOE", "DetectionLoss", "ppyoloe_lite", "CSPBackbone", "FPNNeck",
+    "ETHead",
 ]

@@ -1,0 +1,128 @@
+"""paddle.signal (reference: ``python/paddle/signal.py`` — stft/istft over
+frame + fft ops; SURVEY.md §2.2). TPU-native: framing is a gather (XLA
+batches it); FFT is the XLA FFT HLO."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .autograd.tape import apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames: [..., seq] -> [..., frame_length, n]
+    (axis=-1; reference layout)."""
+    def fn(a):
+        n = (a.shape[axis] - frame_length) // hop_length + 1
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [n, fl]
+        out = jnp.take(a, idx, axis=axis)            # [..., n, fl]
+        return jnp.swapaxes(out, -1, -2)             # [..., fl, n]
+
+    return apply(fn, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, n] -> [..., seq]."""
+    def fn(a):
+        fl, n = a.shape[-2], a.shape[-1]
+        seq = (n - 1) * hop_length + fl
+        frames = jnp.moveaxis(a, -1, 0)              # [n, ..., fl]
+        out = jnp.zeros(a.shape[:-2] + (seq,), a.dtype)
+
+        def body(i, acc):
+            start = i * hop_length
+            pad = jnp.zeros_like(acc)
+            seg = jax.lax.dynamic_update_slice_in_dim(
+                pad, frames[i], start, axis=-1)
+            return acc + seg
+
+        return jax.lax.fori_loop(0, n, body, out)
+
+    return apply(fn, x, op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform; returns [..., n_fft//2+1, frames]
+    complex (onesided default, reference semantics)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(a, *w):
+        x = a
+        if center:
+            pads = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            x = jnp.pad(x, pads, mode=pad_mode)
+        n = (x.shape[-1] - n_fft) // hop_length + 1
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = jnp.take(x, idx, axis=-1)           # [..., n, n_fft]
+        if w:
+            win = w[0]
+            if win_length < n_fft:
+                lpad = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+            frames = frames * win
+        sp = (jnp.fft.rfft(frames, axis=-1) if onesided
+              else jnp.fft.fft(frames, axis=-1))     # [..., n, bins]
+        if normalized:
+            sp = sp / jnp.sqrt(jnp.asarray(n_fft, sp.real.dtype))
+        return jnp.swapaxes(sp, -1, -2)              # [..., bins, n]
+
+    args = (x,) + ((window,) if window is not None else ())
+    return apply(fn, *args, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def fn(sp, *w):
+        sp_t = jnp.swapaxes(sp, -1, -2)              # [..., n, bins]
+        if normalized:
+            sp_t = sp_t * jnp.sqrt(jnp.asarray(n_fft, sp_t.real.dtype))
+        frames = (jnp.fft.irfft(sp_t, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(sp_t, axis=-1).real)
+        if w:
+            win = w[0]
+            if win_length < n_fft:
+                lpad = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+        else:
+            win = jnp.ones((n_fft,), frames.dtype)
+        frames = frames * win
+        n = frames.shape[-2]
+        seq = (n - 1) * hop_length + n_fft
+        shape = frames.shape[:-2] + (seq,)
+        num = jnp.zeros(shape, frames.dtype)
+        den = jnp.zeros((seq,), frames.dtype)
+        fmoved = jnp.moveaxis(frames, -2, 0)         # [n, ..., n_fft]
+        wsq = win * win
+
+        def body(i, carry):
+            num, den = carry
+            start = i * hop_length
+            zn = jnp.zeros_like(num)
+            num = num + jax.lax.dynamic_update_slice_in_dim(
+                zn, fmoved[i], start, axis=-1)
+            zd = jnp.zeros_like(den)
+            den = den + jax.lax.dynamic_update_slice_in_dim(
+                zd, wsq, start, axis=-1)
+            return num, den
+
+        num, den = jax.lax.fori_loop(0, n, body, (num, den))
+        out = num / jnp.maximum(den, 1e-10)
+        if center:
+            out = out[..., n_fft // 2: out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = (x,) + ((window,) if window is not None else ())
+    return apply(fn, *args, op_name="istft")

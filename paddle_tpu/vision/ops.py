@@ -1,0 +1,206 @@
+"""paddle.vision.ops (reference: ``python/paddle/vision/ops.py`` — nms,
+box coders, roi_align, yolo post-processing over phi kernels; SURVEY.md §2.2,
+§2.4 config 3 "PP-YOLOE").
+
+TPU-native notes: NMS is inherently sequential; XLA-friendly form is the
+fixed-iteration suppression loop (lax.fori_loop over a static max-box count)
+so the op jits with static shapes. roi_align uses bilinear gather — XLA
+batches the gathers.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..autograd.tape import apply
+
+__all__ = ["nms", "box_area", "box_iou", "distance2bbox", "roi_align",
+           "yolo_box", "generate_proposals", "box_coder"]
+
+
+def box_area(boxes):
+    def fn(b):
+        return (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return apply(fn, boxes, op_name="box_area")
+
+
+def _iou_matrix(a, b):
+    """a [N,4], b [M,4] xyxy → [N,M] IoU (pure jnp)."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    return apply(_iou_matrix, boxes1, boxes2, op_name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS. Returns kept indices sorted by descending score
+    (reference contract). Category-aware when category_idxs given."""
+    b = boxes._data if isinstance(boxes, Tensor) else jnp.asarray(boxes)
+    n = b.shape[0]
+    s = (scores._data if isinstance(scores, Tensor)
+         else jnp.asarray(scores)) if scores is not None \
+        else jnp.arange(n, 0, -1, dtype=jnp.float32)
+    order = jnp.argsort(-s)
+    bs = b[order]
+    iou = _iou_matrix(bs, bs)
+    if category_idxs is not None:
+        c = (category_idxs._data if isinstance(category_idxs, Tensor)
+             else jnp.asarray(category_idxs))[order]
+        same = c[:, None] == c[None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    # fixed-iteration suppression in sorted space: box i is kept unless a
+    # higher-scored kept box overlaps it above the threshold
+    def body(i, keep):
+        sup = jnp.logical_and(keep, iou[:, i] > iou_threshold)
+        sup = jnp.logical_and(sup, jnp.arange(n) < i)   # only earlier boxes
+        return keep.at[i].set(~jnp.any(sup))
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # output length is data-dependent → extract indices host-side (eager op,
+    # reference contract returns a variable-length index tensor)
+    import numpy as np
+    keep_np = np.asarray(jax.device_get(keep))
+    order_np = np.asarray(jax.device_get(order))
+    idx = order_np[keep_np]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return Tensor(idx.astype("int64"))
+
+
+def distance2bbox(points, distance, max_shapes=None):
+    """Anchor-free head decode (PP-YOLOE): points [..., 2] + ltrb distances
+    [..., 4] → xyxy boxes."""
+    def fn(p, d):
+        x1 = p[..., 0] - d[..., 0]
+        y1 = p[..., 1] - d[..., 1]
+        x2 = p[..., 0] + d[..., 2]
+        y2 = p[..., 1] + d[..., 3]
+        out = jnp.stack([x1, y1, x2, y2], -1)
+        if max_shapes is not None:
+            h, w = max_shapes[0], max_shapes[1]
+            out = jnp.stack([jnp.clip(out[..., 0], 0, w),
+                             jnp.clip(out[..., 1], 0, h),
+                             jnp.clip(out[..., 2], 0, w),
+                             jnp.clip(out[..., 3], 0, h)], -1)
+        return out
+
+    return apply(fn, points, distance, op_name="distance2bbox")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign: x [N,C,H,W], boxes [R,4] xyxy (in image coords), boxes_num
+    [N] rois per image. Output [R, C, out_h, out_w]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def fn(feat, rois, rois_num):
+        n, c, h, w = feat.shape
+        r = rois.shape[0]
+        # image index per roi from boxes_num
+        img_idx = jnp.repeat(jnp.arange(n), rois_num, total_repeat_length=r)
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: oh*ow bins × sr×sr points per bin, bilinear each
+        ys = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :]
+              * rh[:, None] / (oh * sr))                       # [R, oh*sr]
+        xs = (x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :]
+              * rw[:, None] / (ow * sr))                       # [R, ow*sr]
+
+        def bilinear(img, yy, xx):
+            # img [C,H,W]; yy [P], xx [Q] → [C,P,Q]
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1)
+            x1i = jnp.clip(x0 + 1, 0, w - 1)
+            wy = jnp.clip(yy, 0, h - 1) - y0
+            wx = jnp.clip(xx, 0, w - 1) - x0
+            v00 = img[:, y0][:, :, x0]
+            v01 = img[:, y0][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0]
+            v11 = img[:, y1i][:, :, x1i]
+            return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                    + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                    + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                    + v11 * wy[None, :, None] * wx[None, None, :])
+
+        def per_roi(i):
+            img = feat[img_idx[i]]
+            vals = bilinear(img, ys[i], xs[i])       # [C, oh*sr, ow*sr]
+            vals = vals.reshape(c, oh, sr, ow, sr)
+            return vals.mean(axis=(2, 4))            # [C, oh, ow]
+
+        return jax.vmap(per_roi)(jnp.arange(r))
+
+    return apply(fn, x, boxes, boxes_num, op_name="roi_align")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLO head output [N, A*(5+C), H, W] into boxes+scores
+    (reference yolo_box semantics, simplified: returns (boxes, scores))."""
+    na = len(anchors) // 2
+
+    def fn(p, imgs):
+        n, _, h, w = p.shape
+        p = p.reshape(n, na, 5 + class_num, h, w)
+        gx = (jnp.arange(w)[None, None, None, :] + 0.5 * (scale_x_y - 1)
+              + jax.nn.sigmoid(p[:, :, 0]) * scale_x_y) / w
+        gy = (jnp.arange(h)[None, None, :, None] + 0.5 * (scale_x_y - 1)
+              + jax.nn.sigmoid(p[:, :, 1]) * scale_x_y) / h
+        aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+        bw = jnp.exp(p[:, :, 2]) * aw / (w * downsample_ratio)
+        bh = jnp.exp(p[:, :, 3]) * ah / (h * downsample_ratio)
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:])
+        scores = conf[:, :, None] * cls              # [n, a, C, h, w]
+        imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (gx - bw / 2) * imw
+        y1 = (gy - bh / 2) * imh
+        x2 = (gx + bw / 2) * imw
+        y2 = (gy + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(n, -1, 4)
+        scores = scores.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+        mask = scores.max(-1, keepdims=True) >= conf_thresh
+        scores = jnp.where(mask, scores, 0.0)
+        return boxes, scores
+
+    return apply(fn, x, img_size, op_name="yolo_box")
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    raise NotImplementedError("box_coder: use distance2bbox / yolo_box "
+                              "decoders in the TPU build")
+
+
+def generate_proposals(*a, **kw):
+    raise NotImplementedError("RPN generate_proposals is two-stage-detector "
+                              "specific; the TPU build ships anchor-free "
+                              "decode (distance2bbox) + nms")

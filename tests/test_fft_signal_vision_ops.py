@@ -1,0 +1,125 @@
+"""paddle.fft / paddle.signal / paddle.vision.ops / PPYOLOE tests
+(SURVEY.md §2.2 surface + §2.4 config 3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def test_fft_roundtrip_and_grad():
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(4, 16)).astype(np.float32), stop_gradient=False)
+    sp = paddle.fft.rfft(x)
+    assert sp.shape == [4, 9]
+    back = paddle.fft.irfft(sp, n=16)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-5)
+    back.sum().backward()
+    assert x.grad is not None
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((4, 16)), atol=1e-5)
+
+
+def test_fft_2d_and_shift():
+    x = paddle.randn([3, 8, 8])
+    sp = paddle.fft.fft2(x)
+    rec = paddle.fft.ifft2(sp)
+    np.testing.assert_allclose(rec.numpy().real, x.numpy(), atol=1e-5)
+    f = paddle.fft.fftfreq(8)
+    sh = paddle.fft.fftshift(f)
+    assert float(sh.numpy()[0]) == pytest.approx(-0.5)
+
+
+def test_stft_istft_roundtrip():
+    sig = paddle.to_tensor(np.random.default_rng(1).normal(
+        size=(2, 256)).astype(np.float32))
+    win = paddle.to_tensor(np.hanning(64).astype(np.float32))
+    sp = paddle.signal.stft(sig, n_fft=64, hop_length=16, window=win)
+    assert sp.shape[1] == 33            # onesided bins
+    rec = paddle.signal.istft(sp, n_fft=64, hop_length=16, window=win,
+                              length=256)
+    np.testing.assert_allclose(rec.numpy(), sig.numpy(), atol=1e-4)
+
+
+def test_frame_overlap_add():
+    x = paddle.to_tensor(np.arange(10, dtype=np.float32))
+    fr = paddle.signal.frame(x, frame_length=4, hop_length=2)
+    assert fr.shape == [4, 4]           # 4 frames of length 4
+    np.testing.assert_allclose(fr.numpy()[:, 0], [0, 1, 2, 3])
+    back = paddle.signal.overlap_add(fr, hop_length=2)
+    # positions covered by two frames are summed
+    assert back.shape == [10]
+    np.testing.assert_allclose(back.numpy()[0], 0.0)
+
+
+def test_nms_and_box_iou():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    keep = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                    scores=paddle.to_tensor(scores))
+    np.testing.assert_array_equal(np.sort(keep.numpy()), [0, 2])
+    iou = vops.box_iou(paddle.to_tensor(boxes), paddle.to_tensor(boxes))
+    np.testing.assert_allclose(np.diag(iou.numpy()), 1.0, atol=1e-6)
+    # category-aware: same boxes, different classes -> both kept
+    keep2 = vops.nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                     scores=paddle.to_tensor(scores),
+                     category_idxs=paddle.to_tensor(
+                         np.array([0, 1, 0], np.int64)))
+    assert len(keep2.numpy()) == 3
+
+
+def test_roi_align_shape_and_values():
+    # constant feature map -> every roi bin equals the constant
+    x = paddle.to_tensor(np.full((1, 2, 8, 8), 3.0, np.float32))
+    boxes = paddle.to_tensor(np.array([[0, 0, 4, 4], [2, 2, 6, 6]],
+                                      np.float32))
+    num = paddle.to_tensor(np.array([2], np.int32))
+    out = vops.roi_align(x, boxes, num, output_size=2, spatial_scale=1.0)
+    assert out.shape == [2, 2, 2, 2]
+    np.testing.assert_allclose(out.numpy(), 3.0, atol=1e-5)
+
+
+def test_distance2bbox():
+    pts = paddle.to_tensor(np.array([[10.0, 10.0]], np.float32))
+    dist = paddle.to_tensor(np.array([[2.0, 3.0, 4.0, 5.0]], np.float32))
+    out = vops.distance2bbox(pts, dist)
+    np.testing.assert_allclose(out.numpy(), [[8, 7, 14, 15]])
+
+
+def test_ppyoloe_forward_train_predict():
+    from paddle_tpu.models import ppyoloe_lite, DetectionLoss
+    paddle.seed(0)
+    model = ppyoloe_lite(num_classes=4)
+    x = paddle.randn([2, 3, 64, 64])
+    cls_outs, reg_outs = model(x)
+    assert len(cls_outs) == 3
+    assert cls_outs[0].shape == [2, 4, 8, 8]       # stride 8
+    assert reg_outs[2].shape == [2, 4, 2, 2]       # stride 32
+
+    # decode shapes
+    scores, boxes = model.decode(cls_outs, reg_outs)
+    p = 8 * 8 + 4 * 4 + 2 * 2
+    assert scores.shape == [2, p, 4] and boxes.shape == [2, p, 4]
+
+    # one training step decreases loss on dense targets
+    loss_fn = DetectionLoss()
+    tcls = [paddle.zeros(c.shape) for c in cls_outs]
+    treg = [paddle.ones(r.shape) for r in reg_outs]
+    mask = [paddle.ones(r.shape) for r in reg_outs]
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+    losses = []
+    for _ in range(3):
+        cls_outs, reg_outs = model(x)
+        loss = loss_fn(cls_outs, reg_outs, tcls, treg, mask)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    # post-processing runs end-to-end
+    dets = model.predict(x, score_thresh=0.0, top_k=5)
+    assert len(dets) == 2
+    assert dets[0]["boxes"].shape[1] == 4
+    assert len(dets[0]["scores"]) <= 5
