@@ -52,6 +52,8 @@ from . import onnx  # noqa: F401
 from . import utils  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from . import text  # noqa: F401
+from . import audio  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .nn.layer import Layer  # noqa: F401
 from .hapi import Model, summary  # noqa: F401

@@ -1,0 +1,157 @@
+"""paddle.audio (reference: ``python/paddle/audio/`` — Spectrogram /
+MelSpectrogram / LogMelSpectrogram / MFCC features over the fft ops;
+SURVEY.md §2.2). TPU-native: stft → XLA FFT; mel filterbank is a matmul."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..autograd.tape import apply
+from .. import signal as psignal
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]
+
+
+def hz_to_mel(f, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+    f = np.asarray(f, dtype=np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mel = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    safe = np.maximum(f, 1e-10)       # where() evaluates both branches
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(safe / min_log_hz) / logstep, mel)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    mel = np.asarray(mel, dtype=np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(mel >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (mel - min_log_mel)), freqs)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """Mel filterbank [n_mels, n_fft//2+1] (numpy; a constant)."""
+    f_max = f_max or sr / 2
+    n_bins = n_fft // 2 + 1
+    fft_freqs = np.linspace(0, sr / 2, n_bins)
+    mel_pts = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                          n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    fb = np.zeros((n_mels, n_bins))
+    for m in range(n_mels):
+        lo, ctr, hi = hz_pts[m], hz_pts[m + 1], hz_pts[m + 2]
+        up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+        down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+        fb[m] = np.clip(np.minimum(up, down), 0, None)
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb *= enorm[:, None]
+    return fb.astype(np.float32)
+
+
+class functional:
+    hz_to_mel = staticmethod(hz_to_mel)
+    mel_to_hz = staticmethod(mel_to_hz)
+    compute_fbank_matrix = staticmethod(compute_fbank_matrix)
+
+    @staticmethod
+    def create_dct(n_mfcc, n_mels, norm="ortho"):
+        n = np.arange(n_mels)
+        k = np.arange(n_mfcc)[:, None]
+        dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+        if norm == "ortho":
+            dct[0] *= 1.0 / math.sqrt(2)
+            dct *= math.sqrt(2.0 / n_mels)
+        return dct.astype(np.float32)
+
+
+class Spectrogram:
+    """Power spectrogram via stft: [..., n_fft//2+1, frames]."""
+
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect"):
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = np.hanning(self.win_length) if window == "hann" \
+            else np.hamming(self.win_length) if window == "hamming" \
+            else np.ones(self.win_length)
+        self.window = Tensor(w.astype(np.float32))
+
+    def __call__(self, x):
+        sp = psignal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                          window=self.window, center=self.center,
+                          pad_mode=self.pad_mode)
+        power = self.power
+        return apply(lambda s: jnp.abs(s) ** power, sp, op_name="spec_power")
+
+
+class MelSpectrogram(Spectrogram):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney"):
+        super().__init__(n_fft, hop_length, win_length, window, power,
+                         center, pad_mode)
+        self.fbank = Tensor(compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+    def __call__(self, x):
+        spec = super().__call__(x)                    # [..., bins, frames]
+        return apply(lambda s, fb: jnp.einsum("mf,...ft->...mt", fb, s),
+                     spec, self.fbank, op_name="mel_spec")
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *a, ref_value=1.0, amin=1e-10, top_db=None, **kw):
+        super().__init__(*a, **kw)
+        self.amin = amin
+        self.ref_value = ref_value
+        self.top_db = top_db
+
+    def __call__(self, x):
+        mel = super().__call__(x)
+
+        def fn(m):
+            db = 10.0 * jnp.log10(jnp.maximum(m, self.amin))
+            db = db - 10.0 * math.log10(max(self.amin, self.ref_value))
+            if self.top_db is not None:
+                db = jnp.maximum(db, db.max() - self.top_db)
+            return db
+
+        return apply(fn, mel, op_name="log_mel")
+
+
+class MFCC:
+    def __init__(self, sr=22050, n_mfcc=40, n_mels=64, **kw):
+        self.logmel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **kw)
+        self.dct = Tensor(functional.create_dct(n_mfcc, n_mels))
+
+    def __call__(self, x):
+        lm = self.logmel(x)
+        return apply(lambda m, d: jnp.einsum("km,...mt->...kt", d, m),
+                     lm, self.dct, op_name="mfcc")
+
+
+class features:
+    Spectrogram = Spectrogram
+    MelSpectrogram = MelSpectrogram
+    LogMelSpectrogram = LogMelSpectrogram
+    MFCC = MFCC

@@ -5,6 +5,8 @@ from __future__ import annotations
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import autograd  # noqa: F401
+from . import asp  # noqa: F401
 from ..distributed.fleet.utils import recompute as _recompute  # noqa: F401
 
 

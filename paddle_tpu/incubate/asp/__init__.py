@@ -1,0 +1,83 @@
+"""paddle.incubate.asp — 2:4 structured sparsity (reference:
+``python/paddle/incubate/asp/`` — mask generation + pruning for Ampere
+sparse tensor cores; SURVEY.md §2.2 "Incubate").
+
+TPU note: TPUs have no 2:4 sparse MXU mode, so ASP here provides the
+*training-side* semantics — mask computation (n:m along the reduction dim),
+pruning, and mask maintenance after optimizer steps — producing checkpoints
+that are valid 2:4-sparse for deployment elsewhere; compute runs dense.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.core import Tensor
+from ...autograd.tape import no_grad
+
+__all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
+           "reset_excluded_layers", "set_excluded_layers"]
+
+_excluded = set()
+_masks = {}          # id(param) -> (param, np mask)
+
+
+def calculate_density(x):
+    arr = x.numpy() if isinstance(x, Tensor) else np.asarray(x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def create_mask(tensor, func_name="mask_2d_best", n=2, m=4):
+    """n:m mask along the last dim (keep the n largest of every m)."""
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor)
+                     else tensor)
+    flat = np.abs(arr).reshape(-1, m) if arr.size % m == 0 else None
+    if flat is None:
+        return np.ones_like(arr, dtype=bool)
+    keep = np.argsort(-flat, axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, keep, True, axis=1)
+    return mask.reshape(arr.shape)
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def _prunable(model):
+    for name, p in model.named_parameters():
+        if p is None or name in _excluded or p.ndim < 2:
+            continue
+        if p.shape[-1] % 4 == 0:
+            yield name, p
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_2d_best", with_mask=True):
+    """Apply n:m pruning to eligible weights; stores masks for maintenance."""
+    out = {}
+    with no_grad():
+        for name, p in _prunable(model):
+            mask = create_mask(p, mask_algo, n, m)
+            _masks[id(p)] = (p, mask)
+            out[name] = mask
+            p.set_value(p.numpy() * mask)
+    return out
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update (reference
+    ``asp.decorate`` keeps pruned weights at zero through training)."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        if _masks:
+            with no_grad():
+                for p, mask in _masks.values():
+                    p.set_value(p.numpy() * mask)
+
+    optimizer.step = step
+    return optimizer

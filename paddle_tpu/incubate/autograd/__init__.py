@@ -1,0 +1,133 @@
+"""paddle.incubate.autograd (reference: ``python/paddle/incubate/autograd/``
+— forward-mode jvp, vjp, Jacobian, Hessian via the prim/composite-op
+machinery; SURVEY.md §2.1 "Prim/composite ops", §2.2 "Incubate").
+
+TPU-native: the reference needed a whole primitive-op decomposition layer to
+get higher-order AD; JAX has it natively — jvp/jacfwd/jacrev/hessian compose
+with the eager Tensor layer by lifting the user's Tensor-function to a pure
+array function.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...autograd.tape import no_grad
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad"]
+
+
+def _lift(func):
+    """Tensor-function -> pure array function."""
+
+    def pure(*arrs):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrs])
+        return jax.tree.map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+
+    return pure
+
+
+def _arrs(xs):
+    xs = xs if isinstance(xs, (list, tuple)) else [xs]
+    return [x._data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]
+
+
+def _wrap(out):
+    return jax.tree.map(lambda a: Tensor(a), out)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (func(xs), J·v) (reference contract)."""
+    primals = _arrs(xs)
+    tangents = _arrs(v) if v is not None else [jnp.ones_like(a)
+                                               for a in primals]
+    out, tang = jax.jvp(_lift(func), primals, tangents)
+    return _wrap(out), _wrap(tang)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (func(xs), vᵀ·J)."""
+    primals = _arrs(xs)
+    out, f_vjp = jax.vjp(_lift(func), *primals)
+    if v is None:
+        cot = jax.tree.map(jnp.ones_like, out)
+    else:
+        cot = _arrs(v)
+        cot = cot[0] if not isinstance(out, (tuple, list)) else tuple(cot)
+    grads = f_vjp(cot)
+    return _wrap(out), _wrap(list(grads))
+
+
+def forward_grad(func, xs, v=None):
+    return jvp(func, xs, v)[1]
+
+
+def grad(func, xs):
+    """First-order gradient of a scalar Tensor-function."""
+    primals = _arrs(xs)
+    g = jax.grad(lambda *a: _lift(func)(*a), argnums=tuple(
+        range(len(primals))))(*primals)
+    out = _wrap(list(g))
+    return out if len(primals) > 1 else out[0]
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (reference paddle.incubate.autograd.Jacobian):
+    index like J[:] / J[i, j]; shape [out_numel, in_numel] for single x."""
+
+    def __init__(self, func, xs, is_batched=False):
+        primals = _arrs(xs)
+        assert len(primals) == 1, "Jacobian supports a single xs tensor"
+        self._x = primals[0]
+        jac = jax.jacrev(_lift(func))(self._x)
+        if is_batched:
+            # [B, out..., B, in...] batched semantics not materialized;
+            # reference batches over dim 0: take the diagonal over batch
+            b = self._x.shape[0]
+            out_shape = jac.shape[:jac.ndim - self._x.ndim]
+            jacb = jac.reshape(b, -1, b, int(jnp.prod(
+                jnp.asarray(self._x.shape[1:]))))
+            idx = jnp.arange(b)
+            self._m = jacb[idx, :, idx, :]
+        else:
+            out_n = 1
+            for d in jac.shape[:jac.ndim - self._x.ndim]:
+                out_n *= d
+            self._m = jac.reshape(out_n, self._x.size)
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._m[idx])
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._m)
+
+
+class Hessian:
+    """Dense Hessian of a scalar func at xs: [numel, numel]."""
+
+    def __init__(self, func, xs, is_batched=False):
+        primals = _arrs(xs)
+        assert len(primals) == 1, "Hessian supports a single xs tensor"
+        x = primals[0]
+        h = jax.hessian(lambda a: jnp.sum(_lift(func)(a)))(x)
+        self._m = h.reshape(x.size, x.size)
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    def __getitem__(self, idx):
+        return Tensor(self._m[idx])
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self._m)

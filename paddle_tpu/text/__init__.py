@@ -1,0 +1,91 @@
+"""paddle.text (reference: ``python/paddle/text/`` — dataset loaders +
+``ViterbiDecoder``; SURVEY.md §2.2 "Metrics/text/audio").
+
+Datasets that require downloads are out of the zero-egress build (they raise
+with the cache path, like paddle.utils.download); the compute pieces —
+Viterbi decoding for CRF-style sequence labeling — are implemented TPU-style
+with a ``lax.scan`` over time steps (static shapes, vectorized over batch).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..autograd.tape import apply
+
+__all__ = ["ViterbiDecoder", "viterbi_decode"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """Viterbi decoding: potentials [B, T, N] emission scores, transition
+    [N(+2), N(+2)] (+2 = BOS/EOS rows when include_bos_eos_tag). Returns
+    (scores [B], paths [B, T]) — reference ``viterbi_decode`` contract.
+    """
+
+    def fn(emis, trans, *rest):
+        b, t, n = emis.shape
+        lens = rest[0] if rest else jnp.full((b,), t, jnp.int32)
+        if include_bos_eos_tag:
+            # rows/cols n..n+1 are BOS/EOS; strip to the N real tags with
+            # start scores = trans[BOS, :N], stop scores = trans[:N, EOS]
+            start = trans[n, :n]
+            stop = trans[:n, n + 1]
+            trans_core = trans[:n, :n]
+        else:
+            start = jnp.zeros((n,), emis.dtype)
+            stop = jnp.zeros((n,), emis.dtype)
+            trans_core = trans
+
+        alpha0 = emis[:, 0] + start[None, :]                  # [B, N]
+
+        def step(carry, et):
+            alpha, tstep = carry
+            e, = et
+            # scores[b, i, j] = alpha[b, i] + trans[i, j]
+            scores = alpha[:, :, None] + trans_core[None]
+            best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+            new_alpha = jnp.max(scores, axis=1) + e           # [B, N]
+            # positions past each sequence's length keep old alpha
+            active = (tstep < lens)[:, None]
+            new_alpha = jnp.where(active, new_alpha, alpha)
+            return (new_alpha, tstep + 1), best_prev
+
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (alpha0, jnp.ones((b,), jnp.int32)),
+            (jnp.moveaxis(emis[:, 1:], 1, 0),))
+        alpha = alpha + stop[None, :]
+        last_tag = jnp.argmax(alpha, axis=-1)                 # [B]
+        score = jnp.max(alpha, axis=-1)
+
+        # backtrack (scan in reverse over backptrs)
+        def back(carry, bp_t):
+            tag, tstep = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            # only move while within the sequence
+            active = tstep < lens
+            tag = jnp.where(active, prev, tag)
+            return (tag, tstep - 1), tag
+
+        (first_tag, _), path_rev = jax.lax.scan(
+            back, (last_tag, jnp.full((b,), t - 1, jnp.int32)),
+            backptrs, reverse=True)
+        path = jnp.concatenate([path_rev, last_tag[None]], axis=0)
+        return score, jnp.moveaxis(path, 0, 1).astype(jnp.int64)
+
+    args = (potentials, transition_params) + \
+        ((lengths,) if lengths is not None else ())
+    return apply(fn, *args, op_name="viterbi_decode")
+
+
+class ViterbiDecoder:
+    """paddle.text.ViterbiDecoder layer form."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

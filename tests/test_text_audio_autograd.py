@@ -1,0 +1,133 @@
+"""paddle.text (Viterbi), paddle.audio (spectrograms), incubate.autograd
+(jvp/Jacobian/Hessian), incubate.asp tests (SURVEY.md §2.2)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+from paddle_tpu.audio import (Spectrogram, MelSpectrogram, LogMelSpectrogram,
+                              MFCC, functional as AF)
+from paddle_tpu.incubate import autograd as iag
+from paddle_tpu.incubate import asp
+
+
+# -- text -------------------------------------------------------------------
+
+def _brute_viterbi(emis, trans, start, stop):
+    t, n = emis.shape
+    best, best_path = None, None
+    import itertools
+    for path in itertools.product(range(n), repeat=t):
+        s = start[path[0]] + emis[0, path[0]]
+        for i in range(1, t):
+            s += trans[path[i - 1], path[i]] + emis[i, path[i]]
+        s += stop[path[-1]]
+        if best is None or s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+def test_viterbi_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    n, t = 3, 4
+    emis = rng.normal(size=(1, t, n)).astype(np.float32)
+    trans_full = rng.normal(size=(n + 2, n + 2)).astype(np.float32)
+    score, path = viterbi_decode(paddle.to_tensor(emis),
+                                 paddle.to_tensor(trans_full))
+    ref_score, ref_path = _brute_viterbi(
+        emis[0], trans_full[:n, :n], trans_full[n, :n],
+        trans_full[:n, n + 1])
+    assert float(score.numpy()[0]) == pytest.approx(ref_score, abs=1e-5)
+    np.testing.assert_array_equal(path.numpy()[0], ref_path)
+
+
+def test_viterbi_decoder_layer_no_bos():
+    rng = np.random.default_rng(1)
+    emis = rng.normal(size=(2, 5, 4)).astype(np.float32)
+    trans = rng.normal(size=(4, 4)).astype(np.float32)
+    dec = ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=False)
+    score, path = dec(paddle.to_tensor(emis))
+    assert score.shape == [2] and path.shape == [2, 5]
+    assert path.numpy().min() >= 0 and path.numpy().max() < 4
+
+
+# -- audio ------------------------------------------------------------------
+
+def test_spectrogram_pure_tone():
+    sr, n_fft = 1000, 128
+    t = np.arange(sr) / sr
+    freq = 125.0                        # exactly bin 16 of 128 @ sr 1000
+    sig = np.sin(2 * np.pi * freq * t).astype(np.float32)
+    spec = Spectrogram(n_fft=n_fft, hop_length=64)(
+        paddle.to_tensor(sig[None]))
+    s = spec.numpy()[0]                 # [bins, frames]
+    peak_bin = s.mean(-1).argmax()
+    assert peak_bin == round(freq * n_fft / sr)
+
+
+def test_mel_and_logmel_and_mfcc_shapes():
+    sig = paddle.to_tensor(np.random.default_rng(2).normal(
+        size=(2, 2048)).astype(np.float32))
+    mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=32)(sig)
+    assert mel.shape[0] == 2 and mel.shape[1] == 32
+    logmel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32)(sig)
+    assert logmel.shape == mel.shape
+    mfcc = MFCC(sr=8000, n_mfcc=13, n_mels=32, n_fft=256)(sig)
+    assert mfcc.shape[1] == 13
+    fb = AF.compute_fbank_matrix(8000, 256, 32)
+    assert fb.shape == (32, 129)
+    assert (fb >= 0).all()
+
+
+# -- incubate.autograd ------------------------------------------------------
+
+def test_jvp_vjp_consistency():
+    def f(x):
+        return (x ** 2).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    v = paddle.to_tensor(np.array([1.0, 0.0, 0.0], np.float32))
+    out, tang = iag.jvp(f, x, v)
+    assert float(out.numpy()) == pytest.approx(14.0)
+    assert float(tang.numpy()) == pytest.approx(2.0)   # d/dx1 = 2*x1*v1
+
+    out2, grads = iag.vjp(f, x)
+    np.testing.assert_allclose(grads[0].numpy(), [2.0, 4.0, 6.0])
+
+
+def test_jacobian_hessian():
+    def f(x):
+        return x * x
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    J = iag.Jacobian(f, x)
+    np.testing.assert_allclose(J[:].numpy(), [[2.0, 0.0], [0.0, 4.0]])
+
+    def g(x):
+        return (x ** 3).sum()
+
+    H = iag.Hessian(g, x)
+    np.testing.assert_allclose(H[:].numpy(), [[6.0, 0.0], [0.0, 12.0]])
+
+
+# -- incubate.asp -----------------------------------------------------------
+
+def test_asp_prune_and_maintain():
+    paddle.seed(0)
+    model = paddle.nn.Linear(8, 8)
+    masks = asp.prune_model(model)
+    assert masks
+    w = model.weight.numpy()
+    assert asp.calculate_density(model.weight) == pytest.approx(0.5)
+    # 2:4 pattern: every group of 4 along last dim has exactly 2 nonzeros
+    groups = (w.reshape(-1, 4) != 0).sum(1)
+    assert (groups == 2).all()
+
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    loss = (model(paddle.randn([4, 8])) ** 2).mean()
+    loss.backward()
+    opt.step()
+    w2 = model.weight.numpy()
+    assert ((w2 != 0) == (w != 0)).all()   # sparsity pattern preserved
+    asp._masks.clear()
