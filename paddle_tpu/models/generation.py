@@ -1,0 +1,115 @@
+"""Autoregressive generation (reference behavior: PaddleNLP
+``GenerationMixin.generate`` — greedy/sampling decode with KV cache; core
+Paddle contributes the fused attention + cache kernels, SURVEY.md §2.4 note
+on PaddleNLP being a separate repo → in-repo equivalent).
+
+TPU notes: the eager cache is concat-grown (simple, correct); the compiled
+serving path would preallocate [b, max_len, h, d] rings and use the Pallas
+decode kernel — follow-up on the inference milestone.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..autograd.tape import no_grad
+from ..framework import random as prandom
+
+__all__ = ["KVCache", "GenerationMixin"]
+
+
+class KVCache:
+    """Per-attention-layer concat cache. ``update`` returns the full K/V so
+    far (including the new tokens); ``pos`` is the filled length, advanced
+    once per model forward."""
+
+    def __init__(self):
+        self.pos = 0
+        self._store = {}
+
+    def update(self, layer, k_new, v_new):
+        from ..ops import manipulation as manip
+        key = id(layer)
+        if key in self._store:
+            k_old, v_old = self._store[key]
+            k = manip.concat([k_old, k_new], axis=1)
+            v = manip.concat([v_old, v_new], axis=1)
+        else:
+            k, v = k_new, v_new
+        self._store[key] = (k.detach(), v.detach())
+        return k, v
+
+    def advance(self, s):
+        self.pos += int(s)
+
+    def reset(self):
+        self.pos = 0
+        self._store.clear()
+
+
+def _sample_logits(logits, do_sample, top_k, top_p, temperature):
+    """logits [b, V] (jnp) -> token ids [b] (jnp)."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / max(temperature, 1e-6)
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -int(top_k)][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jnp.cumsum(
+            jnp.exp(sorted_l - jnp.max(sorted_l, -1, keepdims=True)) /
+            jnp.sum(jnp.exp(sorted_l - jnp.max(sorted_l, -1, keepdims=True)),
+                    -1, keepdims=True), axis=-1)
+        cutoff_idx = jnp.sum(probs < top_p, axis=-1)
+        kth = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    import jax
+    key = prandom.next_key()
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+class GenerationMixin:
+    """Adds ``generate`` to causal-LM models whose forward accepts
+    ``cache=`` (``supports_cache=True``) or recomputes otherwise."""
+
+    supports_cache = False
+
+    @no_grad()
+    def generate(self, input_ids, max_new_tokens=32, max_length=None,
+                 do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
+                 eos_token_id=None, **kw):
+        """Returns generated ids [b, prompt + new] (prompt included,
+        reference decode contract)."""
+        was_training = self.training
+        self.eval()
+        try:
+            ids = input_ids if isinstance(input_ids, Tensor) \
+                else Tensor(np.asarray(input_ids, np.int64))
+            if max_length is not None:
+                max_new_tokens = max(max_length - ids.shape[1], 0)
+            cache = KVCache() if self.supports_cache else None
+            cur = ids
+            all_ids = ids._data
+            finished = jnp.zeros((ids.shape[0],), bool)
+            for step in range(max_new_tokens):
+                logits = self.forward(cur, cache=cache) \
+                    if cache is not None else self.forward(
+                        Tensor(all_ids))
+                lg = logits._data[:, -1].astype(jnp.float32)
+                nxt = _sample_logits(lg, do_sample, top_k, top_p,
+                                     temperature).astype(all_ids.dtype)
+                if eos_token_id is not None:
+                    nxt = jnp.where(finished,
+                                    jnp.asarray(eos_token_id, nxt.dtype),
+                                    nxt)
+                    finished = jnp.logical_or(finished, nxt == eos_token_id)
+                all_ids = jnp.concatenate([all_ids, nxt[:, None]], axis=1)
+                cur = Tensor(nxt[:, None])
+                if eos_token_id is not None and bool(finished.all()):
+                    break
+            return Tensor(all_ids)
+        finally:
+            if was_training:
+                self.train()

@@ -19,6 +19,7 @@ from ..nn import functional as F
 from ..nn.initializer import Normal
 from ..ops import math as pmath
 from .llama import LlamaPretrainingCriterion
+from .generation import GenerationMixin
 
 
 class GPTConfig:
@@ -136,7 +137,7 @@ class GPTModel(Layer):
         return self.final_norm(hidden)
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(GenerationMixin, Layer):
     """Tied lm_head (logits = hidden @ word_embeddings.T) — the reference's
     ``SharedLayerDesc`` tied-embedding case in pipeline mode."""
 

@@ -23,6 +23,7 @@ from ..nn.initializer import Normal
 from ..ops import fused as fused_ops
 from ..ops import math as pmath
 from ..autograd.tape import apply
+from .generation import GenerationMixin
 
 
 class LlamaConfig:
@@ -112,16 +113,28 @@ class LlamaAttention(Layer):
         self._cos, self._sin = fused_ops.rope_freqs(
             self.head_dim, config.max_position_embeddings, config.rope_theta)
 
-    def forward(self, hidden, attn_mask=None, position_ids=None):
+    def forward(self, hidden, attn_mask=None, position_ids=None, cache=None):
+        from ..ops import manipulation as manip
         b, s, _ = hidden.shape
         q = self.q_proj(hidden).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(hidden).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if cache is not None and position_ids is None:
+            # raw jnp: consumed as a closure constant by the rope op
+            position_ids = jnp.arange(cache.pos, cache.pos + s,
+                                      dtype=jnp.int32)
         q, k, _ = fused_ops.fused_rotary_position_embedding(
             q, k, sin=self._sin, cos=self._cos, position_ids=position_ids)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
-            training=self.training)
+        if cache is not None:
+            # decode: append new K/V, attend over the filled prefix
+            k, v = cache.update(self, k, v)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=None, is_causal=True,
+                training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
+                training=self.training)
         return self.o_proj(out.reshape([b, s, self.num_heads * self.head_dim]))
 
 
@@ -134,9 +147,9 @@ class LlamaDecoderLayer(Layer):
         self.post_attention_layernorm = RMSNorm(config.hidden_size,
                                                 config.rms_norm_eps)
 
-    def forward(self, hidden, attn_mask=None, position_ids=None):
+    def forward(self, hidden, attn_mask=None, position_ids=None, cache=None):
         hidden = hidden + self.self_attn(self.input_layernorm(hidden),
-                                         attn_mask, position_ids)
+                                         attn_mask, position_ids, cache)
         return hidden + self.mlp(self.post_attention_layernorm(hidden))
 
 
@@ -151,11 +164,15 @@ class LlamaModel(Layer):
             [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None, position_ids=None):
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                cache=None):
         hidden = self.embed_tokens(input_ids)
         for layer in self.layers:
-            hidden = layer(hidden, attn_mask, position_ids)
-        return self.norm(hidden)
+            hidden = layer(hidden, attn_mask, position_ids, cache)
+        hidden = self.norm(hidden)
+        if cache is not None:
+            cache.advance(input_ids.shape[1])
+        return hidden
 
 
 class LlamaPretrainingCriterion(Layer):
@@ -183,7 +200,9 @@ class LlamaPretrainingCriterion(Layer):
         return apply(fn, logits, labels, op_name="causal_lm_loss")
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(GenerationMixin, Layer):
+    supports_cache = True
+
     def __init__(self, config):
         super().__init__()
         self.config = config
@@ -197,8 +216,8 @@ class LlamaForCausalLM(Layer):
         self.criterion = LlamaPretrainingCriterion()
 
     def forward(self, input_ids, labels=None, attn_mask=None,
-                position_ids=None):
-        hidden = self.llama(input_ids, attn_mask, position_ids)
+                position_ids=None, cache=None):
+        hidden = self.llama(input_ids, attn_mask, position_ids, cache)
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:

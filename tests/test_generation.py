@@ -1,0 +1,71 @@
+"""KV-cache generation tests (in-repo PaddleNLP-equivalent decode;
+SURVEY.md §2.4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny, GPTForCausalLM, gpt_tiny
+from paddle_tpu.models.generation import KVCache
+
+
+def _ids(b=2, s=5, vocab=128, seed=0):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).integers(0, vocab, (b, s)), "int64")
+
+
+def test_cached_matches_uncached_greedy():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    ids = _ids()
+    out_cached = model.generate(ids, max_new_tokens=6)
+
+    model.supports_cache = False          # force full-recompute path
+    out_full = model.generate(ids, max_new_tokens=6)
+    model.supports_cache = True
+    np.testing.assert_array_equal(out_cached.numpy(), out_full.numpy())
+    assert out_cached.shape == [2, 11]
+    # prompt is preserved
+    np.testing.assert_array_equal(out_cached.numpy()[:, :5], ids.numpy())
+
+
+def test_cache_incremental_forward_matches_full():
+    """Prefill + 1-token decode logits == full forward logits."""
+    paddle.seed(1)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    ids = _ids(b=1, s=6, seed=3)
+    full_logits = model(ids).numpy()
+
+    cache = KVCache()
+    pre = model(paddle.to_tensor(ids.numpy()[:, :5]), cache=cache)
+    step = model(paddle.to_tensor(ids.numpy()[:, 5:6]), cache=cache)
+    np.testing.assert_allclose(step.numpy()[:, 0], full_logits[:, 5],
+                               rtol=1e-4, atol=1e-4)
+    assert cache.pos == 6
+
+
+def test_sampling_and_eos():
+    paddle.seed(2)
+    model = LlamaForCausalLM(llama_tiny())
+    ids = _ids(b=2, s=3, seed=5)
+    out = model.generate(ids, max_new_tokens=5, do_sample=True, top_k=10,
+                         temperature=0.8)
+    assert out.shape == [2, 8]
+    v = model.config.vocab_size
+    assert out.numpy().min() >= 0 and out.numpy().max() < v
+
+    # eos stops generation (force eos = whatever greedy produces first)
+    g = model.generate(ids, max_new_tokens=1)
+    eos = int(g.numpy()[0, -1])
+    out2 = model.generate(ids, max_new_tokens=8, eos_token_id=eos)
+    # batch row 0 hit eos on step 1 → all later tokens are eos
+    row = out2.numpy()[0, 3:]
+    assert row[0] == eos
+
+
+def test_gpt_generate_recompute_path():
+    paddle.seed(3)
+    model = GPTForCausalLM(gpt_tiny())
+    ids = _ids(b=1, s=4, vocab=model.config.vocab_size, seed=7)
+    out = model.generate(ids, max_new_tokens=3)
+    assert out.shape == [1, 7]
