@@ -30,6 +30,9 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 
+# pass registry (paddle.distributed.passes)
+from . import passes  # noqa: F401
+
 # semi-auto parallelism (paddle.distributed.auto_parallel + top-level API)
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401

@@ -1,0 +1,159 @@
+"""Distributed passes (reference: ``python/paddle/distributed/passes/`` — a
+registry of program-rewriting passes for the auto-parallel static engine:
+``auto_parallel_amp``, ``auto_parallel_recompute``, ``auto_parallel_sharding``,
+``pipeline_scheduler_pass`` (FThenB/1F1B/VPP/ZBH1), fuse-allreduce;
+SURVEY.md §2.3 "Distributed passes" + "Static-mode meta-optimizers").
+
+TPU-native framing: the reference's passes rewrite a serialized Program's op
+list (insert cast ops, recompute subgraphs, comm ops). Here compilation is
+XLA's job, so a "pass" transforms the declarative *plan* — the strategy/
+sharding decisions a train step is built from — and the XLA lowering
+realizes it. Several reference passes are XLA built-ins and their pass
+objects document that (apply = no-op with a note): fused allreduce ≡ XLA
+collective combining; fuse-adamw ≡ XLA op fusion.
+"""
+from __future__ import annotations
+
+_PASS_REGISTRY = {}
+
+
+def register_pass(name):
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def new_pass(name, attrs=None):
+    try:
+        cls = _PASS_REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown pass {name!r}; available: "
+                         f"{sorted(_PASS_REGISTRY)}")
+    return cls(attrs or {})
+
+
+class PassBase:
+    """A pass transforms a plan dict (strategy + shardings + step options).
+    ``apply(plan)`` returns the updated plan; ``check`` validates."""
+
+    name = "base"
+
+    def __init__(self, attrs=None):
+        self.attrs = dict(attrs or {})
+
+    def check(self, plan):
+        return True
+
+    def apply(self, plan, *a, **kw):
+        return plan
+
+
+class PassManager:
+    def __init__(self, passes=None):
+        self.passes = list(passes or [])
+
+    def append(self, p):
+        self.passes.append(p)
+
+    def apply(self, plan=None, *a, **kw):
+        plan = dict(plan or {})
+        for p in self.passes:
+            if p.check(plan):
+                plan = p.apply(plan)
+        return plan
+
+    @property
+    def names(self):
+        return [p.name for p in self.passes]
+
+
+@register_pass("auto_parallel_amp")
+class AMPPass(PassBase):
+    """Sets the step's compute dtype policy (O1 lists / O2 bf16 + master
+    weights) — realized by the amp cast hook, not inserted cast ops."""
+
+    def apply(self, plan, *a, **kw):
+        plan["amp"] = {"level": self.attrs.get("level", "O2"),
+                       "dtype": self.attrs.get("dtype", "bfloat16"),
+                       "master_weights": True}
+        return plan
+
+
+@register_pass("auto_parallel_fp16")
+class FP16Pass(AMPPass):
+    def apply(self, plan, *a, **kw):
+        plan = super().apply(plan)
+        plan["amp"]["dtype"] = "float16"
+        return plan
+
+
+@register_pass("auto_parallel_recompute")
+class RecomputePass(PassBase):
+    """Marks layer groups for jax.checkpoint (the reference rewrites the
+    backward block; XLA rematerializes instead)."""
+
+    def apply(self, plan, *a, **kw):
+        plan["recompute"] = {
+            "enable": True,
+            "granularity": self.attrs.get("granularity", "full"),
+            "no_recompute_segments": self.attrs.get(
+                "no_recompute_segments", []),
+        }
+        return plan
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """Sets the ZeRO stage realized as parameter/opt-state PartitionSpecs on
+    the 'sharding' mesh axis."""
+
+    def apply(self, plan, *a, **kw):
+        plan["sharding"] = {"stage": int(self.attrs.get("stage", 2)),
+                            "degree": self.attrs.get("degree", None)}
+        return plan
+
+
+@register_pass("pipeline_scheduler")
+class PipelineSchedulerPass(PassBase):
+    """Selects the microbatch schedule. The SPMD engine's scan schedule
+    (distributed/engine.py) realizes FThenB/1F1B identically (XLA overlaps);
+    VPP maps to stacking virtual stages on the stage axis."""
+
+    SCHEDULES = ("FThenB", "1F1B", "VPP", "ZBH1")
+
+    def check(self, plan):
+        mode = self.attrs.get("schedule_mode", "1F1B")
+        if mode not in self.SCHEDULES:
+            raise ValueError(f"unknown pipeline schedule {mode}")
+        return True
+
+    def apply(self, plan, *a, **kw):
+        plan["pipeline"] = {
+            "schedule_mode": self.attrs.get("schedule_mode", "1F1B"),
+            "accumulate_steps": int(self.attrs.get("accumulate_steps", 1)),
+            "vpp_degree": int(self.attrs.get("vpp_degree", 1)),
+        }
+        return plan
+
+
+@register_pass("fuse_all_reduce")
+class FuseAllReducePass(PassBase):
+    """XLA built-in (collective combining); kept for API parity."""
+
+    def apply(self, plan, *a, **kw):
+        plan.setdefault("notes", []).append(
+            "fuse_all_reduce: XLA combines collectives automatically "
+            "(--xla_tpu_enable_async_collective_fusion)")
+        return plan
+
+
+@register_pass("fused_adamw")
+class FusedAdamWPass(PassBase):
+    """XLA built-in (op fusion of the update chain); kept for API parity."""
+
+    def apply(self, plan, *a, **kw):
+        plan.setdefault("notes", []).append(
+            "fused_adamw: XLA fuses the elementwise update chain")
+        return plan

@@ -1,0 +1,44 @@
+"""Distributed pass registry tests (SURVEY.md §2.3 'Distributed passes')."""
+import pytest
+
+from paddle_tpu.distributed.passes import (
+    new_pass, PassManager, PassBase, register_pass,
+)
+
+
+def test_registry_and_manager():
+    pm = PassManager([
+        new_pass("auto_parallel_amp", {"level": "O2"}),
+        new_pass("auto_parallel_recompute", {"granularity": "full"}),
+        new_pass("auto_parallel_sharding", {"stage": 3}),
+        new_pass("pipeline_scheduler", {"schedule_mode": "1F1B",
+                                        "accumulate_steps": 8}),
+        new_pass("fuse_all_reduce"),
+    ])
+    assert "auto_parallel_amp" in pm.names
+    plan = pm.apply({})
+    assert plan["amp"]["dtype"] == "bfloat16"
+    assert plan["amp"]["master_weights"]
+    assert plan["recompute"]["enable"]
+    assert plan["sharding"]["stage"] == 3
+    assert plan["pipeline"]["accumulate_steps"] == 8
+    assert any("XLA" in n for n in plan["notes"])
+
+
+def test_unknown_pass_and_bad_schedule():
+    with pytest.raises(ValueError, match="unknown pass"):
+        new_pass("nope")
+    p = new_pass("pipeline_scheduler", {"schedule_mode": "bogus"})
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        p.check({})
+
+
+def test_custom_pass_registration():
+    @register_pass("my_test_pass")
+    class MyPass(PassBase):
+        def apply(self, plan, *a, **kw):
+            plan["custom"] = True
+            return plan
+
+    plan = PassManager([new_pass("my_test_pass")]).apply({})
+    assert plan["custom"]
