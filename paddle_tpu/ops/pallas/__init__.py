@@ -6,3 +6,4 @@ from .flash_attention import (  # noqa: F401
     flash_attention, flash_attention_with_lse, mha_reference,
 )
 from .ring_attention import ring_flash_attention  # noqa: F401
+from .quant_matmul import int8_matmul, quantize_weight  # noqa: F401

@@ -115,9 +115,25 @@ class QuantedLinear(Layer):
         self.inner = inner
         self.a_q = a_quanter._instance(inner) if a_quanter else None
         self.w_q = w_quanter._instance(inner) if w_quanter else None
+        self._converted = False          # set by convert(): int8 weight path
 
     def forward(self, x):
         from ..nn import functional as F
+        if self._converted and not self.training:
+            # weight-only int8 inference: Pallas kernel streams int8 weight
+            # tiles + dequantizes in VMEM (ops/pallas/quant_matmul.py)
+            from ..ops.pallas.quant_matmul import int8_matmul
+
+            def fn(a, w_q, s, *bias):
+                shape = a.shape
+                out = int8_matmul(a.reshape(-1, shape[-1]), w_q, s)
+                out = out.reshape(*shape[:-1], out.shape[-1])
+                return out + bias[0] if bias else out
+
+            args = (x, Tensor(self._w_int8), Tensor(self._w_scale))
+            if self.inner.bias is not None:
+                args = args + (self.inner.bias,)
+            return apply(fn, *args, op_name="int8_linear")
         if self.a_q is not None:
             x = self.a_q.quantize(x)
         w = self.inner.weight
@@ -192,12 +208,24 @@ class PTQ(QAT):
 
 
 def convert(model):
-    """Freeze: replace wrappers' weights with int8 + scale attributes
-    (simulated-int8 inference)."""
+    """Freeze: int8 weights + scales. Linear layers get per-output-channel
+    scales and route inference through the Pallas int8 matmul kernel;
+    Conv2D keeps per-tensor simulated int8."""
+    from ..ops.pallas.quant_matmul import quantize_weight
     for name, sub in list(model._sub_layers.items()):
         if sub is None:
             continue
-        if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+        if isinstance(sub, QuantedLinear):
+            w = sub.inner.weight
+            q, scale = quantize_weight(w._data)
+            sub._w_int8 = np.asarray(q)
+            sub._w_scale = np.asarray(scale)
+            sub._converted = True
+            # back-compat per-tensor attrs (test/inspection surface)
+            sub.int8_weight = sub._w_int8
+            sub.weight_scale = float(scale.max() * 127.0)
+            w._data = jnp.asarray(q, jnp.float32) * scale[None, :]
+        elif isinstance(sub, QuantedConv2D):
             w = sub.inner.weight
             scale = float(jnp.max(jnp.abs(w._data))) or 1.0
             qmax = 127.0

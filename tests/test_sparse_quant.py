@@ -118,5 +118,42 @@ def test_convert_int8():
     convert(qmodel)
     lin = qmodel._sub_layers["0"]
     assert lin.int8_weight.dtype == np.int8
-    deq = lin.int8_weight.astype(np.float32) * (lin.weight_scale / 127.0)
-    assert np.abs(deq - ref_w).max() <= lin.weight_scale / 127.0 + 1e-6
+    # per-output-channel dequant reconstructs within one quantum per channel
+    deq = lin._w_int8.astype(np.float32) * lin._w_scale[None, :]
+    assert (np.abs(deq - ref_w) <= lin._w_scale[None, :] + 1e-6).all()
+
+
+def test_int8_matmul_kernel():
+    from paddle_tpu.ops.pallas import int8_matmul, quantize_weight
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 192)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(192, 136)), jnp.float32)
+    q, scale = quantize_weight(w)
+    out = int8_matmul(x, q, scale, block_m=8, block_n=128, block_k=128,
+                      interpret=True)
+    ref = x @ (q.astype(jnp.float32) * scale[None, :])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    # quantization error itself bounded by one quantum per element pair
+    full = x @ w
+    err = np.abs(np.asarray(out) - np.asarray(full))
+    bound = (np.abs(np.asarray(x)) @ np.ones_like(np.asarray(w))) * \
+        np.asarray(scale)[None, :]
+    assert (err <= bound + 1e-4).all()
+
+
+def test_converted_linear_uses_int8_path():
+    paddle.seed(2)
+    model = paddle.nn.Sequential(paddle.nn.Linear(16, 8))
+    qmodel = PTQ(QuantConfig(activation=None,
+                             weight=AbsmaxObserver())).quantize(model)
+    convert(qmodel)
+    lin = qmodel._sub_layers["0"]
+    assert lin._converted
+    qmodel.eval()
+    x = paddle.randn([4, 16])
+    out = qmodel(x)                     # int8 pallas path (interpret on CPU)
+    ref = x.numpy() @ (lin._w_int8.astype(np.float32)
+                       * lin._w_scale[None, :]) + lin.inner.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
