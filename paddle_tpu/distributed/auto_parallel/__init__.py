@@ -28,7 +28,10 @@ from .. import mesh as mesh_mod
 __all__ = [
     "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
     "dtensor_from_fn", "reshard", "shard_optimizer", "get_mesh", "set_mesh",
+    "Engine",
 ]
+
+from .static_engine import Engine  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,131 @@
+"""Auto-parallel Engine (reference: ``python/paddle/distributed/
+auto_parallel/static/engine.py`` — ``Engine(model, loss, optimizer,
+strategy).fit/evaluate/predict/prepare``: completion propagates dist attrs,
+the partitioner emits per-rank programs; SURVEY.md §2.3 "Auto-parallel").
+
+TPU-native: "completion + partitioner" is the XLA SPMD partitioner. Engine
+builds ONE jitted sharded train step from the model's parameter placements
+(or its ``sharding_rules()``) over the global mesh, with donated buffers —
+the per-rank program emission happens inside XLA at compile time.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...framework.core import Tensor
+from ...framework.functional import FunctionalModule
+from .. import mesh as mesh_mod
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.strategy = strategy
+        self._step_fn = None
+        self._state = None
+
+    # -- build the sharded step --------------------------------------------
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        mesh = mesh_mod.get_mesh()
+        fm = FunctionalModule(self.model, training=(mode == "train"))
+        rules = getattr(type(self.model), "sharding_rules", None)
+        if rules is not None:
+            specs = fm.param_specs(rules())
+        else:
+            specs = [P() for _ in fm.params]
+        p_sh = [NamedSharding(mesh, s) for s in specs]
+        lr = 0.001
+        if self.optimizer is not None:
+            lr_attr = getattr(self.optimizer, "_learning_rate", 0.001)
+            lr = float(lr_attr() if callable(lr_attr) else lr_attr)
+        loss_layer = self.loss
+
+        p_arrs = [jax.device_put(a, s)
+                  for a, s in zip(fm.param_arrays(), p_sh)]
+        m_arrs = [jax.device_put(jnp.zeros_like(a), s)
+                  for a, s in zip(p_arrs, p_sh)]
+        v_arrs = [jax.device_put(jnp.zeros_like(a), s)
+                  for a, s in zip(p_arrs, p_sh)]
+        b_arrs = fm.buffer_arrays()      # frozen for the engine's step
+        self._state = {"fm": fm, "p": p_arrs, "m": m_arrs, "v": v_arrs,
+                       "t": 0, "mesh": mesh, "p_sh": p_sh, "b": b_arrs}
+        b1, b2, eps = 0.9, 0.999, 1e-8
+
+        def step(p_arrs, m_arrs, v_arrs, t, key, x, y):
+            def loss_fn(ps):
+                out, _ = fm(ps, b_arrs, key, x)
+                if loss_layer is not None:
+                    lo = loss_layer(Tensor(out) if not isinstance(out, Tensor)
+                                    else out, Tensor(y))
+                    return lo._data if isinstance(lo, Tensor) else lo
+                return out.mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(p_arrs)
+            t = t + 1
+            new_p, new_m, new_v = [], [], []
+            for pa, g, mm, vv in zip(p_arrs, grads, m_arrs, v_arrs):
+                mm = b1 * mm + (1 - b1) * g
+                vv = b2 * vv + (1 - b2) * g * g
+                mhat = mm / (1 - b1 ** t)
+                vhat = vv / (1 - b2 ** t)
+                new_p.append(pa - lr * mhat / (jnp.sqrt(vhat) + eps))
+                new_m.append(mm)
+                new_v.append(vv)
+            return loss, new_p, new_m, new_v, t
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self
+
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
+            valid_data=None, log_freq=10, verbose=0):
+        from ...io import DataLoader
+        if self._step_fn is None:
+            self.prepare()
+        st = self._state
+        loader = train_data if isinstance(train_data, DataLoader) \
+            else DataLoader(train_data, batch_size=batch_size or 8)
+        history = []
+        for epoch in range(epochs):
+            for i, batch in enumerate(loader):
+                x, y = batch[0], batch[1]
+                xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+                key = st["fm"].next_key()
+                loss, st["p"], st["m"], st["v"], st["t"] = self._step_fn(
+                    st["p"], st["m"], st["v"], st["t"], key, xa, ya)
+                history.append(float(loss))
+                if verbose and i % log_freq == 0:
+                    print(f"epoch {epoch} step {i} loss {history[-1]:.4f}")
+                if steps_per_epoch and i + 1 >= steps_per_epoch:
+                    break
+        # write trained params back into the eager model
+        self._sync_back()
+        return history
+
+    def _sync_back(self):
+        st = self._state
+        for p, a in zip(st["fm"].params, st["p"]):
+            p._data = a
+
+    def predict(self, x):
+        st = self._state
+        fm = FunctionalModule(self.model, training=False)
+        xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        out, _ = fm(st["p"], st["b"], fm.next_key(), xa)
+        return Tensor(out)
+
+    @property
+    def main_program(self):
+        """Lowered HLO text of the sharded step (Program analogue)."""
+        return "<jitted SPMD step; inspect via .lowered_text()>"
+
+    def lowered_text(self, *example_args):
+        if self._step_fn is None:
+            raise RuntimeError("call prepare() first")
+        return self._step_fn.lower(*example_args).as_text()

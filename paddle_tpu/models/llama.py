@@ -167,8 +167,17 @@ class LlamaModel(Layer):
     def forward(self, input_ids, attn_mask=None, position_ids=None,
                 cache=None):
         hidden = self.embed_tokens(input_ids)
+        recompute = (self.config.use_recompute and self.training
+                     and cache is None)
+        if recompute:
+            # per-layer remat (reference recompute_granularity='full'):
+            # under jit this wraps each decoder layer in jax.checkpoint
+            from ..distributed.fleet.utils import recompute as remat
         for layer in self.layers:
-            hidden = layer(hidden, attn_mask, position_ids, cache)
+            if recompute:
+                hidden = remat(layer, hidden, attn_mask, position_ids)
+            else:
+                hidden = layer(hidden, attn_mask, position_ids, cache)
         hidden = self.norm(hidden)
         if cache is not None:
             cache.advance(input_ids.shape[1])

@@ -1,0 +1,80 @@
+"""Auto-parallel Engine + Llama recompute tests."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.auto_parallel import Engine
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.framework.functional import FunctionalModule
+
+
+def test_engine_fit_linear():
+    mesh_mod.init_mesh({"dp": 8})
+    try:
+        paddle.seed(0)
+        model = paddle.nn.Linear(8, 4)
+        loss = paddle.nn.MSELoss()
+        opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=model.parameters())
+        eng = Engine(model=model, loss=loss, optimizer=opt)
+        eng.prepare()
+
+        from paddle_tpu.io import TensorDataset
+        x = paddle.randn([32, 8])
+        y = paddle.randn([32, 4])
+        ds = TensorDataset([x, y])
+        hist = eng.fit(ds, epochs=2, batch_size=16)
+        # two batches alternate; compare same-batch losses across epochs
+        assert hist[2] < hist[0] and hist[3] < hist[1]
+        # trained params synced back into the eager model
+        out = eng.predict(x)
+        eager_out = model(x)
+        np.testing.assert_allclose(out.numpy(), eager_out.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_engine_sharded_llama_step():
+    """Engine with a model exposing sharding_rules: params land sharded."""
+    mesh_mod.init_mesh({"dp": 4, "mp": 2})
+    try:
+        paddle.seed(1)
+        model = LlamaForCausalLM(llama_tiny())
+        eng = Engine(model=model,
+                     loss=None,
+                     optimizer=paddle.optimizer.AdamW(
+                         learning_rate=1e-3, parameters=model.parameters()))
+        eng.prepare()
+        from jax.sharding import PartitionSpec as P
+        sharded = [s.spec for s in eng._state["p_sh"]]
+        assert any(P("mp", None) == s or "mp" in str(s) for s in sharded)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_llama_recompute_same_loss_and_grads():
+    paddle.seed(2)
+    cfg_plain = llama_tiny(use_recompute=False)
+    model = LlamaForCausalLM(cfg_plain)
+    fm = FunctionalModule(model, training=True)
+    p = fm.param_arrays()
+    key = fm.next_key()
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+
+    def loss_fn(ps):
+        (loss, _), _ = fm(ps, [], key, ids, labels=labels)
+        return loss
+
+    l0, g0 = jax.jit(jax.value_and_grad(loss_fn))(p)
+
+    model.config.use_recompute = True    # same weights, remat on
+    l1, g1 = jax.jit(jax.value_and_grad(loss_fn))(p)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+    for a, b in zip(g0, g1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
