@@ -34,7 +34,7 @@ class LlamaConfig:
                  rope_theta=10000.0, initializer_range=0.02,
                  tie_word_embeddings=False, use_recompute=False,
                  recompute_granularity="full", sequence_parallel=False,
-                 dtype="float32", **kwargs):
+                 context_parallel=False, dtype="float32", **kwargs):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -49,6 +49,7 @@ class LlamaConfig:
         self.use_recompute = use_recompute
         self.recompute_granularity = recompute_granularity
         self.sequence_parallel = sequence_parallel
+        self.context_parallel = context_parallel
         self.dtype = dtype
         for k, v in kwargs.items():
             setattr(self, k, v)
@@ -113,6 +114,12 @@ class LlamaAttention(Layer):
         self._cos, self._sin = fused_ops.rope_freqs(
             self.head_dim, config.max_position_embeddings, config.rope_theta)
 
+    def _use_ring_attention(self):
+        if not getattr(self.config, "context_parallel", False):
+            return False
+        from ..distributed import mesh as mesh_mod
+        return mesh_mod.has_mesh() and mesh_mod.axis_size("sep") > 1
+
     def forward(self, hidden, attn_mask=None, position_ids=None, cache=None):
         from ..ops import manipulation as manip
         b, s, _ = hidden.shape
@@ -131,6 +138,11 @@ class LlamaAttention(Layer):
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=None, is_causal=True,
                 training=self.training)
+        elif self._use_ring_attention():
+            # context parallelism: seq dim sharded over 'sep', KV blocks
+            # rotate around the ring (SURVEY.md §5.7 mechanism 3)
+            from ..distributed.fleet.utils import ring_attention
+            out = ring_attention(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,
