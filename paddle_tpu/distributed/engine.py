@@ -72,22 +72,97 @@ def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp"):
     return run
 
 
+def pipeline_spmd_interleaved(stage_fn, n_stages, n_micro, vpp,
+                              axis_name="pp"):
+    """Interleaved (VPP) per-device runner — the reference
+    ``PipelineParallelWithInterleave``: L = S·v chunks, chunk c on device
+    c mod S; each tick every device runs its v chunks and the ring wraps
+    (S-1 → 0) carrying activations to the next virtual stage. Expects the
+    local param shard with leading dim v in *slot* order (slot k = chunk
+    ``stage + k·S``) — ``pipeline_forward`` pre-permutes.
+    """
+
+    def run(stacked_params, micro_inputs):
+        stage = jax.lax.axis_index(axis_name)
+        m = micro_inputs.shape[0]
+        chunks = n_stages * vpp
+        ticks = m + chunks - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+        act_shape = micro_inputs.shape[1:]
+        act_dtype = micro_inputs.dtype
+        is_last = stage == n_stages - 1
+
+        def tick(carry, t):
+            recv, out_buf = carry          # recv [v, ...]
+            outs = []
+            for k in range(vpp):
+                params_k = jax.tree.map(lambda a: a[k], stacked_params)
+                c = stage + k * n_stages   # my chunk id at slot k
+                idx = t - c
+                active = jnp.logical_and(idx >= 0, idx < m)
+                if k == 0:
+                    feed = micro_inputs[jnp.clip(t, 0, m - 1)]
+                    x = jnp.where(stage == 0, feed, recv[0])
+                else:
+                    x = recv[k]
+                y = stage_fn(params_k, x)
+                y = jnp.where(active, y, jnp.zeros_like(y))
+                if k == vpp - 1:
+                    slot = jnp.clip(idx, 0, m - 1)
+                    write = jnp.logical_and(active, is_last)
+                    out_buf = jnp.where(write, out_buf.at[slot].set(y),
+                                        out_buf)
+                outs.append(y)
+            sent = jax.lax.ppermute(jnp.stack(outs), axis_name, perm)
+            # ring wrap S-1 → 0 advances the virtual stage: on device 0,
+            # incoming slot k feeds chunk (k+1)·S, i.e. local slot k+1
+            shifted = jnp.concatenate(
+                [jnp.zeros((1,) + act_shape, act_dtype), sent[:-1]], axis=0)
+            recv_next = jnp.where(stage == 0, shifted, sent)
+            return (recv_next, out_buf), None
+
+        out_buf = jnp.zeros((m,) + act_shape, act_dtype)
+        recv0 = jnp.zeros((vpp,) + act_shape, act_dtype)
+        (_, out_buf), _ = jax.lax.scan(tick, (recv0, out_buf),
+                                       jnp.arange(ticks))
+        return jax.lax.psum(out_buf, axis_name)
+
+    return run
+
+
 def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
-                     axis_name="pp", n_stages=None):
+                     axis_name="pp", n_stages=None, vpp_degree=1):
     """Pipelined forward over the global mesh's pp axis (differentiable,
     jit-compatible).
 
-    ``stacked_params``: pytree, leaves stacked [S, ...] (stage dim first).
-    ``micro_inputs``: [M, mb, ...].
+    ``stacked_params``: pytree, leaves stacked [S·vpp, ...] in chunk order
+    (chunk = consecutive layer group). ``micro_inputs``: [M, mb, ...].
+    ``vpp_degree`` > 1 selects the interleaved (VPP) schedule.
     """
     from . import mesh as mesh_mod
     mesh = mesh or mesh_mod.get_mesh()
     n_stages = n_stages or int(mesh.shape[axis_name])
     if n_stages == 1:
-        params = jax.tree.map(lambda a: a[0], stacked_params)
-        return jax.vmap(lambda x: stage_fn(params, x))(micro_inputs)
+        def seq_all(x):
+            n_chunks = jax.tree.leaves(stacked_params)[0].shape[0]
+            for c in range(n_chunks):
+                x = stage_fn(jax.tree.map(lambda a: a[c], stacked_params), x)
+            return x
+        return jax.vmap(seq_all)(micro_inputs)
     n_micro = int(micro_inputs.shape[0])
-    run = pipeline_spmd(stage_fn, n_stages, n_micro, axis_name)
+    if vpp_degree > 1:
+        # chunk-major [c] → slot-major [(k, d) → d*v + k ... ]: device d's
+        # slot k must hold chunk d + k·S, and P('pp') splits contiguously,
+        # so global order becomes [d=0: chunks 0, S, 2S…; d=1: 1, S+1, …]
+        order = jnp.asarray([d + k * n_stages
+                             for d in range(n_stages)
+                             for k in range(vpp_degree)])
+        stacked_params = jax.tree.map(
+            lambda a: jnp.take(a, order, axis=0), stacked_params)
+        run = pipeline_spmd_interleaved(stage_fn, n_stages, n_micro,
+                                        vpp_degree, axis_name)
+    else:
+        run = pipeline_spmd(stage_fn, n_stages, n_micro, axis_name)
     p_specs = jax.tree.map(lambda a: P(axis_name), stacked_params)
     mapped = jax.shard_map(
         run, mesh=mesh, in_specs=(p_specs, P()), out_specs=P(),
