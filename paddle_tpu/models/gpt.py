@@ -71,11 +71,13 @@ class GPTAttention(Layer):
         self.out_proj = Linear(h, h, weight_attr=init)
         self.dropout_p = config.attention_probs_dropout_prob
 
-    def forward(self, hidden):
+    def forward(self, hidden, cache=None):
         b, s, h = hidden.shape
         qkv = self.qkv_proj(hidden).reshape(
             [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = (qkv[:, :, i] for i in range(3))
+        if cache is not None:
+            k, v = cache.update(self, k, v)
         out = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.dropout_p,
             training=self.training)
@@ -94,8 +96,9 @@ class GPTDecoderLayer(Layer):
         self.linear2 = Linear(config.intermediate_size, h, weight_attr=init)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, hidden):
-        hidden = hidden + self.dropout(self.self_attn(self.norm1(hidden)))
+    def forward(self, hidden, cache=None):
+        hidden = hidden + self.dropout(
+            self.self_attn(self.norm1(hidden), cache))
         ff = self.linear2(F.gelu(self.linear1(self.norm2(hidden)),
                                  approximate=True))
         return hidden + self.dropout(ff)
@@ -130,14 +133,24 @@ class GPTModel(Layer):
         self.final_norm = LayerNorm(config.hidden_size,
                                     config.layer_norm_epsilon)
 
-    def forward(self, input_ids, position_ids=None):
+    def forward(self, input_ids, position_ids=None, cache=None):
+        if cache is not None and position_ids is None:
+            from ..ops import creation as C
+            position_ids = C.arange(cache.pos,
+                                    cache.pos + input_ids.shape[1],
+                                    dtype="int64")
         hidden = self.embeddings(input_ids, position_ids)
         for layer in self.decoder:
-            hidden = layer(hidden)
-        return self.final_norm(hidden)
+            hidden = layer(hidden, cache)
+        hidden = self.final_norm(hidden)
+        if cache is not None:
+            cache.advance(input_ids.shape[1])
+        return hidden
 
 
 class GPTForCausalLM(GenerationMixin, Layer):
+    supports_cache = True
+
     """Tied lm_head (logits = hidden @ word_embeddings.T) — the reference's
     ``SharedLayerDesc`` tied-embedding case in pipeline mode."""
 
@@ -147,8 +160,9 @@ class GPTForCausalLM(GenerationMixin, Layer):
         self.gpt = GPTModel(config)
         self.criterion = LlamaPretrainingCriterion()
 
-    def forward(self, input_ids, labels=None, position_ids=None):
-        hidden = self.gpt(input_ids, position_ids)
+    def forward(self, input_ids, labels=None, position_ids=None,
+                cache=None):
+        hidden = self.gpt(input_ids, position_ids, cache)
         logits = pmath.matmul(
             hidden, self.gpt.embeddings.word_embeddings.weight,
             transpose_y=True)

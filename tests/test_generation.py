@@ -63,9 +63,13 @@ def test_sampling_and_eos():
     assert row[0] == eos
 
 
-def test_gpt_generate_recompute_path():
+def test_gpt_generate_cached_matches_uncached():
     paddle.seed(3)
     model = GPTForCausalLM(gpt_tiny())
     ids = _ids(b=1, s=4, vocab=model.config.vocab_size, seed=7)
     out = model.generate(ids, max_new_tokens=3)
     assert out.shape == [1, 7]
+    model.supports_cache = False
+    out_full = model.generate(ids, max_new_tokens=3)
+    model.supports_cache = True
+    np.testing.assert_array_equal(out.numpy(), out_full.numpy())
