@@ -54,7 +54,7 @@ def main():
         opt.clear_grad()
         losses.append(float(loss))
         print(f"step {step} loss {losses[-1]:.4f} "
-              f"(loss_scale {float(scaler._scale):.0f})")
+              f"(loss_scale {float(scaler.get_scale_ratio()):.0f})")
     assert losses[-1] < losses[0]
     print("done")
 

@@ -46,7 +46,8 @@ def parse():
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--seq", type=int, default=64)
     p.add_argument("--lr", type=float, default=1e-3)
-    p.add_argument("--amp", action="store_true", default=True)
+    p.add_argument("--amp", action=argparse.BooleanOptionalAction,
+                   default=True)
     p.add_argument("--ckpt_dir", default="/tmp/llama_pretrain_ckpt")
     return p.parse_args()
 
@@ -99,22 +100,35 @@ def main():
 
     step = jax.jit(train_step, donate_argnums=(0, 1, 2))
 
+    def _save(ckpt, i, p, m, v):
+        def host(arrs):
+            return [paddle.to_tensor(np.asarray(jax.device_get(a)))
+                    for a in arrs]
+        ckpt.save(i, {"p": host(p), "m": host(m), "v": host(v)})
+
     def train(start_step, state, ckpt):
         nonlocal p, m, v
         if state is not None:
-            for t, arr in zip(fm.params, state["p"]):
-                t.set_value(arr.numpy() if hasattr(arr, "numpy") else arr)
-            p = [jax.device_put(t._data, s)
-                 for t, s in zip(fm.params, p_sh)]
+            # restore the FULL optimizer state — params AND Adam moments —
+            # so restart resumes the exact trajectory (and never touches
+            # arrays donated to a failed step call)
+            p = [jax.device_put(jnp.asarray(t.numpy()), s)
+                 for t, s in zip(state["p"], p_sh)]
+            m = [jax.device_put(jnp.asarray(t.numpy()), s)
+                 for t, s in zip(state["m"], p_sh)]
+            v = [jax.device_put(jnp.asarray(t.numpy()), s)
+                 for t, s in zip(state["v"], p_sh)]
         rng = np.random.default_rng(123 + start_step)  # deterministic skip
         t0 = time.time()
+        loss = None
         for i in range(start_step, args.steps):
-            ids = jax.device_put(jnp.asarray(rng.integers(
-                0, cfg.vocab_size, (args.batch, args.seq)), jnp.int32),
-                data_sh)
-            labels = jax.device_put(jnp.asarray(rng.integers(
-                0, cfg.vocab_size, (args.batch, args.seq)), jnp.int32),
-                data_sh)
+            ids_np = rng.integers(0, cfg.vocab_size,
+                                  (args.batch, args.seq + 1))
+            # causal-LM pretraining: labels are next-token-shifted ids
+            ids = jax.device_put(jnp.asarray(ids_np[:, :-1], jnp.int32),
+                                 data_sh)
+            labels = jax.device_put(jnp.asarray(ids_np[:, 1:], jnp.int32),
+                                    data_sh)
             key = fm.next_key()
             loss, p, m, v = step(p, m, v, key, ids, labels)
             if i % 5 == 0 or i == args.steps - 1:
@@ -123,10 +137,10 @@ def main():
                 print(f"step {i} loss {float(loss):.4f} "
                       f"({tok:,.0f} tokens/s)")
             if (i + 1) % 10 == 0:
-                for t, arr in zip(fm.params, p):
-                    t._data = arr
-                ckpt.save(i + 1, {"p": [paddle.to_tensor(
-                    np.asarray(jax.device_get(a))) for a in p]})
+                _save(ckpt, i + 1, p, m, v)
+        if loss is None:     # resumed at/after the final step: nothing to do
+            _, state2 = ckpt.load()
+            return None
         return float(loss)
 
     sup = TrainingSupervisor(args.ckpt_dir, max_restarts=2)

@@ -109,6 +109,13 @@ class QuantConfig:
 # quantized layer wrappers
 # ---------------------------------------------------------------------------
 
+def _apply_quanter(q, t):
+    """QAT quanters fake-quantize; plain PTQ observers only observe."""
+    if hasattr(q, "quantize"):
+        return q.quantize(t)
+    q.observe(t)
+    return t
+
 class QuantedLinear(Layer):
     def __init__(self, inner, a_quanter, w_quanter):
         super().__init__()
@@ -121,8 +128,11 @@ class QuantedLinear(Layer):
         from ..nn import functional as F
         if self._converted and not self.training:
             # weight-only int8 inference: Pallas kernel streams int8 weight
-            # tiles + dequantizes in VMEM (ops/pallas/quant_matmul.py)
+            # tiles + dequantizes in VMEM (ops/pallas/quant_matmul.py).
+            # Inference-only — no VJP on the int8 kernel, so keep the op
+            # off the tape even when a caller forgot no_grad().
             from ..ops.pallas.quant_matmul import int8_matmul
+            from ..autograd.tape import no_grad
 
             def fn(a, w_q, s, *bias):
                 shape = a.shape
@@ -133,12 +143,13 @@ class QuantedLinear(Layer):
             args = (x, Tensor(self._w_int8), Tensor(self._w_scale))
             if self.inner.bias is not None:
                 args = args + (self.inner.bias,)
-            return apply(fn, *args, op_name="int8_linear")
+            with no_grad():
+                return apply(fn, *args, op_name="int8_linear")
         if self.a_q is not None:
-            x = self.a_q.quantize(x)
+            x = _apply_quanter(self.a_q, x)
         w = self.inner.weight
         if self.w_q is not None:
-            w = self.w_q.quantize(w)
+            w = _apply_quanter(self.w_q, w)
         return F.linear(x, w, self.inner.bias)
 
 
@@ -151,8 +162,10 @@ class QuantedConv2D(Layer):
 
     def forward(self, x):
         if self.a_q is not None:
-            x = self.a_q.quantize(x)
-        if self.w_q is None:
+            x = _apply_quanter(self.a_q, x)
+        if self.w_q is None or not hasattr(self.w_q, "quantize"):
+            if self.w_q is not None:
+                self.w_q.observe(self.inner.weight)   # PTQ calibration
             return self.inner(x)
         # run the conv with the fake-quantized weight temporarily swapped in
         w = self.inner.weight
