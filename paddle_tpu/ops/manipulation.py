@@ -426,3 +426,10 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
 def one_hot(x, num_classes, name=None):
     return apply(lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32),
                  x, op_name="one_hot")
+
+
+def permute(x, *perm, name=None):
+    """torch-style alias of transpose(perm)."""
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return transpose(x, list(perm))

@@ -489,3 +489,41 @@ def bincount(x, weights=None, minlength=0):
 @defop
 def increment(x, value=1.0):
     return x + value
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda a: jnp.all(a.astype(bool), axis=axis,
+                                   keepdims=keepdim), x, op_name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda a: jnp.any(a.astype(bool), axis=axis,
+                                   keepdims=keepdim), x, op_name="any")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    def fn(a, *extra):
+        pre = extra[0] if prepend is not None else None
+        app = extra[-1] if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+
+    args = [x] + [t for t in (prepend, append) if t is not None]
+    return apply(fn, *args, op_name="diff")
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, b: a @ b, x, vec, op_name="mv")
+
+
+def take(x, index, mode="raise", name=None):
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+        return jnp.take(flat, idx, mode=m)
+
+    return apply(fn, x, index, op_name="take")
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
