@@ -109,6 +109,7 @@ def monkey_patch_tensor():
         masked_scatter where nonzero unique unique_consecutive
         norm dist histogram bincount increment lcm gcd heaviside hypot
         nan_to_num multiplex divide_no_nan tensordot
+        all any take permute diff mv
         reshape_ squeeze_ unsqueeze_
     """.split()
     for name in methods:
