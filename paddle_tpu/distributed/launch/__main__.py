@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu.distributed.launch`` CLI entry (reference:
+``python -m paddle.distributed.launch``)."""
+import sys
+
+from .main import launch_main
+
+sys.exit(launch_main())

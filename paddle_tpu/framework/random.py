@@ -16,20 +16,30 @@ import numpy as np
 
 
 class Generator:
-    """Stateful generator: (root_key, counter). fold_in per draw."""
+    """Stateful generator: (root_key, counter). fold_in per draw.
+
+    The root key is created LAZILY — a jax dispatch at import time would
+    initialize the backend before user code can pick one (and makes even
+    ``python -m paddle_tpu.distributed.launch`` touch the accelerator).
+    """
 
     def __init__(self, seed_: int = 0):
         self.manual_seed(seed_)
 
     def manual_seed(self, seed_: int):
         self._seed = int(seed_)
-        self._root = jax.random.key(int(seed_))
+        self._root = None          # built on first draw
         self._counter = 0
         return self
 
+    def _root_key(self):
+        if self._root is None:
+            self._root = jax.random.key(self._seed)
+        return self._root
+
     def next_key(self):
         with _lock:
-            k = jax.random.fold_in(self._root, self._counter)
+            k = jax.random.fold_in(self._root_key(), self._counter)
             self._counter += 1
         return k
 
@@ -38,7 +48,7 @@ class Generator:
 
     def set_state(self, state):
         self._seed = int(state["seed"])
-        self._root = jax.random.key(self._seed)
+        self._root = None
         self._counter = int(state["counter"])
 
     @property
