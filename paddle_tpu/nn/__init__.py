@@ -32,5 +32,8 @@ from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,
 )
+from .layers.rnn import (  # noqa: F401
+    SimpleRNN, LSTM, GRU, RNN, SimpleRNNCell, LSTMCell, GRUCell,
+)
 from . import utils  # noqa: F401
 from .clip_grad import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401

@@ -1,0 +1,297 @@
+"""Recurrent layers (reference: ``python/paddle/nn/layer/rnn.py`` —
+SimpleRNN/LSTM/GRU + cells, multi-layer, bidirectional, time_major;
+SURVEY.md §2.2 "nn").
+
+TPU-native: the whole sequence loop is ONE ``lax.scan`` per (layer,
+direction) inside a single traced op — no per-step Python dispatch, XLA
+pipelines the gate matmuls on the MXU. Weight layout matches the reference:
+``weight_ih`` [gates*hidden, input], ``weight_hh`` [gates*hidden, hidden],
+gate order i,f,c,o for LSTM and r,z,c for GRU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..layer import Layer, LayerList
+from ..initializer import Uniform
+from ...autograd.tape import apply
+from ...framework.core import Tensor
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNNCellBase", "RNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+# ---------------------------------------------------------------------------
+# cells
+# ---------------------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        g = self.GATES
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [g * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [g * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [g * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [g * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def get_initial_states(self, batch, dtype=jnp.float32):
+        z = jnp.zeros((batch, self.hidden_size), dtype)
+        return z
+
+    # pure-array single step (used by the scan and by eager cell calls)
+    @staticmethod
+    def step(params, x, state):
+        raise NotImplementedError
+
+
+class SimpleRNNCell(RNNCellBase):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, **kw)
+        self.activation = activation
+
+    @staticmethod
+    def make_step(activation="tanh"):
+        act = jnp.tanh if activation == "tanh" else \
+            (lambda v: jnp.maximum(v, 0))
+
+        def step(params, x, state):
+            wih, whh, bih, bhh = params
+            h = state
+            h2 = act(x @ wih.T + bih + h @ whh.T + bhh)
+            return h2, h2
+        return step
+
+    def forward(self, inputs, states=None):
+        def fn(x, wih, whh, bih, bhh, *st):
+            h = st[0] if st else jnp.zeros((x.shape[0], self.hidden_size),
+                                           x.dtype)
+            h2, _ = SimpleRNNCell.make_step(self.activation)(
+                (wih, whh, bih, bhh), x, h)
+            return h2, h2
+
+        args = (inputs, self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh) + ((states,) if states is not None else ())
+        out, h = apply(fn, *args, op_name="simple_rnn_cell")
+        return out, h
+
+
+class LSTMCell(RNNCellBase):
+    GATES = 4
+
+    @staticmethod
+    def make_step():
+        def step(params, x, state):
+            wih, whh, bih, bhh = params
+            h, c = state
+            gates = x @ wih.T + bih + h @ whh.T + bhh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return h2, (h2, c2)
+        return step
+
+    def forward(self, inputs, states=None):
+        def fn(x, wih, whh, bih, bhh, *st):
+            if st:
+                h, c = st
+            else:
+                z = jnp.zeros((x.shape[0], self.hidden_size), x.dtype)
+                h = c = z
+            h2, (h2b, c2) = LSTMCell.make_step()((wih, whh, bih, bhh), x,
+                                                 (h, c))
+            return h2, (h2b, c2)
+
+        args = [inputs, self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh]
+        if states is not None:
+            args += list(states)
+        out, hc = apply(fn, *args, op_name="lstm_cell")
+        return out, hc
+
+
+class GRUCell(RNNCellBase):
+    GATES = 3
+
+    @staticmethod
+    def make_step():
+        def step(params, x, state):
+            wih, whh, bih, bhh = params
+            h = state
+            xg = x @ wih.T + bih
+            hg = h @ whh.T + bhh
+            xr, xz, xc = jnp.split(xg, 3, axis=-1)
+            hr, hz, hc = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            cand = jnp.tanh(xc + r * hc)
+            h2 = (1 - z) * cand + z * h
+            return h2, h2
+        return step
+
+    def forward(self, inputs, states=None):
+        def fn(x, wih, whh, bih, bhh, *st):
+            h = st[0] if st else jnp.zeros((x.shape[0], self.hidden_size),
+                                           x.dtype)
+            return GRUCell.make_step()((wih, whh, bih, bhh), x, h)
+
+        args = (inputs, self.weight_ih, self.weight_hh, self.bias_ih,
+                self.bias_hh) + ((states,) if states is not None else ())
+        out, h = apply(fn, *args, op_name="gru_cell")
+        return out, h
+
+
+# ---------------------------------------------------------------------------
+# multi-layer wrappers
+# ---------------------------------------------------------------------------
+
+_CELLS = {"SimpleRNN": SimpleRNNCell, "LSTM": LSTMCell, "GRU": GRUCell}
+
+
+class _RNNBase(Layer):
+    MODE = "SimpleRNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndirs = 2 if self.bidirect else 1
+        cell_cls = _CELLS[self.MODE]
+        cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * ndirs
+            for _ in range(ndirs):
+                kw = dict(weight_ih_attr=weight_ih_attr,
+                          weight_hh_attr=weight_hh_attr,
+                          bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+                if self.MODE == "SimpleRNN":
+                    kw["activation"] = activation
+                cells.append(cell_cls(in_sz, hidden_size, **kw))
+        self.cells = LayerList(cells)
+
+    def _step_fn(self):
+        if self.MODE == "SimpleRNN":
+            return SimpleRNNCell.make_step(self.activation)
+        if self.MODE == "LSTM":
+            return LSTMCell.make_step()
+        return GRUCell.make_step()
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        ndirs = 2 if self.bidirect else 1
+        step = self._step_fn()
+        is_lstm = self.MODE == "LSTM"
+        hidden = self.hidden_size
+        time_major = self.time_major
+        nl = self.num_layers
+
+        def fn(x, *weights):
+            # x -> [T, B, F] internally
+            xs = x if time_major else jnp.swapaxes(x, 0, 1)
+            hs, cs = [], []
+            for layer in range(nl):
+                outs = []
+                for d in range(ndirs):
+                    ci = layer * ndirs + d
+                    w = weights[4 * ci: 4 * ci + 4]
+                    seq = xs if d == 0 else jnp.flip(xs, 0)
+                    b = seq.shape[1]
+                    z = jnp.zeros((b, hidden), seq.dtype)
+                    init = (z, z) if is_lstm else z
+
+                    def scan_step(carry, xt, w=w):
+                        h2, carry2 = step(w, xt, carry)
+                        return carry2, h2
+
+                    final, ys = jax.lax.scan(scan_step, init, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    outs.append(ys)
+                    if is_lstm:
+                        hs.append(final[0])
+                        cs.append(final[1])
+                    else:
+                        hs.append(final)
+                xs = outs[0] if ndirs == 1 else jnp.concatenate(outs, -1)
+            out = xs if time_major else jnp.swapaxes(xs, 0, 1)
+            h = jnp.stack(hs, 0)                   # [nl*ndirs, B, H]
+            if is_lstm:
+                return out, (h, jnp.stack(cs, 0))
+            return out, h
+
+        wargs = []
+        for cell in self.cells:
+            wargs += [cell.weight_ih, cell.weight_hh, cell.bias_ih,
+                      cell.bias_hh]
+        return apply(fn, inputs, *wargs, op_name=f"{self.MODE.lower()}")
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "SimpleRNN"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class RNN(Layer):
+    """Generic scanner over a user cell (reference paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager per-step loop through the cell (keeps arbitrary cells valid)
+        xs = inputs if self.time_major else inputs.transpose(
+            [1, 0] + list(range(2, inputs.ndim)))
+        steps = xs.shape[0]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        state = initial_states
+        outs = [None] * steps
+        for t in order:
+            out, state = self.cell(xs[t], state)
+            outs[t] = out
+        from ...ops import manipulation as manip
+        stacked = manip.stack(outs, axis=0)
+        if not self.time_major:
+            stacked = stacked.transpose([1, 0] +
+                                        list(range(2, stacked.ndim)))
+        return stacked, state

@@ -1,0 +1,101 @@
+"""RNN family tests (reference: paddle.nn SimpleRNN/LSTM/GRU — SURVEY.md
+§2.2 'nn'): layer-vs-cell consistency, bidirectional, multi-layer, grads."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import LSTM, GRU, SimpleRNN, RNN, LSTMCell, GRUCell
+
+
+def _x(b=2, t=5, f=4, seed=0):
+    return paddle.to_tensor(np.random.default_rng(seed).normal(
+        size=(b, t, f)).astype(np.float32))
+
+
+def test_lstm_shapes_and_final_state():
+    paddle.seed(0)
+    lstm = LSTM(4, 8, num_layers=2)
+    out, (h, c) = lstm(_x())
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [2, 2, 8] and c.shape == [2, 2, 8]
+    # final hidden of the last layer equals the last output step
+    np.testing.assert_allclose(h.numpy()[-1], out.numpy()[:, -1], atol=1e-6)
+
+
+def test_lstm_matches_cell_loop():
+    paddle.seed(1)
+    lstm = LSTM(4, 8)
+    x = _x(seed=2)
+    out, (h, c) = lstm(x)
+
+    cell = LSTMCell(4, 8)
+    cell.weight_ih.set_value(lstm.cells[0].weight_ih.numpy())
+    cell.weight_hh.set_value(lstm.cells[0].weight_hh.numpy())
+    cell.bias_ih.set_value(lstm.cells[0].bias_ih.numpy())
+    cell.bias_hh.set_value(lstm.cells[0].bias_hh.numpy())
+    state = None
+    for t in range(5):
+        o, state = cell(x[:, t], state)
+    np.testing.assert_allclose(out.numpy()[:, -1], o.numpy(), atol=1e-5)
+    np.testing.assert_allclose(c.numpy()[0], state[1].numpy(), atol=1e-5)
+
+
+def test_bidirectional_lstm():
+    paddle.seed(2)
+    lstm = LSTM(4, 8, direction="bidirect")
+    out, (h, c) = lstm(_x())
+    assert out.shape == [2, 5, 16]
+    assert h.shape == [2, 2, 8]
+
+
+def test_gru_and_simple_rnn():
+    paddle.seed(3)
+    x = _x()
+    gru = GRU(4, 8)
+    out, h = gru(x)
+    assert out.shape == [2, 5, 8] and h.shape == [1, 2, 8]
+    rnn = SimpleRNN(4, 8, activation="relu")
+    out2, h2 = rnn(x)
+    assert out2.shape == [2, 5, 8]
+    assert (out2.numpy() >= 0).all()       # relu activation
+
+
+def test_time_major():
+    paddle.seed(4)
+    lstm = LSTM(4, 8, time_major=True)
+    x = paddle.randn([5, 2, 4])            # [T, B, F]
+    out, _ = lstm(x)
+    assert out.shape == [5, 2, 8]
+
+
+def test_lstm_trains():
+    paddle.seed(5)
+    lstm = LSTM(4, 8)
+    head = paddle.nn.Linear(8, 1)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-2,
+        parameters=lstm.parameters() + head.parameters())
+    x = _x(seed=6)
+    y = paddle.randn([2, 1])
+    losses = []
+    for _ in range(5):
+        out, (h, c) = lstm(x)
+        loss = ((head(out[:, -1]) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert lstm.cells[0].weight_ih.grad is None   # cleared
+
+
+def test_generic_rnn_wrapper():
+    paddle.seed(6)
+    cell = GRUCell(4, 8)
+    rnn = RNN(cell)
+    out, state = rnn(_x())
+    assert out.shape == [2, 5, 8]
+    # reverse direction
+    rnn_r = RNN(cell, is_reverse=True)
+    out_r, _ = rnn_r(_x())
+    assert out_r.shape == [2, 5, 8]

@@ -1,0 +1,161 @@
+"""Self-audit: SURVEY.md §2 component inventory → paddle_tpu modules.
+
+Run: python tools/check_inventory.py
+Prints one line per inventory item with the implementing module(s) and
+whether every listed symbol resolves. Used by CI (tests/test_inventory.py)
+to keep the map honest as the build grows.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# (SURVEY §2 item, module path, symbols that must resolve)
+INVENTORY = [
+    ("Phi kernels / op layer", "paddle_tpu.ops",
+     ["add", "matmul", "einsum", "topk", "cumsum"]),
+    ("Flash attention (FA2 kernels)", "paddle_tpu.ops.pallas",
+     ["flash_attention", "flash_attention_with_lse", "mha_reference"]),
+    ("Ring attention / CP", "paddle_tpu.ops.pallas",
+     ["ring_flash_attention"]),
+    ("Int8 GEMM (quant inference)", "paddle_tpu.ops.pallas",
+     ["int8_matmul", "quantize_weight"]),
+    ("Fused ops (phi fusion tier)", "paddle_tpu.incubate.nn.functional",
+     ["fused_rotary_position_embedding", "fused_rms_norm", "swiglu"]),
+    ("Eager autograd engine", "paddle_tpu.autograd.tape",
+     ["apply", "run_backward", "no_grad"]),
+    ("PyLayer (custom op autograd)", "paddle_tpu.autograd.pylayer",
+     ["PyLayer"]),
+    ("to_static / SOT tracer", "paddle_tpu.jit",
+     ["to_static", "save", "load", "InputSpec"]),
+    ("Static Program/Executor", "paddle_tpu.static",
+     ["Program", "Executor", "BuildStrategy", "program_guard"]),
+    ("Inference predictor", "paddle_tpu.inference",
+     ["Config", "create_predictor"]),
+    ("nn layers", "paddle_tpu.nn",
+     ["Linear", "Conv2D", "LayerNorm", "BatchNorm2D", "MultiHeadAttention",
+      "TransformerEncoder", "LSTM", "Embedding"]),
+    ("Optimizers", "paddle_tpu.optimizer",
+     ["SGD", "Momentum", "Adam", "AdamW", "Lamb", "Adagrad", "RMSProp",
+      "Adadelta"]),
+    ("LR schedulers", "paddle_tpu.optimizer.lr",
+     ["NoamDecay", "LinearWarmup", "CosineAnnealingDecay", "OneCycleLR",
+      "ReduceOnPlateau"]),
+    ("AMP", "paddle_tpu.amp",
+     ["auto_cast", "GradScaler", "decorate"]),
+    ("AMP debugging / nan checker", "paddle_tpu.amp.debugging",
+     ["check_numerics", "enable_tensor_checker", "TensorCheckerConfig"]),
+    ("DataLoader / io", "paddle_tpu.io",
+     ["Dataset", "IterableDataset", "DataLoader", "BatchSampler",
+      "DistributedBatchSampler", "WeightedRandomSampler"]),
+    ("Native shm queue (C++)", "paddle_tpu.io.native",
+     ["ShmQueue", "available"]),
+    ("Profiler", "paddle_tpu.profiler",
+     ["Profiler", "make_scheduler", "RecordEvent", "export_chrome_tracing"]),
+    ("Checkpoint save/load", "paddle_tpu.framework.io",
+     ["save", "load"]),
+    ("Distributed checkpoint", "paddle_tpu.distributed.checkpoint",
+     ["save_state_dict", "load_state_dict", "save_group_sharded_model"]),
+    ("Collectives API", "paddle_tpu.distributed",
+     ["all_reduce", "all_gather", "reduce_scatter", "alltoall", "send",
+      "recv", "new_group", "batch_isend_irecv"]),
+    ("Mesh / topology", "paddle_tpu.distributed.mesh",
+     ["init_mesh", "get_mesh", "HYBRID_AXES"]),
+    ("HybridCommunicateGroup", "paddle_tpu.distributed.fleet",
+     ["HybridCommunicateGroup", "CommunicateTopology"]),
+    ("Fleet facade", "paddle_tpu.distributed.fleet",
+     ["init", "distributed_model", "distributed_optimizer",
+      "DistributedStrategy"]),
+    ("TP/MP layers", "paddle_tpu.distributed.fleet.meta_parallel",
+     ["ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+      "ParallelCrossEntropy", "get_rng_state_tracker"]),
+    ("Pipeline (1F1B + layers)", "paddle_tpu.distributed.fleet.meta_parallel",
+     ["PipelineLayer", "LayerDesc", "SharedLayerDesc", "PipelineParallel"]),
+    ("SPMD pipeline engine (+VPP)", "paddle_tpu.distributed.engine",
+     ["pipeline_forward", "pipeline_spmd", "pipeline_spmd_interleaved"]),
+    ("Sharding stages 1-3", "paddle_tpu.distributed.sharding",
+     ["group_sharded_parallel", "save_group_sharded_model"]),
+    ("Sequence parallel utils",
+     "paddle_tpu.distributed.fleet.utils.sequence_parallel_utils",
+     ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+      "mark_as_sequence_parallel_parameter"]),
+    ("Ring attention facade", "paddle_tpu.distributed.fleet.utils",
+     ["ring_attention", "RingFlashAttention"]),
+    ("Recompute", "paddle_tpu.distributed.fleet.utils", ["recompute"]),
+    ("MoE / EP", "paddle_tpu.incubate.distributed.models.moe",
+     ["MoELayer", "GShardGate", "SwitchGate", "NaiveGate"]),
+    ("Auto-parallel API", "paddle_tpu.distributed.auto_parallel",
+     ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+      "reshard", "shard_optimizer", "Engine"]),
+    ("Distributed passes", "paddle_tpu.distributed.passes",
+     ["new_pass", "PassManager", "register_pass"]),
+    ("Launch CLI", "paddle_tpu.distributed.launch", ["launch_main"]),
+    ("Elastic", "paddle_tpu.distributed.fleet.elastic",
+     ["ElasticManager", "TrainingSupervisor", "CheckpointManager"]),
+    ("Flags system", "paddle_tpu.flags",
+     ["set_flags", "get_flags"]),
+    ("Sparse tensors", "paddle_tpu.sparse",
+     ["sparse_coo_tensor", "sparse_csr_tensor", "matmul", "masked_matmul"]),
+    ("Quantization", "paddle_tpu.quantization",
+     ["QuantConfig", "QAT", "PTQ", "convert"]),
+    ("ASP 2:4 sparsity", "paddle_tpu.incubate.asp",
+     ["prune_model", "decorate", "calculate_density"]),
+    ("Higher-order AD", "paddle_tpu.incubate.autograd",
+     ["jvp", "vjp", "Jacobian", "Hessian"]),
+    ("hapi Model", "paddle_tpu.hapi", ["Model", "summary"]),
+    ("Callbacks", "paddle_tpu.callbacks",
+     ["ModelCheckpoint", "EarlyStopping", "LRScheduler"]),
+    ("Metrics", "paddle_tpu.metric",
+     ["Accuracy", "Precision", "Recall", "Auc"]),
+    ("Vision models", "paddle_tpu.vision.models",
+     ["resnet50", "vgg16", "mobilenet_v2", "LeNet"]),
+    ("Vision ops (detection)", "paddle_tpu.vision.ops",
+     ["nms", "roi_align", "box_iou", "distance2bbox", "yolo_box"]),
+    ("Detection model (PP-YOLOE)", "paddle_tpu.models",
+     ["PPYOLOE", "DetectionLoss", "ppyoloe_lite"]),
+    ("LM zoo", "paddle_tpu.models",
+     ["LlamaForCausalLM", "GPTForCausalLM", "BertModel", "ErnieModel"]),
+    ("Generation", "paddle_tpu.models.generation",
+     ["GenerationMixin", "KVCache"]),
+    ("fft", "paddle_tpu.fft", ["fft", "rfft", "irfft", "fft2", "fftshift"]),
+    ("signal", "paddle_tpu.signal", ["stft", "istft", "frame"]),
+    ("text", "paddle_tpu.text", ["ViterbiDecoder", "viterbi_decode"]),
+    ("audio", "paddle_tpu.audio",
+     ["MelSpectrogram", "LogMelSpectrogram", "MFCC"]),
+    ("Device API", "paddle_tpu.device",
+     ["set_device", "synchronize", "Stream", "Event", "cuda"]),
+    ("Profiler benchmark timer", "paddle_tpu.profiler", ["benchmark"]),
+    ("utils", "paddle_tpu.utils",
+     ["run_check", "get_weights_path_from_url", "try_import"]),
+]
+
+
+def check(verbose=True):
+    failures = []
+    for item, mod_path, symbols in INVENTORY:
+        try:
+            mod = importlib.import_module(mod_path)
+        except Exception as e:
+            failures.append((item, mod_path, f"import failed: {e}"))
+            continue
+        missing = [s for s in symbols if not hasattr(mod, s)]
+        if missing:
+            failures.append((item, mod_path, f"missing {missing}"))
+        elif verbose:
+            print(f"  OK {item:<42} {mod_path}")
+    if failures:
+        for item, mod, why in failures:
+            print(f"FAIL {item:<42} {mod}: {why}")
+    if verbose:
+        print(f"{len(INVENTORY) - len(failures)}/{len(INVENTORY)} "
+              f"inventory items resolved")
+    return failures
+
+
+if __name__ == "__main__":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.exit(1 if check() else 0)
