@@ -129,6 +129,14 @@ def bench_llama():
         loss, p_arrs = step(p_arrs, key, ids, labels)
     loss.block_until_ready()
     dt = time.perf_counter() - t0
+
+    from paddle_tpu.profiler.mfu import llama_train_flops, PEAK_FLOPS
+    flops = llama_train_flops(cfg, batch, seq)
+    chip = os.environ.get("BENCH_CHIP", "v5p")
+    mfu = flops * steps / dt / PEAK_FLOPS.get(chip, PEAK_FLOPS["v5p"])
+    print(json.dumps({"aux_metric": "mfu_" + chip,
+                      "value": round(mfu * 100, 2), "unit": "%"}),
+          file=sys.stderr)
     return {
         "metric": "llama_1b_train_tokens_per_sec",
         "value": round(batch * seq * steps / dt, 2),

@@ -29,7 +29,8 @@ SUFFIX_META = ".pdmeta"
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — export layer for inference.
+    """paddle.jit.save — export a Layer (or plain function / StaticFunction)
+    for inference.
 
     Writes: ``{path}.pdiparams`` (state dict), ``{path}.pdmodel.stablehlo``
     (serialized jax.export artifact of the eval-mode forward, parameters as
@@ -37,7 +38,11 @@ def save(layer, path, input_spec=None, **configs):
     from jax import export as jexport
 
     if not isinstance(layer, Layer):
-        raise TypeError("jit.save expects a Layer (function export TBD)")
+        fn = layer._orig_fn if isinstance(layer, StaticFunction) else layer
+        if not callable(fn):
+            raise TypeError("jit.save expects a Layer, function, or "
+                            "StaticFunction")
+        return _save_function(fn, path, input_spec)
     was_training = layer.training
     layer.eval()
     try:
@@ -95,6 +100,39 @@ def save(layer, path, input_spec=None, **configs):
             layer.train()
 
 
+def _save_function(fn, path, input_spec):
+    """Export a parameterless Tensor-function as StableHLO."""
+    from jax import export as jexport
+    from ..autograd.tape import no_grad
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec")
+    specs = [s if isinstance(s, InputSpec) else InputSpec.from_tensor(s)
+             for s in input_spec]
+    example = [jnp.zeros([1 if d is None else d for d in s.shape],
+                         s.dtype or jnp.float32) for s in specs]
+
+    def infer_fn(*inputs):
+        with no_grad():
+            out = fn(*[Tensor(i) for i in inputs])
+        return jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t,
+                            out, is_leaf=lambda x: isinstance(x, Tensor))
+
+    exported = jexport.export(jax.jit(infer_fn))(*example)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + SUFFIX_MODEL, "wb") as f:
+        f.write(exported.serialize())
+    fio.save({}, path + SUFFIX_PARAMS)
+    meta = {"param_names": [], "param_keys": [], "n_params": 0, "n_bufs": 0,
+            "is_function": True,
+            "input_specs": [(s.shape, np.dtype(s.dtype or np.float32).name)
+                            for s in specs]}
+    with open(path + SUFFIX_META, "wb") as f:
+        pickle.dump(meta, f)
+
+
 class TranslatedLayer(Layer):
     """Result of jit.load: a Layer whose forward runs the exported StableHLO."""
 
@@ -109,8 +147,12 @@ class TranslatedLayer(Layer):
 
     def forward(self, *inputs):
         arrs = [t._data if isinstance(t, Tensor) else jnp.asarray(t) for t in inputs]
-        out = self._exported.call([p._data for p in self._params_list],
-                                  [b._data for b in self._bufs_list], *arrs)
+        if self._meta.get("is_function"):
+            out = self._exported.call(*arrs)
+        else:
+            out = self._exported.call([p._data for p in self._params_list],
+                                      [b._data for b in self._bufs_list],
+                                      *arrs)
         return jax.tree.map(Tensor, out)
 
 

@@ -127,3 +127,32 @@ def test_lr_scheduler_callback(tmp_path):
     model.fit(ds, batch_size=4, epochs=1, verbose=0,
               callbacks=[LRScheduler(by_step=True)])
     assert sched.last_lr < 0.1
+
+
+def test_jit_save_function_export(tmp_path):
+    import paddle_tpu as paddle
+
+    def double_plus(x):
+        return x * 2 + 1
+
+    prefix = str(tmp_path / "fn" / "model")
+    paddle.jit.save(double_plus, prefix,
+                    input_spec=[paddle.jit.InputSpec([2, 3], "float32")])
+    loaded = paddle.jit.load(prefix)
+    x = np.ones((2, 3), np.float32)
+    out = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), x * 2 + 1)
+
+
+def test_mfu_monitor():
+    from paddle_tpu.profiler.mfu import (
+        MFUMonitor, llama_train_flops, llama_param_count)
+    from paddle_tpu.models import llama_tiny
+    cfg = llama_tiny()
+    n = llama_param_count(cfg)
+    assert n > 0
+    fl = llama_train_flops(cfg, batch=2, seq_len=32)
+    assert fl > 6 * n * 64                      # at least the 6N·tokens term
+    mon = MFUMonitor(step_flops=fl, chip="cpu")
+    mon.step(tokens=64)
+    assert mon.mfu() >= 0 and "MFU" in mon.summary()
