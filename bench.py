@@ -2,25 +2,36 @@
 (BASELINE.json config 1). ``BENCH_MODEL=llama`` benches the flagship
 Llama train step (tokens/sec).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is null — the reference mount is empty and BASELINE.json
-records no published numbers (SURVEY.md §6); this run IS the baseline.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus
+``backend``, and ``error``/``note`` when degraded). ``vs_baseline`` is
+null — the reference mount is empty and BASELINE.json records no
+published numbers (SURVEY.md §6); this run IS the baseline.
 
-``BENCH_AMP=1`` (default on TPU) uses the reference's AMP-O2 recipe mapped
-to TPU: fp32 master params, bf16 compute (cast at step entry) — the MXU's
-native dtype.
+Robustness contract (round-2 hardening, see VERDICT.md item 1): round 1
+recorded rc=1 because the ambient TPU plugin failed/hung jax backend
+init *before any benchmark code ran*. This file is now an orchestrator:
+it probes backend availability in a throwaway subprocess under a
+timeout, runs the actual benchmark in a child process (``BENCH_CHILD=1``
+re-entry), retries once on TPU, falls back to a sanitized CPU
+environment, and ALWAYS emits its JSON line — a wedged TPU yields a CPU
+number with a note, never an empty record.
+
+``BENCH_AMP=1`` (default on TPU) uses the reference's AMP-O2 recipe
+mapped to TPU: fp32 master params, bf16 compute — the MXU's native dtype.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 
 def _amp_enabled():
     import jax
-    default = "1" if jax.default_backend() == "tpu" else "0"
+    plat = jax.devices()[0].platform.lower()
+    default = "1" if plat in ("tpu", "axon") else "0"
     return os.environ.get("BENCH_AMP", default) == "1"
 
 
@@ -145,10 +156,110 @@ def bench_llama():
     }
 
 
-def main():
+# --------------------------------------------------------------------------
+# Orchestration: never hang, never exit without a JSON line.
+# --------------------------------------------------------------------------
+
+def _child_main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
     out = bench_llama() if mode == "llama" else bench_resnet()
+    import jax
+    out["backend"] = jax.devices()[0].platform.lower()
     print(json.dumps(out))
+    return 0
+
+
+def _run_child(env, timeout):
+    """Run this file with BENCH_CHILD=1; return (json_dict|None, tail)."""
+    env = dict(env)
+    env["BENCH_CHILD"] = "1"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        out = e.output or ""
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        return None, out[-2000:] + f"\n[timeout {timeout}s]"
+    except OSError as e:
+        return None, f"[spawn failed: {e}]"
+    # relay aux lines (e.g. mfu) from the child's stderr
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-4000:])
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if "metric" in obj:
+                return obj, ""
+    tail = (proc.stdout[-1000:] + "\n" + proc.stderr[-1000:]).strip()
+    return None, tail[-2000:] + f"\n[rc={proc.returncode}]"
+
+
+def main():
+    if os.environ.get("BENCH_CHILD") == "1":
+        return _child_main()
+
+    from __graft_entry__ import _probe_backend, _sanitized_cpu_env
+
+    errors = []
+    plat = _probe_backend(timeout=float(os.environ.get("BENCH_PROBE_TIMEOUT",
+                                                       "180")))
+    if plat is None:
+        errors.append("backend probe failed/hung; skipping accelerator "
+                      "attempts")
+    elif plat == "cpu":
+        # no accelerator to try — go straight to the CPU-sized workload
+        # instead of burning the accelerator-sized attempts on host cores
+        print("bench: default backend is cpu; running cpu-sized workload",
+              file=sys.stderr)
+        plat = None
+    else:
+        print(f"bench: probed default backend = {plat}", file=sys.stderr)
+        for attempt, tmo in ((1, 1500), (2, 900)):
+            obj, tail = _run_child(os.environ, tmo)
+            if obj is not None:
+                print(json.dumps(obj))
+                return 0
+            errors.append(f"{plat} attempt {attempt}: {tail}")
+            print(f"bench: {plat} attempt {attempt} failed:\n{tail}",
+                  file=sys.stderr)
+            time.sleep(15)
+
+    # CPU fallback: sanitized env, smaller default workload so it
+    # finishes quickly on host cores.
+    cpu_env = _sanitized_cpu_env(1)
+    cpu_env.setdefault("BENCH_BATCH",
+                       "64" if os.environ.get("BENCH_MODEL") != "llama"
+                       else "2")
+    cpu_env.setdefault("BENCH_STEPS", "5")
+    cpu_env.setdefault("BENCH_SEQ", "512")
+    cpu_env["BENCH_AMP"] = os.environ.get("BENCH_AMP", "0")
+    obj, tail = _run_child(cpu_env, 1200)
+    if obj is not None:
+        if errors:
+            obj["note"] = "cpu fallback: " + " | ".join(e.splitlines()[0]
+                                                        for e in errors)[:400]
+        print(json.dumps(obj))
+        return 0
+    errors.append(f"cpu fallback: {tail}")
+
+    mode = os.environ.get("BENCH_MODEL", "resnet")
+    print(json.dumps({
+        "metric": ("llama_1b_train_tokens_per_sec" if mode == "llama"
+                   else "resnet50_cifar10_train_throughput"),
+        "value": None,
+        "unit": "tokens/sec" if mode == "llama" else "images/sec",
+        "vs_baseline": None,
+        "error": (" || ".join(e.replace("\n", " ")[:300]
+                              for e in errors))[:1200],
+    }))
+    return 0
 
 
 if __name__ == "__main__":

@@ -35,32 +35,61 @@ def _proc_index():
         return 0
 
 
+def _proc_count():
+    try:
+        return jax.process_count()
+    except RuntimeError:
+        return 1
+
+
+def _rank_meta_name(rank, save_id=None):
+    if save_id is not None:
+        return f"metadata.rank{rank}.{save_id}.json"
+    return f"metadata.rank{rank}.json"
+
+
 def _shard_filename(key, idx):
+    """Filename derived from the *global slice tuple*, not a per-process
+    counter — so two hosts holding different slices of the same tensor can
+    never collide, and the same slice always maps to the same file
+    (fix for round-1 ADVICE high finding: per-process enumerate index)."""
     safe = key.replace("/", "__")
-    return f"{safe}.shard{idx}.npy"
+    if not idx:
+        return f"{safe}.full.npy"
+    span = "_".join(f"{a}-{b}" for a, b in idx)
+    return f"{safe}.s{span}.npy"
 
 
 def _tensor_shards(arr):
-    """Yield (shard_idx, index_slices, np_array) for addressable shards; a
-    fully-replicated array yields one shard (process 0 writes it)."""
-    shards = [s for s in arr.addressable_shards]
-    seen = set()
-    for s in shards:
+    """Yield (index_slices, np_array) for addressable shards this process
+    must write. Only ``replica_id == 0`` copies are written — exactly one
+    process globally owns each slice, so replicated tensors are written
+    once cluster-wide (not once per host)."""
+    for s in arr.addressable_shards:
+        if getattr(s, "replica_id", 0) != 0:
+            continue
         idx = tuple((sl.start or 0, sl.stop if sl.stop is not None else dim)
                     for sl, dim in zip(s.index, arr.shape)) if s.index else ()
-        if idx in seen:
-            continue          # replicated copy — write once
-        seen.add(idx)
         yield idx, np.asarray(s.data)
 
 
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    async_save=False, **kw):
+                    async_save=False, save_id=None, **kw):
     """Save a (possibly sharded) state_dict to ``path`` (a directory).
+
+    ``save_id``: optional token identifying THIS save (e.g. the global
+    step). Strongly recommended for multi-host periodic saves into a
+    reused directory — rank metadata files are then namespaced per save,
+    so the coordinator can never merge a previous save's stale rank file.
+    Without it, a best-effort mtime guard is used instead.
 
     Returns None, or an object with ``.wait()`` when ``async_save``.
     """
+    import time as _time
+
     os.makedirs(path, exist_ok=True)
+    rank, nprocs = _proc_index(), _proc_count()
+    t_start = _time.time()
     flat = _flatten(state_dict)
     meta = {"version": 1, "tensors": {}, "nonarray": {}}
     jobs = []
@@ -69,8 +98,8 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             val = val._data
         if isinstance(val, jax.Array):
             entries = []
-            for i, (idx, npdata) in enumerate(_tensor_shards(val)):
-                fname = _shard_filename(key, i)
+            for idx, npdata in _tensor_shards(val):
+                fname = _shard_filename(key, idx)
                 entries.append({"file": fname,
                                 "index": [list(p) for p in idx]})
                 jobs.append((os.path.join(path, fname), npdata))
@@ -80,20 +109,44 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
                 "shards": entries,
             }
         elif isinstance(val, np.ndarray):
-            fname = _shard_filename(key, 0)
-            meta["tensors"][key] = {
-                "shape": list(val.shape), "dtype": str(val.dtype),
-                "shards": [{"file": fname, "index": []}]}
-            jobs.append((os.path.join(path, fname), val))
+            # host-side arrays are identical on every rank: only the
+            # coordinator writes (uncoordinated same-file writes on a
+            # shared fs can tear)
+            if rank == coordinator_rank:
+                fname = _shard_filename(key, ())
+                meta["tensors"][key] = {
+                    "shape": list(val.shape), "dtype": str(val.dtype),
+                    "shards": [{"file": fname, "index": []}]}
+                jobs.append((os.path.join(path, fname), val))
         else:
-            meta["nonarray"][key] = val
+            if rank == coordinator_rank:
+                meta["nonarray"][key] = val
 
     def write_all():
         for fpath, data in jobs:
             np.save(fpath, data)
-        if _proc_index() == coordinator_rank:
-            with open(os.path.join(path, _SENTINEL_META), "w") as f:
-                json.dump(meta, f)
+        # Every rank publishes its shard metadata (atomically: tmp +
+        # os.replace, so the coordinator can never read a torn file); the
+        # coordinator merges all rank files into the global metadata.json
+        # (the reference gathers metadata to rank 0 the same way —
+        # without this, shards written by other hosts are invisible at
+        # load and _assemble zero-fills them).
+        rank_file = os.path.join(path, _rank_meta_name(rank, save_id))
+        tmp = rank_file + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, rank_file)
+        if rank == coordinator_rank:
+            merge_timeout = float(kw.get(
+                "merge_timeout",
+                os.environ.get("PADDLE_CKPT_MERGE_TIMEOUT", "120")))
+            merged = _merge_rank_meta(path, nprocs, own=meta,
+                                      timeout=merge_timeout,
+                                      save_id=save_id, min_mtime=t_start)
+            tmp = os.path.join(path, _SENTINEL_META) + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(merged, f)
+            os.replace(tmp, os.path.join(path, _SENTINEL_META))
 
     if not async_save:
         write_all()
@@ -110,6 +163,74 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
             th.join()
 
     return _Handle()
+
+
+def _merge_rank_meta(path, nprocs, own=None, timeout=120.0, poll=0.25,
+                     save_id=None, min_mtime=None):
+    """Union the per-rank metadata files into one global metadata dict.
+
+    Waits (bounded) for all ``nprocs`` rank files to appear on the shared
+    filesystem and parse cleanly; merges whatever is usable at timeout
+    with a warning — a partial merge on a non-shared fs degrades to the
+    round-1 behavior rather than failing the save. Rank files are written
+    via os.replace so a visible file is never torn; a transient parse
+    failure is retried until the deadline. Without a ``save_id``
+    namespace, ``min_mtime`` (save start time, minus clock-skew slack)
+    rejects stale rank files left by a previous save into the same dir.
+    """
+    import time as _time
+    import warnings
+
+    deadline = _time.monotonic() + timeout
+    want = {r: _rank_meta_name(r, save_id) for r in range(nprocs)}
+    metas = {}
+    stale = {}      # parsed but older than this save — last-resort only
+    while True:
+        for r, name in want.items():
+            if r in metas:
+                continue
+            fpath = os.path.join(path, name)
+            try:
+                if min_mtime is not None and save_id is None \
+                        and os.path.getmtime(fpath) < min_mtime - 5.0:
+                    # looks like a leftover from a previous save; keep
+                    # polling for a rewrite, but hold onto it — fs clock
+                    # skew can make a legitimate fresh file look old, and
+                    # merging it at deadline beats zero-filling its shards
+                    with open(fpath) as f:
+                        stale[r] = json.load(f)
+                    continue
+                with open(fpath) as f:
+                    metas[r] = json.load(f)
+            except (OSError, ValueError):
+                continue        # absent or mid-write — retry until deadline
+        if len(metas) == nprocs or _time.monotonic() >= deadline:
+            break
+        _time.sleep(poll)
+    for r, m in stale.items():
+        if r not in metas:
+            warnings.warn(f"dist checkpoint: using possibly-stale rank {r} "
+                          f"metadata (mtime predates this save)")
+            metas[r] = m
+    if len(metas) < nprocs:
+        warnings.warn(
+            f"dist checkpoint: only {len(metas)}/{nprocs} rank metadata "
+            f"files usable after {timeout}s; metadata.json will cover "
+            f"those ranks only")
+    metas = [metas[r] for r in sorted(metas)]
+    if own is not None and own not in metas:
+        metas.append(own)
+    merged = {"version": 1, "tensors": {}, "nonarray": {}}
+    for m in metas:
+        merged["nonarray"].update(m.get("nonarray", {}))
+        for key, entry in m.get("tensors", {}).items():
+            tgt = merged["tensors"].setdefault(
+                key, {"shape": entry["shape"], "dtype": entry["dtype"],
+                      "shards": []})
+            have = {s["file"] for s in tgt["shards"]}
+            tgt["shards"].extend(s for s in entry["shards"]
+                                 if s["file"] not in have)
+    return merged
 
 
 def _flatten(d, prefix=""):

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from ..layer import Layer, LayerList
 from ..initializer import Uniform
 from ...autograd.tape import apply
+from ...framework import random as prandom
 from ...framework.core import Tensor
 
 __all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNNCellBase", "RNN",
@@ -209,16 +210,41 @@ class _RNNBase(Layer):
         return GRUCell.make_step()
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
+        """Reference semantics (``python/paddle/nn/layer/rnn.py`` RNNBase):
+        ``initial_states`` is ``[nl*ndirs, B, H]`` (tuple of two for LSTM),
+        ``sequence_length`` ``[B]`` masks steps past each example's length
+        (outputs zeroed, final states taken at the last valid step), and
+        ``dropout`` applies between stacked layers while training."""
         ndirs = 2 if self.bidirect else 1
         step = self._step_fn()
         is_lstm = self.MODE == "LSTM"
         hidden = self.hidden_size
         time_major = self.time_major
         nl = self.num_layers
+        ncells = nl * ndirs
+        has_init = initial_states is not None
+        has_len = sequence_length is not None
+        dropout_p = float(self.dropout)
+        use_drop = dropout_p > 0.0 and self.training and nl > 1
+        drop_key = prandom.next_key() if use_drop else None
 
-        def fn(x, *weights):
+        def fn(x, *args):
+            weights = args[:4 * ncells]
+            rest = list(args[4 * ncells:])
+            init_h = init_c = seq_len = None
+            if has_init:
+                init_h = rest.pop(0)
+                if is_lstm:
+                    init_c = rest.pop(0)
+            if has_len:
+                seq_len = rest.pop(0)
+
             # x -> [T, B, F] internally
             xs = x if time_major else jnp.swapaxes(x, 0, 1)
+            T = xs.shape[0]
+            if has_len:
+                valid = (jnp.arange(T)[:, None]
+                         < seq_len[None, :].astype(jnp.int32))   # [T, B]
             hs, cs = [], []
             for layer in range(nl):
                 outs = []
@@ -227,14 +253,38 @@ class _RNNBase(Layer):
                     w = weights[4 * ci: 4 * ci + 4]
                     seq = xs if d == 0 else jnp.flip(xs, 0)
                     b = seq.shape[1]
-                    z = jnp.zeros((b, hidden), seq.dtype)
-                    init = (z, z) if is_lstm else z
+                    if has_init:
+                        h0 = init_h[ci].astype(seq.dtype)
+                        init = (h0, init_c[ci].astype(seq.dtype)) \
+                            if is_lstm else h0
+                    else:
+                        z = jnp.zeros((b, hidden), seq.dtype)
+                        init = (z, z) if is_lstm else z
 
-                    def scan_step(carry, xt, w=w):
-                        h2, carry2 = step(w, xt, carry)
-                        return carry2, h2
+                    if has_len:
+                        # Masked scan: past-length steps keep the carry and
+                        # emit zeros. For the reverse direction the first
+                        # *valid* step of the descending scan is t=len-1, so
+                        # the same carry-freeze yields correct semantics.
+                        vmask = valid if d == 0 else jnp.flip(valid, 0)
 
-                    final, ys = jax.lax.scan(scan_step, init, seq)
+                        def scan_step(carry, inp, w=w):
+                            xt, vt = inp
+                            h2, carry2 = step(w, xt, carry)
+                            keep = vt[:, None]
+                            carry2 = jax.tree.map(
+                                lambda new, old: jnp.where(keep, new, old),
+                                carry2, carry)
+                            return carry2, jnp.where(keep, h2, 0.0)
+
+                        final, ys = jax.lax.scan(scan_step, init,
+                                                 (seq, vmask))
+                    else:
+                        def scan_step(carry, xt, w=w):
+                            h2, carry2 = step(w, xt, carry)
+                            return carry2, h2
+
+                        final, ys = jax.lax.scan(scan_step, init, seq)
                     if d == 1:
                         ys = jnp.flip(ys, 0)
                     outs.append(ys)
@@ -244,6 +294,12 @@ class _RNNBase(Layer):
                     else:
                         hs.append(final)
                 xs = outs[0] if ndirs == 1 else jnp.concatenate(outs, -1)
+                if use_drop and layer < nl - 1:
+                    key_l = jax.random.fold_in(drop_key, layer)
+                    keep = jax.random.bernoulli(key_l, 1.0 - dropout_p,
+                                                xs.shape)
+                    xs = jnp.where(keep, xs / (1.0 - dropout_p),
+                                   0.0).astype(xs.dtype)
             out = xs if time_major else jnp.swapaxes(xs, 0, 1)
             h = jnp.stack(hs, 0)                   # [nl*ndirs, B, H]
             if is_lstm:
@@ -254,6 +310,10 @@ class _RNNBase(Layer):
         for cell in self.cells:
             wargs += [cell.weight_ih, cell.weight_hh, cell.bias_ih,
                       cell.bias_hh]
+        if has_init:
+            wargs += list(initial_states) if is_lstm else [initial_states]
+        if has_len:
+            wargs.append(sequence_length)
         return apply(fn, inputs, *wargs, op_name=f"{self.MODE.lower()}")
 
 

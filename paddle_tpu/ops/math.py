@@ -516,8 +516,29 @@ def mv(x, vec, name=None):
 
 
 def take(x, index, mode="raise", name=None):
+    if mode == "raise":
+        # jnp has no in-trace raise mode; match the reference's eager
+        # behavior with a host-side bounds check when the index is
+        # concrete (under jit this degrades to clip, documented).
+        import numpy as _np
+        from ..framework.core import Tensor as _T
+        idx_val = index._data if isinstance(index, _T) else index
+        if not isinstance(idx_val, jax.core.Tracer):
+            n = 1
+            for s in (x._data.shape if isinstance(x, _T) else x.shape):
+                n *= s
+            inp = _np.asarray(idx_val)
+            if inp.size and ((inp < -n) | (inp >= n)).any():
+                raise IndexError(
+                    f"paddle.take: index out of range for input with "
+                    f"{n} elements (mode='raise')")
+
     def fn(a, idx):
         flat = a.reshape(-1)
+        if mode == "raise":
+            # negatives are valid python-style indices in raise mode, but
+            # jnp's clip mode would clamp them to 0 — normalize first
+            idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
         m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
         return jnp.take(flat, idx, mode=m)
 

@@ -84,8 +84,9 @@ def int8_matmul(x, w_int8, scale, block_m=128, block_n=128, block_k=128,
     return out[:m, :n]
 
 
-def quantize_weight(w, axis=-1):
-    """f32 [K, N] -> (int8 [K, N], scale [N]) symmetric per-output-channel."""
+def quantize_weight(w):
+    """f32 [K, N] -> (int8 [K, N], scale [N]) symmetric per-output-channel
+    (abs-max over the reduction axis K)."""
     amax = jnp.max(jnp.abs(w), axis=0)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)

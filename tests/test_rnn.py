@@ -99,3 +99,82 @@ def test_generic_rnn_wrapper():
     rnn_r = RNN(cell, is_reverse=True)
     out_r, _ = rnn_r(_x())
     assert out_r.shape == [2, 5, 8]
+
+
+def test_initial_states_honored():
+    """Round-2 ADVICE fix: initial_states must seed the scan (was silently
+    zero-initialized)."""
+    paddle.seed(7)
+    lstm = LSTM(4, 8)
+    x = _x(seed=8)
+    h0 = paddle.randn([1, 2, 8])
+    c0 = paddle.randn([1, 2, 8])
+    out0, _ = lstm(x)
+    out1, (h, c) = lstm(x, (h0, c0))
+    assert not np.allclose(out0.numpy(), out1.numpy())
+
+    # oracle: drive the cell loop from the same initial state
+    cell = LSTMCell(4, 8)
+    for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+        getattr(cell, n).set_value(getattr(lstm.cells[0], n).numpy())
+    state = (h0[0], c0[0])
+    for t in range(5):
+        o, state = cell(x[:, t], state)
+    np.testing.assert_allclose(out1.numpy()[:, -1], o.numpy(), atol=1e-5)
+    np.testing.assert_allclose(h.numpy()[0], state[0].numpy(), atol=1e-5)
+
+    # GRU path: [nl*ndirs, B, H] tensor form
+    gru = GRU(4, 8)
+    g0 = paddle.randn([1, 2, 8])
+    ga, _ = gru(x)
+    gb, _ = gru(x, g0)
+    assert not np.allclose(ga.numpy(), gb.numpy())
+
+
+def test_sequence_length_masks_outputs_and_states():
+    """sequence_length semantics: outputs past each length are zero and the
+    final state is the state at step len-1 (forward direction)."""
+    paddle.seed(8)
+    gru = GRU(4, 8)
+    x = _x(b=2, t=5, seed=9)
+    lens = paddle.to_tensor(np.array([3, 5], np.int64))
+    out, h = gru(x, sequence_length=lens)
+    o = out.numpy()
+    # example 0: steps 3,4 masked to zero; example 1 untouched
+    assert np.all(o[0, 3:] == 0)
+    assert not np.all(o[1, 3:] == 0)
+    # final state of example 0 == output at its last valid step
+    np.testing.assert_allclose(h.numpy()[0, 0], o[0, 2], atol=1e-6)
+    # full-length example matches the unmasked run
+    full, hf = gru(x)
+    np.testing.assert_allclose(o[1], full.numpy()[1], atol=1e-6)
+    np.testing.assert_allclose(h.numpy()[0, 1], hf.numpy()[0, 1], atol=1e-6)
+
+
+def test_sequence_length_bidirectional():
+    """Reverse direction must start from each example's last valid step."""
+    paddle.seed(9)
+    lstm = LSTM(4, 8, direction="bidirect")
+    x = _x(b=2, t=5, seed=10)
+    lens = paddle.to_tensor(np.array([3, 5], np.int64))
+    out, _ = lstm(x, sequence_length=lens)
+    o = out.numpy()
+    assert np.all(o[0, 3:] == 0)
+    # oracle: run the truncated example alone at its true length
+    x_trunc = paddle.to_tensor(x.numpy()[:1, :3])
+    out_t, _ = lstm(x_trunc)
+    np.testing.assert_allclose(o[0, :3], out_t.numpy()[0], atol=1e-5)
+
+
+def test_interlayer_dropout_applied():
+    paddle.seed(10)
+    rnn = GRU(4, 8, num_layers=2, dropout=0.5)
+    x = _x(seed=11)
+    rnn.train()
+    a = rnn(x)[0].numpy()
+    b = rnn(x)[0].numpy()
+    assert not np.allclose(a, b)          # stochastic between calls
+    rnn.eval()
+    c = rnn(x)[0].numpy()
+    d = rnn(x)[0].numpy()
+    np.testing.assert_allclose(c, d)      # deterministic in eval
