@@ -85,11 +85,21 @@ def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
 
     Returns None, or an object with ``.wait()`` when ``async_save``.
     """
-    import time as _time
-
     os.makedirs(path, exist_ok=True)
     rank, nprocs = _proc_index(), _proc_count()
-    t_start = _time.time()
+    # Staleness reference for the no-save_id metadata merge: the save-start
+    # instant measured on the checkpoint FILESYSTEM's clock (a probe file's
+    # mtime), so NFS/local clock skew cannot misclassify fresh rank files.
+    t_start = None
+    if rank == coordinator_rank and nprocs > 1 and save_id is None:
+        probe = os.path.join(path, f".save_probe.{os.getpid()}")
+        try:
+            with open(probe, "w") as f:
+                f.write("x")
+            t_start = os.path.getmtime(probe)
+            os.remove(probe)
+        except OSError:
+            t_start = None
     flat = _flatten(state_dict)
     meta = {"version": 1, "tensors": {}, "nonarray": {}}
     jobs = []
@@ -184,21 +194,22 @@ def _merge_rank_meta(path, nprocs, own=None, timeout=120.0, poll=0.25,
     deadline = _time.monotonic() + timeout
     want = {r: _rank_meta_name(r, save_id) for r in range(nprocs)}
     metas = {}
-    stale = {}      # parsed but older than this save — last-resort only
+    stale = {}      # rank -> path of a file that predates this save
     while True:
         for r, name in want.items():
             if r in metas:
                 continue
             fpath = os.path.join(path, name)
             try:
+                # min_mtime is measured on the same filesystem clock (a
+                # probe file written at save start), so a small slack
+                # covers mtime granularity, not clock skew
                 if min_mtime is not None and save_id is None \
-                        and os.path.getmtime(fpath) < min_mtime - 5.0:
-                    # looks like a leftover from a previous save; keep
-                    # polling for a rewrite, but hold onto it — fs clock
-                    # skew can make a legitimate fresh file look old, and
-                    # merging it at deadline beats zero-filling its shards
-                    with open(fpath) as f:
-                        stale[r] = json.load(f)
+                        and os.path.getmtime(fpath) < min_mtime - 2.0:
+                    # leftover from a previous save; keep polling for a
+                    # rewrite and only fall back to it at deadline —
+                    # merging an old file beats zero-filling its shards
+                    stale[r] = fpath
                     continue
                 with open(fpath) as f:
                     metas[r] = json.load(f)
@@ -207,11 +218,15 @@ def _merge_rank_meta(path, nprocs, own=None, timeout=120.0, poll=0.25,
         if len(metas) == nprocs or _time.monotonic() >= deadline:
             break
         _time.sleep(poll)
-    for r, m in stale.items():
+    for r, fpath in stale.items():
         if r not in metas:
-            warnings.warn(f"dist checkpoint: using possibly-stale rank {r} "
-                          f"metadata (mtime predates this save)")
-            metas[r] = m
+            try:
+                with open(fpath) as f:
+                    metas[r] = json.load(f)
+                warnings.warn(f"dist checkpoint: using possibly-stale rank "
+                              f"{r} metadata (mtime predates this save)")
+            except (OSError, ValueError):
+                pass
     if len(metas) < nprocs:
         warnings.warn(
             f"dist checkpoint: only {len(metas)}/{nprocs} rank metadata "

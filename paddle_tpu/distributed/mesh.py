@@ -88,3 +88,61 @@ def sharding(*spec) -> NamedSharding:
 
 def replicated() -> NamedSharding:
     return NamedSharding(get_mesh(), PartitionSpec())
+
+
+def batch_spec(ndim: int = 3):
+    """Canonical activation PartitionSpec for a [B, T, ...] tensor on the
+    hybrid mesh: batch over the data axes (dp + sharding — ZeRO shards the
+    batch over both), sequence over sep, feature dims replicated (mp splits
+    happen inside attention/MLP via weight shardings). None when no
+    multi-device mesh is active."""
+    if not has_mesh():
+        return None
+    m = get_mesh()
+    if len(m.devices.flat) <= 1:
+        return None
+    data_axes = tuple(ax for ax in ("dp", "sharding")
+                      if int(m.shape.get(ax, 1)) > 1)
+    sep = "sep" if int(m.shape.get("sep", 1)) > 1 else None
+    if not data_axes and sep is None:
+        return None
+    parts = [data_axes if data_axes else None]
+    if ndim >= 2:
+        parts.append(sep)
+    parts += [None] * (ndim - len(parts))
+    return PartitionSpec(*parts)
+
+
+def strip_axis(spec: PartitionSpec, axis: str) -> PartitionSpec:
+    """Remove ``axis`` from every dim entry of a PartitionSpec."""
+    parts = []
+    for e in tuple(spec):
+        if e == axis:
+            parts.append(None)
+        elif isinstance(e, tuple):
+            kept = tuple(a for a in e if a != axis)
+            parts.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        else:
+            parts.append(e)
+    return PartitionSpec(*parts)
+
+
+def unshard_for_compute(arrs, specs, fsdp_axis="sharding"):
+    """ZeRO all-gather at step entry (reference semantics:
+    ``GroupShardedStage3`` gathers each param before forward and
+    reduce-scatters its grad after backward — SURVEY.md §2.3 sharding).
+
+    Constrains every array to its PartitionSpec with ``fsdp_axis``
+    stripped: XLA materializes that as an all-gather over the fsdp axis,
+    and the constraint's transpose reduce-scatters the cotangent back to
+    the sharded layout — grads land already fsdp-sharded for the (also
+    sharded) optimizer update. Being explicit here keeps GSPMD from ever
+    propagating the storage-layout 'sharding' split into activations
+    (the "Involuntary full rematerialization" failure)."""
+    if not has_mesh() or axis_size(fsdp_axis) <= 1:
+        return list(arrs)
+    out = []
+    for a, s in zip(arrs, specs):
+        stripped = strip_axis(s, fsdp_axis)
+        out.append(jax.lax.with_sharding_constraint(a, sharding(*stripped)))
+    return out

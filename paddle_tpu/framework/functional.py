@@ -133,9 +133,13 @@ class FunctionalModule:
         """PartitionSpec per param (in ``self.params`` order) from an ordered
         ``(name-regex, spec-tuple)`` rule list (first match wins; see
         ``paddle_tpu.models.*.sharding_rules``). With ``fsdp_axis`` set
-        (ZeRO-3 / sharding stage-3), each param's first dimension that is not
-        already sharded and is divisible by ``fsdp_size`` is additionally
-        sharded on that axis."""
+        (ZeRO-3 / sharding stage-3), each >=2-D param's first dimension that
+        is not already sharded and is divisible by ``fsdp_size`` is
+        additionally sharded on that axis. 1-D params (norm scales, biases)
+        stay replicated: sharding them saves nothing and GSPMD propagates
+        their split into every activation that consumes them, forcing an
+        "Involuntary full rematerialization" replicate-repartition (observed
+        round 1 in the dryrun)."""
         import re
         from jax.sharding import PartitionSpec as P
 
@@ -150,7 +154,7 @@ class FunctionalModule:
                     spec = tuple(s)
                     break
             spec = list(spec) + [None] * (len(p.shape) - len(spec))
-            if fsdp_axis is not None and fsdp_size > 1:
+            if fsdp_axis is not None and fsdp_size > 1 and len(p.shape) >= 2:
                 for d, (sz, ax) in enumerate(zip(p.shape, spec)):
                     if ax is None and sz % fsdp_size == 0 and sz >= fsdp_size:
                         spec[d] = fsdp_axis

@@ -26,6 +26,30 @@ from ..autograd.tape import apply
 from .generation import GenerationMixin
 
 
+def shard_activation(x):
+    """Pin a [B, T, H] activation to the canonical data layout (batch over
+    dp+sharding, seq over sep) when tracing under a multi-device mesh.
+    Without this, GSPMD can propagate a weight's ZeRO 'sharding'-axis split
+    into the residual stream and fall back to replicate-repartition
+    ("Involuntary full rematerialization") — the maxtext-style activation
+    annotation recipe. No-op in eager / single-device."""
+    import jax
+    from ..distributed import mesh as mesh_mod
+
+    spec = mesh_mod.batch_spec(3)
+    if spec is None:
+        return x
+
+    sh = mesh_mod.sharding(*spec)
+
+    def fn(a):
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sh)
+        return a
+
+    return apply(fn, x, op_name="shard_activation")
+
+
 class LlamaConfig:
     def __init__(self, vocab_size=32000, hidden_size=4096,
                  intermediate_size=11008, num_hidden_layers=32,
@@ -179,6 +203,7 @@ class LlamaModel(Layer):
     def forward(self, input_ids, attn_mask=None, position_ids=None,
                 cache=None):
         hidden = self.embed_tokens(input_ids)
+        hidden = shard_activation(hidden)
         recompute = (self.config.use_recompute and self.training
                      and cache is None)
         if recompute:
@@ -190,6 +215,7 @@ class LlamaModel(Layer):
                 hidden = remat(layer, hidden, attn_mask, position_ids)
             else:
                 hidden = layer(hidden, attn_mask, position_ids, cache)
+            hidden = shard_activation(hidden)
         hidden = self.norm(hidden)
         if cache is not None:
             cache.advance(input_ids.shape[1])

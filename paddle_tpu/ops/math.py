@@ -518,17 +518,18 @@ def mv(x, vec, name=None):
 def take(x, index, mode="raise", name=None):
     if mode == "raise":
         # jnp has no in-trace raise mode; match the reference's eager
-        # behavior with a host-side bounds check when the index is
-        # concrete (under jit this degrades to clip, documented).
-        import numpy as _np
+        # behavior with a bounds check when the index is concrete (under
+        # jit this degrades to clip, documented). The check reduces on
+        # device and fetches ONE scalar — not the whole index array.
         from ..framework.core import Tensor as _T
         idx_val = index._data if isinstance(index, _T) else index
         if not isinstance(idx_val, jax.core.Tracer):
             n = 1
             for s in (x._data.shape if isinstance(x, _T) else x.shape):
                 n *= s
-            inp = _np.asarray(idx_val)
-            if inp.size and ((inp < -n) | (inp >= n)).any():
+            idx_arr = jnp.asarray(idx_val)
+            if idx_arr.size and bool(jnp.any((idx_arr < -n) |
+                                             (idx_arr >= n))):
                 raise IndexError(
                     f"paddle.take: index out of range for input with "
                     f"{n} elements (mode='raise')")

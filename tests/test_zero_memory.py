@@ -1,0 +1,88 @@
+"""ZeRO (sharding stage 2/3) memory accounting (VERDICT.md round-1 item 6;
+reference semantics: ``group_sharded_stage3.py`` params-sharded-at-rest +
+grad reduce-scatter).
+
+Proves the sharded layouts are real, not just claimed:
+- params/opt-state at rest occupy ~1/shd of their global bytes per device,
+- grads come OUT of the step already fsdp-sharded (the transpose of the
+  ``unshard_for_compute`` all-gather is a reduce-scatter),
+- the compiled step's per-device argument bytes shrink accordingly
+  (``compiled.memory_analysis()`` when the backend reports it).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.framework.functional import FunctionalModule
+from paddle_tpu.models import llama_tiny, LlamaForCausalLM
+
+
+def _bytes(a):
+    return a.size * a.dtype.itemsize
+
+
+def test_zero3_params_and_grads_sharded_at_rest():
+    shd = 4
+    mesh = mesh_mod.init_mesh({"dp": 2, "sharding": shd})
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        fm = FunctionalModule(model, training=True)
+        specs = fm.param_specs(LlamaForCausalLM.sharding_rules(),
+                               fsdp_axis="sharding", fsdp_size=shd)
+        shards = [NamedSharding(mesh, s) for s in specs]
+        p_arrs = [jax.device_put(a, s)
+                  for a, s in zip(fm.param_arrays(), shards)]
+
+        # at rest: every >=2-D param holds 1/shd of its bytes per device
+        for a, spec in zip(p_arrs, specs):
+            per_dev = a.addressable_shards[0].data.nbytes
+            if a.ndim >= 2:
+                assert "sharding" in jax.tree.leaves(tuple(spec)), spec
+                assert per_dev * shd == _bytes(a), (a.shape, spec)
+            else:
+                assert per_dev == _bytes(a), (a.shape, spec)
+
+        key = fm.next_key()
+        rng = np.random.default_rng(0)
+        ids = jax.device_put(
+            jnp.asarray(rng.integers(0, 128, (8, 16)), jnp.int32),
+            NamedSharding(mesh, P(("dp", "sharding"))))
+
+        def grads_fn(ps, key, ids):
+            def loss_fn(ps):
+                ps = mesh_mod.unshard_for_compute(ps, specs, "sharding")
+                (loss, _), _ = fm(ps, [], key, ids, labels=ids)
+                return loss
+
+            return jax.value_and_grad(loss_fn)(ps)
+
+        step = jax.jit(grads_fn,
+                       in_shardings=(shards, None,
+                                     NamedSharding(mesh, P(("dp", "sharding")))),
+                       out_shardings=(NamedSharding(mesh, P()), shards))
+        with mesh:
+            loss, grads = step(p_arrs, key, ids)
+        assert np.isfinite(float(loss))
+        # grads land fsdp-sharded (reduce-scatter), matching param layout
+        for g, a in zip(grads, p_arrs):
+            assert g.sharding == a.sharding, (g.shape, g.sharding, a.sharding)
+            if g.ndim >= 2:
+                assert g.addressable_shards[0].data.nbytes * shd == _bytes(g)
+
+        # compiled accounting: per-device argument bytes must be well under
+        # the global param bytes (i.e. XLA sees sharded storage, not
+        # replicas). memory_analysis is backend-dependent; skip if absent.
+        compiled = step.lower(p_arrs, key, ids).compile()
+        ma = compiled.memory_analysis()
+        if ma is not None and getattr(ma, "argument_size_in_bytes", 0):
+            global_param_bytes = sum(_bytes(a) for a in p_arrs)
+            big = sum(_bytes(a) for a in p_arrs if a.ndim >= 2)
+            expect_args = global_param_bytes - big * (1 - 1 / shd)
+            assert ma.argument_size_in_bytes < global_param_bytes * 0.7, (
+                ma.argument_size_in_bytes, global_param_bytes, expect_args)
+    finally:
+        mesh_mod.reset_mesh()
