@@ -130,6 +130,38 @@ def pipeline_spmd_interleaved(stage_fn, n_stages, n_micro, vpp,
     return run
 
 
+def pipeline_seq_forward(block_fn, stacked_params, micro_inputs, *, pre=None,
+                         post=None, mesh=None, axis_name="pp",
+                         vpp_degree=1):
+    """Full-model pipelined forward for stage-heterogeneous LMs (reference:
+    ``pp_layers.py`` stage partition with embedding on stage 0, head on
+    stage S-1, ``SharedLayerDesc`` tied weights).
+
+    TPU-native stage heterogeneity: on GPU pipelines the embedding/head
+    live on the first/last rank because weights are pinned to processes.
+    Under SPMD there is no pinning — so ``pre`` (embedding) and ``post``
+    (final norm + head) run as plain sharded compute over the WHOLE mesh
+    (every chip's MXU works on the vocab matmul instead of 1/S of them),
+    and only the homogeneous decoder-block run is scheduled through the
+    ppermute pipeline. Tied embeddings need no ``allreduce_shared_weight``:
+    reference (``pipeline_parallel.py`` shared-weight sync) — here the tied
+    array simply appears in both ``pre`` and ``post`` closures and
+    ``jax.grad`` sums the two contributions.
+
+    ``pre``/``post``: single-microbatch callables ``x -> y`` (vmapped over
+    the micro axis); ``block_fn(chunk_params, x)`` applies one pipeline
+    chunk. ``micro_inputs``: [M, mb, ...].
+    """
+    h = micro_inputs
+    if pre is not None:
+        h = jax.vmap(pre)(h)
+    h = pipeline_forward(block_fn, stacked_params, h, mesh=mesh,
+                         axis_name=axis_name, vpp_degree=vpp_degree)
+    if post is not None:
+        h = jax.vmap(post)(h)
+    return h
+
+
 def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
                      axis_name="pp", n_stages=None, vpp_degree=1):
     """Pipelined forward over the global mesh's pp axis (differentiable,

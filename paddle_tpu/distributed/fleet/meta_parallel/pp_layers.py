@@ -55,6 +55,7 @@ class PipelineLayer(Layer):
         self._recompute_interval = recompute_interval
         self._num_stages = num_stages or mesh_mod.axis_size("pp")
         self._seg_method = seg_method
+        self._vpp = int(num_virtual_pipeline_stages or 1)
         self.layers_desc = list(layers)
         self._shared_layers = {}  # key -> first-built instance
         built = []
@@ -106,6 +107,28 @@ class PipelineLayer(Layer):
         chunks = np.array_split(marks, n_stages)
         parts = [0] + [int(c[0]) for c in chunks[1:]] + [n_layers]
         return parts
+
+    def homogeneous_run(self):
+        """(lo, hi) bounds of the longest contiguous run of same-class,
+        same-param-signature layers — the pipelineable block region for the
+        jitted SPMD engine; layers before/after become the pre/post
+        segments (reference: embedding/head stages in ``pp_layers.py``)."""
+        def sig(l):
+            return tuple((tuple(p.shape), str(p.dtype))
+                         for p in l.parameters())
+
+        best = (0, 0)
+        i, n = 0, len(self.run_function)
+        while i < n:
+            j = i + 1
+            cls, s0 = type(self.run_function[i]), sig(self.run_function[i])
+            while j < n and type(self.run_function[j]) is cls \
+                    and sig(self.run_function[j]) == s0:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        return best
 
     def get_stage_layers(self, stage_id):
         lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id + 1]
