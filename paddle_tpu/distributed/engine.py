@@ -148,18 +148,201 @@ def pipeline_seq_forward(block_fn, stacked_params, micro_inputs, *, pre=None,
     array simply appears in both ``pre`` and ``post`` closures and
     ``jax.grad`` sums the two contributions.
 
-    ``pre``/``post``: single-microbatch callables ``x -> y`` (vmapped over
-    the micro axis); ``block_fn(chunk_params, x)`` applies one pipeline
-    chunk. ``micro_inputs``: [M, mb, ...].
+    ``pre``/``post``: batched callables ``x -> y`` applied to the
+    microbatches flattened to ONE [M·mb, ...] batch (bigger MXU matmuls
+    than per-micro application, and activation sharding constraints see
+    their canonical [B, T, H] rank); ``block_fn(chunk_params, x)`` applies
+    one pipeline chunk. ``micro_inputs``: [M, mb, ...].
     """
+    def _flat_apply(fn, x):
+        m, mb = x.shape[:2]
+        y = fn(x.reshape((m * mb,) + tuple(x.shape[2:])))
+        return y.reshape((m, mb) + tuple(y.shape[1:]))
+
     h = micro_inputs
     if pre is not None:
-        h = jax.vmap(pre)(h)
+        h = _flat_apply(pre, h)
     h = pipeline_forward(block_fn, stacked_params, h, mesh=mesh,
                          axis_name=axis_name, vpp_degree=vpp_degree)
     if post is not None:
-        h = jax.vmap(post)(h)
+        h = _flat_apply(post, h)
     return h
+
+
+class PipelinedModule:
+    """Functionalize a ``PipelineLayer`` for the jitted SPMD engine —
+    the bridge that lets a REAL stage-heterogeneous LM (embedding stage,
+    N decoder blocks, norm+head stage, optionally tied embeddings) train
+    through ``pipeline_forward`` (reference:
+    ``fleet/meta_parallel/pipeline_parallel.py`` 1F1B over the stage
+    modules built by ``pp_layers.py``).
+
+    Split: ``PipelineLayer.homogeneous_run()`` finds the longest run of
+    identical-signature layers (the decoder blocks); everything before is
+    the *pre* segment (embedding), everything after the *post* segment
+    (final norm + lm head). Pre/post params stay unstacked ("edge"
+    params, sharded by the caller's TP/fsdp rules); block params are
+    stacked ``[S·vpp, layers_per_chunk, ...]`` and sharded ``P('pp')``.
+    Tied embeddings (``SharedLayerDesc``) need no shared-weight allreduce:
+    the tied Parameter is deduped into ONE edge array consumed by both
+    segments, so ``jax.grad`` sums the two contributions.
+
+    Constraint: blocks must be deterministic (no dropout) — the chunk fn
+    runs under ``shard_map`` where closing over a traced RNG key is not
+    portable; Llama/GPT pretrain configs satisfy this.
+
+    Usage::
+
+        pm = PipelinedModule(pipe_layer, mesh=mesh)
+        out = pm(pm.edge_arrays(), pm.stacked_arrays(), micro_x)  # [M, ...]
+    """
+
+    def __init__(self, pipe_layer, mesh=None, axis_name="pp", n_stages=None,
+                 vpp_degree=None):
+        from . import mesh as mesh_mod
+        from ..framework.functional import FunctionalModule
+
+        self.axis_name = axis_name
+        self.mesh = mesh or (mesh_mod.get_mesh() if mesh_mod.has_mesh()
+                             else None)
+        if n_stages is None:
+            n_stages = (int(self.mesh.shape[axis_name])
+                        if self.mesh is not None and
+                        axis_name in self.mesh.shape else pipe_layer._num_stages)
+        self.n_stages = n_stages
+        self.vpp = int(vpp_degree if vpp_degree is not None
+                       else getattr(pipe_layer, "_vpp", 1))
+        n_chunks = self.n_stages * self.vpp
+
+        lo, hi = pipe_layer.homogeneous_run()
+        if hi - lo < n_chunks:
+            raise ValueError(
+                f"homogeneous block run has {hi - lo} layers < "
+                f"{n_chunks} pipeline chunks (stages {self.n_stages} × vpp "
+                f"{self.vpp})")
+        # trailing blocks that don't fill a chunk fold into the post segment
+        hi -= (hi - lo) % n_chunks
+        self.blocks = pipe_layer.run_function[lo:hi]
+        self.lpc = len(self.blocks) // n_chunks          # layers per chunk
+        self.n_chunks = n_chunks
+
+        self._edge = _EdgeSegments(pipe_layer.run_function[:lo],
+                                   pipe_layer.run_function[hi:])
+        self._fm_pre = FunctionalModule(self._edge, method=self._edge.run_pre)
+        self._fm_post = FunctionalModule(self._edge, method=self._edge.run_post)
+        self._fm_blk = FunctionalModule(self.blocks[0])
+        self._blk_params = [list(b.parameters()) for b in self.blocks]
+        for ps in self._blk_params:
+            assert len(ps) == len(self._fm_blk.params), \
+                "pipeline blocks must share one parameter signature"
+        if any(b for blk in self.blocks for b in blk.buffers()):
+            raise ValueError("pipelined blocks with mutable buffers are not "
+                             "supported (BN stats can't thread the schedule)")
+        self.edge_params = self._fm_pre.params           # deduped, tied once
+
+    # -- state ---------------------------------------------------------------
+    def edge_arrays(self):
+        return [p._data for p in self.edge_params]
+
+    def stacked_arrays(self):
+        """Stack each block-param leaf [n_chunks, lpc, ...] in chunk order
+        (chunk c = blocks [c·lpc, (c+1)·lpc))."""
+        outs = []
+        for j in range(len(self._fm_blk.params)):
+            leaf = jnp.stack([ps[j]._data for ps in self._blk_params])
+            outs.append(leaf.reshape((self.n_chunks, self.lpc)
+                                     + tuple(leaf.shape[1:])))
+        return outs
+
+    def write_back(self, edge_arrs, stacked_arrs):
+        """Write updated arrays back into the eager Parameters."""
+        for p, a in zip(self.edge_params, edge_arrs):
+            p._data = a
+        for j, a in enumerate(stacked_arrs):
+            flat = a.reshape((-1,) + tuple(a.shape[2:]))
+            for i, ps in enumerate(self._blk_params):
+                ps[j]._data = flat[i]
+
+    def unstack_grads(self, stacked_grads):
+        """Per-block grad list (parallel to ``self.blocks``) from stacked
+        grads — for eager ``.grad`` write-back in train_batch."""
+        per_block = [[] for _ in self.blocks]
+        for g in stacked_grads:
+            flat = g.reshape((-1,) + tuple(g.shape[2:]))
+            for i in range(len(self.blocks)):
+                per_block[i].append(flat[i])
+        return per_block
+
+    # -- the pure pipelined forward -----------------------------------------
+    def __call__(self, edge_arrs, stacked_arrs, micro_inputs):
+        import jax.random as jrandom
+        const_key = jrandom.PRNGKey(0)   # blocks are deterministic (asserted)
+
+        def chunk_fn(chunk_arrs, x):
+            for l in range(self.lpc):
+                arrs = [a[l] for a in chunk_arrs]
+                x, _ = self._fm_blk(arrs, [], const_key, x)
+            return x
+
+        pre = post = None
+        if self._edge.has_pre:
+            def pre(x):
+                return self._fm_pre(edge_arrs, [], const_key, x)[0]
+        if self._edge.has_post:
+            def post(x):
+                return self._fm_post(edge_arrs, [], const_key, x)[0]
+        return pipeline_seq_forward(chunk_fn, stacked_arrs, micro_inputs,
+                                    pre=pre, post=post, mesh=self.mesh,
+                                    axis_name=self.axis_name,
+                                    vpp_degree=self.vpp)
+
+
+class _EdgeSegments:
+    """Container for the pre/post (embedding / norm+head) segments with
+    tied parameters deduped across both (``Layer.named_parameters`` memo)."""
+
+    def __init__(self, pre_layers, post_layers):
+        from ..nn.layer import Layer
+
+        class _Holder(Layer):
+            pass
+
+        holder = _Holder()
+        for i, l in enumerate(pre_layers):
+            holder.add_sublayer(f"pre_{i}", l)
+        for i, l in enumerate(post_layers):
+            holder.add_sublayer(f"post_{i}", l)
+        self._holder = holder
+        self._pre = list(pre_layers)
+        self._post = list(post_layers)
+        self.has_pre = bool(pre_layers)
+        self.has_post = bool(post_layers)
+
+    # FunctionalModule protocol: parameters()/buffers()/sublayers()
+    def parameters(self):
+        return self._holder.parameters()
+
+    def named_parameters(self):
+        return self._holder.named_parameters()
+
+    def buffers(self):
+        return self._holder.buffers()
+
+    def sublayers(self, include_self=False):
+        return self._holder.sublayers(include_self=False)
+
+    @staticmethod
+    def _run(layers, x):
+        for l in layers:
+            fwd = getattr(l, "_shared_forward", None)
+            x = fwd(l, x) if fwd is not None else l(x)
+        return x
+
+    def run_pre(self, x):
+        return self._run(self._pre, x)
+
+    def run_post(self, x):
+        return self._run(self._post, x)
 
 
 def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,

@@ -50,14 +50,105 @@ class PipelineParallel(Layer):
         self.schedule_mode = pp_cfg.get("schedule_mode", "1F1B")
         self.num_stages = layers._num_stages
         self._loss_fn = layers._loss_fn
+        self._spmd = None        # None = undecided, False = eager fallback
+        self._spmd_step = None
 
     def forward(self, x):
         return self._layers(x)
 
+    # -- jitted SPMD engine dispatch ----------------------------------------
+    def _spmd_module(self):
+        """Build (once) the PipelinedModule when a pp mesh axis is active
+        and the model qualifies (deterministic homogeneous blocks, Layer
+        loss_fn, single-tensor inputs). Returns None → eager fallback."""
+        if self._spmd is not None:
+            return self._spmd or None
+        self._spmd = False
+        from ... import mesh as mesh_mod
+        if not (mesh_mod.has_mesh() and mesh_mod.axis_size("pp") > 1
+                and isinstance(self._loss_fn, Layer)):
+            return None
+        try:
+            from ....distributed.engine import PipelinedModule
+            pm = PipelinedModule(self._layers)
+            for blk in pm.blocks:
+                for sub in blk.sublayers(include_self=True):
+                    if "Dropout" in type(sub).__name__ and \
+                            getattr(sub, "p", 0) > 0:
+                        raise ValueError("dropout inside pipeline blocks")
+        except ValueError as e:
+            import sys
+            print(f"PipelineParallel: eager fallback ({e})", file=sys.stderr)
+            return None
+        self._spmd = pm
+        return pm
+
+    def _train_batch_spmd(self, pm, inputs, labels, optimizer, lr_scheduler,
+                          scaler):
+        """One pipelined step through the jitted ppermute engine: grads for
+        every stage computed in ONE jitted SPMD program (the TPU answer to
+        the reference's 1F1B send/recv loop), written back to ``.grad``,
+        then the eager optimizer/scaler step off the shared tape path."""
+        import jax
+        import jax.numpy as jnp
+
+        n = self.accumulate_steps
+        x, y = inputs._data, labels._data
+        if x.shape[0] % n != 0:
+            raise ValueError(f"batch size {x.shape[0]} not divisible by "
+                             f"accumulate_steps {n}")
+        mb = x.shape[0] // n
+        micro_x = x.reshape((n, mb) + tuple(x.shape[1:]))
+        micro_y = y.reshape((n, mb) + tuple(y.shape[1:]))
+        scale = jnp.asarray(scaler._scale if scaler is not None else 1.0,
+                            jnp.float32)
+
+        if self._spmd_step is None:
+            from ....framework.functional import FunctionalModule
+            loss_fm = FunctionalModule(self._loss_fn)
+            key = jax.random.PRNGKey(0)
+
+            def step(edge, stacked, mx, my, scale):
+                def scaled_loss(e, s):
+                    out = pm(e, s, mx)
+                    per = jax.vmap(
+                        lambda o, l: loss_fm([], [], key, o, l)[0])(out, my)
+                    loss = per.mean()
+                    return loss * scale.astype(loss.dtype), loss
+
+                (_, loss), (ge, gs) = jax.value_and_grad(
+                    scaled_loss, argnums=(0, 1), has_aux=True)(edge, stacked)
+                return loss, ge, gs
+
+            self._spmd_step = jax.jit(step)
+
+        loss, ge, gs = self._spmd_step(pm.edge_arrays(), pm.stacked_arrays(),
+                                       micro_x, micro_y, scale)
+        for p, g in zip(pm.edge_params, ge):
+            p.grad = Tensor(g)
+        for blk, gl in zip(pm.blocks, pm.unstack_grads(gs)):
+            for p, g in zip(blk.parameters(), gl):
+                p.grad = Tensor(g)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(loss)
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """One full pipelined step: micro-batch accumulation + optimizer step.
-        ``data`` = [inputs, labels] (reference contract)."""
+        ``data`` = [inputs, labels] (reference contract). With a pp mesh
+        axis active, runs the jitted SPMD ppermute schedule; otherwise the
+        eager accumulation shim (numerics-identical)."""
         inputs, labels = data
+        if isinstance(inputs, Tensor) and isinstance(labels, Tensor):
+            pm = self._spmd_module()
+            if pm is not None:
+                return self._train_batch_spmd(pm, inputs, labels, optimizer,
+                                              lr_scheduler, scaler)
         n = self.accumulate_steps
         micro_in = _split_micro(inputs, n)
         micro_lb = _split_micro(labels, n)

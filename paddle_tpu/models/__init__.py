@@ -10,7 +10,8 @@ the TPU-native form of the reference's mp/sharding wrappers: annotate, and
 XLA's SPMD partitioner inserts the collectives (SURVEY.md §7.0).
 """
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
-                    LlamaPretrainingCriterion, llama3_8b, llama_tiny)
+                    LlamaPretrainingCriterion, LlamaForCausalLMPipe,
+                    build_llama_pipe, llama3_8b, llama_tiny)
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b, gpt_tiny
 from .bert import (BertConfig, BertModel, BertForSequenceClassification,
                    BertForPretraining, ErnieConfig, ErnieModel,
@@ -20,7 +21,8 @@ from .ppyoloe import (PPYOLOE, DetectionLoss, ppyoloe_lite, CSPBackbone,
 
 __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-    "LlamaPretrainingCriterion", "llama3_8b", "llama_tiny",
+    "LlamaPretrainingCriterion", "LlamaForCausalLMPipe",
+    "build_llama_pipe", "llama3_8b", "llama_tiny",
     "GPTConfig", "GPTModel", "GPTForCausalLM", "gpt3_1p3b", "gpt_tiny",
     "BertConfig", "BertModel", "BertForSequenceClassification",
     "BertForPretraining", "ErnieConfig", "ErnieModel",

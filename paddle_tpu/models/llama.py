@@ -288,3 +288,78 @@ class LlamaForCausalLM(GenerationMixin, Layer):
             (r"lm_head\.weight$", (None, mp)),
             (r".*", ()),   # norms etc. replicated
         ]
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel model description (reference: PaddleNLP
+# ``LlamaForCausalLMPipe`` built on ``PipelineLayer`` with EmbeddingPipe /
+# decoder LayerDescs / RMSNormPipe / LMHeadPipe, tied embeddings via
+# ``SharedLayerDesc`` — fleet pp_layers.py)
+# ---------------------------------------------------------------------------
+
+class LlamaEmbeddingPipe(Layer):
+    """Embedding stage: ids -> hidden. Doubles as the tied lm head via
+    ``SharedLayerDesc(forward_func=_tied_head_forward)``."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.word_embeddings = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=Normal(0.0, config.initializer_range))
+
+    def forward(self, input_ids):
+        return shard_activation(self.word_embeddings(input_ids))
+
+
+def _tied_head_forward(layer, hidden):
+    """Head forward for the tied-embedding SharedLayerDesc instance:
+    logits = hidden @ E^T (same Parameter object as the embedding stage —
+    no shared-weight allreduce needed; grads sum through jax.grad)."""
+    return pmath.matmul(hidden, layer.word_embeddings.weight,
+                        transpose_y=True)
+
+
+class LlamaLMHeadPipe(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              weight_attr=Normal(0.0, config.initializer_range),
+                              bias_attr=False)
+
+    def forward(self, hidden):
+        return self.lm_head(hidden)
+
+
+def build_llama_pipe(config, **pp_kwargs):
+    """``LlamaForCausalLMPipe``: the PipelineLayer description of Llama.
+    Layer list = [embedding, L decoder blocks, final RMSNorm, head]; the
+    jitted SPMD engine (``distributed/engine.py::PipelinedModule``) maps
+    the decoder run onto the pp mesh axis and runs embedding/norm/head as
+    whole-mesh sharded compute."""
+    from ..distributed.fleet.meta_parallel.pp_layers import (
+        PipelineLayer, LayerDesc, SharedLayerDesc)
+
+    descs = []
+    if config.tie_word_embeddings:
+        descs.append(SharedLayerDesc(
+            "llama_embed", LlamaEmbeddingPipe, config,
+            shared_weight_attr="word_embeddings"))
+    else:
+        descs.append(LayerDesc(LlamaEmbeddingPipe, config))
+    descs += [LayerDesc(LlamaDecoderLayer, config)
+              for _ in range(config.num_hidden_layers)]
+    descs.append(LayerDesc(RMSNorm, config.hidden_size, config.rms_norm_eps))
+    if config.tie_word_embeddings:
+        descs.append(SharedLayerDesc(
+            "llama_embed", LlamaEmbeddingPipe, config,
+            forward_func=_tied_head_forward,
+            shared_weight_attr="word_embeddings"))
+    else:
+        descs.append(LayerDesc(LlamaLMHeadPipe, config))
+    pp_kwargs.setdefault("loss_fn", LlamaPretrainingCriterion())
+    pipe = PipelineLayer(descs, **pp_kwargs)
+    pipe.config = config
+    return pipe
+
+
+LlamaForCausalLMPipe = build_llama_pipe

@@ -1,0 +1,194 @@
+"""A REAL transformer through the jitted SPMD pipeline engine (VERDICT.md
+round-1 item 3; reference parity contract: the ``hybrid_parallel_pp_layer`` /
+``hybrid_parallel_pp_embedding`` tests of ``test/collective/fleet`` — a
+pipelined GPT/Llama must match the non-pipelined oracle's loss and grads).
+
+Runs on the 8-device CPU mesh (conftest). The pipelined model is
+stage-heterogeneous: embedding pre-stage, N decoder blocks through the
+ppermute schedule, final-norm + head post-stage, optionally tied embeddings
+(SharedLayerDesc)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.engine import PipelinedModule
+from paddle_tpu.models import LlamaForCausalLMPipe, llama_tiny
+from paddle_tpu.models.llama import LlamaPretrainingCriterion
+
+
+def _make_pipe(tie=False, n_layers=4, num_stages=2, vpp=None):
+    paddle.seed(7)
+    cfg = llama_tiny(num_hidden_layers=n_layers, tie_word_embeddings=tie)
+    pipe = LlamaForCausalLMPipe(
+        cfg, num_stages=num_stages,
+        num_virtual_pipeline_stages=vpp)
+    return cfg, pipe
+
+
+def _data(cfg, batch=8, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    return ids, labels
+
+
+def _oracle_loss_and_grads(pipe, pm, ids, labels):
+    """Non-pipelined oracle: run the SAME parameter arrays through the
+    eager layer stack functionally (n_stages=1 path is NOT used — this is
+    an independent sequential apply) and grad the identical loss."""
+    crit = LlamaPretrainingCriterion()
+
+    def loss_fn(edge, stacked):
+        # sequential apply: pre, blocks in order, post
+        from paddle_tpu.framework.functional import FunctionalModule
+        key = jax.random.PRNGKey(0)
+        h = pm._fm_pre(edge, [], key, ids)[0]
+        flat = [a.reshape((-1,) + tuple(a.shape[2:])) for a in stacked]
+        for i in range(len(pm.blocks)):
+            arrs = [a[i] for a in flat]
+            h, _ = pm._fm_blk(arrs, [], key, h)
+        logits = pm._fm_post(edge, [], key, h)[0]
+        fm_crit = FunctionalModule(crit)
+        return fm_crit([], [], key, logits, labels)[0]
+
+    edge, stacked = pm.edge_arrays(), pm.stacked_arrays()
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(edge, stacked)
+    return loss, grads
+
+
+def _pipelined_loss_and_grads(pm, ids, labels, n_micro):
+    mb = ids.shape[0] // n_micro
+    mx = ids.reshape((n_micro, mb) + tuple(ids.shape[1:]))
+    crit = LlamaPretrainingCriterion()
+    from paddle_tpu.framework.functional import FunctionalModule
+    fm_crit = FunctionalModule(crit)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def step(edge, stacked):
+        def loss_fn(e, s):
+            out = pm(e, s, mx)      # [M, mb, s, V]
+            logits = out.reshape((-1,) + tuple(out.shape[2:]))
+            return fm_crit([], [], key, logits, labels)[0]
+
+        return jax.value_and_grad(loss_fn, argnums=(0, 1))(edge, stacked)
+
+    return step(pm.edge_arrays(), pm.stacked_arrays())
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_pipelined_llama_matches_oracle(tie):
+    cfg, pipe = _make_pipe(tie=tie, n_layers=4, num_stages=2)
+    mesh_mod.init_mesh({"dp": 4, "pp": 2})
+    try:
+        pm = PipelinedModule(pipe)
+        assert pm.n_stages == 2 and pm.lpc == 2
+        ids, labels = _data(cfg)
+        o_loss, (o_ge, o_gs) = _oracle_loss_and_grads(pipe, pm, ids, labels)
+        p_loss, (p_ge, p_gs) = _pipelined_loss_and_grads(pm, ids, labels,
+                                                         n_micro=4)
+        np.testing.assert_allclose(float(p_loss), float(o_loss),
+                                   rtol=2e-5, atol=2e-5)
+        for a, b in zip(p_ge, o_ge):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+        for a, b in zip(p_gs, o_gs):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_pipelined_llama_vpp():
+    """Interleaved schedule: 8 blocks as 4 chunks on 2 stages (vpp=2)."""
+    cfg, pipe = _make_pipe(n_layers=8, num_stages=2, vpp=2)
+    mesh_mod.init_mesh({"dp": 4, "pp": 2})
+    try:
+        pm = PipelinedModule(pipe)
+        assert pm.vpp == 2 and pm.n_chunks == 4 and pm.lpc == 2
+        ids, labels = _data(cfg)
+        o_loss, _ = _oracle_loss_and_grads(pipe, pm, ids, labels)
+        p_loss, _ = _pipelined_loss_and_grads(pm, ids, labels, n_micro=4)
+        np.testing.assert_allclose(float(p_loss), float(o_loss),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_tied_embedding_single_array_and_grad():
+    """SharedLayerDesc ties embedding+head to ONE edge array; its grad is
+    the SUM of embedding-lookup and head-matmul contributions (reference:
+    the tied-weight allreduce of pipeline_parallel.py)."""
+    cfg, pipe = _make_pipe(tie=True, n_layers=2, num_stages=2)
+    mesh_mod.init_mesh({"dp": 4, "pp": 2})
+    try:
+        pm = PipelinedModule(pipe)
+        embed_shaped = [tuple(p.shape) for p in pm.edge_params
+                        if tuple(p.shape) == (cfg.vocab_size, cfg.hidden_size)]
+        assert len(embed_shaped) == 1, \
+            f"tied embedding must be deduped to one edge param: {embed_shaped}"
+        ids, labels = _data(cfg)
+        _, (p_ge, _) = _pipelined_loss_and_grads(pm, ids, labels, n_micro=2)
+        idx = [i for i, p in enumerate(pm.edge_params)
+               if tuple(p.shape) == (cfg.vocab_size, cfg.hidden_size)][0]
+        g = np.asarray(p_ge[idx])
+        # head contribution is dense over vocab; untouched-token rows would
+        # be zero if only the embedding lookup contributed
+        assert (np.abs(g).sum(axis=1) > 0).mean() > 0.9
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_train_batch_spmd_dispatch_and_loss_drop():
+    """PipelineParallel.train_batch uses the jitted engine when a pp mesh
+    axis exists, and training reduces the loss."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineParallel)
+
+    cfg, pipe = _make_pipe(n_layers=4, num_stages=2)
+    mesh_mod.init_mesh({"dp": 4, "pp": 2})
+    try:
+        pp = PipelineParallel(pipe)
+        pp.accumulate_steps = 4
+        opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                     parameters=pipe.parameters())
+        ids, labels = _data(cfg, batch=8, seq=16)
+        from paddle_tpu.framework.core import Tensor
+        losses = [float(pp.train_batch([Tensor(ids), Tensor(labels)], opt))
+                  for _ in range(8)]
+        assert pp._spmd, "expected SPMD engine dispatch under a pp mesh"
+        assert losses[-1] < losses[0] - 0.1, losses
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_train_batch_eager_parity_vs_spmd():
+    """Same model + data: eager accumulation shim and SPMD engine produce
+    the same loss (the hybrid_parallel_pp parity contract)."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineParallel)
+    from paddle_tpu.framework.core import Tensor
+
+    losses = {}
+    for mode in ("eager", "spmd"):
+        cfg, pipe = _make_pipe(n_layers=4, num_stages=2)
+        ids, labels = _data(cfg)
+        opt = paddle.optimizer.SGD(learning_rate=0.0,
+                                   parameters=pipe.parameters())
+        if mode == "spmd":
+            mesh_mod.init_mesh({"dp": 4, "pp": 2})
+        try:
+            pp = PipelineParallel(pipe)
+            pp.accumulate_steps = 4
+            losses[mode] = float(
+                pp.train_batch([Tensor(ids), Tensor(labels)], opt))
+            if mode == "spmd":
+                assert pp._spmd
+        finally:
+            mesh_mod.reset_mesh()
+    np.testing.assert_allclose(losses["spmd"], losses["eager"],
+                               rtol=2e-5, atol=2e-5)
