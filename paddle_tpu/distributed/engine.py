@@ -132,7 +132,7 @@ def pipeline_spmd_interleaved(stage_fn, n_stages, n_micro, vpp,
 
 def pipeline_seq_forward(block_fn, stacked_params, micro_inputs, *, pre=None,
                          post=None, mesh=None, axis_name="pp",
-                         vpp_degree=1):
+                         n_stages=None, vpp_degree=1):
     """Full-model pipelined forward for stage-heterogeneous LMs (reference:
     ``pp_layers.py`` stage partition with embedding on stage 0, head on
     stage S-1, ``SharedLayerDesc`` tied weights).
@@ -163,7 +163,8 @@ def pipeline_seq_forward(block_fn, stacked_params, micro_inputs, *, pre=None,
     if pre is not None:
         h = _flat_apply(pre, h)
     h = pipeline_forward(block_fn, stacked_params, h, mesh=mesh,
-                         axis_name=axis_name, vpp_degree=vpp_degree)
+                         axis_name=axis_name, n_stages=n_stages,
+                         vpp_degree=vpp_degree)
     if post is not None:
         h = _flat_apply(post, h)
     return h
@@ -294,6 +295,7 @@ class PipelinedModule:
         return pipeline_seq_forward(chunk_fn, stacked_arrs, micro_inputs,
                                     pre=pre, post=post, mesh=self.mesh,
                                     axis_name=self.axis_name,
+                                    n_stages=self.n_stages,
                                     vpp_degree=self.vpp)
 
 
@@ -356,7 +358,11 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
     """
     from . import mesh as mesh_mod
     mesh = mesh or mesh_mod.get_mesh()
-    n_stages = n_stages or int(mesh.shape[axis_name])
+    mesh_pp = int(mesh.shape[axis_name]) if axis_name in mesh.shape else 1
+    if n_stages is not None and mesh_pp > 1 and n_stages != mesh_pp:
+        raise ValueError(f"n_stages={n_stages} != mesh '{axis_name}' size "
+                         f"{mesh_pp}: chunks would be silently dropped")
+    n_stages = mesh_pp
     if n_stages == 1:
         def seq_all(x):
             n_chunks = jax.tree.leaves(stacked_params)[0].shape[0]

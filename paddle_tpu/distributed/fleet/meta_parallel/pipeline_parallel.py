@@ -63,22 +63,23 @@ class PipelineParallel(Layer):
         loss_fn, single-tensor inputs). Returns None → eager fallback."""
         if self._spmd is not None:
             return self._spmd or None
-        self._spmd = False
         from ... import mesh as mesh_mod
         if not (mesh_mod.has_mesh() and mesh_mod.axis_size("pp") > 1
                 and isinstance(self._loss_fn, Layer)):
-            return None
+            return None      # undecided — a pp mesh may be installed later
         try:
+            # dropout anywhere in the model would replay one fixed mask
+            # under the engine's constant key — eager fallback instead
+            for sub in self._layers.sublayers(include_self=True):
+                if "Dropout" in type(sub).__name__ and \
+                        getattr(sub, "p", 0) > 0:
+                    raise ValueError("dropout inside pipeline model")
             from ....distributed.engine import PipelinedModule
             pm = PipelinedModule(self._layers)
-            for blk in pm.blocks:
-                for sub in blk.sublayers(include_self=True):
-                    if "Dropout" in type(sub).__name__ and \
-                            getattr(sub, "p", 0) > 0:
-                        raise ValueError("dropout inside pipeline blocks")
         except ValueError as e:
             import sys
             print(f"PipelineParallel: eager fallback ({e})", file=sys.stderr)
+            self._spmd = False
             return None
         self._spmd = pm
         return pm
@@ -100,8 +101,8 @@ class PipelineParallel(Layer):
         mb = x.shape[0] // n
         micro_x = x.reshape((n, mb) + tuple(x.shape[1:]))
         micro_y = y.reshape((n, mb) + tuple(y.shape[1:]))
-        scale = jnp.asarray(scaler._scale if scaler is not None else 1.0,
-                            jnp.float32)
+        scaling = (scaler is not None and getattr(scaler, "_enable", True))
+        scale = jnp.asarray(scaler._scale if scaling else 1.0, jnp.float32)
 
         if self._spmd_step is None:
             from ....framework.functional import FunctionalModule
