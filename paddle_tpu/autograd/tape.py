@@ -148,15 +148,22 @@ def apply(fn, *args, op_name: str | None = None, **kwargs):
 
 
 def _apply_inner(fn, name, args, kwargs):
+    # flatten args AND kwargs: Tensors passed by keyword unwrap (and
+    # differentiate) exactly like positional ones — the reference API
+    # accepts either form for every op
+    leaves, treedef = jax.tree.flatten((list(args), dict(kwargs)),
+                                       is_leaf=lambda x: isinstance(x, Tensor))
     if _amp_cast_inputs is not None:
-        args = _amp_cast_inputs(name, list(args))
-    leaves, treedef = jax.tree.flatten(list(args), is_leaf=lambda x: isinstance(x, Tensor))
+        # cast policy applies to the flattened leaves so keyword Tensors
+        # follow the same AMP dtype as positional ones
+        leaves = _amp_cast_inputs(name, leaves)
     consts = [l._data if isinstance(l, Tensor) else l for l in leaves]
     diff_idx = [i for i, l in enumerate(leaves)
                 if _is_diff_tensor(l)] if is_grad_enabled() else []
 
     if not diff_idx:
-        out = fn(*jax.tree.unflatten(treedef, consts), **kwargs)
+        c_args, c_kwargs = jax.tree.unflatten(treedef, consts)
+        out = fn(*c_args, **c_kwargs)
         if _nan_check:
             _check_finite(out, name)
         return jax.tree.map(lambda v: Tensor(v), out)
@@ -165,7 +172,8 @@ def _apply_inner(fn, name, args, kwargs):
         cl = list(consts)
         for i, a in zip(diff_idx, arrs):
             cl[i] = a
-        return fn(*jax.tree.unflatten(treedef, cl), **kwargs)
+        p_args, p_kwargs = jax.tree.unflatten(treedef, cl)
+        return fn(*p_args, **p_kwargs)
 
     primals = [consts[i] for i in diff_idx]
     out_val, vjp_fn = jax.vjp(pure, *primals)

@@ -193,7 +193,11 @@ def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
-    return randint(low, high, x.shape, dtype or dtypes.dtype_name(x.dtype))
+    # reference allows float x: integers are sampled, then cast to x.dtype
+    dt = dtype or dtypes.dtype_name(x.dtype)
+    if dtypes.is_floating(_dt(dt)):   # incl. bfloat16 (np.issubdtype misses it)
+        return randint(low, high, x.shape, "int64").astype(dt)
+    return randint(low, high, x.shape, dt)
 
 
 def randperm(n, dtype="int64", name=None):

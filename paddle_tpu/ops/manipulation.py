@@ -323,7 +323,8 @@ def scatter_nd(index, updates, shape):
 
 @defop
 def index_add(x, index, axis, value):
-    idx = [slice(None)] * x.ndim
+    # NB: module-level ``slice`` op shadows the builtin here
+    idx = [builtins_slice(None)] * x.ndim
     idx[axis] = index
     return x.at[tuple(idx)].add(value)
 

@@ -1,0 +1,710 @@
+"""The systematic op matrix over the OpTest harness (reference: the
+per-op ``test_*_op.py`` files of ``test/legacy_test/`` driven by
+``op_test.py`` — every public op in ``paddle_tpu/ops/`` must have an OpCase
+here or an explicit exemption with a reason; ``test_coverage`` enforces it)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import OpCase, randn, randpos, randu, randint, _RNG
+
+
+def _mk(**kw):
+    return lambda: {k: (v() if callable(v) else v) for k, v in kw.items()}
+
+
+def _np_gather_axis0(x, index):
+    return x[index]
+
+
+UNARY_SMOOTH = [
+    ("exp", np.exp), ("expm1", np.expm1), ("square", np.square),
+    ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+    ("sinh", np.sinh), ("cosh", np.cosh), ("asinh", np.arcsinh),
+    ("atan", np.arctan), ("erf", lambda x: np.vectorize(_erf)(x)),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+    ("neg", np.negative), ("deg2rad", np.deg2rad), ("rad2deg", np.rad2deg),
+]
+UNARY_POS = [  # need positive inputs
+    ("log", np.log), ("log2", np.log2), ("log10", np.log10),
+    ("log1p", np.log1p), ("sqrt", np.sqrt),
+    ("rsqrt", lambda x: 1 / np.sqrt(x)),
+    ("reciprocal", np.reciprocal),
+    ("digamma", None), ("lgamma", None), ("i0", None),
+]
+UNARY_NONSMOOTH = [  # no grad check at kinks / not differentiable
+    ("abs", np.abs), ("sign", np.sign), ("floor", np.floor),
+    ("ceil", np.ceil), ("round", np.round), ("trunc", np.trunc),
+    ("frac", lambda x: x - np.trunc(x)),
+]
+
+
+def _erf(v):
+    import math
+    return math.erf(v)
+
+
+CASES = []
+
+for name, ref in UNARY_SMOOTH:
+    CASES.append(OpCase(name, _mk(x=lambda: randu(3, 4)),
+                        ref=ref, grad=True, rtol=1e-4, atol=1e-5))
+for name, ref in UNARY_POS:
+    CASES.append(OpCase(
+        name, _mk(x=lambda: randpos(3, 4, lo=0.5, hi=2.0)),
+        ref=(None if ref is None else ref), grad=True, rtol=1e-4, atol=1e-5))
+for name, ref in UNARY_NONSMOOTH:
+    CASES.append(OpCase(name, _mk(x=lambda: randn(3, 4) * 3), ref=ref))
+
+CASES += [
+    OpCase("acosh", _mk(x=lambda: randpos(3, 4, lo=1.2, hi=3.0)),
+           ref=np.arccosh, grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("tan", _mk(x=lambda: randu(3, 4, lo=-1.2, hi=1.2)), ref=np.tan,
+           grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("asin", _mk(x=lambda: randu(3, 4, lo=-0.8, hi=0.8)),
+           ref=np.arcsin, grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("acos", _mk(x=lambda: randu(3, 4, lo=-0.8, hi=0.8)),
+           ref=np.arccos, grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("atanh", _mk(x=lambda: randu(3, 4, lo=-0.8, hi=0.8)),
+           ref=np.arctanh, grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("erfinv", _mk(x=lambda: randu(3, 4, lo=-0.7, hi=0.7)), grad=True),
+    OpCase("logit", _mk(x=lambda: randu(3, 4, lo=0.15, hi=0.85)),
+           ref=lambda x: np.log(x / (1 - x)), grad=True, rtol=1e-4),
+    OpCase("stanh", _mk(x=lambda: randu(3, 4)),
+           ref=lambda x: 1.7159 * np.tanh(0.67 * x), grad=True, rtol=1e-4),
+    OpCase("clip", _mk(x=lambda: randn(3, 4)), kwargs={"min": -0.5, "max": 0.5},
+           ref=lambda x: np.clip(x, -0.5, 0.5)),
+    OpCase("scale", _mk(x=lambda: randn(3, 4)),
+           kwargs={"scale": 2.0, "bias": 1.0},
+           ref=lambda x: 2 * x + 1, grad=True, rtol=1e-4),
+    OpCase("nan_to_num",
+           _mk(x=lambda: np.array([[np.nan, 1.0, np.inf, -np.inf]], np.float32)),
+           ref=lambda x: np.nan_to_num(x, nan=0.0,
+                                       posinf=np.finfo(np.float32).max,
+                                       neginf=np.finfo(np.float32).min)),
+    OpCase("increment", _mk(x=lambda: randn(4)), ref=lambda x: x + 1),
+]
+
+# binary elementwise ---------------------------------------------------------
+BINARY = [
+    ("add", np.add, True), ("subtract", np.subtract, True),
+    ("multiply", np.multiply, True), ("maximum", np.maximum, False),
+    ("minimum", np.minimum, False), ("fmax", np.fmax, False),
+    ("fmin", np.fmin, False), ("atan2", np.arctan2, True),
+    ("hypot", np.hypot, True), ("logaddexp", np.logaddexp, True),
+    ("copysign", np.copysign, False), ("nextafter", np.nextafter, False),
+    ("heaviside", np.heaviside, False),
+]
+for name, ref, grad in BINARY:
+    CASES.append(OpCase(name, _mk(x=lambda: randn(3, 4),
+                                  y=lambda: randn(3, 4) + 0.1),
+                        ref=ref, grad=grad, rtol=1e-4, atol=1e-5))
+CASES += [
+    OpCase("divide", _mk(x=lambda: randn(3, 4),
+                         y=lambda: randpos(3, 4, lo=0.5)),
+           ref=np.divide, grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("divide_no_nan", _mk(x=lambda: randn(3, 4),
+                                y=lambda: np.where(np.arange(12).reshape(3, 4) % 3,
+                                                   randpos(3, 4), 0).astype(np.float32)),
+           ref=lambda x, y: np.where(y == 0, 0.0, x / np.where(y == 0, 1, y))),
+    OpCase("floor_divide", _mk(x=lambda: randint(3, 4, lo=1, hi=20),
+                               y=lambda: randint(3, 4, lo=1, hi=5)),
+           ref=np.floor_divide),
+    OpCase("mod", _mk(x=lambda: randint(3, 4, lo=0, hi=20),
+                      y=lambda: randint(3, 4, lo=1, hi=5)), ref=np.mod),
+    OpCase("pow", _mk(x=lambda: randpos(3, 4), y=lambda: randu(3, 4, lo=1, hi=3)),
+           ref=np.power, grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("lerp", _mk(x=lambda: randn(3, 4), y=lambda: randn(3, 4),
+                       weight=lambda: randu(3, 4, lo=0, hi=1)),
+           ref=lambda x, y, weight: x + weight * (y - x), grad=True, rtol=1e-4),
+    OpCase("gcd", _mk(x=lambda: randint(4, lo=1, hi=40),
+                      y=lambda: randint(4, lo=1, hi=40)), ref=np.gcd),
+    OpCase("lcm", _mk(x=lambda: randint(4, lo=1, hi=12),
+                      y=lambda: randint(4, lo=1, hi=12)), ref=np.lcm),
+    OpCase("multiplex", _mk(inputs=lambda: [randn(4, 3), randn(4, 3)],
+                            index=lambda: np.array([[0], [1], [1], [0]])),
+           ref=lambda inputs, index: np.stack(
+               [inputs[i[0]][r] for r, i in enumerate(index)])),
+]
+
+# reductions ------------------------------------------------------------------
+CASES += [
+    OpCase("sum", _mk(x=lambda: randn(3, 4, 5)), kwargs={"axis": 1},
+           ref=lambda x: x.sum(1), grad=True, rtol=1e-4),
+    OpCase("mean", _mk(x=lambda: randn(3, 4, 5)), kwargs={"axis": [0, 2]},
+           ref=lambda x: x.mean((0, 2)), grad=True, rtol=1e-4),
+    OpCase("prod", _mk(x=lambda: randpos(2, 3)), kwargs={"axis": 1},
+           ref=lambda x: x.prod(1), grad=True, rtol=1e-4),
+    OpCase("max", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 1},
+           ref=lambda x: x.max(1)),
+    OpCase("min", _mk(x=lambda: randn(3, 4)), kwargs={"axis": -1},
+           ref=lambda x: x.min(-1)),
+    OpCase("amax", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 0},
+           ref=lambda x: x.max(0)),
+    OpCase("amin", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 0},
+           ref=lambda x: x.min(0)),
+    OpCase("logsumexp", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 1},
+           ref=lambda x: np.log(np.exp(x).sum(1)), grad=True, rtol=1e-4),
+    OpCase("std", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: x.std(ddof=1), rtol=1e-4),
+    OpCase("var", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: x.var(ddof=1), rtol=1e-4),
+    OpCase("median", _mk(x=lambda: randn(3, 5)), kwargs={"axis": 1},
+           ref=lambda x: np.median(x, 1)),
+    OpCase("nanmedian", _mk(x=lambda: randn(3, 5)), kwargs={"axis": 1},
+           ref=lambda x: np.nanmedian(x, 1)),
+    OpCase("quantile", _mk(x=lambda: randn(3, 8)),
+           kwargs={"q": 0.5, "axis": 1},
+           ref=lambda x: np.quantile(x, 0.5, axis=1), rtol=1e-4, atol=1e-5),
+    OpCase("nansum",
+           _mk(x=lambda: np.where(randn(3, 4) > 1, np.nan, randn(3, 4)).astype(np.float32)),
+           ref=np.nansum, rtol=1e-4, atol=1e-5),
+    OpCase("nanmean",
+           _mk(x=lambda: np.where(randn(3, 4) > 1, np.nan, randn(3, 4)).astype(np.float32)),
+           ref=np.nanmean, rtol=1e-4, atol=1e-5),
+    OpCase("count_nonzero",
+           _mk(x=lambda: (randn(3, 4) > 0).astype(np.float32)),
+           ref=lambda x: np.count_nonzero(x)),
+    OpCase("cumsum", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 1},
+           ref=lambda x: np.cumsum(x, 1), grad=True, rtol=1e-4),
+    OpCase("cumprod", _mk(x=lambda: randpos(3, 4)), kwargs={"dim": 1},
+           ref=lambda x: np.cumprod(x, 1), grad=True, rtol=1e-4),
+    OpCase("cummax", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 1},
+           ref=lambda x: (np.maximum.accumulate(x, 1),
+                          np.array([np.argmax(x[:, :j + 1], 1) * 0 +
+                                    np.array([row[:j + 1].argmax() for row in x])
+                                    for j in range(x.shape[1])]).T)),
+    OpCase("cummin", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 1},
+           ref=lambda x: (np.minimum.accumulate(x, 1),
+                          np.array([[row[:j + 1].argmin() for j in range(x.shape[1])]
+                                    for row in x]))),
+    OpCase("logcumsumexp", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 1},
+           ref=lambda x: np.log(np.cumsum(np.exp(x), 1)), rtol=1e-4),
+    OpCase("trapezoid", _mk(y=lambda: randn(3, 8)),
+           ref=lambda y: np.trapezoid(y, axis=-1) if hasattr(np, "trapezoid")
+           else np.trapz(y, axis=-1), rtol=1e-4),
+    OpCase("all", _mk(x=lambda: randn(3, 4) > 0), kwargs={"axis": 1},
+           ref=lambda x: x.all(1)),
+    OpCase("any", _mk(x=lambda: randn(3, 4) > 0), kwargs={"axis": 1},
+           ref=lambda x: x.any(1)),
+]
+
+# matmul family ---------------------------------------------------------------
+CASES += [
+    OpCase("matmul", _mk(x=lambda: randn(2, 3, 4), y=lambda: randn(2, 4, 5)),
+           ref=np.matmul, grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("bmm", _mk(x=lambda: randn(2, 3, 4), y=lambda: randn(2, 4, 5)),
+           ref=np.matmul, grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("dot", _mk(x=lambda: randn(5), y=lambda: randn(5)),
+           ref=np.dot, grad=True, rtol=1e-4),
+    OpCase("inner", _mk(x=lambda: randn(3, 4), y=lambda: randn(2, 4)),
+           ref=np.inner, grad=True, rtol=1e-4),
+    OpCase("outer", _mk(x=lambda: randn(3), y=lambda: randn(4)),
+           ref=np.outer, grad=True, rtol=1e-4),
+    OpCase("addmm", _mk(input=lambda: randn(3, 5), x=lambda: randn(3, 4),
+                        y=lambda: randn(4, 5)),
+           kwargs={"beta": 0.5, "alpha": 2.0},
+           ref=lambda input, x, y: 0.5 * input + 2.0 * (x @ y),
+           grad=True, rtol=1e-4, atol=1e-5),
+    OpCase("kron", _mk(x=lambda: randn(2, 3), y=lambda: randn(3, 2)),
+           ref=np.kron, rtol=1e-4, atol=1e-5),
+    OpCase("cross", _mk(x=lambda: randn(4, 3), y=lambda: randn(4, 3)),
+           ref=lambda x, y: np.cross(x, y), rtol=1e-4, atol=1e-5),
+    OpCase("trace", _mk(x=lambda: randn(4, 4)), ref=np.trace,
+           grad=True, rtol=1e-4),
+    OpCase("t", _mk(x=lambda: randn(3, 4)), ref=np.transpose),
+    OpCase("mv", _mk(x=lambda: randn(3, 4), vec=lambda: randn(4)),
+           ref=lambda x, vec: x @ vec, grad=True, rtol=1e-4),
+    OpCase(lambda x, y: paddle.einsum("ij,jk->ik", x, y),
+           _mk(x=lambda: randn(3, 4), y=lambda: randn(4, 5)),
+           ref=np.matmul, grad=True, rtol=1e-4, name="einsum"),
+    OpCase("tensordot", _mk(x=lambda: randn(3, 4), y=lambda: randn(4, 5)),
+           kwargs={"axes": 1}, ref=lambda x, y: np.tensordot(x, y, 1),
+           rtol=1e-4, atol=1e-5),
+]
+
+# float predicates / comparisons ----------------------------------------------
+CASES += [
+    OpCase("isnan", _mk(x=lambda: np.array([1.0, np.nan], np.float32)),
+           ref=np.isnan),
+    OpCase("isinf", _mk(x=lambda: np.array([1.0, np.inf], np.float32)),
+           ref=np.isinf),
+    OpCase("isfinite", _mk(x=lambda: np.array([1.0, np.inf, np.nan], np.float32)),
+           ref=np.isfinite),
+    OpCase("isclose", _mk(x=lambda: randn(3), y=lambda: randn(3)),
+           ref=lambda x, y: np.isclose(x, y)),
+    OpCase("allclose", _mk(x=lambda: randn(3), y=lambda: randn(3)),
+           ref=lambda x, y: np.allclose(x, y), static=False),
+    OpCase("equal_all", _mk(x=lambda: randn(3), y=lambda: randn(3)),
+           ref=lambda x, y: np.array_equal(x, y), static=False),
+    OpCase("histogram", _mk(x=lambda: randu(64, lo=0, hi=1)),
+           kwargs={"bins": 8, "min": 0, "max": 1},
+           ref=lambda x: np.histogram(x, 8, (0, 1))[0]),
+    OpCase("bincount", _mk(x=lambda: randint(20, lo=0, hi=6)),
+           ref=lambda x: np.bincount(x)),
+    OpCase("diff", _mk(x=lambda: randn(3, 6)),
+           ref=lambda x: np.diff(x, axis=-1)),
+    OpCase("take", _mk(x=lambda: randn(3, 4),
+                       index=lambda: randint(5, lo=0, hi=12)),
+           ref=lambda x, index: x.reshape(-1)[index]),
+]
+for name, ref in [("equal", np.equal), ("not_equal", np.not_equal),
+                  ("greater_than", np.greater), ("greater_equal", np.greater_equal),
+                  ("less_than", np.less), ("less_equal", np.less_equal)]:
+    CASES.append(OpCase(name, _mk(x=lambda: randint(3, 4, lo=0, hi=3).astype(np.float32),
+                                  y=lambda: randint(3, 4, lo=0, hi=3).astype(np.float32)),
+                        ref=ref))
+for name, ref in [("logical_and", np.logical_and), ("logical_or", np.logical_or),
+                  ("logical_xor", np.logical_xor)]:
+    CASES.append(OpCase(name, _mk(x=lambda: randn(3, 4) > 0,
+                                  y=lambda: randn(3, 4) > 0), ref=ref))
+for name, ref in [("bitwise_and", np.bitwise_and), ("bitwise_or", np.bitwise_or),
+                  ("bitwise_xor", np.bitwise_xor)]:
+    CASES.append(OpCase(name, _mk(x=lambda: randint(3, 4, lo=0, hi=16).astype(np.int32),
+                                  y=lambda: randint(3, 4, lo=0, hi=16).astype(np.int32)),
+                        ref=ref))
+CASES += [
+    OpCase("logical_not", _mk(x=lambda: randn(3, 4) > 0), ref=np.logical_not),
+    OpCase("bitwise_not", _mk(x=lambda: randint(3, 4, lo=0, hi=16).astype(np.int32)),
+           ref=np.bitwise_not),
+    OpCase("is_empty", _mk(x=lambda: randn(2, 2)),
+           ref=lambda x: np.array(False), static=False),
+]
+
+# search / sort ---------------------------------------------------------------
+CASES += [
+    OpCase("argmax", _mk(x=lambda: randn(4, 5)), kwargs={"axis": 1},
+           ref=lambda x: np.argmax(x, 1)),
+    OpCase("argmin", _mk(x=lambda: randn(4, 5)), kwargs={"axis": 1},
+           ref=lambda x: np.argmin(x, 1)),
+    OpCase("argsort", _mk(x=lambda: randn(4, 5)), kwargs={"axis": 1},
+           ref=lambda x: np.argsort(x, 1, kind="stable")),
+    OpCase("sort", _mk(x=lambda: randn(4, 5)), kwargs={"axis": 1},
+           ref=lambda x: np.sort(x, 1)),
+    OpCase("topk", _mk(x=lambda: randn(4, 6)), kwargs={"k": 3},
+           ref=lambda x: (np.sort(x, -1)[:, ::-1][:, :3],
+                          np.argsort(-x, -1, kind="stable")[:, :3])),
+    OpCase("kthvalue", _mk(x=lambda: randn(4, 6)), kwargs={"k": 2},
+           ref=lambda x: (np.sort(x, -1)[:, 1],
+                          np.argsort(x, -1, kind="stable")[:, 1])),
+    OpCase("mode", _mk(x=lambda: randint(4, 9, lo=0, hi=3).astype(np.float32))),
+    OpCase("searchsorted",
+           _mk(sorted_sequence=lambda: np.sort(randn(8)).astype(np.float32),
+               values=lambda: randn(5)),
+           ref=lambda sorted_sequence, values: np.searchsorted(
+               sorted_sequence, values)),
+    OpCase("bucketize",
+           _mk(x=lambda: randn(5),
+               sorted_sequence=lambda: np.sort(randn(8)).astype(np.float32)),
+           ref=lambda x, sorted_sequence: np.searchsorted(sorted_sequence, x)),
+    OpCase("nonzero", _mk(x=lambda: (randn(3, 4) > 0).astype(np.float32)),
+           static=False),
+    OpCase("masked_select", _mk(x=lambda: randn(3, 4),
+                                mask=lambda: randn(3, 4) > 0), static=False),
+    OpCase("unique", _mk(x=lambda: randint(12, lo=0, hi=5).astype(np.float32)),
+           ref=lambda x: np.unique(x), static=False),
+    OpCase("unique_consecutive",
+           _mk(x=lambda: np.array([1, 1, 2, 2, 3, 1, 1], np.float32)),
+           ref=lambda x: np.array([1, 2, 3, 1], np.float32), static=False),
+]
+
+# manipulation ----------------------------------------------------------------
+CASES += [
+    OpCase("reshape", _mk(x=lambda: randn(2, 3, 4)), kwargs={"shape": [6, 4]},
+           ref=lambda x: x.reshape(6, 4), grad=True, rtol=1e-4),
+    OpCase("view", _mk(x=lambda: randn(2, 6)), kwargs={"shape_or_dtype": [3, 4]},
+           ref=lambda x: x.reshape(3, 4)),
+    OpCase("flatten", _mk(x=lambda: randn(2, 3, 4)),
+           kwargs={"start_axis": 1},
+           ref=lambda x: x.reshape(2, 12)),
+    OpCase("squeeze", _mk(x=lambda: randn(1, 3, 1)),
+           ref=lambda x: x.reshape(3)),
+    OpCase("unsqueeze", _mk(x=lambda: randn(3, 4)), kwargs={"axis": [0, -1]},
+           ref=lambda x: x.reshape(1, 3, 4, 1)),
+    OpCase("transpose", _mk(x=lambda: randn(2, 3, 4)),
+           kwargs={"perm": [2, 0, 1]},
+           ref=lambda x: x.transpose(2, 0, 1), grad=True, rtol=1e-4),
+    OpCase(lambda x: paddle.permute(x, 2, 0, 1),
+           _mk(x=lambda: randn(2, 3, 4)),
+           ref=lambda x: x.transpose(2, 0, 1), name="permute"),
+    OpCase("moveaxis", _mk(x=lambda: randn(2, 3, 4)),
+           kwargs={"source": 0, "destination": 2},
+           ref=lambda x: np.moveaxis(x, 0, 2)),
+    OpCase("swapaxes", _mk(x=lambda: randn(2, 3, 4)),
+           kwargs={"axis0": 0, "axis1": 2},
+           ref=lambda x: np.swapaxes(x, 0, 2)),
+    OpCase("concat", lambda: {"x": [randn(2, 3), randn(2, 3)]},
+           kwargs={"axis": 0},
+           ref=lambda x: np.concatenate(x, 0), name="concat"),
+    OpCase("stack", lambda: {"x": [randn(2, 3), randn(2, 3)]},
+           kwargs={"axis": 1}, ref=lambda x: np.stack(x, 1), name="stack"),
+    OpCase("hstack", lambda: {"x": [randn(2, 3), randn(2, 3)]},
+           ref=lambda x: np.hstack(x), name="hstack"),
+    OpCase("vstack", lambda: {"x": [randn(2, 3), randn(2, 3)]},
+           ref=lambda x: np.vstack(x), name="vstack"),
+    OpCase("split", _mk(x=lambda: randn(6, 4)),
+           kwargs={"num_or_sections": 3},
+           ref=lambda x: tuple(np.split(x, 3))),
+    OpCase("chunk", _mk(x=lambda: randn(6, 4)), kwargs={"chunks": 2},
+           ref=lambda x: tuple(np.split(x, 2))),
+    OpCase("unbind", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: tuple(x[i] for i in range(3))),
+    OpCase("unstack", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: tuple(x[i] for i in range(3))),
+    OpCase("tile", _mk(x=lambda: randn(2, 3)), kwargs={"repeat_times": [2, 2]},
+           ref=lambda x: np.tile(x, (2, 2))),
+    OpCase("expand", _mk(x=lambda: randn(1, 3)), kwargs={"shape": [4, 3]},
+           ref=lambda x: np.broadcast_to(x, (4, 3))),
+    OpCase("expand_as", _mk(x=lambda: randn(1, 3), y=lambda: randn(4, 3)),
+           ref=lambda x, y: np.broadcast_to(x, (4, 3))),
+    OpCase("broadcast_to", _mk(x=lambda: randn(1, 3)), kwargs={"shape": [4, 3]},
+           ref=lambda x: np.broadcast_to(x, (4, 3))),
+    OpCase("broadcast_tensors",
+           lambda: {"inputs": [randn(1, 3), randn(4, 1)]},
+           ref=lambda inputs: tuple(np.broadcast_arrays(*inputs)),
+           name="broadcast_tensors"),
+    OpCase("flip", _mk(x=lambda: randn(3, 4)), kwargs={"axis": [1]},
+           ref=lambda x: x[:, ::-1]),
+    OpCase("rot90", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: np.rot90(x)),
+    OpCase("roll", _mk(x=lambda: randn(3, 4)),
+           kwargs={"shifts": 1, "axis": 0}, ref=lambda x: np.roll(x, 1, 0)),
+    OpCase("repeat_interleave", _mk(x=lambda: randn(3, 2)),
+           kwargs={"repeats": 2, "axis": 0},
+           ref=lambda x: np.repeat(x, 2, 0)),
+    OpCase("pad", _mk(x=lambda: randn(2, 2)), kwargs={"pad": [1, 1, 1, 1]},
+           ref=lambda x: np.pad(x, 1)),
+    OpCase("cast", _mk(x=lambda: randn(3, 4)), kwargs={"dtype": "int32"},
+           ref=lambda x: x.astype(np.int32)),
+    OpCase("numel", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: np.array(12), static=False),
+    OpCase("take_along_axis", _mk(arr=lambda: randn(3, 4),
+                                  indices=lambda: randint(3, 2, lo=0, hi=4),
+                                  axis=1),
+           ref=lambda arr, indices, axis: np.take_along_axis(arr, indices, 1)),
+    OpCase("put_along_axis", _mk(arr=lambda: randn(3, 4),
+                                 indices=lambda: randint(3, 1, lo=0, hi=4),
+                                 values=lambda: randn(3, 1), axis=1),
+           ref=lambda arr, indices, values, axis: _np_put_along(
+               arr, indices, values),
+           static=False),
+    OpCase("index_select", _mk(x=lambda: randn(5, 4),
+                               index=lambda: np.array([0, 3, 2])),
+           ref=lambda x, index: x[index]),
+    OpCase("index_sample", _mk(x=lambda: randn(3, 6),
+                               index=lambda: randint(3, 2, lo=0, hi=6)),
+           ref=lambda x, index: np.take_along_axis(x, index, 1)),
+    OpCase("gather", _mk(x=lambda: randn(5, 4),
+                         index=lambda: np.array([1, 4])),
+           ref=_np_gather_axis0, grad=True, grad_vars=["x"], rtol=1e-4),
+    OpCase("gather_nd", _mk(x=lambda: randn(3, 4),
+                            index=lambda: np.array([[0, 1], [2, 3]])),
+           ref=lambda x, index: x[index[:, 0], index[:, 1]]),
+    OpCase("scatter", _mk(x=lambda: np.zeros((5, 2), np.float32),
+                          index=lambda: np.array([1, 3]),
+                          updates=lambda: randn(2, 2)),
+           ref=lambda x, index, updates: _np_scatter(x, index, updates)),
+    OpCase("scatter_nd_add", _mk(x=lambda: np.ones((4, 2), np.float32),
+                                 index=lambda: np.array([[1], [3]]),
+                                 updates=lambda: randn(2, 2)),
+           ref=lambda x, index, updates: _np_scatter_add(x, index, updates)),
+    OpCase("scatter_nd", _mk(index=lambda: np.array([[1], [3]]),
+                             updates=lambda: randn(2, 2), shape=[5, 2]),
+           ref=lambda index, updates, shape: _np_scatter_add(
+               np.zeros((5, 2), np.float32), index, updates)),
+    OpCase("index_add", _mk(x=lambda: np.ones((5, 2), np.float32),
+                            index=lambda: np.array([0, 2]), axis=0,
+                            value=lambda: randn(2, 2)),
+           ref=lambda x, index, axis, value: _np_index_add(x, index, value)),
+    OpCase("index_put", _mk(x=lambda: np.zeros((4, 3), np.float32),
+                            indices=lambda: (np.array([0, 2]),),
+                            value=lambda: randn(2, 3)),
+           ref=lambda x, indices, value: _np_index_put(x, indices, value),
+           static=False),
+    OpCase("masked_fill", _mk(x=lambda: randn(3, 4),
+                              mask=lambda: randn(3, 4) > 0, value=9.0),
+           ref=lambda x, mask, value: np.where(mask, 9.0, x)),
+    OpCase("masked_scatter", _mk(x=lambda: randn(3, 4),
+                                 mask=lambda: randn(3, 4) > 0,
+                                 value=lambda: randn(12)), static=False),
+    OpCase("where", _mk(condition=lambda: randn(3, 4) > 0,
+                        x=lambda: randn(3, 4), y=lambda: randn(3, 4)),
+           ref=lambda condition, x, y: np.where(condition, x, y),
+           grad=True, rtol=1e-4),
+    OpCase("slice", _mk(input=lambda: randn(4, 5)),
+           kwargs={"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]},
+           ref=lambda input: input[1:3, 0:4]),
+    OpCase("strided_slice", _mk(x=lambda: randn(6, 6)),
+           kwargs={"axes": [0], "starts": [0], "ends": [6], "strides": [2]},
+           ref=lambda x: x[::2]),
+    OpCase("shard_index", _mk(input=lambda: randint(6, 1, lo=0, hi=20)),
+           kwargs={"index_num": 20, "nshards": 2, "shard_id": 0},
+           static=False),
+    OpCase("one_hot", _mk(x=lambda: np.array([0, 2, 1])),
+           kwargs={"num_classes": 3},
+           ref=lambda x: np.eye(3, dtype=np.float32)[x]),
+    OpCase("as_real", _mk(x=lambda: randn(3, 2).view(np.complex64)),
+           static=False),
+    OpCase(lambda x: paddle.as_complex(paddle.as_real(x)),
+           _mk(x=lambda: randn(3, 2).view(np.complex64)),
+           static=False, name="as_complex"),
+]
+
+
+def _np_scatter(x, index, updates):
+    out = x.copy()
+    out[index] = updates
+    return out
+
+
+def _np_scatter_add(x, index, updates):
+    out = x.copy()
+    for i, row in zip(index[:, 0], updates):
+        out[i] += row
+    return out
+
+
+def _np_index_add(x, index, value):
+    out = x.copy()
+    for i, row in zip(index, value):
+        out[i] += row
+    return out
+
+
+def _np_put_along(arr, indices, values):
+    out = arr.copy()
+    np.put_along_axis(out, indices, values, 1)
+    return out
+
+
+def _np_index_put(x, indices, value):
+    out = x.copy()
+    out[indices] = value
+    return out
+
+
+# creation --------------------------------------------------------------------
+CASES += [
+    OpCase(lambda: paddle.zeros([2, 3]), lambda: {},
+           ref=lambda: np.zeros((2, 3), np.float32), name="zeros",
+           static=False),
+    OpCase(lambda: paddle.ones([2, 3]), lambda: {},
+           ref=lambda: np.ones((2, 3), np.float32), name="ones", static=False),
+    OpCase(lambda: paddle.full([2, 2], 7.0), lambda: {},
+           ref=lambda: np.full((2, 2), 7.0, np.float32), name="full",
+           static=False),
+    OpCase("zeros_like", _mk(x=lambda: randn(2, 3)), ref=np.zeros_like),
+    OpCase("ones_like", _mk(x=lambda: randn(2, 3)), ref=np.ones_like),
+    OpCase("full_like", _mk(x=lambda: randn(2, 3)), kwargs={"fill_value": 3.0},
+           ref=lambda x: np.full_like(x, 3.0)),
+    OpCase(lambda: paddle.arange(0, 10, 2), lambda: {},
+           ref=lambda: np.arange(0, 10, 2), name="arange", static=False),
+    OpCase(lambda: paddle.linspace(0, 1, 5), lambda: {},
+           ref=lambda: np.linspace(0, 1, 5, dtype=np.float32),
+           name="linspace", static=False),
+    OpCase(lambda: paddle.logspace(0, 2, 3), lambda: {},
+           ref=lambda: np.logspace(0, 2, 3, dtype=np.float32),
+           name="logspace", static=False, rtol=1e-4),
+    OpCase(lambda: paddle.eye(3, 4), lambda: {},
+           ref=lambda: np.eye(3, 4, dtype=np.float32), name="eye",
+           static=False),
+    OpCase("tril", _mk(x=lambda: randn(4, 4)), ref=np.tril),
+    OpCase("triu", _mk(x=lambda: randn(4, 4)), ref=np.triu),
+    OpCase("diag", _mk(x=lambda: randn(4)), ref=np.diag),
+    OpCase("diagflat", _mk(x=lambda: randn(2, 2)), ref=np.diagflat),
+    OpCase("diagonal", _mk(x=lambda: randn(3, 3)),
+           ref=lambda x: np.diagonal(x)),
+    OpCase("diag_embed", _mk(x=lambda: randn(2, 3)),
+           ref=lambda x: np.stack([np.diag(r) for r in x])),
+    OpCase("assign", _mk(x=lambda: randn(3, 4)), ref=lambda x: x),
+    OpCase("clone", _mk(x=lambda: randn(3, 4)), ref=lambda x: x),
+    OpCase("tolist", _mk(x=lambda: randn(3)), static=False,
+           ref=None),
+]
+# meshgrid takes *args — wrap
+CASES = [c for c in CASES if c.name != "meshgrid"]
+CASES.append(OpCase(lambda args: paddle.meshgrid(*args),
+                    lambda: {"args": [randn(3), randn(4)]},
+                    ref=lambda args: tuple(np.meshgrid(*args, indexing="ij")),
+                    name="meshgrid", static=False))
+
+# linalg ----------------------------------------------------------------------
+def _spd(n):
+    a = randn(n, n)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+CASES += [
+    OpCase("linalg.norm", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: np.linalg.norm(x), rtol=1e-4, name="norm"),
+    OpCase("linalg.matrix_norm", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: np.linalg.norm(x, "fro"), rtol=1e-4,
+           name="matrix_norm"),
+    OpCase("linalg.dist", _mk(x=lambda: randn(3, 4), y=lambda: randn(3, 4)),
+           ref=lambda x, y: np.linalg.norm(x - y), rtol=1e-4, name="dist"),
+    OpCase("linalg.inv", _mk(x=lambda: _spd(4)),
+           ref=np.linalg.inv, rtol=1e-3, atol=1e-4, name="inv"),
+    OpCase("linalg.pinv", _mk(x=lambda: randn(4, 3)),
+           ref=np.linalg.pinv, rtol=1e-3, atol=1e-4, name="pinv"),
+    OpCase("linalg.det", _mk(x=lambda: _spd(3)),
+           ref=np.linalg.det, rtol=1e-3, name="det"),
+    OpCase("linalg.slogdet", _mk(x=lambda: _spd(3)),
+           ref=lambda x: np.stack(np.linalg.slogdet(x)).astype(np.float32),
+           rtol=1e-3, name="slogdet"),
+    OpCase("linalg.cholesky", _mk(x=lambda: _spd(4)),
+           ref=np.linalg.cholesky, rtol=1e-3, atol=1e-4, name="cholesky"),
+    OpCase("linalg.solve", _mk(x=lambda: _spd(4), y=lambda: randn(4, 2)),
+           ref=np.linalg.solve, rtol=1e-3, atol=1e-4, name="solve"),
+    OpCase("linalg.triangular_solve",
+           _mk(x=lambda: np.tril(_spd(4)).astype(np.float32),
+               y=lambda: randn(4, 2)),
+           kwargs={"upper": False},
+           ref=lambda x, y: np.linalg.solve(x, y), rtol=1e-3, atol=1e-4,
+           name="triangular_solve"),
+    OpCase("linalg.cholesky_solve",
+           _mk(x=lambda: randn(4, 2),
+               y=lambda: np.linalg.cholesky(_spd(4)).astype(np.float32)),
+           kwargs={"upper": False}, name="cholesky_solve", static=False),
+    OpCase("linalg.matrix_power", _mk(x=lambda: _spd(3)), kwargs={"n": 3},
+           ref=lambda x: np.linalg.matrix_power(x, 3), rtol=1e-3,
+           name="matrix_power"),
+    OpCase("linalg.matrix_rank", _mk(x=lambda: _spd(4)),
+           ref=lambda x: np.array(np.linalg.matrix_rank(x)),
+           static=False, name="matrix_rank"),
+    OpCase("linalg.qr", _mk(x=lambda: randn(4, 3)), static=False, name="qr"),
+    OpCase("linalg.svd", _mk(x=lambda: randn(4, 3)), static=False,
+           name="svd"),
+    OpCase("linalg.eigh", _mk(x=lambda: _spd(4)), static=False, name="eigh"),
+    OpCase("linalg.eigvalsh", _mk(x=lambda: _spd(4)),
+           ref=lambda x: np.linalg.eigvalsh(x), rtol=1e-3, atol=1e-4,
+           name="eigvalsh"),
+    OpCase("linalg.lstsq", _mk(x=lambda: randn(5, 3), y=lambda: randn(5, 2)),
+           static=False, name="lstsq"),
+    OpCase("linalg.lu", _mk(x=lambda: _spd(4)), static=False, name="lu"),
+    OpCase("linalg.cond", _mk(x=lambda: _spd(4)),
+           ref=lambda x: np.array(np.linalg.cond(x), np.float32), rtol=1e-2,
+           name="cond"),
+    OpCase("linalg.cov", _mk(x=lambda: randn(3, 8)),
+           ref=lambda x: np.cov(x), rtol=1e-3, atol=1e-4, name="cov"),
+    OpCase("linalg.corrcoef", _mk(x=lambda: randn(3, 8)),
+           ref=lambda x: np.corrcoef(x), rtol=1e-3, atol=1e-4,
+           name="corrcoef"),
+    OpCase("linalg.householder_product",
+           _mk(x=lambda: randn(4, 3), tau=lambda: randu(3, lo=0.1, hi=1.0)),
+           static=False, name="householder_product"),
+    OpCase("linalg.multi_dot",
+           lambda: {"tensors": [randn(3, 4), randn(4, 5), randn(5, 2)]},
+           ref=lambda tensors: tensors[0] @ tensors[1] @ tensors[2],
+           rtol=1e-4, atol=1e-5, name="multi_dot"),
+]
+
+# random / stateful creation: value checks are meaningless; check shape+range
+RANDOM_OPS = {
+    "rand": lambda: paddle.rand([3, 4]),
+    "uniform": lambda: paddle.uniform([3, 4], min=-1.0, max=1.0),
+    "randn": lambda: paddle.randn([3, 4]),
+    "standard_normal": lambda: paddle.standard_normal([3, 4]),
+    "normal": lambda: paddle.normal(0.0, 1.0, [3, 4]),
+    "randint": lambda: paddle.randint(0, 10, [3, 4]),
+    "randint_like": lambda: paddle.randint_like(paddle.zeros([3, 4]), low=0, high=10),
+    "randperm": lambda: paddle.randperm(8),
+    "bernoulli": lambda: paddle.bernoulli(paddle.full([3, 4], 0.5)),
+    "multinomial": lambda: paddle.multinomial(
+        paddle.to_tensor(np.ones(5, np.float32) / 5), 3),
+    "poisson": lambda: paddle.poisson(paddle.full([3, 4], 2.0)),
+    "exponential_": lambda: paddle.exponential_(paddle.ones([3, 4])),
+    "empty": lambda: paddle.empty([2, 2]),
+    "empty_like": lambda: paddle.empty_like(paddle.ones([2, 2])),
+}
+
+CASES += [
+    OpCase("mm", _mk(x=lambda: randn(3, 4), y=lambda: randn(4, 5)),
+           ref=np.matmul, rtol=1e-4, atol=1e-5),
+    OpCase("remainder", _mk(x=lambda: randint(3, 4, lo=0, hi=20),
+                            y=lambda: randint(3, 4, lo=1, hi=5)), ref=np.mod),
+    OpCase("floor_mod", _mk(x=lambda: randint(3, 4, lo=0, hi=20),
+                            y=lambda: randint(3, 4, lo=1, hi=5)), ref=np.mod),
+    OpCase("negative", _mk(x=lambda: randn(3, 4)), ref=np.negative),
+    OpCase("conj", _mk(x=lambda: randn(3, 2).view(np.complex64)),
+           static=False),
+    OpCase("real", _mk(x=lambda: randn(3, 2).view(np.complex64)),
+           ref=np.real, static=False),
+    OpCase("imag", _mk(x=lambda: randn(3, 2).view(np.complex64)),
+           ref=np.imag, static=False),
+    OpCase("angle", _mk(x=lambda: randn(3, 2).view(np.complex64)),
+           ref=np.angle, static=False),
+    OpCase("linalg.vector_norm", _mk(x=lambda: randn(3, 4)),
+           ref=lambda x: np.linalg.norm(x.ravel()), rtol=1e-4,
+           name="vector_norm"),
+]
+
+# intentionally not OpCase-covered (reason required)
+EXEMPT = {
+    # module plumbing, not ops
+    "apply": "tape dispatcher import", "defop": "tape decorator import",
+    "Tensor": "class import", "builtins_sum": "python builtin passthrough",
+    "builtins_slice": "python builtin passthrough",
+    "in_dynamic_mode": "mode predicate, trivial",
+    # shape/meta helpers with no kernel
+    "broadcast_shape": "pure shape computation, no tensors",
+    "tolist": "covered in CASES but host-side only",
+    # covered through other suites
+    "einsum": "covered via lambda case",
+    "eig": "complex output; smoke-tested in test_fft_signal_vision_ops",
+    "eigvals": "complex output; smoke-tested elsewhere",
+    "pca_lowrank": "randomized algorithm; smoke-tested in test_models",
+    "norm": "covered as linalg.norm case", "dist": "alias of linalg.dist",
+    "inverse": "alias of linalg.inv",
+    # in-place aliases: same kernel as the out-of-place op (covered above)
+    "reshape_": "in-place alias of reshape",
+    "squeeze_": "in-place alias of squeeze",
+    "unsqueeze_": "in-place alias of unsqueeze",
+}
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c.name)
+def test_op_case(case):
+    case.run()
+
+
+@pytest.mark.parametrize("name", sorted(RANDOM_OPS), ids=str)
+def test_random_op(name):
+    paddle.seed(7)
+    out = RANDOM_OPS[name]()
+    arr = np.asarray(out.numpy())
+    assert arr.size > 0
+    if np.issubdtype(arr.dtype, np.floating):
+        assert np.all(np.isfinite(arr))
+    paddle.seed(7)
+    again = np.asarray(RANDOM_OPS[name]().numpy())
+    np.testing.assert_array_equal(arr, again, err_msg=f"{name}: not seeded")
+
+
+def test_coverage():
+    """Every public op defined in paddle_tpu/ops/* has an OpCase, a random-op
+    check, or an explicit exemption (the reference's every-op-has-an-OpTest
+    policy)."""
+    import inspect
+    import paddle_tpu.ops.math as m_math
+    import paddle_tpu.ops.manipulation as m_manip
+    import paddle_tpu.ops.logic as m_logic
+    import paddle_tpu.ops.creation as m_creation
+    import paddle_tpu.ops.linalg as m_linalg
+
+    covered = {c.name for c in CASES} | set(RANDOM_OPS) | set(EXEMPT)
+    missing = []
+    for mod in (m_math, m_manip, m_logic, m_creation, m_linalg):
+        for name, obj in vars(mod).items():
+            if name.startswith("_") or not callable(obj):
+                continue
+            if inspect.ismodule(obj) or inspect.isclass(obj):
+                continue
+            owner = getattr(obj, "__module__", "")
+            if not (owner == mod.__name__
+                    or owner == "paddle_tpu.autograd.tape"):
+                continue   # re-imported helper, not an op definition
+            if name not in covered:
+                missing.append(f"{mod.__name__.split('.')[-1]}.{name}")
+    assert not missing, (
+        f"{len(missing)} ops lack OpTest coverage (add an OpCase or an "
+        f"EXEMPT reason): {sorted(missing)}")
