@@ -29,12 +29,17 @@ def _is_tensor(x):
 
 @contextlib.contextmanager
 def swap_state(params, buffers, p_arrs, b_arrs, rng_key, layer=None,
-               training=None):
+               training=None, enable_grad=False):
     """Swap parameter/buffer backing arrays for (possibly traced) ``p_arrs``/
     ``b_arrs``, seed the hidden RNG from ``rng_key``, raise the tracing flag,
     optionally force ``training`` on every sublayer — and restore everything
-    on exit. The single primitive under FunctionalModule and @to_static."""
-    from ..autograd.tape import no_grad
+    on exit. The single primitive under FunctionalModule and @to_static.
+
+    ``enable_grad=True`` keeps the tape RECORDING during the trace (nodes
+    over tracers) so in-trace ``paddle.grad(create_graph=...)`` works —
+    used by @to_static on retry when the traced function needs autograd;
+    XLA dead-code-eliminates the unused vjps otherwise."""
+    from ..autograd.tape import no_grad, enable_grad as _enable_grad
     from ..jit import api as jit_api
 
     saved_p = [t._data for t in params]
@@ -56,7 +61,7 @@ def swap_state(params, buffers, p_arrs, b_arrs, rng_key, layer=None,
                 l.training = training
         gen._root = rng_key
         gen._counter = 0
-        with no_grad():
+        with (_enable_grad() if enable_grad else no_grad()):
             yield
     finally:
         for t, a in zip(params, saved_p):

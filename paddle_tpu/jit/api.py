@@ -25,6 +25,8 @@ from ..nn.layer import Layer
 
 _static_mode = [False]  # paddle.enable_static (legacy static-graph mode flag)
 _TRACING = [False]
+_STATIC_ACTIVE = [False]   # inside StaticFunction.__call__'s trace (the only
+                           # context with an InTraceAutogradNeeded handler)
 
 _GRAPH_BREAK_ERRORS = (
     jax.errors.TracerBoolConversionError,
@@ -128,18 +130,22 @@ class StaticFunction:
                 [b for b in layer.buffers() if b is not None])
 
     # -- trace + compile ----------------------------------------------------
-    def _make_core(self, treedef, leaves, kwargs_static, params, bufs, sg_flags):
+    def _make_core(self, treedef, leaves, kwargs_static, params, bufs, sg_flags,
+                   tape_in_trace=False):
         """Returns jitted core(p_arrs, b_arrs, key, t_arrs) -> (out, new_bufs).
 
         ``leaves`` gives the static (non-Tensor) leaves; Tensor slots are None
-        and filled from t_arrs at call time.
+        and filled from t_arrs at call time. ``tape_in_trace`` keeps the tape
+        recording during the trace (needed when the function calls
+        paddle.grad — see autograd.tape.InTraceAutogradNeeded).
         """
         static_leaves = [None if isinstance(l, Tensor) else l for l in leaves]
         tensor_slots = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
 
         def core(p_arrs, b_arrs, key, t_arrs):
             from ..framework.functional import swap_state
-            with swap_state(params, bufs, p_arrs, b_arrs, key):
+            with swap_state(params, bufs, p_arrs, b_arrs, key,
+                            enable_grad=tape_in_trace):
                 new_leaves = list(static_leaves)
                 for slot, arr, sg in zip(tensor_slots, t_arrs, sg_flags):
                     tt = Tensor(arr)
@@ -180,15 +186,32 @@ class StaticFunction:
             t_arrs = list(xs[np_ + nb_:])
             return entry["core"](p_arrs, b_arrs, rng_key, t_arrs)
 
+        from ..autograd.tape import InTraceAutogradNeeded
+        prev_static = _STATIC_ACTIVE[0]
+        _STATIC_ACTIVE[0] = True
         try:
-            out_vals, new_bufs = apply(runner, *params, *bufs, *tensor_leaves,
-                                       op_name="to_static")
+            try:
+                out_vals, new_bufs = apply(runner, *params, *bufs,
+                                           *tensor_leaves,
+                                           op_name="to_static")
+            except InTraceAutogradNeeded:
+                # the traced fn calls paddle.grad: re-trace with the tape
+                # recording over tracers (unused vjps are DCE'd by XLA)
+                sg_flags = [t.stop_gradient for t in tensor_leaves]
+                entry["core"] = self._make_core(treedef, leaves, kwargs,
+                                                params, bufs, sg_flags,
+                                                tape_in_trace=True)
+                out_vals, new_bufs = apply(runner, *params, *bufs,
+                                           *tensor_leaves,
+                                           op_name="to_static")
         except _GRAPH_BREAK_ERRORS as e:
             warnings.warn(
                 f"to_static: graph break ({type(e).__name__}) — falling back to "
                 f"eager for {getattr(self._orig_fn, '__name__', self._orig_fn)}")
             entry["fallback"] = True
             return self._call_eager(*args, **kwargs)
+        finally:
+            _STATIC_ACTIVE[0] = prev_static
 
         with no_grad():
             for b, nb in zip(bufs, new_bufs):
