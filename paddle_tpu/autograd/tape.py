@@ -185,7 +185,10 @@ def _apply_inner(fn, name, args, kwargs):
     out_leaves, out_tree = jax.tree.flatten(out_val)
     out_meta = [(v.shape, v.dtype) for v in out_leaves]
     edges = [(leaves[i], leaves[i]._grad_node, leaves[i]._out_idx) for i in diff_idx]
-    node = GradNode(vjp_fn, edges, out_meta, out_tree, name, pure_fn=pure)
+    from ..flags import flag as _flag
+    node = GradNode(vjp_fn, edges, out_meta, out_tree, name,
+                    pure_fn=pure if _flag("FLAGS_enable_double_grad", True)
+                    else None)
 
     wrapped = []
     for k, v in enumerate(out_leaves):
@@ -354,7 +357,14 @@ def _graph_grad(outputs, inputs, grad_outputs, allow_unused):
     result connects to ``inputs`` AND to every requires-grad leaf the
     subgraph touches (weights under a gradient penalty), and third-order
     grads fall out for free (jax differentiates the replay's vjp)."""
-    input_pos = {id(t): i for i, t in enumerate(inputs)}
+    # duplicates in ``inputs`` share one replay variable; every occurrence
+    # gets the same grad in the result (reference behavior)
+    uniq_inputs, input_pos = [], {}
+    for t in inputs:
+        if id(t) not in input_pos:
+            input_pos[id(t)] = len(uniq_inputs)
+            uniq_inputs.append(t)
+    orig_inputs, inputs = inputs, uniq_inputs
 
     # ---- collect the full ancestor graph of outputs (no cut at inputs:
     # an input may sit in another input's ancestry — reference semantics
@@ -369,6 +379,10 @@ def _graph_grad(outputs, inputs, grad_outputs, allow_unused):
         node_set.add(id(n))
         node_objs[id(n)] = n
         if n.pure_fn is None:
+            if n.vjp_fn is None:
+                raise RuntimeError(
+                    f"Trying to backward through node {n.name} a second "
+                    "time; set retain_graph=True if you need to.")
             raise NotImplementedError(
                 f"create_graph=True through op '{n.name}' (a PyLayer or "
                 "custom node without a primal replay fn) is not supported; "
@@ -452,10 +466,13 @@ def _graph_grad(outputs, inputs, grad_outputs, allow_unused):
         g = grad_outputs[i] if grad_outputs is not None else None
         if g is None:
             seeds.append(jnp.ones(t._data.shape, t.dtype))
-        else:
+        elif isinstance(g, Tensor):
             seeds.append(g)
-            if isinstance(g, Tensor) and _is_diff_tensor(g):
+            if _is_diff_tensor(g):
                 seed_from.append(i)
+        else:
+            # same coercion run_backward applies to raw seeds
+            seeds.append(jnp.asarray(g, t.dtype))
 
     def G(*arrs):
         in_arrs = list(arrs[:n_in])
@@ -468,28 +485,34 @@ def _graph_grad(outputs, inputs, grad_outputs, allow_unused):
         (gs,) = vjp(cots)
         return tuple(gs)
 
-    # inputs with a replayed producer enter the outer tape as DETACHED
-    # proxies: the replay already internalized their upstream chain
-    # (``chained``), so keeping the original edge would double-count the
-    # path when the returned grads are differentiated again
-    def _outer_arg(t):
-        if t._grad_node is not None and id(t._grad_node) in node_set:
-            d = Tensor(t._data)
-            d.stop_gradient = False
-            return d
-        return t
-
-    args = ([_outer_arg(t) for t in inputs] + extra +
-            [seeds[i] for i in seed_from])
-    out = apply(G, *args, op_name="grad_replay")
+    # Inputs with a replayed producer must enter the outer tape as LEAF
+    # edges (producer severed): the replay already internalized their
+    # upstream chain (``chained``) — keeping the original edge would
+    # double-count the path when the returned grads are differentiated
+    # again, while a detached copy would orphan d(grad)/d(input). We
+    # temporarily clear ``_grad_node`` around the recording so the edge
+    # captures the ORIGINAL tensor, leaf-like.
+    sever = [t for t in inputs
+             if t._grad_node is not None and id(t._grad_node) in node_set]
+    saved_nodes = [(t, t._grad_node, t._out_idx) for t in sever]
+    try:
+        for t in sever:
+            t._grad_node = None
+        args = (list(inputs) + extra + [seeds[i] for i in seed_from])
+        out = apply(G, *args, op_name="grad_replay")
+    finally:
+        for t, n, k in saved_nodes:
+            t._grad_node = n
+            t._out_idx = k
     # jax.vjp returns a cotangent for every input; true "unused" shows as a
     # symbolically-zero None only pre-materialization. Match the reference's
     # allow_unused contract via graph reachability instead.
     used_ids = ({id(t) for n in order for (t, _, _) in n.edges}
                 | {id(t) for t in outputs})
     result = []
-    for i, g in enumerate(out):
-        if id(inputs[i]) not in used_ids:
+    for t in orig_inputs:
+        g = out[input_pos[id(t)]]
+        if id(t) not in used_ids:
             if not allow_unused:
                 raise ValueError(
                     "One of the differentiated Tensors appears unused in the "

@@ -21,6 +21,11 @@ _DEFAULTS: dict[str, Any] = {
     "FLAGS_max_inplace_grad_add": 0,
     "FLAGS_conv_workspace_size_limit": 512,
     "FLAGS_use_flash_attention": True,   # Pallas FA kernel in sdpa (TPU only)
+    # capture each op's primal replay closure on its GradNode so
+    # paddle.grad(create_graph=True) works; disable to shed the extra
+    # pinned input arrays on retained graphs when higher-order grads are
+    # never taken (autograd/tape.py)
+    "FLAGS_enable_double_grad": True,
 }
 
 _flags: dict[str, Any] = {}

@@ -229,14 +229,26 @@ class StaticFunction:
 
     def get_concrete_program(self, *args, **kwargs):
         """Lower to StableHLO for the given example inputs (Program analogue)."""
+        from ..autograd.tape import InTraceAutogradNeeded
         params, bufs = self._state()
         leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
         tensor_leaves = [l for l in leaves if isinstance(l, Tensor)]
         sg = [t.stop_gradient for t in tensor_leaves]
-        core = self._make_core(treedef, leaves, kwargs, params, bufs, sg)
-        lowered = core.lower([p._data for p in params], [b._data for b in bufs],
-                             prandom.next_key(), [t._data for t in tensor_leaves])
-        return lowered
+        prev_static = _STATIC_ACTIVE[0]
+        _STATIC_ACTIVE[0] = True
+        try:
+            for tape_in_trace in (False, True):
+                core = self._make_core(treedef, leaves, kwargs, params, bufs,
+                                       sg, tape_in_trace=tape_in_trace)
+                try:
+                    return core.lower([p._data for p in params],
+                                      [b._data for b in bufs],
+                                      prandom.next_key(),
+                                      [t._data for t in tensor_leaves])
+                except InTraceAutogradNeeded:
+                    continue   # retry with the tape recording in-trace
+        finally:
+            _STATIC_ACTIVE[0] = prev_static
 
     def rollback(self):
         if isinstance(self._orig_fn, Layer):

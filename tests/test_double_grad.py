@@ -159,6 +159,52 @@ def test_pylayer_ancestry_raises_detach_works():
     np.testing.assert_allclose(gx.numpy(), 2 * 3 * np.ones(4), rtol=1e-6)
 
 
+def test_second_order_wrt_nonleaf_input():
+    """d(gx)/dy must work when y is a non-leaf input: the grad_replay node
+    carries a leaf-like edge to the ORIGINAL y (not a hidden proxy)."""
+    x = _leaf([3.0])
+    y = x * 2.0
+    out = (y * y).sum()
+    gx, gy = paddle.grad(out, [x, y], create_graph=True)
+    (d_gx_dy,) = paddle.grad(gx, y)      # gx = 4y (as a fn of y) → 4
+    np.testing.assert_allclose(d_gx_dy.numpy(), [4.0])
+
+
+def test_duplicate_inputs():
+    x = _leaf([2.0])
+    y = (x * x).sum()
+    g1, g2 = paddle.grad(y, [x, x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), [4.0])
+    np.testing.assert_allclose(g2.numpy(), [4.0])
+
+
+def test_numpy_grad_outputs_coerced():
+    x = _leaf([1.0, 2.0])
+    y = x * x
+    (g,) = paddle.grad(y, x, grad_outputs=[np.ones(2)],  # float64 numpy seed
+                       create_graph=True)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0])
+
+
+def test_freed_graph_clear_error():
+    x = _leaf([1.0])
+    y = (x * x).sum()
+    y.backward()                          # frees vjp_fn AND pure_fn
+    with pytest.raises(RuntimeError, match="second time"):
+        paddle.grad(y, x, create_graph=True)
+
+
+def test_get_concrete_program_with_grad():
+    @paddle.jit.to_static
+    def curvature(x):
+        y = (x * x * x).sum()
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        return (g1 * g1).sum()
+
+    lowered = curvature.get_concrete_program(_leaf([1.0, 2.0]))
+    assert lowered is not None
+
+
 def test_first_order_grad_unchanged():
     x = _leaf([1.0, 2.0])
     y = (x * x).sum()

@@ -1,0 +1,143 @@
+"""REAL multi-process distributed path (VERDICT.md round-1 item 7;
+reference: the ``TestDistBase`` shell-out pattern of
+``test/legacy_test/test_dist_base.py`` — spawn trainers via the launch CLI,
+compare losses against a single-process oracle).
+
+Two local processes rendezvous through ``jax.distributed.initialize``
+(driven by the PADDLE_* env the launcher sets), each drives 2 virtual CPU
+devices, and one jitted SPMD step trains over the global 4-device dp mesh —
+collectives ride Gloo across the processes."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                               + os.environ.get("LOCAL_DEVICES", "2"))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.framework.functional import FunctionalModule
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax.numpy as jnp
+
+    dist.init_parallel_env()
+    world = jax.process_count()
+    n_dev = len(jax.devices())
+    assert n_dev == 4, f"expected 4 global devices, got {n_dev}"
+    mesh = mesh_mod.init_mesh({"dp": n_dev})
+
+    paddle.seed(11)
+    model = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.Tanh(),
+                                 paddle.nn.Linear(16, 1))
+    fm = FunctionalModule(model, training=True)
+    p_arrs = fm.param_arrays()
+    rng = np.random.RandomState(5)
+    X = rng.randn(16, 8).astype(np.float32)
+    W = rng.randn(8, 1).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+
+    data_sh = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+    gx = jax.make_array_from_callback(X.shape, data_sh, lambda i: X[i])
+    gy = jax.make_array_from_callback(Y.shape, data_sh, lambda i: Y[i])
+    key = fm.next_key()
+
+    @jax.jit
+    def step(p_arrs, x, y):
+        def loss_fn(ps):
+            out, _ = fm(ps, [], key, x)
+            return ((out - y) ** 2).mean()
+        loss, g = jax.value_and_grad(loss_fn)(p_arrs)
+        return loss, [p - 0.1 * gg for p, gg in zip(p_arrs, g)]
+
+    losses = []
+    for _ in range(5):
+        loss, p_arrs = step(p_arrs, gx, gy)
+        losses.append(float(jax.device_get(
+            jax.jit(lambda l: l, out_shardings=repl)(loss))))
+    if jax.process_index() == 0:
+        print("LOSSES:", ",".join(f"{l:.6f}" for l in losses), flush=True)
+    print("WORKER_DONE rank", jax.process_index(), flush=True)
+""")
+
+
+def _sanitized_env(extra):
+    env = dict(os.environ)
+    parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+             if p and "axon_site" not in p]
+    env["PYTHONPATH"] = os.pathsep.join([REPO] + parts)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.update(extra)
+    return env
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _parse_losses(text):
+    for line in text.splitlines():
+        if line.startswith("LOSSES:"):
+            return [float(v) for v in line.split(":", 1)[1].split(",")]
+    raise AssertionError(f"no LOSSES line in output:\n{text[-2000:]}")
+
+
+def test_launch_two_process_dp_parity(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+
+    # ---- oracle: one process, 4 local devices, same global mesh
+    out = subprocess.run(
+        [sys.executable, str(worker)],
+        env=_sanitized_env({"LOCAL_DEVICES": "4"}),
+        capture_output=True, text=True, timeout=420, cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr[-2000:]
+    oracle = _parse_losses(out.stdout)
+    assert oracle[-1] < oracle[0], oracle
+
+    # ---- 2 processes x 2 local devices through the launch CLI
+    port = _free_port()
+    logdir = tmp_path / "logs"
+    procs = []
+    for rank in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "2", "--rank", str(rank),
+             "--master", f"127.0.0.1:{port}",
+             "--log_dir", str(logdir), str(worker)],
+            env=_sanitized_env({"LOCAL_DEVICES": "2"}),
+            cwd=str(tmp_path)))
+    for p in procs:
+        try:
+            assert p.wait(timeout=420) == 0
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            logs = "\n".join(f.read_text()[-1500:]
+                             for f in sorted(logdir.glob("workerlog.*")))
+            pytest.fail(f"multi-process launch timed out; logs:\n{logs}")
+
+    log0 = (logdir / "workerlog.0").read_text()
+    dist_losses = _parse_losses(log0)
+    np.testing.assert_allclose(dist_losses, oracle, rtol=1e-5, atol=1e-6)
+    assert "WORKER_DONE rank 0" in log0
+    assert "WORKER_DONE rank 1" in (logdir / "workerlog.1").read_text()
