@@ -23,6 +23,7 @@ import numpy as np
 
 from ..framework.core import Tensor
 from ..framework import dtype as dtypes
+from ..flags import flag as _flag
 
 _FLOAT0 = jax.dtypes.float0
 
@@ -185,7 +186,6 @@ def _apply_inner(fn, name, args, kwargs):
     out_leaves, out_tree = jax.tree.flatten(out_val)
     out_meta = [(v.shape, v.dtype) for v in out_leaves]
     edges = [(leaves[i], leaves[i]._grad_node, leaves[i]._out_idx) for i in diff_idx]
-    from ..flags import flag as _flag
     node = GradNode(vjp_fn, edges, out_meta, out_tree, name,
                     pure_fn=pure if _flag("FLAGS_enable_double_grad", True)
                     else None)
@@ -289,7 +289,8 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
         nodes.add(id(n))
         node_objs[id(n)] = n
         for (_, prod, _) in n.edges:
-            if prod is not None and id(prod) not in nodes:
+            if prod is not None and not isinstance(prod, _SeveredEdge) \
+                    and id(prod) not in nodes:
                 stack.append(prod)
 
     # ---- dependency (consumer) counts among reachable nodes
@@ -383,12 +384,18 @@ def _graph_grad(outputs, inputs, grad_outputs, allow_unused):
                 raise RuntimeError(
                     f"Trying to backward through node {n.name} a second "
                     "time; set retain_graph=True if you need to.")
+            if not _flag("FLAGS_enable_double_grad", True):
+                raise RuntimeError(
+                    "create_graph=True needs FLAGS_enable_double_grad=True "
+                    "(it was disabled, so primal replay fns were not "
+                    "recorded on this graph)")
             raise NotImplementedError(
                 f"create_graph=True through op '{n.name}' (a PyLayer or "
                 "custom node without a primal replay fn) is not supported; "
                 "detach() the subgraph above it if its grads are not needed")
         for (_, prod, _) in n.edges:
-            if prod is not None and id(prod) not in node_set:
+            if prod is not None and not isinstance(prod, _SeveredEdge) \
+                    and id(prod) not in node_set:
                 stack.append(prod)
 
     # forward topological order: producers before consumers
@@ -497,7 +504,7 @@ def _graph_grad(outputs, inputs, grad_outputs, allow_unused):
     saved_nodes = [(t, t._grad_node, t._out_idx) for t in sever]
     try:
         for t in sever:
-            t._grad_node = None
+            t._grad_node = _SEVERED
         args = (list(inputs) + extra + [seeds[i] for i in seed_from])
         out = apply(G, *args, op_name="grad_replay")
     finally:
@@ -521,6 +528,17 @@ def _graph_grad(outputs, inputs, grad_outputs, allow_unused):
         else:
             result.append(g)
     return result
+
+
+class _SeveredEdge:
+    """Marker producer for grad_replay edges whose upstream chain was
+    internalized by the replay: run_backward neither traverses past it
+    nor treats the tensor as a leaf (no spurious ``.grad`` writes on
+    non-leaf inputs)."""
+    __slots__ = ()
+
+
+_SEVERED = _SeveredEdge()
 
 
 class InTraceAutogradNeeded(RuntimeError):

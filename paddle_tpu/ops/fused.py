@@ -78,14 +78,41 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     return tuple(res)
 
 
+# -- fused_swiglu: the worked example for the custom-op extension API
+# (utils.register_op — the TPU-native PD_BUILD_OP). fwd returns
+# (out, residuals); the hand-written VJP recomputes nothing but the cheap
+# sigmoid products (reference: fused_bias_act swiglu backward kernel).
+
+def _swiglu_fwd(a, g):
+    s = 1.0 / (1.0 + jnp.exp(-a))
+    return jnp.asarray(a * s * g, a.dtype), (a, s, g)
+
+
+def _swiglu_vjp(res, cot):
+    a, s, g = res
+    d_silu = s * (1.0 + a * (1.0 - s))        # d/da [a*sigmoid(a)]
+    return (jnp.asarray(cot * g * d_silu, a.dtype),
+            jnp.asarray(cot * a * s, g.dtype))
+
+
+_fused_swiglu_op = None
+
+
+def _swiglu_registered():
+    global _fused_swiglu_op
+    if _fused_swiglu_op is None:
+        from ..utils.custom_op import register_op
+        _fused_swiglu_op = register_op(_swiglu_fwd, name="fused_swiglu",
+                                       vjp=_swiglu_vjp, override=True)
+    return _fused_swiglu_op
+
+
 def fused_swiglu(x, gate=None):
     """swiglu(x, gate) = silu(x) * gate (paddle.incubate fused_swiglu)."""
     if gate is None:
-        def fn(a):
-            u, g = jnp.split(a, 2, axis=-1)
-            return jnp.asarray(jax_silu(u) * g, a.dtype)
-        return apply(fn, x, op_name="fused_swiglu")
-    return apply(lambda a, g: jax_silu(a) * g, x, gate, op_name="fused_swiglu")
+        x, gate = apply(lambda a: tuple(jnp.split(a, 2, axis=-1)), x,
+                        op_name="swiglu_split")
+    return _swiglu_registered()(x, gate)
 
 
 def jax_silu(a):

@@ -7,7 +7,10 @@ import os
 import shutil
 
 __all__ = ["run_check", "get_weights_path_from_url", "download",
-           "cpp_extension", "deprecated", "try_import"]
+           "cpp_extension", "deprecated", "try_import",
+           "register_op", "get_op"]
+
+from .custom_op import register_op, get_op  # noqa: E402,F401
 
 
 def run_check():
@@ -40,16 +43,72 @@ class download:
 
 
 class cpp_extension:
-    """Reference: JIT-compile CUDA/C++ custom ops. The TPU analogue for
-    device kernels is Pallas (paddle_tpu/ops/pallas); host-side C++ builds
-    via the same g++ path the native DataLoader uses (io/native)."""
+    """JIT-compile host-side C++ extensions (reference:
+    ``python/paddle/utils/cpp_extension/`` — there it builds CUDA kernels
+    against the paddle::Tensor ABI; on TPU, device kernels are Pallas
+    (``paddle_tpu/ops/pallas`` + ``utils.register_op``) and this loader
+    covers the HOST tier: compile C++ with the system toolchain, load via
+    ctypes, lift into the op layer with ``register_op(host_callback=True)``."""
+
+    _BUILD_HOME = os.path.expanduser("~/.cache/paddle_tpu/extensions")
 
     @staticmethod
-    def load(name=None, sources=None, **kw):
-        raise NotImplementedError(
-            "custom device kernels on TPU are Pallas kernels "
-            "(see paddle_tpu/ops/pallas); host-side C++ extensions build "
-            "via ctypes like paddle_tpu/io/native")
+    def load(name, sources, extra_cflags=None, extra_ldflags=None,
+             build_directory=None, verbose=False, **kw):
+        """Compile ``sources`` (paths or literal C++ code) into a shared
+        library and return the loaded ``ctypes.CDLL`` (cached by content
+        hash)."""
+        import ctypes
+        import subprocess
+        import tempfile
+
+        srcs, blobs = [], []
+        for s in sources if isinstance(sources, (list, tuple)) else [sources]:
+            if os.path.exists(s):
+                with open(s) as f:
+                    blobs.append(f.read())
+                srcs.append(os.path.abspath(s))
+            else:                      # literal source code
+                blobs.append(s)
+                srcs.append(None)
+        # cache key covers sources AND build flags
+        tag = hashlib.md5("\x00".join(
+            blobs + (extra_cflags or []) + (extra_ldflags or []) + [name]
+        ).encode()).hexdigest()[:16]
+        bdir = build_directory or os.path.join(cpp_extension._BUILD_HOME, name)
+        os.makedirs(bdir, exist_ok=True)
+        so_path = os.path.join(bdir, f"{name}.{tag}.so")
+        if not os.path.exists(so_path):
+            files, scratch = [], []
+            for i, (src, blob) in enumerate(zip(srcs, blobs)):
+                if src is None:
+                    src = os.path.join(bdir, f"{name}.{tag}.{i}.cpp")
+                    with open(src, "w") as f:
+                        f.write(blob)
+                    scratch.append(src)
+                files.append(src)
+            # build to a private temp name, publish atomically: a concurrent
+            # loader (multi-process launch) never CDLLs a half-written .so
+            fd, tmp_so = tempfile.mkstemp(suffix=".so", dir=bdir)
+            os.close(fd)
+            cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+                   + (extra_cflags or []) + files
+                   + ["-o", tmp_so] + (extra_ldflags or []))
+            if verbose:
+                print("cpp_extension:", " ".join(cmd))
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"cpp_extension build failed:\n{proc.stderr[-4000:]}")
+                os.replace(tmp_so, so_path)
+            finally:
+                for p in scratch + [tmp_so]:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+        return ctypes.CDLL(so_path)
 
 
 def deprecated(update_to="", since="", reason=""):

@@ -205,6 +205,18 @@ def test_get_concrete_program_with_grad():
     assert lowered is not None
 
 
+def test_nonleaf_input_grad_not_polluted():
+    """backward through create_graph grads must NOT write .grad on a
+    non-leaf input (the severed edge is not a leaf edge)."""
+    x = _leaf([3.0])
+    y = x * 2.0
+    out = (y * y).sum()
+    gx, gy = paddle.grad(out, [x, y], create_graph=True)
+    (gx * gx).sum().backward()
+    assert y.grad is None, y.grad
+    assert x.grad is not None
+
+
 def test_first_order_grad_unchanged():
     x = _leaf([1.0, 2.0])
     y = (x * x).sum()
