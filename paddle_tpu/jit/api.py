@@ -72,7 +72,11 @@ def _is_tensor(x):
 
 
 def _spec_key(args, kwargs, training):
+    """Cache key + list of objects to pin. Unhashable objects key on id()
+    — the caller must keep the returned ``pinned`` refs alive with the
+    cache entry, else a freed object's recycled id() could wrongly hit."""
     parts = [bool(training)]
+    pinned = []
     for a in jax.tree.leaves((args, kwargs), is_leaf=_is_tensor):
         if isinstance(a, Tensor):
             parts.append(("T", tuple(a._data.shape), str(a.dtype), a.stop_gradient))
@@ -81,8 +85,17 @@ def _spec_key(args, kwargs, training):
         elif isinstance(a, np.ndarray):
             parts.append(("A", a.shape, str(a.dtype), a.tobytes()))
         else:
-            parts.append(("O", id(a)))
-    return tuple(parts)
+            try:
+                hash(a)
+            except TypeError:
+                parts.append(("O", id(a)))
+                pinned.append(a)
+            else:
+                # key on the object itself: the cache key tuple holds a
+                # strong ref (no id recycling) and dict equality uses the
+                # object's own __eq__, so hash collisions can't alias
+                parts.append(("H", a))
+    return tuple(parts), pinned
 
 
 class StaticFunction:
@@ -167,12 +180,13 @@ class StaticFunction:
         training = layer.training if layer is not None else True
         leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
         tensor_leaves = [l for l in leaves if isinstance(l, Tensor)]
-        key = _spec_key(args, kwargs, training)
+        key, pinned = _spec_key(args, kwargs, training)
         entry = self._cache.get(key)
         if entry is None:
             sg_flags = [t.stop_gradient for t in tensor_leaves]
             core = self._make_core(treedef, leaves, kwargs, params, bufs, sg_flags)
-            entry = {"core": core, "fallback": False}
+            entry = {"core": core, "fallback": False, "breaks": 0,
+                     "pinned": pinned}
             self._cache[key] = entry
         if entry["fallback"]:
             return self._call_eager(*args, **kwargs)
@@ -205,14 +219,22 @@ class StaticFunction:
                                            *tensor_leaves,
                                            op_name="to_static")
         except _GRAPH_BREAK_ERRORS as e:
+            # latch the eager fallback only after a SECOND break, so one
+            # transient tracer error doesn't permanently degrade the spec;
+            # genuinely dynamic code (use static.nn.cond/while_loop to stay
+            # compiled) latches on the next call
+            entry["breaks"] += 1
+            entry["fallback"] = entry["breaks"] >= 2
             warnings.warn(
-                f"to_static: graph break ({type(e).__name__}) — falling back to "
-                f"eager for {getattr(self._orig_fn, '__name__', self._orig_fn)}")
-            entry["fallback"] = True
+                f"to_static: graph break ({type(e).__name__}) — falling back "
+                f"to eager for "
+                f"{getattr(self._orig_fn, '__name__', self._orig_fn)}"
+                + (" (latched)" if entry["fallback"] else "; will retry once"))
             return self._call_eager(*args, **kwargs)
         finally:
             _STATIC_ACTIVE[0] = prev_static
 
+        entry["breaks"] = 0     # a clean traced call re-arms the retry
         with no_grad():
             for b, nb in zip(bufs, new_bufs):
                 b._data = nb._data if isinstance(nb, Tensor) else nb

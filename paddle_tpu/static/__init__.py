@@ -115,7 +115,4 @@ def name_scope(prefix=None):
     return contextlib.nullcontext()
 
 
-class nn:
-    @staticmethod
-    def fc(x, size, **kw):
-        raise NotImplementedError("static.nn: use paddle.nn.Linear")
+from . import nn  # noqa: E402,F401  (control flow: cond/while_loop/switch_case)

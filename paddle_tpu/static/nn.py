@@ -1,0 +1,140 @@
+"""paddle.static.nn — static-graph control flow (reference:
+``python/paddle/static/nn/control_flow.py`` — ``cond``/``while_loop``/
+``switch_case``/``case`` build ConditionalBlock/While ops into the Program;
+SURVEY.md §7.1 M1 maps them onto XLA control-flow primitives).
+
+TPU-native: under a trace these lower to ``lax.cond`` / ``lax.while_loop`` /
+``lax.switch`` — compiler-friendly control flow with NO graph break, so a
+tensor-dependent branch inside ``@to_static`` stays compiled instead of
+permanently degrading to eager. Eagerly (concrete predicate) they are plain
+Python control flow, matching reference dygraph semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..autograd.tape import apply
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _unwrap_tree(x):
+    return jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t, x,
+                        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _is_traced(*vals):
+    return any(isinstance(v, jax.core.Tracer)
+               for v in jax.tree.leaves([_unwrap_tree(v) for v in vals]))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run ``true_fn()`` or ``false_fn()`` on a scalar boolean ``pred``.
+
+    Both branches must return the same structure/shapes/dtypes (the
+    reference ConditionalBlock contract == the ``lax.cond`` contract).
+    """
+    p = _arr(pred)
+    if not isinstance(p, jax.core.Tracer):
+        if bool(p):
+            return true_fn() if true_fn is not None else None
+        return false_fn() if false_fn is not None else None
+
+    def fn(pa):
+        def t_(_):
+            return _unwrap_tree(true_fn())
+
+        def f_(_):
+            return _unwrap_tree(false_fn())
+
+        return jax.lax.cond(jnp.asarray(pa).astype(bool).reshape(()),
+                            t_, f_, None)
+
+    return apply(fn, pred, op_name="cond")
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred is True wins (reference ``static.nn.case``);
+    lowers to a chain of ``cond``."""
+    pairs = list(pred_fn_pairs)
+    if not pairs:
+        return default() if default is not None else None
+    (pred, fn), rest = pairs[0], pairs[1:]
+    return cond(pred, fn, lambda: case(rest, default=default))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on an integer index (reference ``static.nn.switch_case``;
+    ``lax.switch`` under trace). ``branch_fns``: list of callables or
+    {index: callable} with dense 0..N-1 keys after filling ``default``."""
+    if isinstance(branch_fns, dict):
+        hi = max(branch_fns) + 1
+        fns = [branch_fns.get(i, default) for i in range(hi)]
+        if any(f is None for f in fns):
+            raise ValueError("switch_case: sparse branch dict needs default")
+    else:
+        fns = list(branch_fns)
+    idx = _arr(branch_index)
+    if not isinstance(idx, jax.core.Tracer):
+        i = int(idx)
+        if 0 <= i < len(fns):
+            return fns[i]()
+        if default is not None:
+            return default()
+        i = max(0, min(i, len(fns) - 1))    # lax.switch clamp semantics
+        return fns[i]()
+    all_fns = fns + ([default] if default is not None else [])
+
+    def fn(ia):
+        i = jnp.asarray(ia).astype(jnp.int32).reshape(())
+        if default is not None:
+            i = jnp.where((i < 0) | (i >= len(fns)), len(fns), i)
+        return jax.lax.switch(i, [lambda _, f=f: _unwrap_tree(f())
+                                  for f in all_fns], None)
+
+    return apply(fn, branch_index, op_name="switch_case")
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """``while cond(*vars): vars = body(*vars)`` (reference
+    ``static.nn.while_loop``). Under a trace this is ``lax.while_loop``:
+    shapes/dtypes of ``loop_vars`` are invariant, and reverse-mode
+    gradients through the traced loop are not defined (same as jax; use
+    a scan-style bounded loop for differentiable iteration)."""
+    if not isinstance(loop_vars, (list, tuple)):
+        raise TypeError("loop_vars must be a list/tuple")
+    if not _is_traced(*loop_vars):
+        out = list(loop_vars)
+        while bool(_arr(cond(*out))):
+            out = list(body(*out))
+            if len(out) != len(loop_vars):
+                raise ValueError("body must return as many vars as it takes")
+        return out
+
+    def fn(*arrs):
+        def c(vs):
+            return jnp.asarray(_unwrap_tree(cond(*_wrap_like(vs)))) \
+                      .astype(bool).reshape(())
+
+        def b(vs):
+            res = body(*_wrap_like(vs))
+            return tuple(_unwrap_tree(r) for r in res)
+
+        return jax.lax.while_loop(c, b, tuple(arrs))
+
+    def _wrap_like(vs):
+        return [Tensor(v) if not isinstance(v, Tensor) else v for v in vs]
+
+    out = apply(fn, *loop_vars, op_name="while_loop")
+    return list(out)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    raise NotImplementedError(
+        "static.nn.fc: build models with paddle.nn.Linear; static-graph "
+        "parameter creation is out of the TPU build's scope (SURVEY.md §7.0)")

@@ -80,10 +80,12 @@ class cpp_extension:
         so_path = os.path.join(bdir, f"{name}.{tag}.so")
         if not os.path.exists(so_path):
             files, scratch = [], []
-            for i, (src, blob) in enumerate(zip(srcs, blobs)):
+            for src, blob in zip(srcs, blobs):
                 if src is None:
-                    src = os.path.join(bdir, f"{name}.{tag}.{i}.cpp")
-                    with open(src, "w") as f:
+                    # per-process unique scratch name: concurrent builders
+                    # of the same tag must not share (or delete) sources
+                    fd, src = tempfile.mkstemp(suffix=".cpp", dir=bdir)
+                    with os.fdopen(fd, "w") as f:
                         f.write(blob)
                     scratch.append(src)
                 files.append(src)

@@ -114,6 +114,11 @@ def register_op(fwd=None, *, name=None, vjp=None, nondiff_argnums=(),
     @functools.wraps(fwd)
     def op(*args, **kwargs):
         if vjp is not None and kwargs:
+            if any(isinstance(v, Tensor) for v in kwargs.values()):
+                raise TypeError(
+                    f"custom op '{op_name}': Tensors must be passed "
+                    "positionally when a vjp is registered (keyword args "
+                    "are static configuration bound by closure)")
             try:
                 fn = _bound(tuple(sorted(kwargs.items())))
             except TypeError:

@@ -1,0 +1,149 @@
+"""Static control flow: paddle.static.nn.cond / while_loop / switch_case
+(VERDICT.md round-1 item 9; reference:
+``python/paddle/static/nn/control_flow.py`` + the dy2static ifelse/while
+converters — here they lower to lax.cond / lax.while_loop / lax.switch so
+tensor-dependent branches compile instead of graph-breaking)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+def t(v):
+    return paddle.to_tensor(np.asarray(v, np.float32))
+
+
+def test_cond_eager():
+    out = snn.cond(t(1.0) > 0, lambda: t([1.0]), lambda: t([2.0]))
+    np.testing.assert_allclose(out.numpy(), [1.0])
+    out = snn.cond(t(-1.0) > 0, lambda: t([1.0]), lambda: t([2.0]))
+    np.testing.assert_allclose(out.numpy(), [2.0])
+
+
+def test_cond_compiled_no_graph_break():
+    """A tensor-dependent branch inside @to_static stays compiled — no
+    graph-break warning, correct both ways."""
+    @paddle.jit.to_static
+    def branchy(x):
+        s = x.sum()
+        return snn.cond(s > 0, lambda: x * 2.0, lambda: x - 1.0)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")     # any graph-break warns -> fail
+        pos = branchy(t([1.0, 2.0]))
+        neg = branchy(t([-1.0, -2.0]))
+    np.testing.assert_allclose(pos.numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(neg.numpy(), [-2.0, -3.0])
+
+
+def test_cond_grad_eager_and_compiled():
+    x = t([3.0])
+    x.stop_gradient = False
+    out = snn.cond((x > 0).all(), lambda: (x * x).sum(), lambda: x.sum())
+    out.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    class Branchy(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(2, 2)
+
+        def forward(self, x):
+            y = self.lin(x)
+            return snn.cond(y.sum() > 0, lambda: (y * y).sum(),
+                            lambda: y.sum())
+
+    m = paddle.jit.to_static(Branchy())
+    xx = t([[1.0, 2.0]])
+    loss = m(xx)
+    loss.backward()      # grads flow through lax.cond via the outer vjp
+    assert m.lin.weight.grad is not None
+
+
+def test_while_loop_eager_and_compiled():
+    def cond_fn(i, s):
+        return i < 5
+
+    def body_fn(i, s):
+        return i + 1, s + i
+
+    i, s = snn.while_loop(cond_fn, body_fn, [t(0.0), t(0.0)])
+    np.testing.assert_allclose(s.numpy(), 10.0)    # 0+1+2+3+4
+
+    @paddle.jit.to_static
+    def f(n):
+        i, s = snn.while_loop(lambda i, s: i < n, body_fn,
+                              [paddle.zeros([]), paddle.zeros([])])
+        return s
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        out = f(t(5.0))
+    np.testing.assert_allclose(out.numpy(), 10.0)
+
+
+def test_switch_case_and_case():
+    fns = [lambda: t([10.0]), lambda: t([20.0]), lambda: t([30.0])]
+    np.testing.assert_allclose(
+        snn.switch_case(paddle.to_tensor(1), fns).numpy(), [20.0])
+
+    @paddle.jit.to_static
+    def f(i):
+        return snn.switch_case(i, fns, default=lambda: t([99.0]))
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        np.testing.assert_allclose(f(paddle.to_tensor(2)).numpy(), [30.0])
+        np.testing.assert_allclose(f(paddle.to_tensor(7)).numpy(), [99.0])
+
+    out = snn.case([(t(0.0) > 1, lambda: t([1.0])),
+                    (t(2.0) > 1, lambda: t([2.0]))],
+                   default=lambda: t([3.0]))
+    np.testing.assert_allclose(out.numpy(), [2.0])
+
+
+def test_graph_break_retries_before_latching():
+    """One transient tracer error must not permanently latch eager."""
+    from paddle_tpu.jit.api import StaticFunction
+
+    fail_once = {"n": 0}
+
+    def flaky(x):
+        if fail_once["n"] == 0:
+            fail_once["n"] += 1
+            if float(x.sum().numpy()) > -1e9:   # tracer bool -> graph break
+                pass
+        return x * 2.0
+
+    sf = StaticFunction(flaky)
+    xx = t([1.0, 2.0])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out1 = sf(xx)                     # breaks (eager result), retry armed
+        out2 = sf(xx)                     # compiles clean this time
+    np.testing.assert_allclose(out1.numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(out2.numpy(), [2.0, 4.0])
+    entry = list(sf._cache.values())[0]
+    assert not entry["fallback"] and entry["breaks"] == 0  # reset on success
+
+
+def test_persistently_dynamic_latches():
+    def dynamic(x):
+        if float(x.sum().numpy()) > 0:    # always concretizes -> break
+            return x * 2.0
+        return x
+
+    from paddle_tpu.jit.api import StaticFunction
+    sf = StaticFunction(dynamic)
+    xx = t([1.0])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sf(xx)
+        sf(xx)
+        out = sf(xx)
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    entry = list(sf._cache.values())[0]
+    assert entry["fallback"] and entry["breaks"] == 2
