@@ -156,13 +156,48 @@ def bench_llama():
     }
 
 
+def bench_llama_decode():
+    """Serving-tier decode bench: batched autoregressive decode through the
+    paged KV cache + Pallas paged_attention kernel (tokens/sec)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    prompt = int(os.environ.get("BENCH_PROMPT", "128"))
+    new = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                      intermediate_size=2816, num_hidden_layers=8,
+                      num_attention_heads=16, num_key_value_heads=8,
+                      max_position_embeddings=max(2048, prompt + new))
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
+                                        (batch, prompt)).astype(np.int64))
+    model.generate(ids, max_new_tokens=4, use_paged_cache=True)  # warmup
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new, use_paged_cache=True)
+    assert out.shape[1] == prompt + new
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "llama_paged_decode_tokens_per_sec",
+        "value": round(batch * new / dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestration: never hang, never exit without a JSON line.
 # --------------------------------------------------------------------------
 
 def _child_main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
-    out = bench_llama() if mode == "llama" else bench_resnet()
+    out = (bench_llama() if mode == "llama"
+           else bench_llama_decode() if mode == "llama_decode"
+           else bench_resnet())
     import jax
     out["backend"] = jax.devices()[0].platform.lower()
     print(json.dumps(out))
@@ -252,9 +287,12 @@ def main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
     print(json.dumps({
         "metric": ("llama_1b_train_tokens_per_sec" if mode == "llama"
+                   else "llama_paged_decode_tokens_per_sec"
+                   if mode == "llama_decode"
                    else "resnet50_cifar10_train_throughput"),
         "value": None,
-        "unit": "tokens/sec" if mode == "llama" else "images/sec",
+        "unit": ("tokens/sec" if mode in ("llama", "llama_decode")
+                 else "images/sec"),
         "vs_baseline": None,
         "error": (" || ".join(e.replace("\n", " ")[:300]
                               for e in errors))[:1200],

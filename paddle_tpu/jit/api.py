@@ -91,10 +91,11 @@ def _spec_key(args, kwargs, training):
                 parts.append(("O", id(a)))
                 pinned.append(a)
             else:
-                # key on the object itself: the cache key tuple holds a
-                # strong ref (no id recycling) and dict equality uses the
-                # object's own __eq__, so hash collisions can't alias
-                parts.append(("H", a))
+                # key on (type, object): the key tuple holds a strong ref
+                # (no id recycling), dict equality uses the object's own
+                # __eq__, and the type tag keeps value-equal cross-type
+                # args (2 vs 2.0 vs True) from aliasing one trace
+                parts.append(("H", type(a).__qualname__, a))
     return tuple(parts), pinned
 
 

@@ -16,7 +16,7 @@ from ..framework.core import Tensor
 from ..autograd.tape import no_grad
 from ..framework import random as prandom
 
-__all__ = ["KVCache", "GenerationMixin"]
+__all__ = ["KVCache", "PagedKVCache", "GenerationMixin"]
 
 
 class KVCache:
@@ -46,6 +46,141 @@ class KVCache:
     def reset(self):
         self.pos = 0
         self._store.clear()
+
+    def attend(self, layer, q, k, v, training=False, dropout_p=0.0):
+        """Cache-aware attention: update the store with this step's K/V and
+        return the attention output [b, s, heads, d]. The attention layer
+        delegates here so cache layouts (concat vs paged) are swappable."""
+        from ..nn import functional as F
+        k, v = self.update(layer, k, v)
+        return F.scaled_dot_product_attention(q, k, v, attn_mask=None,
+                                              dropout_p=dropout_p,
+                                              is_causal=True,
+                                              training=training)
+
+
+class PagedKVCache(KVCache):
+    """Paged (block-table) KV cache for batched decode — the serving tier's
+    cache (reference: ``block_multihead_attention``'s vLLM-style paged KV;
+    VERDICT.md round-1 item 10).
+
+    K/V live in fixed-size pages ``[num_pages, page_size, kv_heads, d]``
+    per attention layer; a shared per-sequence block table maps positions
+    to pages. Prefill scatters the prompt's K/V into pages and attends
+    densely; each decode step writes one slot and runs the Pallas
+    ``paged_attention`` kernel (ops/pallas/paged_attention.py)."""
+
+    def __init__(self, page_size=16, max_len=2048):
+        super().__init__()
+        self.page_size = int(page_size)
+        self.max_len = int(max_len)
+        self.pages_per_seq = -(-self.max_len // self.page_size)
+        self._pools = {}          # id(layer) -> (k_pages, v_pages)
+        self._tables = None       # [batch, pages_per_seq] int32
+        self._batch = None
+
+    def reset(self):
+        super().reset()
+        self._pools.clear()
+        self._tables = None
+        self._batch = None
+
+    def _ensure_tables(self, batch):
+        if self._tables is None:
+            self._batch = batch
+            # contiguous static allocation: sequence b owns pages
+            # [b*pps, (b+1)*pps) — correctness-first; a free-list
+            # allocator can swap in without touching the kernel
+            self._tables = (np.arange(batch)[:, None] * self.pages_per_seq
+                            + np.arange(self.pages_per_seq)[None, :]
+                            ).astype(np.int32)
+        return jnp.asarray(self._tables)
+
+    def _pool(self, layer, kv_heads, d, dtype, batch):
+        key = id(layer)
+        if key not in self._pools:
+            n = batch * self.pages_per_seq
+            shape = (n, self.page_size, kv_heads, d)
+            self._pools[key] = (jnp.zeros(shape, dtype),
+                                jnp.zeros(shape, dtype))
+        return self._pools[key]
+
+    def _step_indices(self, start, s, b):
+        """Scatter/kernel indices for this step — identical for every
+        layer, so compute once per (pos, s, batch)."""
+        key = (start, s, b)
+        if getattr(self, "_idx_key", None) != key:
+            pos = np.arange(start, start + s)
+            self._idx_cache = (
+                jnp.asarray(self._tables[:, pos // self.page_size]),   # [b,s]
+                jnp.asarray((pos % self.page_size)[None, :]
+                            .repeat(b, axis=0)),
+                jnp.asarray(self._tables),
+                jnp.full((b,), start + s, jnp.int32),
+            )
+            self._idx_key = key
+        return self._idx_cache
+
+    def attend(self, layer, q, k, v, training=False, dropout_p=0.0):
+        from ..autograd.tape import apply
+        from ..nn import functional as F
+
+        if dropout_p and training:
+            raise ValueError("PagedKVCache is a serving cache: attention "
+                             "dropout is not supported")
+        b, s, kv_heads, d = (k.shape if not isinstance(k, Tensor)
+                             else tuple(k.shape))
+        if self._batch is not None and self._batch != b:
+            raise ValueError(f"PagedKVCache was allocated for batch "
+                             f"{self._batch}, got {b}; call reset() first")
+        self._ensure_tables(b)
+        k_pages, v_pages = self._pool(layer, kv_heads, d,
+                                      k._data.dtype if isinstance(k, Tensor)
+                                      else k.dtype, b)
+        start = self.pos
+        if start + s > self.max_len:
+            raise ValueError(f"PagedKVCache overflow: {start}+{s} > "
+                             f"{self.max_len}")
+        page_ids, slot_ids, tables, ctx = self._step_indices(start, s, b)
+
+        def scatter(kp, vp, ka, va):
+            kp = kp.at[page_ids, slot_ids].set(ka)
+            vp = vp.at[page_ids, slot_ids].set(va)
+            return kp, vp
+
+        new_kp, new_vp = scatter(k_pages, v_pages,
+                                 k._data if isinstance(k, Tensor) else k,
+                                 v._data if isinstance(v, Tensor) else v)
+        self._pools[id(layer)] = (new_kp, new_vp)
+
+        if s > 1:
+            # prefill: dense attention; with prior context (a reused cache,
+            # chunked prefill) read the full prefix back from the pages —
+            # sdpa's bottom-right causal alignment handles sq != sk
+            if start > 0:
+                n_pages = -(-(start + s) // self.page_size)
+                kf = Tensor(new_kp[jnp.asarray(self._tables[:, :n_pages])]
+                            .reshape(b, n_pages * self.page_size, kv_heads,
+                                     d)[:, :start + s])
+                vf = Tensor(new_vp[jnp.asarray(self._tables[:, :n_pages])]
+                            .reshape(b, n_pages * self.page_size, kv_heads,
+                                     d)[:, :start + s])
+            else:
+                kf, vf = k, v
+            return F.scaled_dot_product_attention(q, kf, vf, attn_mask=None,
+                                                  is_causal=True,
+                                                  training=training)
+        # decode: one token per sequence through the paged kernel
+        from ..ops.pallas.paged_attention import paged_attention
+        import jax as _jax
+        interpret = _jax.default_backend() != "tpu"
+
+        def fn(qa):
+            out = paged_attention(qa[:, 0], new_kp, new_vp, tables, ctx,
+                                  interpret=interpret)
+            return out[:, None]          # [b, 1, heads, d]
+
+        return apply(fn, q, op_name="paged_attention")
 
 
 def _sample_logits(logits, do_sample, top_k, top_p, temperature):
@@ -89,7 +224,14 @@ class GenerationMixin:
                 else Tensor(np.asarray(input_ids, np.int64))
             if max_length is not None:
                 max_new_tokens = max(max_length - ids.shape[1], 0)
-            cache = KVCache() if self.supports_cache else None
+            cache = kw.pop("cache", None)
+            if cache is None and self.supports_cache:
+                if kw.pop("use_paged_cache", False):
+                    cache = PagedKVCache(
+                        page_size=kw.pop("page_size", 16),
+                        max_len=ids.shape[1] + max_new_tokens)
+                else:
+                    cache = KVCache()
             cur = ids
             all_ids = ids._data
             finished = jnp.zeros((ids.shape[0],), bool)

@@ -77,10 +77,12 @@ class GPTAttention(Layer):
             [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = (qkv[:, :, i] for i in range(3))
         if cache is not None:
-            k, v = cache.update(self, k, v)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.dropout_p,
-            training=self.training)
+            out = cache.attend(self, q, k, v, training=self.training,
+                               dropout_p=self.dropout_p)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.dropout_p,
+                training=self.training)
         return self.out_proj(out.reshape([b, s, h]))
 
 

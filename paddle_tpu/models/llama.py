@@ -157,11 +157,9 @@ class LlamaAttention(Layer):
         q, k, _ = fused_ops.fused_rotary_position_embedding(
             q, k, sin=self._sin, cos=self._cos, position_ids=position_ids)
         if cache is not None:
-            # decode: append new K/V, attend over the filled prefix
-            k, v = cache.update(self, k, v)
-            out = F.scaled_dot_product_attention(
-                q, k, v, attn_mask=None, is_causal=True,
-                training=self.training)
+            # decode: the cache owns its layout (concat or paged) and the
+            # cache-aware attention over the filled prefix
+            out = cache.attend(self, q, k, v, training=self.training)
         elif self._use_ring_attention():
             # context parallelism: seq dim sharded over 'sep', KV blocks
             # rotate around the ring (SURVEY.md §5.7 mechanism 3)

@@ -71,14 +71,22 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     """Dispatch on an integer index (reference ``static.nn.switch_case``;
     ``lax.switch`` under trace). ``branch_fns``: list of callables or
     {index: callable} with dense 0..N-1 keys after filling ``default``."""
+    idx = _arr(branch_index)
     if isinstance(branch_fns, dict):
+        if not isinstance(idx, jax.core.Tracer):
+            i = int(idx)     # eager: direct dict dispatch, sparse is fine
+            fn = branch_fns.get(i, default)
+            if fn is None:
+                raise ValueError(f"switch_case: no branch for index {i} "
+                                 "and no default")
+            return fn()
         hi = max(branch_fns) + 1
         fns = [branch_fns.get(i, default) for i in range(hi)]
         if any(f is None for f in fns):
-            raise ValueError("switch_case: sparse branch dict needs default")
+            raise ValueError("switch_case: under a trace a sparse branch "
+                             "dict needs a default (lax.switch is dense)")
     else:
         fns = list(branch_fns)
-    idx = _arr(branch_index)
     if not isinstance(idx, jax.core.Tracer):
         i = int(idx)
         if 0 <= i < len(fns):

@@ -1,0 +1,127 @@
+"""Paged-attention serving tier (VERDICT.md round-1 item 10; reference:
+``block_multihead_attention`` / ``fused_multi_transformer``'s paged KV
+serving path). Kernel runs in interpret mode on CPU; the same code path
+Mosaic-compiles on TPU."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.paged_attention import (paged_attention,
+                                                  paged_attention_reference)
+from paddle_tpu.models.generation import KVCache, PagedKVCache
+
+
+def _setup(batch=3, heads=8, kv_heads=4, d=64, page_size=8, pages_per_seq=4,
+           lens=(5, 17, 32), seed=0):
+    rng = np.random.RandomState(seed)
+    n_pages = batch * pages_per_seq
+    q = jnp.asarray(rng.randn(batch, heads, d), jnp.float32)
+    kp = jnp.asarray(rng.randn(n_pages, page_size, kv_heads, d), jnp.float32)
+    vp = jnp.asarray(rng.randn(n_pages, page_size, kv_heads, d), jnp.float32)
+    tables = (np.arange(batch)[:, None] * pages_per_seq
+              + np.arange(pages_per_seq)[None, :]).astype(np.int32)
+    ctx = np.asarray(lens, np.int32)
+    return q, kp, vp, jnp.asarray(tables), jnp.asarray(ctx)
+
+
+def test_kernel_matches_reference_ragged_gqa():
+    q, kp, vp, tables, ctx = _setup()
+    out = paged_attention(q, kp, vp, tables, ctx, interpret=True)
+    ref = paged_attention_reference(q, kp, vp, tables, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_nonuniform_block_table():
+    """Pages deliberately permuted/shared — the block table, not layout,
+    defines the sequence."""
+    q, kp, vp, _, _ = _setup(batch=2, pages_per_seq=3, lens=(20, 9))
+    tables = jnp.asarray(np.array([[5, 0, 3], [2, 4, 0]], np.int32))
+    ctx = jnp.asarray(np.array([20, 9], np.int32))
+    out = paged_attention(q[:2], kp, vp, tables, ctx, interpret=True)
+    ref = paged_attention_reference(q[:2], kp, vp, tables, ctx)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt"])
+def test_paged_generate_matches_dense(family):
+    """Greedy decode parity: paged cache == concat cache == no cache."""
+    paddle.seed(0)
+    if family == "llama":
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        model = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+        vocab = model.config.vocab_size
+    else:
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        vocab = cfg.vocab_size
+    rng = np.random.RandomState(1)
+    ids = paddle.to_tensor(rng.randint(0, vocab, (2, 7)).astype(np.int64))
+
+    dense = model.generate(ids, max_new_tokens=6)
+    paged = model.generate(ids, max_new_tokens=6, use_paged_cache=True,
+                           page_size=4)
+    np.testing.assert_array_equal(np.asarray(dense._data),
+                                  np.asarray(paged._data))
+
+
+def test_paged_cache_prefill_then_steps():
+    """Cache state evolves correctly across prefill + multiple decodes."""
+    paddle.seed(0)
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    model = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+    model.eval()
+    rng = np.random.RandomState(2)
+    ids = paddle.to_tensor(rng.randint(0, 128, (2, 5)).astype(np.int64))
+
+    from paddle_tpu.autograd.tape import no_grad
+    with no_grad():
+        dense_c, paged_c = KVCache(), PagedKVCache(page_size=4, max_len=16)
+        ld = model(ids, cache=dense_c)
+        lp = model(ids, cache=paged_c)
+        np.testing.assert_allclose(np.asarray(ld._data), np.asarray(lp._data),
+                                   rtol=2e-4, atol=2e-4)
+        nxt = paddle.to_tensor(np.argmax(np.asarray(ld._data)[:, -1], -1)
+                               .astype(np.int64)[:, None])
+        for _ in range(3):
+            ld = model(nxt, cache=dense_c)
+            lp = model(nxt, cache=paged_c)
+            np.testing.assert_allclose(np.asarray(ld._data),
+                                       np.asarray(lp._data),
+                                       rtol=2e-4, atol=2e-4)
+            nxt = paddle.to_tensor(np.argmax(np.asarray(ld._data)[:, -1], -1)
+                                   .astype(np.int64)[:, None])
+
+
+def test_paged_chunked_prefill_sees_prior_context():
+    """Second multi-token chunk into a warm cache must attend over the
+    cached prefix (parity vs the concat cache)."""
+    paddle.seed(0)
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    model = LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+    model.eval()
+    rng = np.random.RandomState(3)
+    c1 = paddle.to_tensor(rng.randint(0, 128, (2, 6)).astype(np.int64))
+    c2 = paddle.to_tensor(rng.randint(0, 128, (2, 5)).astype(np.int64))
+
+    from paddle_tpu.autograd.tape import no_grad
+    with no_grad():
+        dense_c, paged_c = KVCache(), PagedKVCache(page_size=4, max_len=16)
+        model(c1, cache=dense_c)
+        model(c1, cache=paged_c)
+        ld = model(c2, cache=dense_c)
+        lp = model(c2, cache=paged_c)
+    np.testing.assert_allclose(np.asarray(ld._data), np.asarray(lp._data),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_paged_cache_overflow_raises():
+    c = PagedKVCache(page_size=4, max_len=8)
+    q = paddle.to_tensor(np.zeros((1, 9, 2, 8), np.float32))
+    with pytest.raises(ValueError, match="overflow"):
+        c.attend(object(), q, q, q)
