@@ -9,8 +9,21 @@ running each op — the TPU-native analogue of the reference's
 from __future__ import annotations
 
 import contextlib
+import functools
 
+import jax
 import jax.numpy as jnp
+
+
+@functools.partial(jax.jit)
+def _check_finite_and_unscale(grads, inv):
+    """Fused multi-tensor unscale + global finite check (reference:
+    ``check_finite_and_unscale`` CUDA kernel) — one compiled program, one
+    host sync per optimizer step."""
+    outs = [(g.astype(jnp.float32) * inv).astype(g.dtype) for g in grads]
+    finite = jnp.all(jnp.stack(
+        [jnp.all(jnp.isfinite(g.astype(jnp.float32))) for g in grads]))
+    return outs, jnp.logical_not(finite)
 
 from ..framework.core import Tensor
 from ..framework import dtype as dtypes
@@ -156,19 +169,26 @@ class GradScaler:
         return loss * self._scale
 
     def _unscale(self, optimizer):
+        """ONE fused jitted unscale+finite-check over all grads (reference:
+        the ``check_finite_and_unscale`` multi-tensor kernel) — a single
+        device sync for the whole step instead of one blocking round-trip
+        per parameter."""
         if not self._enable or self._unscaled:
             return
-        found_inf = False
-        inv = 1.0 / self._scale
-        for p in optimizer._parameter_list:
-            if p.grad is None:
-                continue
-            g = p.grad._data.astype(jnp.float32) * inv
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            if not finite:
-                found_inf = True
-            p.grad._data = g.astype(p.grad.dtype) if p.grad.dtype != jnp.float32 else g
-        self._found_inf = found_inf
+        grads = [p.grad._data for p in optimizer._parameter_list
+                 if p.grad is not None]
+        if grads:
+            new_grads, found = _check_finite_and_unscale(
+                grads, jnp.asarray(1.0 / self._scale, jnp.float32))
+            i = 0
+            for p in optimizer._parameter_list:
+                if p.grad is None:
+                    continue
+                p.grad._data = new_grads[i]
+                i += 1
+            self._found_inf = bool(found)
+        else:
+            self._found_inf = False
         self._unscaled = True
 
     def unscale_(self, optimizer):

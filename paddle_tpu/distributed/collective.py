@@ -156,6 +156,75 @@ def _exchange(kind: str, value, group: Group):
     return {0: value}
 
 
+_DEV_MESH = [None]
+_DEV_REDUCERS = {}
+
+
+def _normalize_op(op):
+    """Legacy integer enum -> ReduceOp name (reference core.ReduceOp)."""
+    return _LEGACY_OPS.get(op, op) if isinstance(op, int) else op
+
+
+def _dev_reducer(red, out_sharding):
+    """Per-op jitted reducer, created once so repeat eager collectives hit
+    the jit compile cache."""
+    key = (red, out_sharding)
+    if key not in _DEV_REDUCERS:
+        fn = {ReduceOp.SUM: lambda a: a.sum(0),
+              ReduceOp.MAX: lambda a: a.max(0),
+              ReduceOp.MIN: lambda a: a.min(0),
+              ReduceOp.PROD: lambda a: a.prod(0)}[red]
+        _DEV_REDUCERS[key] = jax.jit(fn, out_shardings=out_sharding)
+    return _DEV_REDUCERS[key]
+
+
+def _device_reduce(value: np.ndarray, op, group: Group):
+    """Device-collective tier for reduce ops when the group spans every
+    process: each process feeds its value into a global [n_devices, ...]
+    array (extra local devices hold the op's identity element) and ONE
+    jitted reduction runs over ICI/Gloo — O(tensor) traffic instead of the
+    gather tier's O(world × tensor) host round-trip. Returns the reduced
+    np array, or None when this tier doesn't apply."""
+    if jax.process_count() <= 1 or list(group.ranks) != list(
+            range(get_world_size())):
+        return None
+    if op == ReduceOp.AVG:
+        red, post = ReduceOp.SUM, 1.0 / jax.process_count()
+    else:
+        red, post = op, None
+    if red not in (ReduceOp.SUM, ReduceOp.MAX, ReduceOp.MIN, ReduceOp.PROD):
+        return None
+    dt = np.dtype(value.dtype)
+    if dt == np.bool_:
+        return None                       # identity elements ill-defined
+    if red == ReduceOp.SUM:
+        ident = dt.type(0)
+    elif red == ReduceOp.PROD:
+        ident = dt.type(1)
+    elif np.issubdtype(dt, np.integer):   # MAX/MIN int bounds, not ±inf
+        info = np.iinfo(dt)
+        ident = info.min if red == ReduceOp.MAX else info.max
+    else:
+        ident = -np.inf if red == ReduceOp.MAX else np.inf
+    if _DEV_MESH[0] is None:
+        from jax.sharding import Mesh
+        _DEV_MESH[0] = Mesh(np.array(jax.devices()), ("p",))
+    mesh = _DEV_MESH[0]
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_local = len(jax.local_devices())
+    local = np.broadcast_to(np.asarray(ident, dt),
+                            (n_local,) + value.shape).copy()
+    local[0] = value
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("p")), local)
+    out = _dev_reducer(red, NamedSharding(mesh, P()))(garr)
+    res = np.asarray(out.addressable_data(0))
+    if post is not None:                  # AVG: scale in float, cast back
+        res = (res.astype(np.float64) * post).astype(dt)
+    return res
+
+
 def _np(tensor):
     return np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
 
@@ -176,8 +245,7 @@ _LEGACY_OPS = {0: ReduceOp.SUM, 1: ReduceOp.MAX, 2: ReduceOp.MIN,
 
 
 def _reduce_fn(op):
-    if isinstance(op, int):
-        op = _LEGACY_OPS.get(op, op)
+    op = _normalize_op(op)
     if op not in _REDUCERS:
         raise ValueError(f"unknown ReduceOp {op!r}")
     return _REDUCERS[op]
@@ -188,6 +256,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     group = group or _get_default_group()
     if group.nranks == 1:
         return _Task()
+    if simulator.active_world() is None:
+        dev = _device_reduce(_np(tensor), _normalize_op(op), group)
+        if dev is not None:
+            _write_back(tensor, dev)
+            return _Task()
     got = _exchange("all_reduce", _np(tensor), group)
     vals = [got[i] for i in range(group.nranks)]
     _write_back(tensor, _reduce_fn(op)(vals))
@@ -222,9 +295,14 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
         _write_back(tensor, _np(src))
         return _Task()
     stacked = np.stack([_np(t) for t in tensor_list])  # [nranks, ...] local inputs
+    mine = group.rank
+    if simulator.active_world() is None:
+        dev = _device_reduce(stacked, _normalize_op(op), group)
+        if dev is not None:
+            _write_back(tensor, dev[mine])
+            return _Task()
     got = _exchange("reduce_scatter", stacked, group)
     all_stacked = [got[i] for i in range(group.nranks)]  # per-rank [nranks, ...]
-    mine = group.rank
     reduced = _reduce_fn(op)([s[mine] for s in all_stacked])
     _write_back(tensor, reduced)
     return _Task()

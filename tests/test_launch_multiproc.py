@@ -70,6 +70,17 @@ WORKER = textwrap.dedent("""
             jax.jit(lambda l: l, out_shardings=repl)(loss))))
     if jax.process_index() == 0:
         print("LOSSES:", ",".join(f"{l:.6f}" for l in losses), flush=True)
+
+    # eager collective over the device tier (one jitted reduction across
+    # processes instead of a host allgather)
+    if world > 1:
+        from paddle_tpu.framework.core import Tensor
+        t = Tensor(jnp.full((4,), float(jax.process_index() + 1)))
+        dist.all_reduce(t)
+        expect = sum(range(1, world + 1))
+        assert np.allclose(np.asarray(t._data), expect), np.asarray(t._data)
+        if jax.process_index() == 0:
+            print("ALLREDUCE_OK", flush=True)
     print("WORKER_DONE rank", jax.process_index(), flush=True)
 """)
 
@@ -139,5 +150,6 @@ def test_launch_two_process_dp_parity(tmp_path):
     log0 = (logdir / "workerlog.0").read_text()
     dist_losses = _parse_losses(log0)
     np.testing.assert_allclose(dist_losses, oracle, rtol=1e-5, atol=1e-6)
+    assert "ALLREDUCE_OK" in log0
     assert "WORKER_DONE rank 0" in log0
     assert "WORKER_DONE rank 1" in (logdir / "workerlog.1").read_text()
