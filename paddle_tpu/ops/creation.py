@@ -230,3 +230,11 @@ def poisson(x, name=None):
 def exponential_(x, lam=1.0, name=None):
     val = jax.random.exponential(prandom.next_key(), x._data.shape).astype(x.dtype) / lam
     return x._replace_(val)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def fn(a):
+        cols = n if n is not None else a.shape[0]
+        out = jnp.vander(a, cols, increasing=increasing)
+        return out
+    return apply(fn, x, op_name="vander")

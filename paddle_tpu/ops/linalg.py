@@ -176,3 +176,40 @@ def pca_lowrank(x, q=None, center=True, niter=2):
     u, s, v = jnp.linalg.svd(x, full_matrices=False)
     k = q or min(6, *x.shape[-2:])
     return u[..., :k], s[..., :k], jnp.swapaxes(v, -1, -2)[..., :k]
+
+
+@defop
+def matrix_exp(x):
+    import jax.scipy.linalg as jsl
+    return jsl.expm(x)
+
+
+@defop
+def ormqr(x, tau, y, left=True, transpose=False):
+    """Apply Q (implicit in geqrf's packed reflectors ``x`` + ``tau``) to
+    ``y`` without forming it (LAPACK ormqr semantics): each Householder
+    H_i = I - tau_i v_i v_i^T is applied in the order the side/transpose
+    combination requires."""
+    m = x.shape[-2]
+    k = tau.shape[-1]
+    rows = jnp.arange(m)
+
+    def reflector(i):
+        col = x[:, i]
+        return jnp.where(rows == i, 1.0, jnp.where(rows > i, col, 0.0))
+
+    # Q = H_0 H_1 ... H_{k-1}
+    # left:  Q y   -> apply H_{k-1} first;  Q^T y -> H_0 first
+    # right: y Q   -> apply H_0 first;      y Q^T -> H_{k-1} first
+    ascending = (left and transpose) or (not left and not transpose)
+
+    def body(j, acc):
+        i = j if ascending else k - 1 - j
+        v = reflector(i)
+        t = tau[i]
+        if left:
+            return acc - t * jnp.outer(v, v @ acc)
+        return acc - t * jnp.outer(acc @ v, v)
+
+    return jax.lax.fori_loop(0, k, body, y.astype(jnp.promote_types(x.dtype,
+                                                                    y.dtype)))

@@ -434,3 +434,106 @@ def permute(x, *perm, name=None):
     if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
         perm = tuple(perm[0])
     return transpose(x, list(perm))
+
+
+# ---------------------------------------------------------------------------
+# breadth batch (round 2): reference python/paddle/tensor/manipulation.py
+# ---------------------------------------------------------------------------
+
+def _atleast(nd):
+    def go(*inputs, name=None):
+        fns = {1: jnp.atleast_1d, 2: jnp.atleast_2d, 3: jnp.atleast_3d}
+        outs = [apply(fns[nd], t, op_name=f"atleast_{nd}d") for t in inputs]
+        return outs[0] if len(outs) == 1 else outs
+    go.__name__ = f"atleast_{nd}d"
+    return go
+
+
+atleast_1d = _atleast(1)
+atleast_2d = _atleast(2)
+atleast_3d = _atleast(3)
+
+
+def column_stack(x, name=None):
+    return apply(lambda *ts: jnp.column_stack(ts), *x, op_name="column_stack")
+
+
+def row_stack(x, name=None):
+    return apply(lambda *ts: jnp.vstack(ts), *x, op_name="row_stack")
+
+
+def dstack(x, name=None):
+    return apply(lambda *ts: jnp.dstack(ts), *x, op_name="dstack")
+
+
+def hsplit(x, num_or_indices, name=None):
+    return apply(lambda a: tuple(jnp.hsplit(a, num_or_indices)), x,
+                 op_name="hsplit")
+
+
+def vsplit(x, num_or_indices, name=None):
+    return apply(lambda a: tuple(jnp.vsplit(a, num_or_indices)), x,
+                 op_name="vsplit")
+
+
+def dsplit(x, num_or_indices, name=None):
+    return apply(lambda a: tuple(jnp.dsplit(a, num_or_indices)), x,
+                 op_name="dsplit")
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return apply(lambda a: tuple(jnp.array_split(a, num_or_indices,
+                                                 axis=axis)), x,
+                 op_name="tensor_split")
+
+
+def unflatten(x, axis, shape, name=None):
+    def fn(a):
+        ax = axis % a.ndim
+        sh = list(a.shape[:ax]) + [int(s) for s in shape] + list(a.shape[ax + 1:])
+        return a.reshape(sh)
+    return apply(fn, x, op_name="unflatten")
+
+
+def block_diag(inputs, name=None):
+    def fn(*ts):
+        import jax.scipy.linalg as jsl
+        return jsl.block_diag(*[jnp.atleast_2d(t) for t in ts])
+    return apply(fn, *inputs, op_name="block_diag")
+
+
+@defop
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    # normalize the diagonal plane to the LAST two dims so the advanced
+    # indices stay adjacent (arbitrary axis pairs, ndim >= 2)
+    a1, a2 = axis1 % x.ndim, axis2 % x.ndim
+    xm = jnp.moveaxis(x, (a1, a2), (-2, -1))
+    idx = jnp.arange(y.shape[-1])
+    i1 = idx + (-offset if offset < 0 else 0)
+    i2 = idx + (offset if offset > 0 else 0)
+    xm = xm.at[..., i1, i2].set(y)
+    return jnp.moveaxis(xm, (-2, -1), (a1, a2))
+
+
+@defop
+def select_scatter(x, values, axis, index):
+    indexer = [builtins_slice(None)] * x.ndim
+    indexer[axis % x.ndim] = index
+    return x.at[tuple(indexer)].set(values)
+
+
+@defop
+def slice_scatter(x, value, axes, starts, ends, strides=None):
+    strides = strides or [1] * len(axes)
+    indexer = [builtins_slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        indexer[ax] = builtins_slice(int(st), int(en), int(sr))
+    return x.at[tuple(indexer)].set(value)
+
+
+@defop
+def index_fill(x, index, axis, value):
+    indexer = [builtins_slice(None)] * x.ndim
+    indexer[axis % x.ndim] = index
+    v = value._data if hasattr(value, "_data") else value
+    return x.at[tuple(indexer)].set(jnp.asarray(v, x.dtype))

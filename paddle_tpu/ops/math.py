@@ -549,3 +549,117 @@ def take(x, index, mode="raise", name=None):
 def broadcast_shape(x_shape, y_shape):
     import numpy as _np
     return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+# ---------------------------------------------------------------------------
+# breadth batch (round 2): reference python/paddle/tensor/math.py additions
+# ---------------------------------------------------------------------------
+
+def add_n(inputs, name=None):
+    """paddle.add_n — elementwise sum of a list of tensors."""
+    import functools as _ft
+    import operator as _op
+    if isinstance(inputs, Tensor):
+        return apply(lambda a: a, inputs, op_name="add_n")
+    # NB: module-level ``sum`` is the paddle reduction op, not the builtin
+    return apply(lambda *ts: _ft.reduce(_op.add, ts), *inputs,
+                 op_name="add_n")
+
+
+@defop
+def clip_by_norm(x, max_norm):
+    n = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))
+    scale = jnp.where(n > max_norm, max_norm / jnp.maximum(n, 1e-12), 1.0)
+    return (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+@defop
+def ldexp(x, y):
+    # jnp.ldexp scales incrementally: no 2**y intermediate overflow
+    return jnp.ldexp(x.astype(jnp.float32), y.astype(jnp.int32))
+
+
+@defop
+def frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+sinc = _unary("sinc", jnp.sinc)
+signbit = _unary("signbit", jnp.signbit)
+isneginf = _unary("isneginf", jnp.isneginf)
+isposinf = _unary("isposinf", jnp.isposinf)
+isreal = _unary("isreal", jnp.isreal)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+
+
+@defop
+def polygamma(x, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@defop
+def gammainc(x, y):
+    """Regularized lower incomplete gamma (paddle.gammainc(x, y) = P(x, y))."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+@defop
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+igamma = gammainc
+igammac = gammaincc
+
+
+@defop
+def multigammaln(x, p):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    def fn(a):
+        return jnp.nanquantile(a, q, axis=_axis(axis), keepdims=keepdim)
+    return apply(fn, x, op_name="nanquantile")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along ``axis`` (reference paddle.renorm)."""
+    def fn(a):
+        red = tuple(d for d in range(a.ndim) if d != (axis % a.ndim))
+        norms = jnp.sum(jnp.abs(a.astype(jnp.float32)) ** p, axis=red,
+                        keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return (a * scale).astype(a.dtype)
+    return apply(fn, x, op_name="renorm")
+
+
+@defop
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+@defop
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+
+@defop
+def cartesian_prod(x):
+    """Cartesian product of a list of 1-D tensors (paddle.cartesian_prod)."""
+    grids = jnp.meshgrid(*x, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+@defop
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+    n = x.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.array(list(it), np.int32).reshape(-1, r)
+    return x[idx]

@@ -638,6 +638,147 @@ CASES += [
            name="vector_norm"),
 ]
 
+
+# round-2 breadth batch ------------------------------------------------------
+CASES += [
+    OpCase("add_n", lambda: {"inputs": [randn(3, 4), randn(3, 4), randn(3, 4)]},
+           ref=lambda inputs: inputs[0] + inputs[1] + inputs[2],
+           rtol=1e-5, name="add_n"),
+    OpCase("clip_by_norm", _mk(x=lambda: randn(4, 4) * 10),
+           kwargs={"max_norm": 1.0},
+           ref=lambda x: x * min(1.0, 1.0 / np.linalg.norm(x)), rtol=1e-4),
+    OpCase("ldexp", _mk(x=lambda: randn(3, 4),
+                        y=lambda: randint(3, 4, lo=-3, hi=4).astype(np.float32)),
+           ref=lambda x, y: np.ldexp(x, y.astype(np.int32)), rtol=1e-5),
+    OpCase("frexp", _mk(x=lambda: randpos(3, 4)),
+           ref=lambda x: tuple(np.frexp(x))),
+    OpCase("sinc", _mk(x=lambda: randn(3, 4)), ref=np.sinc, rtol=1e-4,
+           atol=1e-5),
+    OpCase("signbit", _mk(x=lambda: randn(3, 4)), ref=np.signbit),
+    OpCase("isneginf", _mk(x=lambda: np.array([1.0, -np.inf, np.inf], np.float32)),
+           ref=np.isneginf),
+    OpCase("isposinf", _mk(x=lambda: np.array([1.0, -np.inf, np.inf], np.float32)),
+           ref=np.isposinf),
+    OpCase("isreal", _mk(x=lambda: randn(4)), ref=np.isreal, static=False),
+    OpCase("i0e", _mk(x=lambda: randpos(3, 4))),
+    OpCase("i1", _mk(x=lambda: randpos(3, 4))),
+    OpCase("i1e", _mk(x=lambda: randpos(3, 4))),
+    OpCase("polygamma", _mk(x=lambda: randpos(3, 4, lo=0.5, hi=3.0)),
+           kwargs={"n": 1}),
+    OpCase("gammainc", _mk(x=lambda: randpos(3, 4, lo=0.5, hi=3.0),
+                           y=lambda: randpos(3, 4, lo=0.5, hi=3.0))),
+    OpCase("gammaincc", _mk(x=lambda: randpos(3, 4, lo=0.5, hi=3.0),
+                            y=lambda: randpos(3, 4, lo=0.5, hi=3.0))),
+    OpCase("multigammaln", _mk(x=lambda: randpos(3, 4, lo=3.0, hi=6.0)),
+           kwargs={"p": 2}),
+    OpCase("nanquantile",
+           _mk(x=lambda: np.where(randn(3, 8) > 1.5, np.nan,
+                                  randn(3, 8)).astype(np.float32)),
+           kwargs={"q": 0.5, "axis": 1},
+           ref=lambda x: np.nanquantile(x, 0.5, axis=1), rtol=1e-4,
+           atol=1e-5),
+    OpCase("renorm", _mk(x=lambda: randn(3, 4, 5)),
+           kwargs={"p": 2.0, "axis": 1, "max_norm": 1.0}),
+    OpCase("bitwise_left_shift",
+           _mk(x=lambda: randint(3, 4, lo=0, hi=8).astype(np.int32),
+               y=lambda: randint(3, 4, lo=0, hi=4).astype(np.int32)),
+           ref=np.left_shift),
+    OpCase("bitwise_right_shift",
+           _mk(x=lambda: randint(3, 4, lo=0, hi=64).astype(np.int32),
+               y=lambda: randint(3, 4, lo=0, hi=4).astype(np.int32)),
+           ref=np.right_shift),
+    OpCase("cartesian_prod", lambda: {"x": [randn(3), randn(2)]},
+           ref=lambda x: np.stack([g.reshape(-1) for g in
+                                   np.meshgrid(*x, indexing="ij")], -1),
+           name="cartesian_prod"),
+    OpCase("combinations", _mk(x=lambda: randn(4)),
+           ref=lambda x: np.array([[x[0], x[1]], [x[0], x[2]], [x[0], x[3]],
+                                   [x[1], x[2]], [x[1], x[3]],
+                                   [x[2], x[3]]])),
+    OpCase(lambda x: paddle.atleast_1d(x), _mk(x=lambda: np.asarray(3.0, np.float32)),
+           ref=lambda x: np.atleast_1d(x), static=False, name="atleast_1d"),
+    OpCase(lambda x: paddle.atleast_2d(x), _mk(x=lambda: randn(3)),
+           ref=lambda x: np.atleast_2d(x), static=False, name="atleast_2d"),
+    OpCase(lambda x: paddle.atleast_3d(x), _mk(x=lambda: randn(3, 2)),
+           ref=lambda x: np.atleast_3d(x), static=False, name="atleast_3d"),
+    OpCase("column_stack", lambda: {"x": [randn(3), randn(3, 2)]},
+           ref=lambda x: np.column_stack(x), name="column_stack"),
+    OpCase("row_stack", lambda: {"x": [randn(2, 3), randn(1, 3)]},
+           ref=lambda x: np.vstack(x), name="row_stack"),
+    OpCase("dstack", lambda: {"x": [randn(2, 3), randn(2, 3)]},
+           ref=lambda x: np.dstack(x), name="dstack"),
+    OpCase("hsplit", _mk(x=lambda: randn(4, 6)),
+           kwargs={"num_or_indices": 3},
+           ref=lambda x: tuple(np.hsplit(x, 3))),
+    OpCase("vsplit", _mk(x=lambda: randn(6, 4)),
+           kwargs={"num_or_indices": 2},
+           ref=lambda x: tuple(np.vsplit(x, 2))),
+    OpCase("dsplit", _mk(x=lambda: randn(2, 3, 4)),
+           kwargs={"num_or_indices": 2},
+           ref=lambda x: tuple(np.dsplit(x, 2))),
+    OpCase("tensor_split", _mk(x=lambda: randn(7, 3)),
+           kwargs={"num_or_indices": 3},
+           ref=lambda x: tuple(np.array_split(x, 3))),
+    OpCase("unflatten", _mk(x=lambda: randn(2, 12)),
+           kwargs={"axis": 1, "shape": [3, 4]},
+           ref=lambda x: x.reshape(2, 3, 4)),
+    OpCase("block_diag", lambda: {"inputs": [randn(2, 2), randn(3, 1)]},
+           ref=lambda inputs: _np_block_diag(inputs), name="block_diag"),
+    OpCase("diagonal_scatter", _mk(x=lambda: randn(4, 4),
+                                   y=lambda: randn(4)),
+           ref=lambda x, y: _np_diag_scatter(x, y)),
+    OpCase("select_scatter", _mk(x=lambda: randn(3, 4),
+                                 values=lambda: randn(4)),
+           kwargs={"axis": 0, "index": 1},
+           ref=lambda x, values: _np_select_scatter(x, values)),
+    OpCase("slice_scatter", _mk(x=lambda: np.zeros((4, 4), np.float32),
+                                value=lambda: randn(2, 4)),
+           kwargs={"axes": [0], "starts": [1], "ends": [3]},
+           ref=lambda x, value: _np_slice_scatter(x, value)),
+    OpCase("index_fill", _mk(x=lambda: randn(4, 3),
+                             index=lambda: np.array([0, 2])),
+           kwargs={"axis": 0, "value": 7.0},
+           ref=lambda x, index: _np_index_fill(x, index, 7.0)),
+    OpCase("vander", _mk(x=lambda: randn(4)), kwargs={"n": 3},
+           ref=lambda x: np.vander(x, 3), rtol=1e-4, atol=1e-5),
+    OpCase("linalg.matrix_exp", _mk(x=lambda: randn(3, 3) * 0.3),
+           rtol=1e-3, atol=1e-4, name="matrix_exp"),
+    OpCase("linalg.ormqr", _mk(x=lambda: randn(4, 3),
+                               tau=lambda: randu(3, lo=0.1, hi=1.0),
+                               y=lambda: randn(4, 2)),
+           static=False, name="ormqr"),
+]
+
+
+def _np_block_diag(inputs):
+    import scipy.linalg as sl
+    return sl.block_diag(*inputs).astype(np.float32)
+
+
+def _np_diag_scatter(x, y):
+    out = x.copy()
+    np.fill_diagonal(out, y)
+    return out
+
+
+def _np_select_scatter(x, values):
+    out = x.copy()
+    out[1] = values
+    return out
+
+
+def _np_slice_scatter(x, value):
+    out = x.copy()
+    out[1:3] = value
+    return out
+
+
+def _np_index_fill(x, index, v):
+    out = x.copy()
+    out[index] = v
+    return out
+
+
 # intentionally not OpCase-covered (reason required)
 EXEMPT = {
     # module plumbing, not ops
@@ -659,6 +800,7 @@ EXEMPT = {
     "reshape_": "in-place alias of reshape",
     "squeeze_": "in-place alias of squeeze",
     "unsqueeze_": "in-place alias of unsqueeze",
+    "igamma": "alias of gammainc", "igammac": "alias of gammaincc",
 }
 
 
