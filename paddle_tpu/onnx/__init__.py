@@ -1,13 +1,64 @@
-"""paddle.onnx (reference: thin ``paddle.onnx.export`` delegating to the
-external paddle2onnx package; SURVEY.md §2.2). The TPU build's portable
-export format is serialized StableHLO (``paddle.jit.save``) — ONNX export
-would need paddle2onnx, which is not in the image."""
+"""paddle.onnx (reference: ``paddle.onnx.export`` delegating to the external
+paddle2onnx package; SURVEY.md §2.2).
+
+TPU-native: the model is functionalized (the same bridge @to_static uses),
+traced to a jaxpr, and converted equation-by-equation to an ONNX graph
+serialized with an in-repo protobuf writer (``proto.py`` — the onnx package
+is not in the image). Covers the MLP/CNN inference subset; unsupported
+primitives raise by name, and ``paddle.jit.save`` (StableHLO) remains the
+fully-general portable format."""
 from __future__ import annotations
 
+import numpy as np
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    raise NotImplementedError(
-        "paddle.onnx.export requires the external paddle2onnx package (not "
-        "in the TPU build). Use paddle.jit.save(layer, path, input_spec) — "
-        "serialized StableHLO is the portable inference format here; "
-        "paddle.inference.create_predictor loads it.")
+from .export import export_traced
+from . import proto, ref_eval  # noqa: F401
+
+__all__ = ["export", "export_traced"]
+
+
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export an eval-mode Layer to ``<path>.onnx``.
+
+    ``input_spec``: list of example Tensors or InputSpec (static shapes
+    required, as in the reference exporter)."""
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    from ..framework.functional import FunctionalModule
+    from ..jit.api import InputSpec
+
+    if input_spec is None:
+        raise ValueError("paddle.onnx.export needs input_spec (example "
+                         "Tensors or InputSpec with static shapes)")
+    examples = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            examples.append(spec._data)
+        elif isinstance(spec, InputSpec):
+            shape = [1 if (d is None or d == -1) else int(d)
+                     for d in spec.shape]
+            examples.append(jnp.zeros(shape, spec.dtype))
+        else:
+            examples.append(jnp.asarray(np.asarray(spec)))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        fm = FunctionalModule(layer, training=False)
+        p_arrs = fm.param_arrays()
+        b_arrs = fm.buffer_arrays()
+        key = fm.next_key()
+
+        def fwd(*xs):
+            out, _ = fm(p_arrs, b_arrs, key, *xs)
+            return out
+
+        blob = export_traced(fwd, examples, opset=opset_version)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return out_path

@@ -1,0 +1,309 @@
+"""jaxpr → ONNX graph conversion (reference: ``paddle2onnx``'s
+Program→ONNX op mappers; SURVEY.md §2.2 "ONNX export").
+
+TPU-native path: the model is traced to a jaxpr through the same
+functionalization ``@to_static`` uses, then each jaxpr equation maps to an
+ONNX node. Covered primitive subset (the MLP/CNN inference families):
+dot_general, conv_general_dilated, reduce_window (max/avg pool), the
+elementwise/activation set, reductions, reshape/transpose/broadcast,
+concatenate/slice/pad, select_n, cast. Unsupported primitives raise with
+the primitive's name so coverage gaps are explicit, never silent."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import proto
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes = []
+        self.inits = []
+        self.names = {}
+        self.counter = [0]
+
+    def name_of(self, var):
+        key = id(var)
+        if key not in self.names:
+            self.names[key] = f"v{len(self.names)}"
+        return self.names[key]
+
+    def fresh(self, hint):
+        self.counter[0] += 1
+        return f"{hint}_{self.counter[0]}"
+
+    def const(self, arr, hint="const"):
+        name = self.fresh(hint)
+        self.inits.append(proto.tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, op, inputs, n_out=1, hint=None, **attrs):
+        outs = [self.fresh((hint or op).lower()) for _ in range(n_out)]
+        self.nodes.append(proto.node(op, inputs, outs, **attrs))
+        return outs[0] if n_out == 1 else outs
+
+
+def _np_of(var, env):
+    return env[id(var)]
+
+
+def _lower_eqn(ctx, eqn, env):
+    """env: id(var) -> ONNX value name."""
+    prim = eqn.primitive.name
+    invals = []
+    for v in eqn.invars:
+        if isinstance(v, jax.extend.core.Literal):
+            invals.append(ctx.const(np.asarray(v.val), "lit"))
+        else:
+            invals.append(env[id(v)])
+
+    def out(name):
+        env[id(eqn.outvars[0])] = name
+
+    p = eqn.params
+    simple = {
+        "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+        "max": "Max", "min": "Min", "pow": "Pow", "rem": None,
+        "tanh": "Tanh", "exp": "Exp", "log": "Log", "neg": "Neg",
+        "abs": "Abs", "sqrt": "Sqrt", "rsqrt": None, "logistic": "Sigmoid",
+        "floor": "Floor", "ceil": "Ceil", "round": "Round", "sign": "Sign",
+        "sin": "Sin", "cos": "Cos", "erf": "Erf", "sinh": "Sinh",
+        "cosh": "Cosh", "atan": "Atan", "asin": "Asin", "acos": "Acos",
+        "and": "And", "or": "Or", "not": "Not", "xor": "Xor",
+        "eq": "Equal", "ne": None, "lt": "Less", "le": "LessOrEqual",
+        "gt": "Greater", "ge": "GreaterOrEqual",
+    }
+    if prim in simple and simple[prim]:
+        out(ctx.emit(simple[prim], invals))
+    elif prim == "rsqrt":
+        s = ctx.emit("Sqrt", invals)
+        one = ctx.const(np.ones((), eqn.outvars[0].aval.dtype))
+        out(ctx.emit("Div", [one, s]))
+    elif prim == "ne":
+        e = ctx.emit("Equal", invals)
+        out(ctx.emit("Not", [e]))
+    elif prim == "rem":
+        # lax.rem is TRUNCATED remainder == ONNX Mod with fmod=1
+        out(ctx.emit("Mod", invals, fmod=1))
+    elif prim == "integer_pow":
+        y = ctx.const(np.asarray(p["y"], eqn.invars[0].aval.dtype))
+        out(ctx.emit("Pow", [invals[0], y]))
+    elif prim == "dot_general":
+        out(_lower_dot(ctx, eqn, invals))
+    elif prim == "conv_general_dilated":
+        out(_lower_conv(ctx, eqn, invals))
+    elif prim == "reduce_window_max":
+        out(_lower_pool(ctx, eqn, invals, "MaxPool"))
+    elif prim == "reduce_window_sum":
+        out(_lower_pool(ctx, eqn, invals, "SumPool"))
+    elif prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[prim]
+        axes = ctx.const(np.asarray(p["axes"], np.int64))
+        out(ctx.emit(op, [invals[0], axes], keepdims=0))
+    elif prim == "argmax":
+        out(ctx.emit("ArgMax", invals, axis=int(p["axes"][0]), keepdims=0))
+    elif prim == "reshape":
+        shape = ctx.const(np.asarray(eqn.outvars[0].aval.shape, np.int64))
+        out(ctx.emit("Reshape", [invals[0], shape]))
+    elif prim == "squeeze":
+        axes = ctx.const(np.asarray(p["dimensions"], np.int64))
+        out(ctx.emit("Squeeze", [invals[0], axes]))
+    elif prim == "expand_dims":
+        axes = ctx.const(np.asarray(p["dimensions"], np.int64))
+        out(ctx.emit("Unsqueeze", [invals[0], axes]))
+    elif prim == "transpose":
+        out(ctx.emit("Transpose", invals, perm=list(p["permutation"])))
+    elif prim == "broadcast_in_dim":
+        out(_lower_broadcast(ctx, eqn, invals))
+    elif prim == "concatenate":
+        out(ctx.emit("Concat", invals, axis=int(p["dimension"])))
+    elif prim == "slice":
+        starts = ctx.const(np.asarray(p["start_indices"], np.int64))
+        ends = ctx.const(np.asarray(p["limit_indices"], np.int64))
+        axes = ctx.const(np.arange(len(p["start_indices"]), dtype=np.int64))
+        steps = ctx.const(np.asarray(p["strides"] or
+                                     [1] * len(p["start_indices"]), np.int64))
+        out(ctx.emit("Slice", [invals[0], starts, ends, axes, steps]))
+    elif prim == "pad":
+        lo = [c[0] for c in p["padding_config"]]
+        hi = [c[1] for c in p["padding_config"]]
+        if any(c[2] != 0 for c in p["padding_config"]):
+            raise NotImplementedError("onnx export: interior padding")
+        pads = ctx.const(np.asarray(lo + hi, np.int64))
+        out(ctx.emit("Pad", [invals[0], pads, invals[1]]))
+    elif prim == "select_n":
+        # jax select_n(pred, on_false, on_true) -> Where(pred, true, false)
+        out(ctx.emit("Where", [invals[0], invals[2], invals[1]]))
+    elif prim == "convert_element_type":
+        out(ctx.emit("Cast", invals,
+                     to=int(proto.NP2ONNX[np.dtype(p["new_dtype"])])))
+    elif prim == "stop_gradient":
+        env[id(eqn.outvars[0])] = invals[0]
+    elif prim == "custom_jvp_call" or prim == "custom_vjp_call":
+        _inline(ctx, p["call_jaxpr"].jaxpr
+                if hasattr(p["call_jaxpr"], "jaxpr") else p["call_jaxpr"],
+                eqn, env, invals)
+    elif prim in ("pjit", "jit", "closed_call"):
+        _inline(ctx, p["jaxpr"].jaxpr, eqn, env, invals,
+                consts=p["jaxpr"].consts)
+    else:
+        raise NotImplementedError(
+            f"onnx export: unsupported primitive '{prim}' — the portable "
+            "fallback is paddle.jit.save (StableHLO)")
+
+
+def _inline(ctx, jaxpr, eqn, env, invals, consts=()):
+    inner = {}
+    for cv, c in zip(jaxpr.constvars, consts):
+        inner[id(cv)] = ctx.const(np.asarray(c), "w")
+    for v, name in zip(jaxpr.invars, invals):
+        inner[id(v)] = name
+    _lower_jaxpr(ctx, jaxpr, inner)
+    for ov, iv in zip(eqn.outvars, jaxpr.outvars):
+        if isinstance(iv, jax.extend.core.Literal):
+            env[id(ov)] = ctx.const(np.asarray(iv.val), "lit")
+        else:
+            env[id(ov)] = inner[id(iv)]
+
+
+def _lower_dot(ctx, eqn, invals):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    ln, rn = lhs.ndim, rhs.ndim
+    nb = len(lb)
+    # standard matmul patterns ONLY: contract last of lhs with
+    # second-to-last (or only) dim of rhs, batch dims leading and aligned,
+    # and rhs has no extra non-batch dims that MatMul would broadcast into
+    # a transposed result
+    if (list(lb) == list(range(nb)) and list(rb) == list(range(nb))
+            and len(lc) == 1 and len(rc) == 1 and lc[0] == ln - 1
+            and ln - nb >= 1
+            and ((rn - nb == 2 and rc[0] == rn - 2)
+                 or (rn - nb == 1 and rc[0] == rn - 1))):
+        return ctx.emit("MatMul", invals)
+    if len(lc) == 1 and len(rc) == 1 and not lb and not rb and rn <= 2:
+        # contract arbitrary single dims: transpose into matmul form
+        a = invals[0]
+        if lc[0] != ln - 1:
+            perm = [d for d in range(ln) if d != lc[0]] + [lc[0]]
+            a = ctx.emit("Transpose", [a], perm=perm)
+        b = invals[1]
+        if rn == 2 and rc[0] != 0:
+            b = ctx.emit("Transpose", [b], perm=[1, 0])
+        return ctx.emit("MatMul", [a, b])
+    raise NotImplementedError(
+        f"onnx export: dot_general dims {eqn.params['dimension_numbers']}")
+
+
+def _lower_conv(ctx, eqn, invals):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    if dn.lhs_spec[:2] != (0, 1) or dn.out_spec[:2] != (0, 1) or \
+            dn.rhs_spec[:2] != (0, 1):
+        raise NotImplementedError("onnx export: conv layout != NCHW/OIHW")
+    if any(d != 1 for d in p.get("lhs_dilation", ())):
+        raise NotImplementedError(
+            "onnx export: transposed convolution (lhs_dilation) — map to "
+            "ConvTranspose is not implemented")
+    if p.get("batch_group_count", 1) != 1:
+        raise NotImplementedError("onnx export: batch_group_count != 1")
+    pads_lo = [lo for lo, _ in p["padding"]]
+    pads_hi = [hi for _, hi in p["padding"]]
+    attrs = dict(strides=list(p["window_strides"]),
+                 pads=pads_lo + pads_hi,
+                 dilations=list(p["rhs_dilation"]),
+                 group=int(p["feature_group_count"]))
+    return ctx.emit("Conv", invals, **attrs)
+
+
+def _lower_pool(ctx, eqn, invals, kind):
+    p = eqn.params
+    dims = p["window_dimensions"]
+    if dims[0] != 1 or dims[1] != 1:
+        raise NotImplementedError("onnx export: pooling over batch/channel")
+    strides = list(p["window_strides"])[2:]
+    pads = p["padding"]
+    attrs = dict(kernel_shape=list(dims)[2:], strides=strides,
+                 pads=[lo for lo, _ in pads[2:]] + [hi for _, hi in pads[2:]])
+    if kind == "MaxPool":
+        return ctx.emit("MaxPool", invals, **attrs)
+    # SumPool = AveragePool * window size
+    ap = ctx.emit("AveragePool", invals, count_include_pad=1, **attrs)
+    n = int(np.prod(list(dims)[2:]))
+    scale = ctx.const(np.asarray(n, eqn.outvars[0].aval.dtype))
+    return ctx.emit("Mul", [ap, scale])
+
+
+def _lower_broadcast(ctx, eqn, invals):
+    p = eqn.params
+    in_aval = eqn.invars[0].aval
+    out_shape = p["shape"]
+    bdims = p["broadcast_dimensions"]
+    # reshape to out rank with 1s, then Expand
+    interm = [1] * len(out_shape)
+    for i, d in enumerate(bdims):
+        interm[d] = in_aval.shape[i]
+    name = invals[0]
+    if tuple(interm) != tuple(in_aval.shape):
+        shape = ctx.const(np.asarray(interm, np.int64))
+        name = ctx.emit("Reshape", [name, shape])
+    if tuple(interm) != tuple(out_shape):
+        shape = ctx.const(np.asarray(out_shape, np.int64))
+        name = ctx.emit("Expand", [name, shape])
+    return name
+
+
+def _lower_jaxpr(ctx, jaxpr, env):
+    for eqn in jaxpr.eqns:
+        _lower_eqn(ctx, eqn, env)
+
+
+def export_traced(fn, example_args, graph_name="paddle_tpu_model",
+                  opset=13):
+    """Trace ``fn(*example_args)`` (pure, arrays in/out) and return ONNX
+    model bytes."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    ctx = _Ctx()
+    env = {}
+    inputs = []
+    for v, a in zip(jaxpr.invars, example_args):
+        name = ctx.fresh("input")
+        env[id(v)] = name
+        inputs.append(proto.value_info(name, np.asarray(a).dtype,
+                                       np.asarray(a).shape))
+    for cv, c in zip(jaxpr.constvars, closed.consts):
+        env[id(cv)] = ctx.const(np.asarray(c), "w")
+    _lower_jaxpr(ctx, jaxpr, env)
+    outputs = []
+    out_names = []
+    for v in jaxpr.outvars:
+        if isinstance(v, jax.extend.core.Literal):
+            out_names.append(ctx.const(np.asarray(v.val), "lit"))
+            aval_dtype, aval_shape = np.asarray(v.val).dtype, np.asarray(v.val).shape
+        else:
+            out_names.append(env[id(v)])
+            aval_dtype, aval_shape = v.aval.dtype, v.aval.shape
+        outputs.append(proto.value_info(out_names[-1], aval_dtype,
+                                        aval_shape))
+    # ONNX graph outputs must be produced by a node, once: wrap outputs
+    # that alias an input/initializer (or repeat a name) in Identity
+    produced = set()
+    for i, name in enumerate(out_names):
+        node_outs = {f for n in ctx.nodes for f in proto.parse_node(n)["output"]}
+        if name not in node_outs or name in produced:
+            alias = ctx.fresh("out")
+            ctx.nodes.append(proto.node("Identity", [name], [alias]))
+            out_names[i] = alias
+            v = jaxpr.outvars[i]
+            dt = (np.asarray(v.val).dtype
+                  if isinstance(v, jax.extend.core.Literal) else v.aval.dtype)
+            sh = (np.asarray(v.val).shape
+                  if isinstance(v, jax.extend.core.Literal) else v.aval.shape)
+            outputs[i] = proto.value_info(alias, dt, sh)
+        produced.add(out_names[i])
+    g = proto.graph(ctx.nodes, graph_name, ctx.inits, inputs, outputs)
+    return proto.model(g, opset=opset)

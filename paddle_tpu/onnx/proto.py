@@ -1,0 +1,285 @@
+"""Minimal ONNX protobuf wire-format writer/reader (no onnx package in the
+image — reference: ``paddle2onnx``'s dependency on the onnx protobufs; the
+field numbers below are the stable public ``onnx.proto3`` schema, IR v3+).
+
+Only the subset the exporter emits is modeled: ModelProto / GraphProto /
+NodeProto / AttributeProto / TensorProto / ValueInfoProto. The encoder
+produces bytes any ONNX runtime parses; the decoder exists for round-trip
+tests and the in-repo reference evaluator."""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16, DOUBLE = 1, 2, 3, 6, 7, 9, 10, 11
+
+NP2ONNX = {np.dtype(np.float32): FLOAT, np.dtype(np.int64): INT64,
+           np.dtype(np.int32): INT32, np.dtype(np.bool_): BOOL,
+           np.dtype(np.float16): FLOAT16, np.dtype(np.float64): DOUBLE,
+           np.dtype(np.uint8): UINT8, np.dtype(np.int8): INT8}
+ONNX2NP = {v: k for k, v in NP2ONNX.items()}
+
+
+# ---------------------------------------------------------------------------
+# wire-format primitives
+# ---------------------------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value)
+
+
+def _str_field(field: int, value: str) -> bytes:
+    return _len_field(field, value.encode())
+
+
+# ---------------------------------------------------------------------------
+# message builders
+# ---------------------------------------------------------------------------
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    out = b""
+    for d in arr.shape:
+        out += _int_field(1, int(d))
+    out += _int_field(2, NP2ONNX[arr.dtype])
+    out += _str_field(8, name)
+    out += _len_field(9, arr.tobytes())          # raw_data (little-endian)
+    return out
+
+
+def attr(name: str, value) -> bytes:
+    out = _str_field(1, name)
+    if isinstance(value, float):
+        out += _tag(2, 5) + struct.pack("<f", value) + _int_field(20, 1)
+    elif isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        out += _int_field(3, int(value)) + _int_field(20, 2)
+    elif isinstance(value, str):
+        out += _len_field(4, value.encode()) + _int_field(20, 3)
+    elif isinstance(value, np.ndarray):
+        out += _len_field(5, tensor_proto("", value)) + _int_field(20, 4)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, (int, np.integer)) for v in value):
+        for v in value:
+            out += _int_field(8, int(v))
+        out += _int_field(20, 7)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            out += _tag(7, 5) + struct.pack("<f", float(v))
+        out += _int_field(20, 6)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return out
+
+
+def node(op_type: str, inputs, outputs, name="", **attrs) -> bytes:
+    out = b""
+    for i in inputs:
+        out += _str_field(1, i)
+    for o in outputs:
+        out += _str_field(2, o)
+    if name:
+        out += _str_field(3, name)
+    out += _str_field(4, op_type)
+    for k, v in attrs.items():
+        out += _len_field(5, attr(k, v))
+    return out
+
+
+def value_info(name: str, dtype: np.dtype, shape) -> bytes:
+    dims = b""
+    for d in shape:
+        dims += _len_field(1, _int_field(1, int(d)))    # Dimension.dim_value
+    tensor_type = _int_field(1, NP2ONNX[np.dtype(dtype)]) + _len_field(2, dims)
+    return _str_field(1, name) + _len_field(2, _len_field(1, tensor_type))
+
+
+def graph(nodes, name, initializers, inputs, outputs) -> bytes:
+    out = b""
+    for n in nodes:
+        out += _len_field(1, n)
+    out += _str_field(2, name)
+    for t in initializers:
+        out += _len_field(5, t)
+    for vi in inputs:
+        out += _len_field(11, vi)
+    for vi in outputs:
+        out += _len_field(12, vi)
+    return out
+
+
+def model(graph_bytes: bytes, opset: int = 13, ir_version: int = 8) -> bytes:
+    out = _int_field(1, ir_version)
+    out += _str_field(2, "paddle_tpu")
+    out += _str_field(3, "0.1")
+    out += _len_field(7, graph_bytes)
+    out += _len_field(8, _int_field(2, opset))   # OperatorSetId{version}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# decoder (round-trip tests + in-repo evaluator)
+# ---------------------------------------------------------------------------
+
+def _iter_fields(buf: bytes):
+    i = 0
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            val = buf[i:i + ln]
+            i += ln
+        elif wire == 5:
+            val = struct.unpack("<f", buf[i:i + 4])[0]
+            i += 4
+        elif wire == 1:
+            val = struct.unpack("<d", buf[i:i + 8])[0]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wire}")
+        yield field, wire, val
+
+
+def _read_varint(buf: bytes, i: int):
+    shift = n = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def parse_tensor(buf: bytes):
+    dims, dt, name, raw = [], FLOAT, "", b""
+    for f, w, v in _iter_fields(buf):
+        if f == 1:
+            dims.append(v)
+        elif f == 2:
+            dt = v
+        elif f == 8:
+            name = v.decode()
+        elif f == 9:
+            raw = v
+    arr = np.frombuffer(raw, ONNX2NP[dt]).reshape(dims)
+    return name, arr
+
+
+def parse_node(buf: bytes):
+    n = {"input": [], "output": [], "op_type": "", "name": "", "attrs": {}}
+    for f, w, v in _iter_fields(buf):
+        if f == 1:
+            n["input"].append(v.decode())
+        elif f == 2:
+            n["output"].append(v.decode())
+        elif f == 3:
+            n["name"] = v.decode()
+        elif f == 4:
+            n["op_type"] = v.decode()
+        elif f == 5:
+            name, val = _parse_attr(v)
+            n["attrs"][name] = val
+    return n
+
+
+def _parse_attr(buf: bytes):
+    name, atype = "", None
+    sval = fval = ival = tval = None
+    ints, floats = [], []
+    for f, w, v in _iter_fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            fval = v
+        elif f == 3:
+            ival = v
+        elif f == 4:
+            sval = v.decode()
+        elif f == 5:
+            tval = parse_tensor(v)[1]
+        elif f == 7:
+            floats.append(v)
+        elif f == 8:
+            ints.append(v)
+        elif f == 20:
+            atype = v
+    val = {1: fval, 2: ival, 3: sval, 4: tval, 6: floats, 7: ints}.get(atype)
+    return name, val
+
+
+def parse_value_info(buf: bytes):
+    name, dtype, shape = "", None, []
+    for f, w, v in _iter_fields(buf):
+        if f == 1:
+            name = v.decode()
+        elif f == 2:
+            for f2, _, v2 in _iter_fields(v):           # TypeProto
+                if f2 == 1:                             # tensor_type
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            dtype = ONNX2NP[v3]
+                        elif f3 == 2:                   # shape
+                            for f4, _, v4 in _iter_fields(v3):
+                                if f4 == 1:             # dim
+                                    for f5, _, v5 in _iter_fields(v4):
+                                        if f5 == 1:
+                                            shape.append(v5)
+    return name, dtype, shape
+
+
+def parse_model(buf: bytes):
+    out = {"ir_version": None, "opset": None, "graph": None}
+    for f, w, v in _iter_fields(buf):
+        if f == 1:
+            out["ir_version"] = v
+        elif f == 7:
+            out["graph"] = parse_graph(v)
+        elif f == 8:
+            for f2, _, v2 in _iter_fields(v):
+                if f2 == 2:
+                    out["opset"] = v2
+    return out
+
+
+def parse_graph(buf: bytes):
+    g = {"nodes": [], "name": "", "initializers": {}, "inputs": [],
+         "outputs": []}
+    for f, w, v in _iter_fields(buf):
+        if f == 1:
+            g["nodes"].append(parse_node(v))
+        elif f == 2:
+            g["name"] = v.decode()
+        elif f == 5:
+            name, arr = parse_tensor(v)
+            g["initializers"][name] = arr
+        elif f == 11:
+            g["inputs"].append(parse_value_info(v))
+        elif f == 12:
+            g["outputs"].append(parse_value_info(v))
+    return g

@@ -1,0 +1,148 @@
+"""Independent numpy evaluator for the exported ONNX op subset — the test
+oracle standing in for onnxruntime (not in the image). Implements ONNX
+operator SEMANTICS (opset 13) from the public spec, deliberately NOT by
+calling back into the exporter's jax ops, so export bugs can't self-verify."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto
+
+
+def _pool2d(x, kernel, strides, pads, mode):
+    n, c, h, w = x.shape
+    kh, kw = kernel
+    ph0, pw0, ph1, pw1 = pads
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.full((n, c, h + ph0 + ph1, w + pw0 + pw1), fill, x.dtype)
+    xp[:, :, ph0:ph0 + h, pw0:pw0 + w] = x
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    out = np.empty((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * strides[0]:i * strides[0] + kh,
+                     j * strides[1]:j * strides[1] + kw]
+            out[:, :, i, j] = win.max((2, 3)) if mode == "max" \
+                else win.mean((2, 3))
+    return out
+
+
+def _conv2d(x, w, b, strides, pads, dilations, group):
+    n, cin, h, wd = x.shape
+    cout, cing, kh, kw = w.shape
+    ph0, pw0, ph1, pw1 = pads
+    xp = np.zeros((n, cin, h + ph0 + ph1, wd + pw0 + pw1), x.dtype)
+    xp[:, :, ph0:ph0 + h, pw0:pw0 + wd] = x
+    dkh, dkw = (kh - 1) * dilations[0] + 1, (kw - 1) * dilations[1] + 1
+    oh = (xp.shape[2] - dkh) // strides[0] + 1
+    ow = (xp.shape[3] - dkw) // strides[1] + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    cpg = cout // group
+    for g in range(group):
+        xs = xp[:, g * cing:(g + 1) * cing]
+        ws = w[g * cpg:(g + 1) * cpg]
+        for i in range(oh):
+            for j in range(ow):
+                win = xs[:, :, i * strides[0]:i * strides[0] + dkh:dilations[0],
+                         j * strides[1]:j * strides[1] + dkw:dilations[1]]
+                out[:, g * cpg:(g + 1) * cpg, i, j] = np.einsum(
+                    "nchw,ochw->no", win, ws)
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out.astype(x.dtype)
+
+
+def run(model_bytes: bytes, inputs: dict):
+    m = proto.parse_model(model_bytes)
+    g = m["graph"]
+    env = dict(g["initializers"])
+    for name, dtype, shape in g["inputs"]:
+        env[name] = np.asarray(inputs[name], dtype)
+    for nd in g["nodes"]:
+        op, a = nd["op_type"], nd["attrs"]
+        iv = [env[i] for i in nd["input"] if i]
+        o = nd["output"][0]
+        if op in ("Add", "Sub", "Mul", "Div", "Pow", "Max", "Min", "Mod",
+                  "And", "Or", "Xor", "Equal", "Less", "LessOrEqual",
+                  "Greater", "GreaterOrEqual"):
+            f = {"Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+                 "Div": np.divide, "Pow": np.power, "Max": np.maximum,
+                 "Min": np.minimum,
+                 "Mod": (np.fmod if a.get("fmod") else np.mod),
+                 "And": np.logical_and,
+                 "Or": np.logical_or, "Xor": np.logical_xor,
+                 "Equal": np.equal, "Less": np.less,
+                 "LessOrEqual": np.less_equal, "Greater": np.greater,
+                 "GreaterOrEqual": np.greater_equal}[op]
+            env[o] = f(iv[0], iv[1])
+        elif op in ("Tanh", "Exp", "Log", "Neg", "Abs", "Sqrt", "Sigmoid",
+                    "Floor", "Ceil", "Round", "Sign", "Sin", "Cos", "Erf",
+                    "Not", "Sinh", "Cosh", "Atan", "Asin", "Acos"):
+            import math
+            f = {"Tanh": np.tanh, "Exp": np.exp, "Log": np.log,
+                 "Neg": np.negative, "Abs": np.abs, "Sqrt": np.sqrt,
+                 "Sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+                 "Floor": np.floor, "Ceil": np.ceil, "Round": np.round,
+                 "Sign": np.sign, "Sin": np.sin, "Cos": np.cos,
+                 "Erf": np.vectorize(math.erf), "Not": np.logical_not,
+                 "Sinh": np.sinh, "Cosh": np.cosh, "Atan": np.arctan,
+                 "Asin": np.arcsin, "Acos": np.arccos}[op]
+            env[o] = np.asarray(f(iv[0]), iv[0].dtype if op != "Erf"
+                                else np.float32)
+        elif op == "MatMul":
+            env[o] = np.matmul(iv[0], iv[1])
+        elif op == "Reshape":
+            env[o] = iv[0].reshape([int(d) for d in iv[1]])
+        elif op == "Transpose":
+            env[o] = np.transpose(iv[0], a["perm"])
+        elif op == "Expand":
+            env[o] = np.broadcast_to(iv[0], [int(d) for d in iv[1]]).copy()
+        elif op == "Squeeze":
+            env[o] = np.squeeze(iv[0], tuple(int(d) for d in iv[1]))
+        elif op == "Unsqueeze":
+            out = iv[0]
+            for d in sorted(int(x) for x in iv[1]):
+                out = np.expand_dims(out, d)
+            env[o] = out
+        elif op == "Concat":
+            env[o] = np.concatenate(iv, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (iv[1].astype(int), iv[2].astype(int),
+                                         iv[3].astype(int), iv[4].astype(int))
+            idx = [slice(None)] * iv[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                idx[ax] = slice(int(s), int(e), int(st))
+            env[o] = iv[0][tuple(idx)]
+        elif op == "Pad":
+            pads = iv[1].astype(int)
+            nd2 = iv[0].ndim
+            width = [(pads[i], pads[i + nd2]) for i in range(nd2)]
+            cval = float(iv[2]) if len(iv) > 2 else 0.0
+            env[o] = np.pad(iv[0], width, constant_values=cval)
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
+            f = {"ReduceSum": np.sum, "ReduceMax": np.max,
+                 "ReduceMin": np.min, "ReduceProd": np.prod}[op]
+            env[o] = f(iv[0], axis=tuple(int(d) for d in iv[1]),
+                       keepdims=bool(a.get("keepdims", 1)))
+        elif op == "ArgMax":
+            env[o] = np.argmax(iv[0], axis=a["axis"]).astype(np.int64)
+        elif op == "Where":
+            env[o] = np.where(iv[0], iv[1], iv[2])
+        elif op == "Cast":
+            env[o] = iv[0].astype(proto.ONNX2NP[a["to"]])
+        elif op == "Conv":
+            b = iv[2] if len(iv) > 2 else None
+            env[o] = _conv2d(iv[0], iv[1], b, a["strides"], a["pads"],
+                             a["dilations"], a.get("group", 1))
+        elif op == "MaxPool":
+            env[o] = _pool2d(iv[0], a["kernel_shape"], a["strides"],
+                             a["pads"], "max")
+        elif op == "AveragePool":
+            env[o] = _pool2d(iv[0], a["kernel_shape"], a["strides"],
+                             a["pads"], "avg")
+        elif op == "Identity":
+            env[o] = iv[0]
+        else:
+            raise NotImplementedError(f"ref_eval: op {op}")
+    return [env[name] for name, _, _ in g["outputs"]]

@@ -650,7 +650,10 @@ def bitwise_right_shift(x, y):
 
 @defop
 def cartesian_prod(x):
-    """Cartesian product of a list of 1-D tensors (paddle.cartesian_prod)."""
+    """Cartesian product of a list of 1-D tensors (paddle.cartesian_prod);
+    a single input returns it unchanged (reference shape semantics)."""
+    if len(x) == 1:
+        return jnp.asarray(x[0])
     grids = jnp.meshgrid(*x, indexing="ij")
     return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
 
