@@ -28,10 +28,11 @@ from .. import mesh as mesh_mod
 __all__ = [
     "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
     "dtensor_from_fn", "reshard", "shard_optimizer", "get_mesh", "set_mesh",
-    "Engine",
+    "Engine", "CostModel", "Tuner", "ModelSpec", "Plan",
 ]
 
 from .static_engine import Engine  # noqa: E402
+from .cost_model import CostModel, Tuner, ModelSpec, Plan  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

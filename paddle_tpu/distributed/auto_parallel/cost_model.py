@@ -1,0 +1,207 @@
+"""Auto-parallel cost model + mesh tuner (reference:
+``python/paddle/distributed/auto_parallel/static/cost/`` — per-op
+comp/comm cost classes rolled up by the rule-based ``tuner/``; SURVEY.md
+§2.3 "Auto-parallel ... cost model/tuner").
+
+TPU-native re-design: instead of per-op cost objects over a ProgramDesc,
+an ANALYTIC roofline for transformer train steps over the hybrid mesh
+``[dp, pp, sharding, sep, mp]`` (the scaling-book recipe):
+
+* compute   = train FLOPs / (chips · peak · efficiency)
+* TP comm   = 2 allreduces of [B·S/chips_b, H] per layer over the mp axis
+* DP/ZeRO   = grad reduce-scatter + param all-gather over dp·sharding
+* PP bubble = (pp-1)/(micro+pp-1) multiplier
+* memory/chip = params·(2+opt)/shard + activations — plans that do not
+  fit HBM are rejected before timing.
+
+``Tuner.tune`` enumerates degree factorizations of the chip count and
+returns ranked ``Plan``s. Estimates steer the search; measured profiles
+(profiler.mfu) refine them — same contract as the reference tuner."""
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+# per-chip hardware characteristics (bf16 peak FLOP/s, HBM bytes, ICI
+# GB/s per link); conservative public numbers
+CHIPS = {
+    "v4": dict(flops=275e12, hbm=32e9, ici=100e9),
+    "v5e": dict(flops=197e12, hbm=16e9, ici=50e9),
+    "v5p": dict(flops=459e12, hbm=95e9, ici=100e9),
+}
+
+
+@dataclass
+class ModelSpec:
+    """Transformer shape (derivable from LlamaConfig/GPTConfig)."""
+    num_layers: int
+    hidden: int
+    intermediate: int
+    vocab: int
+    seq_len: int
+    global_batch: int
+    num_heads: int = 0
+    bytes_per_param: int = 4          # fp32 master params
+    optimizer_states: int = 2         # adam m+v
+
+    @classmethod
+    def from_config(cls, cfg, seq_len=None, global_batch=1):
+        return cls(
+            num_layers=cfg.num_hidden_layers,
+            hidden=cfg.hidden_size,
+            intermediate=getattr(cfg, "intermediate_size",
+                                 4 * cfg.hidden_size),
+            vocab=cfg.vocab_size,
+            seq_len=seq_len or getattr(cfg, "max_position_embeddings", 2048),
+            global_batch=global_batch,
+            num_heads=getattr(cfg, "num_attention_heads", 0),
+        )
+
+    @property
+    def n_params(self):
+        per_layer = (4 * self.hidden * self.hidden            # qkv+o (MHA)
+                     + 3 * self.hidden * self.intermediate)   # swiglu mlp
+        return (self.num_layers * per_layer
+                + 2 * self.vocab * self.hidden)               # embed + head
+
+    def train_flops(self):
+        """6·params·tokens + attention quadratic term."""
+        tokens = self.global_batch * self.seq_len
+        attn = (12 * self.num_layers * self.hidden
+                * self.global_batch * self.seq_len ** 2)
+        return 6 * self.n_params * tokens + attn
+
+
+@dataclass
+class Plan:
+    degrees: dict
+    step_time_s: float
+    mem_per_chip: float
+    breakdown: dict = field(default_factory=dict)
+
+    def __repr__(self):
+        d = {k: v for k, v in self.degrees.items() if v > 1} or {"dp": 1}
+        return (f"Plan({d}, step={self.step_time_s * 1e3:.1f}ms, "
+                f"mem={self.mem_per_chip / 1e9:.1f}GB)")
+
+
+class CostModel:
+    def __init__(self, chip="v5p", mfu_target=0.45, micro_batches=8,
+                 recompute=True):
+        self.hw = CHIPS[chip] if isinstance(chip, str) else chip
+        self.eff = mfu_target
+        self.micro = micro_batches
+        self.recompute = recompute
+
+    # -- memory ---------------------------------------------------------------
+    def memory_per_chip(self, m: ModelSpec, d: dict):
+        shard = d["sharding"] * d["dp"]        # ZeRO shards over data axes
+        model_parallel = d["mp"] * d["pp"]
+        params = m.n_params * m.bytes_per_param / model_parallel
+        # params + grads + opt states sharded by ZeRO (stage-3 semantics)
+        state = params * (2 + m.optimizer_states) / shard + params / shard
+        per_chip_tokens = (m.global_batch * m.seq_len
+                           / (d["dp"] * d["sharding"] * d["sep"]))
+        act_factor = 4 if self.recompute else 12
+        acts = act_factor * per_chip_tokens * m.hidden \
+            * (m.num_layers / d["pp"]) * 2 / max(self.micro, 1)
+        return state + acts
+
+    # -- time -----------------------------------------------------------------
+    def step_time(self, m: ModelSpec, d: dict):
+        chips = 1
+        for v in d.values():
+            chips *= v
+        compute = m.train_flops() / (chips * self.hw["flops"] * self.eff)
+        # PP bubble stretches compute
+        bubble = (d["pp"] - 1) / (self.micro + d["pp"] - 1) if d["pp"] > 1 else 0.0
+        compute *= 1.0 / (1.0 - bubble) if bubble < 1 else float("inf")
+
+        ici = self.hw["ici"]
+        toks_per_chip = (m.global_batch * m.seq_len
+                         / (d["dp"] * d["sharding"] * d["sep"]))
+        # TP: 2 allreduces of the activation per layer over mp
+        tp = 0.0
+        if d["mp"] > 1:
+            vol = 2 * m.num_layers * toks_per_chip * m.hidden * 2  # bf16
+            tp = 2 * vol * (d["mp"] - 1) / d["mp"] / ici
+        # grads: reduce-scatter + all-gather over the dp·sharding group
+        data = d["dp"] * d["sharding"]
+        dpc = 0.0
+        if data > 1:
+            gbytes = m.n_params * 2 / (d["mp"] * d["pp"])
+            dpc = 2 * gbytes * (data - 1) / data / ici
+        # sep (context parallel): ring K/V exchange per layer
+        sp = 0.0
+        if d["sep"] > 1:
+            kv = m.num_layers * toks_per_chip * m.hidden * 2 * 2
+            sp = kv * (d["sep"] - 1) / d["sep"] / ici
+        # per-collective launch latency: small, but it is what makes a
+        # plain-DP plan win for models where every plan's bandwidth
+        # terms round to zero
+        lat = 5e-6
+        launches = (2 * m.num_layers * (d["mp"] > 1)
+                    + 2 * m.num_layers * (d["sep"] > 1)
+                    + 2 * (data > 1)
+                    + self.micro * 2 * (d["pp"] > 1))
+        overhead = lat * launches
+        return (compute + tp + sp + max(dpc, 0.0) * 0.5 + overhead,
+                {"compute_s": compute, "tp_s": tp, "dp_s": dpc, "sp_s": sp,
+                 "bubble": bubble, "latency_s": overhead})
+
+
+class Tuner:
+    """Enumerate mesh-degree factorizations; reject plans that overflow
+    HBM or violate divisibility; rank by estimated step time (reference:
+    the rule-based + cost-model tuner)."""
+
+    AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+    def __init__(self, cost_model: CostModel | None = None, chip="v5p",
+                 max_mp=8, max_pp=16):
+        self.cm = cost_model or CostModel(chip=chip)
+        self.max_mp = max_mp
+        self.max_pp = max_pp
+
+    def _factorizations(self, n):
+        divs = [d for d in range(1, n + 1) if n % d == 0]
+        for dp, pp, shd, sep, mp in itertools.product(divs, repeat=5):
+            if dp * pp * shd * sep * mp == n:
+                yield {"dp": dp, "pp": pp, "sharding": shd, "sep": sep,
+                       "mp": mp}
+
+    def _valid(self, m: ModelSpec, d: dict):
+        if d["mp"] > self.max_mp or d["pp"] > self.max_pp:
+            return False
+        if d["mp"] > 1 and (m.hidden % d["mp"] or
+                            (m.num_heads and m.num_heads % d["mp"])):
+            return False
+        if d["pp"] > 1 and m.num_layers % d["pp"]:
+            return False
+        if d["sep"] > 1 and m.seq_len % d["sep"]:
+            return False
+        if m.global_batch % (d["dp"] * d["sharding"]):
+            return False
+        return True
+
+    def tune(self, model, n_devices, seq_len=None, global_batch=None,
+             top_k=3):
+        m = model if isinstance(model, ModelSpec) else ModelSpec.from_config(
+            model, seq_len=seq_len, global_batch=global_batch or 8)
+        plans = []
+        hbm = self.cm.hw["hbm"]
+        for d in self._factorizations(n_devices):
+            if not self._valid(m, d):
+                continue
+            mem = self.cm.memory_per_chip(m, d)
+            if mem > 0.9 * hbm:
+                continue
+            t, br = self.cm.step_time(m, d)
+            plans.append(Plan(d, t, mem, br))
+        plans.sort(key=lambda p: p.step_time_s)
+        if not plans:
+            raise ValueError(
+                f"no valid plan for {n_devices} chips: the model does not "
+                f"fit 90% of HBM under any degree assignment (try more "
+                "chips, recompute, or a smaller micro-batch)")
+        return plans[:top_k]

@@ -35,9 +35,13 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
         if isinstance(spec, Tensor):
             examples.append(spec._data)
         elif isinstance(spec, InputSpec):
-            shape = [1 if (d is None or d == -1) else int(d)
-                     for d in spec.shape]
-            examples.append(jnp.zeros(shape, spec.dtype))
+            if any(d is None or d == -1 for d in spec.shape):
+                raise ValueError(
+                    "paddle.onnx.export needs STATIC shapes; dynamic dims "
+                    f"in {spec} — export one model per bucket, or use "
+                    "paddle.jit.save (StableHLO) for shape polymorphism")
+            examples.append(jnp.zeros([int(d) for d in spec.shape],
+                                      spec.dtype))
         else:
             examples.append(jnp.asarray(np.asarray(spec)))
 

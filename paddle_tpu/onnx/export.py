@@ -104,7 +104,11 @@ def _lower_eqn(ctx, eqn, env):
         axes = ctx.const(np.asarray(p["axes"], np.int64))
         out(ctx.emit(op, [invals[0], axes], keepdims=0))
     elif prim == "argmax":
-        out(ctx.emit("ArgMax", invals, axis=int(p["axes"][0]), keepdims=0))
+        am = ctx.emit("ArgMax", invals, axis=int(p["axes"][0]), keepdims=0)
+        want = np.dtype(p["index_dtype"])
+        if want != np.int64:     # ONNX ArgMax always emits int64
+            am = ctx.emit("Cast", [am], to=int(proto.NP2ONNX[want]))
+        out(am)
     elif prim == "reshape":
         shape = ctx.const(np.asarray(eqn.outvars[0].aval.shape, np.int64))
         out(ctx.emit("Reshape", [invals[0], shape]))
@@ -135,6 +139,10 @@ def _lower_eqn(ctx, eqn, env):
         pads = ctx.const(np.asarray(lo + hi, np.int64))
         out(ctx.emit("Pad", [invals[0], pads, invals[1]]))
     elif prim == "select_n":
+        if len(eqn.invars) != 3 or \
+                eqn.invars[0].aval.dtype != np.dtype(np.bool_):
+            raise NotImplementedError(
+                "onnx export: select_n with >2 cases / integer predicate")
         # jax select_n(pred, on_false, on_true) -> Where(pred, true, false)
         out(ctx.emit("Where", [invals[0], invals[2], invals[1]]))
     elif prim == "convert_element_type":
@@ -143,9 +151,9 @@ def _lower_eqn(ctx, eqn, env):
     elif prim == "stop_gradient":
         env[id(eqn.outvars[0])] = invals[0]
     elif prim == "custom_jvp_call" or prim == "custom_vjp_call":
-        _inline(ctx, p["call_jaxpr"].jaxpr
-                if hasattr(p["call_jaxpr"], "jaxpr") else p["call_jaxpr"],
-                eqn, env, invals)
+        cj = p["call_jaxpr"]
+        _inline(ctx, cj.jaxpr if hasattr(cj, "jaxpr") else cj,
+                eqn, env, invals, consts=getattr(cj, "consts", ()))
     elif prim in ("pjit", "jit", "closed_call"):
         _inline(ctx, p["jaxpr"].jaxpr, eqn, env, invals,
                 consts=p["jaxpr"].consts)
@@ -180,9 +188,12 @@ def _lower_dot(ctx, eqn, invals):
     # a transposed result
     if (list(lb) == list(range(nb)) and list(rb) == list(range(nb))
             and len(lc) == 1 and len(rc) == 1 and lc[0] == ln - 1
-            and ln - nb >= 1
-            and ((rn - nb == 2 and rc[0] == rn - 2)
-                 or (rn - nb == 1 and rc[0] == rn - 1))):
+            and ((nb == 0 and ln >= 1
+                  and ((rn == 2 and rc[0] == 0) or (rn == 1 and rc[0] == 0)))
+                 or (nb > 0 and ln - nb >= 2 and rn - nb == 2
+                     and rc[0] == rn - 2))):
+        # MatMul broadcast matches dot_general ONLY for these shapes: a
+        # batched vector operand would broadcast into a transposed result
         return ctx.emit("MatMul", invals)
     if len(lc) == 1 and len(rc) == 1 and not lb and not rb and rn <= 2:
         # contract arbitrary single dims: transpose into matmul form
@@ -224,6 +235,13 @@ def _lower_pool(ctx, eqn, invals, kind):
     dims = p["window_dimensions"]
     if dims[0] != 1 or dims[1] != 1:
         raise NotImplementedError("onnx export: pooling over batch/channel")
+    if any(d != 1 for d in p.get("window_dilation", ())) or \
+            any(d != 1 for d in p.get("base_dilation", ())):
+        raise NotImplementedError("onnx export: dilated pooling")
+    if p["window_strides"][0] != 1 or p["window_strides"][1] != 1 or \
+            p["padding"][0] != (0, 0) or p["padding"][1] != (0, 0):
+        raise NotImplementedError(
+            "onnx export: stride/padding on batch/channel dims")
     strides = list(p["window_strides"])[2:]
     pads = p["padding"]
     attrs = dict(kernel_shape=list(dims)[2:], strides=strides,
@@ -292,8 +310,8 @@ def export_traced(fn, example_args, graph_name="paddle_tpu_model",
     # ONNX graph outputs must be produced by a node, once: wrap outputs
     # that alias an input/initializer (or repeat a name) in Identity
     produced = set()
+    node_outs = {f for n in ctx.nodes for f in proto.parse_node(n)["output"]}
     for i, name in enumerate(out_names):
-        node_outs = {f for n in ctx.nodes for f in proto.parse_node(n)["output"]}
         if name not in node_outs or name in produced:
             alias = ctx.fresh("out")
             ctx.nodes.append(proto.node("Identity", [name], [alias]))

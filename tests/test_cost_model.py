@@ -1,0 +1,66 @@
+"""Auto-parallel cost model + tuner (reference:
+``auto_parallel/static/cost/`` + rule-based tuner — the analytic roofline
+re-design; SURVEY.md §2.3 auto-parallel row)."""
+import pytest
+
+from paddle_tpu.distributed.auto_parallel import CostModel, Tuner, ModelSpec
+from paddle_tpu.models import llama3_8b, llama_tiny
+
+
+def _8b(batch=64, seq=4096):
+    return ModelSpec.from_config(llama3_8b(), seq_len=seq, global_batch=batch)
+
+
+def test_param_count_sane():
+    m = _8b()
+    # Llama-3-8B ~8e9 params (MHA approximation inflates q/k/v a little)
+    assert 6e9 < m.n_params < 11e9
+
+
+def test_small_model_prefers_data_parallel():
+    m = ModelSpec.from_config(llama_tiny(), seq_len=128, global_batch=32)
+    plans = Tuner(chip="v5p").tune(m, 8)
+    best = plans[0].degrees
+    assert best["mp"] == 1 and best["pp"] == 1, plans[0]
+    assert best["dp"] * best["sharding"] == 8
+
+
+def test_big_model_small_chip_needs_model_sharding():
+    """8B training state (fp32 master + adam ≈ 128GB) on v5e (16GB):
+    every valid plan must shard the model state, and 8 chips genuinely
+    cannot hold it at all."""
+    with pytest.raises(ValueError, match="no valid plan"):
+        Tuner(chip="v5e").tune(_8b(batch=64, seq=2048), 8)
+    plans = Tuner(chip="v5e").tune(_8b(batch=64, seq=2048), 16)
+    best = plans[0].degrees
+    assert best["sharding"] * best["mp"] * best["pp"] > 1, plans[0]
+    hbm = CostModel(chip="v5e").hw["hbm"]
+    assert plans[0].mem_per_chip < 0.9 * hbm
+
+
+def test_memory_rejects_impossible():
+    with pytest.raises(ValueError, match="no valid plan"):
+        Tuner(chip="v5e").tune(_8b(batch=512, seq=8192), 1)
+
+
+def test_more_chips_faster():
+    t = Tuner(chip="v5p")
+    t8 = t.tune(_8b(), 8)[0].step_time_s
+    t32 = t.tune(_8b(), 32)[0].step_time_s
+    assert t32 < t8
+
+
+def test_divisibility_respected():
+    m = ModelSpec(num_layers=6, hidden=512, intermediate=1408, vocab=1000,
+                  seq_len=128, global_batch=16, num_heads=8)
+    for p in Tuner(chip="v5p").tune(m, 16, top_k=10):
+        d = p.degrees
+        assert m.num_layers % d["pp"] == 0
+        assert d["mp"] == 1 or m.hidden % d["mp"] == 0
+        assert m.global_batch % (d["dp"] * d["sharding"]) == 0
+
+
+def test_breakdown_fields():
+    p = Tuner(chip="v5p").tune(_8b(), 16)[0]
+    assert {"compute_s", "tp_s", "dp_s", "bubble"} <= set(p.breakdown)
+    assert p.step_time_s >= p.breakdown["compute_s"] > 0
