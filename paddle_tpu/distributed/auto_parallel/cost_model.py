@@ -22,12 +22,16 @@ from __future__ import annotations
 import itertools
 from dataclasses import dataclass, field
 
-# per-chip hardware characteristics (bf16 peak FLOP/s, HBM bytes, ICI
-# GB/s per link); conservative public numbers
+from ...profiler.mfu import PEAK_FLOPS, transformer_train_flops
+
+# per-chip hardware characteristics (peak bf16 FLOP/s shared with
+# profiler.mfu so tuner estimates and measured MFU agree; HBM bytes,
+# ICI GB/s per link — conservative public numbers)
 CHIPS = {
-    "v4": dict(flops=275e12, hbm=32e9, ici=100e9),
-    "v5e": dict(flops=197e12, hbm=16e9, ici=50e9),
-    "v5p": dict(flops=459e12, hbm=95e9, ici=100e9),
+    "v4": dict(flops=PEAK_FLOPS["v4"], hbm=32e9, ici=100e9),
+    "v5e": dict(flops=PEAK_FLOPS["v5e"], hbm=16e9, ici=50e9),
+    "v5p": dict(flops=PEAK_FLOPS["v5p"], hbm=95e9, ici=100e9),
+    "v6e": dict(flops=PEAK_FLOPS["v6e"], hbm=32e9, ici=100e9),
 }
 
 
@@ -44,6 +48,8 @@ class ModelSpec:
     bytes_per_param: int = 4          # fp32 master params
     optimizer_states: int = 2         # adam m+v
 
+    kv_heads: int = 0                 # GQA; 0 = MHA
+
     @classmethod
     def from_config(cls, cfg, seq_len=None, global_batch=1):
         return cls(
@@ -55,21 +61,27 @@ class ModelSpec:
             seq_len=seq_len or getattr(cfg, "max_position_embeddings", 2048),
             global_batch=global_batch,
             num_heads=getattr(cfg, "num_attention_heads", 0),
+            kv_heads=getattr(cfg, "num_key_value_heads", 0),
         )
 
     @property
     def n_params(self):
-        per_layer = (4 * self.hidden * self.hidden            # qkv+o (MHA)
-                     + 3 * self.hidden * self.intermediate)   # swiglu mlp
+        """GQA-accurate count (mirrors profiler.mfu.llama_param_count)."""
+        head_dim = self.hidden // self.num_heads if self.num_heads else 0
+        kv = (self.kv_heads or self.num_heads) * head_dim if head_dim \
+            else self.hidden
+        per_layer = (2 * self.hidden * self.hidden          # q, o
+                     + 2 * self.hidden * kv                 # k, v
+                     + 3 * self.hidden * self.intermediate)
         return (self.num_layers * per_layer
-                + 2 * self.vocab * self.hidden)               # embed + head
+                + 2 * self.vocab * self.hidden)             # embed + head
 
     def train_flops(self):
-        """6·params·tokens + attention quadratic term."""
-        tokens = self.global_batch * self.seq_len
-        attn = (12 * self.num_layers * self.hidden
-                * self.global_batch * self.seq_len ** 2)
-        return 6 * self.n_params * tokens + attn
+        """Shared formula with profiler.mfu (causal attention term)."""
+        return transformer_train_flops(
+            self.n_params, self.global_batch * self.seq_len,
+            num_layers=self.num_layers, hidden_size=self.hidden,
+            seq_len=self.seq_len, causal=True)
 
 
 @dataclass
@@ -95,7 +107,9 @@ class CostModel:
 
     # -- memory ---------------------------------------------------------------
     def memory_per_chip(self, m: ModelSpec, d: dict):
-        shard = d["sharding"] * d["dp"]        # ZeRO shards over data axes
+        # ZeRO state shards over the 'sharding' axis ONLY (what the
+        # runtime's shard_spec_for actually does); plain dp replicates it
+        shard = d["sharding"]
         model_parallel = d["mp"] * d["pp"]
         params = m.n_params * m.bytes_per_param / model_parallel
         # params + grads + opt states sharded by ZeRO (stage-3 semantics)
@@ -145,9 +159,11 @@ class CostModel:
                     + 2 * (data > 1)
                     + self.micro * 2 * (d["pp"] > 1))
         overhead = lat * launches
-        return (compute + tp + sp + max(dpc, 0.0) * 0.5 + overhead,
-                {"compute_s": compute, "tp_s": tp, "dp_s": dpc, "sp_s": sp,
-                 "bubble": bubble, "latency_s": overhead})
+        dpc_eff = dpc * 0.5     # grad comm overlaps the backward pass
+        return (compute + tp + sp + dpc_eff + overhead,
+                {"compute_s": compute, "tp_s": tp, "dp_s": dpc_eff,
+                 "dp_raw_s": dpc, "sp_s": sp, "bubble": bubble,
+                 "latency_s": overhead})
 
 
 class Tuner:
@@ -190,9 +206,11 @@ class Tuner:
             model, seq_len=seq_len, global_batch=global_batch or 8)
         plans = []
         hbm = self.cm.hw["hbm"]
+        n_div_ok = 0
         for d in self._factorizations(n_devices):
             if not self._valid(m, d):
                 continue
+            n_div_ok += 1
             mem = self.cm.memory_per_chip(m, d)
             if mem > 0.9 * hbm:
                 continue
@@ -200,6 +218,12 @@ class Tuner:
             plans.append(Plan(d, t, mem, br))
         plans.sort(key=lambda p: p.step_time_s)
         if not plans:
+            if n_div_ok == 0:
+                raise ValueError(
+                    f"no valid plan for {n_devices} chips: every degree "
+                    "assignment violates divisibility (layers % pp, "
+                    "hidden/heads % mp, seq % sep, batch % dp*sharding) — "
+                    "adjust the shapes/batch, not the chip count")
             raise ValueError(
                 f"no valid plan for {n_devices} chips: the model does not "
                 f"fit 90% of HBM under any degree assignment (try more "

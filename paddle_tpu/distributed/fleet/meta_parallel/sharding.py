@@ -97,20 +97,42 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
 
 class GroupShardedStage2:
     """Model wrapper for stage 2 (API parity with ``GroupShardedStage2``).
-    Forward delegates; grads/opt-state sharding is the optimizer wrapper's
-    job."""
+
+    Forward delegates; opt-state sharding is the optimizer wrapper's job.
+    The ZeRO-2 memory contract — grads sharded AS they are produced, not
+    at step() — is enforced with per-parameter grad hooks: each cotangent
+    is placed on its 'sharding'-axis layout the moment the tape
+    accumulates it (the reference's backward reduce-scatter hook,
+    ``group_sharded_stage2.py``'s _grad_storage path)."""
 
     def __init__(self, layer, optimizer, group=None, sync_buffers=False,
                  buffer_max_size=2 ** 23, auto_refresh_trainable=True,
                  device="tpu", dp_group=None):
         self._layer = layer
         self._optimizer = optimizer
+        self._hooks = []
+        for p in layer.parameters():
+            if p is None:
+                continue
+            spec = shard_spec_for(p._data.shape)
+            if spec is not None:
+                self._hooks.append(p.register_hook(
+                    lambda g, _spec=spec: _place_tensor(g, _spec)))
 
     def __call__(self, *a, **k):
         return self._layer(*a, **k)
 
     def __getattr__(self, item):
         return getattr(self._layer, item)
+
+
+def _place_tensor(g, spec):
+    data = g._data if hasattr(g, "_data") else g
+    placed = _place(data, spec)
+    if hasattr(g, "_data"):
+        g._data = placed
+        return g
+    return placed
 
 
 class GroupShardedStage3:

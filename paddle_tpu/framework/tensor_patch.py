@@ -111,6 +111,12 @@ def monkey_patch_tensor():
         nan_to_num multiplex divide_no_nan tensordot
         all any take permute diff mv
         reshape_ squeeze_ unsqueeze_
+        ldexp frexp sinc signbit isneginf isposinf isreal i0 i0e i1 i1e
+        polygamma gammainc gammaincc multigammaln nanquantile renorm
+        bitwise_left_shift bitwise_right_shift combinations clip_by_norm
+        unflatten diagonal_scatter select_scatter slice_scatter index_fill
+        tensor_split hsplit vsplit dsplit vander atleast_1d atleast_2d
+        atleast_3d
     """.split()
     for name in methods:
         fn = getattr(ops, name, None) or getattr(ops.linalg, name, None)

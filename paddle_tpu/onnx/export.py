@@ -190,7 +190,7 @@ def _lower_dot(ctx, eqn, invals):
             and len(lc) == 1 and len(rc) == 1 and lc[0] == ln - 1
             and ((nb == 0 and ln >= 1
                   and ((rn == 2 and rc[0] == 0) or (rn == 1 and rc[0] == 0)))
-                 or (nb > 0 and ln - nb >= 2 and rn - nb == 2
+                 or (nb > 0 and ln - nb == 2 and rn - nb == 2
                      and rc[0] == rn - 2))):
         # MatMul broadcast matches dot_general ONLY for these shapes: a
         # batched vector operand would broadcast into a transposed result
