@@ -235,14 +235,20 @@ class DistributedBatchSampler(BatchSampler):
             rng.shuffle(indices)
         indices += indices[: (self.total_size - len(indices))]
         indices = indices[self.local_rank:self.total_size:self.nranks]
-        batch = []
+        batch, b_idx = [], 0
         for idx in indices:
             batch.append(idx)
             if len(batch) == self.batch_size:
-                yield batch
+                if b_idx >= self._consumed:
+                    self._consumed = b_idx + 1
+                    yield batch
+                b_idx += 1
                 batch = []
         if batch and not self.drop_last:
-            yield batch
+            if b_idx >= self._consumed:
+                self._consumed = b_idx + 1
+                yield batch
+        self._consumed = 0          # next epoch starts fresh
 
     def __len__(self):
         if self.drop_last:
@@ -251,6 +257,22 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    # -- deterministic resume (reference: sampler state in checkpoints;
+    #    SURVEY.md §5.4 / §7.3 hard part 3) --------------------------------
+    _consumed = 0
+
+    def state_dict(self):
+        """Epoch + consumed-batch counter: restoring and re-iterating
+        skips exactly the batches already trained on (same shuffle order
+        — the epoch seeds the permutation)."""
+        return {"epoch": self.epoch, "consumed_batches": self._consumed}
+
+    def set_state_dict(self, state):
+        self.epoch = int(state.get("epoch", 0))
+        self._consumed = int(state.get("consumed_batches", 0))
+
+    load_state_dict = set_state_dict
 
 
 # ---------------------------------------------------------------------------
@@ -477,6 +499,19 @@ class DataLoader:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
                                               drop_last=drop_last)
+
+    def state_dict(self):
+        """Deterministic-resume state (delegates to the batch sampler —
+        reference: dataloader/sampler state in train checkpoints)."""
+        sd = getattr(self.batch_sampler, "state_dict", None)
+        return sd() if sd else {}
+
+    def set_state_dict(self, state):
+        ss = getattr(self.batch_sampler, "set_state_dict", None)
+        if ss:
+            ss(state)
+
+    load_state_dict = set_state_dict
 
     def __iter__(self):
         if self._iterable_mode:

@@ -117,3 +117,59 @@ def test_resnet50_param_count():
     net = models.resnet50(num_classes=1000)
     n = sum(p.size for p in net.parameters())
     assert 25_000_000 < n < 26_000_000  # 25.5M matches torchvision/paddle
+
+
+def test_distributed_sampler_deterministic_resume():
+    """Checkpoint the sampler mid-epoch, restore, and get exactly the
+    unconsumed remainder in the same shuffle order (SURVEY.md §5.4 /
+    hard part 3 'sampler state in checkpoints')."""
+    import numpy as np
+    from paddle_tpu.io import DistributedBatchSampler
+
+    ds = np.arange(37)
+    s = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0,
+                                shuffle=True)
+    s.set_epoch(3)
+    full = [list(b) for b in s]
+
+    s2 = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0,
+                                 shuffle=True)
+    s2.set_epoch(3)
+    it = iter(s2)
+    consumed = [next(it) for _ in range(2)]
+    state = s2.state_dict()
+    assert state == {"epoch": 3, "consumed_batches": 2}
+
+    s3 = DistributedBatchSampler(ds, batch_size=4, num_replicas=2, rank=0,
+                                 shuffle=True)
+    s3.set_state_dict(state)
+    resumed = [list(b) for b in s3]
+    assert consumed + resumed == full
+    # next epoch after the resumed one starts fresh
+    s3.set_epoch(4)
+    assert len([b for b in s3]) == len(full)
+
+
+def test_dataloader_state_dict_delegates():
+    import numpy as np
+    from paddle_tpu.io import DataLoader, DistributedBatchSampler
+
+    class DS:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    # NB: the loader's buffered reader prefetches ahead of what the train
+    # loop consumed — exact mid-epoch state lives at the SAMPLER level;
+    # through the loader the delegation round-trips it.
+    bs = DistributedBatchSampler(DS(), batch_size=4, num_replicas=1, rank=0)
+    dl = DataLoader(DS(), batch_sampler=bs)
+    bs.set_state_dict({"epoch": 2, "consumed_batches": 1})
+    assert dl.state_dict() == {"epoch": 2, "consumed_batches": 1}
+    dl2 = DataLoader(DS(), batch_sampler=DistributedBatchSampler(
+        DS(), batch_size=4, num_replicas=1, rank=0))
+    dl2.set_state_dict(dl.state_dict())
+    remaining = [b for b in dl2]
+    assert len(remaining) == 3
