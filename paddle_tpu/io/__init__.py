@@ -228,27 +228,34 @@ class DistributedBatchSampler(BatchSampler):
         self.num_samples = int(math.ceil(len(dataset) / self.nranks))
         self.total_size = self.num_samples * self.nranks
 
-    def __iter__(self):
+    def _batches(self):
         indices = np.arange(len(self.dataset)).tolist()
         if self.shuffle:
             rng = np.random.RandomState(self.epoch)
             rng.shuffle(indices)
         indices += indices[: (self.total_size - len(indices))]
         indices = indices[self.local_rank:self.total_size:self.nranks]
-        batch, b_idx = [], 0
-        for idx in indices:
-            batch.append(idx)
-            if len(batch) == self.batch_size:
-                if b_idx >= self._consumed:
-                    self._consumed = b_idx + 1
-                    yield batch
-                b_idx += 1
-                batch = []
-        if batch and not self.drop_last:
-            if b_idx >= self._consumed:
-                self._consumed = b_idx + 1
-                yield batch
-        self._consumed = 0          # next epoch starts fresh
+        out = [indices[i:i + self.batch_size]
+               for i in range(0, len(indices), self.batch_size)]
+        if self.drop_last and out and len(out[-1]) < self.batch_size:
+            out.pop()
+        return out
+
+    def __iter__(self):
+        # one-shot resume offset: a fresh iteration after a break/early
+        # stop must NOT skip (the skip happens only on the iteration
+        # right after set_state_dict)
+        skip, self._resume_from = self._resume_from, 0
+        batches = self._batches()
+        if skip > len(batches):
+            raise ValueError(
+                f"sampler resume state skips {skip} batches but this "
+                f"epoch has only {len(batches)} — the checkpoint was "
+                "taken with a different batch size / dataset / replicas")
+        for b_idx in range(skip, len(batches)):
+            self._consumed = b_idx + 1     # progress for state_dict
+            yield batches[b_idx]
+        self._consumed = 0                 # exhausted: next epoch is fresh
 
     def __len__(self):
         if self.drop_last:
@@ -260,17 +267,20 @@ class DistributedBatchSampler(BatchSampler):
 
     # -- deterministic resume (reference: sampler state in checkpoints;
     #    SURVEY.md §5.4 / §7.3 hard part 3) --------------------------------
-    _consumed = 0
+    _consumed = 0       # batches yielded so far this epoch (live progress)
+    _resume_from = 0    # one-shot skip target set by set_state_dict
 
     def state_dict(self):
         """Epoch + consumed-batch counter: restoring and re-iterating
-        skips exactly the batches already trained on (same shuffle order
-        — the epoch seeds the permutation)."""
+        skips exactly the batches already yielded (same shuffle order —
+        the epoch seeds the permutation). Valid after a mid-epoch break
+        too (progress is tracked per yield, not reset on abandonment)."""
         return {"epoch": self.epoch, "consumed_batches": self._consumed}
 
     def set_state_dict(self, state):
         self.epoch = int(state.get("epoch", 0))
-        self._consumed = int(state.get("consumed_batches", 0))
+        self._resume_from = int(state.get("consumed_batches", 0))
+        self._consumed = self._resume_from
 
     load_state_dict = set_state_dict
 
@@ -500,20 +510,48 @@ class DataLoader:
                                               batch_size=batch_size,
                                               drop_last=drop_last)
 
+    _yielded = 0        # batches handed to the TRAIN LOOP this epoch
+    _resume_base = 0
+
     def state_dict(self):
-        """Deterministic-resume state (delegates to the batch sampler —
-        reference: dataloader/sampler state in train checkpoints)."""
-        sd = getattr(self.batch_sampler, "state_dict", None)
-        return sd() if sd else {}
+        """Deterministic-resume state. The consumed count is tracked at
+        the LOADER boundary (batches handed to the train loop), so the
+        buffered reader's prefetch depth cannot over-report (reference:
+        dataloader/sampler state in train checkpoints)."""
+        epoch = getattr(self.batch_sampler, "epoch", 0)
+        return {"epoch": epoch, "consumed_batches": self._yielded}
 
     def set_state_dict(self, state):
         ss = getattr(self.batch_sampler, "set_state_dict", None)
-        if ss:
-            ss(state)
+        if ss is None:
+            if state and state.get("consumed_batches"):
+                raise ValueError(
+                    "DataLoader resume needs a sampler with set_state_dict "
+                    "(DistributedBatchSampler); the default BatchSampler "
+                    "cannot skip consumed batches")
+            return
+        ss(state)
+        self._resume_base = int(state.get("consumed_batches", 0))
+        self._yielded = self._resume_base
 
     load_state_dict = set_state_dict
 
     def __iter__(self):
+        base, self._resume_base = self._resume_base, 0
+        inner_it = self._inner_iter()
+        self._yielded = base
+
+        def counted():
+            for item in inner_it:
+                # count BEFORE handing out: a checkpoint taken inside the
+                # train loop body sees the current batch as consumed
+                self._yielded += 1
+                yield item
+            self._yielded = 0      # clean epoch end
+
+        return counted()
+
+    def _inner_iter(self):
         if self._iterable_mode:
             inner = self._iter_iterable()
         elif self.num_workers > 0:

@@ -150,26 +150,55 @@ def test_distributed_sampler_deterministic_resume():
     assert len([b for b in s3]) == len(full)
 
 
-def test_dataloader_state_dict_delegates():
+def test_dataloader_mid_epoch_checkpoint_prefetch_accurate():
+    """Loader-level consumed count = batches handed to the train loop —
+    the buffered reader's prefetch depth must not over-report."""
     import numpy as np
     from paddle_tpu.io import DataLoader, DistributedBatchSampler
 
     class DS:
         def __len__(self):
-            return 16
+            return 32
 
         def __getitem__(self, i):
             return np.float32(i)
 
-    # NB: the loader's buffered reader prefetches ahead of what the train
-    # loop consumed — exact mid-epoch state lives at the SAMPLER level;
-    # through the loader the delegation round-trips it.
-    bs = DistributedBatchSampler(DS(), batch_size=4, num_replicas=1, rank=0)
-    dl = DataLoader(DS(), batch_sampler=bs)
-    bs.set_state_dict({"epoch": 2, "consumed_batches": 1})
-    assert dl.state_dict() == {"epoch": 2, "consumed_batches": 1}
-    dl2 = DataLoader(DS(), batch_sampler=DistributedBatchSampler(
-        DS(), batch_size=4, num_replicas=1, rank=0))
-    dl2.set_state_dict(dl.state_dict())
-    remaining = [b for b in dl2]
-    assert len(remaining) == 3
+    def make():
+        return DataLoader(DS(), batch_sampler=DistributedBatchSampler(
+            DS(), batch_size=4, num_replicas=1, rank=0),
+            prefetch_factor=3)
+
+    dl = make()
+    full = [np.asarray(b).tolist() for b in dl]
+
+    dl1 = make()
+    it = iter(dl1)
+    seen = [np.asarray(next(it)).tolist() for _ in range(3)]
+    state = dl1.state_dict()
+    assert state["consumed_batches"] == 3, state    # NOT 3+prefetch
+
+    dl2 = make()
+    dl2.set_state_dict(state)
+    rest = [np.asarray(b).tolist() for b in dl2]
+    assert seen + rest == full
+
+    # abandoned iteration must NOT skip on the next fresh epoch
+    again = [np.asarray(b).tolist() for b in dl1]
+    assert again == full
+
+
+def test_dataloader_resume_rejects_default_sampler():
+    import numpy as np
+    import pytest as _pytest
+    from paddle_tpu.io import DataLoader
+
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    dl = DataLoader(DS(), batch_size=4)
+    with _pytest.raises(ValueError, match="set_state_dict"):
+        dl.set_state_dict({"epoch": 0, "consumed_batches": 2})
