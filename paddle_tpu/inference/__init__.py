@@ -144,3 +144,5 @@ class PrecisionType:
     Float32 = 0
     Half = 1
     Int8 = 2
+
+from .serving import ServingEngine  # noqa: E402,F401

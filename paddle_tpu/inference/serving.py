@@ -1,0 +1,184 @@
+"""Batched serving engine (reference: the serving tier around
+``fused_multi_transformer`` / Paddle Inference's request batching —
+SURVEY.md §2.1 "Inference engine", §3.6; VERDICT.md L11 "no serving tier").
+
+TPU-native: requests are micro-batched by prompt length (same-shape
+grouping keeps every step a fixed-shape jit-friendly batch), each group
+decodes through the paged KV cache + Pallas ``paged_attention`` kernel,
+and per-request results are fanned back to the callers. Static batching
+with a collect window — the continuous-batching scheduler can replace the
+grouping policy without touching the decode path."""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+
+class _Request:
+    def __init__(self, ids, max_new_tokens, kwargs):
+        self.ids = np.asarray(ids)
+        if self.ids.ndim == 1:
+            self.ids = self.ids[None]
+        self.max_new_tokens = max_new_tokens
+        self.kwargs = kwargs
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+
+
+class ServingEngine:
+    """Thread-safe batched ``generate`` front end.
+
+    engine = ServingEngine(model, max_batch_size=8)
+    engine.start()
+    out = engine.generate(prompt_ids, max_new_tokens=64)   # blocks
+    engine.stop()
+    """
+
+    _STOP = object()
+
+    def __init__(self, model, max_batch_size=8, batch_window_s=0.005,
+                 use_paged_cache=True, page_size=16):
+        # NB: generate() handles eval()/restore per call — constructing an
+        # engine must not flip a training model's mode
+        self.model = model
+        self.max_batch = int(max_batch_size)
+        self.window = float(batch_window_s)
+        self.use_paged = use_paged_cache
+        self.page_size = page_size
+        self._q: queue.Queue = queue.Queue()
+        self._thread = None
+        self._running = False
+        self.batches_run = 0          # observability/testing
+
+    # -- client API ----------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens=32, timeout=None, **kwargs):
+        if not self._running:
+            raise RuntimeError("ServingEngine not started (call start())")
+        ids = input_ids.numpy() if isinstance(input_ids, Tensor) \
+            else np.asarray(input_ids)
+        req = _Request(ids, max_new_tokens, kwargs)
+        self._q.put(req)
+        if not req.done.wait(timeout):
+            raise TimeoutError("generate timed out")
+        if req.error is not None:
+            raise req.error
+        return Tensor(req.result)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._running:
+            return self
+        # drain stale stop tokens from a previous stop() so the new
+        # worker doesn't die on arrival
+        try:
+            while True:
+                item = self._q.get_nowait()
+                if item is not self._STOP and item is not None:
+                    self._q.put(item)
+                    break
+        except queue.Empty:
+            pass
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if not self._running and self._thread is None:
+            return
+        self._running = False
+        self._q.put(self._STOP)
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    # -- scheduler -----------------------------------------------------------
+    def _collect(self):
+        """Block for one request, then drain compatible ones within the
+        window. Groups by (prompt_len, max_new_tokens, kwargs) — equal
+        shapes keep the decode batch fixed-shape."""
+        first = self._q.get()
+        if first is self._STOP or first is None:
+            return None
+        group = [first]
+        key = (first.ids.shape[1], first.max_new_tokens,
+               tuple(sorted(first.kwargs.items())))
+        deadline = threading.Event()
+        timer = threading.Timer(self.window, deadline.set)
+        timer.start()
+        leftovers = []
+        try:
+            while sum(r.ids.shape[0] for r in group) < self.max_batch \
+                    and not deadline.is_set():
+                try:
+                    nxt = self._q.get(timeout=self.window / 4 or 0.001)
+                except queue.Empty:
+                    continue
+                if nxt is self._STOP or nxt is None:
+                    self._q.put(self._STOP)  # re-post the stop token
+                    break
+                k = (nxt.ids.shape[1], nxt.max_new_tokens,
+                     tuple(sorted(nxt.kwargs.items())))
+                if k == key and (sum(r.ids.shape[0] for r in group)
+                                 + nxt.ids.shape[0]) <= self.max_batch:
+                    group.append(nxt)
+                else:
+                    leftovers.append(nxt)
+        finally:
+            timer.cancel()
+            for r in leftovers:             # incompatible: next rounds
+                self._q.put(r)
+        return group
+
+    def _loop(self):
+        try:
+            self._serve()
+        finally:
+            # fail any stranded requests (queued behind the stop token /
+            # leftovers re-queued after it) instead of blocking callers
+            try:
+                while True:
+                    item = self._q.get_nowait()
+                    if isinstance(item, _Request):
+                        item.error = RuntimeError("ServingEngine stopped")
+                        item.done.set()
+            except queue.Empty:
+                pass
+
+    def _serve(self):
+        while self._running:
+            group = self._collect()
+            if group is None:
+                break
+            try:
+                batch = np.concatenate([r.ids for r in group], axis=0)
+                kwargs = dict(group[0].kwargs)
+                if self.use_paged:
+                    kwargs.setdefault("use_paged_cache", True)
+                    kwargs.setdefault("page_size", self.page_size)
+                out = self.model.generate(
+                    Tensor(batch), max_new_tokens=group[0].max_new_tokens,
+                    **kwargs)
+                arr = np.asarray(out.numpy())
+                self.batches_run += 1
+                row = 0
+                for r in group:
+                    n = r.ids.shape[0]
+                    r.result = arr[row:row + n]
+                    row += n
+                    r.done.set()
+            except Exception as e:          # fan the failure out, keep serving
+                for r in group:
+                    r.error = e
+                    r.done.set()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -459,17 +459,47 @@ class _PrefetchIter:
         self.q = queue.Queue(maxsize=depth)
         self.done = object()
         self.err = None
+        self._stop = threading.Event()
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
 
     def _run(self):
         try:
             for item in self.inner:
-                self.q.put(item)
+                while not self._stop.is_set():
+                    try:
+                        self.q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
         except Exception as e:
             self.err = e
         finally:
-            self.q.put(self.done)
+            try:
+                self.q.put_nowait(self.done)
+            except queue.Full:
+                pass
+
+    def shutdown(self):
+        """Unblock and retire the prefetch thread (mid-epoch break path:
+        without this, an abandoned iterator leaks the thread blocked on a
+        full queue — and through it the worker processes)."""
+        self._stop.set()
+        try:
+            while True:
+                self.q.get_nowait()
+        except queue.Empty:
+            pass
+        close = getattr(self.inner, "close", None) or \
+            getattr(self.inner, "shutdown", None)
+        if close:
+            try:
+                close()
+            except Exception:
+                pass
+        self.thread.join(timeout=5)
 
     def __iter__(self):
         return self
@@ -511,7 +541,6 @@ class DataLoader:
                                               drop_last=drop_last)
 
     _yielded = 0        # batches handed to the TRAIN LOOP this epoch
-    _resume_base = 0
 
     def state_dict(self):
         """Deterministic-resume state. The consumed count is tracked at
@@ -531,23 +560,31 @@ class DataLoader:
                     "cannot skip consumed batches")
             return
         ss(state)
-        self._resume_base = int(state.get("consumed_batches", 0))
-        self._yielded = self._resume_base
+        self._yielded = int(state.get("consumed_batches", 0))
 
     load_state_dict = set_state_dict
 
     def __iter__(self):
-        base, self._resume_base = self._resume_base, 0
+        # the loader's consumed base is whatever skip the sampler has
+        # armed, read BEFORE the inner iterator (and its prefetch thread)
+        # can consume it — keeps the two in sync even if this iterator is
+        # later abandoned without a single next()
+        base = getattr(self.batch_sampler, "_resume_from", 0)
         inner_it = self._inner_iter()
         self._yielded = base
 
         def counted():
-            for item in inner_it:
-                # count BEFORE handing out: a checkpoint taken inside the
-                # train loop body sees the current batch as consumed
-                self._yielded += 1
-                yield item
-            self._yielded = 0      # clean epoch end
+            try:
+                for item in inner_it:
+                    # count BEFORE handing out: a checkpoint inside the
+                    # loop body sees the current batch as consumed
+                    self._yielded += 1
+                    yield item
+                self._yielded = 0      # clean epoch end
+            finally:
+                stop = getattr(inner_it, "shutdown", None)
+                if stop:               # break/early-stop: retire prefetch
+                    stop()
 
         return counted()
 

@@ -1,0 +1,96 @@
+"""Batched serving engine (reference: Paddle Inference request batching
+around the fused decode tier; VERDICT round-1 L11 'no serving tier')."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import ServingEngine
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+
+
+def test_concurrent_requests_batched_and_correct(model):
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 128, (1, 6)).astype(np.int64) for _ in range(4)]
+    # sequential oracle
+    oracle = [np.asarray(model.generate(paddle.to_tensor(p),
+                                        max_new_tokens=5)._data)
+              for p in prompts]
+
+    eng = ServingEngine(model, max_batch_size=4, batch_window_s=0.25)
+    with eng:
+        results = [None] * 4
+
+        def call(i):
+            results[i] = np.asarray(
+                eng.generate(prompts[i], max_new_tokens=5, timeout=300)
+                .numpy())
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for got, want in zip(results, oracle):
+        np.testing.assert_array_equal(got, want)
+    # the window collected them into fewer model calls than requests
+    assert eng.batches_run < 4, eng.batches_run
+
+
+def test_incompatible_lengths_get_separate_batches(model):
+    rng = np.random.RandomState(1)
+    a = rng.randint(0, 128, (1, 4)).astype(np.int64)
+    b = rng.randint(0, 128, (1, 9)).astype(np.int64)
+    eng = ServingEngine(model, max_batch_size=4, batch_window_s=0.05)
+    with eng:
+        out = [None, None]
+
+        def call(i, p):
+            out[i] = eng.generate(p, max_new_tokens=3, timeout=300)
+
+        ts = [threading.Thread(target=call, args=(0, a)),
+              threading.Thread(target=call, args=(1, b))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    assert out[0].shape[1] == 4 + 3 and out[1].shape[1] == 9 + 3
+    assert eng.batches_run == 2
+
+
+def test_error_fans_out_and_engine_survives(model):
+    eng = ServingEngine(model, max_batch_size=2, batch_window_s=0.01)
+    with eng:
+        bad = np.zeros((1, 0), np.int64)      # empty prompt -> error
+        with pytest.raises(Exception):
+            eng.generate(bad, max_new_tokens=2, timeout=300)
+        ok = eng.generate(np.ones((1, 4), np.int64), max_new_tokens=2,
+                          timeout=300)
+        assert ok.shape[1] == 6
+
+
+def test_requires_start(model):
+    eng = ServingEngine(model)
+    with pytest.raises(RuntimeError, match="start"):
+        eng.generate(np.ones((1, 4), np.int64))
+
+
+def test_stop_start_cycle_and_stranded_requests(model):
+    eng = ServingEngine(model, max_batch_size=2, batch_window_s=0.01)
+    eng.start()
+    eng.stop()
+    eng.stop()                      # double stop must be harmless
+    eng.start()                     # restart: stale stop tokens drained
+    out = eng.generate(np.ones((1, 4), np.int64), max_new_tokens=2,
+                       timeout=300)
+    assert out.shape[1] == 6
+    eng.stop()
+    with pytest.raises(RuntimeError, match="not started"):
+        eng.generate(np.ones((1, 4), np.int64))
