@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import numpy as np
 
@@ -63,6 +64,10 @@ class ServingEngine:
             else np.asarray(input_ids)
         req = _Request(ids, max_new_tokens, kwargs)
         self._q.put(req)
+        if not self._running and not req.done.is_set():
+            # raced with stop(): the worker's drain may already be past
+            req.error = RuntimeError("ServingEngine stopped")
+            req.done.set()
         if not req.done.wait(timeout):
             raise TimeoutError("generate timed out")
         if req.error is not None:
@@ -108,17 +113,17 @@ class ServingEngine:
         group = [first]
         key = (first.ids.shape[1], first.max_new_tokens,
                tuple(sorted(first.kwargs.items())))
-        deadline = threading.Event()
-        timer = threading.Timer(self.window, deadline.set)
-        timer.start()
+        deadline = time.monotonic() + self.window
         leftovers = []
         try:
-            while sum(r.ids.shape[0] for r in group) < self.max_batch \
-                    and not deadline.is_set():
+            while sum(r.ids.shape[0] for r in group) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
                 try:
-                    nxt = self._q.get(timeout=self.window / 4 or 0.001)
+                    nxt = self._q.get(timeout=remaining)
                 except queue.Empty:
-                    continue
+                    break
                 if nxt is self._STOP or nxt is None:
                     self._q.put(self._STOP)  # re-post the stop token
                     break
@@ -130,7 +135,6 @@ class ServingEngine:
                 else:
                     leftovers.append(nxt)
         finally:
-            timer.cancel()
             for r in leftovers:             # incompatible: next rounds
                 self._q.put(r)
         return group
@@ -166,10 +170,22 @@ class ServingEngine:
                     **kwargs)
                 arr = np.asarray(out.numpy())
                 self.batches_run += 1
+                prompt_len = group[0].ids.shape[1]
+                eos = kwargs.get("eos_token_id")
                 row = 0
                 for r in group:
                     n = r.ids.shape[0]
-                    r.result = arr[row:row + n]
+                    res = arr[row:row + n]
+                    if eos is not None:
+                        # trim co-batch eos padding: a request's output
+                        # must not depend on its batch-mates' lengths
+                        gen = res[:, prompt_len:]
+                        hits = np.argmax(gen == eos, axis=1)
+                        has = (gen == eos).any(axis=1)
+                        stop = int(np.max(np.where(has, hits + 1,
+                                                   gen.shape[1])))
+                        res = res[:, :prompt_len + stop]
+                    r.result = res
                     row += n
                     r.done.set()
             except Exception as e:          # fan the failure out, keep serving

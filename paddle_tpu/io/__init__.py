@@ -477,10 +477,14 @@ class _PrefetchIter:
         except Exception as e:
             self.err = e
         finally:
-            try:
-                self.q.put_nowait(self.done)
-            except queue.Full:
-                pass
+            # deliver the sentinel even when the queue is full (consumer
+            # lagging at epoch end); only a shutdown() may abandon it
+            while not self._stop.is_set():
+                try:
+                    self.q.put(self.done, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def shutdown(self):
         """Unblock and retire the prefetch thread (mid-epoch break path:

@@ -42,3 +42,50 @@ def test_custom_pass_registration():
 
     plan = PassManager([new_pass("my_test_pass")]).apply({})
     assert plan["custom"]
+
+
+def test_gradient_merge_real_semantics():
+    """GradientMergePass.wrap: the optimizer applies every k-th step with
+    averaged accumulated grads — parity vs one big-batch step."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.passes import new_pass
+
+    def make():
+        paddle.seed(5)
+        m = paddle.nn.Linear(4, 3)
+        return m, paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=m.parameters())
+
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(8, 4).astype(np.float32) for _ in range(2)]
+
+    # oracle: one step on the concatenated batch
+    m1, o1 = make()
+    loss = (m1(paddle.to_tensor(np.concatenate(xs))) ** paddle.to_tensor(2.0)).mean()
+    loss.backward()
+    o1.step()
+    w_oracle = np.asarray(m1.weight._data)
+
+    # gradient merge: two half-batches, k_steps=2
+    m2, o2 = make()
+    gm = new_pass("auto_parallel_gradient_merge", {"k_steps": 2, "avg": True})
+    opt = gm.wrap(o2)
+    for x in xs:
+        loss = (m2(paddle.to_tensor(x)) ** paddle.to_tensor(2.0)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_allclose(np.asarray(m2.weight._data), w_oracle,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_new_round2_passes_registered():
+    from paddle_tpu.distributed.passes import new_pass, PassManager
+
+    pm = PassManager([new_pass("auto_parallel_master_grad"),
+                      new_pass("fuse_gemm_epilogue"),
+                      new_pass("allreduce_matmul_grad_overlapping")])
+    plan = pm.apply({})
+    assert plan["amp"]["master_grad"] is True
+    assert len(plan["notes"]) == 2
