@@ -141,9 +141,9 @@ def bench_llama():
     loss.block_until_ready()
     dt = time.perf_counter() - t0
 
-    from paddle_tpu.profiler.mfu import llama_train_flops, PEAK_FLOPS
+    from paddle_tpu.profiler.mfu import llama_train_flops, PEAK_FLOPS, chip_kind
     flops = llama_train_flops(cfg, batch, seq)
-    chip = os.environ.get("BENCH_CHIP", "v5p")
+    chip = os.environ.get("BENCH_CHIP") or chip_kind(jax.devices()[0])
     mfu = flops * steps / dt / PEAK_FLOPS.get(chip, PEAK_FLOPS["v5p"])
     print(json.dumps({"aux_metric": "mfu_" + chip,
                       "value": round(mfu * 100, 2), "unit": "%"}),

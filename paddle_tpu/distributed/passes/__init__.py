@@ -75,9 +75,12 @@ class AMPPass(PassBase):
     weights) — realized by the amp cast hook, not inserted cast ops."""
 
     def apply(self, plan, *a, **kw):
-        plan["amp"] = {"level": self.attrs.get("level", "O2"),
-                       "dtype": self.attrs.get("dtype", "bfloat16"),
-                       "master_weights": True}
+        # merge, don't clobber: MasterGradPass may have recorded
+        # master_grad in plan['amp'] already (pass order is free)
+        plan.setdefault("amp", {}).update(
+            {"level": self.attrs.get("level", "O2"),
+             "dtype": self.attrs.get("dtype", "bfloat16"),
+             "master_weights": True})
         return plan
 
 

@@ -1,0 +1,41 @@
+"""paddle.distribution — probability distributions, transforms, and KL
+(reference: ``python/paddle/distribution/`` — Distribution base +
+``normal.py``/``uniform.py``/... families, ``transform.py``, ``kl.py``;
+SURVEY.md citation convention: canonical upstream paths, unverified).
+
+TPU-native design: parameters live as ``Tensor``s and all differentiable
+math (``log_prob``, ``entropy``, ``rsample``) is written in paddle ops so
+it records on the autograd tape and traces under ``jax.jit``; sampling
+draws from the framework PRNG (``paddle.seed``-derived counter keys,
+``framework/random.py``) via ``jax.random`` so it is deterministic and
+TPU-resident.
+"""
+from __future__ import annotations
+
+from .distribution import Distribution, ExponentialFamily, Independent
+from .families import (
+    Bernoulli, Beta, Binomial, Categorical, Cauchy, Dirichlet, Exponential,
+    Gamma, Geometric, Gumbel, Laplace, LogNormal, Multinomial,
+    MultivariateNormal, Normal, Poisson, StudentT, Uniform,
+)
+from .transform import (
+    AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+    Transform, TransformedDistribution,
+)
+from .kl import kl_divergence, register_kl
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Independent",
+    "Bernoulli", "Beta", "Binomial", "Categorical", "Cauchy", "Dirichlet",
+    "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace", "LogNormal",
+    "Multinomial", "MultivariateNormal", "Normal", "Poisson", "StudentT",
+    "Uniform",
+    "Transform", "TransformedDistribution", "AbsTransform", "AffineTransform",
+    "ChainTransform", "ExpTransform", "IndependentTransform",
+    "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform",
+    "kl_divergence", "register_kl",
+]

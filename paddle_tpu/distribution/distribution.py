@@ -1,0 +1,158 @@
+"""Distribution base classes (reference:
+``python/paddle/distribution/distribution.py``,
+``exponential_family.py``, ``independent.py``)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..framework import random as prandom
+from ..autograd.tape import apply
+
+
+def _arr(x, dtype=None):
+    """Tensor/array/scalar -> jnp array (keeps Tensors' underlying array)."""
+    if isinstance(x, Tensor):
+        a = x._data
+    else:
+        a = jnp.asarray(x, jnp.float32 if isinstance(x, (int, float)) else None)
+    if dtype is not None and a.dtype != dtype:
+        a = a.astype(dtype)
+    return a
+
+
+def _wrap(a):
+    return a if isinstance(a, Tensor) else Tensor(a)
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Base of all distributions (reference Distribution ABC: sample /
+    rsample / log_prob / probs / entropy / kl_divergence, with
+    ``batch_shape`` + ``event_shape``)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape_tuple(batch_shape)
+        self._event_shape = _shape_tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(_arr(self.variance)))
+
+    def sample(self, shape=()):
+        """Draw (non-reparameterized); default falls back to rsample with
+        gradients cut, matching the reference's sample/rsample split."""
+        out = self.rsample(shape).detach()
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_arr(self.log_prob(value))))
+
+    # reference spells it ``probs``
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return (_shape_tuple(sample_shape) + self.batch_shape
+                + self.event_shape)
+
+    def _key(self):
+        return prandom.next_key()
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family marker (reference ``exponential_family.py`` —
+    enables the Bregman-divergence generic entropy; subclasses here
+    provide closed forms so this stays a marker/base)."""
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_ndims`` batch dims as
+    event dims (reference ``python/paddle/distribution/independent.py``)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        shape = base.batch_shape + base.event_shape
+        split = len(base.batch_shape) - self.reinterpreted_batch_ndims
+        if split < 0:
+            raise ValueError(
+                "reinterpreted_batch_ndims exceeds batch rank "
+                f"({self.reinterpreted_batch_ndims} > {len(base.batch_shape)})")
+        super().__init__(shape[:split], shape[split:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+
+        def fn(a):
+            n = self.reinterpreted_batch_ndims
+            return jnp.sum(a, axis=tuple(range(a.ndim - n, a.ndim))) if n else a
+        return apply(fn, lp, op_name="independent_log_prob")
+
+    def entropy(self):
+        ent = self.base.entropy()
+
+        def fn(a):
+            n = self.reinterpreted_batch_ndims
+            return jnp.sum(a, axis=tuple(range(a.ndim - n, a.ndim))) if n else a
+        return apply(fn, ent, op_name="independent_entropy")
+
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)

@@ -1,0 +1,809 @@
+"""Distribution families (reference: ``python/paddle/distribution/*.py`` —
+one module per family upstream; gathered here since each is a thin
+parameterization over jnp math + the framework PRNG).
+
+Differentiable quantities (``log_prob``/``entropy``/``rsample``) run
+through :func:`paddle_tpu.autograd.tape.apply` so they record on the tape
+and trace under jit; draws use ``jax.random`` with counter-derived keys.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+from ..autograd.tape import apply
+from .distribution import (
+    Distribution, ExponentialFamily, _arr, _wrap, _shape_tuple, _HALF_LOG_2PI,
+)
+
+_EULER = float(np.euler_gamma)
+
+
+def _param(x):
+    if isinstance(x, Tensor):
+        return x
+    t = to_tensor(np.asarray(x, np.float32))
+    t.stop_gradient = True
+    return t
+
+
+def _bshape(*xs):
+    return tuple(np.broadcast_shapes(*[tuple(_arr(x).shape) for x in xs]))
+
+
+class Normal(ExponentialFamily):
+    """reference ``python/paddle/distribution/normal.py``."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(_arr(self.loc), self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(_arr(self.scale) ** 2, self.batch_shape))
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        eps = jax.random.normal(self._key(), full, jnp.float32)
+        return apply(lambda l, s: l + s * eps, self.loc, self.scale,
+                     op_name="normal_rsample")
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            return (-((v - l) ** 2) / (2.0 * s ** 2) - jnp.log(s)
+                    - _HALF_LOG_2PI)
+        return apply(fn, self.loc, self.scale, _param(value),
+                     op_name="normal_log_prob")
+
+    def entropy(self):
+        def fn(l, s):
+            return jnp.broadcast_to(0.5 + _HALF_LOG_2PI + jnp.log(s),
+                                    _bshape(l, s))
+        return apply(fn, self.loc, self.scale, op_name="normal_entropy")
+
+
+class Uniform(Distribution):
+    """reference ``python/paddle/distribution/uniform.py`` (support
+    ``[low, high)``)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _param(low)
+        self.high = _param(high)
+        super().__init__(_bshape(self.low, self.high))
+
+    @property
+    def mean(self):
+        return apply(lambda a, b: (a + b) / 2.0, self.low, self.high,
+                     op_name="uniform_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda a, b: (b - a) ** 2 / 12.0, self.low, self.high,
+                     op_name="uniform_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(), full, jnp.float32)
+        return apply(lambda a, b: a + (b - a) * u, self.low, self.high,
+                     op_name="uniform_rsample")
+
+    def log_prob(self, value):
+        def fn(a, b, v):
+            inside = (v >= a) & (v < b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+        return apply(fn, self.low, self.high, _param(value),
+                     op_name="uniform_log_prob")
+
+    def entropy(self):
+        return apply(lambda a, b: jnp.log(b - a), self.low, self.high,
+                     op_name="uniform_entropy")
+
+
+class Bernoulli(ExponentialFamily):
+    """reference ``python/paddle/distribution/bernoulli.py`` (probs
+    parameterization)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_param = _param(probs)
+        super().__init__(_bshape(self.probs_param))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(_arr(self.probs_param),
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        return apply(lambda p: p * (1 - p), self.probs_param,
+                     op_name="bernoulli_var")
+
+    def sample(self, shape=()):
+        full = self._extend_shape(shape)
+        p = jnp.broadcast_to(_arr(self.probs_param), self.batch_shape)
+        out = jax.random.bernoulli(self._key(), p, full)
+        return Tensor(out.astype(jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(p, v):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc)
+        return apply(fn, self.probs_param, _param(value),
+                     op_name="bernoulli_log_prob")
+
+    def entropy(self):
+        def fn(p):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            return -(pc * jnp.log(pc) + (1 - pc) * jnp.log1p(-pc))
+        return apply(fn, self.probs_param, op_name="bernoulli_entropy")
+
+
+class Categorical(Distribution):
+    """reference ``python/paddle/distribution/categorical.py`` — takes
+    unnormalized ``logits``; last axis indexes categories."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _param(logits)
+        shape = tuple(_arr(self.logits).shape)
+        self._num_categories = shape[-1]
+        super().__init__(shape[:-1])
+
+    @property
+    def probs_tensor(self):
+        return apply(jax.nn.softmax, self.logits, op_name="categorical_probs")
+
+    def sample(self, shape=()):
+        sample_shape = _shape_tuple(shape)
+        lg = _arr(self.logits)
+        # normalize: reference treats rows as unnormalized probabilities when
+        # non-negative; we follow logits convention (log-space)
+        out = jax.random.categorical(
+            self._key(), lg, axis=-1,
+            shape=sample_shape + tuple(lg.shape[:-1]))
+        from ..framework.dtype import INT_DTYPE
+        return Tensor(out.astype(INT_DTYPE))
+
+    def log_prob(self, value):
+        def fn(lg, v):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            vi = v.astype(jnp.int32)
+            return jnp.take_along_axis(
+                logp, vi[..., None], axis=-1)[..., 0]
+        return apply(fn, self.logits, _param(value),
+                     op_name="categorical_log_prob")
+
+    def entropy(self):
+        def fn(lg):
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return apply(fn, self.logits, op_name="categorical_entropy")
+
+
+class Beta(ExponentialFamily):
+    """reference ``python/paddle/distribution/beta.py``."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _param(alpha)
+        self.beta = _param(beta)
+        super().__init__(_bshape(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return apply(lambda a, b: a / (a + b), self.alpha, self.beta,
+                     op_name="beta_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                     self.alpha, self.beta, op_name="beta_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        k1, k2 = jax.random.split(self._key())
+
+        def fn(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, full))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, full))
+            return ga / (ga + gb)
+        return apply(fn, self.alpha, self.beta, op_name="beta_rsample")
+
+    def log_prob(self, value):
+        def fn(a, b, v):
+            lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b)
+                     - jax.lax.lgamma(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+        return apply(fn, self.alpha, self.beta, _param(value),
+                     op_name="beta_log_prob")
+
+    def entropy(self):
+        def fn(a, b):
+            dg = jax.lax.digamma
+            lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b)
+                     - jax.lax.lgamma(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return apply(fn, self.alpha, self.beta, op_name="beta_entropy")
+
+
+class Gamma(ExponentialFamily):
+    """reference ``python/paddle/distribution/gamma.py`` (concentration /
+    rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _param(concentration)
+        self.rate = _param(rate)
+        super().__init__(_bshape(self.concentration, self.rate))
+
+    @property
+    def mean(self):
+        return apply(lambda c, r: c / r, self.concentration, self.rate,
+                     op_name="gamma_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda c, r: c / r ** 2, self.concentration, self.rate,
+                     op_name="gamma_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        key = self._key()
+
+        def fn(c, r):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, full))
+            return g / r
+        return apply(fn, self.concentration, self.rate,
+                     op_name="gamma_rsample")
+
+    def log_prob(self, value):
+        def fn(c, r, v):
+            return (c * jnp.log(r) + (c - 1) * jnp.log(v) - r * v
+                    - jax.lax.lgamma(c))
+        return apply(fn, self.concentration, self.rate, _param(value),
+                     op_name="gamma_log_prob")
+
+    def entropy(self):
+        def fn(c, r):
+            return (c - jnp.log(r) + jax.lax.lgamma(c)
+                    + (1 - c) * jax.lax.digamma(c))
+        return apply(fn, self.concentration, self.rate,
+                     op_name="gamma_entropy")
+
+
+class Dirichlet(ExponentialFamily):
+    """reference ``python/paddle/distribution/dirichlet.py``."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _param(concentration)
+        shape = tuple(_arr(self.concentration).shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return apply(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                     self.concentration, op_name="dirichlet_mean")
+
+    @property
+    def variance(self):
+        def fn(c):
+            a0 = jnp.sum(c, -1, keepdims=True)
+            m = c / a0
+            return m * (1 - m) / (a0 + 1)
+        return apply(fn, self.concentration, op_name="dirichlet_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        key = self._key()
+
+        def fn(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, full))
+            return g / jnp.sum(g, -1, keepdims=True)
+        return apply(fn, self.concentration, op_name="dirichlet_rsample")
+
+    def log_prob(self, value):
+        def fn(c, v):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + jax.lax.lgamma(jnp.sum(c, -1))
+                    - jnp.sum(jax.lax.lgamma(c), -1))
+        return apply(fn, self.concentration, _param(value),
+                     op_name="dirichlet_log_prob")
+
+    def entropy(self):
+        def fn(c):
+            a0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            lnB = jnp.sum(jax.lax.lgamma(c), -1) - jax.lax.lgamma(a0)
+            return (lnB + (a0 - k) * jax.lax.digamma(a0)
+                    - jnp.sum((c - 1) * jax.lax.digamma(c), -1))
+        return apply(fn, self.concentration, op_name="dirichlet_entropy")
+
+
+class Exponential(ExponentialFamily):
+    """reference ``python/paddle/distribution/exponential.py`` (rate)."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(_bshape(self.rate))
+
+    @property
+    def mean(self):
+        return apply(lambda r: 1.0 / r, self.rate, op_name="exp_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda r: 1.0 / r ** 2, self.rate, op_name="exp_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        e = jax.random.exponential(self._key(), full, jnp.float32)
+        return apply(lambda r: e / r, self.rate, op_name="exp_rsample")
+
+    def log_prob(self, value):
+        return apply(lambda r, v: jnp.log(r) - r * v, self.rate,
+                     _param(value), op_name="exp_log_prob")
+
+    def entropy(self):
+        return apply(lambda r: 1.0 - jnp.log(r), self.rate,
+                     op_name="exp_entropy")
+
+
+class Geometric(Distribution):
+    """reference ``python/paddle/distribution/geometric.py`` — pmf
+    ``p (1-p)^k`` over failures ``k >= 0`` before the first success."""
+
+    def __init__(self, probs, name=None):
+        self.probs_param = _param(probs)
+        super().__init__(_bshape(self.probs_param))
+
+    @property
+    def mean(self):
+        return apply(lambda p: (1 - p) / p, self.probs_param,
+                     op_name="geom_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda p: (1 - p) / p ** 2, self.probs_param,
+                     op_name="geom_var")
+
+    def sample(self, shape=()):
+        full = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(), full, jnp.float32,
+                               minval=1e-7, maxval=1.0)
+        p = _arr(self.probs_param)
+        out = jnp.floor(jnp.log(u) / jnp.log1p(-p))
+        return Tensor(out.astype(jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(p, v):
+            return v * jnp.log1p(-p) + jnp.log(p)
+        return apply(fn, self.probs_param, _param(value),
+                     op_name="geom_log_prob")
+
+    def entropy(self):
+        def fn(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+        return apply(fn, self.probs_param, op_name="geom_entropy")
+
+
+class Gumbel(Distribution):
+    """reference ``python/paddle/distribution/gumbel.py``."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply(lambda l, s: l + s * _EULER, self.loc, self.scale,
+                     op_name="gumbel_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda l, s: (math.pi ** 2 / 6.0) * s ** 2
+                     + jnp.zeros_like(l),
+                     self.loc, self.scale, op_name="gumbel_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        g = jax.random.gumbel(self._key(), full, jnp.float32)
+        return apply(lambda l, s: l + s * g, self.loc, self.scale,
+                     op_name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return apply(fn, self.loc, self.scale, _param(value),
+                     op_name="gumbel_log_prob")
+
+    def entropy(self):
+        return apply(lambda l, s: jnp.log(s) + 1.0 + _EULER
+                     + jnp.zeros_like(l),
+                     self.loc, self.scale, op_name="gumbel_entropy")
+
+
+class Laplace(Distribution):
+    """reference ``python/paddle/distribution/laplace.py``."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(_arr(self.loc), self.batch_shape))
+
+    @property
+    def variance(self):
+        return apply(lambda l, s: 2 * s ** 2 + jnp.zeros_like(l),
+                     self.loc, self.scale, op_name="laplace_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(), full, jnp.float32,
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return apply(lambda l, s: l - s * jnp.sign(u)
+                     * jnp.log1p(-2 * jnp.abs(u)),
+                     self.loc, self.scale, op_name="laplace_rsample")
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            return -jnp.abs(v - l) / s - jnp.log(2 * s)
+        return apply(fn, self.loc, self.scale, _param(value),
+                     op_name="laplace_log_prob")
+
+    def entropy(self):
+        return apply(lambda l, s: 1.0 + jnp.log(2 * s) + jnp.zeros_like(l),
+                     self.loc, self.scale, op_name="laplace_entropy")
+
+
+class LogNormal(Distribution):
+    """reference ``python/paddle/distribution/lognormal.py`` (upstream
+    builds it as exp-transformed Normal; closed forms here)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return apply(lambda l, s: jnp.exp(l + s ** 2 / 2), self.loc,
+                     self.scale, op_name="lognormal_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda l, s: (jnp.exp(s ** 2) - 1)
+                     * jnp.exp(2 * l + s ** 2),
+                     self.loc, self.scale, op_name="lognormal_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        eps = jax.random.normal(self._key(), full, jnp.float32)
+        return apply(lambda l, s: jnp.exp(l + s * eps), self.loc, self.scale,
+                     op_name="lognormal_rsample")
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            lv = jnp.log(v)
+            return (-((lv - l) ** 2) / (2 * s ** 2) - jnp.log(s)
+                    - _HALF_LOG_2PI - lv)
+        return apply(fn, self.loc, self.scale, _param(value),
+                     op_name="lognormal_log_prob")
+
+    def entropy(self):
+        return apply(lambda l, s: 0.5 + _HALF_LOG_2PI + jnp.log(s) + l,
+                     self.loc, self.scale, op_name="lognormal_entropy")
+
+
+class Multinomial(Distribution):
+    """reference ``python/paddle/distribution/multinomial.py``."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_param = _param(probs)
+        shape = tuple(_arr(self.probs_param).shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return apply(lambda p: self.total_count
+                     * (p / jnp.sum(p, -1, keepdims=True)),
+                     self.probs_param, op_name="multinomial_mean")
+
+    @property
+    def variance(self):
+        def fn(p):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            return self.total_count * pn * (1 - pn)
+        return apply(fn, self.probs_param, op_name="multinomial_var")
+
+    def sample(self, shape=()):
+        sample_shape = _shape_tuple(shape)
+        p = _arr(self.probs_param)
+        logits = jnp.log(p / jnp.sum(p, -1, keepdims=True))
+        k = p.shape[-1]
+        draws = jax.random.categorical(
+            self._key(), logits, axis=-1,
+            shape=(self.total_count,) + sample_shape + tuple(p.shape[:-1]))
+        counts = jnp.sum(jax.nn.one_hot(draws, k, dtype=jnp.float32), axis=0)
+        return Tensor(counts)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(p, v):
+            pn = p / jnp.sum(p, -1, keepdims=True)
+            return (jax.lax.lgamma(jnp.asarray(self.total_count + 1.0))
+                    - jnp.sum(jax.lax.lgamma(v + 1.0), -1)
+                    + jnp.sum(v * jnp.log(pn), -1))
+        return apply(fn, self.probs_param, _param(value),
+                     op_name="multinomial_log_prob")
+
+
+class MultivariateNormal(Distribution):
+    """reference ``python/paddle/distribution/multivariate_normal.py``."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _param(loc)
+        given = [a is not None for a in
+                 (covariance_matrix, precision_matrix, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError("pass exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self.scale_tril = _param(scale_tril)
+        elif covariance_matrix is not None:
+            cov = _param(covariance_matrix)
+            self.scale_tril = apply(jnp.linalg.cholesky, cov,
+                                    op_name="mvn_chol")
+        else:
+            prec = _param(precision_matrix)
+
+            def fn(pm):
+                c = jnp.linalg.cholesky(jnp.linalg.inv(pm))
+                return c
+            self.scale_tril = apply(fn, prec, op_name="mvn_chol_prec")
+        d = tuple(_arr(self.loc).shape)[-1]
+        batch = tuple(np.broadcast_shapes(
+            tuple(_arr(self.loc).shape)[:-1],
+            tuple(_arr(self.scale_tril).shape)[:-2]))
+        self._dim = d
+        super().__init__(batch, (d,))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(_arr(self.loc),
+                                      self.batch_shape + self.event_shape))
+
+    @property
+    def variance(self):
+        def fn(st):
+            return jnp.broadcast_to(jnp.sum(st * st, -1),
+                                    self.batch_shape + self.event_shape)
+        return apply(fn, self.scale_tril, op_name="mvn_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        eps = jax.random.normal(self._key(), full, jnp.float32)
+
+        def fn(l, st):
+            return l + jnp.einsum("...ij,...j->...i", st, eps)
+        return apply(fn, self.loc, self.scale_tril, op_name="mvn_rsample")
+
+    def log_prob(self, value):
+        def fn(l, st, v):
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(
+                jnp.broadcast_to(st, diff.shape[:-1] + st.shape[-2:]),
+                diff[..., None], lower=True)[..., 0]
+            m = jnp.sum(sol ** 2, -1)
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(st, axis1=-2, axis2=-1)), -1)
+            return (-0.5 * m - half_logdet
+                    - self._dim * _HALF_LOG_2PI)
+        return apply(fn, self.loc, self.scale_tril, _param(value),
+                     op_name="mvn_log_prob")
+
+    def entropy(self):
+        def fn(st):
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(st, axis1=-2, axis2=-1)), -1)
+            return jnp.broadcast_to(
+                0.5 * self._dim * (1.0 + 2.0 * _HALF_LOG_2PI) + half_logdet,
+                self.batch_shape)
+        return apply(fn, self.scale_tril, op_name="mvn_entropy")
+
+
+class Poisson(ExponentialFamily):
+    """reference ``python/paddle/distribution/poisson.py``."""
+
+    _ENTROPY_TERMS = 128   # static series cutoff (accurate for rate < ~60)
+
+    def __init__(self, rate, name=None):
+        self.rate = _param(rate)
+        super().__init__(_bshape(self.rate))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(_arr(self.rate), self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(_arr(self.rate), self.batch_shape))
+
+    def sample(self, shape=()):
+        full = self._extend_shape(shape)
+        lam = jnp.broadcast_to(_arr(self.rate), full)
+        out = jax.random.poisson(self._key(), lam)
+        return Tensor(out.astype(jnp.float32))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        def fn(r, v):
+            return v * jnp.log(r) - r - jax.lax.lgamma(v + 1.0)
+        return apply(fn, self.rate, _param(value), op_name="poisson_log_prob")
+
+    def entropy(self):
+        def fn(r):
+            k = jnp.arange(self._ENTROPY_TERMS, dtype=jnp.float32)
+            shape = r.shape + (1,)
+            rr = r.reshape(shape)
+            logpmf = (k * jnp.log(rr) - rr - jax.lax.lgamma(k + 1.0))
+            return -jnp.sum(jnp.exp(logpmf) * logpmf, -1)
+        return apply(fn, self.rate, op_name="poisson_entropy")
+
+
+class Binomial(Distribution):
+    """reference ``python/paddle/distribution/binomial.py``."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_param = _param(probs)
+        super().__init__(_bshape(self.probs_param))
+
+    @property
+    def mean(self):
+        return apply(lambda p: self.total_count * p, self.probs_param,
+                     op_name="binomial_mean")
+
+    @property
+    def variance(self):
+        return apply(lambda p: self.total_count * p * (1 - p),
+                     self.probs_param, op_name="binomial_var")
+
+    def sample(self, shape=()):
+        full = self._extend_shape(shape)
+        p = jnp.broadcast_to(_arr(self.probs_param), full)
+        draws = jax.random.bernoulli(
+            self._key(), p[None], (self.total_count,) + full)
+        return Tensor(jnp.sum(draws.astype(jnp.float32), axis=0))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def fn(p, v):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            logc = (jax.lax.lgamma(jnp.asarray(n + 1.0))
+                    - jax.lax.lgamma(v + 1.0) - jax.lax.lgamma(n - v + 1.0))
+            return logc + v * jnp.log(pc) + (n - v) * jnp.log1p(-pc)
+        return apply(fn, self.probs_param, _param(value),
+                     op_name="binomial_log_prob")
+
+
+class Cauchy(Distribution):
+    """reference ``python/paddle/distribution/cauchy.py`` (undefined
+    mean/variance, matching upstream which raises)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(), full, jnp.float32,
+                               minval=1e-6, maxval=1 - 1e-6)
+        return apply(lambda l, s: l + s * jnp.tan(math.pi * (u - 0.5)),
+                     self.loc, self.scale, op_name="cauchy_rsample")
+
+    def log_prob(self, value):
+        def fn(l, s, v):
+            z = (v - l) / s
+            return -jnp.log(math.pi * s * (1 + z ** 2))
+        return apply(fn, self.loc, self.scale, _param(value),
+                     op_name="cauchy_log_prob")
+
+    def entropy(self):
+        return apply(lambda l, s: jnp.log(4 * math.pi * s)
+                     + jnp.zeros_like(l),
+                     self.loc, self.scale, op_name="cauchy_entropy")
+
+
+class StudentT(Distribution):
+    """reference ``python/paddle/distribution/student_t.py`` (df, loc,
+    scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _param(df)
+        self.loc = _param(loc)
+        self.scale = _param(scale)
+        super().__init__(_bshape(self.df, self.loc, self.scale))
+
+    @property
+    def mean(self):
+        def fn(df, l):
+            return jnp.where(df > 1, jnp.broadcast_to(l, _bshape(df, l)),
+                             jnp.nan)
+        return apply(fn, self.df, self.loc, op_name="studentt_mean")
+
+    @property
+    def variance(self):
+        def fn(df, s):
+            v = s ** 2 * df / (df - 2)
+            return jnp.where(df > 2, v,
+                             jnp.where(df > 1, jnp.inf, jnp.nan))
+        return apply(fn, self.df, self.scale, op_name="studentt_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        k1, k2 = jax.random.split(self._key())
+        eps = jax.random.normal(k1, full, jnp.float32)
+
+        def fn(df, l, s):
+            g = jax.random.gamma(k2, jnp.broadcast_to(df / 2.0, full))
+            chi2 = 2.0 * g
+            t = eps * jnp.sqrt(df / chi2)
+            return l + s * t
+        return apply(fn, self.df, self.loc, self.scale,
+                     op_name="studentt_rsample")
+
+    def log_prob(self, value):
+        def fn(df, l, s, v):
+            z = (v - l) / s
+            return (jax.lax.lgamma((df + 1) / 2)
+                    - jax.lax.lgamma(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return apply(fn, self.df, self.loc, self.scale, _param(value),
+                     op_name="studentt_log_prob")
+
+    def entropy(self):
+        def fn(df, s):
+            dg = jax.lax.digamma
+            return ((df + 1) / 2 * (dg((df + 1) / 2) - dg(df / 2))
+                    + 0.5 * jnp.log(df)
+                    + jax.lax.lgamma(df / 2)
+                    + jax.lax.lgamma(jnp.asarray(0.5))
+                    - jax.lax.lgamma((df + 1) / 2)
+                    + jnp.log(s))
+        return apply(fn, self.df, self.scale, op_name="studentt_entropy")

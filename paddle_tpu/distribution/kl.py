@@ -1,0 +1,172 @@
+"""KL divergence registry + closed forms (reference:
+``python/paddle/distribution/kl.py`` — ``register_kl`` double-dispatch
+over distribution types)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd.tape import apply
+from .distribution import _arr
+from . import families as F
+
+_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator: register ``fn(p, q) -> Tensor`` for (type(p), type(q));
+    dispatch walks the MRO like the reference."""
+    def deco(fn):
+        _REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    best, depth = None, None
+    for (pc, qc), fn in _REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            d = (type(p).__mro__.index(pc), type(q).__mro__.index(qc))
+            if depth is None or d < depth:
+                best, depth = fn, d
+    if best is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__}); "
+            "use register_kl to add one")
+    return best(p, q)
+
+
+@register_kl(F.Normal, F.Normal)
+def _kl_normal(p, q):
+    def fn(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return apply(fn, p.loc, p.scale, q.loc, q.scale, op_name="kl_normal")
+
+
+@register_kl(F.Uniform, F.Uniform)
+def _kl_uniform(p, q):
+    def fn(pa, pb, qa, qb):
+        out = jnp.log((qb - qa) / (pb - pa))
+        return jnp.where((qa <= pa) & (pb <= qb), out, jnp.inf)
+    return apply(fn, p.low, p.high, q.low, q.high, op_name="kl_uniform")
+
+
+@register_kl(F.Bernoulli, F.Bernoulli)
+def _kl_bernoulli(p, q):
+    def fn(pp, qp):
+        eps = 1e-7
+        pp = jnp.clip(pp, eps, 1 - eps)
+        qp = jnp.clip(qp, eps, 1 - eps)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+    return apply(fn, p.probs_param, q.probs_param, op_name="kl_bernoulli")
+
+
+@register_kl(F.Categorical, F.Categorical)
+def _kl_categorical(p, q):
+    def fn(pl, ql):
+        plog = jax.nn.log_softmax(pl, axis=-1)
+        qlog = jax.nn.log_softmax(ql, axis=-1)
+        return jnp.sum(jnp.exp(plog) * (plog - qlog), -1)
+    return apply(fn, p.logits, q.logits, op_name="kl_categorical")
+
+
+@register_kl(F.Beta, F.Beta)
+def _kl_beta(p, q):
+    def fn(pa, pb, qa, qb):
+        lg, dg = jax.lax.lgamma, jax.lax.digamma
+
+        def lbeta(a, b):
+            return lg(a) + lg(b) - lg(a + b)
+        return (lbeta(qa, qb) - lbeta(pa, pb)
+                + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+    return apply(fn, p.alpha, p.beta, q.alpha, q.beta, op_name="kl_beta")
+
+
+@register_kl(F.Gamma, F.Gamma)
+def _kl_gamma(p, q):
+    def fn(pc, pr, qc, qr):
+        lg, dg = jax.lax.lgamma, jax.lax.digamma
+        return ((pc - qc) * dg(pc) - lg(pc) + lg(qc)
+                + qc * (jnp.log(pr) - jnp.log(qr))
+                + pc * (qr - pr) / pr)
+    return apply(fn, p.concentration, p.rate, q.concentration, q.rate,
+                 op_name="kl_gamma")
+
+
+@register_kl(F.Dirichlet, F.Dirichlet)
+def _kl_dirichlet(p, q):
+    def fn(pc, qc):
+        lg, dg = jax.lax.lgamma, jax.lax.digamma
+        p0 = jnp.sum(pc, -1)
+        q0 = jnp.sum(qc, -1)
+        return (lg(p0) - lg(q0)
+                - jnp.sum(lg(pc) - lg(qc), -1)
+                + jnp.sum((pc - qc) * (dg(pc) - dg(p0)[..., None]), -1))
+    return apply(fn, p.concentration, q.concentration, op_name="kl_dirichlet")
+
+
+@register_kl(F.Exponential, F.Exponential)
+def _kl_exponential(p, q):
+    def fn(pr, qr):
+        ratio = qr / pr
+        return ratio - 1 - jnp.log(ratio)
+    return apply(fn, p.rate, q.rate, op_name="kl_exponential")
+
+
+@register_kl(F.Laplace, F.Laplace)
+def _kl_laplace(p, q):
+    def fn(pl, ps, ql, qs):
+        # KL(La(u1,b1)||La(u2,b2)) = log(b2/b1) + |u1-u2|/b2
+        #                            + (b1/b2) exp(-|u1-u2|/b1) - 1
+        adiff = jnp.abs(pl - ql)
+        return (jnp.log(qs / ps) + adiff / qs
+                + (ps / qs) * jnp.exp(-adiff / ps) - 1.0)
+    return apply(fn, p.loc, p.scale, q.loc, q.scale, op_name="kl_laplace")
+
+
+@register_kl(F.Geometric, F.Geometric)
+def _kl_geometric(p, q):
+    def fn(pp, qp):
+        return (-(1 - pp) / pp * (jnp.log1p(-qp) - jnp.log1p(-pp))
+                + jnp.log(pp) - jnp.log(qp))
+    return apply(fn, p.probs_param, q.probs_param, op_name="kl_geometric")
+
+
+@register_kl(F.MultivariateNormal, F.MultivariateNormal)
+def _kl_mvn(p, q):
+    def fn(pl, pst, ql, qst):
+        d = pl.shape[-1]
+        half_logdet_p = jnp.sum(
+            jnp.log(jnp.diagonal(pst, axis1=-2, axis2=-1)), -1)
+        half_logdet_q = jnp.sum(
+            jnp.log(jnp.diagonal(qst, axis1=-2, axis2=-1)), -1)
+        m = jax.scipy.linalg.solve_triangular(qst, pst, lower=True)
+        tr = jnp.sum(m * m, axis=(-2, -1))
+        diff = ql - pl
+        sol = jax.scipy.linalg.solve_triangular(
+            qst, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, -1)
+        return 0.5 * (2 * (half_logdet_q - half_logdet_p) - d + tr + maha)
+    return apply(fn, p.loc, p.scale_tril, q.loc, q.scale_tril,
+                 op_name="kl_mvn")
+
+
+@register_kl(F.LogNormal, F.LogNormal)
+def _kl_lognormal(p, q):
+    # KL is invariant under the shared exp transform -> Normal KL
+    def fn(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return apply(fn, p.loc, p.scale, q.loc, q.scale, op_name="kl_lognormal")
+
+
+@register_kl(F.Poisson, F.Poisson)
+def _kl_poisson(p, q):
+    def fn(pr, qr):
+        return pr * (jnp.log(pr) - jnp.log(qr)) - pr + qr
+    return apply(fn, p.rate, q.rate, op_name="kl_poisson")

@@ -1,0 +1,331 @@
+"""Bijective transforms + TransformedDistribution (reference:
+``python/paddle/distribution/transform.py``,
+``transformed_distribution.py``)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..autograd.tape import apply
+from .distribution import Distribution, _arr, _wrap, _shape_tuple
+
+
+class Transform:
+    """Bijection y = f(x) with log|det J|. ``_event_rank`` is the event
+    rank of the OUTPUT space consumed by one application."""
+
+    _event_rank = 0
+
+    def forward(self, x):
+        return apply(self._forward, x, op_name=type(self).__name__ + "_fwd")
+
+    def inverse(self, y):
+        return apply(self._inverse, y, op_name=type(self).__name__ + "_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return apply(self._log_det, x,
+                     op_name=type(self).__name__ + "_logdet")
+
+    def inverse_log_det_jacobian(self, y):
+        x = self.inverse(y)
+        ld = self.forward_log_det_jacobian(x)
+        return apply(lambda a: -a, ld, op_name="neg_logdet")
+
+    # subclasses implement pure-jnp versions
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _log_det(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = loc
+        self.scale = scale
+
+    def _forward(self, x):
+        return _arr(self.loc) + _arr(self.scale) * x
+
+    def _inverse(self, y):
+        return (y - _arr(self.loc)) / _arr(self.scale)
+
+    def _log_det(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(_arr(self.scale))), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _log_det(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = power
+
+    def _forward(self, x):
+        return jnp.power(x, _arr(self.power))
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / _arr(self.power))
+
+    def _log_det(self, x):
+        p = _arr(self.power)
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1)))
+
+
+class AbsTransform(Transform):
+    """Non-bijective |x| (forward-only, like the reference)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y   # principal branch
+
+    def _log_det(self, x):
+        return jnp.zeros_like(x)
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _log_det(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _log_det(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """exp + normalize over the last axis (not bijective; matches the
+    reference's forward/inverse pair)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _log_det(self, x):
+        raise NotImplementedError("SoftmaxTransform has no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex (reference StickBreakingTransform)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1)
+        one_minus = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), jnp.cumprod(1 - z, -1)], -1)
+        return zpad * one_minus
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros_like(y[..., :1]), ycum[..., :-1]], -1)
+        offset = y.shape[-1] - 1 - jnp.cumsum(
+            jnp.ones_like(y[..., :-1]), -1) + 1
+        z = y[..., :-1] / rest
+        return jnp.log(z / (1 - z)) + jnp.log(offset)
+
+    def _log_det(self, x):
+        offset = x.shape[-1] - jnp.cumsum(jnp.ones_like(x), -1) + 1
+        t = x - jnp.log(offset)
+        z = jax.nn.sigmoid(t)
+        rest = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), jnp.cumprod(1 - z, -1)[..., :-1]], -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rest), -1)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = _shape_tuple(in_event_shape)
+        self.out_event_shape = _shape_tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape or (1,))) != int(
+                np.prod(self.out_event_shape or (1,))):
+            raise ValueError("reshape must preserve the event size")
+        self._event_rank = len(self.out_event_shape)
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _log_det(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead, x.dtype)
+
+
+class IndependentTransform(Transform):
+    """Promote ``reinterpreted_batch_ndims`` batch dims of a base transform
+    to event dims (log-det summed over them)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        self._event_rank = base._event_rank + self.reinterpreted_batch_ndims
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _log_det(self, x):
+        ld = self.base._log_det(x)
+        n = self.reinterpreted_batch_ndims
+        if n == 0:
+            return ld
+        return jnp.sum(ld, axis=tuple(range(ld.ndim - n, ld.ndim)))
+
+
+class StackTransform(Transform):
+    """Apply a list of transforms along slices of ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p.squeeze(self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _log_det(self, x):
+        return self._map("_log_det", x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_rank = max((t._event_rank for t in self.transforms),
+                               default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _log_det(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t._log_det(x)
+            total = ld if total is None else total + ld
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """reference ``python/paddle/distribution/transformed_distribution.py``."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        extra = self._chain._event_rank - len(base.event_shape)
+        if extra > 0:
+            # transform consumes batch dims as event dims
+            shape = base.batch_shape + base.event_shape
+            super().__init__(shape[:len(shape) - self._chain._event_rank],
+                             shape[len(shape) - self._chain._event_rank:])
+        else:
+            super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        x = x.detach()
+        x.stop_gradient = True
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        """base log_prob at the pulled-back value minus the accumulated
+        log-det, with event-rank reduction matching the reference."""
+        y = value
+        event_rank = max(self._chain._event_rank, len(self.base.event_shape))
+        lp = None
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+
+            def reduce_ld(a, rank=event_rank, trank=t._event_rank):
+                n = rank - trank
+                if n > 0:
+                    return jnp.sum(
+                        a, axis=tuple(range(a.ndim - n, a.ndim)))
+                return a
+            ld = apply(lambda xv, tt=t, rl=reduce_ld: rl(tt._log_det(xv)),
+                       x, op_name="td_logdet")
+            lp = ld if lp is None else apply(
+                lambda a, b: a + b, lp, ld, op_name="td_logdet_acc")
+            y = x
+        base_lp = self.base.log_prob(y)
+        # base event rank may be smaller than ours: sum the difference
+        extra = event_rank - len(self.base.event_shape)
+
+        def fin(blp, ldt=None):
+            out = blp
+            if extra > 0:
+                out = jnp.sum(out,
+                              axis=tuple(range(out.ndim - extra, out.ndim)))
+            return out
+        base_red = apply(fin, base_lp, op_name="td_base_red")
+        if lp is None:
+            return base_red
+        return apply(lambda a, b: a - b, base_red, lp,
+                     op_name="td_log_prob")

@@ -64,12 +64,24 @@ class ServingEngine:
             else np.asarray(input_ids)
         req = _Request(ids, max_new_tokens, kwargs)
         self._q.put(req)
-        if not self._running and not req.done.is_set():
-            # raced with stop(): the worker's drain may already be past
-            req.error = RuntimeError("ServingEngine stopped")
-            req.done.set()
-        if not req.done.wait(timeout):
-            raise TimeoutError("generate timed out")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not req.done.is_set():
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise TimeoutError("generate timed out")
+            th = self._thread
+            worker_alive = th is not None and th.is_alive()
+            if not self._running and not worker_alive:
+                # raced with stop() AND the worker (whose exit path fails
+                # every still-queued request) is gone: our request provably
+                # missed the drain — fail it here rather than hang
+                if not req.done.is_set():
+                    req.error = RuntimeError("ServingEngine stopped")
+                    req.done.set()
+                break
+            req.done.wait(0.5 if remaining is None
+                          else min(0.5, remaining))
         if req.error is not None:
             raise req.error
         return Tensor(req.result)
@@ -176,7 +188,7 @@ class ServingEngine:
                 for r in group:
                     n = r.ids.shape[0]
                     res = arr[row:row + n]
-                    if eos is not None:
+                    if eos is not None and arr.shape[1] > prompt_len:
                         # trim co-batch eos padding: a request's output
                         # must not depend on its batch-mates' lengths
                         gen = res[:, prompt_len:]

@@ -450,41 +450,69 @@ class _SingleProcessIter:
         return _to_device(self.loader.collate_fn(samples))
 
 
+def _prefetch_run(wref, inner, q, stop, done):
+    """Producer loop of :class:`_PrefetchIter`. Holds only a weakref to the
+    wrapper so an abandoned iterator (collected without shutdown()) lets
+    this thread notice via the dead ref and exit instead of spinning on a
+    full queue forever."""
+    def owner():
+        return wref()
+
+    err = None
+    try:
+        for item in inner:
+            while not stop.is_set():
+                if owner() is None:
+                    stop.set()
+                    break
+                try:
+                    q.put(item, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if stop.is_set():
+                return
+    except Exception as e:
+        err = e
+    finally:
+        self = owner()
+        if self is not None:
+            if err is not None:
+                self.err = err
+            # best-effort sentinel; _finished is the authoritative end
+            # signal (consumer falls back to it when the queue is full)
+            self._finished = True
+        try:
+            q.put_nowait(done)
+        except queue.Full:
+            pass
+        if stop.is_set() or self is None:
+            close = getattr(inner, "close", None) or \
+                getattr(inner, "shutdown", None)
+            if close:
+                try:
+                    close()
+                except Exception:
+                    pass
+
+
 class _PrefetchIter:
     """Depth-k device prefetch wrapper (buffered_reader analogue)."""
 
     def __init__(self, inner, depth=2):
+        import weakref
         self.inner = inner
         self.depth = depth
         self.q = queue.Queue(maxsize=depth)
         self.done = object()
         self.err = None
+        self._finished = False
         self._stop = threading.Event()
-        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread = threading.Thread(
+            target=_prefetch_run,
+            args=(weakref.ref(self), inner, self.q, self._stop, self.done),
+            daemon=True)
         self.thread.start()
-
-    def _run(self):
-        try:
-            for item in self.inner:
-                while not self._stop.is_set():
-                    try:
-                        self.q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
-                if self._stop.is_set():
-                    return
-        except Exception as e:
-            self.err = e
-        finally:
-            # deliver the sentinel even when the queue is full (consumer
-            # lagging at epoch end); only a shutdown() may abandon it
-            while not self._stop.is_set():
-                try:
-                    self.q.put(self.done, timeout=0.1)
-                    break
-                except queue.Full:
-                    continue
 
     def shutdown(self):
         """Unblock and retire the prefetch thread (mid-epoch break path:
@@ -509,7 +537,20 @@ class _PrefetchIter:
         return self
 
     def __next__(self):
-        item = self.q.get()
+        while True:
+            try:
+                item = self.q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._finished or not self.thread.is_alive():
+                    # producer exited — but it may have put final batches
+                    # (and/or the sentinel) AFTER our get() timed out:
+                    # drain once more before concluding the epoch is over
+                    try:
+                        item = self.q.get_nowait()
+                    except queue.Empty:
+                        item = self.done   # truly drained; sentinel may
+                    break                  # have been dropped when full
         if item is self.done:
             if self.err:
                 raise self.err
@@ -568,13 +609,29 @@ class DataLoader:
 
     load_state_dict = set_state_dict
 
+    _active_inner_ref = None
+
+    @property
+    def _active_inner(self):
+        """Live inner iterator of the current epoch (or None) — transport
+        introspection; weakly held so it can't outlive its consumer."""
+        return (self._active_inner_ref()
+                if self._active_inner_ref is not None else None)
+
     def __iter__(self):
         # the loader's consumed base is whatever skip the sampler has
         # armed, read BEFORE the inner iterator (and its prefetch thread)
         # can consume it — keeps the two in sync even if this iterator is
         # later abandoned without a single next()
         base = getattr(self.batch_sampler, "_resume_from", 0)
+        # NB: a previous epoch's live iterator is NOT retired here —
+        # nested/concurrent iteration over one loader must keep working;
+        # abandoned iterators are reclaimed by _prefetch_run's weakref
         inner_it = self._inner_iter()
+        # weakref: the loader must not keep an abandoned iterator (and its
+        # prefetch thread / worker pool) alive — introspection only
+        import weakref
+        self._active_inner_ref = weakref.ref(inner_it)
         self._yielded = base
 
         def counted():

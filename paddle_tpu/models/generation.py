@@ -64,11 +64,13 @@ class PagedKVCache(KVCache):
     cache (reference: ``block_multihead_attention``'s vLLM-style paged KV;
     VERDICT.md round-1 item 10).
 
-    K/V live in fixed-size pages ``[num_pages, page_size, kv_heads, d]``
-    per attention layer; a shared per-sequence block table maps positions
-    to pages. Prefill scatters the prompt's K/V into pages and attends
-    densely; each decode step writes one slot and runs the Pallas
-    ``paged_attention`` kernel (ops/pallas/paged_attention.py)."""
+    K/V live in fixed-size pages ``[kv_heads, num_pages, page_size, d]``
+    (kv-head-major: each (head, page) block is one contiguous aligned
+    slab, the layout the TPU decode kernel DMAs) per attention layer; a
+    shared per-sequence block table maps positions to pages. Prefill
+    scatters the prompt's K/V into pages and attends densely; each decode
+    step writes one slot and runs the ``paged_attention`` kernel
+    (ops/pallas/paged_attention.py)."""
 
     def __init__(self, page_size=16, max_len=2048):
         super().__init__()
@@ -100,7 +102,7 @@ class PagedKVCache(KVCache):
         key = id(layer)
         if key not in self._pools:
             n = batch * self.pages_per_seq
-            shape = (n, self.page_size, kv_heads, d)
+            shape = (kv_heads, n, self.page_size, d)
             self._pools[key] = (jnp.zeros(shape, dtype),
                                 jnp.zeros(shape, dtype))
         return self._pools[key]
@@ -144,8 +146,11 @@ class PagedKVCache(KVCache):
         page_ids, slot_ids, tables, ctx = self._step_indices(start, s, b)
 
         def scatter(kp, vp, ka, va):
-            kp = kp.at[page_ids, slot_ids].set(ka)
-            vp = vp.at[page_ids, slot_ids].set(va)
+            # pools are [kv, page, slot, d]; ka/va arrive [b, s, kv, d]
+            kt = jnp.moveaxis(ka, 2, 0)            # [kv, b, s, d]
+            vt = jnp.moveaxis(va, 2, 0)
+            kp = kp.at[:, page_ids, slot_ids].set(kt)
+            vp = vp.at[:, page_ids, slot_ids].set(vt)
             return kp, vp
 
         new_kp, new_vp = scatter(k_pages, v_pages,
@@ -159,10 +164,12 @@ class PagedKVCache(KVCache):
             # sdpa's bottom-right causal alignment handles sq != sk
             if start > 0:
                 n_pages = -(-(start + s) // self.page_size)
-                kf = Tensor(new_kp[jnp.asarray(self._tables[:, :n_pages])]
+                tb = jnp.asarray(self._tables[:, :n_pages])
+                # [kv, b, pages, slot, d] -> [b, seq, kv, d]
+                kf = Tensor(jnp.moveaxis(new_kp[:, tb], 0, 3)
                             .reshape(b, n_pages * self.page_size, kv_heads,
                                      d)[:, :start + s])
-                vf = Tensor(new_vp[jnp.asarray(self._tables[:, :n_pages])]
+                vf = Tensor(jnp.moveaxis(new_vp[:, tb], 0, 3)
                             .reshape(b, n_pages * self.page_size, kv_heads,
                                      d)[:, :start + s])
             else:

@@ -238,3 +238,9 @@ def vander(x, n=None, increasing=False, name=None):
         out = jnp.vander(a, cols, increasing=increasing)
         return out
     return apply(fn, x, op_name="vander")
+
+
+def complex(real, imag, name=None):
+    """paddle.complex — build a complex tensor from real/imag parts."""
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag,
+                 op_name="complex")

@@ -213,3 +213,32 @@ def ormqr(x, tau, y, left=True, transpose=False):
 
     return jax.lax.fori_loop(0, k, body, y.astype(jnp.promote_types(x.dtype,
                                                                     y.dtype)))
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """paddle.linalg.lu_unpack — (P, L, U) from lu()'s packed output.
+
+    ``x`` is the packed LU factor, ``y`` the pivot vector from
+    :func:`lu` (LAPACK getrf convention: row i swapped with y[i])."""
+    def fn(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots -> permutation matrix: apply row swaps to identity
+        def perm_of(pv):
+            perm = jnp.arange(m)
+            def body(i, p):
+                j = pv[i].astype(jnp.int32)
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+            return jax.lax.fori_loop(0, pv.shape[0], body, perm)
+        if piv.ndim == 1:
+            perm = perm_of(piv)
+        else:
+            perm = jax.vmap(perm_of)(piv.reshape(-1, piv.shape[-1])
+                                     ).reshape(*piv.shape[:-1], m)
+        P = jax.nn.one_hot(perm, m, dtype=lu_.dtype)
+        P = jnp.swapaxes(P, -1, -2)
+        return P, L, U
+    return apply(fn, x, y, op_name="lu_unpack")

@@ -537,3 +537,34 @@ def index_fill(x, index, axis, value):
     indexer[axis % x.ndim] = index
     v = value._data if hasattr(value, "_data") else value
     return x.at[tuple(indexer)].set(jnp.asarray(v, x.dtype))
+
+
+@defop
+def unfold(x, axis, size, step):
+    """Tensor.unfold — sliding windows of ``size`` every ``step`` along
+    ``axis``; window becomes a trailing dim (reference
+    ``python/paddle/tensor/manipulation.py`` unfold)."""
+    ax = int(axis) % x.ndim
+    n = (x.shape[ax] - size) // step + 1
+    starts = jnp.arange(n) * step
+    win = jnp.arange(size)
+    idx = starts[:, None] + win[None, :]          # [n, size]
+    out = jnp.take(x, idx.reshape(-1), axis=ax)
+    shp = list(x.shape[:ax]) + [n, size] + list(x.shape[ax + 1:])
+    out = out.reshape(shp)
+    # move the window dim to the end
+    return jnp.moveaxis(out, ax + 1, -1)
+
+
+def rank(x):
+    """paddle.rank — 0-D int32 tensor holding ndim."""
+    from ..framework.core import Tensor
+    nd = x.ndim if hasattr(x, "ndim") else jnp.asarray(x).ndim
+    return Tensor(jnp.asarray(nd, jnp.int32))
+
+
+def shape(x):
+    """paddle.shape — 1-D int32 tensor of the (static) shape."""
+    from ..framework.core import Tensor
+    shp = x.shape if hasattr(x, "shape") else jnp.asarray(x).shape
+    return Tensor(jnp.asarray(shp, jnp.int32))

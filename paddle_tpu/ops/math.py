@@ -230,6 +230,54 @@ def trapezoid(y, x=None, dx=None, axis=-1):
     return jnp.trapezoid(y, x=x, dx=1.0 if dx is None and x is None else dx, axis=axis)
 
 
+@defop
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    ax = int(axis) % y.ndim
+    n = y.shape[ax]
+    lo = jax.lax.slice_in_dim(y, 0, n - 1, axis=ax)
+    hi = jax.lax.slice_in_dim(y, 1, n, axis=ax)
+    if x is not None:
+        xa = jnp.asarray(x)
+        if xa.ndim == 1:
+            shape = [1] * y.ndim
+            shape[ax] = n
+            xa = xa.reshape(shape)
+        d = (jax.lax.slice_in_dim(xa, 1, n, axis=ax)
+             - jax.lax.slice_in_dim(xa, 0, n - 1, axis=ax))
+    else:
+        d = 1.0 if dx is None else dx
+    return jnp.cumsum((lo + hi) * 0.5 * d, axis=ax)
+
+
+@defop
+def sgn(x):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, jnp.zeros_like(x), x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+@defop
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    # x: [*, P, M], y: [*, R, M] -> [*, P, R]
+    if p == 2.0 and compute_mode != "donot_use_mm_for_euclid_dist":
+        # MXU-friendly: |x-y|^2 = |x|^2 + |y|^2 - 2 x.y; zero distances
+        # are masked out of the sqrt so the gradient is a 0 subgradient
+        # there (cdist(x, x) diagonal) instead of inf*0 = NaN
+        x2 = jnp.sum(x * x, axis=-1)[..., :, None]
+        y2 = jnp.sum(y * y, axis=-1)[..., None, :]
+        xy = jnp.matmul(x, jnp.swapaxes(y, -1, -2))
+        d2 = jnp.maximum(x2 + y2 - 2.0 * xy, 0.0)
+        safe = jnp.where(d2 == 0.0, 1.0, d2)
+        return jnp.where(d2 == 0.0, 0.0, jnp.sqrt(safe))
+    diff_ = x[..., :, None, :] - y[..., None, :, :]
+    if p == 0:
+        return jnp.sum((diff_ != 0).astype(x.dtype), axis=-1)
+    if jnp.isinf(p):
+        return jnp.max(jnp.abs(diff_), axis=-1)
+    return jnp.sum(jnp.abs(diff_) ** p, axis=-1) ** (1.0 / p)
+
+
 # ---------------------------------------------------------------------------
 # reductions
 # ---------------------------------------------------------------------------

@@ -3,20 +3,29 @@
 ``fused_multi_transformer``'s paged KV cache; SURVEY.md §2.2 "Incubate"
 serving block, VERDICT.md round-1 item 10).
 
-TPU-native design: the KV cache lives in HBM as fixed-size pages
-``[num_pages, page_size, kv_heads, head_dim]``; a per-sequence block table
-maps logical context positions to pages (vLLM layout). One decode step
-attends ONE query token per sequence over its paged context:
+TPU-native design: the KV cache lives in HBM as fixed-size pages in
+**kv-head-major** layout ``[kv_heads, num_pages, page_size, head_dim]`` —
+each (head, page) block is a contiguous, tile-aligned ``[page_size, d]``
+slab, so a page fetch is one aligned HBM→VMEM DMA and every in-kernel dot
+is a plain 2-D MXU matmul (no batched dot_general, which Mosaic lowers
+poorly). A per-sequence block table maps logical context positions to
+pages (vLLM layout). One decode step attends ONE query token per sequence
+over its paged context.
 
-* grid ``(batch, pages_per_seq)`` — the page axis is the sequential minor
-  dimension, accumulated with online softmax in VMEM scratch (the same
-  streaming-softmax recurrence as the flash kernel);
-* the page to fetch is data-dependent: ``block_tables`` rides in SMEM as a
-  scalar-prefetch operand and the K/V BlockSpec ``index_map`` reads it to
-  steer each page's HBM→VMEM DMA (Pallas' dynamic-block addressing — the
-  TPU analogue of the CUDA kernel's pointer chasing);
-* GQA: queries grouped ``[kv_heads, group, d]`` against the page's
-  ``[page_size, kv_heads, d]`` — one MXU dot per page, no K/V repeats.
+Two tiers, mirroring how the reference wires the vendored FA2 library as
+a phi kernel (SURVEY.md §2.1 "Flash-attention integration"):
+
+* on real TPU the call delegates to
+  ``jax.experimental.pallas.ops.tpu.paged_attention`` — the
+  production-hardened Mosaic kernel (manual double-buffered page DMA,
+  megacore support). Delegation is deliberate: round 2 demonstrated that
+  a from-scratch Mosaic decode kernel can wedge the single TPU tunnel
+  (remote-compile hang with no error propagation), which is unacceptable
+  for a serving path.
+* everywhere else (CPU tests, interpret mode) runs the in-repo kernel
+  below: grid ``(batch, kv_head, pages)``, block-table-steered dynamic
+  BlockSpec index maps (scalar prefetch in SMEM), online-softmax scratch
+  accumulation — the same streaming recurrence as the flash kernel.
 
 Unused block-table entries MUST be 0 (a valid page): their scores are
 masked by ``context_lens`` but the DMA address must be in range.
@@ -38,7 +47,7 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, sm_scale, page_size,
                    pages_per_seq, group):
     b = pl.program_id(0)
-    p = pl.program_id(1)
+    p = pl.program_id(2)
 
     @pl.when(p == 0)
     def _init():
@@ -47,28 +56,24 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     ctx = lens_ref[b]
-    q = q_ref[0].astype(jnp.float32)               # [heads, d]
-    k = k_ref[0].astype(jnp.float32)               # [page_size, kv, d]
-    v = v_ref[0].astype(jnp.float32)
-    kv_heads = k.shape[1]
-    heads, d = q.shape
-    qg = q.reshape(kv_heads, group, d)
-    # s[kv, g, ps] = qg[kv, g, :] . k[ps, kv, :]
+    q = q_ref[0, 0].astype(jnp.float32)            # [group, d]
+    k = k_ref[0, 0].astype(jnp.float32)            # [page_size, d]
+    v = v_ref[0, 0].astype(jnp.float32)
+    # s[g, ps] — one plain 2-D MXU dot
     s = jax.lax.dot_general(
-        qg, k, (((2,), (2,)), ((0,), (1,))),
+        q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * sm_scale
-    pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     s = jnp.where(pos < ctx, s, NEG_INF)
 
-    m_prev = m_ref[...][:, :, :1]                  # [kv, g, 1]
+    m_prev = m_ref[...][:, :1]                     # [g, 1]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     w = jnp.exp(s - m_new)                         # masked -> 0
     corr = jnp.exp(m_prev - m_new)
-    l_new = l_ref[...][:, :, :1] * corr + jnp.sum(w, -1, keepdims=True)
-    # acc[kv, g, d] += w[kv, g, ps] . v[ps, kv, d]
-    pv = jax.lax.dot_general(
-        w, v, (((2,), (0,)), ((0,), (1,))),
+    l_new = l_ref[...][:, :1] * corr + jnp.sum(w, -1, keepdims=True)
+    pv = jax.lax.dot_general(                      # [g, d]
+        w, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     acc_ref[...] = acc_ref[...] * corr + pv
     m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -76,8 +81,50 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(p == pages_per_seq - 1)
     def _finalize():
-        l = jnp.maximum(l_ref[...][:, :, :1], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).reshape(heads, d).astype(o_ref.dtype)
+        l = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, block_tables, context_lens,
+                            *, sm_scale, interpret):
+    batch, heads, d = q.shape
+    kv_heads, _, page_size, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    group = heads // kv_heads
+    qg = q.reshape(batch, kv_heads, group, d)
+
+    kernel = functools.partial(
+        _decode_kernel, sm_scale=sm_scale, page_size=page_size,
+        pages_per_seq=pages_per_seq, group=group)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(batch, kv_heads, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d),
+                         lambda b, h, p, tbl, ln: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b, h, p, tbl, ln: (h, tbl[b, p], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda b, h, p, tbl, ln: (h, tbl[b, p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda b, h, p, tbl, ln: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((batch, kv_heads, group, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(context_lens, jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(batch, heads, d)
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
@@ -85,67 +132,48 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
     """One-token decode attention over a paged KV cache.
 
     q              [batch, heads, head_dim]
-    k_pages/v_pages [num_pages, page_size, kv_heads, head_dim]
+    k_pages/v_pages [kv_heads, num_pages, page_size, head_dim]
     block_tables   [batch, pages_per_seq] int32 (unused entries = 0)
     context_lens   [batch] int32 — tokens already in context (incl. this one)
     -> [batch, heads, head_dim]
     """
     batch, heads, d = q.shape
-    _, page_size, kv_heads, _ = k_pages.shape
-    pages_per_seq = block_tables.shape[1]
-    group = heads // kv_heads
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-
-    kernel = functools.partial(
-        _decode_kernel, sm_scale=sm_scale, page_size=page_size,
-        pages_per_seq=pages_per_seq, group=group)
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(batch, pages_per_seq),
-        in_specs=[
-            pl.BlockSpec((1, heads, d), lambda b, p, tbl, ln: (b, 0, 0)),
-            pl.BlockSpec((1, page_size, kv_heads, d),
-                         lambda b, p, tbl, ln: (tbl[b, p], 0, 0, 0)),
-            pl.BlockSpec((1, page_size, kv_heads, d),
-                         lambda b, p, tbl, ln: (tbl[b, p], 0, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, heads, d), lambda b, p, tbl, ln: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((kv_heads, group, 128), jnp.float32),
-            pltpu.VMEM((kv_heads, group, 128), jnp.float32),
-            pltpu.VMEM((kv_heads, group, d), jnp.float32),
-        ],
-    )
-    return pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((batch, heads, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
-        interpret=interpret,
-    )(jnp.asarray(block_tables, jnp.int32),
-      jnp.asarray(context_lens, jnp.int32), q, k_pages, v_pages)
+    if not interpret and jax.default_backend() == "tpu":
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _jax_paged)
+        pages_per_seq = block_tables.shape[1]
+        ppcb = next(n for n in (8, 4, 2, 1) if pages_per_seq % n == 0)
+        # the production kernel applies no softmax scale: fold into q
+        return _jax_paged(
+            (q * sm_scale).astype(q.dtype), k_pages, v_pages,
+            jnp.asarray(context_lens, jnp.int32),
+            jnp.asarray(block_tables, jnp.int32),
+            pages_per_compute_block=ppcb)
+    return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                   context_lens, sm_scale=sm_scale,
+                                   interpret=interpret)
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
                               context_lens):
-    """Dense numpy-style oracle for tests."""
+    """Dense numpy-style oracle for tests (kv-major page layout)."""
     batch, heads, d = q.shape
-    _, page_size, kv_heads, _ = k_pages.shape
+    kv_heads, _, page_size, _ = k_pages.shape
     group = heads // kv_heads
     outs = []
     for b in range(batch):
         ctx = int(context_lens[b])
         n_pages = -(-ctx // page_size)
-        ks = jnp.concatenate([k_pages[int(block_tables[b, p])]
-                              for p in range(n_pages)], axis=0)[:ctx]
-        vs = jnp.concatenate([v_pages[int(block_tables[b, p])]
-                              for p in range(n_pages)], axis=0)[:ctx]
+        ks = jnp.concatenate([k_pages[:, int(block_tables[b, p])]
+                              for p in range(n_pages)], axis=1)[:, :ctx]
+        vs = jnp.concatenate([v_pages[:, int(block_tables[b, p])]
+                              for p in range(n_pages)], axis=1)[:, :ctx]
         qb = q[b].reshape(kv_heads, group, d).astype(jnp.float32)
-        s = jnp.einsum("kgd,skd->kgs", qb, ks.astype(jnp.float32))
+        s = jnp.einsum("kgd,ksd->kgs", qb, ks.astype(jnp.float32))
         s = s / math.sqrt(d)
         w = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("kgs,skd->kgd", w, vs.astype(jnp.float32))
+        o = jnp.einsum("kgs,ksd->kgd", w, vs.astype(jnp.float32))
         outs.append(o.reshape(heads, d))
     return jnp.stack(outs).astype(q.dtype)

@@ -19,6 +19,24 @@ PEAK_FLOPS = {
 }
 
 
+def chip_kind(device=None):
+    """Map a jax device to a PEAK_FLOPS key (e.g. 'TPU v5 lite' -> 'v5e')."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "") or ""
+    k = kind.lower()
+    if "v5 lite" in k or "v5e" in k or "v5litepod" in k:
+        return "v5e"
+    if "v5p" in k or "v5" in k:
+        return "v5p"
+    if "v6" in k:
+        return "v6e"
+    if "v4" in k:
+        return "v4"
+    return "cpu" if device.platform == "cpu" else "v5p"
+
+
 def transformer_train_flops(num_params, tokens, num_layers=None,
                             hidden_size=None, seq_len=None, causal=True):
     """6·N·tokens (fwd 2N + bwd 4N) + attention 12·L·h·s²·b term."""

@@ -72,8 +72,10 @@ def test_dataloader_shm_transport_matches_single_process():
     loader = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False,
                         use_shared_memory=True)
     it = iter(loader)
-    # confirm the native transport is actually in use
-    inner = it.inner if hasattr(it, "inner") else it
+    # confirm the native transport is actually in use (the loader exposes
+    # its live inner iterator; unwrap the prefetch wrapper if present)
+    inner = loader._active_inner
+    inner = getattr(inner, "inner", inner)
     assert inner._shm is not None
     got = [(x.numpy(), y.numpy()) for x, y in it]
     assert len(got) == len(ref)

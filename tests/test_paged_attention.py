@@ -19,8 +19,8 @@ def _setup(batch=3, heads=8, kv_heads=4, d=64, page_size=8, pages_per_seq=4,
     rng = np.random.RandomState(seed)
     n_pages = batch * pages_per_seq
     q = jnp.asarray(rng.randn(batch, heads, d), jnp.float32)
-    kp = jnp.asarray(rng.randn(n_pages, page_size, kv_heads, d), jnp.float32)
-    vp = jnp.asarray(rng.randn(n_pages, page_size, kv_heads, d), jnp.float32)
+    kp = jnp.asarray(rng.randn(kv_heads, n_pages, page_size, d), jnp.float32)
+    vp = jnp.asarray(rng.randn(kv_heads, n_pages, page_size, d), jnp.float32)
     tables = (np.arange(batch)[:, None] * pages_per_seq
               + np.arange(pages_per_seq)[None, :]).astype(np.int32)
     ctx = np.asarray(lens, np.int32)
