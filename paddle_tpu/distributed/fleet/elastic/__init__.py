@@ -71,8 +71,14 @@ def _make_store(server):
         raise ValueError("elastic needs a server (file:///shared/dir)")
     if server.startswith("file://"):
         return FileKVStore(server[len("file://"):])
+    if server.startswith("tcp://") or server.startswith("etcd://"):
+        # etcd:// accepted for reference CLI compat; served by the in-repo
+        # C++ TCPStore (distributed/native/tcp_store.cpp)
+        from .tcp_kv import TcpKVStore
+        return TcpKVStore("tcp://" + server.split("://", 1)[1])
     raise NotImplementedError(f"elastic store scheme not supported: {server} "
-                              "(TPU build supports file:// shared storage)")
+                              "(TPU build: file:// shared dir or tcp:// "
+                              "in-repo TCPStore)")
 
 
 class ElasticManager:

@@ -1,0 +1,74 @@
+"""TCP-backed elastic KV store — the native-TCPStore tier of the elastic
+membership layer (reference: the etcd server behind
+``python/paddle/distributed/fleet/elastic/manager.py``; here etcd's role
+is played by the in-repo C++ TCPStore, ``distributed/native/tcp_store.cpp``).
+
+Values carry a wall-clock timestamp (like FileKVStore) so the manager's
+TTL heartbeat logic is store-agnostic."""
+from __future__ import annotations
+
+import json
+import time
+
+
+class TcpKVStore:
+    """FileKVStore-interface adapter over ``distributed.native.TCPStore``.
+
+    ``spec``: ``tcp://host:port`` — the first manager to bind the port
+    becomes the server (etcd stand-in); everyone else connects as client.
+    """
+
+    def __init__(self, spec):
+        import socket
+        from ...native import TCPStore
+        hostport = spec[len("tcp://"):]
+        host, _, port = hostport.partition(":")
+        host = host or "127.0.0.1"
+        port = int(port or 0)
+        # only a node the spec actually names may serve (binding the port
+        # on an unrelated machine would create a phantom empty store)
+        local_names = {"127.0.0.1", "localhost", "0.0.0.0",
+                       socket.gethostname()}
+        try:
+            local_names.add(socket.gethostbyname(socket.gethostname()))
+        except OSError:
+            pass
+        self._store = None
+        if host in local_names:
+            try:
+                self._store = TCPStore(host="127.0.0.1", port=port,
+                                       is_master=True)
+            except RuntimeError:
+                pass             # port taken: a peer manager is serving
+        if self._store is None:
+            self._store = TCPStore(host=host, port=port, is_master=False)
+
+    def put(self, key, value):
+        self._store.set(key, json.dumps({"value": value,
+                                         "ts": time.time()}))
+
+    def get(self, key):
+        try:
+            raw = self._store.get(key, wait=False)
+        except KeyError:
+            return None
+        try:
+            return json.loads(raw.decode())["value"]
+        except ValueError:
+            return None
+
+    def delete(self, key):
+        self._store.delete_key(key)
+
+    def keys(self, prefix=""):
+        return self._store.keys(prefix)
+
+    def age(self, key):
+        try:
+            raw = self._store.get(key, wait=False)
+            return time.time() - json.loads(raw.decode())["ts"]
+        except (KeyError, ValueError):
+            return None
+
+    def close(self):
+        self._store.close()

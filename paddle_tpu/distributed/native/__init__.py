@@ -1,0 +1,198 @@
+"""Native (C++) distributed runtime — builds and wraps tcp_store.cpp.
+
+The reference's rendezvous is a C++ TCP KV store
+(``paddle/fluid/distributed/store/tcp_store.cc``: master-hosted map with
+SET/GET/WAIT/ADD, used for env rendezvous and barriers — SURVEY.md §2.1
+"Collective runtime"). This is the TPU-build equivalent, compiled with g++
+at first use and driven over a ctypes ABI (no pybind11 in the image).
+
+``TCPStore(host, port, is_master, world_size)`` mirrors the reference's
+Python surface: ``set/get/add/wait/delete_key`` + ``barrier()`` built on
+ADD+WAIT. ``available()`` gates callers for toolchain-less machines.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+_LIB = None
+_LIB_ERR = None
+_BUILD_LOCK = threading.Lock()
+
+
+def _build_lib():
+    src = os.path.join(os.path.dirname(__file__), "tcp_store.cpp")
+    build_dir = os.path.join(tempfile.gettempdir(),
+                             f"paddle_tpu_native_{os.getuid()}")
+    os.makedirs(build_dir, exist_ok=True)
+    so = os.path.join(build_dir, "libtcpstore.so")
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src,
+               "-o", so + ".tmp", "-pthread"]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(so + ".tmp", so)
+    return so
+
+
+def _lib():
+    global _LIB, _LIB_ERR
+    if _LIB is not None or _LIB_ERR is not None:
+        return _LIB
+    with _BUILD_LOCK:
+        if _LIB is not None or _LIB_ERR is not None:
+            return _LIB
+        try:
+            lib = ctypes.CDLL(_build_lib())
+        except Exception as e:      # no toolchain: callers fall back
+            _LIB_ERR = e
+            return None
+        lib.ts_server_start.restype = ctypes.c_void_p
+        lib.ts_server_start.argtypes = [ctypes.c_int]
+        lib.ts_server_port.restype = ctypes.c_int
+        lib.ts_server_port.argtypes = [ctypes.c_void_p]
+        lib.ts_server_stop.argtypes = [ctypes.c_void_p]
+        lib.ts_client_connect.restype = ctypes.c_void_p
+        lib.ts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.ts_client_close.argtypes = [ctypes.c_void_p]
+        for name, extra in (("ts_set", [ctypes.c_char_p, ctypes.c_uint32]),
+                            ("ts_get", []),
+                            ("ts_add", [ctypes.c_int64]),
+                            ("ts_wait", [ctypes.c_uint32]),
+                            ("ts_delete", []),
+                            ("ts_list", [])):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int64
+            fn.argtypes = ([ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.c_uint32] + extra)
+        lib.ts_read_buf.restype = ctypes.c_int64
+        lib.ts_read_buf.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_int64]
+        _LIB = lib
+        return _LIB
+
+
+def available():
+    return _lib() is not None
+
+
+class TCPStore:
+    """Reference-compatible TCP rendezvous store.
+
+    The master rank hosts the server in-process; every rank (master
+    included) talks to it through a client connection.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=120):
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError(
+                f"native TCPStore unavailable (g++ build failed: "
+                f"{_LIB_ERR})")
+        self._lib = lib
+        self._server = None
+        self.world_size = int(world_size)
+        self.timeout = float(timeout)
+        if is_master:
+            self._server = lib.ts_server_start(int(port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot listen on {port}")
+            port = lib.ts_server_port(self._server)
+        self.host, self.port = host, int(port)
+        self._client = lib.ts_client_connect(
+            host.encode(), int(port), int(self.timeout * 1000))
+        if not self._client:
+            raise RuntimeError(
+                f"TCPStore: cannot reach master at {host}:{port} within "
+                f"{timeout}s")
+
+    # -- KV surface (reference core.TCPStore methods) ----------------------
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        k = key.encode()
+        st = self._lib.ts_set(self._client, k, len(k), bytes(value),
+                              len(value))
+        if st != 0:
+            raise RuntimeError("TCPStore.set failed (connection lost)")
+
+    def get(self, key, wait=True, timeout=None):
+        k = key.encode()
+        if wait:
+            self.wait(key, timeout)
+        n = self._lib.ts_get(self._client, k, len(k))
+        if n == -1:
+            raise KeyError(key)
+        if n < -1:
+            raise RuntimeError("TCPStore.get failed (connection lost)")
+        buf = ctypes.create_string_buffer(int(n) or 1)
+        got = self._lib.ts_read_buf(self._client, buf, int(n) or 1)
+        return buf.raw[:got]
+
+    _CONN_LOST = -(2 ** 63)    # C++ kConnLost sentinel
+
+    def add(self, key, amount=1):
+        k = key.encode()
+        out = self._lib.ts_add(self._client, k, len(k), int(amount))
+        if out == self._CONN_LOST:
+            raise RuntimeError("TCPStore.add failed (connection lost)")
+        return int(out)
+
+    def wait(self, key, timeout=None):
+        k = key.encode()
+        tmo = int((self.timeout if timeout is None else timeout) * 1000)
+        st = self._lib.ts_wait(self._client, k, len(k), tmo)
+        if st == self._CONN_LOST:
+            raise RuntimeError("TCPStore.wait failed (connection lost)")
+        if st != 0:
+            raise TimeoutError(f"TCPStore.wait({key!r}) timed out")
+
+    def delete_key(self, key):
+        k = key.encode()
+        self._lib.ts_delete(self._client, k, len(k))
+
+    def keys(self, prefix=""):
+        p = prefix.encode()
+        n = self._lib.ts_list(self._client, p, len(p))
+        if n < 0:
+            raise RuntimeError("TCPStore.keys failed")
+        buf = ctypes.create_string_buffer(int(n) or 1)
+        got = self._lib.ts_read_buf(self._client, buf, int(n) or 1)
+        out, i = [], 0
+        raw = buf.raw[:got]
+        while i + 4 <= len(raw):
+            ln = int.from_bytes(raw[i:i + 4], "little")
+            out.append(raw[i + 4:i + 4 + ln].decode())
+            i += 4 + ln
+        return out
+
+    # -- synchronization helpers ------------------------------------------
+    def barrier(self, name="barrier", timeout=None):
+        """All ``world_size`` ranks rendezvous: ADD a shared counter; the
+        last arrival of each ROUND publishes that round's release key
+        everyone WAITs on — reusable for any number of rounds (the count
+        key is monotone; the round index is derived from it)."""
+        n = self.add(f"__{name}/count", 1)
+        rnd = (n - 1) // self.world_size
+        if n == (rnd + 1) * self.world_size:
+            self.set(f"__{name}/release/{rnd}", b"1")
+        self.wait(f"__{name}/release/{rnd}", timeout)
+
+    def close(self):
+        if getattr(self, "_client", None):
+            self._lib.ts_client_close(self._client)
+            self._client = None
+        if getattr(self, "_server", None):
+            self._lib.ts_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,355 @@
+// TCP key-value rendezvous store — the native runtime piece behind
+// paddle_tpu.distributed.TCPStore (reference:
+// paddle/fluid/distributed/store/tcp_store.cc + tcp_utils.cc — SURVEY.md
+// §2.1 "Collective runtime": master-hosted KV with SET/GET/WAIT/ADD used
+// for env rendezvous and barriers).
+//
+// Design: one server thread per listening store, one handler thread per
+// accepted connection (rank count is tens, not thousands); a mutex+condvar
+// protected unordered_map<string,string>; WAIT blocks server-side until the
+// key exists (with client-supplied timeout). ctypes ABI (no pybind11 in
+// the image): plain C functions over opaque handles.
+//
+// Wire protocol (little-endian):
+//   request:  u8 op | u32 klen | key | u32 vlen | val
+//     op: 1=SET 2=GET 3=ADD(val=i64 delta) 4=WAIT(val=u32 timeout_ms)
+//         5=DELETE 6=LIST_KEYS(prefix=key)
+//   response: i64 status | payload
+//     status >=0: payload length (GET/LIST) or new counter value (ADD)
+//     status -1: key missing (GET) / timeout (WAIT)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <climits>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::unordered_map<std::string, std::string> kv;
+  std::mutex mu;
+  std::condition_variable cv;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::vector<int> conn_fds;
+  std::mutex handlers_mu;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool reply(int fd, int64_t status, const std::string& payload = "") {
+  if (!write_full(fd, &status, sizeof(status))) return false;
+  if (!payload.empty() && !write_full(fd, payload.data(), payload.size()))
+    return false;
+  return true;
+}
+
+void handle_conn(Store* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    uint32_t klen, vlen;
+    if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_full(fd, &key[0], klen)) break;
+    if (!read_full(fd, &vlen, 4)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_full(fd, &val[0], vlen)) break;
+
+    bool ok = true;
+    switch (op) {
+      case 1: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->kv[key] = val;
+        }
+        s->cv.notify_all();
+        ok = reply(fd, 0);
+        break;
+      }
+      case 2: {  // GET
+        std::string out;
+        bool found = false;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          auto it = s->kv.find(key);
+          if (it != s->kv.end()) {
+            out = it->second;
+            found = true;
+          }
+        }
+        ok = found ? reply(fd, static_cast<int64_t>(out.size()), out)
+                   : reply(fd, -1);
+        break;
+      }
+      case 3: {  // ADD
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          auto it = s->kv.find(key);
+          if (it != s->kv.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string stored(8, '\0');
+          std::memcpy(&stored[0], &cur, 8);
+          s->kv[key] = stored;
+        }
+        s->cv.notify_all();
+        ok = reply(fd, cur);
+        break;
+      }
+      case 4: {  // WAIT
+        uint32_t timeout_ms = 0;
+        if (val.size() == 4) std::memcpy(&timeout_ms, val.data(), 4);
+        std::unique_lock<std::mutex> lk(s->mu);
+        bool found = s->cv.wait_for(
+            lk, std::chrono::milliseconds(timeout_ms),
+            [&] { return s->stop.load() || s->kv.count(key) > 0; });
+        lk.unlock();
+        ok = reply(fd, (found && !s->stop.load()) ? 0 : -1);
+        break;
+      }
+      case 5: {  // DELETE
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          s->kv.erase(key);
+        }
+        s->cv.notify_all();
+        ok = reply(fd, 0);
+        break;
+      }
+      case 6: {  // LIST_KEYS with prefix
+        std::string out;
+        {
+          std::lock_guard<std::mutex> lk(s->mu);
+          for (auto& it : s->kv) {
+            if (it.first.rfind(key, 0) == 0) {
+              uint32_t n = static_cast<uint32_t>(it.first.size());
+              out.append(reinterpret_cast<char*>(&n), 4);
+              out.append(it.first);
+            }
+          }
+        }
+        ok = reply(fd, static_cast<int64_t>(out.size()), out);
+        break;
+      }
+      default:
+        ok = reply(fd, -2);
+    }
+    if (!ok) break;
+  }
+  ::close(fd);
+}
+
+void accept_loop(Store* s) {
+  for (;;) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(s->listen_fd, reinterpret_cast<sockaddr*>(&peer),
+                      &plen);
+    if (fd < 0) {
+      if (s->stop.load()) return;
+      continue;
+    }
+    std::lock_guard<std::mutex> lk(s->handlers_mu);
+    if (s->stop.load()) {
+      ::close(fd);
+      return;
+    }
+    s->conn_fds.push_back(fd);
+    s->handlers.emplace_back(handle_conn, s, fd);
+  }
+}
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+  std::string buf;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* ts_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new Store();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+int ts_server_port(void* h) { return static_cast<Store*>(h)->port; }
+
+void ts_server_stop(void* h) {
+  auto* s = static_cast<Store*>(h);
+  s->stop.store(true);
+  s->cv.notify_all();           // wake WAIT handlers
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // shut down every live connection so blocked recv()s return, then
+    // JOIN the handlers — deleting the Store under detached threads that
+    // still hold its mutex would be a use-after-free
+    std::lock_guard<std::mutex> lk(s->handlers_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->handlers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+// ---- client ----
+void* ts_client_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                  sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void ts_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+// connection-loss sentinel: cannot collide with ADD counter values in
+// practice (callers would need a counter at INT64_MIN)
+static constexpr int64_t kConnLost = INT64_MIN;
+
+static int64_t request(Client* c, uint8_t op, const char* key, uint32_t klen,
+                       const char* val, uint32_t vlen, int with_payload) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  std::string msg;
+  msg.push_back(static_cast<char>(op));
+  msg.append(reinterpret_cast<char*>(&klen), 4);
+  msg.append(key, klen);
+  msg.append(reinterpret_cast<char*>(&vlen), 4);
+  if (vlen) msg.append(val, vlen);
+  if (!write_full(c->fd, msg.data(), msg.size())) return kConnLost;
+  int64_t status;
+  if (!read_full(c->fd, &status, 8)) return kConnLost;
+  if (with_payload && status > 0) {
+    c->buf.resize(static_cast<size_t>(status));
+    if (!read_full(c->fd, &c->buf[0], c->buf.size())) return kConnLost;
+  } else if (with_payload) {
+    c->buf.clear();
+  }
+  return status;
+}
+
+int64_t ts_set(void* h, const char* key, uint32_t klen, const char* val,
+               uint32_t vlen) {
+  return request(static_cast<Client*>(h), 1, key, klen, val, vlen, 0);
+}
+
+int64_t ts_get(void* h, const char* key, uint32_t klen) {
+  return request(static_cast<Client*>(h), 2, key, klen, nullptr, 0, 1);
+}
+
+int64_t ts_add(void* h, const char* key, uint32_t klen, int64_t delta) {
+  return request(static_cast<Client*>(h), 3, key, klen,
+                 reinterpret_cast<const char*>(&delta), 8, 0);
+}
+
+int64_t ts_wait(void* h, const char* key, uint32_t klen,
+                uint32_t timeout_ms) {
+  return request(static_cast<Client*>(h), 4, key, klen,
+                 reinterpret_cast<const char*>(&timeout_ms), 4, 0);
+}
+
+int64_t ts_delete(void* h, const char* key, uint32_t klen) {
+  return request(static_cast<Client*>(h), 5, key, klen, nullptr, 0, 0);
+}
+
+int64_t ts_list(void* h, const char* prefix, uint32_t plen) {
+  return request(static_cast<Client*>(h), 6, prefix, plen, nullptr, 0, 1);
+}
+
+// copy out the payload of the last GET/LIST on this client
+int64_t ts_read_buf(void* h, char* out, int64_t cap) {
+  auto* c = static_cast<Client*>(h);
+  std::lock_guard<std::mutex> lk(c->mu);
+  int64_t n = static_cast<int64_t>(c->buf.size());
+  if (n > cap) return -n;
+  std::memcpy(out, c->buf.data(), static_cast<size_t>(n));
+  return n;
+}
+
+}  // extern "C"

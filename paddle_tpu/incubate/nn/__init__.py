@@ -7,6 +7,7 @@ same code paths the plain layers use.
 from __future__ import annotations
 
 from . import functional  # noqa: F401
+from .fused_transformer import FusedMultiTransformer  # noqa: F401
 from ...nn.layers.transformer import TransformerEncoderLayer as _TEL
 
 

@@ -432,6 +432,10 @@ class _MultiprocessIter:
             self._shm.close()
             self._shm = None
 
+    # public alias: _PrefetchIter and the abandoned-iterator reclaim path
+    # retire the worker pool through getattr(inner, "shutdown")
+    shutdown = _shutdown
+
     def __del__(self):
         self._shutdown()
 

@@ -248,6 +248,16 @@ class LlamaPretrainingCriterion(Layer):
 class LlamaForCausalLM(GenerationMixin, Layer):
     supports_cache = True
 
+    @classmethod
+    def from_pretrained(cls, model_dir, dtype="float32", **overrides):
+        """Build from a LOCAL HF-format Llama checkpoint directory
+        (config.json + safetensors/bin; PaddleNLP-``from_pretrained``
+        surface, zero-egress — see models/pretrained.py)."""
+        from .pretrained import llama_config_from_hf, load_llama_from_hf
+        cfg = llama_config_from_hf(model_dir, dtype=dtype, **overrides)
+        model = cls(cfg)
+        return load_llama_from_hf(model, model_dir, dtype=dtype)
+
     def __init__(self, config):
         super().__init__()
         self.config = config

@@ -1,0 +1,157 @@
+"""HF-format pretrained checkpoint loading for the LM zoo (reference:
+PaddleNLP's ``from_pretrained`` tier over the model zoo — SURVEY.md §2.4
+notes the zoos are separate repos, so the in-repo equivalent loads the
+interoperable Hugging Face layout: ``config.json`` +
+``model.safetensors`` / ``pytorch_model.bin`` from a LOCAL directory
+(zero-egress build: no hub download; point at a path)).
+
+Weight convention: HF/torch linears are ``[out, in]``; this framework
+follows the reference's ``[in, out]`` — 2-D projection weights are
+transposed on load. Embedding tables ``[vocab, hidden]`` pass through.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+
+def _read_hf_weights(model_dir):
+    """Load all tensors from safetensors shards or pytorch_model.bin."""
+    tensors = {}
+    st_files = sorted(f for f in os.listdir(model_dir)
+                      if f.endswith(".safetensors"))
+    if st_files:
+        from safetensors import safe_open
+        for fname in st_files:
+            with safe_open(os.path.join(model_dir, fname), framework="np") \
+                    as f:
+                for k in f.keys():
+                    tensors[k] = np.asarray(f.get_tensor(k))
+        return tensors
+    bin_files = sorted(f for f in os.listdir(model_dir)
+                       if f.startswith("pytorch_model") and
+                       f.endswith(".bin"))
+    if bin_files:
+        import torch
+        for fname in bin_files:
+            sd = torch.load(os.path.join(model_dir, fname),
+                            map_location="cpu", weights_only=True)
+            for k, v in sd.items():
+                tensors[k] = v.to(torch.float32).numpy()
+        return tensors
+    raise IOError(f"no model.safetensors / pytorch_model*.bin under "
+                  f"{model_dir}")
+
+
+def load_hf_config(model_dir):
+    with open(os.path.join(model_dir, "config.json")) as f:
+        return json.load(f)
+
+
+def _strip_prefix(name, prefixes):
+    for p in prefixes:
+        if name.startswith(p):
+            return name[len(p):]
+    return name
+
+
+def load_llama_from_hf(model, model_dir, dtype="float32"):
+    """Fill a ``LlamaForCausalLM`` from an HF Llama checkpoint dir."""
+    raw = _read_hf_weights(model_dir)
+    own = model.state_dict()
+    mapped = {}
+    for name, arr in raw.items():
+        n = _strip_prefix(name, ("model.",))
+        if n.startswith("layers.") or n in ("embed_tokens.weight",
+                                            "norm.weight"):
+            tgt = "llama." + n
+        elif name == "lm_head.weight":
+            tgt = "lm_head.weight"
+        else:
+            continue          # rotary inv_freq buffers etc.
+        if tgt not in own:
+            continue
+        # HF torch Linears are [out, in]; ours are [in, out] — transpose
+        # every 2-D projection (shape comparison can't catch square ones).
+        # The embedding table [vocab, hidden] is the one 2-D passthrough.
+        if arr.ndim == 2 and tgt != "llama.embed_tokens.weight":
+            arr = arr.T
+        want = tuple(own[tgt].shape)
+        if tuple(arr.shape) != want:
+            raise ValueError(f"shape mismatch for {tgt}: checkpoint "
+                             f"{arr.shape} vs model {want}")
+        mapped[tgt] = arr.astype(dtype)
+    if getattr(model.config, "tie_word_embeddings", False) \
+            and "lm_head.weight" not in mapped:
+        mapped["lm_head.weight"] = mapped["llama.embed_tokens.weight"] \
+            .T.astype(dtype)
+    missing = [k for k in own if k not in mapped]
+    if missing:
+        raise ValueError(f"checkpoint missing parameters: {missing[:8]}")
+    model.set_state_dict(mapped)
+    return model
+
+
+def llama_config_from_hf(model_dir, **overrides):
+    from .llama import LlamaConfig
+    cfg = load_hf_config(model_dir)
+    fields = dict(
+        vocab_size=cfg.get("vocab_size", 32000),
+        hidden_size=cfg.get("hidden_size", 4096),
+        intermediate_size=cfg.get("intermediate_size", 11008),
+        num_hidden_layers=cfg.get("num_hidden_layers", 32),
+        num_attention_heads=cfg.get("num_attention_heads", 32),
+        num_key_value_heads=cfg.get("num_key_value_heads"),
+        max_position_embeddings=cfg.get("max_position_embeddings", 4096),
+        rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+    )
+    fields.update(overrides)
+    return LlamaConfig(**fields)
+
+
+def load_gpt_from_hf(model, model_dir, dtype="float32"):
+    """Fill a ``GPTForCausalLM`` from an HF GPT-2 checkpoint dir.
+
+    GPT-2 quirk: HF stores ``Conv1D`` weights already ``[in, out]`` —
+    attn/mlp projections pass through untransposed; only true Linears
+    (none in GPT-2 blocks) would transpose.
+    """
+    raw = _read_hf_weights(model_dir)
+    own = model.state_dict()
+    mapped = {}
+    for name, arr in raw.items():
+        n = _strip_prefix(name, ("transformer.",))
+        tgt = None
+        if n == "wte.weight":
+            tgt = "gpt.embeddings.word_embeddings.weight"
+        elif n == "wpe.weight":
+            tgt = "gpt.embeddings.position_embeddings.weight"
+        elif n.startswith("ln_f."):
+            tgt = "gpt.final_norm." + n[len("ln_f."):]
+        elif n.startswith("h."):
+            tgt = "gpt.decoder." + n[2:]
+            for hf, ours in ((".attn.c_attn.", ".self_attn.qkv_proj."),
+                             (".attn.c_proj.", ".self_attn.out_proj."),
+                             (".mlp.c_fc.", ".linear1."),
+                             (".mlp.c_proj.", ".linear2."),
+                             (".ln_1.", ".norm1."), (".ln_2.", ".norm2.")):
+                tgt = tgt.replace(hf, ours)
+        elif name == "lm_head.weight":
+            tgt = "lm_head.weight"
+        if tgt is None or tgt not in own:
+            continue
+        # GPT-2 Conv1D weights are already [in, out] — pass through; the
+        # only true torch Linear is lm_head ([out, in] -> transpose)
+        if tgt == "lm_head.weight" and arr.ndim == 2:
+            arr = arr.T
+        want = tuple(own[tgt].shape)
+        if tuple(arr.shape) != want:
+            raise ValueError(f"shape mismatch for {tgt}: checkpoint "
+                             f"{arr.shape} vs model {want}")
+        mapped[tgt] = arr.astype(dtype)
+    model.set_state_dict(mapped)
+    return model

@@ -1,0 +1,46 @@
+"""Pretrained-weight loading shared by the vision zoo (reference:
+``python/paddle/utils/download.py`` + per-model ``model_urls`` tables in
+``python/paddle/vision/models/*.py``).
+
+Zero-egress build: weights resolve through the local cache
+(``~/.cache/paddle_tpu/weights``) via
+:func:`paddle_tpu.utils.get_weights_path_from_url`; a cache miss raises
+with the exact path to drop the file at. The URL table keeps the
+reference's canonical filenames so a user can copy weights straight from
+an upstream cache."""
+from __future__ import annotations
+
+# canonical upstream URL table (filenames define the cache keys)
+model_urls = {
+    "resnet18": "https://paddle-hapi.bj.bcebos.com/models/resnet18.pdparams",
+    "resnet34": "https://paddle-hapi.bj.bcebos.com/models/resnet34.pdparams",
+    "resnet50": "https://paddle-hapi.bj.bcebos.com/models/resnet50.pdparams",
+    "resnet101":
+        "https://paddle-hapi.bj.bcebos.com/models/resnet101.pdparams",
+    "resnet152":
+        "https://paddle-hapi.bj.bcebos.com/models/resnet152.pdparams",
+    "vgg16": "https://paddle-hapi.bj.bcebos.com/models/vgg16.pdparams",
+    "vgg19": "https://paddle-hapi.bj.bcebos.com/models/vgg19.pdparams",
+    "mobilenetv1_1.0":
+        "https://paddle-hapi.bj.bcebos.com/models/mobilenetv1_1.0.pdparams",
+    "mobilenetv2_1.0":
+        "https://paddle-hapi.bj.bcebos.com/models/mobilenet_v2_x1.0.pdparams",
+    "lenet": "https://paddle-hapi.bj.bcebos.com/models/lenet.pdparams",
+}
+
+
+def load_pretrained(model, arch):
+    """Load cached pretrained weights into ``model`` (strict key match)."""
+    from ...utils import get_weights_path_from_url
+    import paddle_tpu as paddle
+    url = model_urls.get(arch)
+    if url is None:
+        raise ValueError(f"no pretrained weights registered for '{arch}'")
+    path = get_weights_path_from_url(url)
+    state = paddle.load(path)
+    missing, unexpected = model.set_state_dict(state)
+    if missing or unexpected:
+        raise RuntimeError(
+            f"pretrained state_dict mismatch for {arch}: "
+            f"missing={list(missing)[:5]} unexpected={list(unexpected)[:5]}")
+    return model

@@ -97,12 +97,16 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV1(scale=scale, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable offline")
-    return MobileNetV1(scale=scale, **kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, f"mobilenetv1_{scale}")
+    return model
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV2(scale=scale, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable offline")
-    return MobileNetV2(scale=scale, **kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, f"mobilenetv2_{scale}")
+    return model

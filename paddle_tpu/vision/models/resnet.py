@@ -116,10 +116,11 @@ class ResNet(nn.Layer):
 
 
 def _resnet(block, depth, pretrained=False, **kwargs):
+    model = ResNet(block, depth, **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable offline; "
-                           "load a local state_dict with set_state_dict")
-    return ResNet(block, depth, **kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, f"resnet{depth}")
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):

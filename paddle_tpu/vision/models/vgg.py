@@ -50,10 +50,15 @@ class VGG(nn.Layer):
         return x
 
 
+_ARCH_BY_CFG = {"A": "vgg11", "B": "vgg13", "D": "vgg16", "E": "vgg19"}
+
+
 def _vgg(cfg, batch_norm=False, pretrained=False, **kwargs):
+    model = VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs)
     if pretrained:
-        raise RuntimeError("pretrained weights unavailable offline")
-    return VGG(_make_layers(_CFGS[cfg], batch_norm), **kwargs)
+        from ._utils import load_pretrained
+        load_pretrained(model, _ARCH_BY_CFG[cfg])
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):

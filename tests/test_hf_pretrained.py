@@ -1,0 +1,82 @@
+"""from_pretrained over local HF checkpoints: logits parity vs the
+transformers (torch CPU) forward on the same weights — the strongest
+possible oracle for the model families (reference: PaddleNLP
+from_pretrained + its HF interop)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM as PTLlama
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def hf_llama_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_llama")
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=88,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(cfg)
+    hf.eval()
+    hf.save_pretrained(d)
+    return str(d), hf
+
+
+def test_llama_logits_match_transformers(hf_llama_dir):
+    d, hf = hf_llama_dir
+    model = PTLlama.from_pretrained(d)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 96, (2, 10)).astype(np.int64)
+
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.float().numpy()
+    model.eval()
+    got = model(paddle.to_tensor(ids))
+    if isinstance(got, tuple):
+        got = got[0]
+    np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_llama_generate_greedy_matches_transformers(hf_llama_dir):
+    d, hf = hf_llama_dir
+    model = PTLlama.from_pretrained(d)
+    model.eval()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 96, (1, 6)).astype(np.int64)
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids), max_new_tokens=8,
+                           do_sample=False).numpy()
+    got = model.generate(paddle.to_tensor(ids), max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(got.numpy()), want)
+
+
+def test_gpt2_weights_map(tmp_path):
+    cfg = transformers.GPT2Config(
+        vocab_size=80, n_positions=32, n_embd=24, n_layer=2, n_head=3)
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    hf.eval()       # GPT-2 defaults to 0.1 dropout — train mode would
+    hf.save_pretrained(tmp_path)   # make the oracle nondeterministic
+
+    from paddle_tpu.models import GPTForCausalLM, GPTConfig
+    from paddle_tpu.models.pretrained import load_gpt_from_hf
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=80, hidden_size=24, num_hidden_layers=2,
+        num_attention_heads=3, max_position_embeddings=32))
+    load_gpt_from_hf(model, str(tmp_path))
+
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 80, (2, 8)).astype(np.int64)
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.float().numpy()
+    model.eval()
+    got = model(paddle.to_tensor(ids))
+    if isinstance(got, tuple):
+        got = got[0]
+    np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                               rtol=2e-4, atol=2e-4)

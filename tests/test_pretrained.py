@@ -1,0 +1,42 @@
+"""Pretrained-weight cache path (reference ``utils/download.py`` +
+``model_urls``): weights placed in the local cache load through
+``pretrained=True``; a cache miss raises with the actionable path."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import resnet18
+from paddle_tpu.vision.models._utils import model_urls
+import paddle_tpu.utils as U
+
+
+def test_cache_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setattr(U, "_WEIGHTS_HOME", str(tmp_path))
+    paddle.seed(11)
+    donor = resnet18(num_classes=10)
+    fname = os.path.basename(model_urls["resnet18"])
+    paddle.save(donor.state_dict(), str(tmp_path / fname))
+
+    paddle.seed(99)   # different init — must be overwritten by the load
+    model = resnet18(pretrained=True, num_classes=10)
+    for k, v in donor.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v.numpy()),
+                                      np.asarray(model.state_dict()[k]
+                                                 .numpy()), err_msg=k)
+
+
+def test_cache_miss_is_actionable(tmp_path, monkeypatch):
+    monkeypatch.setattr(U, "_WEIGHTS_HOME", str(tmp_path / "nope"))
+    with pytest.raises(IOError, match="place the weights file at"):
+        resnet18(pretrained=True)
+
+
+def test_mismatched_state_dict_rejected(tmp_path, monkeypatch):
+    monkeypatch.setattr(U, "_WEIGHTS_HOME", str(tmp_path))
+    donor = resnet18(num_classes=7)    # head shape differs from default
+    fname = os.path.basename(model_urls["resnet18"])
+    paddle.save(donor.state_dict(), str(tmp_path / fname))
+    with pytest.raises(Exception):
+        resnet18(pretrained=True, num_classes=10)

@@ -1,0 +1,189 @@
+"""Native C++ TCPStore (reference ``tcp_store.cc`` rendezvous) — KV ops,
+blocking wait, atomic add, cross-process barrier."""
+import multiprocessing as mp
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.native import TCPStore, available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="g++ toolchain unavailable")
+
+
+def test_set_get_delete_keys():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        master.set("alpha", b"1")
+        master.set("beta/x", "two")
+        assert master.get("alpha") == b"1"
+        assert master.get("beta/x") == b"two"
+        assert sorted(master.keys("beta")) == ["beta/x"]
+        master.delete_key("alpha")
+        with pytest.raises(KeyError):
+            master.get("alpha", wait=False)
+    finally:
+        master.close()
+
+
+def test_add_is_atomic_across_clients():
+    master = TCPStore(is_master=True, world_size=1)
+    port = master.port
+    try:
+        clients = [TCPStore(port=port, world_size=1) for _ in range(4)]
+        errs = []
+
+        def bump(c):
+            try:
+                for _ in range(50):
+                    c.add("ctr", 1)
+            except Exception as e:
+                errs.append(e)
+        ts = [threading.Thread(target=bump, args=(c,)) for c in clients]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs
+        assert master.add("ctr", 0) == 200
+        for c in clients:
+            c.close()
+    finally:
+        master.close()
+
+
+def test_wait_blocks_until_set():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        client = TCPStore(port=master.port, world_size=1)
+        t0 = time.monotonic()
+
+        def late_set():
+            time.sleep(0.3)
+            master.set("late", b"v")
+        th = threading.Thread(target=late_set)
+        th.start()
+        assert client.get("late", timeout=5) == b"v"
+        assert time.monotonic() - t0 >= 0.25
+        th.join()
+        with pytest.raises(TimeoutError):
+            client.wait("never", timeout=0.2)
+        client.close()
+    finally:
+        master.close()
+
+
+def _rank_proc(port, rank, world, q):
+    try:
+        store = TCPStore(port=port, is_master=False, world_size=world,
+                         timeout=30)
+        store.set(f"rank/{rank}", str(rank))
+        store.barrier("join")
+        # after the barrier every rank's key must be visible
+        got = sorted(int(store.get(f"rank/{r}", timeout=5))
+                     for r in range(world))
+        q.put((rank, got))
+        store.close()
+    except Exception as e:   # pragma: no cover
+        q.put((rank, repr(e)))
+
+
+def test_multiprocess_rendezvous_barrier():
+    world = 3
+    master = TCPStore(is_master=True, world_size=world)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rank_proc,
+                         args=(master.port, r, world, q))
+             for r in range(world)]
+    try:
+        [p.start() for p in procs]
+        results = [q.get(timeout=60) for _ in range(world)]
+        for rank, got in results:
+            assert isinstance(got, list), f"rank {rank} failed: {got}"
+            assert got == list(range(world))
+    finally:
+        [p.join(timeout=10) for p in procs]
+        [p.terminate() for p in procs if p.is_alive()]
+        master.close()
+
+
+def test_elastic_manager_over_tcp_store():
+    """The elastic membership layer runs over the tcp:// (C++ TCPStore)
+    backend exactly as over file:// — etcd-role parity."""
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.distributed.native import TCPStore as _TS
+
+    seed = _TS(is_master=True)          # hold the port as the server
+    try:
+        spec = f"tcp://127.0.0.1:{seed.port}"
+        a = ElasticManager(server=spec, job_id="jt", np="1:4",
+                           host="10.0.0.1:8000", ttl=0.5,
+                           heartbeat_interval=0.1)
+        b = ElasticManager(server=spec, job_id="jt", np="1:4",
+                           host="10.0.0.2:8000", ttl=0.5,
+                           heartbeat_interval=0.1)
+        a.register()
+        assert a.hosts() == ["10.0.0.1:8000"]
+        b.register()
+        changed, cur = a.world_changed()
+        assert changed and len(cur) == 2
+        env = a.accept_world()
+        assert env["PADDLE_TRAINERS_NUM"] == "2"
+        a.stop(); b.stop()
+    finally:
+        seed.close()
+
+
+def test_barrier_is_reusable():
+    master = TCPStore(is_master=True, world_size=2)
+    client = TCPStore(port=master.port, world_size=2)
+    try:
+        for _ in range(3):      # three rounds over the same name
+            errs = []
+
+            def go(s):
+                try:
+                    s.barrier("phase", timeout=10)
+                except Exception as e:
+                    errs.append(e)
+            ts = [threading.Thread(target=go, args=(s,))
+                  for s in (master, client)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            assert not errs, errs
+    finally:
+        client.close()
+        master.close()
+
+
+def test_negative_counters_ok():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        assert master.add("neg", -1) == -1
+        assert master.add("neg", -1) == -2
+        assert master.add("neg", 5) == 3
+    finally:
+        master.close()
+
+
+def test_server_stop_with_live_blocked_client():
+    """close() with a client blocked in wait() must not crash/UAF; the
+    blocked wait returns an error promptly."""
+    master = TCPStore(is_master=True, world_size=1)
+    client = TCPStore(port=master.port, world_size=1)
+    out = {}
+
+    def waiter():
+        try:
+            client.wait("nothing", timeout=30)
+            out["r"] = "found"
+        except Exception as e:
+            out["r"] = type(e).__name__
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.2)
+    master.close()               # server gone while wait in flight
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert out["r"] in ("TimeoutError", "RuntimeError")
+    client.close()
