@@ -37,3 +37,13 @@ from .layers.rnn import (  # noqa: F401
 )
 from . import utils  # noqa: F401
 from .clip_grad import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
+from .layers.rnn import RNNCellBase  # noqa: F401
+from .layers.extras import (  # noqa: F401
+    MaxPool3D, AvgPool3D, AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    Conv1DTranspose, Conv3DTranspose,
+    Unflatten, Fold, PixelUnshuffle, PairwiseDistance,
+    SiLU, Softmax2D,
+    CTCLoss, SoftMarginLoss, PoissonNLLLoss, MultiLabelSoftMarginLoss,
+    MultiMarginLoss, TripletMarginWithDistanceLoss, HSigmoidLoss,
+)

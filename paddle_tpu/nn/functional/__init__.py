@@ -4,3 +4,4 @@ from .activation import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403

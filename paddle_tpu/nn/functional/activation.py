@@ -182,3 +182,8 @@ def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True):
 @defop
 def log_sigmoid(x):
     return jax.nn.log_sigmoid(x)
+
+
+# reference spells both: paddle.nn.functional.log_sigmoid is canonical,
+# logsigmoid survives as the compat alias
+logsigmoid = log_sigmoid

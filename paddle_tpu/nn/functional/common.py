@@ -181,43 +181,74 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return apply(fn, *args, op_name="conv3d")
 
 
-def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
-                     groups=1, dilation=1, data_format="NCHW", output_size=None,
-                     name=None):
-    nd = 2
+def _conv_transpose_nd(x, weight, bias, nd, stride, padding, output_padding,
+                       groups, dilation, output_size, op_name):
+    """Shared N-D transposed convolution (paddle weight layout
+    [in_c, out_c/groups, *k]); ``output_size`` resolves the stride
+    ambiguity by overriding the per-dim output padding."""
     strides = _tuple(stride, nd)
     dil = _tuple(dilation, nd)
-    opad = _tuple(output_padding, nd)
+    opad = list(_tuple(output_padding, nd))
     padding_ = padding
+    dn_map = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+              3: ("NCDHW", "OIDHW", "NCDHW")}
+    if output_size is not None:
+        if isinstance(padding_, str):
+            raise NotImplementedError(
+                "output_size with string padding is unsupported")
+        if hasattr(output_size, "tolist"):
+            output_size = output_size.tolist()
+        out_sp = [int(s) for s in tuple(output_size)[-nd:]]
+        p = _conv_padding(padding_, nd)
+        kshape = weight.shape[2:]
+        in_sp = x.shape[2:2 + nd]
+        for i in range(nd):
+            base = ((int(in_sp[i]) - 1) * strides[i] - p[i][0] - p[i][1]
+                    + dil[i] * (int(kshape[i]) - 1) + 1)
+            extra = out_sp[i] - base
+            if extra < 0 or extra >= strides[i] + max(0, dil[i] - 1):
+                raise ValueError(
+                    f"output_size[{i}]={out_sp[i]} unreachable "
+                    f"(base {base}, stride {strides[i]})")
+            opad[i] = extra
 
     def fn(a, w, *b):
-        # paddle transpose-conv weight layout: [in_c, out_c/groups, kH, kW]
         kshape = w.shape[2:]
         if isinstance(padding_, str):
             pad = padding_.upper()
         else:
             p = _conv_padding(padding_, nd)
-            # transposed conv padding math: lax.conv_transpose handles 'SAME'/'VALID';
-            # for explicit pads use gradient-style: pad_t = dil*(k-1) - pad
-            pad = [(dil[i] * (kshape[i] - 1) - p[i][0] + 0,
-                    dil[i] * (kshape[i] - 1) - p[i][1] + opad[i]) for i in range(nd)]
-        w_flip = jnp.flip(w, axis=(2, 3))  # IOHW -> use as OIHW after swap
+            # transposed conv padding math (gradient-style):
+            # pad_t = dil*(k-1) - pad, high side + output_padding
+            pad = [(dil[i] * (kshape[i] - 1) - p[i][0],
+                    dil[i] * (kshape[i] - 1) - p[i][1] + opad[i])
+                   for i in range(nd)]
+        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
         if groups == 1:
-            w_t = jnp.swapaxes(w_flip, 0, 1)  # [out_c, in_c, kH, kW]
+            w_t = jnp.swapaxes(w_flip, 0, 1)   # -> [out_c, in_c, *k]
         else:
             ic, ocg = w.shape[0], w.shape[1]
             w_g = w_flip.reshape(groups, ic // groups, ocg, *kshape)
-            w_t = jnp.swapaxes(w_g, 1, 2).reshape(groups * ocg, ic // groups, *kshape)
+            w_t = jnp.swapaxes(w_g, 1, 2).reshape(groups * ocg, ic // groups,
+                                                  *kshape)
         out = lax.conv_general_dilated(
-            a, w_t, window_strides=(1, 1), padding=pad, lhs_dilation=strides,
-            rhs_dilation=dil, dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=groups)
+            a, w_t, window_strides=(1,) * nd, padding=pad,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=dn_map[nd], feature_group_count=groups)
         if b:
-            out = out + b[0].reshape([1, -1, 1, 1])
+            out = out + b[0].reshape([1, -1] + [1] * nd)
         return out
 
     args = (x, weight) + ((bias,) if bias is not None else ())
-    return apply(fn, *args, op_name="conv2d_transpose")
+    return apply(fn, *args, op_name=op_name)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None,
+                     name=None):
+    return _conv_transpose_nd(x, weight, bias, 2, stride, padding,
+                              output_padding, groups, dilation, output_size,
+                              "conv2d_transpose")
 
 
 # ---------------------------------------------------------------------------
@@ -297,6 +328,11 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     ksize = _tuple(kernel_size, 2)
     strides = _tuple(stride, 2) if stride is not None else ksize
     pad = _conv_padding(padding, 2) if not isinstance(padding, str) else padding
+    if divisor_override:
+        sums = _pool(x, ksize, strides, pad, lax.add, 0.0, data_format,
+                     ceil_mode)
+        return apply(lambda s: s / float(divisor_override), sums,
+                     op_name="avg_pool_divisor")
     return _pool(x, ksize, strides, pad, lax.add, 0.0, data_format,
                  ceil_mode, norm="avg", count_include_pad=not exclusive)
 

@@ -1,0 +1,499 @@
+"""Functional breadth batch 2 (reference: ``python/paddle/nn/functional/``
+— pooling.py 3-D + unpool, conv.py 1-D/3-D transpose, vision.py
+affine_grid/grid_sample/pixel_unshuffle/temporal_shift, common.py fold,
+extension.py sequence_mask/gather_tree, loss.py tail)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...autograd.tape import apply
+from .common import _tuple, _conv_padding, _pool
+
+
+# ---------------------------------------------------------------------------
+# pooling: 3-D + indices + unpool
+# ---------------------------------------------------------------------------
+
+def _check_index_pool_args(padding, ceil_mode, data_format, expect_df):
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            "return_mask pooling: string padding unsupported (use ints)")
+    if ceil_mode:
+        raise NotImplementedError(
+            "return_mask pooling: ceil_mode unsupported")
+    if data_format != expect_df:
+        raise NotImplementedError(
+            f"return_mask pooling: only {expect_df} layout")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    ksize = _tuple(kernel_size, 3)
+    strides = _tuple(stride, 3) if stride is not None else ksize
+    if return_mask:
+        _check_index_pool_args(padding, ceil_mode, data_format, "NCDHW")
+        return _max_pool_with_index(x, ksize, strides,
+                                    _tuple(padding, 3))
+    pad = _conv_padding(padding, 3) if not isinstance(padding, str) else padding
+    return _pool(x, ksize, strides, pad, lax.max, -jnp.inf, data_format,
+                 ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    ksize = _tuple(kernel_size, 3)
+    strides = _tuple(stride, 3) if stride is not None else ksize
+    pad = _conv_padding(padding, 3) if not isinstance(padding, str) else padding
+    if divisor_override:
+        sums = _pool(x, ksize, strides, pad, lax.add, 0.0, data_format,
+                     ceil_mode)
+        return apply(lambda s: s / float(divisor_override), sums,
+                     op_name="avg_pool_divisor")
+    return _pool(x, ksize, strides, pad, lax.add, 0.0, data_format,
+                 ceil_mode, norm="avg", count_include_pad=not exclusive)
+
+
+def _max_pool_with_index(x, ksize, strides, pads):
+    """Window argmax pooling: returns (values, flat spatial indices into the
+    UNPADDED input) — the mask `paddle.nn.functional.max_pool*d(...,
+    return_mask=True)` contract that MaxUnPool consumes."""
+    nd = len(ksize)
+
+    def fn(a):
+        n, c = a.shape[:2]
+        spatial = a.shape[2:]
+        padded = jnp.pad(
+            a, [(0, 0), (0, 0)] + [(p, p) for p in pads],
+            constant_values=-jnp.inf)
+        outs = [(padded.shape[2 + i] - ksize[i]) // strides[i] + 1
+                for i in range(nd)]
+        # gather every window position: iterate the (static, small) kernel
+        windows = []
+        flat_idx = []
+        for off in np.ndindex(*ksize):
+            sl = [slice(None), slice(None)]
+            idx_terms = []
+            for i in range(nd):
+                start = off[i]
+                sl.append(slice(start, start + outs[i] * strides[i],
+                                strides[i]))
+            windows.append(padded[tuple(sl)])
+            # index of this element in the unpadded input
+            coord = []
+            for i in range(nd):
+                pos = (jnp.arange(outs[i]) * strides[i] + off[i] - pads[i])
+                coord.append(pos)
+            flat = jnp.zeros([1] * nd, jnp.int32)
+            mult = 1
+            for i in reversed(range(nd)):
+                shape = [1] * nd
+                shape[i] = outs[i]
+                flat = flat + coord[i].reshape(shape) * mult
+                mult *= spatial[i]
+            flat_idx.append(jnp.broadcast_to(flat, outs))
+        stack = jnp.stack(windows, axis=-1)       # [n, c, *outs, K]
+        idxs = jnp.stack(flat_idx, axis=-1)       # [*outs, K]
+        arg = jnp.argmax(stack, axis=-1)
+        vals = jnp.take_along_axis(stack, arg[..., None], -1)[..., 0]
+        mask = jnp.take_along_axis(
+            jnp.broadcast_to(idxs, stack.shape), arg[..., None], -1)[..., 0]
+        return vals, mask.astype(jnp.int32)
+
+    return apply(fn, x, op_name="max_pool_index")
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    _check_index_pool_args(padding, False, "NCHW", "NCHW")
+    ksize = _tuple(kernel_size, 2)
+    strides = _tuple(stride, 2) if stride is not None else ksize
+    return _max_pool_with_index(x, ksize, strides, _tuple(padding, 2))
+
+
+def max_pool1d_with_index(x, kernel_size, stride=None, padding=0):
+    _check_index_pool_args(padding, False, "NCL", "NCL")
+    ksize = _tuple(kernel_size, 1)
+    strides = _tuple(stride, 1) if stride is not None else ksize
+    return _max_pool_with_index(x, ksize, strides, _tuple(padding, 1))
+
+
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size):
+    ksize = _tuple(kernel_size, nd)
+    strides = _tuple(stride, nd) if stride is not None else ksize
+    pads = _tuple(padding, nd)
+
+    def fn(a, idx):
+        n, c = a.shape[:2]
+        outs_in = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size)[-nd:]
+        else:
+            out_sp = tuple((outs_in[i] - 1) * strides[i] - 2 * pads[i]
+                           + ksize[i] for i in range(nd))
+        total = int(np.prod(out_sp))
+        flat = jnp.zeros((n, c, total), a.dtype)
+        ai = a.reshape(n, c, -1)
+        ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        flat = flat.at[jnp.arange(n)[:, None, None],
+                       jnp.arange(c)[None, :, None], ii].set(ai)
+        return flat.reshape((n, c) + out_sp)
+
+    return apply(fn, x, indices, op_name="max_unpool")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size)
+
+
+# ---------------------------------------------------------------------------
+# transposed convs (1-D / 3-D) — shared N-D core in common.py
+# ---------------------------------------------------------------------------
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", output_size=None, name=None):
+    from .common import _conv_transpose_nd
+    return _conv_transpose_nd(x, weight, bias, 1, stride, padding,
+                              output_padding, groups, dilation, output_size,
+                              "conv1d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    from .common import _conv_transpose_nd
+    return _conv_transpose_nd(x, weight, bias, 3, stride, padding,
+                              output_padding, groups, dilation, output_size,
+                              "conv3d_transpose")
+
+
+# ---------------------------------------------------------------------------
+# vision
+# ---------------------------------------------------------------------------
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        return a.reshape(n, c * r * r, h // r, w // r)
+
+    return apply(fn, x, op_name="pixel_unshuffle")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — inverse of :func:`unfold` (overlaps sum)."""
+    out_hw = _tuple(output_sizes, 2)
+    ks = _tuple(kernel_sizes, 2)
+    st = _tuple(strides, 2)
+    pd = _tuple(paddings, 2)
+    dl = _tuple(dilations, 2)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = out_hw[0] + 2 * pd[0], out_hw[1] + 2 * pd[1]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        cols = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :,
+                             i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(
+                    cols[:, :, i, j])
+        return out[:, :, pd[0]: pd[0] + out_hw[0], pd[1]: pd[1] + out_hw[1]]
+
+    return apply(fn, x, op_name="fold")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] -> sampling grid [N, H, W, 2] (x, y in [-1, 1])."""
+    if hasattr(out_shape, "tolist"):
+        out_shape = out_shape.tolist()
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+            ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)            # [h, w]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)   # [h, w, 3]
+        out = jnp.einsum("hwk,nok->nhwo", base, th)  # [n, h, w, 2]
+        return out
+
+    return apply(fn, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x [N, C, H, W], grid [N, Ho, Wo, 2] (x, y normalized) ->
+    [N, C, Ho, Wo]. padding_mode: zeros | border."""
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(
+            f"grid_sample padding_mode={padding_mode!r} unsupported "
+            "(zeros/border only)")
+    if mode not in ("bilinear", "nearest"):
+        raise NotImplementedError(f"grid_sample mode={mode!r} unsupported")
+
+    def fn(a, g):
+        n, c, h, w = a.shape
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) * (size - 1) / 2.0
+            return ((coord + 1.0) * size - 1.0) / 2.0
+
+        gx = unnorm(g[..., 0], w)                 # [n, ho, wo]
+        gy = unnorm(g[..., 1], h)
+
+        def sample(ix, iy):
+            inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            v = a[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [n,ho,wo,c]
+            if padding_mode == "zeros":
+                v = jnp.where(inb[..., None], v, 0.0)
+            return v
+
+        if mode == "nearest":
+            out = sample(jnp.round(gx).astype(jnp.int32),
+                         jnp.round(gy).astype(jnp.int32))
+        else:
+            x0 = jnp.floor(gx).astype(jnp.int32)
+            y0 = jnp.floor(gy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = gx - x0
+            wy = gy - y0
+            out = (sample(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+                   + sample(x1, y0) * (wx * (1 - wy))[..., None]
+                   + sample(x0, y1) * ((1 - wx) * wy)[..., None]
+                   + sample(x1, y1) * (wx * wy)[..., None])
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return apply(fn, x, grid, op_name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (reference phi temporal_shift kernel)."""
+
+    def fn(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        left = jnp.concatenate(
+            [v[:, 1:, :fold_c], jnp.zeros_like(v[:, :1, :fold_c])], axis=1)
+        right = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold_c:2 * fold_c]),
+             v[:, :-1, fold_c:2 * fold_c]], axis=1)
+        rest = v[:, :, 2 * fold_c:]
+        return jnp.concatenate([left, right, rest],
+                               axis=2).reshape(nt, c, h, w)
+
+    return apply(fn, x, op_name="temporal_shift")
+
+
+# ---------------------------------------------------------------------------
+# sequence extension ops
+# ---------------------------------------------------------------------------
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...framework import dtype as dtypes
+
+    def fn(lens):
+        m = maxlen if maxlen is not None else int(jnp.max(lens))
+        rng = jnp.arange(m)
+        return (rng[None, :] < lens[..., None]).astype(
+            dtypes.convert_dtype(dtype))
+
+    return apply(fn, x, op_name="sequence_mask")
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference phi gather_tree kernel):
+    ids/parents [max_time, batch, beam] -> full sequences per beam."""
+
+    def fn(i, p):
+        T = i.shape[0]
+
+        def step(beams, t):
+            # beams: current beam index per [batch, beam]
+            tok = jnp.take_along_axis(i[t], beams, axis=-1)
+            par = jnp.take_along_axis(p[t], beams, axis=-1)
+            return par, tok
+
+        init = jnp.broadcast_to(jnp.arange(i.shape[2]), i.shape[1:])
+        _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply(fn, ids, parents, op_name="gather_tree")
+
+
+# ---------------------------------------------------------------------------
+# distance / losses
+# ---------------------------------------------------------------------------
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return apply(fn, x, y, op_name="pairwise_distance")
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = (y * jnp.log(y) - y
+                        + 0.5 * jnp.log(2 * math.pi * y))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply(fn, input, label, op_name="poisson_nll_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return apply(fn, input, label, op_name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def fn(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(fn, *args, op_name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def fn(x, y, *w):
+        n, c = x.shape
+        correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32), 1)
+        m = jnp.maximum(margin - correct + x, 0.0) ** p
+        if w:
+            m = m * jnp.take(w[0], y.astype(jnp.int32))[:, None]
+        hot = jax.nn.one_hot(y.astype(jnp.int32), c, dtype=x.dtype)
+        return _reduce(jnp.sum(m * (1 - hot), -1) / c, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(fn, *args, op_name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    if distance_function is not None:
+        dp = distance_function(input, positive)
+        dn = distance_function(input, negative)
+        if swap:
+            dpn = distance_function(positive, negative)
+            dn = apply(lambda a, b: jnp.minimum(a, b), dn, dpn,
+                       op_name="tm_swap")
+        return apply(lambda a, b:
+                     _reduce(jnp.maximum(a - b + margin, 0.0), reduction),
+                     dp, dn, op_name="triplet_margin_distance")
+
+    def fn(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos, axis=-1)
+        dn = jnp.linalg.norm(a - neg, axis=-1)
+        if swap:
+            dn = jnp.minimum(dn, jnp.linalg.norm(pos - neg, axis=-1))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(fn, input, positive, negative,
+                 op_name="triplet_margin_distance")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over a complete binary tree (default) or a
+    custom path table (reference phi hsigmoid_loss kernel: heap-numbered
+    internal nodes 1..K-1; leaf for class c is node c+K; loss sums
+    -log sigmoid((1-2*code)*(w_n.x+b_n)) over the root->leaf path)."""
+    K = int(num_classes)
+    depth = max(K - 1, 1).bit_length() + 1
+
+    def fn(x, y, w, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        pt = next(it) if path_table is not None else None
+        pc = next(it) if path_code is not None else None
+        yl = y.reshape(-1).astype(jnp.int32)
+        if pt is not None:
+            nodes = pt.astype(jnp.int32)         # [n, path_len]
+            codes = pc.astype(x.dtype)
+            valid = nodes >= 0
+            nodes = jnp.maximum(nodes, 0)
+        else:
+            leaf = yl + K                        # heap leaf id
+            # bits of `leaf` below its MSB, walked root->leaf
+            nbits = jnp.floor(jnp.log2(leaf.astype(jnp.float32))
+                              ).astype(jnp.int32)
+            steps = jnp.arange(depth)
+            shift = nbits[:, None] - 1 - steps[None, :]
+            valid = shift >= 0
+            sh = jnp.maximum(shift, 0)
+            codes = ((leaf[:, None] >> sh) & 1).astype(x.dtype)
+            # node visited before consuming each bit
+            nodes = leaf[:, None] >> (sh + 1)
+            nodes = jnp.where(valid, nodes, 1) - 1   # 0-based rows of w
+        logits = jnp.einsum("nd,npd->np", x,
+                            jnp.take(w, nodes, axis=0))
+        if b is not None:
+            logits = logits + jnp.take(b.reshape(-1), nodes)
+        per_step = -jax.nn.log_sigmoid((1.0 - 2.0 * codes) * logits)
+        loss = jnp.sum(jnp.where(valid, per_step, 0.0), axis=-1)
+        return loss[:, None]
+
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if path_table is not None:
+        args += [path_table, path_code]
+    return apply(fn, *args, op_name="hsigmoid_loss")

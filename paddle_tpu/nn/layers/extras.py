@@ -1,0 +1,297 @@
+"""Layer breadth batch 2 (reference: ``python/paddle/nn/layer/`` —
+pooling.py 3-D/unpool tiers, conv.py 1-D/3-D transpose, common.py
+Unflatten/Fold/PairwiseDistance, vision.py PixelUnshuffle, loss.py tail,
+activation.py SiLU/Softmax2D)."""
+from __future__ import annotations
+
+from ..layer import Layer
+from .. import functional as F
+from .conv import _ConvNd
+
+
+# -------------------------------------------------------------- pooling
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, return_mask,
+                     data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, *self.args)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override, data_format)
+
+    def forward(self, x):
+        return F.avg_pool3d(x, *self.args)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        from ...autograd.tape import apply
+        import jax.numpy as jnp
+        sizes = self.output_size
+        if isinstance(sizes, int):
+            sizes = (sizes,) * 3
+
+        def fn(a):
+            n, c, d, h, w = a.shape
+            od = sizes[0] or d
+            oh = sizes[1] or h
+            ow = sizes[2] or w
+            # adaptive = mean over evenly-split bins
+            assert d % od == 0 and h % oh == 0 and w % ow == 0, (
+                "AdaptiveAvgPool3D: non-divisible sizes unsupported")
+            v = a.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+            return v.mean(axis=(3, 5, 7))
+
+        return apply(fn, x, op_name="adaptive_avg_pool3d")
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        from ...autograd.tape import apply
+        out = int(self.output_size)
+
+        def fn(a):
+            n, c, l = a.shape
+            assert l % out == 0, "AdaptiveMaxPool1D: non-divisible length"
+            return a.reshape(n, c, out, l // out).max(axis=-1)
+
+        if self.return_mask:
+            l = int(x.shape[-1])
+            assert l % out == 0, "AdaptiveMaxPool1D: non-divisible length"
+            return F.max_pool1d_with_index(x, kernel_size=l // out)
+        return apply(fn, x, op_name="adaptive_max_pool1d")
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self.args
+        return F.max_unpool1d(x, indices, k, s, p, o)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self.args
+        return F.max_unpool2d(x, indices, k, s, p, o)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self.args = (kernel_size, stride, padding, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, o = self.args
+        return F.max_unpool3d(x, indices, k, s, p, o)
+
+
+# -------------------------------------------------------------- convs
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation,
+                                  self._data_format, output_size)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation,
+                                  self._data_format, output_size)
+
+
+# -------------------------------------------------------------- common
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = int(axis)
+        self.shape = list(shape)
+
+    def forward(self, x):
+        full = list(x.shape)
+        ax = self.axis % len(full)
+        return x.reshape(full[:ax] + self.shape + full[ax + 1:])
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.factor = downscale_factor
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.factor)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.args = (p, epsilon, keepdim)
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, *self.args)
+
+
+# -------------------------------------------------------------- activations
+
+class SiLU(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW input."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+# -------------------------------------------------------------- losses
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, *self.args)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.args = (p, margin)
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, *self.args,
+                                   weight=self.weight,
+                                   reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.args = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(input, positive,
+                                                   negative, *self.args)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (reference
+    ``paddle.nn.HSigmoidLoss``: owns the internal-node weight table)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        rows = num_classes - 1 if not is_custom else num_classes
+        self.weight = self.create_parameter([rows, feature_size],
+                                            attr=weight_attr)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter([rows, 1], attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code)

@@ -1,0 +1,232 @@
+"""Layer/functional breadth batch 2 — numeric parity against torch (CPU)
+as the oracle where available (reference test pattern: per-op
+``test_*_op.py`` with framework-reference comparison)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+RNG = np.random.RandomState(7)
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def _cmp(got, want, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                               rtol=rtol, atol=atol)
+
+
+def test_pool3d_parity():
+    x = RNG.randn(2, 3, 8, 8, 8).astype(np.float32)
+    _cmp(F.max_pool3d(t(x), 2),
+         TF.max_pool3d(torch.tensor(x), 2).numpy())
+    _cmp(F.avg_pool3d(t(x), 2, stride=2),
+         TF.avg_pool3d(torch.tensor(x), 2, 2).numpy())
+    _cmp(nn.MaxPool3D(2)(t(x)),
+         TF.max_pool3d(torch.tensor(x), 2).numpy())
+
+
+def test_max_unpool2d_roundtrip():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    tv, ti = torch.nn.functional.max_pool2d(torch.tensor(x), 2,
+                                            return_indices=True)
+    v, idx = F.max_pool2d_with_index(t(x), 2)
+    np.testing.assert_allclose(np.asarray(v.numpy()), tv.numpy(),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx.numpy()), ti.numpy())
+    un_t = TF.max_unpool2d(tv, ti, 2).numpy()
+    un = F.max_unpool2d(v, idx, 2)
+    np.testing.assert_allclose(np.asarray(un.numpy()), un_t, rtol=1e-6)
+    un_l = nn.MaxUnPool2D(2)(v, idx)
+    np.testing.assert_allclose(np.asarray(un_l.numpy()), un_t, rtol=1e-6)
+
+
+def test_conv_transpose_1d_3d_parity():
+    x1 = RNG.randn(2, 4, 10).astype(np.float32)
+    w1 = RNG.randn(4, 3, 3).astype(np.float32)   # [in, out, k]
+    want = TF.conv_transpose1d(torch.tensor(x1), torch.tensor(w1),
+                               stride=2, padding=1).numpy()
+    _cmp(F.conv1d_transpose(t(x1), t(w1), stride=2, padding=1), want,
+         rtol=1e-4)
+
+    x3 = RNG.randn(1, 2, 5, 5, 5).astype(np.float32)
+    w3 = RNG.randn(2, 3, 3, 3, 3).astype(np.float32)
+    want3 = TF.conv_transpose3d(torch.tensor(x3), torch.tensor(w3),
+                                stride=2).numpy()
+    _cmp(F.conv3d_transpose(t(x3), t(w3), stride=2), want3, rtol=1e-4,
+         atol=1e-4)
+
+
+def test_pixel_unshuffle_fold_unflatten():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    _cmp(F.pixel_unshuffle(t(x), 2),
+         TF.pixel_unshuffle(torch.tensor(x), 2).numpy())
+    # fold(unfold(x)) == x * overlap_count
+    cols = F.unfold(t(x), 3, strides=1, paddings=1)
+    back = F.fold(cols, (8, 8), 3, strides=1, paddings=1)
+    tcols = TF.unfold(torch.tensor(x), 3, padding=1)
+    tback = TF.fold(tcols, (8, 8), 3, padding=1).numpy()
+    _cmp(back, tback, rtol=1e-5)
+    u = nn.Unflatten(1, [1, 3])(t(x))
+    assert tuple(u.shape) == (2, 1, 3, 8, 8)
+
+
+def test_affine_grid_grid_sample_parity():
+    theta = RNG.randn(2, 2, 3).astype(np.float32) * 0.3
+    theta[:, 0, 0] += 1
+    theta[:, 1, 1] += 1
+    x = RNG.randn(2, 3, 6, 6).astype(np.float32)
+    for align in (True, False):
+        grid_t = TF.affine_grid(torch.tensor(theta), (2, 3, 6, 6),
+                                align_corners=align)
+        grid = F.affine_grid(t(theta), (2, 3, 6, 6), align_corners=align)
+        np.testing.assert_allclose(np.asarray(grid.numpy()),
+                                   grid_t.numpy(), rtol=1e-4, atol=1e-5)
+        want = TF.grid_sample(torch.tensor(x), grid_t,
+                              align_corners=align).numpy()
+        got = F.grid_sample(t(x), grid, align_corners=align)
+        np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_sequence_ops():
+    lens = paddle.to_tensor(np.array([2, 4, 1], np.int64))
+    m = F.sequence_mask(lens, maxlen=5)
+    np.testing.assert_array_equal(
+        np.asarray(m.numpy()),
+        [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0], [1, 0, 0, 0, 0]])
+
+    x = RNG.randn(8, 8, 4, 4).astype(np.float32)   # nt=8, seg=4, c=8
+    out = F.temporal_shift(t(x), 4, 0.25)           # fold = 2 channels
+    assert tuple(out.shape) == (8, 8, 4, 4)
+    v = x.reshape(2, 4, 8, 4, 4)
+    np.testing.assert_allclose(np.asarray(out.numpy()).reshape(
+        2, 4, 8, 4, 4)[:, :-1, 0], v[:, 1:, 0], rtol=1e-6)  # ch0 shifts left
+    np.testing.assert_allclose(np.asarray(out.numpy()).reshape(
+        2, 4, 8, 4, 4)[:, 1:, 2], v[:, :-1, 2], rtol=1e-6)  # ch2 shifts right
+    np.testing.assert_allclose(np.asarray(out.numpy()).reshape(
+        2, 4, 8, 4, 4)[:, :, 4:], v[:, :, 4:], rtol=1e-6)   # rest untouched
+
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+    out = F.gather_tree(paddle.to_tensor(ids), paddle.to_tensor(parents))
+    assert tuple(out.shape) == (3, 1, 2)
+
+
+def test_loss_tail_parity():
+    x = RNG.randn(4, 5).astype(np.float32)
+    y01 = (RNG.rand(4, 5) > 0.5).astype(np.float32)
+    lab = RNG.randint(0, 5, (4,)).astype(np.int64)
+    ypm = np.where(RNG.rand(4, 5) > 0.5, 1.0, -1.0).astype(np.float32)
+    pos = np.abs(RNG.randn(4, 5)).astype(np.float32) + 0.5
+
+    _cmp(F.soft_margin_loss(t(x), t(ypm)),
+         TF.soft_margin_loss(torch.tensor(x), torch.tensor(ypm)).numpy())
+    _cmp(F.multi_label_soft_margin_loss(t(x), t(y01)),
+         TF.multilabel_soft_margin_loss(torch.tensor(x),
+                                        torch.tensor(y01)).numpy())
+    _cmp(F.multi_margin_loss(t(x), paddle.to_tensor(lab)),
+         TF.multi_margin_loss(torch.tensor(x), torch.tensor(lab)).numpy())
+    _cmp(F.poisson_nll_loss(t(x), t(pos)),
+         TF.poisson_nll_loss(torch.tensor(x), torch.tensor(pos)).numpy())
+    a, p, n = (RNG.randn(4, 8).astype(np.float32) for _ in range(3))
+    _cmp(F.triplet_margin_with_distance_loss(t(a), t(p), t(n)),
+         TF.triplet_margin_with_distance_loss(
+             torch.tensor(a), torch.tensor(p), torch.tensor(n)).numpy(),
+         rtol=1e-4)
+    d = F.pairwise_distance(t(a), t(p))
+    want = TF.pairwise_distance(torch.tensor(a), torch.tensor(p)).numpy()
+    _cmp(d, want, rtol=1e-4)
+    _cmp(nn.PairwiseDistance()(t(a), t(p)), want, rtol=1e-4)
+
+
+def test_hsigmoid_loss():
+    paddle.seed(3)
+    feat, K = 6, 5
+    layer = nn.HSigmoidLoss(feat, K)
+    x = t(RNG.randn(4, feat).astype(np.float32))
+    lab = paddle.to_tensor(RNG.randint(0, K, (4,)).astype(np.int64))
+    out = layer(x, lab)
+    assert tuple(out.shape) == (4, 1)
+    arr = np.asarray(out.numpy())
+    assert np.isfinite(arr).all() and (arr > 0).all()
+    # differentiable down to the weight table
+    out.sum().backward()
+    g = layer.weight.grad
+    assert g is not None and np.abs(np.asarray(g.numpy())).sum() > 0
+    # custom path table: two classes, single root node decision
+    w = t(np.array([[1.0, 0.0, 0, 0, 0, 0]], np.float32))
+    pt = paddle.to_tensor(np.array([[0], [0]], np.int64))
+    pc = paddle.to_tensor(np.array([[0], [1]], np.float32))
+    xin = t(np.array([[2.0, 0, 0, 0, 0, 0], [2.0, 0, 0, 0, 0, 0]],
+                     np.float32))
+    labs = paddle.to_tensor(np.array([0, 1], np.int64))
+    out = F.hsigmoid_loss(xin, labs, 2, w, path_table=pt, path_code=pc)
+    # code 0 -> -log sigmoid(+2); code 1 -> -log sigmoid(-2)
+    want = -np.log([1 / (1 + np.exp(-2.0)), 1 / (1 + np.exp(2.0))])
+    np.testing.assert_allclose(np.asarray(out.numpy())[:, 0], want,
+                               rtol=1e-5)
+
+
+def test_activation_layers():
+    x = RNG.randn(2, 3, 4, 4).astype(np.float32)
+    _cmp(nn.SiLU()(t(x)), TF.silu(torch.tensor(x)).numpy())
+    _cmp(nn.Softmax2D()(t(x)),
+         TF.softmax(torch.tensor(x), dim=1).numpy())
+    _cmp(F.logsigmoid(t(x)), TF.logsigmoid(torch.tensor(x)).numpy())
+
+
+def test_adaptive_pools():
+    x = RNG.randn(2, 3, 8, 8, 8).astype(np.float32)
+    _cmp(nn.AdaptiveAvgPool3D(2)(t(x)),
+         TF.adaptive_avg_pool3d(torch.tensor(x), 2).numpy())
+    x1 = RNG.randn(2, 3, 12).astype(np.float32)
+    _cmp(nn.AdaptiveMaxPool1D(4)(t(x1)),
+         TF.adaptive_max_pool1d(torch.tensor(x1), 4).numpy())
+
+
+def test_review_fixes_extras():
+    x3 = RNG.randn(1, 2, 6, 6, 6).astype(np.float32)
+    with pytest.raises(NotImplementedError):
+        F.max_pool3d(t(x3), 2, ceil_mode=True, return_mask=True)
+    with pytest.raises(NotImplementedError):
+        F.max_pool3d(t(x3), 2, padding="SAME", return_mask=True)
+    # divisor_override = window-sum semantics
+    got = F.avg_pool3d(t(x3), 2, divisor_override=1)
+    want = TF.avg_pool3d(torch.tensor(x3), 2, divisor_override=1).numpy()
+    _cmp(got, want, rtol=1e-5)
+    got2 = F.avg_pool2d(t(x3[:, :, 0]), 2, divisor_override=3)
+    want2 = TF.avg_pool2d(torch.tensor(x3[:, :, 0]), 2,
+                          divisor_override=3).numpy()
+    _cmp(got2, want2, rtol=1e-5)
+    # output_size resolves transposed-conv stride ambiguity
+    x1 = RNG.randn(1, 2, 5).astype(np.float32)
+    w1 = RNG.randn(2, 2, 3).astype(np.float32)
+    for want_len in (9, 10):
+        got = F.conv1d_transpose(t(x1), t(w1), stride=2, padding=1,
+                                 output_size=[want_len])
+        assert got.shape[-1] == want_len, (want_len, got.shape)
+    with pytest.raises(ValueError):
+        F.conv1d_transpose(t(x1), t(w1), stride=2, padding=1,
+                           output_size=[20])
+    # conv2d_transpose shares the core and honors output_size too
+    x2 = RNG.randn(1, 2, 5, 5).astype(np.float32)
+    w2 = RNG.randn(2, 2, 3, 3).astype(np.float32)
+    got = F.conv2d_transpose(t(x2), t(w2), stride=2, padding=1,
+                             output_size=[10, 9])
+    assert tuple(got.shape)[-2:] == (10, 9)
+    # grid_sample: unsupported modes raise instead of silently clamping
+    g = np.zeros((1, 2, 2, 2), np.float32)
+    with pytest.raises(NotImplementedError):
+        F.grid_sample(t(x2), t(g), padding_mode="reflection")
+    # adaptive max pool mask path rejects non-divisible lengths
+    with pytest.raises(AssertionError):
+        nn.AdaptiveMaxPool1D(4, return_mask=True)(
+            t(RNG.randn(1, 2, 10).astype(np.float32)))

@@ -130,6 +130,22 @@ INVENTORY = [
     ("Profiler benchmark timer", "paddle_tpu.profiler", ["benchmark"]),
     ("utils", "paddle_tpu.utils",
      ["run_check", "get_weights_path_from_url", "try_import"]),
+    ("Paged attention (serving KV)", "paddle_tpu.ops.pallas.paged_attention",
+     ["paged_attention", "paged_attention_reference"]),
+    ("Serving engine (batched decode)", "paddle_tpu.inference.serving",
+     ["ServingEngine"]),
+    ("FusedMultiTransformer (serving block)", "paddle_tpu.incubate.nn",
+     ["FusedMultiTransformer"]),
+    ("TCPStore rendezvous (C++)", "paddle_tpu.distributed.native",
+     ["TCPStore", "available"]),
+    ("paddle.distribution", "paddle_tpu.distribution",
+     ["Normal", "Gamma", "Dirichlet", "MultivariateNormal",
+      "TransformedDistribution", "kl_divergence", "register_kl"]),
+    ("Pretrained weights (zoo cache + HF interop)", "paddle_tpu.models.pretrained",
+     ["load_llama_from_hf", "load_gpt_from_hf", "llama_config_from_hf"]),
+    ("nn breadth batch 2 (unpool/3d/losses)", "paddle_tpu.nn",
+     ["MaxUnPool2D", "Conv3DTranspose", "HSigmoidLoss", "Fold",
+      "PixelUnshuffle", "TripletMarginWithDistanceLoss"]),
 ]
 
 
