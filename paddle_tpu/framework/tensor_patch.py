@@ -117,6 +117,7 @@ def monkey_patch_tensor():
         unflatten diagonal_scatter select_scatter slice_scatter index_fill
         tensor_split hsplit vsplit dsplit vander atleast_1d atleast_2d
         atleast_3d
+        sgn cdist unfold trapezoid cumulative_trapezoid rank
     """.split()
     for name in methods:
         fn = getattr(ops, name, None) or getattr(ops.linalg, name, None)

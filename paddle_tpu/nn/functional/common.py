@@ -319,6 +319,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     ksize = _tuple(kernel_size, 2)
     strides = _tuple(stride, 2) if stride is not None else ksize
+    if return_mask:
+        from .extras import _max_pool_with_index, _check_index_pool_args
+        _check_index_pool_args(padding, ceil_mode, data_format, "NCHW")
+        return _max_pool_with_index(x, ksize, strides, _tuple(padding, 2))
     pad = _conv_padding(padding, 2) if not isinstance(padding, str) else padding
     return _pool(x, ksize, strides, pad, lax.max, -jnp.inf, data_format, ceil_mode)
 
@@ -328,6 +332,14 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     ksize = _tuple(kernel_size, 2)
     strides = _tuple(stride, 2) if stride is not None else ksize
     pad = _conv_padding(padding, 2) if not isinstance(padding, str) else padding
+    return _avg_pool_impl(x, ksize, strides, pad, data_format, ceil_mode,
+                          exclusive, divisor_override)
+
+
+def _avg_pool_impl(x, ksize, strides, pad, data_format, ceil_mode,
+                   exclusive, divisor_override):
+    """Shared avg-pool tail: divisor_override = fixed divisor (window
+    sums / divisor), else true mean with the exclusive/include-pad rule."""
     if divisor_override:
         sums = _pool(x, ksize, strides, pad, lax.add, 0.0, data_format,
                      ceil_mode)
@@ -341,6 +353,10 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     ksize = _tuple(kernel_size, 1)
     strides = _tuple(stride, 1) if stride is not None else ksize
+    if return_mask:
+        from .extras import _max_pool_with_index, _check_index_pool_args
+        _check_index_pool_args(padding, ceil_mode, "NCL", "NCL")
+        return _max_pool_with_index(x, ksize, strides, _tuple(padding, 1))
     pad = _conv_padding(padding, 1) if not isinstance(padding, str) else padding
     return _pool(x, ksize, strides, pad, lax.max, -jnp.inf, "NCL", ceil_mode)
 

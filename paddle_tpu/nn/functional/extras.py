@@ -47,16 +47,43 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
+    from .common import _avg_pool_impl
     ksize = _tuple(kernel_size, 3)
     strides = _tuple(stride, 3) if stride is not None else ksize
     pad = _conv_padding(padding, 3) if not isinstance(padding, str) else padding
-    if divisor_override:
-        sums = _pool(x, ksize, strides, pad, lax.add, 0.0, data_format,
-                     ceil_mode)
-        return apply(lambda s: s / float(divisor_override), sums,
-                     op_name="avg_pool_divisor")
-    return _pool(x, ksize, strides, pad, lax.add, 0.0, data_format,
-                 ceil_mode, norm="avg", count_include_pad=not exclusive)
+    return _avg_pool_impl(x, ksize, strides, pad, data_format, ceil_mode,
+                          exclusive, divisor_override)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """General-bin adaptive mean pooling (floor/ceil bin edges — same
+    algorithm as adaptive_avg_pool2d in common.py)."""
+    if data_format != "NCDHW":
+        raise NotImplementedError("adaptive_avg_pool3d: NCDHW only")
+    sizes = _tuple(output_size, 3)
+
+    def fn(a):
+        n, c, d, h, w = a.shape
+        od, oh, ow = sizes[0] or d, sizes[1] or h, sizes[2] or w
+        if d % od == 0 and h % oh == 0 and w % ow == 0:
+            v = a.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+            return v.mean(axis=(3, 5, 7))
+        outs = []
+        for i in range(od):
+            d0, d1 = (i * d) // od, -((-(i + 1) * d) // od)
+            rows = []
+            for j in range(oh):
+                h0, h1 = (j * h) // oh, -((-(j + 1) * h) // oh)
+                cols = []
+                for k in range(ow):
+                    w0, w1 = (k * w) // ow, -((-(k + 1) * w) // ow)
+                    cols.append(a[:, :, d0:d1, h0:h1, w0:w1]
+                                .mean(axis=(2, 3, 4)))
+                rows.append(jnp.stack(cols, -1))
+            outs.append(jnp.stack(rows, -2))
+        return jnp.stack(outs, -3)
+
+    return apply(fn, x, op_name="adaptive_avg_pool3d")
 
 
 def _max_pool_with_index(x, ksize, strides, pads):
@@ -122,7 +149,11 @@ def max_pool1d_with_index(x, kernel_size, stride=None, padding=0):
     return _max_pool_with_index(x, ksize, strides, _tuple(padding, 1))
 
 
-def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size):
+def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size,
+                data_format):
+    if data_format not in ("NCL", "NCHW", "NCDHW"):
+        raise NotImplementedError(
+            f"max_unpool: channels-first only (got {data_format})")
     ksize = _tuple(kernel_size, nd)
     strides = _tuple(stride, nd) if stride is not None else ksize
     pads = _tuple(padding, nd)
@@ -131,14 +162,23 @@ def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size):
         n, c = a.shape[:2]
         outs_in = a.shape[2:]
         if output_size is not None:
-            out_sp = tuple(output_size)[-nd:]
+            out_sp = tuple(int(s) for s in tuple(output_size)[-nd:])
         else:
             out_sp = tuple((outs_in[i] - 1) * strides[i] - 2 * pads[i]
                            + ksize[i] for i in range(nd))
         total = int(np.prod(out_sp))
-        flat = jnp.zeros((n, c, total), a.dtype)
         ai = a.reshape(n, c, -1)
         ii = idx.reshape(n, c, -1).astype(jnp.int32)
+        if not isinstance(ii, jax.core.Tracer):
+            # eager: match the reference's error on out-of-range indices
+            # (under jit XLA silently drops OOB scatters)
+            hi = int(jnp.max(ii)) if ii.size else 0
+            if hi >= total:
+                raise ValueError(
+                    f"max_unpool: index {hi} out of range for output "
+                    f"size {out_sp} ({total} elements) — pass a larger "
+                    "output_size")
+        flat = jnp.zeros((n, c, total), a.dtype)
         flat = flat.at[jnp.arange(n)[:, None, None],
                        jnp.arange(c)[None, :, None], ii].set(ai)
         return flat.reshape((n, c) + out_sp)
@@ -149,19 +189,19 @@ def _max_unpool(x, indices, nd, kernel_size, stride, padding, output_size):
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
                  output_size=None, data_format="NCL", name=None):
     return _max_unpool(x, indices, 1, kernel_size, stride, padding,
-                       output_size)
+                       output_size, data_format)
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  output_size=None, data_format="NCHW", name=None):
     return _max_unpool(x, indices, 2, kernel_size, stride, padding,
-                       output_size)
+                       output_size, data_format)
 
 
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
                  output_size=None, data_format="NCDHW", name=None):
     return _max_unpool(x, indices, 3, kernel_size, stride, padding,
-                       output_size)
+                       output_size, data_format)
 
 
 # ---------------------------------------------------------------------------
@@ -191,6 +231,8 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
 # ---------------------------------------------------------------------------
 
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    if data_format != "NCHW":
+        raise NotImplementedError("pixel_unshuffle: NCHW only")
     r = int(downscale_factor)
 
     def fn(a):
@@ -304,6 +346,8 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
 def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
                    name=None):
     """TSM temporal shift (reference phi temporal_shift kernel)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("temporal_shift: NCHW only")
 
     def fn(a):
         nt, c, h, w = a.shape
@@ -394,7 +438,9 @@ def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
 
 def soft_margin_loss(input, label, reduction="mean", name=None):
     def fn(x, y):
-        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+        # softplus(-yx) == log1p(exp(-yx)) without float32 overflow at
+        # confident wrong predictions
+        return _reduce(jax.nn.softplus(-y * x), reduction)
     return apply(fn, input, label, op_name="soft_margin_loss")
 
 

@@ -216,4 +216,60 @@ def log_loss(input, label, epsilon=1e-4, name=None):
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError("ctc_loss lands with the audio/speech round")
+    """CTC loss via the standard log-space alpha (forward) recursion as one
+    ``lax.scan`` over time (reference: ``phi warpctc_kernel``; layouts per
+    paddle: log_probs [T, B, C] logits or log-probs, labels [B, L]).
+
+    Differentiable through the tape (grad of logsumexp-recursion = the
+    soft alignment posteriors — no custom backward needed)."""
+    import jax
+
+    def fn(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        L = lab.shape[1]
+        S = 2 * L + 1
+        lab = lab.astype(jnp.int32)
+        # extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((B, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        # allowed skip transition s-2 -> s: only onto a non-blank that
+        # differs from the previous non-blank
+        skip_ok = jnp.zeros((B, S), bool)
+        if L > 1:
+            diff = lab[:, 1:] != lab[:, :-1]
+            skip_ok = skip_ok.at[:, 3::2].set(diff)
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, S), neg_inf, jnp.float32)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), ext[:, 0]])
+        if S > 1:
+            alpha0 = alpha0.at[:, 1].set(lp[0, jnp.arange(B), ext[:, 1]])
+
+        def step(alpha, lp_t):
+            stay = alpha
+            prev = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            prev2 = jnp.where(skip_ok, prev2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(stay, prev), prev2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)   # [B, S]
+            return merged + emit, merged + emit
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T,B,S]
+        # per-sequence terminal: t = in_len-1, states 2*lab_len-1 / 2*lab_len
+        tt = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        at_t = alphas[tt, jnp.arange(B)]                   # [B, S]
+        s_last = jnp.clip(2 * lab_len.astype(jnp.int32), 0, S - 1)
+        s_prev = jnp.clip(2 * lab_len.astype(jnp.int32) - 1, 0, S - 1)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(at_t, s_last[:, None], 1)[:, 0],
+            jnp.take_along_axis(at_t, s_prev[:, None], 1)[:, 0])
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        return _reduce(loss, reduction)
+
+    return apply(fn, log_probs, labels, input_lengths, label_lengths,
+                 op_name="ctc_loss")

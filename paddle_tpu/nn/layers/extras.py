@@ -38,26 +38,10 @@ class AdaptiveAvgPool3D(Layer):
     def __init__(self, output_size, data_format="NCDHW", name=None):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        from ...autograd.tape import apply
-        import jax.numpy as jnp
-        sizes = self.output_size
-        if isinstance(sizes, int):
-            sizes = (sizes,) * 3
-
-        def fn(a):
-            n, c, d, h, w = a.shape
-            od = sizes[0] or d
-            oh = sizes[1] or h
-            ow = sizes[2] or w
-            # adaptive = mean over evenly-split bins
-            assert d % od == 0 and h % oh == 0 and w % ow == 0, (
-                "AdaptiveAvgPool3D: non-divisible sizes unsupported")
-            v = a.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
-            return v.mean(axis=(3, 5, 7))
-
-        return apply(fn, x, op_name="adaptive_avg_pool3d")
+        return F.adaptive_avg_pool3d(x, self.output_size, self.data_format)
 
 
 class AdaptiveMaxPool1D(Layer):
@@ -69,16 +53,18 @@ class AdaptiveMaxPool1D(Layer):
     def forward(self, x):
         from ...autograd.tape import apply
         out = int(self.output_size)
+        l = int(x.shape[-1])
+        if l % out != 0:
+            raise ValueError(
+                f"AdaptiveMaxPool1D: length {l} not divisible by "
+                f"output_size {out}")
+        if self.return_mask:
+            return F.max_pool1d_with_index(x, kernel_size=l // out)
 
         def fn(a):
-            n, c, l = a.shape
-            assert l % out == 0, "AdaptiveMaxPool1D: non-divisible length"
-            return a.reshape(n, c, out, l // out).max(axis=-1)
+            n, c, ll = a.shape
+            return a.reshape(n, c, out, ll // out).max(axis=-1)
 
-        if self.return_mask:
-            l = int(x.shape[-1])
-            assert l % out == 0, "AdaptiveMaxPool1D: non-divisible length"
-            return F.max_pool1d_with_index(x, kernel_size=l // out)
         return apply(fn, x, op_name="adaptive_max_pool1d")
 
 
@@ -217,7 +203,8 @@ class CTCLoss(Layer):
     def forward(self, log_probs, labels, input_lengths, label_lengths,
                 norm_by_times=False):
         return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
-                          blank=self.blank, reduction=self.reduction)
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
 
 
 class SoftMarginLoss(Layer):

@@ -226,7 +226,74 @@ def test_review_fixes_extras():
     g = np.zeros((1, 2, 2, 2), np.float32)
     with pytest.raises(NotImplementedError):
         F.grid_sample(t(x2), t(g), padding_mode="reflection")
-    # adaptive max pool mask path rejects non-divisible lengths
-    with pytest.raises(AssertionError):
+    # adaptive max pool rejects non-divisible lengths
+    with pytest.raises(ValueError):
         nn.AdaptiveMaxPool1D(4, return_mask=True)(
             t(RNG.randn(1, 2, 10).astype(np.float32)))
+
+
+def test_ctc_loss_matches_torch():
+    rng = np.random.RandomState(0)
+    T_, B_, C_, L_ = 12, 3, 6, 4
+    logits = rng.randn(T_, B_, C_).astype(np.float32)
+    labels = rng.randint(1, C_, (B_, L_)).astype(np.int64)
+    in_lens = np.array([12, 10, 8], np.int64)
+    lab_lens = np.array([4, 3, 2], np.int64)
+    want = torch.nn.functional.ctc_loss(
+        torch.tensor(logits).log_softmax(-1), torch.tensor(labels),
+        torch.tensor(in_lens), torch.tensor(lab_lens), blank=0,
+        reduction="none").numpy()
+    got = F.ctc_loss(t(logits), paddle.to_tensor(labels),
+                     paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+                     blank=0, reduction="none")
+    _cmp(got, want, rtol=1e-4)
+    # repeated labels exercise the skip-transition mask
+    labels2 = np.array([[2, 2, 3, 3]] * B_, np.int64)
+    want2 = torch.nn.functional.ctc_loss(
+        torch.tensor(logits).log_softmax(-1), torch.tensor(labels2),
+        torch.tensor(in_lens), torch.tensor(lab_lens), blank=0,
+        reduction="none").numpy()
+    got2 = F.ctc_loss(t(logits), paddle.to_tensor(labels2),
+                      paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
+                      blank=0, reduction="none")
+    _cmp(got2, want2, rtol=1e-4)
+    # layer + norm_by_times + grad
+    x = t(logits); x.stop_gradient = False
+    loss = nn.CTCLoss()(x, paddle.to_tensor(labels),
+                        paddle.to_tensor(in_lens),
+                        paddle.to_tensor(lab_lens), norm_by_times=True)
+    loss.backward()
+    assert np.isfinite(np.asarray(x.grad.numpy())).all()
+
+
+def test_second_review_fixes():
+    # max_pool2d/1d return_mask now returns (values, indices)
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    v, idx = F.max_pool2d(t(x), 2, return_mask=True)
+    tv, ti = torch.nn.functional.max_pool2d(torch.tensor(x), 2,
+                                            return_indices=True)
+    np.testing.assert_array_equal(np.asarray(idx.numpy()), ti.numpy())
+    x1 = RNG.randn(2, 3, 8).astype(np.float32)
+    v1, i1 = F.max_pool1d(t(x1), 2, return_mask=True)
+    assert tuple(v1.shape) == (2, 3, 4)
+    # OOB unpool indices raise eagerly
+    with pytest.raises(ValueError, match="out of range"):
+        F.max_unpool2d(v, idx, 2, output_size=[4, 4])
+    # non-channels-first layouts refuse instead of silently misreading
+    with pytest.raises(NotImplementedError):
+        F.pixel_unshuffle(t(x), 2, data_format="NHWC")
+    with pytest.raises(NotImplementedError):
+        F.temporal_shift(t(x), 2, data_format="NHWC")
+    with pytest.raises(NotImplementedError):
+        F.max_unpool2d(v, idx, 2, data_format="NHWC")
+    # soft_margin_loss stable at confident wrong predictions
+    big = F.soft_margin_loss(t(np.float32([[100.0]])),
+                             t(np.float32([[-1.0]])))
+    assert np.isfinite(big.numpy()).all() and abs(float(big.numpy()) - 100) < 1
+    # adaptive_avg_pool3d non-divisible general path
+    x5 = RNG.randn(1, 2, 5, 7, 5).astype(np.float32)
+    got = F.adaptive_avg_pool3d(t(x5), 3)
+    want = TF.adaptive_avg_pool3d(torch.tensor(x5), 3).numpy()
+    _cmp(got, want, rtol=1e-5)
+    got_l = nn.AdaptiveAvgPool3D(3)(t(x5))
+    _cmp(got_l, want, rtol=1e-5)

@@ -1,0 +1,48 @@
+"""Export the derived op schema (reference L0 codegen analogue):
+writes ``paddle_tpu/ops/ops.yaml`` and ``docs/OPS.md`` from the registry
+in ``paddle_tpu/ops/schema.py``. Run after adding ops; CI
+(tests/test_op_schema.py) fails if the committed export is stale."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.ops import schema  # noqa: E402
+
+
+def main():
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    reg = schema.build_registry()
+
+    with open(os.path.join(root, "paddle_tpu", "ops", "ops.yaml"), "w") as f:
+        f.write(schema.to_yaml(reg))
+
+    s = schema.summary(reg)
+    lines = ["# Op surface (generated — tools/gen_op_schema.py)", "",
+             f"{s['total_ops']} public ops "
+             f"({s['tensor_methods']} tensor methods, "
+             f"{s['inplace_variants']} in-place variants).",
+             "",
+             "| op | module | signature | method | inplace |",
+             "|---|---|---|---|---|"]
+    for name in sorted(reg):
+        sp = reg[name]
+        sig = sp.signature.replace("|", "\\|")
+        if len(sig) > 80:
+            sig = sig[:77] + "..."
+        lines.append(f"| {name} | {sp.module} | `{sig}` | "
+                     f"{'x' if sp.tensor_method else ''} | "
+                     f"{'x' if sp.inplace_variant else ''} |")
+    os.makedirs(os.path.join(root, "docs"), exist_ok=True)
+    with open(os.path.join(root, "docs", "OPS.md"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"exported {s['total_ops']} ops "
+          f"({s['tensor_methods']} methods) -> ops.yaml, docs/OPS.md")
+
+
+if __name__ == "__main__":
+    main()
