@@ -79,8 +79,11 @@ class BertEmbeddings(Layer):
             position_ids = C.arange(0, input_ids.shape[1], dtype="int64")
         emb = (self.word_embeddings(input_ids) +
                self.position_embeddings(position_ids))
-        if token_type_ids is not None:
-            emb = emb + self.token_type_embeddings(token_type_ids)
+        if token_type_ids is None:
+            # reference semantics: absent segment ids mean segment 0 —
+            # the type-0 embedding is still added
+            token_type_ids = C.zeros(list(input_ids.shape), dtype="int64")
+        emb = emb + self.token_type_embeddings(token_type_ids)
         return self.dropout(self.layer_norm(emb))
 
 

@@ -153,5 +153,75 @@ def load_gpt_from_hf(model, model_dir, dtype="float32"):
             raise ValueError(f"shape mismatch for {tgt}: checkpoint "
                              f"{arr.shape} vs model {want}")
         mapped[tgt] = arr.astype(dtype)
+    missing = [k for k in own if k not in mapped]
+    if missing:
+        raise ValueError(
+            f"BERT checkpoint left parameters unmapped (random init would "
+            f"be silent garbage): {missing[:8]}")
+    model.set_state_dict(mapped)
+    return model
+
+
+def bert_config_from_hf(model_dir, **overrides):
+    from .bert import BertConfig
+    cfg = load_hf_config(model_dir)
+    fields = dict(
+        vocab_size=cfg.get("vocab_size", 30522),
+        hidden_size=cfg.get("hidden_size", 768),
+        num_hidden_layers=cfg.get("num_hidden_layers", 12),
+        num_attention_heads=cfg.get("num_attention_heads", 12),
+        intermediate_size=cfg.get("intermediate_size", 3072),
+        hidden_act=cfg.get("hidden_act", "gelu"),
+        hidden_dropout_prob=cfg.get("hidden_dropout_prob", 0.1),
+        attention_probs_dropout_prob=cfg.get(
+            "attention_probs_dropout_prob", 0.1),
+        max_position_embeddings=cfg.get("max_position_embeddings", 512),
+        type_vocab_size=cfg.get("type_vocab_size", 2),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-12),
+    )
+    fields.update(overrides)
+    return BertConfig(**fields)
+
+
+def load_bert_from_hf(model, model_dir, dtype="float32"):
+    """Fill a ``BertModel`` from an HF BERT checkpoint dir (post-LN
+    naming: attention.output.LayerNorm -> norm1, output.LayerNorm ->
+    norm2; all torch Linears transpose to [in, out])."""
+    raw = _read_hf_weights(model_dir)
+    own = model.state_dict()
+    mapped = {}
+    for name, arr in raw.items():
+        n = _strip_prefix(name, ("bert.",))
+        # old TF-converted checkpoints: LayerNorm.gamma/beta
+        n = n.replace(".LayerNorm.gamma", ".LayerNorm.weight") \
+             .replace(".LayerNorm.beta", ".LayerNorm.bias")
+        tgt = None
+        if n.startswith("embeddings."):
+            tgt = n.replace(".LayerNorm.", ".layer_norm.")
+        elif n.startswith("encoder.layer."):
+            tgt = "encoder.layers." + n[len("encoder.layer."):]
+            for hf, ours in (
+                    (".attention.self.query.", ".self_attn.q_proj."),
+                    (".attention.self.key.", ".self_attn.k_proj."),
+                    (".attention.self.value.", ".self_attn.v_proj."),
+                    (".attention.output.dense.", ".self_attn.out_proj."),
+                    (".attention.output.LayerNorm.", ".norm1."),
+                    (".intermediate.dense.", ".linear1."),
+                    (".output.dense.", ".linear2."),
+                    (".output.LayerNorm.", ".norm2.")):
+                tgt = tgt.replace(hf, ours)
+        elif n.startswith("pooler.dense."):
+            tgt = n
+        if tgt is None or tgt not in own:
+            continue
+        if arr.ndim == 2 and "word_embeddings" not in tgt \
+                and "position_embeddings" not in tgt \
+                and "token_type_embeddings" not in tgt:
+            arr = arr.T           # torch Linear [out, in] -> [in, out]
+        want = tuple(own[tgt].shape)
+        if tuple(arr.shape) != want:
+            raise ValueError(f"shape mismatch for {tgt}: checkpoint "
+                             f"{arr.shape} vs model {want}")
+        mapped[tgt] = arr.astype(dtype)
     model.set_state_dict(mapped)
     return model

@@ -205,6 +205,8 @@ class ColorJitter(BaseTransform):
         super().__init__(keys)
         self.brightness = brightness
         self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
 
     def _apply_image(self, img):
         arr = _to_hwc_array(img).astype(np.float32)
@@ -215,6 +217,17 @@ class ColorJitter(BaseTransform):
             mean = arr.mean()
             arr = (arr - mean) * np.random.uniform(max(0, 1 - self.contrast),
                                                    1 + self.contrast) + mean
+        if (self.saturation or self.hue) and arr.ndim == 3 \
+                and arr.shape[-1] == 3:
+            hsv = _rgb_to_hsv(np.clip(arr, 0, 255) / 255.0)
+            if self.saturation:
+                f = np.random.uniform(max(0, 1 - self.saturation),
+                                      1 + self.saturation)
+                hsv[..., 1] = np.clip(hsv[..., 1] * f, 0, 1)
+            if self.hue:
+                hsv[..., 0] = (hsv[..., 0]
+                               + np.random.uniform(-self.hue, self.hue)) % 1.0
+            arr = _hsv_to_rgb(hsv) * 255.0
         return np.clip(arr, 0, 255).astype(np.uint8)
 
 
@@ -264,3 +277,162 @@ def center_crop(img, output_size):
 
 def pad(img, padding, fill=0, padding_mode="constant"):
     return Pad(padding, fill, padding_mode)(img)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img).astype(np.float32)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        return np.clip((arr - mean) * f + mean, 0, 255).astype(np.uint8)
+
+
+def _rgb_to_hsv(arr):
+    """arr float [H, W, 3] in [0, 1] -> hsv same shape."""
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    mx = arr.max(-1)
+    mn = arr.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    h = np.where(mx == r, (g - b) / diff % 6.0, h)
+    h = np.where(mx == g, (b - r) / diff + 2.0, h)
+    h = np.where(mx == b, (r - g) / diff + 4.0, h)
+    h = h / 6.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+    return np.stack([h, s, mx], -1)
+
+
+def _hsv_to_rgb(hsv):
+    h, s, v = hsv[..., 0] * 6.0, hsv[..., 1], hsv[..., 2]
+    i = np.floor(h).astype(np.int32) % 6
+    f = h - np.floor(h)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    out = np.select(
+        [(i == 0)[..., None], (i == 1)[..., None], (i == 2)[..., None],
+         (i == 3)[..., None], (i == 4)[..., None], (i == 5)[..., None]],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return out
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        if arr.ndim != 3 or arr.shape[-1] != 3:
+            return arr            # grayscale has no saturation
+        arr = arr.astype(np.float32) / 255.0
+        hsv = _rgb_to_hsv(arr)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        hsv[..., 1] = np.clip(hsv[..., 1] * f, 0, 1)
+        return np.clip(_hsv_to_rgb(hsv) * 255.0, 0, 255).astype(np.uint8)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value          # in [0, 0.5]
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        if arr.ndim != 3 or arr.shape[-1] != 3:
+            return arr            # grayscale has no hue
+        arr = arr.astype(np.float32) / 255.0
+        hsv = _rgb_to_hsv(arr)
+        shift = np.random.uniform(-self.value, self.value)
+        hsv[..., 0] = (hsv[..., 0] + shift) % 1.0
+        return np.clip(_hsv_to_rgb(hsv) * 255.0, 0, 255).astype(np.uint8)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img).astype(np.float32)
+        if arr.ndim == 2:
+            g = arr               # already single-channel
+        elif arr.shape[-1] == 1:
+            g = arr[..., 0]
+        else:
+            g = (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+                 + 0.114 * arr[..., 2])
+        out = np.repeat(g[..., None], self.n, axis=-1)
+        return np.clip(out, 0, 255).astype(np.uint8)
+
+
+class RandomRotation(BaseTransform):
+    """Rotation by a uniform angle in ``degrees`` — supports nearest and
+    bilinear interpolation, custom ``center``, and ``expand`` (canvas
+    grows to fit the rotated image); no scipy dependency."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            degrees = (-float(degrees), float(degrees))
+        if interpolation not in ("nearest", "bilinear"):
+            raise NotImplementedError(
+                f"RandomRotation: interpolation {interpolation!r} "
+                "unsupported (nearest/bilinear)")
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        h, w = arr.shape[:2]
+        ang = np.deg2rad(np.random.uniform(*self.degrees))
+        if self.center is not None:
+            cx, cy = float(self.center[0]), float(self.center[1])
+        else:
+            cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        if self.expand:
+            # output canvas bounding the rotated input rectangle
+            oh = int(np.ceil(abs(h * np.cos(ang)) + abs(w * np.sin(ang))))
+            ow = int(np.ceil(abs(h * np.sin(ang)) + abs(w * np.cos(ang))))
+            ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+        else:
+            oh, ow, ocy, ocx = h, w, cy, cx
+        yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+        # inverse map: output pixel -> source coordinate
+        ys = cy + (yy - ocy) * np.cos(ang) - (xx - ocx) * np.sin(ang)
+        xs = cx + (yy - ocy) * np.sin(ang) + (xx - ocx) * np.cos(ang)
+        shape = ((oh, ow) + arr.shape[2:])
+
+        def gather(yi, xi):
+            inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            src = arr.astype(np.float32)[np.clip(yi, 0, h - 1),
+                                         np.clip(xi, 0, w - 1)]
+            m = inb[..., None] if arr.ndim == 3 else inb
+            return np.where(m, src, float(self.fill))
+
+        if self.interpolation == "nearest":
+            out = gather(np.round(ys).astype(np.int64),
+                         np.round(xs).astype(np.int64))
+        else:
+            y0 = np.floor(ys).astype(np.int64)
+            x0 = np.floor(xs).astype(np.int64)
+            wy = (ys - y0)[..., None] if arr.ndim == 3 else ys - y0
+            wx = (xs - x0)[..., None] if arr.ndim == 3 else xs - x0
+            out = (gather(y0, x0) * (1 - wy) * (1 - wx)
+                   + gather(y0, x0 + 1) * (1 - wy) * wx
+                   + gather(y0 + 1, x0) * wy * (1 - wx)
+                   + gather(y0 + 1, x0 + 1) * wy * wx)
+        out = out.reshape(shape)
+        if arr.dtype == np.uint8:
+            out = np.clip(out, 0, 255).astype(np.uint8)
+        return out

@@ -80,3 +80,35 @@ def test_gpt2_weights_map(tmp_path):
         got = got[0]
     np.testing.assert_allclose(np.asarray(got.numpy()), want,
                                rtol=2e-4, atol=2e-4)
+
+
+def test_bert_logits_match_transformers(tmp_path):
+    cfg = transformers.BertConfig(
+        vocab_size=120, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=48, type_vocab_size=2)
+    torch.manual_seed(0)
+    hf = transformers.BertModel(cfg)
+    hf.eval()
+    hf.save_pretrained(tmp_path)
+
+    from paddle_tpu.models import BertModel
+    from paddle_tpu.models.pretrained import (bert_config_from_hf,
+                                              load_bert_from_hf)
+    model = BertModel(bert_config_from_hf(str(tmp_path)))
+    load_bert_from_hf(model, str(tmp_path))
+    model.eval()
+
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 120, (2, 9)).astype(np.int64)
+    with torch.no_grad():
+        out = hf(torch.tensor(ids))
+        want_seq = out.last_hidden_state.numpy()
+        want_pooled = out.pooler_output.numpy()
+    got = model(paddle.to_tensor(ids))
+    got_seq, got_pooled = (got if isinstance(got, tuple) else (got, None))
+    np.testing.assert_allclose(np.asarray(got_seq.numpy()), want_seq,
+                               rtol=2e-4, atol=2e-4)
+    if got_pooled is not None:
+        np.testing.assert_allclose(np.asarray(got_pooled.numpy()),
+                                   want_pooled, rtol=2e-4, atol=2e-4)
