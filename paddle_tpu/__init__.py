@@ -47,6 +47,7 @@ from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import sparse  # noqa: F401
 from . import distribution  # noqa: F401
+from . import geometric  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import callbacks  # noqa: F401

@@ -1,0 +1,136 @@
+"""paddle.geometric — graph learning primitives (reference:
+``python/paddle/geometric/`` — ``math.py`` segment ops backed by phi
+``segment_pool`` kernels, ``message_passing/send_recv.py``
+``send_u_recv``/``send_ue_recv``/``send_uv`` backed by
+``graph_send_recv`` kernels).
+
+TPU-native: every op is a jnp ``segment_*`` / gather composition — XLA
+lowers the unsorted-segment reductions to efficient one-hot matmuls or
+scatters on the MXU, which is exactly how GNN aggregation is done on TPU
+(no CUDA atomic-scatter kernel needed). ``out_size``/``num_segments``
+must be static under jit (pass it explicitly inside traced code).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .framework.core import Tensor
+from .autograd.tape import apply
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _n_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    arr = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+    if isinstance(arr, jax.core.Tracer):
+        raise ValueError(
+            "segment op under jit: the output size is data-dependent — "
+            "pass num_segments explicitly")
+    return int(jax.device_get(arr.max())) + 1 if arr.size else 0
+
+
+def _segment(x, ids, num, op):
+    def fn(a, i):
+        return _segment_raw(a, i, num, op)
+    return apply(fn, x, ids, op_name=f"segment_{op}")
+
+
+# num_segments is an extension kwarg over the reference signature: the
+# output row count is data-dependent (max id + 1), which cannot be derived
+# under a jit trace — pass it explicitly in traced code.
+
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    return _segment(data, segment_ids,
+                    _n_segments(segment_ids, num_segments), "sum")
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    return _segment(data, segment_ids,
+                    _n_segments(segment_ids, num_segments), "mean")
+
+
+def segment_max(data, segment_ids, num_segments=None, name=None):
+    return _segment(data, segment_ids,
+                    _n_segments(segment_ids, num_segments), "max")
+
+
+def segment_min(data, segment_ids, num_segments=None, name=None):
+    return _segment(data, segment_ids,
+                    _n_segments(segment_ids, num_segments), "min")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather source-node features along edges, reduce at destinations.
+    Default output row count is ``x.shape[0]`` (the reference's
+    node-count semantics), so edge-less nodes keep their zero row."""
+    num = int(x.shape[0]) if out_size is None else int(out_size)
+
+    def fn(a, src, dst):
+        msgs = jnp.take(a, src.astype(jnp.int32), axis=0)
+        return _segment_raw(msgs, dst, num, reduce_op)
+    return apply(fn, x, src_index, dst_index, op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine source-node features with edge features, reduce at
+    destinations (message_op: add | sub | mul | div). Default output row
+    count is ``x.shape[0]`` like the reference."""
+    num = int(x.shape[0]) if out_size is None else int(out_size)
+
+    def fn(a, e, src, dst):
+        msgs = jnp.take(a, src.astype(jnp.int32), axis=0)
+        if message_op == "add":
+            msgs = msgs + e
+        elif message_op == "sub":
+            msgs = msgs - e
+        elif message_op == "mul":
+            msgs = msgs * e
+        elif message_op == "div":
+            msgs = msgs / e
+        else:
+            raise ValueError(message_op)
+        return _segment_raw(msgs, dst, num, reduce_op)
+    return apply(fn, x, y, src_index, dst_index, op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from source and destination node features."""
+
+    def fn(a, b, src, dst):
+        u = jnp.take(a, src.astype(jnp.int32), axis=0)
+        v = jnp.take(b, dst.astype(jnp.int32), axis=0)
+        if message_op == "add":
+            return u + v
+        if message_op == "sub":
+            return u - v
+        if message_op == "mul":
+            return u * v
+        if message_op == "div":
+            return u / v
+        raise ValueError(message_op)
+    return apply(fn, x, y, src_index, dst_index, op_name="send_uv")
+
+
+def _segment_raw(msgs, dst, num, reduce_op):
+    dst = dst.astype(jnp.int32)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst, num)
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, dst, num)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
+                                  dst, num)
+        shape = (num,) + (1,) * (msgs.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1.0)
+    if reduce_op == "max":
+        out = jax.ops.segment_max(msgs, dst, num)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    if reduce_op == "min":
+        out = jax.ops.segment_min(msgs, dst, num)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+    raise ValueError(reduce_op)

@@ -79,13 +79,17 @@ class Optimizer:
     # -- the eager step ------------------------------------------------------
     @no_grad()
     def step(self):
+        # accept plain Tensors with stop_gradient=False, like the
+        # reference (Parameter.trainable; Tensor -> not stop_gradient)
         params_grads = [(p, p.grad) for p in self._parameter_list
-                        if p.grad is not None and p.trainable]
+                        if p.grad is not None
+                        and getattr(p, "trainable", not p.stop_gradient)]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
         for p, g in params_grads:
-            group_lr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            group_lr = lr * getattr(p, "optimize_attr",
+                                    {}).get("learning_rate", 1.0)
             slots = self._get_slots(p)
             self._step_t[id(p)] += 1
             t = self._step_t[id(p)]
@@ -363,8 +367,11 @@ class Lamb(Optimizer):
         else:
             self._excluded_now = set()
         self._current_param = None
+        # accept plain Tensors with stop_gradient=False, like the
+        # reference (Parameter.trainable; Tensor -> not stop_gradient)
         params_grads = [(p, p.grad) for p in self._parameter_list
-                        if p.grad is not None and p.trainable]
+                        if p.grad is not None
+                        and getattr(p, "trainable", not p.stop_gradient)]
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
@@ -401,3 +408,5 @@ class L2Decay:
 class L1Decay:
     def __init__(self, coeff=0.0):
         self._coeff = coeff
+
+from .extras import Rprop, ASGD, NAdam, RAdam, LBFGS  # noqa: E402,F401

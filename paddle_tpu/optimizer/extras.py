@@ -1,0 +1,282 @@
+"""Optimizer breadth batch (reference: ``python/paddle/optimizer/`` —
+``rprop.py``, ``asgd.py``, ``nadam.py``, ``radam.py``, ``lbfgs.py``)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import Optimizer
+
+
+class Rprop(Optimizer):
+    """Resilient backprop: per-element step sizes grown/shrunk by the
+    gradient sign agreement (reference ``paddle.optimizer.Rprop``)."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._lr_range = learning_rate_range
+        self._etas = etas
+
+    def _init_slots(self, p):
+        try:
+            lr0 = float(self.get_lr())
+        except TypeError:
+            lr0 = 0.001
+        return {"prev_grad": jnp.zeros_like(p),
+                "step_size": jnp.full_like(p, lr0)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        eta_neg, eta_pos = self._etas
+        lo, hi = self._lr_range
+        sign = jnp.sign(g * slots["prev_grad"])
+        factor = jnp.where(sign > 0, eta_pos,
+                           jnp.where(sign < 0, eta_neg, 1.0))
+        step = jnp.clip(slots["step_size"] * factor, lo, hi)
+        # on sign change: zero the gradient for this step (classic Rprop-)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        p = p - jnp.sign(g_eff) * step
+        return p, {"prev_grad": g_eff, "step_size": step}
+
+
+class ASGD(Optimizer):
+    """SGD over the average of the last ``batch_num`` gradients
+    (reference ``paddle.optimizer.ASGD``: a circular gradient buffer of
+    ``batch_num`` entries, update with the running mean)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._n = max(int(batch_num), 1)
+
+    def _init_slots(self, p):
+        return {"grad_sum": jnp.zeros_like(p),
+                "buffer": jnp.zeros((self._n,) + p.shape, p.dtype)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        idx = (t - 1) % self._n
+        old = slots["buffer"][idx]
+        gsum = slots["grad_sum"] - old + g
+        buf = slots["buffer"].at[idx].set(g)
+        denom = min(t, self._n)
+        p = p - lr * gsum / denom
+        return p, {"grad_sum": gsum, "buffer": buf}
+
+
+class NAdam(Optimizer):
+    """Adam with Nesterov momentum (reference ``paddle.optimizer.NAdam``,
+    Dozat 2016 momentum-decay schedule)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+        self._psi = momentum_decay
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p),
+                "mu_prod": jnp.ones((), jnp.float32)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        b1, b2 = self._beta1, self._beta2
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = slots["mu_prod"] * mu_t
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        mhat = (mu_next * m / (1 - mu_prod * mu_next)
+                + (1 - mu_t) * g / (1 - mu_prod))
+        vhat = v / (1 - b2 ** t)
+        p = p - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return p, {"moment1": m, "moment2": v, "mu_prod": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference ``paddle.optimizer.RAdam``, Liu 2020:
+    variance-rectification term, SGD-with-momentum fallback early on)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    def _apply(self, p, g, slots, lr, t, wd):
+        if wd:
+            g = g + wd * p
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1 - b2 ** t)
+        if rho_t > 5.0:
+            vhat = jnp.sqrt(v / (1 - b2 ** t))
+            r = math.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                          / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            p = p - lr * r * mhat / (vhat + self._epsilon)
+        else:
+            p = p - lr * mhat
+        return p, {"moment1": m, "moment2": v}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with closure-based ``step`` (reference
+    ``paddle.optimizer.LBFGS``: two-loop recursion over a bounded
+    (s, y) history; optional strong-Wolfe line search)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        if grad_clip is not None:
+            raise ValueError(
+                "LBFGS: grad_clip is incompatible with the closure-based "
+                "line search (clipping would break the Wolfe conditions)")
+        super().__init__(learning_rate, parameters, weight_decay, None,
+                         name, False)
+        self._wd = (float(weight_decay) if isinstance(weight_decay,
+                                                      (int, float)) else 0.0)
+        self.max_iter = max_iter
+        self.max_eval = max_eval or max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s, self._y = [], []
+        self._prev_flat_grad = None
+
+    # -- flat helpers --------------------------------------------------------
+    def _params(self):
+        return [p for p in self._parameter_list
+                if getattr(p, "trainable", not p.stop_gradient)]
+
+    def _gather_flat_grad(self):
+        # a parameter outside the closure's loss has grad None -> zeros
+        flat = jnp.concatenate([
+            jnp.ravel(p.grad._data) if p.grad is not None
+            else jnp.zeros(int(np.prod(p.shape)) if p.shape else 1,
+                           jnp.float32)
+            for p in self._params()])
+        if self._wd:
+            flat = flat + self._wd * self._flat_params()
+        return flat
+
+    def _flat_params(self):
+        return jnp.concatenate([jnp.ravel(p._data) for p in self._params()])
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            p._data = flat[off:off + n].reshape(p.shape).astype(p.dtype)
+            off += n
+
+    def _direction(self, flat_grad):
+        """Two-loop recursion: H·g over the stored (s, y) pairs."""
+        q = flat_grad
+        alphas = []
+        for s, y in reversed(list(zip(self._s, self._y))):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            alphas.append((a, rho))
+            q = q - a * y
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10)
+            q = q * gamma
+        for (a, rho), (s, y) in zip(reversed(alphas),
+                                    zip(self._s, self._y)):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    def _eval(self, closure, flat):
+        """Set params to ``flat`` and re-evaluate. The closure follows the
+        reference contract: clear grads, run forward, call backward, and
+        return the loss tensor."""
+        self._set_flat_params(flat)
+        loss = closure()
+        return float(loss.numpy()), self._gather_flat_grad()
+
+    def step(self, closure):
+        """Run up to ``max_iter`` L-BFGS iterations; returns final loss."""
+        loss, flat_grad = self._eval(closure, self._flat_params())
+        evals = 1
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(flat_grad))) <= self.tol_grad:
+                break
+            d = self._direction(flat_grad)
+            x0 = self._flat_params()
+            g0_dot_d = float(jnp.vdot(flat_grad, d))
+            if g0_dot_d > -1e-15:      # not a descent direction: reset
+                self._s, self._y = [], []
+                d = -flat_grad
+                g0_dot_d = float(jnp.vdot(flat_grad, d))
+            lr = float(self.get_lr())
+            if self.line_search_fn == "strong_wolfe":
+                c1, c2 = 1e-4, 0.9
+                t = lr
+                t_eval = None            # step the params CURRENTLY sit at
+                for _ls in range(20):
+                    new_loss, new_grad = self._eval(closure, x0 + t * d)
+                    t_eval = t
+                    evals += 1
+                    if new_loss > loss + c1 * t * g0_dot_d:
+                        t *= 0.5          # Armijo failed: shrink
+                    elif abs(float(jnp.vdot(new_grad, d))) \
+                            > c2 * abs(g0_dot_d):
+                        # curvature failed: widen/shrink and retry
+                        t *= 2.0 if float(jnp.vdot(new_grad, d)) \
+                            < 0 else 0.5
+                    else:
+                        break             # both Wolfe conditions hold
+                    if evals >= self.max_eval:
+                        break
+                if t != t_eval:
+                    # loop exited right after proposing a new t: evaluate
+                    # it so params/loss/grad and the (s, y) pair agree
+                    new_loss, new_grad = self._eval(closure, x0 + t * d)
+                    t_eval = t
+                    evals += 1
+                t = t_eval
+            else:
+                t = lr
+                new_loss, new_grad = self._eval(closure, x0 + t * d)
+                evals += 1
+            s = t * d
+            y = new_grad - flat_grad
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if abs(new_loss - loss) < self.tol_change:
+                loss, flat_grad = new_loss, new_grad
+                break
+            loss, flat_grad = new_loss, new_grad
+            if evals >= self.max_eval:
+                break
+        from ..framework.core import Tensor
+        return Tensor(jnp.asarray(loss, jnp.float32))

@@ -1,0 +1,110 @@
+"""paddle.geometric segment + message-passing ops vs numpy oracles
+(reference: test/legacy_test/test_segment_ops.py, test_graph_send_recv)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+
+RNG = np.random.RandomState(0)
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+def test_segment_ops():
+    data = RNG.randn(6, 3).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 3], np.int64)   # segment 2 empty
+    got = np.asarray(G.segment_sum(t(data), t(ids)).numpy())
+    want = np.zeros((4, 3), np.float32)
+    for i, s in enumerate(ids):
+        want[s] += data[i]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    gm = np.asarray(G.segment_mean(t(data), t(ids)).numpy())
+    np.testing.assert_allclose(gm[0], data[:2].mean(0), rtol=1e-6)
+    np.testing.assert_allclose(gm[2], 0.0)          # empty -> 0
+
+    gx = np.asarray(G.segment_max(t(data), t(ids)).numpy())
+    np.testing.assert_allclose(gx[1], data[2:5].max(0), rtol=1e-6)
+    np.testing.assert_allclose(gx[2], 0.0)
+    gn = np.asarray(G.segment_min(t(data), t(ids)).numpy())
+    np.testing.assert_allclose(gn[1], data[2:5].min(0), rtol=1e-6)
+
+
+def test_send_u_recv_and_grad():
+    x = RNG.randn(4, 2).astype(np.float32)
+    src = np.array([0, 1, 2, 3, 1], np.int64)
+    dst = np.array([1, 2, 1, 0, 0], np.int64)
+    out = np.asarray(G.send_u_recv(t(x), t(src), t(dst),
+                                   reduce_op="sum").numpy())
+    # reference semantics: output has x.shape[0] rows — node 3 has no
+    # incoming edge and keeps a zero row
+    want = np.zeros((4, 2), np.float32)
+    for s, d in zip(src, dst):
+        want[d] += x[s]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    xt = t(x)
+    xt.stop_gradient = False
+    G.send_u_recv(xt, t(src), t(dst), reduce_op="sum",
+                  out_size=4).sum().backward()
+    g = np.asarray(xt.grad.numpy())
+    # node 1 feeds two edges -> grad 2, others 1
+    np.testing.assert_allclose(g[:, 0], [1, 2, 1, 1], rtol=1e-6)
+
+
+def test_send_ue_recv_and_uv():
+    x = RNG.randn(3, 2).astype(np.float32)
+    e = RNG.randn(4, 2).astype(np.float32)
+    src = np.array([0, 1, 2, 0], np.int64)
+    dst = np.array([1, 0, 0, 2], np.int64)
+    out = np.asarray(G.send_ue_recv(t(x), t(e), t(src), t(dst),
+                                    message_op="mul",
+                                    reduce_op="max").numpy())
+    msgs = x[src] * e
+    want = np.full((3, 2), -np.inf, np.float32)   # 3 nodes here
+    for i, d in enumerate(dst):
+        want[d] = np.maximum(want[d], msgs[i])
+    want[np.isinf(want)] = 0.0
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+    uv = np.asarray(G.send_uv(t(x), t(x), t(src), t(dst),
+                              message_op="sub").numpy())
+    np.testing.assert_allclose(uv, x[src] - x[dst], rtol=1e-6)
+
+
+def test_jit_with_out_size():
+    x = RNG.randn(5, 2).astype(np.float32)
+    ids = np.array([0, 1, 1, 2, 2], np.int64)
+
+    def fn(a):
+        # num_segments passed explicitly: traceable, no graph break
+        return G.segment_sum(a, t(ids), num_segments=3)
+
+    static = paddle.jit.to_static(fn)
+    got = np.asarray(static(t(x)).numpy())
+    np.testing.assert_allclose(got, np.asarray(fn(t(x)).numpy()),
+                               rtol=1e-6)
+
+
+def test_lbfgs_partial_params_and_wd():
+    import paddle_tpu.optimizer as opt
+    w1 = paddle.to_tensor(np.array([2.0], np.float32))
+    w2 = paddle.to_tensor(np.array([5.0], np.float32))
+    w1.stop_gradient = False
+    w2.stop_gradient = False
+    o = opt.LBFGS(learning_rate=0.5, max_iter=5, parameters=[w1, w2])
+
+    def closure():
+        o.clear_grad()
+        loss = (w1 ** 2).sum()     # w2 unused -> grad None
+        loss.backward()
+        return loss
+
+    o.step(closure)                # must not crash on w2.grad is None
+    assert abs(float(w2.numpy()[0]) - 5.0) < 1e-6   # untouched
+    import pytest as _pytest
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    with _pytest.raises(ValueError):
+        opt.LBFGS(parameters=[w1], grad_clip=ClipGradByGlobalNorm(1.0))

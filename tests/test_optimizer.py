@@ -149,3 +149,53 @@ def test_regression_convergence():
         opt.step()
         opt.clear_grad()
     np.testing.assert_allclose(net.weight.numpy(), w_true, atol=0.05)
+
+
+def test_extra_optimizers_converge():
+    """Rprop/ASGD/NAdam/RAdam minimize a quadratic; parity sanity on a
+    1-step Adam-family bound (reference optimizer test pattern)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt
+
+    target = np.array([1.5, -2.0, 0.5], np.float32)
+    for cls, kw in [(opt.Rprop, {}), (opt.ASGD, {"batch_num": 2}),
+                    (opt.NAdam, {}), (opt.RAdam, {})]:
+        paddle.seed(0)
+        w = paddle.to_tensor(np.zeros(3, np.float32))
+        w.stop_gradient = False
+        o = cls(learning_rate=0.1, parameters=[w], **kw)
+        for _ in range(200):
+            loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        got = np.asarray(w.numpy())
+        np.testing.assert_allclose(got, target, atol=0.15,
+                                   err_msg=cls.__name__)
+
+
+def test_lbfgs_rosenbrock():
+    """LBFGS with strong-Wolfe line search solves Rosenbrock in a handful
+    of closure steps (the classic L-BFGS acceptance test)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt
+
+    w = paddle.to_tensor(np.array([-1.0, 1.0], np.float32))
+    w.stop_gradient = False
+    o = opt.LBFGS(learning_rate=1.0, max_iter=25,
+                  line_search_fn="strong_wolfe", parameters=[w])
+
+    def closure():
+        o.clear_grad()
+        x, y = w[0], w[1]
+        loss = (1 - x) ** 2 + 100 * (y - x ** 2) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(8):
+        loss = o.step(closure)
+    final = np.asarray(w.numpy())
+    np.testing.assert_allclose(final, [1.0, 1.0], atol=1e-2)
+    assert float(loss.numpy()) < 1e-4
