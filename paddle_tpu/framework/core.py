@@ -187,7 +187,17 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self):
-        return np.asarray(jax.device_get(self._data))
+        try:
+            return np.asarray(jax.device_get(self._data))
+        except RuntimeError as e:
+            if "deleted" in str(e).lower() or "donated" in str(e).lower():
+                # donation/aliasing misuse guard (SURVEY.md §5.2 TPU
+                # equivalent of StreamSafeCUDAAllocator's reuse guard)
+                raise RuntimeError(
+                    "Tensor used after its device buffer was donated to a "
+                    "jitted call (donate_argnums) — keep the returned "
+                    "tensor instead of the donated input") from e
+            raise
 
     def item(self, *args):
         return self.numpy().item(*args)

@@ -121,16 +121,16 @@ def _segment_raw(msgs, dst, num, reduce_op):
     dst = dst.astype(jnp.int32)
     if reduce_op == "sum":
         return jax.ops.segment_sum(msgs, dst, num)
+    shape = (num,) + (1,) * (msgs.ndim - 1)
+    cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), jnp.float32),
+                              dst, num).reshape(shape)
     if reduce_op == "mean":
         s = jax.ops.segment_sum(msgs, dst, num)
-        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
-                                  dst, num)
-        shape = (num,) + (1,) * (msgs.ndim - 1)
-        return s / jnp.maximum(cnt.reshape(shape), 1.0)
-    if reduce_op == "max":
-        out = jax.ops.segment_max(msgs, dst, num)
-        return jnp.where(jnp.isfinite(out), out, 0.0)
-    if reduce_op == "min":
-        out = jax.ops.segment_min(msgs, dst, num)
-        return jnp.where(jnp.isfinite(out), out, 0.0)
+        return s / jnp.maximum(cnt, 1.0).astype(msgs.dtype)
+    if reduce_op in ("max", "min"):
+        out = (jax.ops.segment_max if reduce_op == "max"
+               else jax.ops.segment_min)(msgs, dst, num)
+        # empty segments: reference returns 0 (count mask — dtype-safe for
+        # ints, where isfinite would never fire)
+        return jnp.where(cnt > 0, out, jnp.zeros((), msgs.dtype))
     raise ValueError(reduce_op)

@@ -6,6 +6,7 @@ import numpy as np
 
 from ...framework.core import Tensor
 from ...autograd.tape import no_grad
+from ... import optimizer as _opt
 
 
 class LookAhead:
@@ -94,3 +95,31 @@ class ModelAverage:
                 for p in self._params:
                     p.set_value(self._backup[id(p)])
             self._backup = None
+
+
+class DistributedFusedLamb(_opt.Lamb):
+    """reference ``paddle.incubate.optimizer.DistributedFusedLamb`` — a
+    CUDA-fused, sharded LAMB. TPU-native: the per-op fusion is XLA's job
+    and parameter sharding comes from the sharding mesh axis, so this is
+    LAMB with the reference's extra knobs accepted for compat (the
+    clip_after_allreduce/is_grad_scaled_by_nranks semantics are owned by
+    the hybrid optimizer's global-norm clip over mesh axes)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                 alignment=128, nproc_per_node=None, use_master_param_norm=True,
+                 gradient_accumulation_steps=1, use_master_acc_grad=True,
+                 name=None):
+        if gradient_accumulation_steps != 1:
+            raise NotImplementedError(
+                "DistributedFusedLamb: gradient_accumulation_steps != 1 — "
+                "accumulate with model.no_sync()/manual accumulation, then "
+                "step() once")
+        super().__init__(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, parameters=parameters,
+                         grad_clip=grad_clip,
+                         exclude_from_weight_decay_fn=exclude_from_weight_decay_fn,
+                         multi_precision=use_master_param_norm)

@@ -379,9 +379,19 @@ class Lamb(Optimizer):
             self._current_param = p
             slots = self._get_slots(p)
             self._step_t[id(p)] += 1
-            new_p, new_slots = self._apply(p._data, g._data, slots, lr,
+            g_arr = g._data
+            if "master" in slots:        # fp32 master-weight round trip
+                p_arr = slots["master"]
+                g_arr = g_arr.astype(jnp.float32)
+            else:
+                p_arr = p._data
+            new_p, new_slots = self._apply(p_arr, g_arr, slots, lr,
                                            self._step_t[id(p)], 0.0)
-            p._data = new_p
+            if "master" in slots:
+                new_slots["master"] = new_p
+                p._data = new_p.astype(p.dtype)
+            else:
+                p._data = new_p
             self._slots[id(p)] = new_slots
 
     def _apply(self, p, g, slots, lr, t, wd):

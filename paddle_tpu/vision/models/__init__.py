@@ -6,3 +6,9 @@ from .resnet import (  # noqa: F401
 from .lenet import LeNet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2  # noqa: F401
+from .extras import (  # noqa: F401
+    AlexNet, alexnet, SqueezeNet, squeezenet1_0, squeezenet1_1,
+    MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
+    mobilenet_v3_large, ShuffleNetV2, shufflenet_v2_x1_0,
+    DenseNet, densenet121,
+)

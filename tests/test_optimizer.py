@@ -199,3 +199,25 @@ def test_lbfgs_rosenbrock():
     final = np.asarray(w.numpy())
     np.testing.assert_allclose(final, [1.0, 1.0], atol=1e-2)
     assert float(loss.numpy()) < 1e-4
+
+
+def test_lbfgs_partial_params_and_wd():
+    import paddle_tpu.optimizer as opt
+    w1 = paddle.to_tensor(np.array([2.0], np.float32))
+    w2 = paddle.to_tensor(np.array([5.0], np.float32))
+    w1.stop_gradient = False
+    w2.stop_gradient = False
+    o = opt.LBFGS(learning_rate=0.5, max_iter=5, parameters=[w1, w2])
+
+    def closure():
+        o.clear_grad()
+        loss = (w1 ** 2).sum()     # w2 unused -> grad None
+        loss.backward()
+        return loss
+
+    o.step(closure)                # must not crash on w2.grad is None
+    assert abs(float(w2.numpy()[0]) - 5.0) < 1e-6   # untouched
+    import pytest as _pytest
+    from paddle_tpu.nn import ClipGradByGlobalNorm
+    with _pytest.raises(ValueError):
+        opt.LBFGS(parameters=[w1], grad_clip=ClipGradByGlobalNorm(1.0))

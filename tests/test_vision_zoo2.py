@@ -1,0 +1,39 @@
+"""Vision zoo batch 2: forward shapes + one train step per family
+(reference test pattern: test/legacy_test/test_vision_models.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+
+CASES = [
+    ("alexnet", lambda: M.alexnet(num_classes=4), 64),
+    ("squeezenet1_0", lambda: M.squeezenet1_0(num_classes=4), 64),
+    ("squeezenet1_1", lambda: M.squeezenet1_1(num_classes=4), 64),
+    ("mobilenet_v3_small",
+     lambda: M.mobilenet_v3_small(num_classes=4), 32),
+    ("mobilenet_v3_large",
+     lambda: M.mobilenet_v3_large(num_classes=4), 32),
+    ("shufflenet_v2_x1_0",
+     lambda: M.shufflenet_v2_x1_0(num_classes=4), 32),
+    ("densenet121", lambda: M.densenet121(num_classes=4), 32),
+    ("wide_resnet50_2", lambda: M.wide_resnet50_2(num_classes=4), 32),
+]
+
+
+@pytest.mark.parametrize("name,mk,size", CASES, ids=[c[0] for c in CASES])
+def test_forward_and_train_step(name, mk, size):
+    paddle.seed(0)
+    model = mk()
+    model.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, size, size).astype("float32"))
+    y = paddle.to_tensor(np.array([1, 3], np.int64))
+    logits = model(x)
+    assert tuple(logits.shape) == (2, 4), name
+    loss = paddle.nn.CrossEntropyLoss()(logits, y)
+    loss.backward()
+    grads = [p.grad for p in model.parameters() if p.grad is not None]
+    assert grads, name
+    total = sum(float(np.abs(np.asarray(g.numpy())).sum()) for g in grads)
+    assert np.isfinite(total) and total > 0, name

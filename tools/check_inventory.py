@@ -146,6 +146,13 @@ INVENTORY = [
     ("nn breadth batch 2 (unpool/3d/losses)", "paddle_tpu.nn",
      ["MaxUnPool2D", "Conv3DTranspose", "HSigmoidLoss", "Fold",
       "PixelUnshuffle", "TripletMarginWithDistanceLoss"]),
+    ("paddle.geometric (GNN ops)", "paddle_tpu.geometric",
+     ["segment_sum", "send_u_recv", "send_ue_recv", "send_uv"]),
+    ("Optimizer breadth (LBFGS tier)", "paddle_tpu.optimizer",
+     ["LBFGS", "RAdam", "NAdam", "Rprop", "ASGD"]),
+    ("Vision zoo batch 2", "paddle_tpu.vision.models",
+     ["AlexNet", "SqueezeNet", "MobileNetV3Small", "ShuffleNetV2",
+      "DenseNet", "wide_resnet50_2"]),
 ]
 
 
