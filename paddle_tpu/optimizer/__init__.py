@@ -76,6 +76,23 @@ class Optimizer:
     def _apply(self, p, g, slots, lr, t, wd):
         raise NotImplementedError
 
+    def _masterized_apply(self, p, g, slots, lr, t, wd):
+        """Run _apply with the fp32 master-weight round trip when the
+        slot exists (low-precision params under multi_precision)."""
+        g_arr = g._data
+        if "master" in slots:
+            p_arr = slots["master"]
+            g_arr = g_arr.astype(jnp.float32)
+        else:
+            p_arr = p._data
+        new_p, new_slots = self._apply(p_arr, g_arr, slots, lr, t, wd)
+        if "master" in slots:
+            new_slots["master"] = new_p
+            p._data = new_p.astype(p.dtype)
+        else:
+            p._data = new_p
+        self._slots[id(p)] = new_slots
+
     # -- the eager step ------------------------------------------------------
     @no_grad()
     def step(self):
@@ -95,19 +112,7 @@ class Optimizer:
             t = self._step_t[id(p)]
             wd = self._wd_coeff(p) if getattr(p, "regularizer", None) is None \
                 else float(getattr(p.regularizer, "_coeff", 0.0))
-            g_arr = g._data
-            if "master" in slots:
-                p_arr = slots["master"]
-                g_arr = g_arr.astype(jnp.float32)
-            else:
-                p_arr = p._data
-            new_p, new_slots = self._apply(p_arr, g_arr, slots, group_lr, t, wd)
-            if "master" in slots:
-                new_slots["master"] = new_p
-                p._data = new_p.astype(p.dtype)
-            else:
-                p._data = new_p
-            self._slots[id(p)] = new_slots
+            self._masterized_apply(p, g, slots, group_lr, t, wd)
         return None
 
     minimize = None  # set below
@@ -379,20 +384,8 @@ class Lamb(Optimizer):
             self._current_param = p
             slots = self._get_slots(p)
             self._step_t[id(p)] += 1
-            g_arr = g._data
-            if "master" in slots:        # fp32 master-weight round trip
-                p_arr = slots["master"]
-                g_arr = g_arr.astype(jnp.float32)
-            else:
-                p_arr = p._data
-            new_p, new_slots = self._apply(p_arr, g_arr, slots, lr,
-                                           self._step_t[id(p)], 0.0)
-            if "master" in slots:
-                new_slots["master"] = new_p
-                p._data = new_p.astype(p.dtype)
-            else:
-                p._data = new_p
-            self._slots[id(p)] = new_slots
+            self._masterized_apply(p, g, slots, lr,
+                                   self._step_t[id(p)], 0.0)
 
     def _apply(self, p, g, slots, lr, t, wd):
         m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g

@@ -155,12 +155,7 @@ class LBFGS(Optimizer):
                 "line search (clipping would break the Wolfe conditions)")
         super().__init__(learning_rate, parameters, weight_decay, None,
                          name, False)
-        if weight_decay is None:
-            self._wd = 0.0
-        elif isinstance(weight_decay, (int, float)):
-            self._wd = float(weight_decay)
-        else:                     # L1Decay/L2Decay-style object
-            self._wd = float(getattr(weight_decay, "_coeff", 0.0))
+        self._wd = self._wd_coeff(None)   # number or L2Decay-style object
         self.max_iter = max_iter
         self.max_eval = max_eval or max_iter * 5 // 4
         self.tol_grad = tolerance_grad

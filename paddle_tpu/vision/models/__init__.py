@@ -2,6 +2,8 @@
 from .resnet import (  # noqa: F401
     ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
     resnet101, resnet152, wide_resnet50_2, resnext50_32x4d,
+    resnext101_32x4d, resnext101_64x4d, resnext152_32x4d,
+    wide_resnet101_2,
 )
 from .lenet import LeNet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
@@ -11,4 +13,7 @@ from .extras import (  # noqa: F401
     MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
     mobilenet_v3_large, ShuffleNetV2, shufflenet_v2_x1_0,
     DenseNet, densenet121,
+)
+from .inception import (  # noqa: F401
+    GoogLeNet, googlenet, InceptionV3, inception_v3,
 )

@@ -92,11 +92,19 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    return SqueezeNet("1.0", **kwargs)
+    model = SqueezeNet("1.0", **kwargs)
+    if pretrained:
+        from ._utils import load_pretrained
+        load_pretrained(model, "squeezenet1_0")
+    return model
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    return SqueezeNet("1.1", **kwargs)
+    model = SqueezeNet("1.1", **kwargs)
+    if pretrained:
+        from ._utils import load_pretrained
+        load_pretrained(model, "squeezenet1_1")
+    return model
 
 
 class _SE(nn.Layer):
@@ -155,7 +163,7 @@ _V3_LARGE = [
 
 class _MobileNetV3(nn.Layer):
     def __init__(self, cfg, last_exp, num_classes=1000, scale=1.0,
-                 with_pool=True):
+                 with_pool=True, head_width=1280):
         super().__init__()
         self.with_pool = with_pool
         self.num_classes = num_classes
@@ -177,8 +185,8 @@ class _MobileNetV3(nn.Layer):
         self.pool = nn.AdaptiveAvgPool2D(1)
         if num_classes > 0:
             self.classifier = nn.Sequential(
-                nn.Linear(c(last_exp), 1280), nn.Hardswish(),
-                nn.Dropout(0.2), nn.Linear(1280, num_classes))
+                nn.Linear(c(last_exp), head_width), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(head_width, num_classes))
 
     def forward(self, x):
         x = self.features(x)
@@ -191,7 +199,8 @@ class _MobileNetV3(nn.Layer):
 
 class MobileNetV3Small(_MobileNetV3):
     def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
-        super().__init__(_V3_SMALL, 576, num_classes, scale, with_pool)
+        super().__init__(_V3_SMALL, 576, num_classes, scale, with_pool,
+                         head_width=1024)   # reference small-variant head
 
 
 class MobileNetV3Large(_MobileNetV3):
@@ -200,11 +209,19 @@ class MobileNetV3Large(_MobileNetV3):
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3Small(scale=scale, **kwargs)
+    model = MobileNetV3Small(scale=scale, **kwargs)
+    if pretrained:
+        from ._utils import load_pretrained
+        load_pretrained(model, f"mobilenet_v3_small_{scale}")
+    return model
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV3Large(scale=scale, **kwargs)
+    model = MobileNetV3Large(scale=scale, **kwargs)
+    if pretrained:
+        from ._utils import load_pretrained
+        load_pretrained(model, f"mobilenet_v3_large_{scale}")
+    return model
 
 
 def _channel_shuffle(x, groups):
@@ -292,7 +309,11 @@ class ShuffleNetV2(nn.Layer):
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
-    return ShuffleNetV2(scale=1.0, **kwargs)
+    model = ShuffleNetV2(scale=1.0, **kwargs)
+    if pretrained:
+        from ._utils import load_pretrained
+        load_pretrained(model, "shufflenet_v2_x1_0")
+    return model
 
 
 class _DenseLayer(nn.Layer):
@@ -350,5 +371,9 @@ class DenseNet(nn.Layer):
 
 
 def densenet121(pretrained=False, **kwargs):
-    return DenseNet(121, **kwargs)
+    model = DenseNet(121, **kwargs)
+    if pretrained:
+        from ._utils import load_pretrained
+        load_pretrained(model, "densenet121")
+    return model
 

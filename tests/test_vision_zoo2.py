@@ -37,3 +37,41 @@ def test_forward_and_train_step(name, mk, size):
     assert grads, name
     total = sum(float(np.abs(np.asarray(g.numpy())).sum()) for g in grads)
     assert np.isfinite(total) and total > 0, name
+
+
+def test_googlenet_aux_heads():
+    paddle.seed(0)
+    m = M.googlenet(num_classes=4)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 3, 64, 64).astype("float32"))
+    m.train()
+    out, aux1, aux2 = m(x)
+    assert tuple(out.shape) == (2, 4)
+    assert tuple(aux1.shape) == (2, 4) and tuple(aux2.shape) == (2, 4)
+    loss = (paddle.nn.CrossEntropyLoss()(out, paddle.to_tensor([0, 1]))
+            + 0.3 * paddle.nn.CrossEntropyLoss()(aux1,
+                                                 paddle.to_tensor([0, 1])))
+    loss.backward()
+    m.eval()
+    single = m(x)
+    assert tuple(single.shape) == (2, 4)
+
+
+def test_inception_v3_forward():
+    paddle.seed(0)
+    m = M.inception_v3(num_classes=4)
+    m.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 3, 96, 96).astype("float32"))
+    out = m(x)
+    assert tuple(out.shape) == (1, 4)
+
+
+def test_resnext_variants():
+    paddle.seed(0)
+    for mk in (M.resnext101_32x4d, M.wide_resnet101_2):
+        m = mk(num_classes=3)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 3, 32, 32).astype("float32"))
+        assert tuple(m(x).shape) == (1, 3)
