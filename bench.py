@@ -103,12 +103,16 @@ def bench_llama():
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     amp = _amp_enabled()
+    # MFU sweep knobs (BENCH_REMAT=1 -> full activation recompute per
+    # layer; trades FLOPs for HBM so bigger BENCH_BATCH/BENCH_SEQ fit)
+    remat = os.environ.get("BENCH_REMAT", "0") == "1"
 
     paddle.seed(0)
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
                       intermediate_size=2816, num_hidden_layers=8,
                       num_attention_heads=16, num_key_value_heads=8,
-                      max_position_embeddings=max(2048, seq))
+                      max_position_embeddings=max(2048, seq),
+                      use_recompute=remat)
     model = LlamaForCausalLM(cfg)
     model.train()
     fm = FunctionalModule(model, training=True)

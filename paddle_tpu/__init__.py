@@ -59,7 +59,7 @@ from . import text  # noqa: F401
 from . import audio  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .nn.layer import Layer  # noqa: F401
-from .hapi import Model, summary  # noqa: F401
+from .hapi import Model, summary, flops  # noqa: F401
 from .flags import set_flags, get_flags  # noqa: F401
 from .jit.api import disable_static, enable_static, in_dynamic_mode  # noqa: F401
 

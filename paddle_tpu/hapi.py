@@ -202,3 +202,92 @@ def summary(net, input_size=None, dtypes=None, input=None):
     lines.append(f"Trainable params: {trainable:,}")
     print("\n".join(lines))
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops (reference ``python/paddle/hapi/dynamic_flops.py``):
+    per-layer FLOP counting via forward hooks over one dummy forward.
+    Returns total FLOPs; ``custom_ops`` maps Layer classes to
+    ``fn(layer, input, output) -> flops``."""
+    import numpy as np
+    from .framework.core import Tensor
+
+    custom_ops = custom_ops or {}
+    counts = []     # (layer name path, class, flops, params)
+
+    def _n(shape):
+        return int(np.prod([s for s in shape if s]))
+
+    def count(layer, inp, out):
+        x = inp[0] if isinstance(inp, (tuple, list)) else inp
+        y = out[0] if isinstance(out, (tuple, list)) else out
+        cls = type(layer)
+        if cls in custom_ops:
+            return custom_ops[cls](layer, inp, out)
+        name = cls.__name__
+        # reference dynamic_flops convention: one MAC = 1 FLOP, bias
+        # counted (count_convNd: out_numel * (Cin/g*K + bias))
+        if name in ("Conv2D", "Conv1D", "Conv3D"):
+            k = _n(layer._kernel_size)
+            cin = layer._in_channels // getattr(layer, "_groups", 1)
+            bias = 1 if getattr(layer, "bias", None) is not None else 0
+            return _n(y.shape) * (cin * k + bias)
+        if name == "Linear":
+            in_f = layer.weight.shape[0]
+            bias = 1 if getattr(layer, "bias", None) is not None else 0
+            return _n(y.shape) * (in_f + bias)
+        if name in ("BatchNorm2D", "BatchNorm1D", "BatchNorm3D",
+                    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm2D"):
+            return 2 * _n(x.shape)
+        if name in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Hardswish",
+                    "Hardsigmoid", "SiLU", "Silu", "Swish", "LeakyReLU",
+                    "Softmax"):
+            return _n(y.shape)
+        if "Pool" in name:
+            return _n(y.shape)
+        return 0
+
+    handles = []
+    is_leaf = lambda l: not list(l.children())
+
+    def attach(layer, prefix=""):
+        for n, child in layer.named_children():
+            path = f"{prefix}.{n}" if prefix else n
+            if is_leaf(child):
+                def hook(l, i, o, _p=path):
+                    fl = count(l, i, o)
+                    params = sum(p.size for p in l.parameters())
+                    counts.append((_p, type(l).__name__, fl, params))
+                handles.append(child.register_forward_post_hook(hook))
+            else:
+                attach(child, path)
+    attach(net)
+    if not handles and is_leaf(net):
+        # the net itself is a single leaf layer (paddle.flops(conv, ...))
+        def root_hook(l, i, o):
+            fl = count(l, i, o)
+            params = sum(p.size for p in l.parameters())
+            counts.append(("(root)", type(l).__name__, fl, params))
+        handles.append(net.register_forward_post_hook(root_hook))
+
+    was_training = net.training
+    net.eval()
+    try:
+        x = Tensor(np.zeros(input_size, np.float32))
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(c[2] for c in counts)
+    total_params = sum(c[3] for c in counts)
+    if print_detail:
+        width = max((len(c[0]) for c in counts), default=20) + 2
+        print(f"{'Layer':<{width}}{'Type':<18}{'FLOPs':>16}{'Params':>12}")
+        for path, tname, fl, pr in counts:
+            print(f"{path:<{width}}{tname:<18}{fl:>16,}{pr:>12,}")
+        print(f"Total GFLOPs: {total / 1e9:.4f}")
+        print(f"Total params: {total_params:,}")
+    return total

@@ -9,3 +9,21 @@ def test_inventory_complete():
     from check_inventory import check
     failures = check(verbose=False)
     assert not failures, failures
+
+
+def test_paddle_flops():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 16, 5))
+    total = paddle.flops(net, (2, 3, 4, 4))
+    # reference MAC convention with bias: out_numel * (Cin*K + 1)
+    conv = 2 * 4 * 4 * 8 * (3 * 9 + 1)
+    relu = 2 * 8 * 4 * 4
+    lin = 2 * 5 * (128 + 1)
+    assert total == conv + relu + lin, (total, conv + relu + lin)
+    # bare leaf layer counts too
+    leaf = paddle.flops(nn.Linear(10, 20, bias_attr=False), (4, 10))
+    assert leaf == 4 * 20 * 10, leaf
