@@ -60,6 +60,13 @@ from . import audio  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .nn.layer import Layer  # noqa: F401
 from .hapi import Model, summary, flops  # noqa: F401
+from .compat_namespaces import (  # noqa: F401
+    regularizer, version, sysconfig, iinfo, finfo, is_tensor, is_complex,
+    is_floating_point, is_integer, create_parameter, batch, LazyGuard,
+)
+from . import ops as tensor  # noqa: F401  (paddle.tensor namespace alias)
+import sys as _sys
+_sys.modules[__name__ + ".tensor"] = tensor   # `import paddle_tpu.tensor`
 from .flags import set_flags, get_flags  # noqa: F401
 from .jit.api import disable_static, enable_static, in_dynamic_mode  # noqa: F401
 

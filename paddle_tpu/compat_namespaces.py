@@ -1,0 +1,190 @@
+"""Top-level compat surface (reference: assorted ``python/paddle/``
+namespaces — ``regularizer.py``, ``version/__init__.py``,
+``sysconfig.py``, ``base/`` (the old fluid glue), ``batch.py``, the
+``iinfo/finfo`` dtype-info APIs and tensor predicates from
+``python/paddle/framework/``/``tensor/attribute.py``)."""
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+import jax.numpy as jnp
+
+from .framework.core import Tensor, Parameter
+from .framework import dtype as dtypes
+
+
+# ---------------------------------------------------------------- regularizer
+
+regularizer = types.ModuleType("paddle_tpu.regularizer")
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+
+regularizer.L1Decay = L1Decay
+regularizer.L2Decay = L2Decay
+sys.modules["paddle_tpu.regularizer"] = regularizer
+
+
+# ---------------------------------------------------------------- version
+
+version = types.ModuleType("paddle_tpu.version")
+version.full_version = "3.0.0+tpu"
+version.major = "3"
+version.minor = "0"
+version.patch = "0"
+version.rc = "0"
+version.commit = "tpu-native"
+version.istaged = False
+version.cuda = lambda: "False"
+version.cudnn = lambda: "False"
+version.xpu = lambda: "False"
+version.show = lambda: print(f"paddle_tpu {version.full_version} "
+                             f"(TPU-native JAX/XLA build)")
+sys.modules["paddle_tpu.version"] = version
+
+
+# ---------------------------------------------------------------- sysconfig
+
+sysconfig = types.ModuleType("paddle_tpu.sysconfig")
+
+
+def _get_include():
+    import os
+    return os.path.join(os.path.dirname(__file__), "include")
+
+
+def _get_lib():
+    import os
+    return os.path.join(os.path.dirname(__file__), "lib")
+
+
+sysconfig.get_include = _get_include
+sysconfig.get_lib = _get_lib
+sys.modules["paddle_tpu.sysconfig"] = sysconfig
+
+
+# ---------------------------------------------------------------- dtype info
+
+class iinfo:
+    """paddle.iinfo — integer dtype metadata."""
+
+    def __init__(self, dtype):
+        info = np.iinfo(np.dtype(dtypes.convert_dtype(dtype)))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+class finfo:
+    """paddle.finfo — floating dtype metadata (bfloat16 included)."""
+
+    def __init__(self, dtype):
+        dt = dtypes.convert_dtype(dtype)
+        if dt == jnp.bfloat16:
+            self.min, self.max = -3.3895314e38, 3.3895314e38
+            self.eps = 0.0078125
+            self.tiny = self.smallest_normal = 1.1754944e-38
+            self.resolution = 0.01
+            self.bits = 16
+            self.dtype = "bfloat16"
+            return
+        info = np.finfo(np.dtype(dt))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+# ---------------------------------------------------------------- predicates
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(_dt(x), jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_dt(x), jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_dt(x), jnp.integer)
+
+
+def _dt(x):
+    return x.dtype if hasattr(x, "dtype") else jnp.asarray(x).dtype
+
+
+# ---------------------------------------------------------------- misc
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter — a free-standing Parameter honoring the
+    same ParamAttr precedence as Layer.create_parameter."""
+    from .nn.initializer import Constant, XavierUniform
+    from .framework.param_attr import ParamAttr
+    attr = ParamAttr._to_attr(attr)
+    init = None
+    trainable = True
+    lr = 1.0
+    if attr is not None:
+        if attr.initializer is not None:
+            init = attr.initializer
+        trainable = getattr(attr, "trainable", True)
+        lr = getattr(attr, "learning_rate", 1.0)
+        name = name or getattr(attr, "name", None)
+    if init is None:
+        init = default_initializer or (Constant(0.0) if is_bias
+                                       else XavierUniform())
+    shape = [int(s) for s in shape]
+    data = init(shape, dtypes.convert_dtype(dtype))
+    p = Parameter(data, trainable=trainable)
+    p.optimize_attr["learning_rate"] = lr
+    if name:
+        p.name = name
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch — wrap a sample reader into a batch reader (legacy
+    reader-decorator API)."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+class LazyGuard:
+    """paddle.LazyGuard — in the reference, defers parameter
+    materialization until ``layer.to()`` is called. JAX arrays are
+    buffer-backed and cheap on host, and jit tracing never materializes
+    donated inits, so eager init is already effectively lazy; the guard
+    is a functional no-op kept for API compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

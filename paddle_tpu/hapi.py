@@ -214,6 +214,7 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
     custom_ops = custom_ops or {}
     counts = []     # (layer name path, class, flops, params)
+    seen_params = set()          # layers whose params were already counted
 
     def _n(shape):
         return int(np.prod([s for s in shape if s]))
@@ -227,7 +228,8 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         name = cls.__name__
         # reference dynamic_flops convention: one MAC = 1 FLOP, bias
         # counted (count_convNd: out_numel * (Cin/g*K + bias))
-        if name in ("Conv2D", "Conv1D", "Conv3D"):
+        if name in ("Conv2D", "Conv1D", "Conv3D", "Conv2DTranspose",
+                    "Conv1DTranspose", "Conv3DTranspose"):
             k = _n(layer._kernel_size)
             cin = layer._in_channels // getattr(layer, "_groups", 1)
             bias = 1 if getattr(layer, "bias", None) is not None else 0
@@ -256,7 +258,10 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
             if is_leaf(child):
                 def hook(l, i, o, _p=path):
                     fl = count(l, i, o)
-                    params = sum(p.size for p in l.parameters())
+                    params = 0
+                    if id(l) not in seen_params:   # shared layers: once
+                        seen_params.add(id(l))
+                        params = sum(p.size for p in l.parameters())
                     counts.append((_p, type(l).__name__, fl, params))
                 handles.append(child.register_forward_post_hook(hook))
             else:
@@ -266,11 +271,17 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         # the net itself is a single leaf layer (paddle.flops(conv, ...))
         def root_hook(l, i, o):
             fl = count(l, i, o)
-            params = sum(p.size for p in l.parameters())
+            params = 0
+            if id(l) not in seen_params:
+                seen_params.add(id(l))
+                params = sum(p.size for p in l.parameters())
             counts.append(("(root)", type(l).__name__, fl, params))
         handles.append(net.register_forward_post_hook(root_hook))
 
-    was_training = net.training
+    # snapshot per-layer training flags: a blanket net.train() after
+    # would flip deliberately-frozen sublayers (e.g. frozen BN) to train
+    modes = [(l, l.training) for l in net.sublayers(include_self=True)] \
+        if hasattr(net, "sublayers") else [(net, net.training)]
     net.eval()
     try:
         x = Tensor(np.zeros(input_size, np.float32))
@@ -278,8 +289,8 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     finally:
         for h in handles:
             h.remove()
-        if was_training:
-            net.train()
+        for l, was in modes:
+            l.training = was
 
     total = sum(c[2] for c in counts)
     total_params = sum(c[3] for c in counts)

@@ -568,3 +568,36 @@ def shape(x):
     from ..framework.core import Tensor
     shp = x.shape if hasattr(x, "shape") else jnp.asarray(x).shape
     return Tensor(jnp.asarray(shp, jnp.int32))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """paddle.crop — slice a region of ``shape`` at ``offsets`` (negative
+    shape entries keep the remaining extent, like the reference)."""
+    xs = list(x.shape)
+    if shape is None:
+        shape = xs
+    if hasattr(shape, "tolist"):
+        shape = shape.tolist()
+    if offsets is None:
+        offsets = [0] * len(xs)
+    if hasattr(offsets, "tolist"):
+        offsets = offsets.tolist()
+    if len(shape) != len(xs) or len(offsets) != len(xs):
+        raise ValueError(
+            f"crop: shape/offsets rank {len(shape)}/{len(offsets)} must "
+            f"equal input rank {len(xs)}")
+    starts = [int(o) for o in offsets]
+    sizes = [int(xs[i] - starts[i]) if int(s) == -1 else int(s)
+             for i, s in enumerate(shape)]
+    for i, (st, sz) in enumerate(zip(starts, sizes)):
+        if st < 0 or sz < 0 or st + sz > xs[i]:
+            raise ValueError(
+                f"crop: dim {i} region [{st}, {st + sz}) out of bounds "
+                f"for extent {xs[i]}")
+
+    def fn(a):
+        idx = tuple(builtins_slice(st, st + sz)
+                    for st, sz in zip(starts, sizes))
+        return a[idx]
+
+    return apply(fn, x, op_name="crop")

@@ -27,3 +27,18 @@ def test_paddle_flops():
     # bare leaf layer counts too
     leaf = paddle.flops(nn.Linear(10, 20, bias_attr=False), (4, 10))
     assert leaf == 4 * 20 * 10, leaf
+
+
+def test_compat_namespaces():
+    import numpy as np
+    import paddle_tpu as paddle
+
+    assert paddle.iinfo("int8").max == 127
+    assert abs(paddle.finfo("float16").eps - 0.000977) < 1e-5
+    x = paddle.to_tensor(np.zeros((4, 6), np.float32))
+    c = paddle.crop(x, shape=[2, -1], offsets=[1, 2])
+    assert tuple(c.shape) == (2, 4)
+    assert paddle.version.cuda() == "False"
+    assert paddle.tensor.matmul is paddle.matmul
+    p = paddle.create_parameter([2, 2], is_bias=True)
+    assert float(np.abs(np.asarray(p.numpy())).sum()) == 0.0
