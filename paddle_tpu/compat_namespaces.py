@@ -21,6 +21,8 @@ regularizer = types.ModuleType("paddle_tpu.regularizer")
 
 
 class L1Decay:
+    _l1 = True        # optimizer applies coeff*sign(w), not L2 decay
+
     def __init__(self, coeff=0.0):
         self._coeff = float(coeff)
 
@@ -91,15 +93,13 @@ class finfo:
 
     def __init__(self, dtype):
         dt = dtypes.convert_dtype(dtype)
-        if dt == jnp.bfloat16:
-            self.min, self.max = -3.3895314e38, 3.3895314e38
-            self.eps = 0.0078125
-            self.tiny = self.smallest_normal = 1.1754944e-38
-            self.resolution = 0.01
-            self.bits = 16
-            self.dtype = "bfloat16"
-            return
-        info = np.finfo(np.dtype(dt))
+        try:
+            info = np.finfo(np.dtype(dt))
+        except ValueError:
+            # this numpy doesn't treat ml_dtypes.bfloat16 as inexact;
+            # ml_dtypes ships its own exact finfo
+            import ml_dtypes
+            info = ml_dtypes.finfo(dt)
         self.min = float(info.min)
         self.max = float(info.max)
         self.eps = float(info.eps)

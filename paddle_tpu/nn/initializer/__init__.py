@@ -182,3 +182,27 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _global_weight_init = None
 _global_bias_init = None
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transpose convs (reference
+    ``paddle.nn.initializer.Bilinear``: each [kh, kw] slice is the
+    separable triangle filter; channel slices identical)."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+        shape = tuple(shape)
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D weight")
+        kh, kw = shape[2], shape[3]
+        # reference (caffe) bilinear kernel: f = ceil(k/2),
+        # c = (2f - 1 - f%2) / (2f); w(x) = 1 - |x/f - c|
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - np.abs(og[0] / fh - ch))
+                * (1 - np.abs(og[1] / fw - cw)))
+        w = np.zeros(shape, np.float32)
+        w[:, :] = filt
+        return jnp.asarray(w, dtypes.convert_dtype(dtype))

@@ -242,3 +242,29 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
         P = jnp.swapaxes(P, -1, -2)
         return P, L, U
     return apply(fn, x, y, op_name="lu_unpack")
+
+
+def matrix_transpose(x, name=None):
+    """paddle.linalg.matrix_transpose — swap the last two dims."""
+    return apply(lambda a: jnp.swapaxes(a, -1, -2),
+                 x, op_name="matrix_transpose")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """paddle.linalg.cholesky_inverse — inverse of A from its Cholesky
+    factor (A = LL^T or U^T U)."""
+    def fn(f):
+        eye = jnp.eye(f.shape[-1], dtype=f.dtype)
+        inv_f = jax.scipy.linalg.solve_triangular(f, eye, lower=not upper)
+        inv_ft = jnp.swapaxes(inv_f, -1, -2)    # batched-safe transpose
+        return (inv_f @ inv_ft) if upper else (inv_ft @ inv_f)
+    return apply(fn, x, op_name="cholesky_inverse")
+
+
+def lu_solve(b, lu_data, lu_pivots, trans="N", name=None):
+    """paddle.linalg.lu_solve — solve A x = b from lu()'s packed factor."""
+    def fn(bb, lu_, piv):
+        t = {"N": 0, "T": 1, "C": 2}.get(trans, 0)
+        return jax.scipy.linalg.lu_solve((lu_, piv.astype(jnp.int32)),
+                                         bb, trans=t)
+    return apply(fn, b, lu_data, lu_pivots, op_name="lu_solve")

@@ -110,8 +110,16 @@ class Optimizer:
             slots = self._get_slots(p)
             self._step_t[id(p)] += 1
             t = self._step_t[id(p)]
-            wd = self._wd_coeff(p) if getattr(p, "regularizer", None) is None \
-                else float(getattr(p.regularizer, "_coeff", 0.0))
+            reg = getattr(p, "regularizer", None) or self.regularization
+            if getattr(reg, "_l1", False):
+                # L1: add coeff*sign(w) to the gradient; no L2 term
+                coeff = float(getattr(reg, "_coeff", 0.0))
+                g = Tensor(g._data + coeff * jnp.sign(p._data))
+                wd = 0.0
+            else:
+                wd = self._wd_coeff(p) \
+                    if getattr(p, "regularizer", None) is None \
+                    else float(getattr(p.regularizer, "_coeff", 0.0))
             self._masterized_apply(p, g, slots, group_lr, t, wd)
         return None
 

@@ -89,3 +89,135 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+# ---------------------------------------------------------------------------
+# datasets (reference: ``python/paddle/text/datasets/`` — UCIHousing, Imdb,
+# Imikolov, Movielens, Conll05, WMT14/16). Zero-egress build: each dataset
+# resolves from the local weight/data cache
+# (~/.cache/paddle_tpu/datasets/<name>) and raises with the expected path
+# on a miss; UCIHousing additionally offers a deterministic synthetic mode
+# for tests/examples.
+# ---------------------------------------------------------------------------
+
+class _CachedDataset:
+    """Base for reference text datasets in the zero-egress build."""
+
+    _filename = None      # expected file under the cache dir
+
+    def __init__(self, data_file=None, mode="train", **kw):
+        import os
+        self.mode = mode
+        if data_file is None:
+            cache = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+            data_file = os.path.join(cache, self._filename)
+        if not os.path.exists(data_file):
+            raise IOError(
+                f"{type(self).__name__}: no network egress in the TPU "
+                f"build — place the reference archive at {data_file}")
+        self.data_file = data_file
+        self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+class UCIHousing(_CachedDataset):
+    """Boston-housing regression rows (13 features, 1 target). Pass
+    ``synthetic=N`` to generate a deterministic stand-in dataset."""
+
+    _filename = "housing.data"
+
+    def __init__(self, data_file=None, mode="train", synthetic=None, **kw):
+        import numpy as np
+        if synthetic:
+            rng = np.random.RandomState(0)
+            feats = rng.rand(int(synthetic), 13).astype("float32")
+            w = rng.rand(13, 1).astype("float32")
+            tgt = feats @ w + 0.1 * rng.rand(int(synthetic), 1)
+            self.mode = mode
+            self.samples = [(feats[i], tgt[i].astype("float32"))
+                            for i in range(int(synthetic))]
+            return
+        super().__init__(data_file, mode, **kw)
+
+    def _load(self):
+        import numpy as np
+        raw = np.loadtxt(self.data_file).astype("float32")
+        split = int(0.8 * len(raw))
+        rows = raw[:split] if self.mode == "train" else raw[split:]
+        mu, sigma = raw[:, :13].mean(0), raw[:, :13].std(0) + 1e-8
+        self.samples = [(((r[:13] - mu) / sigma).astype("float32"),
+                         r[13:14].astype("float32")) for r in rows]
+
+
+class Imdb(_CachedDataset):
+    """IMDB sentiment archive (aclImdb_v1.tar.gz)."""
+
+    _filename = "aclImdb_v1.tar.gz"
+
+    def _load(self):
+        import re
+        from collections import Counter
+        import tarfile
+        any_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        tok_pat = re.compile(r"[a-z']+")
+        # pass 1: frequency-sorted vocab over the WHOLE archive so train
+        # and test instances share word ids (reference build_dict)
+        freq = Counter()
+        mode_docs = []
+        with tarfile.open(self.data_file) as tf:
+            for m in tf.getmembers():
+                match = any_pat.match(m.name)
+                if not match:
+                    continue
+                text = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower()
+                toks = tok_pat.findall(text)
+                freq.update(toks)
+                if match.group(1) == self.mode:
+                    mode_docs.append(
+                        (toks, 0 if match.group(2) == "pos" else 1))
+        self.word_idx = {w: i for i, (w, _) in enumerate(
+            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))}
+        self.samples = [([self.word_idx[t] for t in toks], lab)
+                        for toks, lab in mode_docs]
+
+
+class Imikolov(_CachedDataset):
+    """PTB language-model n-grams (simple-examples.tgz)."""
+
+    _filename = "simple-examples.tgz"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", **kw):
+        self.data_type = data_type
+        self.window_size = window_size
+        super().__init__(data_file, mode, **kw)
+
+    def _load(self):
+        import tarfile
+        name = (f"./simple-examples/data/ptb.{self.mode}.txt")
+        with tarfile.open(self.data_file) as tf:
+            text = tf.extractfile(name).read().decode("utf-8")
+        self.word_idx = {"<eos>": 0}
+        sents = []
+        for line in text.splitlines():
+            toks = line.split() + ["<eos>"]
+            sents.append([self.word_idx.setdefault(t, len(self.word_idx))
+                          for t in toks])
+        if str(self.data_type).upper() == "SEQ":
+            self.samples = sents           # one id-sequence per sentence
+        else:
+            out = []
+            n = self.window_size
+            for s in sents:
+                for i in range(len(s) - n + 1):
+                    out.append(tuple(s[i:i + n]))
+            self.samples = out

@@ -805,6 +805,22 @@ CASES += [
 ]
 
 
+def test_linalg_extras():
+    a = randn(4, 4)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    c = np.linalg.cholesky(spd).astype(np.float32)
+    inv = paddle.linalg.cholesky_inverse(paddle.to_tensor(c))
+    np.testing.assert_allclose(np.asarray(inv.numpy()), np.linalg.inv(spd),
+                               rtol=2e-3, atol=1e-4)
+    lu_d, piv = paddle.linalg.lu(paddle.to_tensor(spd))
+    b = randn(4, 2)
+    x = paddle.linalg.lu_solve(paddle.to_tensor(b), lu_d, piv)
+    np.testing.assert_allclose(spd @ np.asarray(x.numpy()), b,
+                               rtol=1e-3, atol=1e-3)
+    mt = paddle.linalg.matrix_transpose(paddle.to_tensor(a))
+    np.testing.assert_array_equal(np.asarray(mt.numpy()), a.T)
+
+
 def test_lu_unpack_reconstructs():
     a = randn(5, 5)
     lu_d, piv = paddle.linalg.lu(paddle.to_tensor(a))
@@ -832,6 +848,9 @@ EXEMPT = {
     "lu_unpack": "multi-output; covered by test_lu_unpack_reconstructs",
     "rank": "host-side shape metadata; covered by test_rank_shape_meta",
     "crop": "static slicing; covered by test_compat_namespaces",
+    "matrix_transpose": "covered by test_linalg_extras",
+    "cholesky_inverse": "covered by test_linalg_extras",
+    "lu_solve": "covered by test_linalg_extras",
     "shape": "host-side shape metadata; covered by test_rank_shape_meta",
     # module plumbing, not ops
     "apply": "tape dispatcher import", "defop": "tape decorator import",

@@ -131,3 +131,58 @@ def test_asp_prune_and_maintain():
     w2 = model.weight.numpy()
     assert ((w2 != 0) == (w != 0)).all()   # sparsity pattern preserved
     asp._masks.clear()
+
+
+def test_text_datasets(tmp_path):
+    """Cache-resolving text datasets: synthetic UCIHousing trains a
+    regressor; cache misses raise with the expected path; a locally
+    built Imdb archive parses."""
+    import io
+    import os
+    import tarfile
+
+    import numpy as np
+    import pytest
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as opt
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.text import UCIHousing, Imdb
+
+    ds = UCIHousing(synthetic=64)
+    model = nn.Linear(13, 1)
+    o = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+    first = last = None
+    for ep in range(5):
+        for x, y in DataLoader(ds, batch_size=16):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward(); o.step(); o.clear_grad()
+            last = float(loss.numpy())
+            if first is None:
+                first = last
+    assert last < first
+
+    with pytest.raises(IOError, match="place the reference archive"):
+        Imdb(data_file="/nonexistent/aclImdb_v1.tar.gz")
+
+    # build a tiny archive in the Imdb layout and parse it
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        for i, (split, lab, txt) in enumerate([
+                ("train", "pos", b"great movie loved it"),
+                ("train", "neg", b"terrible waste of time"),
+                ("test", "pos", b"fine")]):
+            data = txt
+            info = tarfile.TarInfo(f"aclImdb/{split}/{lab}/{i}.txt")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    path = str(tmp_path / "test_imdb.tar.gz")
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+    imdb = Imdb(data_file=path, mode="train")
+    assert len(imdb) == 2
+    ids, label = imdb[0]
+    assert label in (0, 1) and len(ids) == 4
+    # train/test instances share word ids (whole-archive vocab)
+    imdb_test = Imdb(data_file=path, mode="test")
+    assert imdb_test.word_idx == imdb.word_idx
