@@ -263,8 +263,12 @@ def cholesky_inverse(x, upper=False, name=None):
 
 def lu_solve(b, lu_data, lu_pivots, trans="N", name=None):
     """paddle.linalg.lu_solve — solve A x = b from lu()'s packed factor."""
+    if trans not in ("N", "T", "C"):
+        raise ValueError(f"lu_solve: trans must be 'N', 'T' or 'C', "
+                         f"got {trans!r}")
+
     def fn(bb, lu_, piv):
-        t = {"N": 0, "T": 1, "C": 2}.get(trans, 0)
+        t = {"N": 0, "T": 1, "C": 2}[trans]
         return jax.scipy.linalg.lu_solve((lu_, piv.astype(jnp.int32)),
                                          bb, trans=t)
     return apply(fn, b, lu_data, lu_pivots, op_name="lu_solve")

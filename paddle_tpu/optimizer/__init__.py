@@ -417,6 +417,9 @@ class L2Decay:
 
 
 class L1Decay:
+    _l1 = True       # step() applies coeff*sign(w) — same contract as
+                     # paddle_tpu.regularizer.L1Decay
+
     def __init__(self, coeff=0.0):
         self._coeff = coeff
 

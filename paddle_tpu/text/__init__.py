@@ -109,8 +109,8 @@ class _CachedDataset:
         import os
         self.mode = mode
         if data_file is None:
-            cache = os.path.expanduser("~/.cache/paddle_tpu/datasets")
-            data_file = os.path.join(cache, self._filename)
+            from ..utils import dataset_cache_path
+            data_file = dataset_cache_path(self._filename)
         if not os.path.exists(data_file):
             raise IOError(
                 f"{type(self).__name__}: no network egress in the TPU "
@@ -162,30 +162,41 @@ class Imdb(_CachedDataset):
 
     _filename = "aclImdb_v1.tar.gz"
 
+    _vocab_cache = {}     # data_file -> word_idx (one archive pass)
+
     def _load(self):
         import re
         from collections import Counter
         import tarfile
         any_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
         tok_pat = re.compile(r"[a-z']+")
-        # pass 1: frequency-sorted vocab over the WHOLE archive so train
-        # and test instances share word ids (reference build_dict)
-        freq = Counter()
+        # frequency-sorted vocab over the WHOLE archive so train and test
+        # instances share word ids (reference build_dict); cached per
+        # archive so the second split skips the full decode pass
+        cached = Imdb._vocab_cache.get(self.data_file)
+        freq = Counter() if cached is None else None
         mode_docs = []
         with tarfile.open(self.data_file) as tf:
             for m in tf.getmembers():
                 match = any_pat.match(m.name)
                 if not match:
                     continue
+                in_mode = match.group(1) == self.mode
+                if freq is None and not in_mode:
+                    continue            # vocab cached: only read our split
                 text = tf.extractfile(m).read().decode(
                     "utf-8", "ignore").lower()
                 toks = tok_pat.findall(text)
-                freq.update(toks)
-                if match.group(1) == self.mode:
+                if freq is not None:
+                    freq.update(toks)
+                if in_mode:
                     mode_docs.append(
                         (toks, 0 if match.group(2) == "pos" else 1))
-        self.word_idx = {w: i for i, (w, _) in enumerate(
-            sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))}
+        if cached is None:
+            cached = {w: i for i, (w, _) in enumerate(
+                sorted(freq.items(), key=lambda kv: (-kv[1], kv[0])))}
+            Imdb._vocab_cache[self.data_file] = cached
+        self.word_idx = cached
         self.samples = [([self.word_idx[t] for t in toks], lab)
                         for toks, lab in mode_docs]
 
@@ -203,17 +214,30 @@ class Imikolov(_CachedDataset):
 
     def _load(self):
         import tarfile
-        name = (f"./simple-examples/data/ptb.{self.mode}.txt")
         with tarfile.open(self.data_file) as tf:
-            text = tf.extractfile(name).read().decode("utf-8")
-        self.word_idx = {"<eos>": 0}
+            # vocab ALWAYS from the train file (first-occurrence order) so
+            # train/test instances share word ids (reference build_dict)
+            train_text = tf.extractfile(
+                "./simple-examples/data/ptb.train.txt").read().decode(
+                "utf-8")
+            self.word_idx = {"<eos>": 0, "<unk>": 1}
+            for line in train_text.splitlines():
+                for t in line.split():
+                    self.word_idx.setdefault(t, len(self.word_idx))
+            if self.mode == "train":
+                text = train_text
+            else:
+                text = tf.extractfile(
+                    f"./simple-examples/data/ptb.{self.mode}.txt"
+                ).read().decode("utf-8")
+        unk = self.word_idx["<unk>"]
         sents = []
         for line in text.splitlines():
             toks = line.split() + ["<eos>"]
-            sents.append([self.word_idx.setdefault(t, len(self.word_idx))
-                          for t in toks])
+            sents.append([self.word_idx.get(t, unk) for t in toks])
         if str(self.data_type).upper() == "SEQ":
-            self.samples = sents           # one id-sequence per sentence
+            # reference SEQ mode: (src, trg) = (l[:-1], l[1:]) per sentence
+            self.samples = [(s[:-1], s[1:]) for s in sents if len(s) > 1]
         else:
             out = []
             n = self.window_size

@@ -125,3 +125,10 @@ def try_import(name, err_msg=None):
         return importlib.import_module(name)
     except ImportError as e:
         raise ImportError(err_msg or str(e))
+
+
+def dataset_cache_path(filename):
+    """Shared local dataset cache (~/.cache/paddle/dataset — the same root
+    MNIST/Cifar resolve from) for the zero-egress build."""
+    return os.path.join(os.path.expanduser("~/.cache/paddle/dataset"),
+                        filename)

@@ -161,3 +161,75 @@ class MNIST(Dataset):
 
 class FashionMNIST(MNIST):
     pass
+
+
+class _CachedVisionDataset(Dataset):
+    """Reference vision datasets in the zero-egress build: resolve the
+    archive from ~/.cache/paddle_tpu/datasets and raise with the expected
+    path on a miss (reference: ``python/paddle/vision/datasets/``)."""
+
+    _filename = None
+
+    def __init__(self, data_file=None, mode="train", transform=None, **kw):
+        self.mode = mode
+        self.transform = transform
+        if data_file is None:
+            from ...utils import dataset_cache_path
+            data_file = dataset_cache_path(self._filename)
+        if not os.path.exists(data_file):
+            raise IOError(
+                f"{type(self).__name__}: no network egress in the TPU "
+                f"build — place the reference archive at {data_file}")
+        self.data_file = data_file
+        self._load()
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        img, label = self.samples[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class Flowers(_CachedVisionDataset):
+    """102-category flowers (102flowers.tgz + imagelabels.mat +
+    setid.mat placed side by side in the cache dir)."""
+
+    _filename = "102flowers.tgz"
+
+    def _load(self):
+        raise NotImplementedError(
+            "Flowers: archive parsing requires scipy.io + PIL decoding of "
+            "the jpgs; place the extracted arrays as flowers_<mode>.npz "
+            "({'images': uint8 NHWC, 'labels': int64}) next to the archive "
+            "and use FlowersArrays instead")
+
+
+class FlowersArrays(_CachedVisionDataset):
+    """Flowers from a pre-extracted ``flowers_<mode>.npz`` (images uint8
+    NHWC + labels int64) — the decoded-array path for offline machines."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, **kw):
+        self._filename = f"flowers_{mode}.npz"
+        super().__init__(data_file, mode, transform, **kw)
+
+    def _load(self):
+        blob = np.load(self.data_file)
+        self.samples = [(blob["images"][i], int(blob["labels"][i]))
+                        for i in range(len(blob["labels"]))]
+
+
+class VOC2012(_CachedVisionDataset):
+    """Pascal VOC 2012 segmentation pairs from a pre-extracted
+    ``voc2012_<mode>.npz`` ({'images': uint8 NHWC, 'masks': uint8 NHW})."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, **kw):
+        self._filename = f"voc2012_{mode}.npz"
+        super().__init__(data_file, mode, transform, **kw)
+
+    def _load(self):
+        blob = np.load(self.data_file)
+        self.samples = [(blob["images"][i], blob["masks"][i])
+                        for i in range(len(blob["images"]))]

@@ -202,3 +202,26 @@ def test_dataloader_resume_rejects_default_sampler():
     dl = DataLoader(DS(), batch_size=4)
     with _pytest.raises(ValueError, match="set_state_dict"):
         dl.set_state_dict({"epoch": 0, "consumed_batches": 2})
+
+
+def test_cached_vision_datasets(tmp_path):
+    import numpy as np
+    import pytest
+    from paddle_tpu.vision.datasets import FlowersArrays, VOC2012
+
+    np.savez(tmp_path / "flowers_train.npz",
+             images=np.zeros((4, 8, 8, 3), np.uint8),
+             labels=np.arange(4, dtype=np.int64))
+    ds = FlowersArrays(data_file=str(tmp_path / "flowers_train.npz"))
+    img, lab = ds[1]
+    assert img.shape == (8, 8, 3) and lab == 1 and len(ds) == 4
+
+    np.savez(tmp_path / "voc.npz",
+             images=np.zeros((2, 8, 8, 3), np.uint8),
+             masks=np.ones((2, 8, 8), np.uint8))
+    voc = VOC2012(data_file=str(tmp_path / "voc.npz"))
+    img, mask = voc[0]
+    assert mask.shape == (8, 8)
+
+    with pytest.raises(IOError, match="place the reference archive"):
+        VOC2012(data_file=str(tmp_path / "missing.npz"))
