@@ -4,9 +4,11 @@ concurrent generate() calls.
 
     python examples/serve_llama_hf.py --model-dir /path/to/hf_llama
     python examples/serve_llama_hf.py            # tiny random demo model
+    FORCE_CPU=0 python examples/serve_llama_hf.py   # use the accelerator
 
-On TPU the decode path runs jax's production paged-attention Pallas
-kernel; on CPU it runs the in-repo interpret-mode kernel — same API.
+Defaults to the CPU backend (FORCE_CPU=1) so the demo runs anywhere; with
+FORCE_CPU=0 on a TPU host the decode path runs jax's production
+paged-attention Pallas kernel — same API either way.
 """
 import argparse
 import os

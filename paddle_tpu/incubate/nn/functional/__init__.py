@@ -45,3 +45,99 @@ def fused_multi_head_attention(*a, **kw):
 def fused_feedforward(*a, **kw):
     raise NotImplementedError(
         "fused_feedforward: compose Linear+activation — XLA fuses the chain")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """paddle.incubate.nn.functional.fused_linear — on TPU XLA fuses the
+    matmul+bias chain; this is the API-parity entry (reference routes to
+    the cublasLt fused gemm epilogue)."""
+    def fn(a, w, *b):
+        wt = jnp.swapaxes(w, -1, -2) if transpose_weight else w
+        out = a @ wt
+        if b:
+            out = out + b[0]
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(fn, *args, op_name="fused_linear")
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """fused matmul + bias + activation (gelu/relu) — one XLA fusion."""
+    def fn(a, w, b):
+        if trans_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if trans_y:
+            w = jnp.swapaxes(w, -1, -2)
+        out = a @ w + b
+        if activation == "gelu":
+            return jax.nn.gelu(out)
+        if activation == "relu":
+            return jax.nn.relu(out)
+        if activation in ("", "none", None):
+            return out
+        raise ValueError(f"unsupported activation {activation!r}")
+    return apply(fn, x, y, bias, op_name="fused_linear_activation")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y in one fused op (reference fused_dropout_add)."""
+    from ....framework import random as prandom
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and p > 0.0:
+            # reference eval semantics for this mode: scale by (1-p)
+            return apply(lambda a, b: a * (1.0 - p) + b, x, y,
+                         op_name="fused_dropout_add")
+        return apply(lambda a, b: a + b, x, y, op_name="fused_dropout_add")
+    key = prandom.next_key()
+
+    def fn(a, b):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        if mode == "upscale_in_train":
+            a = jnp.where(keep, a / (1.0 - p), 0.0)
+        else:
+            a = jnp.where(keep, a, 0.0)
+        return a + b
+    return apply(fn, x, y, op_name="fused_dropout_add")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """layer_norm(residual + dropout(x + bias)) — the reference's fused
+    residual block epilogue; XLA fuses the chain on TPU."""
+    from ....framework import random as prandom
+    key = prandom.next_key() if (training and dropout_rate > 0.0) else None
+
+    def fn(a, res, *rest):
+        it = iter(rest)
+        b = next(it) if bias is not None else None
+        g = next(it) if ln_scale is not None else None
+        beta = next(it) if ln_bias is not None else None
+        if b is not None:
+            a = a + b
+        if key is not None:
+            keep = jax.random.bernoulli(key, 1.0 - dropout_rate, a.shape)
+            if mode == "upscale_in_train":
+                a = jnp.where(keep, a / (1.0 - dropout_rate), 0.0)
+            else:
+                a = jnp.where(keep, a, 0.0)
+        elif mode == "downscale_in_infer" and dropout_rate > 0.0:
+            a = a * (1.0 - dropout_rate)   # reference eval scaling
+        h = a + res
+        mu = jnp.mean(h, -1, keepdims=True)
+        var = jnp.var(h, -1, keepdims=True)
+        out = (h - mu) * jax.lax.rsqrt(var + ln_epsilon)
+        if g is not None:
+            out = out * g
+        if beta is not None:
+            out = out + beta
+        return out
+
+    args = [x, residual]
+    for t in (bias, ln_scale, ln_bias):
+        if t is not None:
+            args.append(t)
+    return apply(fn, *args, op_name="fused_bias_dropout_residual_ln")

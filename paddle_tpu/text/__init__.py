@@ -94,8 +94,8 @@ class ViterbiDecoder:
 # ---------------------------------------------------------------------------
 # datasets (reference: ``python/paddle/text/datasets/`` — UCIHousing, Imdb,
 # Imikolov, Movielens, Conll05, WMT14/16). Zero-egress build: each dataset
-# resolves from the local weight/data cache
-# (~/.cache/paddle_tpu/datasets/<name>) and raises with the expected path
+# resolves from the shared local cache (~/.cache/paddle/dataset/<name>,
+# utils.dataset_cache_path) and raises with the expected path
 # on a miss; UCIHousing additionally offers a deterministic synthetic mode
 # for tests/examples.
 # ---------------------------------------------------------------------------

@@ -165,8 +165,9 @@ class FashionMNIST(MNIST):
 
 class _CachedVisionDataset(Dataset):
     """Reference vision datasets in the zero-egress build: resolve the
-    archive from ~/.cache/paddle_tpu/datasets and raise with the expected
-    path on a miss (reference: ``python/paddle/vision/datasets/``)."""
+    archive from the shared ~/.cache/paddle/dataset root and raise with
+    the expected path on a miss (reference:
+    ``python/paddle/vision/datasets/``)."""
 
     _filename = None
 
@@ -193,18 +194,17 @@ class _CachedVisionDataset(Dataset):
         return img, label
 
 
-class Flowers(_CachedVisionDataset):
-    """102-category flowers (102flowers.tgz + imagelabels.mat +
-    setid.mat placed side by side in the cache dir)."""
+class Flowers:
+    """102-category flowers. The raw 102flowers.tgz needs PIL jpeg
+    decoding (not in this build) — use :class:`FlowersArrays` with a
+    pre-extracted ``flowers_<mode>.npz``; this class exists to give that
+    guidance at construction time."""
 
-    _filename = "102flowers.tgz"
-
-    def _load(self):
+    def __init__(self, *a, **kw):
         raise NotImplementedError(
-            "Flowers: archive parsing requires scipy.io + PIL decoding of "
-            "the jpgs; place the extracted arrays as flowers_<mode>.npz "
-            "({'images': uint8 NHWC, 'labels': int64}) next to the archive "
-            "and use FlowersArrays instead")
+            "Flowers: jpeg decoding is unavailable offline; extract the "
+            "archive to flowers_<mode>.npz ({'images': uint8 NHWC, "
+            "'labels': int64}) and use vision.datasets.FlowersArrays")
 
 
 class FlowersArrays(_CachedVisionDataset):

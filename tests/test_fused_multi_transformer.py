@@ -138,3 +138,38 @@ def test_attn_mask_shapes():
     np.testing.assert_allclose(out3[1], out_plain[1], rtol=1e-5, atol=1e-6)
     # row 0 (beyond pos 0, which attends to itself only) must differ
     assert np.abs(out3[0, 1:] - out_plain[0, 1:]).max() > 1e-4
+
+
+def test_fused_functional_shims():
+    """incubate.nn.functional fused_* API-parity shims compute the same
+    math as the composed ops (XLA provides the fusion on TPU)."""
+    import paddle_tpu.incubate.nn.functional as IF
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    w = paddle.to_tensor(rng.randn(8, 6).astype(np.float32))
+    b = paddle.to_tensor(rng.randn(6).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(IF.fused_linear(x, w, b).numpy()),
+        x.numpy() @ w.numpy() + b.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(IF.fused_linear_activation(x, w, b,
+                                              activation="relu").numpy()),
+        np.maximum(x.numpy() @ w.numpy() + b.numpy(), 0), rtol=1e-5)
+    y = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(IF.fused_dropout_add(x, y, p=0.0).numpy()),
+        x.numpy() + y.numpy(), rtol=1e-6)
+    h = x.numpy() + y.numpy()
+    want = ((h - h.mean(-1, keepdims=True))
+            / np.sqrt(h.var(-1, keepdims=True) + 1e-5))
+    got = IF.fused_bias_dropout_residual_layer_norm(
+        x, y, ln_scale=paddle.to_tensor(np.ones(8, np.float32)),
+        dropout_rate=0.0)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want, rtol=1e-4,
+                               atol=1e-5)
+    # dropout path differentiates
+    xt = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    xt.stop_gradient = False
+    paddle.seed(3)
+    IF.fused_dropout_add(xt, y, p=0.4).sum().backward()
+    assert np.isfinite(np.asarray(xt.grad.numpy())).all()
