@@ -152,7 +152,15 @@ INVENTORY = [
      ["LBFGS", "RAdam", "NAdam", "Rprop", "ASGD"]),
     ("Vision zoo batch 2", "paddle_tpu.vision.models",
      ["AlexNet", "SqueezeNet", "MobileNetV3Small", "ShuffleNetV2",
-      "DenseNet", "wide_resnet50_2"]),
+      "DenseNet", "wide_resnet50_2", "GoogLeNet", "InceptionV3"]),
+    ("Compat namespaces", "paddle_tpu",
+     ["iinfo", "finfo", "is_tensor", "create_parameter", "flops",
+      "LazyGuard"]),
+    ("Fused functional shims", "paddle_tpu.incubate.nn.functional",
+     ["fused_linear", "fused_dropout_add",
+      "fused_bias_dropout_residual_layer_norm"]),
+    ("Text datasets (cache-gated)", "paddle_tpu.text",
+     ["UCIHousing", "Imdb", "Imikolov"]),
 ]
 
 
