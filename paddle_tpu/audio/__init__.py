@@ -155,3 +155,54 @@ class features:
     MelSpectrogram = MelSpectrogram
     LogMelSpectrogram = LogMelSpectrogram
     MFCC = MFCC
+
+
+# ---------------------------------------------------------------------------
+# datasets (reference: ``python/paddle/audio/datasets/`` — TESS, ESC50).
+# Zero-egress: resolve pre-extracted arrays from the shared local cache.
+# ---------------------------------------------------------------------------
+
+class _CachedAudioDataset:
+    """Waveform datasets from a pre-extracted ``<name>_<mode>.npz``
+    ({'waveforms': float32 [N, T], 'labels': int64 [N]})."""
+
+    _name = None
+
+    def __init__(self, mode="train", feat_type="raw", data_file=None,
+                 sample_rate=16000, **kw):
+        import os
+        self.mode = mode
+        self.feat_type = feat_type
+        if data_file is None:
+            from ..utils import dataset_cache_path
+            data_file = dataset_cache_path(f"{self._name}_{mode}.npz")
+        if not os.path.exists(data_file):
+            raise IOError(
+                f"{type(self).__name__}: no network egress in the TPU "
+                f"build — place the pre-extracted arrays at {data_file}")
+        blob = np.load(data_file)
+        self.waveforms = blob["waveforms"].astype(np.float32)
+        self.labels = blob["labels"].astype(np.int64)
+        # build the (filterbank-heavy) transform ONCE, not per sample
+        self._mfcc = (MFCC(sr=sample_rate) if feat_type == "mfcc" else None)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        wav = self.waveforms[i]
+        if self._mfcc is not None:
+            wav = np.asarray(self._mfcc(Tensor(wav[None])).numpy())[0]
+        return wav, int(self.labels[i])
+
+
+class TESS(_CachedAudioDataset):
+    """Toronto emotional speech set (reference paddle.audio.datasets.TESS)."""
+
+    _name = "tess"
+
+
+class ESC50(_CachedAudioDataset):
+    """ESC-50 environmental sounds (reference paddle.audio.datasets.ESC50)."""
+
+    _name = "esc50"

@@ -550,11 +550,23 @@ class Multinomial(Distribution):
     def log_prob(self, value):
         def fn(p, v):
             pn = p / jnp.sum(p, -1, keepdims=True)
+            # xlogy semantics: v=0 contributes 0 even when pn=0 (else
+            # 0 * -inf poisons entropy() for zero-prob categories)
+            term = jnp.where(v == 0, 0.0,
+                             v * jnp.log(jnp.maximum(pn, 1e-38)))
             return (jax.lax.lgamma(jnp.asarray(self.total_count + 1.0))
                     - jnp.sum(jax.lax.lgamma(v + 1.0), -1)
-                    + jnp.sum(v * jnp.log(pn), -1))
+                    + jnp.sum(term, -1))
         return apply(fn, self.probs_param, _param(value),
                      op_name="multinomial_log_prob")
+
+    def entropy(self):
+        """Monte-Carlo entropy (no closed form; reference estimates
+        similarly): -E[log_prob] over framework-PRNG draws."""
+        draws = self.sample((256,))
+        lp = self.log_prob(draws)
+        return apply(lambda a: -jnp.mean(a, axis=0), lp,
+                     op_name="multinomial_entropy")
 
 
 class MultivariateNormal(Distribution):
@@ -710,6 +722,24 @@ class Binomial(Distribution):
             return logc + v * jnp.log(pc) + (n - v) * jnp.log1p(-pc)
         return apply(fn, self.probs_param, _param(value),
                      op_name="binomial_log_prob")
+
+    def entropy(self):
+        """Exact entropy by summing -pmf*log_pmf over the (static)
+        support 0..total_count."""
+        n = self.total_count
+
+        def fn(p):
+            eps = 1e-7
+            pc = jnp.clip(p, eps, 1 - eps)
+            k = jnp.arange(n + 1, dtype=jnp.float32)
+            shape = pc.shape + (1,)
+            pcr = pc.reshape(shape)
+            logpmf = (jax.lax.lgamma(jnp.asarray(n + 1.0))
+                      - jax.lax.lgamma(k + 1.0)
+                      - jax.lax.lgamma(n - k + 1.0)
+                      + k * jnp.log(pcr) + (n - k) * jnp.log1p(-pcr))
+            return -jnp.sum(jnp.exp(logpmf) * logpmf, -1)
+        return apply(fn, self.probs_param, op_name="binomial_entropy")
 
 
 class Cauchy(Distribution):

@@ -17,3 +17,9 @@ def identity_loss(x, reduction="none"):
     if reduction in ("sum",):
         return pmath.sum(x)
     return x
+
+# reference exposes the segment pools under incubate too
+# (python/paddle/incubate/tensor/math.py)
+from ..geometric import (  # noqa: E402,F401
+    segment_sum, segment_mean, segment_max, segment_min,
+)

@@ -321,3 +321,13 @@ def test_kl_differentiable():
     # d/ds 0.5(s^2/4 - 1 - log(s^2/4)) = s/4 - 1/s
     np.testing.assert_allclose(np.asarray(s.grad.numpy()),
                                1 / 4 - 1.0, rtol=1e-4)
+
+
+def test_binomial_multinomial_entropy():
+    b = Binomial(10, t(0.4))
+    np.testing.assert_allclose(float(b.entropy().numpy()),
+                               st.binom(10, 0.4).entropy(), rtol=1e-4)
+    paddle.seed(0)
+    m = Multinomial(8, t([0.2, 0.3, 0.5]))
+    ent = float(m.entropy().numpy())
+    assert abs(ent - st.multinomial(8, [0.2, 0.3, 0.5]).entropy()) < 0.2

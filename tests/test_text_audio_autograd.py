@@ -186,3 +186,31 @@ def test_text_datasets(tmp_path):
     # train/test instances share word ids (whole-archive vocab)
     imdb_test = Imdb(data_file=path, mode="test")
     assert imdb_test.word_idx == imdb.word_idx
+
+
+def test_audio_datasets(tmp_path):
+    import numpy as np
+    import pytest
+    from paddle_tpu.audio import TESS, ESC50
+
+    np.savez(tmp_path / "w.npz",
+             waveforms=np.random.RandomState(0).rand(3, 400)
+             .astype("float32"),
+             labels=np.arange(3, dtype=np.int64))
+    ds = TESS(data_file=str(tmp_path / "w.npz"))
+    wav, lab = ds[2]
+    assert wav.shape == (400,) and lab == 2 and len(ds) == 3
+    with pytest.raises(IOError, match="place the pre-extracted"):
+        ESC50(data_file=str(tmp_path / "missing.npz"))
+
+
+def test_incubate_segment_alias():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.incubate as inc
+
+    out = inc.segment_sum(
+        paddle.to_tensor(np.ones((4, 2), np.float32)),
+        paddle.to_tensor(np.array([0, 0, 1, 1], np.int64)))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[2, 2], [2, 2]])
