@@ -248,3 +248,66 @@ class AllreduceOverlapPass(PassBase):
             "allreduce overlap: XLA latency-hiding scheduler overlaps "
             "grad collectives with the backward matmuls")
         return plan
+
+
+def build_strategy_from_plan(plan):
+    """Execute a pass plan: fold the dict the passes produced into a
+    concrete ``DistributedStrategy`` (+ model-config knobs via
+    :func:`apply_plan_to_config`) that ``fleet.init`` /
+    ``distributed_model`` actually run with — the reference's
+    program-rewrite step collapsed onto strategy/config space (on TPU the
+    rewrites themselves are XLA sharding/fusion passes)."""
+    from ..fleet.distributed_strategy import DistributedStrategy
+
+    strat = DistributedStrategy()
+    if "amp" in plan:
+        strat.amp = True
+        amp = dict(plan["amp"])
+        strat.amp_configs = {
+            "level": amp.get("level", "O2"),
+            "dtype": amp.get("dtype", "bfloat16"),
+            "use_master_weights": amp.get("master_weights", True),
+            "use_master_grad": amp.get("master_grad", False),
+        }
+    if "recompute" in plan and plan["recompute"].get("enable", True):
+        strat.recompute = True
+        strat.recompute_configs = dict(plan["recompute"])
+    h = dict(strat.hybrid_configs)          # accumulate; assign once at
+    if "sharding" in plan:                  # the end (the setter merges
+        strat.sharding = True               # from DEFAULTS, not current)
+        strat.sharding_configs = dict(plan["sharding"])
+        h["sharding_degree"] = int(plan["sharding"].get("degree", 1) or 1)
+        # the stage HybridParallelOptimizer actually reads lives under
+        # hybrid_configs["sharding_configs"]
+        sc = dict(h.get("sharding_configs", {}))
+        sc["stage"] = int(plan["sharding"].get("stage", 1))
+        h["sharding_configs"] = sc
+    if "pipeline" in plan:
+        pp = plan["pipeline"]
+        h["pp_degree"] = int(pp.get("pp_degree", pp.get("degree", 1)) or 1)
+        ppc = dict(h.get("pp_configs", {}))
+        ppc["schedule_mode"] = pp.get("schedule_mode", "1F1B")
+        ppc["accumulate_steps"] = int(pp.get("accumulate_steps", 1))
+        ppc["vpp_degree"] = int(pp.get("vpp_degree", 1))
+        h["pp_configs"] = ppc               # the runtime reads pp_configs
+    strat.hybrid_configs = h
+    if "gradient_merge" in plan:
+        strat.gradient_merge = True
+        strat.gradient_merge_configs = dict(plan["gradient_merge"])
+    return strat
+
+
+def apply_plan_to_config(plan, model_config):
+    """Push plan knobs that live on the MODEL into its config (recompute
+    granularity, sequence parallel) — returns the same config object."""
+    rc = plan.get("recompute")
+    if rc and rc.get("enable", True) \
+            and hasattr(model_config, "use_recompute"):
+        model_config.use_recompute = True
+        gran = rc.get("granularity")
+        if gran and hasattr(model_config, "recompute_granularity"):
+            model_config.recompute_granularity = gran
+    if plan.get("sequence_parallel") \
+            and hasattr(model_config, "sequence_parallel"):
+        model_config.sequence_parallel = True
+    return model_config
