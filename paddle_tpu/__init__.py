@@ -26,6 +26,10 @@ from .framework import core as _core  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .ops import linalg  # noqa: F401
 from .ops.linalg import norm, dist, inv as inverse  # noqa: F401
+from .ops.linalg import (  # noqa: F401  (reference top-level aliases)
+    matrix_power, cov, corrcoef,
+)
+from .ops import bitwise_not as bitwise_invert  # noqa: F401
 from .autograd import no_grad, enable_grad, grad, set_grad_enabled, is_grad_enabled  # noqa: F401
 from .autograd.pylayer import PyLayer  # noqa: F401
 from . import framework  # noqa: F401

@@ -118,6 +118,8 @@ def monkey_patch_tensor():
         tensor_split hsplit vsplit dsplit vander atleast_1d atleast_2d
         atleast_3d
         sgn cdist unfold trapezoid cumulative_trapezoid rank
+        float_power vdot nanargmax nanargmin positive isin fliplr
+        flipud index_copy view view_as
     """.split()
     for name in methods:
         fn = getattr(ops, name, None) or getattr(ops.linalg, name, None)
@@ -140,6 +142,21 @@ def monkey_patch_tensor():
         s, op_name="fill_diagonal_"))
     T.uniform_ = lambda s, min=-1.0, max=1.0, seed=0: s._replace_(
         ops.uniform(s.shape, dtype=s.dtype, min=min, max=max)._data)
+    # in-place distribution fills (reference Tensor.cauchy_/geometric_/
+    # log_normal_) — framework-PRNG seeded
+    def _fill_from(dist_builder):
+        def fill(s, *a, **kw):
+            d = dist_builder(*a, **kw)
+            return s._replace_(
+                d.sample(tuple(s.shape))._data.astype(s.dtype))
+        return fill
+    from ..distribution import Cauchy as _Cauchy, Geometric as _Geometric, \
+        LogNormal as _LogNormal
+    T.cauchy_ = _fill_from(lambda loc=0.0, scale=1.0, **k:
+                           _Cauchy(loc, scale))
+    T.geometric_ = _fill_from(lambda probs=0.5, **k: _Geometric(probs))
+    T.log_normal_ = _fill_from(lambda mean=1.0, std=2.0, **k:
+                               _LogNormal(mean, std))
     T.normal_ = lambda s, mean=0.0, std=1.0: s._replace_(
         (ops.randn(s.shape, dtype=s.dtype) * std + mean)._data)
 

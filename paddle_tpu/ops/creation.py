@@ -244,3 +244,15 @@ def complex(real, imag, name=None):
     """paddle.complex — build a complex tensor from real/imag parts."""
     return apply(lambda r, i: jax.lax.complex(r, i), real, imag,
                  op_name="complex")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.tril_indices(int(row), k=int(offset), m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(_dt(dtype, "int64")))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = jnp.triu_indices(int(row), k=int(offset), m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(_dt(dtype, "int64")))

@@ -601,3 +601,57 @@ def crop(x, shape=None, offsets=None, name=None):
         return a[idx]
 
     return apply(fn, x, op_name="crop")
+
+
+@defop
+def fliplr(x):
+    return jnp.fliplr(x)
+
+
+@defop
+def flipud(x):
+    return jnp.flipud(x)
+
+
+@defop
+def index_copy(x, index, axis, value):
+    idx = [builtins_slice(None)] * x.ndim
+    idx[axis % x.ndim] = index
+    return x.at[tuple(idx)].set(value)
+
+
+def view(x, shape_or_dtype, name=None):
+    """paddle.view — reshape (list/tuple) or dtype reinterpretation.
+
+    Dtype views follow the reference shape rule: the LAST dim rescales by
+    the byte-width ratio (f32 (2,6) viewed as f16 -> (2,12); f16 (2,6)
+    viewed as f32 -> (2,3)), unlike raw lax.bitcast_convert_type which
+    appends/consumes a trailing ratio dim."""
+    from ..framework import dtype as dtypes
+    import numpy as np
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return apply(lambda a: a.reshape(tuple(int(s)
+                                               for s in shape_or_dtype)),
+                     x, op_name="view")
+    dt = dtypes.convert_dtype(shape_or_dtype)
+
+    def fn(a):
+        src = np.dtype(a.dtype).itemsize
+        dst = np.dtype(dt).itemsize
+        if src == dst:
+            return jax.lax.bitcast_convert_type(a, dt)
+        if src > dst:                      # narrowing: split last dim
+            out = jax.lax.bitcast_convert_type(a, dt)   # (..., n, r)
+            return out.reshape(a.shape[:-1] + (a.shape[-1] * (src // dst),))
+        r = dst // src                     # widening: fold last dim
+        if a.shape[-1] % r:
+            raise ValueError(
+                f"view: last dim {a.shape[-1]} not divisible by the "
+                f"byte-width ratio {r}")
+        packed = a.reshape(a.shape[:-1] + (a.shape[-1] // r, r))
+        return jax.lax.bitcast_convert_type(packed, dt)
+    return apply(fn, x, op_name="view")
+
+
+def view_as(x, other, name=None):
+    return view(x, list(other.shape))

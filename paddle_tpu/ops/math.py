@@ -714,3 +714,43 @@ def combinations(x, r=2, with_replacement=False):
           if with_replacement else itertools.combinations(range(n), r))
     idx = np.array(list(it), np.int32).reshape(-1, r)
     return x[idx]
+
+
+@defop
+def float_power(x, y):
+    return jnp.float_power(x, y)
+
+
+@defop
+def vdot(x, y):
+    return jnp.vdot(x, y)
+
+
+@defop
+def nanargmax(x, axis=None, keepdim=False):
+    return jnp.nanargmax(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def nanargmin(x, axis=None, keepdim=False):
+    return jnp.nanargmin(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@defop
+def positive(x):
+    return +x
+
+
+@defop
+def isin(x, test_x, assume_unique=False, invert=False):
+    return jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    def fn(a, *w):
+        return jnp.histogramdd(a, bins=bins, range=ranges,
+                               density=density,
+                               weights=w[0] if w else None)
+    args = (x,) + ((weights,) if weights is not None else ())
+    return apply(fn, *args, op_name="histogramdd")

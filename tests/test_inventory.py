@@ -42,3 +42,11 @@ def test_compat_namespaces():
     assert paddle.tensor.matmul is paddle.matmul
     p = paddle.create_parameter([2, 2], is_bias=True)
     assert float(np.abs(np.asarray(p.numpy())).sum()) == 0.0
+    v = paddle.view(paddle.to_tensor(np.zeros((2, 6), np.float32)), [3, 4])
+    assert tuple(v.shape) == (3, 4)
+    tl = np.asarray(paddle.tril_indices(3).numpy())
+    want_r, want_c = np.tril_indices(3)
+    np.testing.assert_array_equal(tl, np.stack([want_r, want_c]))
+    hist = paddle.histogramdd(paddle.to_tensor(
+        np.random.rand(20, 2).astype(np.float32)), bins=4)
+    assert np.asarray(hist[0].numpy()).sum() == 20

@@ -795,6 +795,23 @@ def _np_unfold(x):
 
 CASES += [
     OpCase("sgn", _mk(x=lambda: randn(3, 4)), ref=np.sign),
+    OpCase("float_power", _mk(x=lambda: randpos(3, 4), y=lambda: randu(3, 4, lo=1, hi=2)),
+           ref=np.float_power),
+    OpCase("vdot", _mk(x=lambda: randn(6), y=lambda: randn(6)),
+           ref=np.vdot, grad=True, rtol=1e-4),
+    OpCase("nanargmax", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 1},
+           ref=lambda x: np.nanargmax(x, 1)),
+    OpCase("nanargmin", _mk(x=lambda: randn(3, 4)), kwargs={"axis": 1},
+           ref=lambda x: np.nanargmin(x, 1)),
+    OpCase("positive", _mk(x=lambda: randn(3, 4)), ref=lambda x: +x,
+           grad=True, rtol=1e-5),
+    OpCase("fliplr", _mk(x=lambda: randn(3, 4)), ref=np.fliplr, grad=True,
+           rtol=1e-5),
+    OpCase("flipud", _mk(x=lambda: randn(3, 4)), ref=np.flipud, grad=True,
+           rtol=1e-5),
+    OpCase("isin", _mk(x=lambda: randint(3, 4, lo=0, hi=5),
+                       test_x=lambda: np.array([1, 3], np.int64)),
+           ref=lambda x, test_x: np.isin(x, test_x)),
     OpCase("cdist", _mk(x=lambda: randu(5, 3), y=lambda: randu(4, 3)),
            ref=_np_cdist, grad=True, rtol=1e-4, atol=1e-5),
     OpCase("cumulative_trapezoid", _mk(y=lambda: randn(3, 6)),
@@ -851,6 +868,12 @@ EXEMPT = {
     "matrix_transpose": "covered by test_linalg_extras",
     "cholesky_inverse": "covered by test_linalg_extras",
     "lu_solve": "covered by test_linalg_extras",
+    "histogramdd": "multi-output histogram; smoke-covered in inventory",
+    "index_copy": "same kernel family as index_fill (OpCase-covered)",
+    "view": "reshape/bitcast alias; covered by test_compat_namespaces",
+    "view_as": "alias of view",
+    "tril_indices": "static index generator; covered below",
+    "triu_indices": "static index generator; covered below",
     "shape": "host-side shape metadata; covered by test_rank_shape_meta",
     # module plumbing, not ops
     "apply": "tape dispatcher import", "defop": "tape decorator import",
