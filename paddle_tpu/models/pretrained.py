@@ -57,6 +57,16 @@ def _strip_prefix(name, prefixes):
     return name
 
 
+def _check_fully_mapped(own, mapped, arch):
+    """Every model parameter must come from the checkpoint — an unmapped
+    key would silently stay randomly initialized after set_state_dict."""
+    missing = [k for k in own if k not in mapped]
+    if missing:
+        raise ValueError(
+            f"{arch} checkpoint left parameters unmapped (random init "
+            f"would be silent garbage): {missing[:8]}")
+
+
 def load_llama_from_hf(model, model_dir, dtype="float32"):
     """Fill a ``LlamaForCausalLM`` from an HF Llama checkpoint dir."""
     raw = _read_hf_weights(model_dir)
@@ -87,9 +97,7 @@ def load_llama_from_hf(model, model_dir, dtype="float32"):
             and "lm_head.weight" not in mapped:
         mapped["lm_head.weight"] = mapped["llama.embed_tokens.weight"] \
             .T.astype(dtype)
-    missing = [k for k in own if k not in mapped]
-    if missing:
-        raise ValueError(f"checkpoint missing parameters: {missing[:8]}")
+    _check_fully_mapped(own, mapped, "Llama")
     model.set_state_dict(mapped)
     return model
 
@@ -153,11 +161,7 @@ def load_gpt_from_hf(model, model_dir, dtype="float32"):
             raise ValueError(f"shape mismatch for {tgt}: checkpoint "
                              f"{arr.shape} vs model {want}")
         mapped[tgt] = arr.astype(dtype)
-    missing = [k for k in own if k not in mapped]
-    if missing:
-        raise ValueError(
-            f"BERT checkpoint left parameters unmapped (random init would "
-            f"be silent garbage): {missing[:8]}")
+    _check_fully_mapped(own, mapped, "GPT")
     model.set_state_dict(mapped)
     return model
 
@@ -223,5 +227,6 @@ def load_bert_from_hf(model, model_dir, dtype="float32"):
             raise ValueError(f"shape mismatch for {tgt}: checkpoint "
                              f"{arr.shape} vs model {want}")
         mapped[tgt] = arr.astype(dtype)
+    _check_fully_mapped(own, mapped, "BERT")
     model.set_state_dict(mapped)
     return model

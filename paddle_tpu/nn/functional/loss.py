@@ -269,6 +269,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         loss = -ll
         if norm_by_times:
             loss = loss / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # reference semantics: each sample's loss is divided by its
+            # label_length before averaging (mean(loss / label_lengths))
+            loss = loss / jnp.maximum(lab_len.astype(jnp.float32), 1.0)
         return _reduce(loss, reduction)
 
     return apply(fn, log_probs, labels, input_lengths, label_lengths,

@@ -219,7 +219,10 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
     """paddle.linalg.lu_unpack — (P, L, U) from lu()'s packed output.
 
     ``x`` is the packed LU factor, ``y`` the pivot vector from
-    :func:`lu` (LAPACK getrf convention: row i swapped with y[i])."""
+    :func:`lu` (0-based jax ``lu_factor`` convention: row i swapped
+    with y[i], indices starting at 0 — NOT LAPACK getrf's 1-based
+    pivots; convert with ``piv - 1`` before calling if you have
+    those)."""
     def fn(lu_, piv):
         m, n = lu_.shape[-2], lu_.shape[-1]
         k = min(m, n)
@@ -262,7 +265,10 @@ def cholesky_inverse(x, upper=False, name=None):
 
 
 def lu_solve(b, lu_data, lu_pivots, trans="N", name=None):
-    """paddle.linalg.lu_solve — solve A x = b from lu()'s packed factor."""
+    """paddle.linalg.lu_solve — solve A x = b from lu()'s packed factor.
+
+    ``lu_pivots`` must follow the 0-based jax ``lu_factor`` convention
+    (as returned by :func:`lu`), not LAPACK getrf's 1-based pivots."""
     if trans not in ("N", "T", "C"):
         raise ValueError(f"lu_solve: trans must be 'N', 'T' or 'C', "
                          f"got {trans!r}")

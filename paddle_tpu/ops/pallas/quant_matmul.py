@@ -50,6 +50,12 @@ def int8_matmul(x, w_int8, scale, block_m=128, block_n=128, block_k=128,
     dequant = int8 * scale). Returns x @ (w_int8 * scale) [M, N]."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if not interpret and jax.default_backend() == "tpu":
+        from ...utils.guarded_compile import kernel_allowed
+        if not kernel_allowed("quant_matmul", "int8 matmul kernel"):
+            # XLA fallback: dequantize + plain matmul (safe, more HBM)
+            w = w_int8.astype(jnp.float32) * scale[None, :]
+            return (x.astype(jnp.float32) @ w).astype(out_dtype or x.dtype)
     m, kdim = x.shape
     _, n = w_int8.shape
     out_dtype = out_dtype or x.dtype

@@ -1,0 +1,347 @@
+"""First-compile guard for in-repo Pallas TPU kernels.
+
+Reference analogue: the fail-fast watchdog semantics of the launch
+controllers (``python/paddle/distributed/launch/controllers/`` — an
+unhealthy worker is detected and killed by a supervisor instead of
+hanging the job; SURVEY.md §5.3).
+
+Round-2 post-mortem (VERDICT.md "What's weak" 1): under
+``PALLAS_AXON_REMOTE_COMPILE=1`` the Mosaic compile of a brand-new
+kernel runs server-side with **no error or timeout path** — one hung
+compile of the from-scratch paged-attention kernel wedged the single
+TPU tunnel for the rest of the session. This module makes "first Mosaic
+compile of kernel X" an explicitly supervised event:
+
+* :func:`prove` runs a kernel's canary (tiny tile-aligned shapes,
+  fwd+bwd where the kernel has a VJP) in a DISPOSABLE subprocess under a
+  hard timeout, and latches the outcome (``ok`` / ``bad``) to a marker
+  file. A hang kills the child and latches ``bad``; it is never retried
+  implicitly — a latched-bad kernel stays quarantined until
+  :func:`clear` is called deliberately.
+* kernel entry points call :func:`kernel_allowed` before their first
+  real TPU dispatch. Unproven or quarantined kernels fall back to their
+  pure-XLA reference path (slower but safe) with a warning, instead of
+  risking the chip from a long-lived process that cannot be killed
+  without losing session state.
+* orchestrators (``bench.py``, ``tools/tpu_watch.sh``) call
+  :func:`prove_all` for the kernels their workload needs *before*
+  spawning the TPU child, so benches still get the fast kernels — every
+  first compile having happened in a process that was safe to lose.
+
+Guard policy (``PADDLE_TPU_KERNEL_GUARD`` env):
+
+* ``strict`` (default) — only ``ok``-proven kernels may Mosaic-compile
+  in this process; everything else uses the XLA fallback.
+* ``prove``  — like strict, but an ``unknown`` kernel triggers a lazy
+  one-time :func:`prove` at first dispatch (self-healing; the proof
+  subprocess claims the TPU concurrently with this process, so only
+  use it on runtimes that allow a second client — on a single-tunnel
+  setup run the CLI before starting the job instead).
+* ``trust`` — unproven kernels may compile (latched-``bad`` kernels are
+  still blocked). For environments without the wedge failure mode.
+* ``off``  — guard disabled entirely (unit tests, interpret mode).
+
+The guard only engages on real TPU backends: CPU/interpret runs never
+consult it (Mosaic interpret mode executes in-process and cannot hang
+the tunnel).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import warnings
+
+_OK, _BAD, _UNKNOWN = "ok", "bad", "unknown"
+
+# Canary sources. Contract: print PROOF_OK only after the kernel has
+# BOTH Mosaic-compiled/run AND matched its XLA reference numerically
+# (a miscompile that returns garbage must not latch ok); print
+# PROOF_SKIP (and exit 3) when the environment can't answer the
+# question (e.g. not actually on a TPU backend) — skips latch nothing.
+# Shapes are small but tile-aligned (second-minor >= 8, minor 128) so
+# the Mosaic lowering exercised is the same one real workloads hit.
+_REQUIRE_TPU = """
+import jax
+if jax.default_backend() != "tpu":
+    print("PROOF_SKIP: backend is " + jax.default_backend())
+    raise SystemExit(3)
+"""
+
+CANARIES = {
+    "flash_attention": _REQUIRE_TPU + """
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.flash_attention import (
+    flash_attention, flash_attention_with_lse, mha_reference)
+rs = np.random.RandomState(0)
+q = jnp.asarray(rs.randn(1, 256, 4, 128), jnp.bfloat16)
+k = jnp.asarray(rs.randn(1, 256, 2, 128), jnp.bfloat16)   # GQA group 2
+v = jnp.asarray(rs.randn(1, 256, 2, 128), jnp.bfloat16)
+def loss(q, k, v):
+    out = flash_attention(q, k, v, causal=True, interpret=False)
+    return out.astype(jnp.float32).sum()
+def ref_loss(q, k, v):
+    qk, kk, vk = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    out = jnp.swapaxes(mha_reference(qk, kk, vk, causal=True), 1, 2)
+    return out.astype(jnp.float32).sum()
+g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+gr = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
+for got, want in zip(g, gr):
+    got = got.astype(jnp.float32); want = want.astype(jnp.float32)
+    gerr = float(jnp.max(jnp.abs(got - want)))
+    scale = max(1.0, float(jnp.max(jnp.abs(want))))
+    assert gerr < 5e-2 * scale, ("bwd numeric mismatch", gerr, scale)
+qk, kk, vk = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+out, lse = flash_attention_with_lse(qk, kk, vk, q_offset=256, kv_offset=0,
+                                    interpret=False)
+ref, ref_lse = mha_reference(qk, kk, vk, q_offset=256, kv_offset=0,
+                             with_lse=True)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                            ref.astype(jnp.float32))))
+lse_err = float(jnp.max(jnp.abs(lse - ref_lse)))
+assert err < 5e-2 and lse_err < 5e-2, ("numeric mismatch", err, lse_err)
+print("PROOF_OK")
+""",
+    "paged_attention": _REQUIRE_TPU + """
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.paged_attention import (
+    _paged_attention_pallas, paged_attention_reference)
+rs = np.random.RandomState(0)
+batch, kv_heads, group, d, page, npages = 4, 2, 4, 128, 16, 8
+q = jnp.asarray(rs.randn(batch, kv_heads * group, d), jnp.bfloat16)
+kp = jnp.asarray(rs.randn(kv_heads, npages, page, d), jnp.bfloat16)
+vp = jnp.asarray(rs.randn(kv_heads, npages, page, d), jnp.bfloat16)
+tbl = jnp.asarray(rs.randint(0, npages, (batch, 4)), jnp.int32)
+lens = jnp.asarray([64, 33, 17, 50], jnp.int32)
+out = _paged_attention_pallas(q, kp, vp, tbl, lens,
+                              sm_scale=d ** -0.5, interpret=False)
+ref = paged_attention_reference(q, kp, vp, tbl, lens)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                            ref.astype(jnp.float32))))
+assert err < 5e-2, ("numeric mismatch", err)
+print("PROOF_OK")
+""",
+    "quant_matmul": _REQUIRE_TPU + """
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.quant_matmul import int8_matmul, quantize_weight
+rs = np.random.RandomState(0)
+x = jnp.asarray(rs.randn(128, 256), jnp.float32)
+w8, scale = quantize_weight(jnp.asarray(rs.randn(256, 256), jnp.float32))
+out = int8_matmul(x, w8, scale, interpret=False)
+ref = x @ (w8.astype(jnp.float32) * scale[None, :])
+err = float(jnp.max(jnp.abs(out - ref)))
+assert err < 1e-3, ("numeric mismatch", err)
+print("PROOF_OK")
+""",
+    # Proves the flash kernel compiles inside a shard_map/ppermute ring
+    # context (the CP path). Requires the plain flash proof first — with
+    # flash quarantined the ring would silently exercise only the XLA
+    # fallback, proving nothing.
+    "ring_attention": _REQUIRE_TPU + """
+from paddle_tpu.utils import guarded_compile as _gc
+if _gc.status("flash_attention") != "ok":
+    print("PROOF_SKIP: flash_attention not proven ok yet")
+    raise SystemExit(3)
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+from paddle_tpu.ops.pallas.ring_attention import ring_flash_attention
+from paddle_tpu.ops.pallas.flash_attention import mha_reference
+rs = np.random.RandomState(0)
+q = jnp.asarray(rs.randn(1, 256, 4, 128), jnp.bfloat16)
+mesh = Mesh(np.asarray(jax.devices()[:1]), ("sep",))
+f = shard_map(
+    lambda a, b, c: ring_flash_attention(a, b, c, axis_name="sep",
+                                         axis_size=1, interpret=False),
+    mesh=mesh, in_specs=(P("sep"), P("sep"), P("sep")), out_specs=P("sep"),
+    check_rep=False)
+out = jax.jit(f)(q, q, q)
+qk = jnp.swapaxes(q, 1, 2)
+ref = jnp.swapaxes(mha_reference(qk, qk, qk), 1, 2)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                            ref.astype(jnp.float32))))
+assert err < 5e-2, ("numeric mismatch", err)
+print("PROOF_OK")
+""",
+}
+
+# Kernels each bench workload needs proven before its TPU child starts.
+BENCH_KERNELS = {
+    "resnet": [],
+    "llama": ["flash_attention"],
+    "llama_decode": ["flash_attention", "paged_attention"],
+    "data": [],
+}
+
+
+def _proof_dir() -> str:
+    d = os.environ.get("PADDLE_TPU_KERNEL_PROOF_DIR")
+    if not d:
+        d = os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                         "kernel_proofs")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _marker(kernel_id: str, state: str) -> str:
+    return os.path.join(_proof_dir(), f"{kernel_id}.{state}")
+
+
+# Per-process memo of terminal proof states: one stat() per kernel per
+# process instead of per dispatch. prove()/clear() keep it coherent;
+# cross-process coherence is by convention (orchestrators prove BEFORE
+# spawning the worker that consults the markers).
+_STATUS_CACHE: dict = {}
+
+
+def status(kernel_id: str) -> str:
+    """Latched proof state: 'ok', 'bad' or 'unknown'. 'bad' wins — a
+    kernel that ever hung stays quarantined until clear()."""
+    key = (_proof_dir(), kernel_id)
+    st = _STATUS_CACHE.get(key)
+    if st in (_OK, _BAD):
+        return st
+    if os.path.exists(_marker(kernel_id, _BAD)):
+        st = _BAD
+    elif os.path.exists(_marker(kernel_id, _OK)):
+        st = _OK
+    else:
+        st = _UNKNOWN
+    if st != _UNKNOWN:
+        _STATUS_CACHE[key] = st
+    return st
+
+
+def clear(kernel_id: str) -> None:
+    _STATUS_CACHE.pop((_proof_dir(), kernel_id), None)
+    for state in (_OK, _BAD):
+        try:
+            os.remove(_marker(kernel_id, state))
+        except OSError:
+            pass
+
+
+def prove(kernel_id: str, timeout: float = 420.0, src: str | None = None,
+          env: dict | None = None) -> bool:
+    """Run the kernel's canary in a disposable subprocess under a hard
+    timeout; latch and return the outcome. Idempotent: an existing
+    latch is returned without re-running.
+
+    Latch rules: a timeout or a real failure latches ``bad``; a
+    PROOF_SKIP (canary found the environment unable to answer, e.g. not
+    on a TPU backend) or a spawn error latches NOTHING — those are
+    transient, not evidence about the kernel."""
+    st = status(kernel_id)
+    if st != _UNKNOWN:
+        return st == _OK
+    if src is None:
+        src = CANARIES[kernel_id]
+    child_env = dict(env if env is not None else os.environ)
+    # Unconditional, NOT setdefault: if the child inherited strict it
+    # would gate its own kernel, exercise only the XLA fallback, and
+    # latch a vacuous PROOF_OK — the canary must compile the real
+    # Mosaic kernel. The child process is disposable by construction.
+    child_env["PADDLE_TPU_KERNEL_GUARD"] = "trust"
+    note = ""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", src], env=child_env,
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        if "PROOF_SKIP" in proc.stdout or proc.returncode == 3:
+            print(f"guarded_compile: '{kernel_id}' canary skipped (no "
+                  f"latch): {proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else 'rc=3'}",
+                  file=sys.stderr)
+            return False
+        ok = proc.returncode == 0 and "PROOF_OK" in proc.stdout
+        if not ok:
+            note = (proc.stdout[-400:] + "\n" + proc.stderr[-800:]).strip()
+    except subprocess.TimeoutExpired:
+        ok = False
+        note = f"canary timed out after {timeout}s (possible Mosaic hang)"
+    except OSError as e:
+        print(f"guarded_compile: '{kernel_id}' canary spawn failed (no "
+              f"latch): {e}", file=sys.stderr)
+        return False
+    with open(_marker(kernel_id, _OK if ok else _BAD), "w") as f:
+        f.write(note or "proved")
+    _STATUS_CACHE[(_proof_dir(), kernel_id)] = _OK if ok else _BAD
+    if not ok:
+        print(f"guarded_compile: kernel '{kernel_id}' QUARANTINED: "
+              f"{note.splitlines()[0] if note else 'failed'}",
+              file=sys.stderr)
+    return ok
+
+
+def prove_all(kernel_ids, timeout: float = 420.0) -> dict:
+    return {k: prove(k, timeout=timeout) for k in kernel_ids}
+
+
+def kernel_allowed(kernel_id: str, what: str = "Pallas kernel",
+                   fallback: str = "the XLA fallback path") -> bool:
+    """Call-site gate for a kernel's first real-TPU dispatch from this
+    (long-lived, not-safe-to-lose) process."""
+    mode = os.environ.get("PADDLE_TPU_KERNEL_GUARD", "strict").lower()
+    if mode == "off":
+        return True
+    st = status(kernel_id)
+    if st == _OK:
+        return True
+    if st == _BAD:
+        warnings.warn(
+            f"{what} '{kernel_id}' is quarantined (its canary compile "
+            f"hung or failed); using {fallback}. "
+            f"`python -m paddle_tpu.utils.guarded_compile clear "
+            f"{kernel_id}` to retry.", RuntimeWarning, stacklevel=3)
+        return False
+    if mode == "trust":
+        return True
+    if mode == "prove" and kernel_id in CANARIES:
+        return prove(kernel_id)
+    warnings.warn(
+        f"{what} '{kernel_id}' has not been proven on this backend; "
+        f"using {fallback}. Run `python -m "
+        f"paddle_tpu.utils.guarded_compile prove {kernel_id}` (disposable "
+        f"subprocess + timeout) first, set PADDLE_TPU_KERNEL_GUARD=prove "
+        f"for lazy proving, or =trust to compile unproven kernels.",
+        RuntimeWarning, stacklevel=3)
+    return False
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(prog="paddle_tpu.utils.guarded_compile")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("prove")
+    p.add_argument("kernels", nargs="+",
+                   help=f"kernel ids or 'all' ({', '.join(CANARIES)})")
+    p.add_argument("--timeout", type=float, default=420.0)
+    s = sub.add_parser("status")
+    s.add_argument("kernels", nargs="*", default=[])
+    c = sub.add_parser("clear")
+    c.add_argument("kernels", nargs="+")
+    args = ap.parse_args(argv)
+    names = list(CANARIES) if getattr(args, "kernels", None) in (["all"],) \
+        else list(getattr(args, "kernels", []) or CANARIES)
+    if args.cmd == "prove":
+        unknown = [k for k in names if k not in CANARIES]
+        if unknown:
+            print(f"no canary for: {unknown} (known: {list(CANARIES)})",
+                  file=sys.stderr)
+            return 2
+        res = prove_all(names, timeout=args.timeout)
+        print(res)
+        return 0 if all(res.values()) else 1
+    if args.cmd == "clear":
+        for k in names:
+            clear(k)
+        return 0
+    for k in names:
+        print(k, status(k))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -257,6 +257,17 @@ def test_ctc_loss_matches_torch():
                       paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens),
                       blank=0, reduction="none")
     _cmp(got2, want2, rtol=1e-4)
+    # reduction='mean' divides each sample's loss by its label_length
+    # before averaging (torch/paddle semantics)
+    want_mean = torch.nn.functional.ctc_loss(
+        torch.tensor(logits).log_softmax(-1), torch.tensor(labels),
+        torch.tensor(in_lens), torch.tensor(lab_lens), blank=0,
+        reduction="mean").numpy()
+    got_mean = F.ctc_loss(t(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(in_lens),
+                          paddle.to_tensor(lab_lens),
+                          blank=0, reduction="mean")
+    _cmp(got_mean, want_mean, rtol=1e-4)
     # layer + norm_by_times + grad
     x = t(logits); x.stop_gradient = False
     loss = nn.CTCLoss()(x, paddle.to_tensor(labels),

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Watch for TPU tunnel recovery; the moment a backend probe succeeds,
+# run the three benches back-to-back and record their JSON lines.
+# Round-3 context: the axon pool was wedged at round start (VERDICT item 1
+# asks for benches FIRST — this is the closest achievable: benches fire in
+# the first healthy window). Only one process may touch the TPU, so this
+# watcher is the sole chip client until it exits.
+OUT=${1:-/root/repo/BENCH_TPU_SESSION.json}
+LOG=/tmp/tpu_watch.log
+cd /root/repo
+echo "[tpu_watch] start $(date -u +%H:%M:%SZ)" >> "$LOG"
+while true; do
+  if timeout 150 python -c "import jax; assert jax.default_backend() not in ('cpu',); print('OK', jax.devices())" >> "$LOG" 2>&1; then
+    echo "[tpu_watch] TPU reachable $(date -u +%H:%M:%SZ); running benches" >> "$LOG"
+    {
+      echo '{"session": "round3", "captured_at": "'"$(date -u +%Y-%m-%dT%H:%M:%SZ)"'", "results": ['
+      first=1
+      for mode in resnet llama llama_decode; do
+        # bench.py bounds its own children (probe 150s + attempts
+        # 1500/900 + cpu fallback 1200, killed on expiry by
+        # subprocess.run); 4800s is a backstop only, so it can't fire
+        # mid-run and orphan a TPU-holding child while the loop moves on.
+        line=$(BENCH_MODEL=$mode BENCH_PROBE_TIMEOUT=150 timeout 4800 python bench.py 2>>"$LOG" | tail -1)
+        echo "[tpu_watch] $mode -> $line" >> "$LOG"
+        [ -z "$line" ] && line='{"metric": "'$mode'", "value": null, "error": "bench timed out"}'
+        if [ $first -eq 1 ]; then first=0; else echo ','; fi
+        echo "$line"
+      done
+      echo ']}'
+    } > "$OUT.tmp" && mv "$OUT.tmp" "$OUT"
+    echo "[tpu_watch] done; results in $OUT" >> "$LOG"
+    exit 0
+  fi
+  echo "[tpu_watch] probe failed $(date -u +%H:%M:%SZ); retry in 300s" >> "$LOG"
+  sleep 300
+done
