@@ -225,6 +225,83 @@ def _device_reduce(value: np.ndarray, op, group: Group):
     return res
 
 
+_PROC_MESH = [None]
+
+
+def _proc_mesh():
+    """1-D mesh with exactly ONE device per process — the natural carrier
+    for eager rank↔rank collectives (rank r's data lives on process r's
+    first device; shardings over this mesh map 1:1 to ranks regardless of
+    how many local devices each process drives)."""
+    if _PROC_MESH[0] is None:
+        from jax.sharding import Mesh
+        by_proc = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, d)
+        devs = [by_proc[p] for p in sorted(by_proc)]
+        _PROC_MESH[0] = Mesh(np.array(devs), ("p",))
+    return _PROC_MESH[0]
+
+
+def _device_reduce_scatter(stacked: np.ndarray, op, group: Group):
+    """Device-collective tier for reduce_scatter: the [nranks, ...] local
+    contributions of every process form a global [world, nranks, ...]
+    array on the per-process mesh; ONE jitted sum over the process axis
+    with rank-sharded output makes XLA emit a real reduce-scatter over
+    ICI/Gloo — O(tensor) traffic instead of the host-gather tier's
+    O(world × tensor). Returns this rank's reduced slice, or None when
+    the tier doesn't apply."""
+    world = jax.process_count()
+    if world <= 1 or list(group.ranks) != list(range(get_world_size())) \
+            or stacked.shape[0] != world:
+        return None
+    op = _normalize_op(op)
+    if op == ReduceOp.AVG:
+        red, post = ReduceOp.SUM, 1.0 / world
+    else:
+        red, post = op, None
+    fns = {ReduceOp.SUM: lambda a: a.sum(0),
+           ReduceOp.MAX: lambda a: a.max(0),
+           ReduceOp.MIN: lambda a: a.min(0),
+           ReduceOp.PROD: lambda a: a.prod(0)}
+    if red not in fns or np.dtype(stacked.dtype) == np.bool_:
+        return None
+    mesh = _proc_mesh()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("p")), stacked[None])    # my [1, world, ...]
+    key = ("rs", red)
+    if key not in _DEV_REDUCERS:
+        _DEV_REDUCERS[key] = jax.jit(
+            fns[red], out_shardings=NamedSharding(mesh, P("p")))
+    out = _DEV_REDUCERS[key](garr)
+    res = np.asarray(out.addressable_data(0))[0]       # my rank's slice
+    if post is not None:
+        res = (res.astype(np.float64) * post).astype(stacked.dtype)
+    return res
+
+
+def _device_alltoall(stacked: np.ndarray, group: Group):
+    """Device-collective tier for alltoall: global [world, nranks, ...]
+    on the per-process mesh, ONE jitted swap of the process/rank axes
+    with rank-sharded output — XLA emits a true all-to-all. Returns this
+    rank's [nranks, ...] received block, or None when inapplicable."""
+    world = jax.process_count()
+    if world <= 1 or list(group.ranks) != list(range(get_world_size())) \
+            or stacked.shape[0] != world:
+        return None
+    mesh = _proc_mesh()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("p")), stacked[None])
+    if "a2a" not in _DEV_REDUCERS:
+        _DEV_REDUCERS["a2a"] = jax.jit(
+            lambda a: jnp.swapaxes(a, 0, 1),
+            out_shardings=NamedSharding(mesh, P("p")))
+    out = _DEV_REDUCERS["a2a"](garr)
+    return np.asarray(out.addressable_data(0))[0]      # my received block
+
+
 def _np(tensor):
     return np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
 
@@ -297,6 +374,10 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
     stacked = np.stack([_np(t) for t in tensor_list])  # [nranks, ...] local inputs
     mine = group.rank
     if simulator.active_world() is None:
+        dev = _device_reduce_scatter(stacked, op, group)
+        if dev is not None:
+            _write_back(tensor, dev)
+            return _Task()
         dev = _device_reduce(stacked, _normalize_op(op), group)
         if dev is not None:
             _write_back(tensor, dev[mine])
@@ -314,6 +395,12 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
         return _Task()
     stacked = np.stack([_np(t) for t in in_tensor_list])
+    if simulator.active_world() is None:
+        dev = _device_alltoall(stacked, group)
+        if dev is not None:
+            for i in range(group.nranks):
+                out_tensor_list.append(Tensor(jnp.asarray(dev[i])))
+            return _Task()
     got = _exchange("alltoall", stacked, group)
     mine = group.rank
     for i in range(group.nranks):
@@ -394,27 +481,98 @@ def barrier(group=None):
 # point-to-point
 # ---------------------------------------------------------------------------
 
+# Cross-process p2p rides the C++ TCPStore (native/tcp_store.cpp) — the
+# reference's ProcessGroup send/recv contract (SURVEY.md §2.3) served by
+# the same rendezvous KV the launch/elastic stack uses. Rank 0 hosts the
+# store server; message keys are (src, dst, seq) with per-direction
+# sequence counters on both ends, so ordered matched pairs never collide.
+_P2P_STORE = [None]
+_P2P_SEQ: dict = {}
+
+
+def _p2p_store():
+    if _P2P_STORE[0] is not None:
+        return _P2P_STORE[0]
+    import os
+    from .native import TCPStore
+    rank, world = get_rank(), get_world_size()
+    host, port = "127.0.0.1", 0
+    ep = os.environ.get("PADDLE_MASTER") or \
+        (os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",") or [""])[0]
+    if ":" in ep:
+        host, p = ep.rsplit(":", 1)
+        port = int(p) + 17            # offset: base port holds the jax
+        #                               coordinator / launch rendezvous
+    port = int(os.environ.get("PADDLE_P2P_PORT", port))
+    if not port:
+        raise RuntimeError(
+            "cross-process send/recv needs a rendezvous endpoint: launch "
+            "via paddle_tpu.distributed.launch (sets PADDLE_MASTER) or set "
+            "PADDLE_P2P_PORT")
+    _P2P_STORE[0] = TCPStore(host=host, port=port, is_master=(rank == 0),
+                             world_size=world)
+    return _P2P_STORE[0]
+
+
+def _p2p_pack(arr: np.ndarray) -> bytes:
+    import io
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _p2p_unpack(raw: bytes) -> np.ndarray:
+    import io
+    return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+def _gid(group: Group) -> str:
+    """Stable group identity for p2p keys — the rank set, not the
+    per-process Group id (ids differ across ranks). Keeps concurrent
+    p2p in two subgroups between the same rank pair from crossing
+    payloads (the simulator path keys the same way)."""
+    return "-".join(map(str, group.ranks))
+
 
 def send(tensor, dst=0, group=None, sync_op=True):
     w = simulator.active_world()
-    if w is None:
-        raise RuntimeError("send/recv outside simulation requires multi-host "
-                           "launch (p2p rides the pp/sep mesh axes inside jit)")
     group = group or _get_default_group()
-    gkey = tuple(group.ranks)  # group identity = rank set (ids differ per rank)
-    seq = w.next_tag("p2p_send", (gkey, simulator.current_rank(), dst))[2]
-    w.rendezvous.put((gkey, simulator.current_rank(), dst, seq), _np(tensor))
+    if w is not None:
+        gkey = tuple(group.ranks)  # group identity = rank set (ids differ per rank)
+        seq = w.next_tag("p2p_send", (gkey, simulator.current_rank(), dst))[2]
+        w.rendezvous.put((gkey, simulator.current_rank(), dst, seq),
+                         _np(tensor))
+        return _Task()
+    if get_world_size() <= 1:
+        raise RuntimeError("send/recv needs a multi-process launch or the "
+                           "thread simulator")
+    store = _p2p_store()
+    me, gid = get_rank(), _gid(group)
+    k = ("s", gid, me, dst)
+    seq = _P2P_SEQ[k] = _P2P_SEQ.get(k, -1) + 1
+    store.set(f"p2p/{gid}/{me}>{dst}/{seq}", _p2p_pack(_np(tensor)))
     return _Task()
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     w = simulator.active_world()
-    if w is None:
-        raise RuntimeError("send/recv outside simulation requires multi-host launch")
     group = group or _get_default_group()
-    gkey = tuple(group.ranks)
-    seq = w.next_tag("p2p_recv", (gkey, src, simulator.current_rank()))[2]
-    val = w.rendezvous.get((gkey, src, simulator.current_rank(), seq))
+    if w is not None:
+        gkey = tuple(group.ranks)
+        seq = w.next_tag("p2p_recv", (gkey, src, simulator.current_rank()))[2]
+        val = w.rendezvous.get((gkey, src, simulator.current_rank(), seq))
+        _write_back(tensor, val)
+        return _Task()
+    if get_world_size() <= 1:
+        raise RuntimeError("send/recv needs a multi-process launch or the "
+                           "thread simulator")
+    store = _p2p_store()
+    me, gid = get_rank(), _gid(group)
+    k = ("r", gid, src, me)
+    seq = _P2P_SEQ[k] = _P2P_SEQ.get(k, -1) + 1
+    key = f"p2p/{gid}/{src}>{me}/{seq}"
+    val = _p2p_unpack(store.get(key, wait=True))
+    store.delete_key(key)
     _write_back(tensor, val)
     return _Task()
 

@@ -28,7 +28,18 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 
-def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp"):
+def _chunk_key(base_key, micro_idx, chunk_id):
+    """Deterministic per-(microbatch, chunk) PRNG key — the reference's
+    ``RNGStatesTracker`` contract (``fleet/layers/mpu/random.py``): each
+    microbatch × pipeline chunk draws an independent, schedule-invariant
+    stream, so a pipelined run with dropout reproduces the sequential
+    run bit-for-bit given the same base key."""
+    import jax.random as jrandom
+    return jrandom.fold_in(jrandom.fold_in(base_key, micro_idx), chunk_id)
+
+
+def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp",
+                  with_keys=False):
     """Per-device pipelined runner (call inside shard_map over ``axis_name``).
 
     ``stage_fn(stage_params, x) -> y`` applies ONE stage (y.shape == x.shape).
@@ -36,9 +47,14 @@ def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp"):
     shard of the [S, ...]-stacked params (leading dim 1) and replicated
     ``micro_inputs`` [M, mb, ...]; it returns the last stage's outputs
     [M, mb, ...], broadcast to every pp rank.
+
+    ``with_keys=True`` changes the contracts to
+    ``stage_fn(stage_params, x, key)`` / ``run(..., base_key)`` —
+    each tick's call receives the deterministic per-(microbatch, stage)
+    key, so stochastic blocks (dropout) are supported.
     """
 
-    def run(stacked_params, micro_inputs):
+    def run(stacked_params, micro_inputs, base_key=None):
         params = jax.tree.map(lambda a: a[0], stacked_params)
         stage = jax.lax.axis_index(axis_name)
         m = micro_inputs.shape[0]
@@ -54,7 +70,11 @@ def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp"):
             active = jnp.logical_and(idx >= 0, idx < m)
             feed = micro_inputs[jnp.clip(t, 0, m - 1)]
             x = jnp.where(stage == 0, feed, recv)
-            y = stage_fn(params, x)
+            if with_keys:
+                key = _chunk_key(base_key, jnp.clip(idx, 0, m - 1), stage)
+                y = stage_fn(params, x, key)
+            else:
+                y = stage_fn(params, x)
             y = jnp.where(active, y, jnp.zeros_like(y))
             slot = jnp.clip(idx, 0, m - 1)
             write = jnp.logical_and(active, is_last)
@@ -73,16 +93,17 @@ def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp"):
 
 
 def pipeline_spmd_interleaved(stage_fn, n_stages, n_micro, vpp,
-                              axis_name="pp"):
+                              axis_name="pp", with_keys=False):
     """Interleaved (VPP) per-device runner — the reference
     ``PipelineParallelWithInterleave``: L = S·v chunks, chunk c on device
     c mod S; each tick every device runs its v chunks and the ring wraps
     (S-1 → 0) carrying activations to the next virtual stage. Expects the
     local param shard with leading dim v in *slot* order (slot k = chunk
     ``stage + k·S``) — ``pipeline_forward`` pre-permutes.
+    ``with_keys`` as in :func:`pipeline_spmd` (chunk id = stage + k·S).
     """
 
-    def run(stacked_params, micro_inputs):
+    def run(stacked_params, micro_inputs, base_key=None):
         stage = jax.lax.axis_index(axis_name)
         m = micro_inputs.shape[0]
         chunks = n_stages * vpp
@@ -105,7 +126,11 @@ def pipeline_spmd_interleaved(stage_fn, n_stages, n_micro, vpp,
                     x = jnp.where(stage == 0, feed, recv[0])
                 else:
                     x = recv[k]
-                y = stage_fn(params_k, x)
+                if with_keys:
+                    key = _chunk_key(base_key, jnp.clip(idx, 0, m - 1), c)
+                    y = stage_fn(params_k, x, key)
+                else:
+                    y = stage_fn(params_k, x)
                 y = jnp.where(active, y, jnp.zeros_like(y))
                 if k == vpp - 1:
                     slot = jnp.clip(idx, 0, m - 1)
@@ -132,7 +157,7 @@ def pipeline_spmd_interleaved(stage_fn, n_stages, n_micro, vpp,
 
 def pipeline_seq_forward(block_fn, stacked_params, micro_inputs, *, pre=None,
                          post=None, mesh=None, axis_name="pp",
-                         n_stages=None, vpp_degree=1):
+                         n_stages=None, vpp_degree=1, rng_key=None):
     """Full-model pipelined forward for stage-heterogeneous LMs (reference:
     ``pp_layers.py`` stage partition with embedding on stage 0, head on
     stage S-1, ``SharedLayerDesc`` tied weights).
@@ -152,21 +177,29 @@ def pipeline_seq_forward(block_fn, stacked_params, micro_inputs, *, pre=None,
     microbatches flattened to ONE [M·mb, ...] batch (bigger MXU matmuls
     than per-micro application, and activation sharding constraints see
     their canonical [B, T, H] rank); ``block_fn(chunk_params, x)`` applies
-    one pipeline chunk. ``micro_inputs``: [M, mb, ...].
+    one pipeline chunk. ``micro_inputs``: [M, mb, ...]. With ``rng_key``
+    set, ``block_fn(chunk_params, x, key)`` gets per-(micro, chunk) keys
+    and ``pre``/``post`` become ``fn(x, key)`` with their own derived
+    keys (they run once over the flat batch, outside the schedule, so a
+    single key each keeps them schedule-invariant too).
     """
-    def _flat_apply(fn, x):
+    def _flat_apply(fn, x, key=None):
         m, mb = x.shape[:2]
-        y = fn(x.reshape((m * mb,) + tuple(x.shape[2:])))
+        flat = x.reshape((m * mb,) + tuple(x.shape[2:]))
+        y = fn(flat) if key is None else fn(flat, key)
         return y.reshape((m, mb) + tuple(y.shape[1:]))
 
+    import jax.random as jrandom
     h = micro_inputs
     if pre is not None:
-        h = _flat_apply(pre, h)
+        h = _flat_apply(pre, h, None if rng_key is None
+                        else jrandom.fold_in(rng_key, 0x5e90))
     h = pipeline_forward(block_fn, stacked_params, h, mesh=mesh,
                          axis_name=axis_name, n_stages=n_stages,
-                         vpp_degree=vpp_degree)
+                         vpp_degree=vpp_degree, rng_key=rng_key)
     if post is not None:
-        h = _flat_apply(post, h)
+        h = _flat_apply(post, h, None if rng_key is None
+                        else jrandom.fold_in(rng_key, 0x5e91))
     return h
 
 
@@ -188,9 +221,18 @@ class PipelinedModule:
     the tied Parameter is deduped into ONE edge array consumed by both
     segments, so ``jax.grad`` sums the two contributions.
 
-    Constraint: blocks must be deterministic (no dropout) — the chunk fn
-    runs under ``shard_map`` where closing over a traced RNG key is not
-    portable; Llama/GPT pretrain configs satisfy this.
+    Stochastic blocks (dropout): pass ``rng_key`` to ``__call__`` — the
+    engine threads deterministic per-(microbatch, chunk) keys through
+    the scan (reference ``RNGStatesTracker`` semantics), so a pipelined
+    run reproduces the sequential run given the same base key. Without
+    a key the blocks run with a constant key (dropout degenerates to a
+    fixed mask — fine for the dropout-free pretrain configs).
+
+    Mutable buffers (BN running stats) remain unsupported by design:
+    under the skewed schedule each stage sees microbatches at different
+    ticks, so a buffer update order would be schedule-dependent — the
+    reference has the same constraint in spirit (per-stage BN is local
+    to a rank there; here weights are stacked across stages).
 
     Usage::
 
@@ -275,28 +317,45 @@ class PipelinedModule:
         return per_block
 
     # -- the pure pipelined forward -----------------------------------------
-    def __call__(self, edge_arrs, stacked_arrs, micro_inputs):
+    def __call__(self, edge_arrs, stacked_arrs, micro_inputs, rng_key=None):
         import jax.random as jrandom
-        const_key = jrandom.PRNGKey(0)   # blocks are deterministic (asserted)
+        const_key = jrandom.PRNGKey(0)
+        threaded = rng_key is not None
 
-        def chunk_fn(chunk_arrs, x):
-            for l in range(self.lpc):
-                arrs = [a[l] for a in chunk_arrs]
-                x, _ = self._fm_blk(arrs, [], const_key, x)
-            return x
+        if threaded:
+            def chunk_fn(chunk_arrs, x, key):
+                for l in range(self.lpc):
+                    arrs = [a[l] for a in chunk_arrs]
+                    x, _ = self._fm_blk(arrs, [],
+                                        jrandom.fold_in(key, l), x)
+                return x
 
-        pre = post = None
-        if self._edge.has_pre:
-            def pre(x):
-                return self._fm_pre(edge_arrs, [], const_key, x)[0]
-        if self._edge.has_post:
-            def post(x):
-                return self._fm_post(edge_arrs, [], const_key, x)[0]
+            pre = post = None
+            if self._edge.has_pre:
+                def pre(x, key):
+                    return self._fm_pre(edge_arrs, [], key, x)[0]
+            if self._edge.has_post:
+                def post(x, key):
+                    return self._fm_post(edge_arrs, [], key, x)[0]
+        else:
+            def chunk_fn(chunk_arrs, x):
+                for l in range(self.lpc):
+                    arrs = [a[l] for a in chunk_arrs]
+                    x, _ = self._fm_blk(arrs, [], const_key, x)
+                return x
+
+            pre = post = None
+            if self._edge.has_pre:
+                def pre(x):
+                    return self._fm_pre(edge_arrs, [], const_key, x)[0]
+            if self._edge.has_post:
+                def post(x):
+                    return self._fm_post(edge_arrs, [], const_key, x)[0]
         return pipeline_seq_forward(chunk_fn, stacked_arrs, micro_inputs,
                                     pre=pre, post=post, mesh=self.mesh,
                                     axis_name=self.axis_name,
                                     n_stages=self.n_stages,
-                                    vpp_degree=self.vpp)
+                                    vpp_degree=self.vpp, rng_key=rng_key)
 
 
 class _EdgeSegments:
@@ -348,13 +407,18 @@ class _EdgeSegments:
 
 
 def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
-                     axis_name="pp", n_stages=None, vpp_degree=1):
+                     axis_name="pp", n_stages=None, vpp_degree=1,
+                     rng_key=None):
     """Pipelined forward over the global mesh's pp axis (differentiable,
     jit-compatible).
 
     ``stacked_params``: pytree, leaves stacked [S·vpp, ...] in chunk order
     (chunk = consecutive layer group). ``micro_inputs``: [M, mb, ...].
     ``vpp_degree`` > 1 selects the interleaved (VPP) schedule.
+    With ``rng_key`` set, ``stage_fn(params, x, key)`` receives a
+    deterministic per-(microbatch, chunk) key — stochastic stages
+    (dropout) produce the same result as a sequential run with the same
+    base key, regardless of schedule or pp size.
     """
     from . import mesh as mesh_mod
     mesh = mesh or mesh_mod.get_mesh()
@@ -363,13 +427,20 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
         raise ValueError(f"n_stages={n_stages} != mesh '{axis_name}' size "
                          f"{mesh_pp}: chunks would be silently dropped")
     n_stages = mesh_pp
+    with_keys = rng_key is not None
     if n_stages == 1:
-        def seq_all(x):
-            n_chunks = jax.tree.leaves(stacked_params)[0].shape[0]
+        n_chunks = jax.tree.leaves(stacked_params)[0].shape[0]
+
+        def seq_all(x, micro_idx):
             for c in range(n_chunks):
-                x = stage_fn(jax.tree.map(lambda a: a[c], stacked_params), x)
+                p = jax.tree.map(lambda a: a[c], stacked_params)
+                if with_keys:
+                    x = stage_fn(p, x, _chunk_key(rng_key, micro_idx, c))
+                else:
+                    x = stage_fn(p, x)
             return x
-        return jax.vmap(seq_all)(micro_inputs)
+        m = micro_inputs.shape[0]
+        return jax.vmap(seq_all)(micro_inputs, jnp.arange(m))
     n_micro = int(micro_inputs.shape[0])
     if vpp_degree > 1:
         # chunk-major [c] → slot-major [(k, d) → d*v + k ... ]: device d's
@@ -381,14 +452,18 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
         stacked_params = jax.tree.map(
             lambda a: jnp.take(a, order, axis=0), stacked_params)
         run = pipeline_spmd_interleaved(stage_fn, n_stages, n_micro,
-                                        vpp_degree, axis_name)
+                                        vpp_degree, axis_name,
+                                        with_keys=with_keys)
     else:
-        run = pipeline_spmd(stage_fn, n_stages, n_micro, axis_name)
+        run = pipeline_spmd(stage_fn, n_stages, n_micro, axis_name,
+                            with_keys=with_keys)
     p_specs = jax.tree.map(lambda a: P(axis_name), stacked_params)
+    in_specs = (p_specs, P()) + ((P(),) if with_keys else ())
     mapped = jax.shard_map(
-        run, mesh=mesh, in_specs=(p_specs, P()), out_specs=P(),
+        run, mesh=mesh, in_specs=in_specs, out_specs=P(),
         axis_names={axis_name}, check_vma=False)
+    args = (stacked_params, micro_inputs) + ((rng_key,) if with_keys else ())
     # axes outside axis_name stay in "auto" sharding mode, which shard_map
     # only supports under jit — so compile here; callers' outer jit still
     # fuses through (nested jit is inlined)
-    return jax.jit(mapped)(stacked_params, micro_inputs)
+    return jax.jit(mapped)(*args)

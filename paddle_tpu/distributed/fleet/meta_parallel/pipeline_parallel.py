@@ -68,12 +68,12 @@ class PipelineParallel(Layer):
                 and isinstance(self._loss_fn, Layer)):
             return None      # undecided — a pp mesh may be installed later
         try:
-            # dropout anywhere in the model would replay one fixed mask
-            # under the engine's constant key — eager fallback instead
-            for sub in self._layers.sublayers(include_self=True):
-                if "Dropout" in type(sub).__name__ and \
-                        getattr(sub, "p", 0) > 0:
-                    raise ValueError("dropout inside pipeline model")
+            # dropout is supported: the engine threads deterministic
+            # per-(microbatch, chunk) keys through the scan — remember
+            # to pass a fresh base key every step
+            self._needs_key = any(
+                "Dropout" in type(sub).__name__ and getattr(sub, "p", 0) > 0
+                for sub in self._layers.sublayers(include_self=True))
             from ....distributed.engine import PipelinedModule
             pm = PipelinedModule(self._layers)
         except ValueError as e:
@@ -109,9 +109,9 @@ class PipelineParallel(Layer):
             loss_fm = FunctionalModule(self._loss_fn)
             key = jax.random.PRNGKey(0)
 
-            def step(edge, stacked, mx, my, scale):
+            def step(edge, stacked, mx, my, scale, rkey):
                 def scaled_loss(e, s):
-                    out = pm(e, s, mx)
+                    out = pm(e, s, mx, rng_key=rkey)
                     per = jax.vmap(
                         lambda o, l: loss_fm([], [], key, o, l)[0])(out, my)
                     loss = per.mean()
@@ -123,8 +123,16 @@ class PipelineParallel(Layer):
 
             self._spmd_step = jax.jit(step)
 
+        # stochastic models draw a fresh base key per step (the engine
+        # derives schedule-invariant per-micro×chunk keys from it);
+        # deterministic models keep a fixed key for reproducibility
+        if getattr(self, "_needs_key", False):
+            from ....framework import random as prandom
+            rkey = prandom.next_key()
+        else:
+            rkey = jax.random.PRNGKey(0)
         loss, ge, gs = self._spmd_step(pm.edge_arrays(), pm.stacked_arrays(),
-                                       micro_x, micro_y, scale)
+                                       micro_x, micro_y, scale, rkey)
         for p, g in zip(pm.edge_params, ge):
             p.grad = Tensor(g)
         for blk, gl in zip(pm.blocks, pm.unstack_grads(gs)):

@@ -8,6 +8,7 @@ from ....framework.core import Tensor
 from ....autograd.tape import apply, no_grad
 from . import sequence_parallel_utils  # noqa: F401
 from .ring_attention import ring_attention, RingFlashAttention  # noqa: F401
+from .ulysses import ulysses_attention, UlyssesAttention  # noqa: F401
 
 
 def _is_tensor(x):

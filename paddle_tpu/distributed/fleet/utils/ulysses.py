@@ -1,0 +1,103 @@
+"""Ulysses (all-to-all) sequence-parallel attention — CP mechanism 2.
+
+Reference analogue: the DeepSpeed-Ulysses-style sep parallelism the
+reference ecosystem wires over its sep comm group + ``alltoall`` p2p
+(SURVEY.md §5.7 mechanism 2: "all-to-all head/seq swap"), complementing
+the ring rotation (mechanism 3, ``ring_attention``).
+
+TPU-native design: inside ``shard_map`` over the 'sep' mesh axis, one
+``lax.all_to_all`` re-partitions the activation from sequence-sharded
+[b, s/n, h, d] to head-sharded [b, s, h/n, d] — on TPU this lowers to a
+single ICI all-to-all, after which every device runs a plain full-
+sequence flash attention over its head slice (exact causal masking, no
+per-step rotation), and a second all-to-all swaps back. Versus the ring:
+
+* communication is 2 all-to-alls of the activation instead of n-1
+  ppermutes of K/V — cheaper when n is large or KV is wide (GQA makes
+  ring cheaper: it only rotates the narrow KV heads);
+* no causal load skew (the ring's late ranks do more masked work);
+* requires ``heads % n == 0`` (head capacity bounds sep, the classic
+  Ulysses limit), while the ring scales regardless of head count.
+
+Gradients: ``all_to_all`` is its own transpose, so ``jax.grad`` derives
+the backward swaps automatically.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ... import mesh as mesh_mod
+from ....autograd.tape import apply
+from ....framework.core import Tensor
+
+
+def _ulysses_shard(q, k, v, *, axis_name, n, causal, interpret, use_kernel):
+    """Per-device body ([b, s_local, h, d] in, same out)."""
+    # seq-sharded -> head-sharded: split heads n-ways, gather full seq
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)      # [b, s_global, h/n, d]
+    if use_kernel:
+        from ....ops.pallas.flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal, interpret=interpret)
+    else:
+        from ....ops.pallas.flash_attention import mha_reference
+        out = jnp.swapaxes(
+            mha_reference(jnp.swapaxes(qh, 1, 2), jnp.swapaxes(kh, 1, 2),
+                          jnp.swapaxes(vh, 1, 2), causal=causal), 1, 2)
+    # head-sharded -> seq-sharded
+    return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_attention(q, k, v, causal=True, seq_axis="sep", mesh=None,
+                      interpret=None, use_kernel=True):
+    """All-to-all sequence-parallel attention over the mesh's ``seq_axis``.
+
+    q/k/v: jax arrays (or Tensors), paddle layout [b, s, h, d], seq dim
+    sharded over ``seq_axis``. Requires ``num_heads % axis_size == 0``
+    (and ``kv_heads % axis_size == 0`` under GQA). Drop-in alternative
+    to :func:`ring_attention` — same signature, same numerics.
+    """
+    mesh = mesh or mesh_mod.get_mesh()
+    n = int(mesh.shape[seq_axis]) if seq_axis in mesh.shape else 1
+
+    def jfn(qa, ka, va):
+        if n == 1:
+            from .ring_attention import ring_attention as _ring
+            return _ring(qa, ka, va, causal=causal, seq_axis=seq_axis,
+                         mesh=mesh, interpret=interpret,
+                         use_kernel=use_kernel)
+        hq, hk = qa.shape[2], ka.shape[2]
+        if hq % n or hk % n:
+            raise ValueError(
+                f"ulysses_attention needs heads divisible by the "
+                f"'{seq_axis}' size {n}; got q heads {hq}, kv heads {hk} "
+                f"(use ring_attention for head-limited models)")
+        spec = P(None, seq_axis, None, None)
+        inner = functools.partial(
+            _ulysses_shard, axis_name=seq_axis, n=n, causal=causal,
+            interpret=interpret, use_kernel=use_kernel)
+        mapped = jax.shard_map(
+            inner, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            axis_names={seq_axis}, check_vma=False)
+        # partial-manual shard_map (other mesh axes stay auto) is only
+        # supported under jit; nested jit inlines into callers' traces
+        return jax.jit(mapped)(qa, ka, va)
+
+    if isinstance(q, Tensor):
+        return apply(jfn, q, k, v, op_name="ulysses_attention")
+    return jfn(q, k, v)
+
+
+class UlyssesAttention:
+    """Facade mirroring ``RingFlashAttention``: ``UlyssesAttention.apply``."""
+
+    @staticmethod
+    def apply(q, k, v, causal=True, seq_axis="sep", **kw):
+        return ulysses_attention(q, k, v, causal=causal, seq_axis=seq_axis,
+                                 **kw)

@@ -57,6 +57,7 @@ def _worker_env(args):
         "PADDLE_TRAINERS_NUM": str(args.nnodes),
         "PADDLE_TRAINER_ENDPOINTS": endpoints,
         "PADDLE_CURRENT_ENDPOINT": eps[rank % len(eps)],
+        "PADDLE_MASTER": master,
         "PADDLE_JOB_ID": args.job_id,
     })
     return env, rank

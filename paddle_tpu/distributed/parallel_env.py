@@ -49,6 +49,17 @@ def init_parallel_env():
     if not mesh_mod.has_mesh():
         mesh_mod.init_mesh()
     _initialized[0] = True
+    if nranks > 1 and _env_int("PADDLE_TRAINER_ID", 0) == 0:
+        # host the p2p rendezvous store NOW: lazy creation at rank 0's
+        # first send/recv would deadlock jobs where only non-zero ranks
+        # exchange p2p (they'd wait on a server nobody starts)
+        try:
+            from . import collective
+            collective._p2p_store()
+        except Exception as e:     # best-effort: p2p then errors at use
+            import sys
+            print(f"init_parallel_env: p2p store not hosted ({e})",
+                  file=sys.stderr)
     return ParallelEnv()
 
 

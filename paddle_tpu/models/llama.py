@@ -58,7 +58,8 @@ class LlamaConfig:
                  rope_theta=10000.0, initializer_range=0.02,
                  tie_word_embeddings=False, use_recompute=False,
                  recompute_granularity="full", sequence_parallel=False,
-                 context_parallel=False, dtype="float32", **kwargs):
+                 context_parallel=False, cp_mode="ring", dtype="float32",
+                 **kwargs):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -74,6 +75,7 @@ class LlamaConfig:
         self.recompute_granularity = recompute_granularity
         self.sequence_parallel = sequence_parallel
         self.context_parallel = context_parallel
+        self.cp_mode = cp_mode            # "ring" | "ulysses" (SURVEY §5.7)
         self.dtype = dtype
         for k, v in kwargs.items():
             setattr(self, k, v)
@@ -161,10 +163,16 @@ class LlamaAttention(Layer):
             # cache-aware attention over the filled prefix
             out = cache.attend(self, q, k, v, training=self.training)
         elif self._use_ring_attention():
-            # context parallelism: seq dim sharded over 'sep', KV blocks
-            # rotate around the ring (SURVEY.md §5.7 mechanism 3)
-            from ..distributed.fleet.utils import ring_attention
-            out = ring_attention(q, k, v, causal=True)
+            # context parallelism: seq dim sharded over 'sep'. cp_mode
+            # picks the mechanism (SURVEY.md §5.7): "ring" rotates KV
+            # blocks with ppermute (3); "ulysses" swaps seq<->head with
+            # one all-to-all each way (2)
+            if getattr(self.config, "cp_mode", "ring") == "ulysses":
+                from ..distributed.fleet.utils import ulysses_attention
+                out = ulysses_attention(q, k, v, causal=True)
+            else:
+                from ..distributed.fleet.utils import ring_attention
+                out = ring_attention(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None,

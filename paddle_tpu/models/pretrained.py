@@ -57,14 +57,23 @@ def _strip_prefix(name, prefixes):
     return name
 
 
-def _check_fully_mapped(own, mapped, arch):
+def _check_fully_mapped(own, mapped, arch, optional=()):
     """Every model parameter must come from the checkpoint — an unmapped
-    key would silently stay randomly initialized after set_state_dict."""
+    key would silently stay randomly initialized after set_state_dict.
+    ``optional`` prefixes (e.g. BERT's pooler, absent from MLM-only
+    exports) only warn, matching HF's own load behavior."""
     missing = [k for k in own if k not in mapped]
-    if missing:
+    soft = [k for k in missing if any(k.startswith(p) for p in optional)]
+    hard = [k for k in missing if k not in soft]
+    if hard:
         raise ValueError(
             f"{arch} checkpoint left parameters unmapped (random init "
-            f"would be silent garbage): {missing[:8]}")
+            f"would be silent garbage): {hard[:8]}")
+    if soft:
+        import warnings
+        warnings.warn(f"{arch} checkpoint omits optional parameters "
+                      f"(randomly initialized): {soft[:8]}", RuntimeWarning,
+                      stacklevel=3)
 
 
 def load_llama_from_hf(model, model_dir, dtype="float32"):
@@ -227,6 +236,6 @@ def load_bert_from_hf(model, model_dir, dtype="float32"):
             raise ValueError(f"shape mismatch for {tgt}: checkpoint "
                              f"{arr.shape} vs model {want}")
         mapped[tgt] = arr.astype(dtype)
-    _check_fully_mapped(own, mapped, "BERT")
+    _check_fully_mapped(own, mapped, "BERT", optional=("pooler.",))
     model.set_state_dict(mapped)
     return model

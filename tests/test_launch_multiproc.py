@@ -75,12 +75,50 @@ WORKER = textwrap.dedent("""
     # processes instead of a host allgather)
     if world > 1:
         from paddle_tpu.framework.core import Tensor
-        t = Tensor(jnp.full((4,), float(jax.process_index() + 1)))
+        me = jax.process_index()
+        t = Tensor(jnp.full((4,), float(me + 1)))
         dist.all_reduce(t)
         expect = sum(range(1, world + 1))
         assert np.allclose(np.asarray(t._data), expect), np.asarray(t._data)
-        if jax.process_index() == 0:
+        if me == 0:
             print("ALLREDUCE_OK", flush=True)
+
+        # reduce_scatter device tier: rank r gets sum_p(p-th input of
+        # each process); inputs are (proc+1)*(slot+1) -> slice r sums to
+        # (slot r+1) * sum(proc+1)
+        outs = Tensor(jnp.zeros((2,), jnp.float32))
+        ins = [Tensor(jnp.full((2,), float((me + 1) * (s + 1)), jnp.float32))
+               for s in range(world)]
+        dist.reduce_scatter(outs, ins)
+        want_rs = (me + 1) * sum(p + 1 for p in range(world))
+        assert np.allclose(np.asarray(outs._data), want_rs), \
+            (np.asarray(outs._data), want_rs)
+
+        # alltoall device tier: slot s of my inputs goes to rank s
+        a2a_out = []
+        a2a_in = [Tensor(jnp.full((2,), float(me * 10 + s), jnp.float32))
+                  for s in range(world)]
+        dist.alltoall(a2a_out, a2a_in)
+        got = [float(np.asarray(t_._data)[0]) for t_ in a2a_out]
+        assert got == [p * 10 + me for p in range(world)], got
+
+        # real cross-process send/recv through the TCPStore p2p channel
+        if me == 0:
+            msg = Tensor(jnp.arange(6, dtype=jnp.float32).reshape(2, 3))
+            dist.send(msg, dst=1)
+            back = Tensor(jnp.zeros((2, 3), jnp.float32))
+            dist.recv(back, src=1)
+            assert np.allclose(np.asarray(back._data),
+                               np.arange(6).reshape(2, 3) * 2), \
+                np.asarray(back._data)
+            print("P2P_OK", flush=True)
+        else:
+            got_t = Tensor(jnp.zeros((2, 3), jnp.float32))
+            dist.recv(got_t, src=0)
+            reply = Tensor(jnp.asarray(np.asarray(got_t._data) * 2))
+            dist.send(reply, dst=0)
+        if me == 0:
+            print("RS_A2A_OK", flush=True)
     print("WORKER_DONE rank", jax.process_index(), flush=True)
 """)
 
@@ -151,5 +189,7 @@ def test_launch_two_process_dp_parity(tmp_path):
     dist_losses = _parse_losses(log0)
     np.testing.assert_allclose(dist_losses, oracle, rtol=1e-5, atol=1e-6)
     assert "ALLREDUCE_OK" in log0
+    assert "RS_A2A_OK" in log0
+    assert "P2P_OK" in log0
     assert "WORKER_DONE rank 0" in log0
     assert "WORKER_DONE rank 1" in (logdir / "workerlog.1").read_text()

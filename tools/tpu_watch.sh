@@ -15,13 +15,16 @@ while true; do
     {
       echo '{"session": "round3", "captured_at": "'"$(date -u +%Y-%m-%dT%H:%M:%SZ)"'", "results": ['
       first=1
-      for mode in resnet llama llama_decode; do
+      for spec in resnet llama llama_decode data resnet+BENCH_DATA=loader; do
+        mode=${spec%%+*}
+        extra=""
+        [ "$spec" != "$mode" ] && extra=${spec#*+}
         # bench.py bounds its own children (probe 150s + attempts
         # 1500/900 + cpu fallback 1200, killed on expiry by
         # subprocess.run); 4800s is a backstop only, so it can't fire
         # mid-run and orphan a TPU-holding child while the loop moves on.
-        line=$(BENCH_MODEL=$mode BENCH_PROBE_TIMEOUT=150 timeout 4800 python bench.py 2>>"$LOG" | tail -1)
-        echo "[tpu_watch] $mode -> $line" >> "$LOG"
+        line=$(env $extra BENCH_MODEL=$mode BENCH_PROBE_TIMEOUT=150 timeout 4800 python bench.py 2>>"$LOG" | tail -1)
+        echo "[tpu_watch] $spec -> $line" >> "$LOG"
         [ -z "$line" ] && line='{"metric": "'$mode'", "value": null, "error": "bench timed out"}'
         if [ $first -eq 1 ]; then first=0; else echo ','; fi
         echo "$line"
