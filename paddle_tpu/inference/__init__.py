@@ -145,4 +145,4 @@ class PrecisionType:
     Half = 1
     Int8 = 2
 
-from .serving import ServingEngine  # noqa: E402,F401
+from .serving import ServingEngine, ContinuousServingEngine  # noqa: E402,F401

@@ -15,6 +15,7 @@ import threading
 import time
 
 import numpy as np
+import jax.numpy as jnp
 
 from ..framework.core import Tensor
 
@@ -210,3 +211,208 @@ class ServingEngine:
 
     def __exit__(self, *exc):
         self.stop()
+
+
+class _Row:
+    """One sequence of a request inside the continuous scheduler."""
+
+    def __init__(self, req, ids):
+        self.req = req
+        self.prompt = np.asarray(ids)        # [s]
+        self.generated: list = []
+        self.done = False
+
+
+class ContinuousServingEngine:
+    """Continuous-batching serving engine (reference: the vLLM-style
+    scheduler the serving tier around ``fused_multi_transformer`` targets;
+    VERDICT.md round-2 item 8 — per-step admit/evict over the paged KV
+    cache, replacing :class:`ServingEngine`'s static same-shape windows).
+
+    TPU-native scheduling: admission prefills ONE sequence into a free
+    slot of a :class:`SlotPagedKVCache`; every decode step then runs a
+    single fixed-shape ``[max_batch, 1]`` forward where each slot carries
+    its own position/context length — sequences of different prompt
+    lengths and decode budgets share every step, a finished sequence's
+    slot is reused immediately, and the compiled decode program never
+    changes shape.
+
+    engine = ContinuousServingEngine(model, max_batch_size=8)
+    engine.start()
+    out = engine.generate(prompt_ids, max_new_tokens=64)   # blocks
+    engine.stop()
+    """
+
+    _STOP = ServingEngine._STOP
+
+    def __init__(self, model, max_batch_size=8, page_size=16, max_len=2048,
+                 pad_token_id=0):
+        self.model = model
+        self.max_batch = int(max_batch_size)
+        self.page_size = int(page_size)
+        self.max_len = int(max_len)
+        self.pad_token_id = int(pad_token_id)
+        self._q: queue.Queue = queue.Queue()
+        self._thread = None
+        self._running = False
+        # observability (and the "beats static batching" proof in tests)
+        self.decode_steps = 0
+        self.prefills = 0
+
+    def generate(self, input_ids, max_new_tokens=32, max_length=None,
+                 timeout=None, **kwargs):
+        ids = input_ids.numpy() if isinstance(input_ids, Tensor) \
+            else np.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        if max_length is not None:           # GenerationMixin contract
+            max_new_tokens = max(int(max_length) - ids.shape[1], 0)
+        if max_new_tokens <= 0:              # zero budget: prompt unchanged
+            return Tensor(ids)
+        if ids.shape[1] + max_new_tokens > self.max_len:
+            # fail THIS request up front — admitted-then-overflowing would
+            # poison every co-scheduled request via the batch error path
+            raise ValueError(
+                f"request needs {ids.shape[1]} + {max_new_tokens} tokens "
+                f"> engine max_len {self.max_len}")
+        return ServingEngine.generate(self, ids,
+                                      max_new_tokens=max_new_tokens,
+                                      timeout=timeout, **kwargs)
+
+    start = ServingEngine.start
+    stop = ServingEngine.stop
+    _loop = ServingEngine._loop
+    __enter__ = ServingEngine.__enter__
+    __exit__ = ServingEngine.__exit__
+
+    # -- scheduler ----------------------------------------------------------
+    def _admit(self, cache, free, active, pending):
+        from ..models.generation import _sample_logits
+        while free and pending:
+            row = pending.pop(0)
+            slot = free.pop(0)
+            cache.begin_prefill(slot)
+            s = row.prompt.shape[0]
+            logits = self.model.forward(
+                Tensor(row.prompt[None]), cache=cache,
+                position_ids=np.arange(s, dtype=np.int32))
+            kw = row.req.kwargs
+            nxt = int(np.asarray(_sample_logits(
+                logits._data[:, -1].astype(jnp.float32),
+                kw.get("do_sample", False), kw.get("top_k", 0),
+                kw.get("top_p", 1.0), kw.get("temperature", 1.0)))[0])
+            self.prefills += 1
+            active[slot] = row
+            self._push_token(cache, free, active, slot, nxt)
+
+    def _push_token(self, cache, free, active, slot, token):
+        row = active[slot]
+        row.generated.append(token)
+        eos = row.req.kwargs.get("eos_token_id")
+        if (eos is not None and token == eos) or \
+                len(row.generated) >= row.req.max_new_tokens:
+            row.done = True
+            active[slot] = None
+            cache.free(slot)
+            free.append(slot)
+            self._maybe_finish(row.req)
+
+    def _maybe_finish(self, req):
+        rows = req._rows
+        if not all(r.done for r in rows):
+            return
+        eos = req.kwargs.get("eos_token_id")
+        pad = self.pad_token_id if eos is None else eos
+        width = req.ids.shape[1] + max(len(r.generated) for r in rows)
+        out = np.full((len(rows), width), pad, req.ids.dtype)
+        for i, r in enumerate(rows):
+            seq = np.concatenate([r.prompt, np.asarray(r.generated,
+                                                       req.ids.dtype)])
+            out[i, :seq.shape[0]] = seq
+        req.result = out
+        req.done.set()
+
+    def _serve(self):
+        from ..autograd.tape import no_grad
+        with no_grad():
+            self._serve_impl()
+
+    def _serve_impl(self):
+        from ..models.generation import SlotPagedKVCache, _sample_logits
+
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            cache = SlotPagedKVCache(self.max_batch,
+                                     page_size=self.page_size,
+                                     max_len=self.max_len)
+            free = list(range(self.max_batch))
+            active: list = [None] * self.max_batch
+            pending: list = []
+
+            def enqueue(item):
+                """False = stop token; otherwise split into rows."""
+                if item is self._STOP or item is None:
+                    return False
+                item._rows = [_Row(item, row) for row in item.ids]
+                pending.extend(item._rows)
+                return True
+
+            while self._running:
+                # block only when idle; otherwise drain without waiting
+                if not pending and all(r is None for r in active):
+                    if not enqueue(self._q.get()):
+                        break
+                try:
+                    while True:
+                        if not enqueue(self._q.get_nowait()):
+                            self._running = False
+                            break
+                except queue.Empty:
+                    pass
+                try:
+                    self._admit(cache, free, active, pending)
+                    mask = np.asarray([r is not None for r in active])
+                    if not mask.any():
+                        continue
+                    # ONE fixed-shape decode step for every active slot
+                    cache.begin_decode(mask)
+                    cur = np.full((self.max_batch, 1), self.pad_token_id,
+                                  np.int64)
+                    for i, r in enumerate(active):
+                        if r is not None:
+                            cur[i, 0] = (r.generated[-1] if r.generated
+                                         else r.prompt[-1])
+                    pos = cache.lens.astype(np.int32)[:, None]
+                    logits = self.model.forward(Tensor(cur), cache=cache,
+                                                position_ids=pos)
+                    lg = logits._data[:, -1].astype(jnp.float32)
+                    self.decode_steps += 1
+                    greedy = np.asarray(jnp.argmax(lg, axis=-1))
+                    for i, r in enumerate(list(active)):
+                        if r is None:
+                            continue
+                        kw = r.req.kwargs
+                        if kw.get("do_sample", False):
+                            tok = int(np.asarray(_sample_logits(
+                                lg[i:i + 1], True, kw.get("top_k", 0),
+                                kw.get("top_p", 1.0),
+                                kw.get("temperature", 1.0)))[0])
+                        else:
+                            tok = int(greedy[i])
+                        self._push_token(cache, free, active, i, tok)
+                except Exception as e:      # fail everything in flight
+                    reqs = {r.req for r in pending}
+                    reqs |= {r.req for r in active if r is not None}
+                    for req in reqs:
+                        req.error = e
+                        req.done.set()
+                    pending.clear()
+                    active = [None] * self.max_batch
+                    free = list(range(self.max_batch))
+                    cache = SlotPagedKVCache(self.max_batch,
+                                             page_size=self.page_size,
+                                             max_len=self.max_len)
+        finally:
+            if was_training:
+                self.model.train()

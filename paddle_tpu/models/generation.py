@@ -16,7 +16,7 @@ from ..framework.core import Tensor
 from ..autograd.tape import no_grad
 from ..framework import random as prandom
 
-__all__ = ["KVCache", "PagedKVCache", "GenerationMixin"]
+__all__ = ["KVCache", "PagedKVCache", "SlotPagedKVCache", "GenerationMixin"]
 
 
 class KVCache:
@@ -187,6 +187,133 @@ class PagedKVCache(KVCache):
                                   interpret=interpret)
             return out[:, None]          # [b, 1, heads, d]
 
+        return apply(fn, q, op_name="paged_attention")
+
+
+class SlotPagedKVCache:
+    """Per-slot paged KV cache — the continuous-batching serving cache
+    (reference: the vLLM-style block cache behind
+    ``block_multihead_attention``; VERDICT.md round-2 item 8).
+
+    Unlike :class:`PagedKVCache` (one uniform batch filled in lockstep),
+    every slot here has its own context length and lifecycle: a slot is
+    **prefilled** alone when a request is admitted, participates in
+    fixed-shape [max_batch, 1] **decode** steps with its own position,
+    and is **freed** on completion so the next request reuses its pages.
+    The decode step's shape never changes, so the whole serve loop stays
+    on one compiled program while requests come and go.
+    """
+
+    def __init__(self, max_batch, page_size=16, max_len=2048):
+        self.max_batch = int(max_batch)
+        self.page_size = int(page_size)
+        self.max_len = int(max_len)
+        self.pages_per_seq = -(-self.max_len // self.page_size)
+        self._pools = {}            # id(layer) -> (k_pages, v_pages)
+        self._tables = (np.arange(self.max_batch)[:, None]
+                        * self.pages_per_seq
+                        + np.arange(self.pages_per_seq)[None, :]
+                        ).astype(np.int32)
+        self.lens = np.zeros(self.max_batch, np.int32)   # filled ctx/slot
+        self._mode = None            # ("prefill", slot) | ("decode", mask)
+        self._idx = None             # per-forward index memo
+
+    # -- engine-facing lifecycle -------------------------------------------
+    def begin_prefill(self, slot):
+        self._mode = ("prefill", int(slot))
+        self._idx = None             # per-forward index memo (see attend)
+        self.lens[slot] = 0
+
+    def begin_decode(self, active_mask):
+        self._mode = ("decode", np.asarray(active_mask, bool))
+        self._idx = None
+
+    def free(self, slot):
+        self.lens[slot] = 0
+
+    @property
+    def pos(self):
+        # models read cache.pos for default position ids; the engine
+        # always passes explicit per-slot positions instead
+        m = self._mode
+        return int(self.lens[m[1]]) if m and m[0] == "prefill" else 0
+
+    def advance(self, s):
+        mode, arg = self._mode
+        if mode == "prefill":
+            self.lens[arg] += int(s)
+        else:
+            self.lens[arg] += 1
+
+    def _pool(self, layer, kv_heads, d, dtype):
+        key = id(layer)
+        if key not in self._pools:
+            n = self.max_batch * self.pages_per_seq
+            shape = (kv_heads, n, self.page_size, d)
+            self._pools[key] = (jnp.zeros(shape, dtype),
+                                jnp.zeros(shape, dtype))
+        return self._pools[key]
+
+    # -- attention ----------------------------------------------------------
+    def attend(self, layer, q, k, v, training=False, dropout_p=0.0):
+        from ..autograd.tape import apply
+        from ..nn import functional as F
+
+        mode, arg = self._mode
+        ka = k._data if isinstance(k, Tensor) else k
+        va = v._data if isinstance(v, Tensor) else v
+        b, s, kv_heads, d = ka.shape
+        k_pages, v_pages = self._pool(layer, kv_heads, d, ka.dtype)
+
+        if mode == "prefill":
+            assert b == 1, "prefill admits one request at a time"
+            slot = arg
+            start = int(self.lens[slot])
+            if start + s > self.max_len:
+                raise ValueError(f"slot overflow: {start}+{s} > "
+                                 f"{self.max_len}")
+            if self._idx is None:    # indices shared by every layer
+                pos = np.arange(start, start + s)
+                self._idx = (
+                    jnp.asarray(self._tables[slot, pos // self.page_size]),
+                    jnp.asarray(pos % self.page_size))
+            page_ids, slot_ids = self._idx
+            kt = jnp.moveaxis(ka[0], 1, 0)          # [kv, s, d]
+            vt = jnp.moveaxis(va[0], 1, 0)
+            self._pools[id(layer)] = (
+                k_pages.at[:, page_ids, slot_ids].set(kt),
+                v_pages.at[:, page_ids, slot_ids].set(vt))
+            return F.scaled_dot_product_attention(
+                q, k, v, attn_mask=None, is_causal=True, training=training)
+
+        # decode: one token for EVERY slot (fixed shape), per-slot ctx
+        assert b == self.max_batch and s == 1
+        if self._idx is None:        # indices shared by every layer
+            lens = self.lens.copy()
+            self._idx = (
+                jnp.asarray(self._tables[np.arange(b),
+                                         lens // self.page_size])[:, None],
+                jnp.asarray(lens % self.page_size)[:, None],
+                jnp.asarray(self._tables),
+                # inactive slots still flow through the kernel (fixed
+                # shape); ctx=1 reads their own page 0 slot 0 — finite,
+                # discarded
+                jnp.asarray(np.where(arg, lens + 1, 1).astype(np.int32)))
+        page_ids, slot_ids, tables, ctx = self._idx
+        kt = jnp.moveaxis(ka, 2, 0)                 # [kv, b, 1, d]
+        vt = jnp.moveaxis(va, 2, 0)
+        new_kp = k_pages.at[:, page_ids, slot_ids].set(kt)
+        new_vp = v_pages.at[:, page_ids, slot_ids].set(vt)
+        self._pools[id(layer)] = (new_kp, new_vp)
+
+        from ..ops.pallas.paged_attention import paged_attention
+        import jax as _jax
+        interpret = _jax.default_backend() != "tpu"
+
+        def fn(qa):
+            out = paged_attention(qa[:, 0], new_kp, new_vp, tables, ctx,
+                                  interpret=interpret)
+            return out[:, None]
         return apply(fn, q, op_name="paged_attention")
 
 

@@ -1,0 +1,153 @@
+"""Continuous-batching serving engine (VERDICT.md round-2 item 8):
+per-step admit/evict over the slot-paged KV cache — greedy parity vs
+``model.generate``, mid-flight slot reuse, and mixed-length throughput
+beating the static same-shape window batcher."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousServingEngine, ServingEngine
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(num_hidden_layers=2))
+
+
+def _oracle(model, p, n):
+    return np.asarray(model.generate(paddle.to_tensor(p),
+                                     max_new_tokens=n)._data)
+
+
+def test_greedy_parity_mixed_lengths(model):
+    """Requests with DIFFERENT prompt lengths and budgets decode together
+    yet match the per-request sequential oracle exactly (greedy)."""
+    rng = np.random.RandomState(1)
+    specs = [(4, 6), (7, 4), (10, 5), (5, 3)]      # (prompt_len, max_new)
+    prompts = [rng.randint(0, 128, (1, s)).astype(np.int64)
+               for s, _ in specs]
+    oracle = [_oracle(model, p, n) for p, (_, n) in zip(prompts, specs)]
+
+    eng = ContinuousServingEngine(model, max_batch_size=4, max_len=64)
+    with eng:
+        results = [None] * len(specs)
+
+        def call(i):
+            results[i] = np.asarray(eng.generate(
+                prompts[i], max_new_tokens=specs[i][1], timeout=300).numpy())
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(len(specs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    for got, want in zip(results, oracle):
+        np.testing.assert_array_equal(got, want)
+    # the whole mixed workload shared decode steps: fewer than the sum
+    # of per-request budgets proves co-batching happened
+    assert eng.decode_steps < sum(n for _, n in specs), eng.decode_steps
+    assert eng.prefills == len(specs)
+
+
+def test_multi_row_request_and_slot_reuse(model):
+    """A 2-row request splits into per-row slots; more requests than
+    slots forces eviction + reuse mid-flight."""
+    rng = np.random.RandomState(2)
+    p2 = rng.randint(0, 128, (2, 5)).astype(np.int64)
+    singles = [rng.randint(0, 128, (1, 5)).astype(np.int64)
+               for _ in range(3)]
+    want2 = _oracle(model, p2, 4)
+    want_s = [_oracle(model, p, 4) for p in singles]
+
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=64)
+    with eng:
+        results = {}
+
+        def call(name, ids):
+            results[name] = np.asarray(eng.generate(
+                ids, max_new_tokens=4, timeout=300).numpy())
+
+        threads = [threading.Thread(target=call, args=("p2", p2))]
+        threads += [threading.Thread(target=call, args=(f"s{i}", p))
+                    for i, p in enumerate(singles)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    np.testing.assert_array_equal(results["p2"], want2)
+    for i, want in enumerate(want_s):
+        np.testing.assert_array_equal(results[f"s{i}"], want)
+    assert eng.prefills == 5          # 2 rows + 3 singles through 2 slots
+
+
+def test_eos_frees_slot_early(model):
+    """A request whose eos fires immediately stops decoding and its
+    output is trimmed to the eos, not padded to max_new_tokens."""
+    rng = np.random.RandomState(3)
+    p = rng.randint(0, 128, (1, 6)).astype(np.int64)
+    # discover the first greedy token, then use it as "eos"
+    first = int(_oracle(model, p, 1)[0, -1])
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=64)
+    with eng:
+        out = np.asarray(eng.generate(p, max_new_tokens=8, timeout=300,
+                                      eos_token_id=first).numpy())
+    assert out.shape[1] == p.shape[1] + 1
+    assert out[0, -1] == first
+    assert eng.decode_steps == 0      # finished at prefill, zero decodes
+
+
+def test_request_validation_and_budget_edges(model):
+    rng = np.random.RandomState(5)
+    p = rng.randint(0, 128, (1, 6)).astype(np.int64)
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=32)
+    with eng:
+        # zero budget: prompt returned unchanged, nothing scheduled
+        out = eng.generate(p, max_new_tokens=0, timeout=60)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), p)
+        # max_length honored (GenerationMixin contract)
+        out = eng.generate(p, max_length=9, timeout=120)
+        assert np.asarray(out.numpy()).shape == (1, 9)
+        # an oversized request fails ITSELF up front, not its batch-mates
+        with pytest.raises(ValueError, match="max_len"):
+            eng.generate(p, max_new_tokens=30, timeout=60)
+        # engine still serves afterwards
+        out = eng.generate(p, max_new_tokens=2, timeout=120)
+        assert np.asarray(out.numpy()).shape == (1, 8)
+    assert eng.prefills == 2
+
+
+def test_continuous_beats_static_window_on_mixed_lengths(model):
+    """The round-2 verdict's bar: mixed-length decode throughput must
+    beat static window batching (which can only group same-shape
+    requests, so distinct prompt lengths serialize)."""
+    rng = np.random.RandomState(4)
+    specs = [(4, 8), (6, 8), (9, 8), (12, 8)]
+    prompts = [rng.randint(0, 128, (1, s)).astype(np.int64)
+               for s, _ in specs]
+
+    def run(engine_cls, **kw):
+        eng = engine_cls(model, max_batch_size=4, **kw)
+        with eng:
+            t0 = time.perf_counter()
+            threads = [threading.Thread(
+                target=lambda i=i: eng.generate(prompts[i],
+                                                max_new_tokens=specs[i][1],
+                                                timeout=600))
+                for i in range(len(specs))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0
+
+    t_cont = run(ContinuousServingEngine, max_len=64)
+    t_static = run(ServingEngine, batch_window_s=0.2)
+    # static pays 4 separate decode sequences (one per unique prompt
+    # length); continuous shares every step. Generous margin for CI noise.
+    assert t_cont < t_static, (t_cont, t_static)
