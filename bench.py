@@ -188,13 +188,26 @@ def bench_llama():
     # MFU sweep knobs (BENCH_REMAT=1 -> full activation recompute per
     # layer; trades FLOPs for HBM so bigger BENCH_BATCH/BENCH_SEQ fit)
     remat = os.environ.get("BENCH_REMAT", "0") == "1"
+    # BENCH_PRESET=1b: a genuinely 1B-class config (TinyLlama-1.1B
+    # shape) — the sub-1B default can't saturate the MXU (round-2 MFU
+    # was measured at h1024/L8; VERDICT item 2 asks for 1B+)
+    preset = os.environ.get("BENCH_PRESET", "")
+    if preset == "1b":
+        dims = dict(hidden_size=2048, intermediate_size=5632,
+                    num_hidden_layers=22, num_attention_heads=32,
+                    num_key_value_heads=4)
+    else:
+        dims = dict(hidden_size=int(os.environ.get("BENCH_HIDDEN", "1024")),
+                    intermediate_size=int(os.environ.get("BENCH_INTER",
+                                                         "2816")),
+                    num_hidden_layers=int(os.environ.get("BENCH_LAYERS",
+                                                         "8")),
+                    num_attention_heads=16, num_key_value_heads=8)
 
     paddle.seed(0)
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                      intermediate_size=2816, num_hidden_layers=8,
-                      num_attention_heads=16, num_key_value_heads=8,
+    cfg = LlamaConfig(vocab_size=32000,
                       max_position_embeddings=max(2048, seq),
-                      use_recompute=remat)
+                      use_recompute=remat, **dims)
     model = LlamaForCausalLM(cfg)
     model.train()
     fm = FunctionalModule(model, training=True)
@@ -239,6 +252,10 @@ def bench_llama():
         "value": round(batch * seq * steps / dt, 2),
         "unit": "tokens/sec",
         "vs_baseline": None,
+        "mfu_pct": round(mfu * 100, 2),
+        "chip": chip,
+        "config": {"batch": batch, "seq": seq, "remat": remat,
+                   **{k: v for k, v in dims.items()}},
     }
 
 

@@ -12,8 +12,8 @@ from .autograd.tape import apply
 
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
-    "fft2", "ifft2", "rfft2", "irfft2",
-    "fftn", "ifftn", "rfftn", "irfftn",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
     "fftfreq", "rfftfreq", "fftshift", "ifftshift",
 ]
 
@@ -52,6 +52,65 @@ fftn = _wrapn(jnp.fft.fftn)
 ifftn = _wrapn(jnp.fft.ifftn)
 rfftn = _wrapn(jnp.fft.rfftn)
 irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def _hfftn_impl(a, s, axes, norm):
+    """Hermitian FFT over multiple axes (torch/paddle semantics: plain
+    FFT over the leading axes, hermitian (real-output) FFT on the last —
+    numpy only ships the 1-D hfft)."""
+    axes = tuple(axes)
+    lead, last = axes[:-1], axes[-1]
+    n_last = None if s is None else s[-1]
+    if lead:
+        a = jnp.fft.fftn(a, s=None if s is None else s[:-1], axes=lead,
+                         norm=norm)
+    return jnp.fft.hfft(a, n=n_last, axis=last, norm=norm)
+
+
+def _ihfftn_impl(a, s, axes, norm):
+    axes = tuple(axes)
+    lead, last = axes[:-1], axes[-1]
+    n_last = None if s is None else s[-1]
+    out = jnp.fft.ihfft(a, n=n_last, axis=last, norm=norm)
+    if lead:
+        out = jnp.fft.ifftn(out, s=None if s is None else s[:-1], axes=lead,
+                            norm=norm)
+    return out
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """paddle.fft.hfft2 — 2-D FFT of a Hermitian-symmetric signal (real
+    output)."""
+    return apply(lambda a: _hfftn_impl(a, s, axes, _norm(norm)), x,
+                 op_name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """paddle.fft.ihfft2 — inverse of :func:`hfft2` (Hermitian output)."""
+    return apply(lambda a: _ihfftn_impl(a, s, axes, _norm(norm)), x,
+                 op_name="ihfft2")
+
+
+def _default_axes(a, s, axes):
+    if axes is not None:
+        return tuple(axes)
+    # numpy/paddle contract: with s given, the LAST len(s) axes
+    return tuple(range(a.ndim - len(s), a.ndim)) if s is not None \
+        else tuple(range(a.ndim))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """paddle.fft.hfftn — N-D Hermitian FFT (real output)."""
+    def fn(a):
+        return _hfftn_impl(a, s, _default_axes(a, s, axes), _norm(norm))
+    return apply(fn, x, op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """paddle.fft.ihfftn — inverse of :func:`hfftn`."""
+    def fn(a):
+        return _ihfftn_impl(a, s, _default_axes(a, s, axes), _norm(norm))
+    return apply(fn, x, op_name="ihfftn")
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

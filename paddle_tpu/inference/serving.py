@@ -358,20 +358,35 @@ class ContinuousServingEngine:
                 pending.extend(item._rows)
                 return True
 
-            while self._running:
+            while True:
+                draining = not self._running
+                if draining and all(r is None for r in active):
+                    break
                 # block only when idle; otherwise drain without waiting
-                if not pending and all(r is None for r in active):
+                if not draining and not pending and \
+                        all(r is None for r in active):
                     if not enqueue(self._q.get()):
-                        break
+                        self._running = False
+                        continue     # drain in-flight rows before exit
+                if not draining:
+                    try:
+                        while True:
+                            if not enqueue(self._q.get_nowait()):
+                                self._running = False
+                                break
+                    except queue.Empty:
+                        pass
+                if not self._running and pending:
+                    # stop(): un-admitted rows fail fast; admitted rows
+                    # decode to completion (the base engine's contract —
+                    # in-flight work is finished, not discarded)
+                    for row in pending:
+                        row.req.error = RuntimeError("ServingEngine stopped")
+                        row.req.done.set()
+                    pending.clear()
                 try:
-                    while True:
-                        if not enqueue(self._q.get_nowait()):
-                            self._running = False
-                            break
-                except queue.Empty:
-                    pass
-                try:
-                    self._admit(cache, free, active, pending)
+                    if self._running:
+                        self._admit(cache, free, active, pending)
                     mask = np.asarray([r is not None for r in active])
                     if not mask.any():
                         continue

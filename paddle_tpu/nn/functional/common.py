@@ -647,8 +647,13 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
     return apply(fn, x, op_name="normalize")
 
 
-def unfold_channels(*a, **k):
-    raise NotImplementedError
+def unfold_channels(x, kernel_sizes, strides=1, paddings=0, dilations=1,
+                    name=None):
+    """im2col with channel-major patch ordering ([c0·k00, c0·k01, …]) —
+    the layout :func:`unfold` already produces; kept as a distinct name
+    for callers that spell the reference's channels variant."""
+    return unfold(x, kernel_sizes, strides=strides, paddings=paddings,
+                  dilations=dilations, name=name)
 
 
 def bilinear(x1, x2, weight, bias=None, name=None):

@@ -70,9 +70,34 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
     return loss
 
 
-def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
-    return cross_entropy(input, label, weight=weight, ignore_index=ignore_index,
-                         reduction=reduction, use_softmax=False)
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    """paddle.nn.functional.nll_loss — input is LOG-probabilities
+    [N, C, d1, ...] (torch/paddle contract; NOT probabilities — that is
+    ``cross_entropy(use_softmax=False)``'s convention)."""
+    def fn(lp, lab, *w):
+        lp = lp.astype(jnp.float32)
+        idx = lab.astype(jnp.int32)
+        safe = jnp.where(idx == ignore_index, 0, idx)
+        # class axis is 1 for N-D input ([N, C, d1, ...])
+        picked = jnp.take_along_axis(lp, safe[:, None] if lp.ndim > 1
+                                     else safe[None], axis=1 if lp.ndim > 1
+                                     else 0)
+        loss = -jnp.squeeze(picked, axis=1) if lp.ndim > 1 else -picked
+        mask = idx != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if w:
+            cw = jnp.take(w[0], safe) * mask.astype(jnp.float32)
+            if reduction == "mean":
+                return jnp.sum(loss * cw) / jnp.maximum(jnp.sum(cw), 1e-30)
+            loss = loss * cw
+        elif reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(fn, *args, op_name="nll_loss")
 
 
 def mse_loss(input, label, reduction="mean", name=None):

@@ -232,6 +232,38 @@ def exponential_(x, lam=1.0, name=None):
     return x._replace_(val)
 
 
+def binomial(count, prob, name=None):
+    """paddle.binomial — samples from Binomial(count, prob) per element
+    (reference kernel: ``paddle/phi/kernels/cpu/binomial_kernel``)."""
+    n = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    n, p = jnp.broadcast_arrays(n, p)
+    return Tensor(jax.random.binomial(
+        prandom.next_key(), n.astype(jnp.float32),
+        p.astype(jnp.float32)).astype(INT_DTYPE))
+
+
+def standard_gamma(x, name=None):
+    """paddle.standard_gamma — Gamma(alpha=x, scale=1) samples."""
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.gamma(prandom.next_key(), a).astype(a.dtype))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """paddle.log_normal — exp(Normal(mean, std)) of the given shape."""
+    shape = [1] if shape is None else list(shape)
+    dt = _dt(dtype, "float32")
+    z = jax.random.normal(prandom.next_key(), tuple(int(s) for s in shape))
+    return Tensor(jnp.exp(mean + std * z).astype(dt))
+
+
+def polar(abs, angle, name=None):
+    """paddle.polar — complex tensor from magnitude + phase."""
+    return apply(lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                              r * jnp.sin(t)),
+                 abs, angle, op_name="polar")
+
+
 def vander(x, n=None, increasing=False, name=None):
     def fn(a):
         cols = n if n is not None else a.shape[0]

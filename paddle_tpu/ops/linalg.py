@@ -278,3 +278,38 @@ def lu_solve(b, lu_data, lu_pivots, trans="N", name=None):
         return jax.scipy.linalg.lu_solve((lu_, piv.astype(jnp.int32)),
                                          bb, trans=t)
     return apply(fn, b, lu_data, lu_pivots, op_name="lu_solve")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """paddle.linalg.vecdot — vector dot product along ``axis`` with
+    broadcasting over the remaining dims."""
+    def fn(a, b):
+        return (a * b).sum(axis=axis)
+    return apply(fn, x, y, op_name="vecdot")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """paddle.linalg.svd_lowrank — randomized low-rank SVD via ``niter``
+    subspace (power) iterations (Halko et al., the reference algorithm).
+    Returns (U [m, q], S [q], V [n, q])."""
+    from ..framework import random as prandom
+
+    def fn(a, *rest):
+        b = a - rest[0] if rest else a
+        m, n = b.shape[-2], b.shape[-1]
+        k = min(int(q), m, n)
+        bt = jnp.swapaxes(b, -1, -2)      # batched-safe transpose
+        omega = jax.random.normal(prandom.next_key(),
+                                  b.shape[:-2] + (n, k), b.dtype)
+        y = b @ omega
+        for _ in range(int(niter)):
+            # re-orthonormalize each subspace iteration: raw power
+            # iterations collapse the basis in float32
+            q_i, _ = jnp.linalg.qr(y)
+            y = b @ (bt @ q_i)
+        Q, _ = jnp.linalg.qr(y)
+        ub, s, vt = jnp.linalg.svd(jnp.swapaxes(Q, -1, -2) @ b,
+                                   full_matrices=False)
+        return Q @ ub, s, jnp.swapaxes(vt, -1, -2)
+    args = (x,) + ((M,) if M is not None else ())
+    return apply(fn, *args, op_name="svd_lowrank")

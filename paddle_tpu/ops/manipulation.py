@@ -655,3 +655,65 @@ def view(x, shape_or_dtype, name=None):
 
 def view_as(x, other, name=None):
     return view(x, list(other.shape))
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """paddle.as_strided — strided view over the flattened buffer
+    (gather-based: XLA has no aliasing views, so this materializes)."""
+    def fn(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(int(offset))
+        for s, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(int(s)) * int(st)
+        return flat[idx.reshape(-1)].reshape(tuple(int(s) for s in shape))
+    return apply(fn, x, op_name="as_strided")
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """paddle.fill_diagonal_tensor — write ``y`` along the (dim1, dim2)
+    diagonal of ``x`` (out-of-place; ``fill_diagonal_tensor_`` mutates)."""
+    def fn(a, b):
+        n = min(a.shape[dim1], a.shape[dim2] - offset) if offset >= 0 \
+            else min(a.shape[dim1] + offset, a.shape[dim2])
+        i = jnp.arange(n) + max(-offset, 0)
+        j = jnp.arange(n) + max(offset, 0)
+        # move the diagonal dims to the front for a single scatter
+        moved = jnp.moveaxis(a, (dim1, dim2), (0, 1))
+        bm = jnp.moveaxis(b, -1, 0) if b.ndim else b
+        upd = moved.at[i, j].set(bm)
+        return jnp.moveaxis(upd, (0, 1), (dim1, dim2))
+    return apply(fn, x, y, op_name="fill_diagonal_tensor")
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    out = fill_diagonal_tensor(x, y, offset=offset, dim1=dim1, dim2=dim2)
+    return x._replace_(out._data if isinstance(out, Tensor) else out)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """paddle.Tensor.fill_diagonal_ — fill the (offset) diagonal in
+    place. For ndim > 2 the torch/paddle contract fills the
+    (i, i, ..., i) hyper-diagonal of an all-equal-dims tensor (offset
+    must be 0 there)."""
+    a = x._data
+    if a.ndim > 2:
+        if offset != 0:
+            raise ValueError("fill_diagonal_: offset is only supported "
+                             "for 2-D tensors")
+        if len(set(a.shape)) != 1:
+            raise ValueError("fill_diagonal_: ndim>2 needs all dims equal")
+        i = jnp.arange(a.shape[0])
+        new = a.at[tuple([i] * a.ndim)].set(value)
+        return x._replace_(new)
+    if a.ndim == 2 and wrap and a.shape[0] > a.shape[1]:
+        # torch/paddle wrap semantics: repeat the diagonal every n+1 rows
+        rows = jnp.arange(a.shape[0])
+        cols = (rows + offset) % (a.shape[1] + 1)
+        hit = cols < a.shape[1]
+        new = a.at[rows[hit], cols[hit]].set(value)
+    else:
+        n = min(a.shape[-2] - max(-offset, 0), a.shape[-1] - max(offset, 0))
+        i = jnp.arange(n) + max(-offset, 0)
+        j = jnp.arange(n) + max(offset, 0)
+        new = a.at[..., i, j].set(value)
+    return x._replace_(new)

@@ -754,3 +754,74 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                                weights=w[0] if w else None)
     args = (x,) + ((weights,) if weights is not None else ())
     return apply(fn, *args, op_name="histogramdd")
+
+
+@defop
+def gammaln(x):
+    """paddle.gammaln — log|Gamma(x)| (same kernel family as lgamma)."""
+    return jax.lax.lgamma(x)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    """paddle.histogram_bin_edges — the bin edges histogram() would use."""
+    def fn(a):
+        lo, hi = float(min), float(max)
+        if lo == 0 and hi == 0:
+            return jnp.histogram_bin_edges(a, bins=int(bins))
+        return jnp.histogram_bin_edges(a, bins=int(bins), range=(lo, hi))
+    return apply(fn, x, op_name="histogram_bin_edges")
+
+
+def reduce_as(x, target, name=None):
+    """paddle.reduce_as — sum x down to target's (broadcast-compatible)
+    shape: the transpose of broadcasting, used by backward composition."""
+    tgt = tuple(target.shape) if hasattr(target, "shape") else tuple(target)
+
+    def fn(a):
+        extra = a.ndim - len(tgt)
+        out = a.sum(axis=tuple(range(extra))) if extra else a
+        keep = tuple(i for i, (s, t) in enumerate(zip(out.shape, tgt))
+                     if s != t and t == 1)
+        return out.sum(axis=keep, keepdims=True) if keep else out
+    return apply(fn, x, op_name="reduce_as")
+
+
+def pdist(x, p=2.0, name=None):
+    """paddle.pdist — condensed pairwise distances of the rows of a 2-D
+    tensor (upper triangle of cdist(x, x), k=1)."""
+    def fn(a):
+        n = a.shape[0]
+        diff = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            d = jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 0.0))
+        elif p == 0:
+            d = (diff != 0).sum(-1).astype(a.dtype)
+        elif p == float("inf"):
+            d = jnp.abs(diff).max(-1)
+        else:
+            d = (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+        iu, ju = jnp.triu_indices(n, k=1)
+        return d[iu, ju]
+    return apply(fn, x, op_name="pdist")
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """paddle.tensor.top_p_sampling — nucleus sampling over the last axis
+    of probabilities ``x`` with per-row cumulative threshold ``ps``.
+    Returns (selected probability, selected index)."""
+    from ..framework import random as prandom
+
+    def fn(probs, p_row):
+        sorted_p = jnp.sort(probs, axis=-1)[..., ::-1]
+        csum = jnp.cumsum(sorted_p, axis=-1)
+        # keep the smallest prefix with cumulative mass >= ps
+        keep_sorted = csum - sorted_p < p_row[..., None]
+        kth = jnp.sum(keep_sorted, axis=-1) - 1
+        cutoff = jnp.take_along_axis(sorted_p, kth[..., None], axis=-1)
+        masked = jnp.where(probs >= cutoff, probs, 0.0)
+        logits = jnp.log(jnp.maximum(masked, 1e-30))
+        key = prandom.next_key() if seed is None else jax.random.key(seed)
+        idx = jax.random.categorical(key, logits, axis=-1)
+        val = jnp.take_along_axis(probs, idx[..., None], axis=-1)
+        return val, idx[..., None].astype(INT_DTYPE)
+    return apply(fn, x, ps, op_name="top_p_sampling")

@@ -22,6 +22,13 @@ __all__ = [
     "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
     "SparseCsrTensor", "add", "multiply", "matmul", "masked_matmul", "relu",
     "is_sparse", "nn",
+    # elementwise value ops (pattern-preserving)
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "abs", "pow", "neg", "expm1", "log1p", "cast",
+    "rad2deg", "deg2rad", "isnan",
+    # binary / reduction / structure
+    "subtract", "divide", "sum", "transpose", "reshape", "coalesce",
+    "is_same_shape", "mask_as", "slice", "mv", "addmm",
 ]
 
 
@@ -220,6 +227,209 @@ def relu(x):
     m = _coo(x)._m
     return SparseCooTensor(jsparse.BCOO((jnp.maximum(m.data, 0), m.indices),
                                         shape=m.shape))
+
+
+# -- elementwise value ops (reference: paddle/phi/kernels/sparse/unary_*):
+# pattern-preserving maps over the stored values only -------------------------
+
+def _unary(x, vfn):
+    m = _coo(x)._m
+    out = SparseCooTensor(jsparse.BCOO((vfn(m.data), m.indices),
+                                       shape=m.shape))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def sin(x, name=None):
+    return _unary(x, jnp.sin)
+
+
+def tan(x, name=None):
+    return _unary(x, jnp.tan)
+
+
+def asin(x, name=None):
+    return _unary(x, jnp.arcsin)
+
+
+def atan(x, name=None):
+    return _unary(x, jnp.arctan)
+
+
+def sinh(x, name=None):
+    return _unary(x, jnp.sinh)
+
+
+def tanh(x, name=None):
+    return _unary(x, jnp.tanh)
+
+
+def asinh(x, name=None):
+    return _unary(x, jnp.arcsinh)
+
+
+def atanh(x, name=None):
+    return _unary(x, jnp.arctanh)
+
+
+def sqrt(x, name=None):
+    return _unary(x, jnp.sqrt)
+
+
+def square(x, name=None):
+    return _unary(x, jnp.square)
+
+
+def abs(x, name=None):
+    return _unary(x, jnp.abs)
+
+
+def pow(x, factor, name=None):
+    return _unary(x, lambda v: v ** factor)
+
+
+def neg(x, name=None):
+    return _unary(x, jnp.negative)
+
+
+def expm1(x, name=None):
+    return _unary(x, jnp.expm1)
+
+
+def log1p(x, name=None):
+    return _unary(x, jnp.log1p)
+
+
+def rad2deg(x, name=None):
+    return _unary(x, jnp.rad2deg)
+
+
+def deg2rad(x, name=None):
+    return _unary(x, jnp.deg2rad)
+
+
+def isnan(x, name=None):
+    return _unary(x, jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    m = _coo(x)._m
+    data = m.data if value_dtype is None else \
+        m.data.astype(dtypes.convert_dtype(value_dtype))
+    idx = m.indices if index_dtype is None else \
+        m.indices.astype(dtypes.convert_dtype(index_dtype))
+    out = SparseCooTensor(jsparse.BCOO((data, idx), shape=m.shape))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+# -- binary / reductions / structure -----------------------------------------
+
+def subtract(x, y, name=None):
+    if is_sparse(y):
+        return add(x, neg(y))
+    return Tensor(x.to_dense()._data - _as_array(y))
+
+
+def divide(x, y, name=None):
+    """Elementwise divide. Sparse ÷ dense divides the stored values by
+    the dense entries at their coordinates (pattern preserved); sparse ÷
+    sparse requires matching (coalesced) patterns — the reference's
+    same-pattern contract."""
+    m = _coo(x)._m.sum_duplicates()
+    if is_sparse(y):
+        ym = _coo(y)._m.sum_duplicates()
+        if m.indices.shape != ym.indices.shape or \
+                bool((m.indices != ym.indices).any()):
+            raise ValueError("sparse.divide needs identical sparsity "
+                             "patterns (coalesce first)")
+        vals = m.data / ym.data
+    else:
+        d = _as_array(y)
+        vals = m.data / d[tuple(m.indices[:, i]
+                                for i in range(m.indices.shape[1]))]
+    out = SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """paddle.sparse.sum — dense scalar for axis=None, else a sparse
+    tensor with the axis reduced."""
+    m = _coo(x)._m
+    data = m.data if dtype is None else \
+        m.data.astype(dtypes.convert_dtype(dtype))
+    if axis is None:
+        out = data.sum()
+        return Tensor(out[None] if keepdim else out)
+    dense = jsparse.BCOO((data, m.indices), shape=m.shape).todense()
+    red = dense.sum(axis=axis, keepdims=keepdim)
+    nse = int((red != 0).sum())
+    out = SparseCooTensor(jsparse.bcoo_fromdense(red, nse=max(nse, 1)))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+        and out._m.ndim == 2 else out
+
+
+def transpose(x, perm, name=None):
+    m = _coo(x)._m
+    out = SparseCooTensor(jsparse.bcoo_transpose(
+        m, permutation=tuple(int(p) for p in perm)))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def reshape(x, shape, name=None):
+    m = _coo(x)._m
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        total = int(np.prod(m.shape))
+        shape = tuple(total // known if s == -1 else s for s in shape)
+    out = SparseCooTensor(jsparse.bcoo_reshape(m, new_sizes=shape))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def coalesce(x, name=None):
+    return _coo(x).coalesce()
+
+
+def is_same_shape(x, y, name=None):
+    sx = x.shape if is_sparse(x) else list(_as_array(x).shape)
+    sy = y.shape if is_sparse(y) else list(_as_array(y).shape)
+    return list(sx) == list(sy)
+
+
+def mask_as(x, mask, name=None):
+    """Sample dense ``x`` at ``mask``'s sparsity pattern (reference
+    ``paddle.sparse.mask_as``)."""
+    xa = _as_array(x)
+    m = _coo(mask)._m
+    vals = xa[tuple(m.indices[:, i] for i in range(m.indices.shape[1]))]
+    out = SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) else out
+
+
+def slice(x, axes, starts, ends, name=None):
+    m = _coo(x)._m
+    dense = m.todense()
+    # build python slices explicitly (the name `slice` is shadowed here)
+    import builtins
+    sl = [builtins.slice(None)] * dense.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[int(ax)] = builtins.slice(int(st), int(en))
+    sub = dense[tuple(sl)]
+    nse = int((sub != 0).sum())
+    out = SparseCooTensor(jsparse.bcoo_fromdense(sub, nse=max(nse, 1)))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def mv(x, vec, name=None):
+    """sparse matrix × dense vector → dense vector."""
+    return matmul(x, vec)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """input + alpha·(x @ y) with a sparse ``x`` (dense result)."""
+    prod = matmul(x, y)
+    base = input.to_dense() if is_sparse(input) else \
+        (input if isinstance(input, Tensor) else Tensor(_as_array(input)))
+    return Tensor(beta * base._data + alpha * prod._data)
 
 
 class nn:

@@ -1,0 +1,269 @@
+"""Systematic nn.functional matrix vs torch (reference: the per-op
+``test_activation_op.py`` / ``test_*_loss.py`` files of
+``test/legacy_test/`` — every functional in the op schema must be
+exercised by name; this file covers the tail the layer-level suites
+don't hit directly)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+RNG = np.random.RandomState(5)
+
+
+def t(x):
+    return paddle.to_tensor(x)
+
+
+def _cmp(got, want, rtol=1e-4, atol=1e-5):
+    if isinstance(want, torch.Tensor):
+        want = want.detach().numpy()
+    np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                               rtol=rtol, atol=atol)
+
+
+# -- activations: (name, paddle kwargs, torch fn) ---------------------------
+
+ACTIVATIONS = [
+    ("celu", {"alpha": 1.2}, lambda x: TF.celu(x, alpha=1.2)),
+    ("elu", {"alpha": 0.8}, lambda x: TF.elu(x, alpha=0.8)),
+    ("hardshrink", {}, TF.hardshrink),
+    ("hardtanh", {}, TF.hardtanh),
+    ("hardsigmoid", {}, TF.hardsigmoid),
+    ("hardswish", {}, TF.hardswish),
+    ("leaky_relu", {"negative_slope": 0.1},
+     lambda x: TF.leaky_relu(x, 0.1)),
+    ("log_sigmoid", {}, TF.logsigmoid),
+    ("mish", {}, TF.mish),
+    ("relu6", {}, TF.relu6),
+    ("selu", {}, TF.selu),
+    ("softplus", {}, TF.softplus),
+    ("softshrink", {}, TF.softshrink),
+    ("softsign", {}, TF.softsign),
+    ("swish", {}, TF.silu),
+    ("tanhshrink", {}, TF.tanhshrink),
+]
+
+
+@pytest.mark.parametrize("name,kw,ref", ACTIVATIONS,
+                         ids=[a[0] for a in ACTIVATIONS])
+def test_activation_matches_torch(name, kw, ref):
+    x = RNG.randn(3, 4).astype(np.float32) * 2
+    _cmp(getattr(F, name)(t(x), **kw), ref(torch.tensor(x)))
+
+
+def test_prelu_glu_maxout_relu_():
+    x = RNG.randn(2, 6).astype(np.float32)
+    w = np.asarray([0.25], np.float32)
+    _cmp(F.prelu(t(x), t(w)), TF.prelu(torch.tensor(x), torch.tensor(w)))
+    _cmp(F.glu(t(x), axis=-1), TF.glu(torch.tensor(x), dim=-1))
+    # maxout (phi MaxOutFunctor): output channel i = max over the
+    # CONSECUTIVE input channels [i*groups, (i+1)*groups)
+    xm = RNG.randn(2, 6, 4, 4).astype(np.float32)
+    got = np.asarray(F.maxout(t(xm), groups=3).numpy())
+    want = xm.reshape(2, 2, 3, 4, 4).max(axis=2)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # relu_ mutates in place
+    xr = t(np.asarray([-1.0, 2.0], np.float32))
+    F.relu_(xr)
+    np.testing.assert_allclose(np.asarray(xr.numpy()), [0.0, 2.0])
+
+
+def test_rrelu_gumbel_softmax_seeded():
+    paddle.seed(3)
+    x = RNG.randn(4, 5).astype(np.float32)
+    # eval mode: rrelu is deterministic (mean slope)
+    got = np.asarray(F.rrelu(t(x), lower=0.1, upper=0.3,
+                             training=False).numpy())
+    want = np.where(x >= 0, x, 0.2 * x)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # training mode: random slopes within [lower, upper], seeded
+    paddle.seed(3)
+    a = np.asarray(F.rrelu(t(x), training=True).numpy())
+    paddle.seed(3)
+    b = np.asarray(F.rrelu(t(x), training=True).numpy())
+    np.testing.assert_array_equal(a, b)
+    # gumbel_softmax: rows sum to 1; hard=True yields one-hot
+    paddle.seed(4)
+    g = np.asarray(F.gumbel_softmax(t(x), temperature=0.5).numpy())
+    np.testing.assert_allclose(g.sum(-1), np.ones(4), rtol=1e-4)
+    gh = np.asarray(F.gumbel_softmax(t(x), hard=True).numpy())
+    assert ((gh == 0) | (gh == 1)).all() and gh.sum() == 4
+
+
+# -- losses -----------------------------------------------------------------
+
+def test_loss_matrix_matches_torch():
+    p = np.clip(RNG.rand(4, 3).astype(np.float32), 0.05, 0.95)
+    y = (RNG.rand(4, 3) > 0.5).astype(np.float32)
+    _cmp(F.binary_cross_entropy(t(p), t(y)),
+         TF.binary_cross_entropy(torch.tensor(p), torch.tensor(y)))
+    logits = RNG.randn(4, 3).astype(np.float32)
+    _cmp(F.binary_cross_entropy_with_logits(t(logits), t(y)),
+         TF.binary_cross_entropy_with_logits(torch.tensor(logits),
+                                             torch.tensor(y)))
+    a = RNG.randn(4, 6).astype(np.float32)
+    b = RNG.randn(4, 6).astype(np.float32)
+    _cmp(F.mse_loss(t(a), t(b)), TF.mse_loss(torch.tensor(a),
+                                             torch.tensor(b)))
+    _cmp(F.l1_loss(t(a), t(b)), TF.l1_loss(torch.tensor(a),
+                                           torch.tensor(b)))
+    _cmp(F.smooth_l1_loss(t(a), t(b)),
+         TF.smooth_l1_loss(torch.tensor(a), torch.tensor(b)))
+    _cmp(F.kl_div(t(np.log(p)), t(p)),
+         TF.kl_div(torch.tensor(np.log(p)), torch.tensor(p)))
+    lab = RNG.randint(0, 3, (4,)).astype(np.int64)
+    logp = np.log(p / p.sum(-1, keepdims=True))
+    _cmp(F.nll_loss(t(logp.astype(np.float32)), t(lab)),
+         TF.nll_loss(torch.tensor(logp.astype(np.float32)),
+                     torch.tensor(lab)))
+    yy = np.where(RNG.rand(4) > 0.5, 1.0, -1.0).astype(np.float32)
+    _cmp(F.cosine_embedding_loss(t(a), t(b), t(yy)),
+         TF.cosine_embedding_loss(torch.tensor(a), torch.tensor(b),
+                                  torch.tensor(yy)))
+    _cmp(F.hinge_embedding_loss(t(a), t(yy[:, None].repeat(6, 1))),
+         TF.hinge_embedding_loss(torch.tensor(a),
+                                 torch.tensor(yy[:, None].repeat(6, 1))))
+    m1 = RNG.randn(4).astype(np.float32)
+    m2 = RNG.randn(4).astype(np.float32)
+    _cmp(F.margin_ranking_loss(t(m1), t(m2), t(yy)),
+         TF.margin_ranking_loss(torch.tensor(m1), torch.tensor(m2),
+                                torch.tensor(yy)))
+    c = RNG.randn(4, 6).astype(np.float32)
+    _cmp(F.triplet_margin_loss(t(a), t(b), t(c)),
+         TF.triplet_margin_loss(torch.tensor(a), torch.tensor(b),
+                                torch.tensor(c)), rtol=1e-3)
+    # paddle-only surfaces
+    _cmp(F.square_error_cost(t(a), t(b)), (a - b) ** 2)
+    eps = 1e-4      # paddle log_loss epsilon inside both logs
+    _cmp(F.log_loss(t(p[:, :1]), t(y[:, :1])),
+         -(y[:, :1] * np.log(p[:, :1] + eps) +
+           (1 - y[:, :1]) * np.log(1 - p[:, :1] + eps)), rtol=1e-4)
+    sm = np.asarray(F.label_smooth(t(y), epsilon=0.1).numpy())
+    np.testing.assert_allclose(sm, y * 0.9 + 0.1 / 3, rtol=1e-4)
+    loss, sp = F.softmax_with_cross_entropy(
+        t(logits), t(lab[:, None]), return_softmax=True)
+    want = TF.cross_entropy(torch.tensor(logits), torch.tensor(lab),
+                            reduction="none")
+    np.testing.assert_allclose(np.asarray(loss.numpy()).ravel(),
+                               want.numpy(), rtol=1e-4, atol=1e-5)
+    # focal loss vs manual formula
+    fl = np.asarray(F.sigmoid_focal_loss(
+        t(logits), t(y), reduction="none").numpy())
+    sig = 1 / (1 + np.exp(-logits))
+    ce = -(y * np.log(sig) + (1 - y) * np.log(1 - sig))
+    pt = y * sig + (1 - y) * (1 - sig)
+    alpha_t = y * 0.25 + (1 - y) * 0.75
+    np.testing.assert_allclose(fl, alpha_t * (1 - pt) ** 2 * ce,
+                               rtol=1e-3, atol=1e-4)
+
+
+# -- conv / pool / norm ------------------------------------------------------
+
+def test_conv_family_matches_torch():
+    x1 = RNG.randn(2, 3, 12).astype(np.float32)
+    w1 = RNG.randn(4, 3, 3).astype(np.float32)
+    _cmp(F.conv1d(t(x1), t(w1), padding=1),
+         TF.conv1d(torch.tensor(x1), torch.tensor(w1), padding=1),
+         rtol=1e-3, atol=1e-4)
+    x2 = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    w2 = RNG.randn(5, 3, 3, 3).astype(np.float32)
+    _cmp(F.conv2d(t(x2), t(w2), stride=2, padding=1),
+         TF.conv2d(torch.tensor(x2), torch.tensor(w2), stride=2,
+                   padding=1), rtol=1e-3, atol=1e-4)
+    x3 = RNG.randn(1, 2, 5, 6, 6).astype(np.float32)
+    w3 = RNG.randn(3, 2, 2, 2, 2).astype(np.float32)
+    _cmp(F.conv3d(t(x3), t(w3)),
+         TF.conv3d(torch.tensor(x3), torch.tensor(w3)),
+         rtol=1e-3, atol=1e-4)
+
+
+def test_pool_family_matches_torch():
+    x = RNG.randn(2, 3, 12).astype(np.float32)
+    _cmp(F.avg_pool1d(t(x), 3), TF.avg_pool1d(torch.tensor(x), 3))
+    _cmp(F.adaptive_avg_pool1d(t(x), 4),
+         TF.adaptive_avg_pool1d(torch.tensor(x), 4))
+    x2 = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    _cmp(F.adaptive_avg_pool2d(t(x2), 2),
+         TF.adaptive_avg_pool2d(torch.tensor(x2), 2))
+    got = F.adaptive_max_pool2d(t(x2), 2)
+    _cmp(got, TF.adaptive_max_pool2d(torch.tensor(x2), 2))
+    v, idx = F.max_pool1d_with_index(t(x), 2)
+    tv, ti = TF.max_pool1d(torch.tensor(x), 2, return_indices=True)
+    _cmp(v, tv)
+    np.testing.assert_array_equal(np.asarray(idx.numpy()), ti.numpy())
+    # unpool round-trips the pooled values back to their argmax slots
+    got = F.max_unpool1d(v, idx, 2)
+    want = TF.max_unpool1d(tv, ti, 2)
+    _cmp(got, want)
+    x3 = RNG.randn(1, 2, 4, 4, 4).astype(np.float32)
+    v3, i3 = TF.max_pool3d(torch.tensor(x3), 2, return_indices=True)
+    pv3, pi3 = F.max_pool3d(t(x3), 2, return_mask=True)
+    got3 = F.max_unpool3d(pv3, pi3, 2)
+    _cmp(got3, TF.max_unpool3d(v3, i3, 2))
+
+
+def test_norm_family_matches_torch():
+    x = RNG.randn(3, 4, 5).astype(np.float32)
+    _cmp(F.layer_norm(t(x), normalized_shape=[5]),
+         TF.layer_norm(torch.tensor(x), [5]), rtol=1e-3, atol=1e-4)
+    _cmp(F.normalize(t(x)), TF.normalize(torch.tensor(x)), rtol=1e-4)
+    x4 = RNG.randn(2, 3, 6, 6).astype(np.float32)
+    rm = np.zeros(3, np.float32)
+    rv = np.ones(3, np.float32)
+    _cmp(F.batch_norm(t(x4), t(rm), t(rv), training=False),
+         TF.batch_norm(torch.tensor(x4), torch.tensor(rm),
+                       torch.tensor(rv)), rtol=1e-3, atol=1e-4)
+    _cmp(F.instance_norm(t(x4)), TF.instance_norm(torch.tensor(x4)),
+         rtol=1e-3, atol=1e-4)
+    _cmp(F.local_response_norm(t(x4), size=3),
+         TF.local_response_norm(torch.tensor(x4), 3), rtol=1e-3,
+         atol=1e-4)
+    # rms_norm vs manual formula
+    w = RNG.rand(5).astype(np.float32) + 0.5
+    got = np.asarray(F.rms_norm(t(x), t(w), epsilon=1e-6).numpy())
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_misc_functionals():
+    x = RNG.randn(2, 4, 6).astype(np.float32)
+    w = RNG.randn(6, 3).astype(np.float32)
+    b = RNG.randn(3).astype(np.float32)
+    _cmp(F.linear(t(x), t(w), t(b)),
+         torch.tensor(x) @ torch.tensor(w) + torch.tensor(b),
+         rtol=1e-4, atol=1e-4)
+    a = RNG.randn(3, 8).astype(np.float32)
+    c = RNG.randn(3, 8).astype(np.float32)
+    _cmp(F.cosine_similarity(t(a), t(c)),
+         TF.cosine_similarity(torch.tensor(a), torch.tensor(c)),
+         rtol=1e-4)
+    x4 = RNG.randn(1, 4, 3, 3).astype(np.float32)
+    _cmp(F.pixel_shuffle(t(x4), 2),
+         TF.pixel_shuffle(torch.tensor(x4), 2))
+    up = RNG.randn(1, 2, 4, 4).astype(np.float32)
+    _cmp(F.upsample(t(up), scale_factor=2),
+         TF.interpolate(torch.tensor(up), scale_factor=2), rtol=1e-4)
+    # unfold_channels: paddle's F.unfold (im2col)
+    ix = RNG.randn(1, 2, 5, 5).astype(np.float32)
+    _cmp(F.unfold_channels(t(ix), 3) if hasattr(F, "unfold_channels")
+         else F.unfold(t(ix), 3),
+         TF.unfold(torch.tensor(ix), 3), rtol=1e-4)
+    got = np.asarray(F.unfold_channels(t(ix), 3).numpy())
+    np.testing.assert_allclose(got, TF.unfold(torch.tensor(ix), 3).numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_variants_train_eval():
+    x = np.ones((4, 3, 6, 6), np.float32)
+    paddle.seed(0)
+    for fn, arg in ((F.dropout2d, t(x)), (F.dropout3d, t(x[..., None])),
+                    (F.alpha_dropout, t(x))):
+        out_eval = np.asarray(fn(arg, training=False).numpy())
+        np.testing.assert_allclose(out_eval, np.asarray(arg.numpy()))
+        out_train = np.asarray(fn(arg, p=0.5, training=True).numpy())
+        assert out_train.shape == np.asarray(arg.numpy()).shape
+        assert not np.allclose(out_train, np.asarray(arg.numpy()))

@@ -598,6 +598,47 @@ CASES += [
            rtol=1e-4, atol=1e-5, name="multi_dot"),
 ]
 
+# round-3 op tranche (VERDICT item 7)
+def _np_pdist(x):
+    n = x.shape[0]
+    out = []
+    for i in range(n):
+        for j in range(i + 1, n):
+            out.append(np.sqrt(((x[i] - x[j]) ** 2).sum()))
+    return np.asarray(out, x.dtype)
+
+
+def _np_fill_diag_tensor(x, y):
+    out = x.copy()
+    np.fill_diagonal(out, y)
+    return out
+
+
+CASES += [
+    OpCase("gammaln", _mk(x=lambda: randpos(3, 4, lo=0.5, hi=3.0)),
+           grad=True, rtol=1e-4, atol=1e-4),
+    OpCase("histogram_bin_edges", _mk(x=lambda: randn(20)),
+           kwargs={"bins": 8, "min": -2.0, "max": 2.0},
+           ref=lambda x: np.histogram_bin_edges(x, bins=8, range=(-2, 2))
+           .astype(np.float32)),
+    OpCase("pdist", _mk(x=lambda: randn(5, 3)), ref=_np_pdist,
+           grad=False, rtol=1e-4, atol=1e-5),
+    OpCase("reduce_as", _mk(x=lambda: randn(3, 4),
+                            target=lambda: randn(1, 4)),
+           ref=lambda x, target: x.sum(0, keepdims=True),
+           rtol=1e-4, atol=1e-5),
+    OpCase("linalg.vecdot", _mk(x=lambda: randn(3, 4),
+                                y=lambda: randn(3, 4)),
+           ref=lambda x, y: (x * y).sum(-1), grad=True,
+           rtol=1e-4, atol=1e-5, name="vecdot"),
+    OpCase("as_strided", _mk(x=lambda: randn(12)),
+           kwargs={"shape": [3, 4], "stride": [4, 1]},
+           ref=lambda x: x.reshape(3, 4)),
+    OpCase("fill_diagonal_tensor",
+           _mk(x=lambda: randn(4, 4), y=lambda: randn(4)),
+           ref=_np_fill_diag_tensor),
+]
+
 # random / stateful creation: value checks are meaningless; check shape+range
 RANDOM_OPS = {
     "rand": lambda: paddle.rand([3, 4]),
@@ -615,6 +656,13 @@ RANDOM_OPS = {
     "exponential_": lambda: paddle.exponential_(paddle.ones([3, 4])),
     "empty": lambda: paddle.empty([2, 2]),
     "empty_like": lambda: paddle.empty_like(paddle.ones([2, 2])),
+    "binomial": lambda: paddle.binomial(paddle.full([3, 4], 10.0),
+                                        paddle.full([3, 4], 0.5)),
+    "standard_gamma": lambda: paddle.standard_gamma(paddle.full([3, 4], 2.0)),
+    "log_normal": lambda: paddle.log_normal(0.0, 1.0, [3, 4]),
+    "top_p_sampling": lambda: paddle.tensor.top_p_sampling(
+        paddle.to_tensor(np.full((2, 8), 0.125, np.float32)),
+        paddle.to_tensor(np.full((2,), 0.9, np.float32)))[1],
 }
 
 CASES += [
@@ -895,6 +943,12 @@ EXEMPT = {
     "squeeze_": "in-place alias of squeeze",
     "unsqueeze_": "in-place alias of unsqueeze",
     "igamma": "alias of gammainc", "igammac": "alias of gammaincc",
+    "polar": "complex output; covered by test_polar_complex (CPU)",
+    "svd_lowrank": "randomized algorithm; smoke-tested in "
+                   "test_op_surface_r3.py",
+    "fill_diagonal_": "in-place; same kernel as fill_diagonal_tensor",
+    "fill_diagonal_tensor_": "in-place alias of fill_diagonal_tensor",
+    "jax_silu": "internal helper of fused_swiglu (which is tested)",
 }
 
 
@@ -916,31 +970,55 @@ def test_random_op(name):
     np.testing.assert_array_equal(arr, again, err_msg=f"{name}: not seeded")
 
 
+# Modules whose ops are exercised by their own dedicated suites: an op
+# there is covered iff its NAME literally appears in one of the listed
+# test files (a real, greppable gate — renaming or adding an op without
+# touching its suite fails test_coverage).
+SUITE_COVERED = {
+    "functional": ["test_nn.py", "test_nn_extras.py", "test_models.py",
+                   "test_io_vision.py", "test_text_audio_autograd.py",
+                   "test_fft_signal_vision_ops.py", "test_vision_zoo2.py",
+                   "test_review_fixes.py", "test_ops_numeric.py",
+                   "test_functional_ops.py"],
+    "fft": ["test_fft_signal_vision_ops.py", "test_op_surface_r3.py"],
+    "signal": ["test_fft_signal_vision_ops.py"],
+    "sparse": ["test_sparse_quant.py", "test_op_surface_r3.py"],
+    "geometric": ["test_geometric.py"],
+    "fused": ["test_fused_multi_transformer.py", "test_nn_extras.py",
+              "test_ops_numeric.py", "test_models.py",
+              "test_op_surface_r3.py"],
+}
+
+
+def _suite_text(files):
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    return "\n".join(open(os.path.join(here, f)).read() for f in files)
+
+
 def test_coverage():
-    """Every public op defined in paddle_tpu/ops/* has an OpCase, a random-op
-    check, or an explicit exemption (the reference's every-op-has-an-OpTest
-    policy)."""
-    import inspect
-    import paddle_tpu.ops.math as m_math
-    import paddle_tpu.ops.manipulation as m_manip
-    import paddle_tpu.ops.logic as m_logic
-    import paddle_tpu.ops.creation as m_creation
-    import paddle_tpu.ops.linalg as m_linalg
+    """Every op in the schema registry has an OpCase, a random-op check,
+    an explicit exemption, or (for suite-covered modules) appears by name
+    in its dedicated test suite (the reference's every-op-has-an-OpTest
+    policy, extended across the whole registry)."""
+    import re
+    from paddle_tpu.ops.schema import build_registry
 
     covered = {c.name for c in CASES} | set(RANDOM_OPS) | set(EXEMPT)
+    suite_cache = {k: _suite_text(v) for k, v in SUITE_COVERED.items()}
     missing = []
-    for mod in (m_math, m_manip, m_logic, m_creation, m_linalg):
-        for name, obj in vars(mod).items():
-            if name.startswith("_") or not callable(obj):
-                continue
-            if inspect.ismodule(obj) or inspect.isclass(obj):
-                continue
-            owner = getattr(obj, "__module__", "")
-            if not (owner == mod.__name__
-                    or owner == "paddle_tpu.autograd.tape"):
-                continue   # re-imported helper, not an op definition
-            if name not in covered:
-                missing.append(f"{mod.__name__.split('.')[-1]}.{name}")
+    for name, spec in build_registry().items():
+        mods = (spec.module,) + spec.aliases
+        ok = name in covered
+        for m in mods:
+            if ok:
+                break
+            if m in suite_cache:
+                ok = re.search(rf"\b{re.escape(name)}\b",
+                               suite_cache[m]) is not None
+        if not ok:
+            missing.append(f"{spec.module}.{name}")
     assert not missing, (
-        f"{len(missing)} ops lack OpTest coverage (add an OpCase or an "
-        f"EXEMPT reason): {sorted(missing)}")
+        f"{len(missing)} ops lack OpTest coverage (add an OpCase, an "
+        f"EXEMPT reason, or exercise it in its module suite): "
+        f"{sorted(missing)}")

@@ -32,6 +32,24 @@ while true; do
       echo ']}'
     } > "$OUT.tmp" && mv "$OUT.tmp" "$OUT"
     echo "[tpu_watch] done; results in $OUT" >> "$LOG"
+    # MFU sweep toward the 40% north star (VERDICT round-2 item 2):
+    # 1B-class llama over batch/seq/remat; each line records the mfu aux
+    SWEEP=/root/repo/BENCH_SWEEP_R3.jsonl
+    : > "$SWEEP"
+    for cfg in \
+      "BENCH_PRESET=1b BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_REMAT=1" \
+      "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1" \
+      "BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=1" \
+      "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=4096 BENCH_REMAT=1" \
+      "BENCH_BATCH=16 BENCH_SEQ=2048" \
+      "BENCH_BATCH=32 BENCH_SEQ=1024" ; do
+      line=$(env $cfg BENCH_MODEL=llama BENCH_PROBE_TIMEOUT=150 \
+             timeout 4800 python bench.py 2>>"$LOG" | tail -1)
+      [ -z "$line" ] && line='{"error": "bench run timed out or died"}'
+      echo "{\"config\": \"$cfg\", \"result\": $line}" >> "$SWEEP"
+      echo "[tpu_watch] sweep $cfg -> $line" >> "$LOG"
+    done
+    echo "[tpu_watch] sweep done -> $SWEEP" >> "$LOG"
     exit 0
   fi
   echo "[tpu_watch] probe failed $(date -u +%H:%M:%SZ); retry in 300s" >> "$LOG"
