@@ -377,13 +377,21 @@ class ContinuousServingEngine:
                     except queue.Empty:
                         pass
                 if not self._running and pending:
-                    # stop(): un-admitted rows fail fast; admitted rows
-                    # decode to completion (the base engine's contract —
-                    # in-flight work is finished, not discarded)
+                    # stop(): un-admitted rows fail fast — including any
+                    # already-admitted SIBLING rows of the same request
+                    # (finishing them would be wasted work: the caller
+                    # already got the error). Fully-admitted requests
+                    # decode to completion (the base engine's contract).
+                    dropped = {row.req for row in pending}
                     for row in pending:
                         row.req.error = RuntimeError("ServingEngine stopped")
                         row.req.done.set()
                     pending.clear()
+                    for i, r in enumerate(active):
+                        if r is not None and r.req in dropped:
+                            active[i] = None
+                            cache.free(i)
+                            free.append(i)
                 try:
                     if self._running:
                         self._admit(cache, free, active, pending)

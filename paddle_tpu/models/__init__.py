@@ -12,7 +12,8 @@ XLA's SPMD partitioner inserts the collectives (SURVEY.md §7.0).
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
                     LlamaPretrainingCriterion, LlamaForCausalLMPipe,
                     build_llama_pipe, llama3_8b, llama_tiny)
-from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt3_1p3b, gpt_tiny
+from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, GPTForCausalLMPipe,
+                  gpt3_1p3b, gpt_tiny)
 from .bert import (BertConfig, BertModel, BertForSequenceClassification,
                    BertForPretraining, ErnieConfig, ErnieModel,
                    ErnieForSequenceClassification, bert_base, bert_tiny)
@@ -23,7 +24,8 @@ __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
     "LlamaPretrainingCriterion", "LlamaForCausalLMPipe",
     "build_llama_pipe", "llama3_8b", "llama_tiny",
-    "GPTConfig", "GPTModel", "GPTForCausalLM", "gpt3_1p3b", "gpt_tiny",
+    "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTForCausalLMPipe",
+    "gpt3_1p3b", "gpt_tiny",
     "BertConfig", "BertModel", "BertForSequenceClassification",
     "BertForPretraining", "ErnieConfig", "ErnieModel",
     "ErnieForSequenceClassification", "bert_base", "bert_tiny",

@@ -185,3 +185,75 @@ class GPTForCausalLM(GenerationMixin, Layer):
             (r"linear2\.weight$", (mp, None)),
             (r".*", ()),
         ]
+
+
+# ---------------------------------------------------------------------------
+# pipeline-parallel model description (reference: PaddleNLP
+# ``GPTForCausalLMPipe`` — modeling_pp.py LayerDesc list with tied
+# embeddings via SharedLayerDesc; the Fleet hybrid benchmark model,
+# BASELINE.json configs[3]). Unlike Llama, GPT blocks are stochastic
+# (attention + residual dropout) — the PP engine threads per-
+# (microbatch, chunk) PRNG keys through the schedule for them.
+# ---------------------------------------------------------------------------
+
+class GPTWordEmbeddingPipe(Layer):
+    """Tied pair's minimal stage: ONLY the word embedding lives here, so
+    the head-side SharedLayerDesc instance carries no dead
+    position/dropout parameters (same shape as LlamaEmbeddingPipe)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.word_embeddings = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=Normal(0.0, config.initializer_range))
+
+    def forward(self, input_ids):
+        return self.word_embeddings(input_ids)
+
+
+class GPTPosDropPipe(Layer):
+    """Second embedding stage: learned positions + embedding dropout."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=Normal(0.0, config.initializer_range))
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, hidden):
+        from ..ops import creation as C
+        pos = C.arange(0, hidden.shape[1], dtype="int64")
+        return self.dropout(hidden + self.position_embeddings(pos))
+
+
+def _gpt_tied_head_forward(layer, hidden):
+    """logits = hidden @ E^T (same Parameter as the embedding stage)."""
+    return pmath.matmul(hidden, layer.word_embeddings.weight,
+                        transpose_y=True)
+
+
+def build_gpt_pipe(config, **pp_kwargs):
+    """``GPTForCausalLMPipe``: [word-embed (tied), pos+dropout, L pre-LN
+    blocks, final LayerNorm, tied head] as a PipelineLayer description
+    for the jitted SPMD engine."""
+    from ..distributed.fleet.meta_parallel.pp_layers import (
+        PipelineLayer, LayerDesc, SharedLayerDesc)
+
+    descs = [SharedLayerDesc("gpt_embed", GPTWordEmbeddingPipe, config,
+                             shared_weight_attr="word_embeddings"),
+             LayerDesc(GPTPosDropPipe, config)]
+    descs += [LayerDesc(GPTDecoderLayer, config)
+              for _ in range(config.num_hidden_layers)]
+    descs.append(LayerDesc(LayerNorm, config.hidden_size,
+                           config.layer_norm_epsilon))
+    descs.append(SharedLayerDesc("gpt_embed", GPTWordEmbeddingPipe, config,
+                                 forward_func=_gpt_tied_head_forward,
+                                 shared_weight_attr="word_embeddings"))
+    pp_kwargs.setdefault("loss_fn", LlamaPretrainingCriterion())
+    pipe = PipelineLayer(descs, **pp_kwargs)
+    pipe.config = config
+    return pipe
+
+
+GPTForCausalLMPipe = build_gpt_pipe

@@ -282,9 +282,10 @@ def lu_solve(b, lu_data, lu_pivots, trans="N", name=None):
 
 def vecdot(x, y, axis=-1, name=None):
     """paddle.linalg.vecdot — vector dot product along ``axis`` with
-    broadcasting over the remaining dims."""
+    broadcasting over the remaining dims (first argument conjugated for
+    complex inputs, the Array-API contract)."""
     def fn(a, b):
-        return (a * b).sum(axis=axis)
+        return (jnp.conj(a) * b).sum(axis=axis)
     return apply(fn, x, y, op_name="vecdot")
 
 

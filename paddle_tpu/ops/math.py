@@ -812,6 +812,9 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
     from ..framework import random as prandom
 
     def fn(probs, p_row):
+        if threshold is not None:
+            # reference threshold mode: tokens below it never sample
+            probs = jnp.where(probs >= threshold, probs, 0.0)
         sorted_p = jnp.sort(probs, axis=-1)[..., ::-1]
         csum = jnp.cumsum(sorted_p, axis=-1)
         # keep the smallest prefix with cumulative mass >= ps

@@ -157,6 +157,47 @@ def test_pipelined_module_dropout_matches_manual_derivation():
         mesh_mod.reset_mesh()
 
 
+def test_gpt_pipe_trains_with_dropout():
+    """GPTForCausalLMPipe (stochastic blocks: attention + residual
+    dropout) trains through the SPMD engine with key threading — the
+    config-4 model family (GPT dp x pp, BASELINE.json configs[3])."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (
+        PipelineParallel)
+    from paddle_tpu.framework.core import Tensor
+    from paddle_tpu.models import GPTForCausalLMPipe, gpt_tiny
+
+    mesh_mod.init_mesh({"dp": 4, "pp": 2})
+    try:
+        paddle.seed(9)
+        cfg = gpt_tiny(num_hidden_layers=2)
+        pipe = GPTForCausalLMPipe(cfg, num_stages=2)
+        pipe.train()
+        pp = PipelineParallel(pipe)
+        pp.accumulate_steps = 2
+        opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                     parameters=pipe.parameters())
+        rng = np.random.default_rng(3)
+        ids = Tensor(jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)),
+                                 jnp.int32))
+        labels = Tensor(jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32))
+        losses = [float(pp.train_batch([ids, labels], opt))
+                  for _ in range(10)]
+        assert pp._spmd and pp._needs_key
+        assert losses[-1] < losses[0], losses
+        # tied embedding: the two SharedLayerDesc instances hold ONE
+        # Parameter, and it appears exactly once in the edge params
+        # (position table shares the shape, hence identity-based check)
+        from paddle_tpu.models.gpt import GPTWordEmbeddingPipe
+        shared = [l.word_embeddings.weight for l in pipe.run_function
+                  if isinstance(l, GPTWordEmbeddingPipe)]
+        assert len(shared) == 2 and shared[0] is shared[1]
+        pm = pp._spmd
+        assert sum(1 for p in pm.edge_params if p is shared[0]) == 1
+    finally:
+        mesh_mod.reset_mesh()
+
+
 def test_train_batch_spmd_with_dropout_no_fallback():
     """PipelineParallel.train_batch keeps the SPMD engine (no eager
     fallback) for a dropout model, and training reduces the loss."""

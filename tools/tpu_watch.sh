@@ -45,7 +45,11 @@ while true; do
       "BENCH_BATCH=32 BENCH_SEQ=1024" ; do
       line=$(env $cfg BENCH_MODEL=llama BENCH_PROBE_TIMEOUT=150 \
              timeout 4800 python bench.py 2>>"$LOG" | tail -1)
-      [ -z "$line" ] && line='{"error": "bench run timed out or died"}'
+      # only splice verified-JSON into the sweep file — a timeout-kill
+      # mid-print or stray stdout must not poison every later parse
+      if ! printf '%s' "$line" | python -c 'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
+        line='{"error": "bench run produced no parseable JSON (timeout/kill?)"}'
+      fi
       echo "{\"config\": \"$cfg\", \"result\": $line}" >> "$SWEEP"
       echo "[tpu_watch] sweep $cfg -> $line" >> "$LOG"
     done
