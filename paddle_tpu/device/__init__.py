@@ -9,6 +9,12 @@ from ..framework.core import (  # noqa: F401
     set_device, get_device, current_place, device_count, Place, CPUPlace,
     TPUPlace, CUDAPlace, is_compiled_with_cuda, is_compiled_with_xpu,
 )
+from . import memory  # noqa: F401
+from .memory import (  # noqa: F401
+    memory_stats, memory_allocated, max_memory_allocated, memory_reserved,
+    reset_peak_memory_stats, empty_cache, memory_summary,
+    live_tensor_report,
+)
 
 
 def get_all_device_type():
@@ -102,29 +108,15 @@ class cuda:
     def synchronize(device=None):
         synchronize()
 
-    @staticmethod
-    def memory_allocated(device=None):
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return stats.get("bytes_in_use", 0)
-
-    @staticmethod
-    def max_memory_allocated(device=None):
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return stats.get("peak_bytes_in_use", 0)
-
-    @staticmethod
-    def max_memory_reserved(device=None):
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return stats.get("peak_bytes_in_use", 0)
-
-    @staticmethod
-    def memory_reserved(device=None):
-        stats = jax.local_devices()[0].memory_stats() or {}
-        return stats.get("bytes_limit", 0)
-
-    @staticmethod
-    def empty_cache():
-        pass
+    memory_allocated = staticmethod(memory.memory_allocated)
+    max_memory_allocated = staticmethod(memory.max_memory_allocated)
+    # PJRT has no reserved-pool concept; the limit is the honest analogue
+    max_memory_reserved = staticmethod(memory.memory_reserved)
+    memory_reserved = staticmethod(memory.memory_reserved)
+    empty_cache = staticmethod(memory.empty_cache)
+    memory_summary = staticmethod(memory.memory_summary)
+    reset_peak_memory_stats = staticmethod(memory.reset_peak_memory_stats)
+    memory_stats = staticmethod(memory.memory_stats)
 
     @staticmethod
     def get_device_properties(device=None):

@@ -1,0 +1,130 @@
+"""Device memory runtime (reference: ``paddle/fluid/memory`` /
+``phi/core/memory`` — the stats/allocator layer; SURVEY.md §2.1
+"Memory/allocators". On TPU the BFC allocator itself belongs to XLA
+(SURVEY §7.0), so the runtime surface here is the part users actually
+touch: per-device stats, live-buffer accounting, leak triage, and the
+torch/paddle-style summary — built on PJRT ``memory_stats()`` plus
+``jax.live_arrays()`` (real buffer-level introspection, not a facade).
+"""
+from __future__ import annotations
+
+import jax
+
+# reset_peak baselines per device index (XLA reports process-lifetime
+# peaks; paddle/torch semantics want peaks since the last reset — we
+# snapshot the lifetime peak at reset and report growth beyond it)
+_PEAK_BASE: dict = {}
+
+
+def _dev(device=None):
+    devs = jax.local_devices()
+    if device is None:
+        return devs[0]
+    if isinstance(device, int):
+        return devs[device]
+    if isinstance(device, str):          # "tpu:0" / "gpu:1" / "cpu"
+        _, _, idx = device.partition(":")
+        return devs[int(idx) if idx else 0]
+    return device
+
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT allocator stats (bytes_in_use, peak_bytes_in_use,
+    bytes_limit, num_allocs, ... — keys are backend-dependent)."""
+    return dict(_dev(device).memory_stats() or {})
+
+
+def memory_allocated(device=None) -> int:
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes in use since :func:`reset_peak_memory_stats` (or
+    process start). XLA only exposes the lifetime peak, so after a
+    reset this reports max(current, lifetime-peak growth)."""
+    d = _dev(device)
+    peak = int(memory_stats(d).get("peak_bytes_in_use", 0))
+    base = _PEAK_BASE.get(d.id)
+    if base is None:
+        return peak
+    # a lifetime peak above the reset snapshot must have happened after
+    # the reset; otherwise the best observable bound is current usage
+    return peak if peak > base else memory_allocated(d)
+
+
+def reset_peak_memory_stats(device=None) -> None:
+    d = _dev(device)
+    _PEAK_BASE[d.id] = int(memory_stats(d).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    return int(memory_stats(device).get("bytes_limit", 0))
+
+
+def empty_cache() -> None:
+    """No-op by design: XLA owns the device allocator and there is no
+    fragmentation-fighting pool to release (the CUDA idiom of calling
+    this per-N-steps must stay cheap). Use
+    :func:`clear_compile_caches` to deliberately drop compiled
+    executables (expensive: everything recompiles)."""
+
+
+def clear_compile_caches() -> None:
+    """Drop jit/compilation caches — reclaims host memory at the cost of
+    full recompilation on next dispatch."""
+    jax.clear_caches()
+
+
+# -- live-buffer accounting (leak triage) ------------------------------------
+
+def live_arrays(device=None):
+    """All live jax Arrays on ``device`` (or every local device)."""
+    arrs = jax.live_arrays()
+    if device is None:
+        return arrs
+    want = _dev(device)
+    out = []
+    for a in arrs:
+        try:
+            if want in a.devices():
+                out.append(a)
+        except RuntimeError:        # deleted/donated between list & query
+            pass
+    return out
+
+
+def live_tensor_report(device=None, top=20):
+    """Aggregate live buffers by (shape, dtype): count and total bytes,
+    largest first — the 'what is eating HBM' view."""
+    groups: dict = {}
+    for a in live_arrays(device):
+        try:
+            key = (tuple(a.shape), str(a.dtype))
+            nbytes = a.size * a.dtype.itemsize
+        except RuntimeError:
+            continue
+        cnt, tot = groups.get(key, (0, 0))
+        groups[key] = (cnt + 1, tot + nbytes)
+    rows = [{"shape": list(k[0]), "dtype": k[1], "count": c,
+             "total_bytes": t} for k, (c, t) in groups.items()]
+    rows.sort(key=lambda r: -r["total_bytes"])
+    return rows[:top]
+
+
+def memory_summary(device=None) -> str:
+    """Human-readable report (torch.cuda.memory_summary shape)."""
+    d = _dev(device)
+    st = memory_stats(d)
+    gib = 2.0 ** 30
+    lines = [
+        f"=== device memory summary: {d} ===",
+        f"in use       : {st.get('bytes_in_use', 0) / gib:8.3f} GiB",
+        f"lifetime peak: {st.get('peak_bytes_in_use', 0) / gib:8.3f} GiB",
+        f"limit        : {st.get('bytes_limit', 0) / gib:8.3f} GiB",
+        f"allocations  : {st.get('num_allocs', 'n/a')}",
+        "--- largest live buffer groups ---",
+    ]
+    for r in live_tensor_report(d, top=8):
+        lines.append(f"  {r['count']:4d} x {str(r['shape']):24s} "
+                     f"{r['dtype']:10s} {r['total_bytes'] / gib:8.4f} GiB")
+    return "\n".join(lines)

@@ -38,12 +38,66 @@ def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
     global _strategy
     _strategy = strategy or DistributedStrategy()
     init_parallel_env()
+    if getattr(_strategy, "auto_search", False):
+        _apply_auto_search(_strategy)
     degrees = _strategy.degrees()
     mesh_mod.init_mesh(degrees)
     set_hybrid_communicate_group(None)
     set_hybrid_communicate_group(HybridCommunicateGroup())
     _initialized[0] = True
     return
+
+
+def _apply_auto_search(strategy):
+    """strategy.auto_search: pick hybrid degrees with the cost-model
+    Tuner (reference: the rule-based auto-parallel tuner steering
+    strategy.auto) and install them as this job's hybrid_configs.
+    Explicitly-set degrees win — the tuner only fills an untouched
+    (all-1) hybrid config."""
+    import sys
+    import jax
+    if any(v > 1 for v in strategy.degrees().values()):
+        return                     # user already chose a layout
+    cfg = dict(strategy.auto_search_configs or {})
+    model = cfg.pop("model", None)
+    if model is None:
+        print("fleet.init: auto_search needs auto_search_configs['model'] "
+              "(a model config or ModelSpec); keeping dp-only", file=sys.stderr)
+        return
+    from ..auto_parallel.cost_model import ModelSpec, Tuner
+    n = len(jax.devices())
+    chip = cfg.pop("chip", None)
+    if chip is None:
+        plat = jax.devices()[0].device_kind.lower()
+        chip = next((k for k in ("v6e", "v5p", "v5e", "v4")
+                     if k in plat), "v5e")
+    seq_len = cfg.pop("seq_len", None)
+    global_batch = cfg.pop("global_batch", None)
+    if isinstance(model, ModelSpec):
+        import dataclasses
+        overrides = {}
+        if seq_len is not None:
+            overrides["seq_len"] = int(seq_len)
+        if global_batch is not None:
+            overrides["global_batch"] = int(global_batch)
+        spec = dataclasses.replace(model, **overrides) if overrides \
+            else model
+    else:
+        spec = ModelSpec.from_config(model, seq_len=seq_len,
+                                     global_batch=global_batch or n)
+    try:
+        plan = Tuner(chip=chip).tune(spec, n, top_k=1)[0]
+    except ValueError as e:
+        print(f"fleet.init: auto_search found no valid plan ({e}); "
+              f"keeping dp-only", file=sys.stderr)
+        return
+    # update ONLY the degree keys in place — the user's pp_configs /
+    # sharding settings etc. must survive the tuner
+    for k, v in plan.degrees.items():
+        strategy._hybrid_configs[f"{k}_degree"] = int(v)
+    print(f"fleet.init: auto_search chose {plan.degrees} "
+          f"(est {plan.step_time_s * 1e3:.2f} ms/step, "
+          f"{plan.mem_per_chip / 2**30:.2f} GiB/chip)", file=sys.stderr)
 
 
 def is_initialized():

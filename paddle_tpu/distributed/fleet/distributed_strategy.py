@@ -84,6 +84,13 @@ class DistributedStrategy:
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
         self.fuse_all_reduce_ops = True  # advisory on TPU (XLA fuses)
         self.nccl_comm_num = 1           # accepted, meaningless on ICI
+        # auto-parallel mesh search (reference: strategy.auto / the
+        # rule-based tuner): with auto_search=True and a model spec in
+        # auto_search_configs, fleet.init runs the cost-model Tuner over
+        # the available chips and installs the best plan's degrees
+        self.auto_search = False
+        self.auto_search_configs = {}    # model=<cfg>|ModelSpec fields,
+        #                                  seq_len, global_batch, chip
 
     # -- hybrid --------------------------------------------------------------
     @property

@@ -190,6 +190,8 @@ class Tensor:
         try:
             return np.asarray(jax.device_get(self._data))
         except RuntimeError as e:
+            if type(e).__name__ == "DonatedTensorError":
+                raise          # already the clear guard diagnostic
             if "deleted" in str(e).lower() or "donated" in str(e).lower():
                 # donation/aliasing misuse guard (SURVEY.md §5.2 TPU
                 # equivalent of StreamSafeCUDAAllocator's reuse guard)

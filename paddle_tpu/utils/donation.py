@@ -1,0 +1,126 @@
+"""Donation / aliasing misuse guards — the TPU analogue of the
+reference's memory sanitizers (SURVEY.md §5.2: where CUDA builds lean on
+compute-sanitizer/ASAN for use-after-free, the XLA equivalent failure
+class is *buffer donation*: a donated input's HBM is reused for outputs,
+and any later host access to the donated array is a use-after-free that
+jax reports as a bare "Array has been deleted").
+
+Two guards:
+
+* :func:`donated_jit` — ``jax.jit`` + ``donate_argnums`` wrapper for
+  Tensor-level training steps. After each call the donated Tensors'
+  storage is replaced by a poison object, so ANY later use raises
+  :class:`DonatedTensorError` naming the argument and the fix (rebind
+  the returned arrays), instead of a deep-in-XLA deletion error.
+* :func:`find_aliases` / :func:`assert_no_aliases` — detect distinct
+  Parameters/Tensors silently sharing one backing buffer (unintended
+  weight tying — the aliasing half of the sanitizer row; deliberate
+  ties like tied embeddings can be allowlisted).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.core import Tensor
+
+
+class DonatedTensorError(RuntimeError):
+    pass
+
+
+class _PoisonedStorage:
+    """Stand-in for a donated Tensor's array: every use raises a clear
+    diagnostic instead of XLA's 'Array has been deleted'."""
+
+    __slots__ = ("_msg",)
+
+    def __init__(self, msg):
+        object.__setattr__(self, "_msg", msg)
+
+    def _raise(self, *a, **k):
+        raise DonatedTensorError(object.__getattribute__(self, "_msg"))
+
+    def __getattr__(self, name):
+        self._raise()
+
+    __array__ = __iter__ = __len__ = __bool__ = _raise
+    __add__ = __radd__ = __mul__ = __rmul__ = __sub__ = __rsub__ = _raise
+    __matmul__ = __getitem__ = __neg__ = _raise
+
+    def __repr__(self):
+        return f"<donated tensor: {object.__getattribute__(self, '_msg')}>"
+
+
+def donated_jit(fn, donate_argnums=(), **jit_kwargs):
+    """jit ``fn`` with buffer donation over Tensor arguments, poisoning
+    each donated Tensor after the call.
+
+    ``fn`` receives/returns raw arrays (the usual functional train-step
+    shape); the wrapper accepts Tensors or arrays at the donated
+    positions. Typical use::
+
+        step = donated_jit(train_step, donate_argnums=(0,))
+        new_params = step(params_tensor_list, batch)   # params poisoned
+    """
+    donate = tuple(donate_argnums)
+    jitted = jax.jit(fn, donate_argnums=donate, **jit_kwargs)
+
+    def unwrap(x):
+        return x._data if isinstance(x, Tensor) else x
+
+    def call(*args, **kwargs):
+        is_t = lambda t: isinstance(t, Tensor)     # noqa: E731
+        raw = [jax.tree.map(unwrap, a, is_leaf=is_t) for a in args]
+        raw_kw = {k: jax.tree.map(unwrap, v, is_leaf=is_t)
+                  for k, v in kwargs.items()}
+        out = jitted(*raw, **raw_kw)
+        for i in donate:
+            msg = (f"argument {i} of {getattr(fn, '__name__', 'fn')} was "
+                   f"DONATED to XLA (its HBM now backs the outputs); "
+                   f"rebind the returned arrays instead of reusing it")
+
+            def poison(t):
+                if isinstance(t, Tensor):
+                    t._data = _PoisonedStorage(msg)
+                return t
+            jax.tree.map(poison, args[i],
+                         is_leaf=lambda t: isinstance(t, Tensor))
+        return out
+
+    return call
+
+
+def find_aliases(tensors, names=None):
+    """Group distinct Tensor objects that share one backing jax.Array.
+    Returns a list of groups (each a list of names/indices, len >= 2)."""
+    by_buf = {}
+    for i, t in enumerate(tensors):
+        if not isinstance(t, Tensor) or isinstance(t._data,
+                                                   _PoisonedStorage):
+            continue
+        key = id(t._data)
+        label = names[i] if names is not None else i
+        by_buf.setdefault(key, []).append(label)
+    return [g for g in by_buf.values() if len(g) > 1]
+
+
+def assert_no_aliases(layer_or_tensors, allow=()):
+    """Raise if two distinct Parameters share a buffer (unintended weight
+    tying). ``allow``: name-substring allowlist for deliberate ties
+    (e.g. ``("embed",)`` for tied embeddings)."""
+    if hasattr(layer_or_tensors, "named_parameters"):
+        named = [(n, p) for n, p in layer_or_tensors.named_parameters()
+                 if p is not None]
+        names = [n for n, _ in named]
+        tensors = [p for _, p in named]
+    else:
+        tensors = list(layer_or_tensors)
+        names = list(range(len(tensors)))
+    groups = find_aliases(tensors, names)
+    bad = [g for g in groups
+           if not any(any(str(a) in str(n) for a in allow) for n in g)]
+    if bad:
+        raise AssertionError(
+            f"distinct parameters share one buffer (unintended aliasing / "
+            f"weight tying): {bad}; pass allow=(...) for deliberate ties")
+    return groups

@@ -64,3 +64,45 @@ def test_breakdown_fields():
     p = Tuner(chip="v5p").tune(_8b(), 16)[0]
     assert {"compute_s", "tp_s", "dp_s", "bubble"} <= set(p.breakdown)
     assert p.step_time_s >= p.breakdown["compute_s"] > 0
+
+
+def test_fleet_auto_search_installs_tuned_degrees():
+    """strategy.auto_search wires the cost-model Tuner into fleet.init
+    (VERDICT.md round-2 §2.3 'tuner not wired to fleet defaults'): the
+    chosen plan's degrees become the job's hybrid config/mesh."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.models import llama3_8b
+
+    strat = dist.fleet.DistributedStrategy()
+    strat.auto_search = True
+    strat.auto_search_configs = {"model": llama3_8b(), "seq_len": 4096,
+                                 "global_batch": 8, "chip": "v5p"}
+    dist.fleet.init(is_collective=True, strategy=strat)
+    try:
+        d = strat.degrees()
+        # an 8B model on 8 chips cannot be plain dp: the tuner must have
+        # chosen real model sharding, and the mesh must match it
+        assert any(d[k] > 1 for k in ("mp", "pp", "sharding", "sep")), d
+        mesh = mesh_mod.get_mesh()
+        for k, v in d.items():
+            assert int(mesh.shape[k]) == v
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_fleet_auto_search_respects_explicit_degrees():
+    """User-set degrees always win over the tuner."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.models import llama3_8b
+
+    strat = dist.fleet.DistributedStrategy()
+    strat.auto_search = True
+    strat.auto_search_configs = {"model": llama3_8b(), "chip": "v5p"}
+    strat.hybrid_configs = {"dp_degree": 4, "mp_degree": 2}
+    dist.fleet.init(is_collective=True, strategy=strat)
+    try:
+        assert strat.degrees()["dp"] == 4 and strat.degrees()["mp"] == 2
+    finally:
+        mesh_mod.reset_mesh()

@@ -1,0 +1,67 @@
+"""Donation / aliasing misuse guards (SURVEY.md §5.2 — the TPU
+equivalent of the reference's memory sanitizers; VERDICT.md round-2 §5.2
+row: 'no donation/aliasing-misuse guard')."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.utils.donation import (DonatedTensorError, assert_no_aliases,
+                                       donated_jit, find_aliases)
+
+
+def test_donated_jit_poisons_inputs():
+    import jax.numpy as jnp
+    p = [paddle.to_tensor(np.ones((4, 4), np.float32)),
+         paddle.to_tensor(np.full((4,), 2.0, np.float32))]
+
+    def step(arrs, x):
+        w, b = arrs
+        y = x @ w + b
+        return [w - 0.1, b - 0.1], y.sum()
+
+    step_j = donated_jit(step, donate_argnums=(0,))
+    x = jnp.ones((2, 4), jnp.float32)
+    new_arrs, loss = step_j(p, x)
+    assert float(loss) == 2 * 4 * (4 + 2)     # 8 entries of value 6
+    # the donated Tensors now raise a CLEAR error on any use
+    with pytest.raises(DonatedTensorError, match="DONATED"):
+        p[0].numpy()
+    with pytest.raises(DonatedTensorError, match="rebind"):
+        _ = p[1] + 1.0
+    # rebinding the returned arrays is the documented fix
+    p2 = [paddle.to_tensor(np.asarray(a)) for a in new_arrs]
+    np.testing.assert_allclose(np.asarray(p2[0].numpy()),
+                               np.full((4, 4), 0.9, np.float32))
+
+
+def test_find_and_assert_aliases():
+    a = paddle.to_tensor(np.zeros(3, np.float32))
+    b = paddle.to_tensor(np.zeros(3, np.float32))
+    c = paddle.Tensor(a._data)            # aliases a's buffer
+    groups = find_aliases([a, b, c], names=["a", "b", "c"])
+    assert groups == [["a", "c"]]
+    with pytest.raises(AssertionError, match="aliasing"):
+        assert_no_aliases([a, b, c])
+
+
+def test_assert_no_aliases_on_layers():
+    lin = nn.Linear(4, 4)
+    assert assert_no_aliases(lin) == []   # clean model: no groups
+
+    class Tied(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(8, 4)
+            self.head = nn.Linear(4, 8, bias_attr=False)
+            # two DISTINCT Parameter objects, one backing buffer — the
+            # accidental-aliasing shape named_parameters' identity memo
+            # cannot dedupe (a same-object tie is deduped there and is
+            # not an aliasing hazard)
+            self.head.weight._data = self.embed.weight._data
+
+    tied = Tied()
+    with pytest.raises(AssertionError):
+        assert_no_aliases(tied)
+    groups = assert_no_aliases(tied, allow=("embed",))
+    assert len(groups) == 1               # reported but allowed
