@@ -54,7 +54,8 @@ class Engine:
                   for a, s in zip(p_arrs, p_sh)]
         b_arrs = fm.buffer_arrays()      # frozen for the engine's step
         self._state = {"fm": fm, "p": p_arrs, "m": m_arrs, "v": v_arrs,
-                       "t": 0, "mesh": mesh, "p_sh": p_sh, "b": b_arrs}
+                       "t": 0, "mesh": mesh, "p_sh": p_sh, "b": b_arrs,
+                       "mode": mode}
         b1, b2, eps = 0.9, 0.999, 1e-8
 
         def step(p_arrs, m_arrs, v_arrs, t, key, x, y):
@@ -82,20 +83,45 @@ class Engine:
         self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
         return self
 
+    def _data_axes(self):
+        mesh = self._state["mesh"]
+        return tuple(a for a in ("dp", "sharding") if a in mesh.shape
+                     and mesh.shape[a] > 1)
+
+    def _data_sharding(self):
+        """Shard batch dim over the mesh's data axes when present (the
+        completion pass's input annotation in the reference)."""
+        axes = self._data_axes()
+        return NamedSharding(self._state["mesh"], P(axes if axes else None))
+
+    def _put_batch(self, x, y):
+        mesh = self._state["mesh"]
+        axes = self._data_axes()
+        div = int(np.prod([mesh.shape[a] for a in axes])) if axes else 1
+        sh = self._data_sharding()
+        xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        # both operands must divide the data axes; a ragged one falls
+        # back to replicated rather than crashing mid-epoch
+        if xa.shape[0] % div == 0 and ya.shape[0] % div == 0:
+            xa = jax.device_put(xa, sh)
+            ya = jax.device_put(ya, sh)
+        return xa, ya
+
     def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
             valid_data=None, log_freq=10, verbose=0):
         from ...io import DataLoader
-        if self._step_fn is None:
-            self.prepare()
+        if self._step_fn is None or self._state.get("mode") != "train":
+            # a step compiled by evaluate() ran with training=False
+            # (dropout/BN off) — training must rebuild it
+            self.prepare(mode="train")
         st = self._state
         loader = train_data if isinstance(train_data, DataLoader) \
             else DataLoader(train_data, batch_size=batch_size or 8)
         history = []
         for epoch in range(epochs):
             for i, batch in enumerate(loader):
-                x, y = batch[0], batch[1]
-                xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-                ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+                xa, ya = self._put_batch(batch[0], batch[1])
                 key = st["fm"].next_key()
                 loss, st["p"], st["m"], st["v"], st["t"] = self._step_fn(
                     st["p"], st["m"], st["v"], st["t"], key, xa, ya)
@@ -104,9 +130,93 @@ class Engine:
                     print(f"epoch {epoch} step {i} loss {history[-1]:.4f}")
                 if steps_per_epoch and i + 1 >= steps_per_epoch:
                     break
+            if valid_data is not None:
+                ev = self.evaluate(valid_data, batch_size=batch_size)
+                if verbose:
+                    print(f"epoch {epoch} eval_loss {ev['loss']:.4f}")
         # write trained params back into the eager model
         self._sync_back()
         return history
+
+    def evaluate(self, eval_data, batch_size=None, steps=None):
+        """Mean loss over ``eval_data`` with the current sharded params
+        (reference ``Engine.evaluate``)."""
+        from ...io import DataLoader
+        if self._step_fn is None:
+            self.prepare(mode="eval")
+        st = self._state
+
+        if "eval_fn" not in st:
+            fm_eval = FunctionalModule(self.model, training=False)
+            loss_layer = self.loss
+            b_arrs = st["b"]
+
+            def eval_step(p_arrs, key, x, y):
+                out, _ = fm_eval(p_arrs, b_arrs, key, x)
+                if loss_layer is not None:
+                    lo = loss_layer(Tensor(out), Tensor(y))
+                    return lo._data if isinstance(lo, Tensor) else lo
+                return out.mean()
+            st["eval_fn"] = jax.jit(eval_step)
+        loader = eval_data if isinstance(eval_data, DataLoader) \
+            else DataLoader(eval_data, batch_size=batch_size or 8)
+        losses = []
+        for i, batch in enumerate(loader):
+            xa, ya = self._put_batch(batch[0], batch[1])
+            losses.append(float(st["eval_fn"](st["p"], st["fm"].next_key(),
+                                              xa, ya)))
+            if steps and i + 1 >= steps:
+                break
+        return {"loss": float(np.mean(losses)) if losses else float("nan")}
+
+    def cost(self, seq_len=None, global_batch=None, chip=None):
+        """Tuner-estimated step time/memory for the CURRENT mesh degrees
+        (reference ``Engine.cost``): the analytic cost model scores the
+        layout the engine will compile."""
+        from .cost_model import CostModel, ModelSpec
+        cfg = getattr(self.model, "config", None)
+        if cfg is None:
+            raise ValueError("Engine.cost needs a model with .config "
+                             "(transformer shape)")
+        mesh = mesh_mod.get_mesh()
+        degrees = {a: int(mesh.shape[a]) if a in mesh.shape else 1
+                   for a in ("dp", "pp", "sharding", "sep", "mp")}
+        if chip is None:
+            plat = jax.devices()[0].device_kind.lower()
+            chip = next((k for k in ("v6e", "v5p", "v5e", "v4")
+                         if k in plat), "v5e")
+        spec = ModelSpec.from_config(cfg, seq_len=seq_len,
+                                     global_batch=global_batch or 8)
+        cm = CostModel(chip=chip)
+        t, breakdown = cm.step_time(spec, degrees)
+        return {"step_time_s": t, "mem_per_chip": cm.memory_per_chip(
+            spec, degrees), "degrees": degrees, **breakdown}
+
+    def save(self, path):
+        """Persist the engine's (sharded) parameters + optimizer state."""
+        st = self._state
+        if st is None:
+            raise RuntimeError("call prepare() first")
+        np.savez(path, t=st["t"],
+                 **{f"p_{i}": np.asarray(a) for i, a in enumerate(st["p"])},
+                 **{f"m_{i}": np.asarray(a) for i, a in enumerate(st["m"])},
+                 **{f"v_{i}": np.asarray(a) for i, a in enumerate(st["v"])})
+
+    def load(self, path):
+        if self._step_fn is None:
+            self.prepare()
+        st = self._state
+        data = np.load(path if str(path).endswith(".npz") else f"{path}.npz")
+        n = len(st["p"])
+        st["p"] = [jax.device_put(data[f"p_{i}"], s)
+                   for i, s in zip(range(n), st["p_sh"])]
+        st["m"] = [jax.device_put(data[f"m_{i}"], s)
+                   for i, s in zip(range(n), st["p_sh"])]
+        st["v"] = [jax.device_put(data[f"v_{i}"], s)
+                   for i, s in zip(range(n), st["p_sh"])]
+        st["t"] = int(data["t"])
+        self._sync_back()
+        return self
 
     def _sync_back(self):
         st = self._state

@@ -143,12 +143,17 @@ class PipelineSchedulerPass(PassBase):
 
 @register_pass("fuse_all_reduce")
 class FuseAllReducePass(PassBase):
-    """XLA built-in (collective combining); kept for API parity."""
+    """Collective combining — realized by XLA; applying the pass pins
+    the responsible compiler flags into the plan so
+    ``install_xla_flags`` can arm them explicitly."""
 
     def apply(self, plan, *a, **kw):
+        plan.setdefault("xla_flags", []).extend([
+            "--xla_tpu_enable_async_collective_fusion=true",
+            "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+        ])
         plan.setdefault("notes", []).append(
-            "fuse_all_reduce: XLA combines collectives automatically "
-            "(--xla_tpu_enable_async_collective_fusion)")
+            "fuse_all_reduce: XLA collective combining (flags pinned)")
         return plan
 
 
@@ -241,12 +246,15 @@ class FuseGemmEpiloguePass(PassBase):
 
 @register_pass("allreduce_matmul_grad_overlapping")
 class AllreduceOverlapPass(PassBase):
-    """XLA built-in (async collectives overlap compute); API parity."""
+    """Grad-collective/compute overlap — realized by XLA's latency-hiding
+    scheduler; applying the pass pins the flag into the plan."""
 
     def apply(self, plan, *a, **kw):
+        plan.setdefault("xla_flags", []).append(
+            "--xla_tpu_enable_latency_hiding_scheduler=true")
         plan.setdefault("notes", []).append(
             "allreduce overlap: XLA latency-hiding scheduler overlaps "
-            "grad collectives with the backward matmuls")
+            "grad collectives with the backward matmuls (flag pinned)")
         return plan
 
 
@@ -295,6 +303,49 @@ def build_strategy_from_plan(plan):
         strat.gradient_merge = True
         strat.gradient_merge_configs = dict(plan["gradient_merge"])
     return strat
+
+
+def install_xla_flags(plan, env=None, platform=None):
+    """Arm the plan's pinned XLA compiler flags (collective fusion,
+    latency-hiding scheduler, ...) in ``env`` — the executable half of
+    the XLA-builtin passes. TPU-only flags are only installed when the
+    backend is a TPU (XLA rejects unknown flags at init), and flags must
+    be set BEFORE the first backend initialization to take effect in
+    this process (they always apply to spawned children).
+
+    Returns the list of flags installed."""
+    import os
+    flags = list(dict.fromkeys(plan.get("xla_flags", [])))  # dedup, ordered
+    if not flags:
+        return []
+    if platform is None:
+        # Must not call jax.default_backend() here: that would perform
+        # the very backend initialization the flags need to precede,
+        # rendering them inert for this process. Probe initialized
+        # state / env only.
+        try:
+            from jax._src import xla_bridge as xb
+            initialized = bool(getattr(xb, "backends_are_initialized",
+                                       lambda: getattr(xb, "_backends",
+                                                       None))())
+        except Exception:
+            initialized = False
+        if initialized:
+            import jax
+            platform = jax.default_backend()
+        else:
+            envs = (os.environ.get("JAX_PLATFORMS", "")
+                    + os.environ.get("PJRT_DEVICE", "")).lower()
+            platform = "tpu" if ("tpu" in envs or "axon" in envs
+                                 or os.environ.get("PALLAS_AXON_POOL_IPS")
+                                 ) else "unknown"
+    if platform != "tpu":
+        return []            # tpu-only flags would crash other backends
+    env = os.environ if env is None else env
+    current = env.get("XLA_FLAGS", "").split()
+    merged = current + [f for f in flags if f not in current]
+    env["XLA_FLAGS"] = " ".join(merged)
+    return [f for f in flags if f not in current]
 
 
 def apply_plan_to_config(plan, model_config):

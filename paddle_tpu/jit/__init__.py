@@ -76,8 +76,13 @@ def save(layer, path, input_spec=None, **configs):
                     t._data = a
 
         jitted = jax.jit(infer_fn)
-        exported = jexport.export(jitted)(
-            [p._data for p in params], [b._data for b in bufs], *example)
+        # canonicalize state to host-backed single-device arrays: params
+        # trained under a multi-device mesh carry shardings, and tracing
+        # with them bakes an N-device requirement into the export (the
+        # loaded artifact must run on a single chip)
+        p_ex = [jnp.asarray(jax.device_get(p._data)) for p in params]
+        b_ex = [jnp.asarray(jax.device_get(b._data)) for b in bufs]
+        exported = jexport.export(jitted)(p_ex, b_ex, *example)
         blob = exported.serialize()
         d = os.path.dirname(path)
         if d:
@@ -90,7 +95,8 @@ def save(layer, path, input_spec=None, **configs):
             "param_keys": [k for k, _ in layer.state_dict().items()],
             "n_params": len(params),
             "n_bufs": len(bufs),
-            "input_specs": [(s.shape, np.dtype(s.dtype or np.float32).name)
+            "input_specs": [(s.shape, np.dtype(s.dtype or np.float32).name,
+                             getattr(s, "name", None))
                             for s in specs],
         }
         with open(path + SUFFIX_META, "wb") as f:

@@ -71,6 +71,23 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None, return_numpy=True):
         outs = []
+        # a loaded inference Program executes its compiled StableHLO
+        compiled = getattr(program, "_compiled", None)
+        if compiled is not None:
+            feed = feed or {}
+            names = getattr(program, "_feed_names", list(feed))
+            missing = [n for n in names if n not in feed]
+            extra = [k for k in feed if k not in names]
+            if missing or extra:
+                raise KeyError(
+                    f"Executor.run feed mismatch: program expects "
+                    f"{names}, missing={missing}, unknown={extra} — "
+                    f"positional fallback would silently reorder inputs")
+            args = [feed[n] for n in names]
+            out = compiled(*args)
+            flat = out if isinstance(out, (list, tuple)) else [out]
+            return [o.numpy() if return_numpy and isinstance(o, Tensor)
+                    else o for o in flat]
         for f in (fetch_list or []):
             if callable(f):
                 out = f(**(feed or {}))
@@ -101,14 +118,46 @@ class ExecutionStrategy:
         self.num_threads = 1
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
-    raise NotImplementedError(
-        "static save_inference_model: use paddle.jit.save (StableHLO export)")
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         **kwargs):
+    """Static-mode export bridged to the StableHLO path (reference:
+    ``static.save_inference_model`` → Program serialization; here the
+    IR IS StableHLO, so this wraps :func:`paddle.jit.save`).
+
+    ``feed_vars``: InputSpecs (from :func:`static.data`) or Tensors;
+    ``fetch_vars``: a Layer or callable producing the fetch outputs."""
+    from .. import jit as pjit
+
+    target = fetch_vars[0] if isinstance(fetch_vars, (list, tuple)) \
+        and len(fetch_vars) == 1 else fetch_vars
+    if not callable(target):
+        raise TypeError(
+            "save_inference_model needs fetch_vars to be (or contain) the "
+            "Layer/callable that computes the fetches; a bare fetched "
+            "Tensor has no captured graph in this build — pass the model")
+    specs = [v if isinstance(v, InputSpec) else InputSpec.from_tensor(v)
+             for v in (feed_vars if isinstance(feed_vars, (list, tuple))
+                       else [feed_vars])]
+    pjit.save(target, path_prefix, input_spec=specs)
+    return path_prefix
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError(
-        "static load_inference_model: use paddle.jit.load")
+    """Load a static export: returns ``(program, feed_names,
+    fetch_names)`` where ``program`` is runnable via ``Executor.run``
+    (it is also directly callable)."""
+    from .. import jit as pjit
+
+    layer = pjit.load(path_prefix)
+    meta = getattr(layer, "_meta", {}) or {}
+    specs = meta.get("input_specs") or []
+    # meta entries are (shape, dtype[, name]); older exports lack names
+    feed_names = [(s[2] if len(s) > 2 and s[2] else f"feed_{i}")
+                  for i, s in enumerate(specs)]
+    prog = Program()
+    prog._compiled = layer
+    prog._feed_names = feed_names
+    return prog, feed_names, ["fetch_0"]
 
 
 def name_scope(prefix=None):

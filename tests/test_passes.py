@@ -153,3 +153,27 @@ def test_plan_executes_into_strategy_and_training():
     finally:
         from paddle_tpu.distributed import mesh as mesh_mod
         mesh_mod.reset_mesh()
+
+
+def test_xla_builtin_passes_pin_flags_and_install():
+    """The XLA-builtin passes are no longer note-only: applying them pins
+    concrete compiler flags, and install_xla_flags arms them (TPU only —
+    other backends would reject unknown flags)."""
+    from paddle_tpu.distributed.passes import install_xla_flags, new_pass
+
+    plan = {}
+    new_pass("fuse_all_reduce").apply(plan)
+    new_pass("allreduce_matmul_grad_overlapping").apply(plan)
+    assert any("async_collective_fusion" in f for f in plan["xla_flags"])
+    assert any("latency_hiding_scheduler" in f for f in plan["xla_flags"])
+
+    env = {"XLA_FLAGS": "--existing=1"}
+    added = install_xla_flags(plan, env=env, platform="tpu")
+    assert added and all(a in env["XLA_FLAGS"] for a in added)
+    assert env["XLA_FLAGS"].startswith("--existing=1")
+    # idempotent: a second install adds nothing
+    assert install_xla_flags(plan, env=env, platform="tpu") == []
+    # non-TPU backends: never touched
+    env2 = {}
+    assert install_xla_flags(plan, env=env2, platform="cpu") == []
+    assert "XLA_FLAGS" not in env2

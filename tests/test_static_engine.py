@@ -1,0 +1,74 @@
+"""Auto-parallel Engine (reference ``auto_parallel/static/engine.py``:
+fit/evaluate/predict/save/load/cost — VERDICT.md round-2 §2.3 'static
+Engine remains thin')."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.auto_parallel import Engine
+from paddle_tpu.io import TensorDataset
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(8, 32)
+        self.l2 = nn.Linear(32, 1)
+
+    def forward(self, x):
+        return self.l2(paddle.tanh(self.l1(x)))
+
+
+def _data(n=64):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 8).astype(np.float32)
+    W = rng.randn(8, 1).astype(np.float32)
+    Y = (X @ W).astype(np.float32)
+    return TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+
+
+def test_engine_fit_evaluate_predict_roundtrip(tmp_path):
+    mesh_mod.init_mesh({"dp": 8})
+    try:
+        paddle.seed(0)
+        model = _MLP()
+        eng = Engine(model=model, loss=nn.MSELoss(),
+                     optimizer=paddle.optimizer.Adam(
+                         learning_rate=0.01, parameters=model.parameters()))
+        eng.prepare()
+        ds = _data()
+        hist = eng.fit(ds, epochs=6, batch_size=16)
+        assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])
+        ev = eng.evaluate(ds, batch_size=16)
+        assert ev["loss"] == pytest.approx(hist[-1], rel=1.0)
+        assert ev["loss"] < hist[0]
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        out = eng.predict(x)
+        assert tuple(out.shape) == (4, 1)
+
+        # save -> perturb -> load restores the trained state exactly
+        path = str(tmp_path / "engine_ckpt.npz")
+        eng.save(path)
+        before = np.asarray(eng._state["p"][0])
+        eng._state["p"] = [a * 0 for a in eng._state["p"]]
+        eng.load(path)
+        np.testing.assert_array_equal(np.asarray(eng._state["p"][0]), before)
+        ev2 = eng.evaluate(ds, batch_size=16, steps=2)
+        assert np.isfinite(ev2["loss"])
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_engine_cost_reports_current_mesh():
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    mesh_mod.init_mesh({"dp": 4, "mp": 2})
+    try:
+        eng = Engine(model=LlamaForCausalLM(llama_tiny()))
+        c = eng.cost(seq_len=128, global_batch=8, chip="v5e")
+        assert c["degrees"]["dp"] == 4 and c["degrees"]["mp"] == 2
+        assert c["step_time_s"] > 0 and c["mem_per_chip"] > 0
+        assert "compute_s" in c
+    finally:
+        mesh_mod.reset_mesh()

@@ -1,0 +1,25 @@
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def test_save_load_inference_model_roundtrip(tmp_path):
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 2))
+    model.eval()
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    want = np.asarray(model(paddle.to_tensor(x)).numpy())
+
+    exe = static.Executor()
+    spec = static.InputSpec([3, 6], "float32", "x")  # static batch (jax.export)
+    path = str(tmp_path / "inf_model")
+    static.save_inference_model(path, [spec], [model], exe)
+
+    prog, feed_names, fetch_names = static.load_inference_model(path, exe)
+    assert feed_names == ["x"]           # spec names survive the export
+    (got,) = exe.run(prog, feed={"x": x})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # misnamed feeds fail loudly instead of silently reordering
+    import pytest
+    with pytest.raises(KeyError, match="feed mismatch"):
+        exe.run(prog, feed={"wrong": x})
