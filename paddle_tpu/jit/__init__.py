@@ -133,7 +133,8 @@ def _save_function(fn, path, input_spec):
     fio.save({}, path + SUFFIX_PARAMS)
     meta = {"param_names": [], "param_keys": [], "n_params": 0, "n_bufs": 0,
             "is_function": True,
-            "input_specs": [(s.shape, np.dtype(s.dtype or np.float32).name)
+            "input_specs": [(s.shape, np.dtype(s.dtype or np.float32).name,
+                             getattr(s, "name", None))
                             for s in specs]}
     with open(path + SUFFIX_META, "wb") as f:
         pickle.dump(meta, f)

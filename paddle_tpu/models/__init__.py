@@ -12,6 +12,8 @@ XLA's SPMD partitioner inserts the collectives (SURVEY.md §7.0).
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
                     LlamaPretrainingCriterion, LlamaForCausalLMPipe,
                     build_llama_pipe, llama3_8b, llama_tiny)
+from .t5 import (T5Config, T5ForConditionalGeneration,  # noqa: F401
+                 t5_tiny)
 from .gpt import (GPTConfig, GPTModel, GPTForCausalLM, GPTForCausalLMPipe,
                   gpt3_1p3b, gpt_tiny)
 from .bert import (BertConfig, BertModel, BertForSequenceClassification,
@@ -24,6 +26,7 @@ __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
     "LlamaPretrainingCriterion", "LlamaForCausalLMPipe",
     "build_llama_pipe", "llama3_8b", "llama_tiny",
+    "T5Config", "T5ForConditionalGeneration", "t5_tiny",
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTForCausalLMPipe",
     "gpt3_1p3b", "gpt_tiny",
     "BertConfig", "BertModel", "BertForSequenceClassification",

@@ -239,3 +239,93 @@ def load_bert_from_hf(model, model_dir, dtype="float32"):
     _check_fully_mapped(own, mapped, "BERT", optional=("pooler.",))
     model.set_state_dict(mapped)
     return model
+
+
+def t5_config_from_hf(model_dir, **overrides):
+    from .t5 import T5Config
+    cfg = load_hf_config(model_dir)
+    fields = dict(
+        vocab_size=cfg.get("vocab_size", 32128),
+        d_model=cfg.get("d_model", 512),
+        d_kv=cfg.get("d_kv", 64),
+        d_ff=cfg.get("d_ff", 2048),
+        num_layers=cfg.get("num_layers", 6),
+        num_decoder_layers=cfg.get("num_decoder_layers"),
+        num_heads=cfg.get("num_heads", 8),
+        relative_attention_num_buckets=cfg.get(
+            "relative_attention_num_buckets", 32),
+        relative_attention_max_distance=cfg.get(
+            "relative_attention_max_distance", 128),
+        dropout_rate=cfg.get("dropout_rate", 0.1),
+        layer_norm_epsilon=cfg.get("layer_norm_epsilon", 1e-6),
+        feed_forward_proj=cfg.get("feed_forward_proj", "relu"),
+        pad_token_id=cfg.get("pad_token_id", 0),
+        decoder_start_token_id=cfg.get("decoder_start_token_id", 0),
+        eos_token_id=cfg.get("eos_token_id", 1),
+        tie_word_embeddings=cfg.get("tie_word_embeddings", True),
+    )
+    fields.update(overrides)
+    return T5Config(**fields)
+
+
+def load_t5_from_hf(model, model_dir, dtype="float32"):
+    """Fill a ``T5ForConditionalGeneration`` from an HF T5 checkpoint
+    dir. HF layout: encoder/decoder ``block.N.layer.K`` where K=0 is
+    self-attention, the decoder's K=1 is cross-attention (EncDecAttention)
+    and the last K is DenseReluDense; all Linears are [out, in] →
+    transposed to this framework's [in, out]."""
+    raw = _read_hf_weights(model_dir)
+    own = model.state_dict()
+    mapped = {}
+    for name, arr in raw.items():
+        n = name
+        if n in ("shared.weight", "encoder.embed_tokens.weight",
+                 "decoder.embed_tokens.weight", "lm_head.weight"):
+            if n == "lm_head.weight" and "lm_head.weight" in own:
+                # untied checkpoint (T5 v1.1 / Flan): independent head,
+                # torch Linear [out, in] -> transpose
+                mapped["lm_head.weight"] = arr.T.astype(dtype)
+                continue
+            if n != "shared.weight":
+                continue              # tied copies of the same table
+            mapped["shared.weight"] = arr.astype(dtype)
+            continue
+        tgt = n
+        for stack, dec in (("encoder.", False), ("decoder.", True)):
+            if not n.startswith(stack + "block."):
+                continue
+            parts = n.split(".")       # stack, block, N, layer, K, ...
+            bi, k = parts[2], int(parts[4])
+            rest = ".".join(parts[5:])
+            ff_k = 2 if dec else 1
+            if k == 0:                 # self-attention sub-layer
+                rest = rest.replace("SelfAttention.", "self_attn.") \
+                           .replace("layer_norm.", "norm1.")
+            elif dec and k == 1:       # cross-attention sub-layer
+                rest = rest.replace("EncDecAttention.", "cross_attn.") \
+                           .replace("layer_norm.", "norm_cross.")
+            elif k == ff_k:            # feed-forward sub-layer
+                rest = rest.replace("DenseReluDense.wi_0.", "ff.wi.") \
+                           .replace("DenseReluDense.wi_1.", "ff.wi_1.") \
+                           .replace("DenseReluDense.wi.", "ff.wi.") \
+                           .replace("DenseReluDense.wo.", "ff.wo.") \
+                           .replace("layer_norm.", "norm2.")
+            tgt = f"{stack}blocks.{bi}.{rest}"
+        tgt = tgt.replace("encoder.final_layer_norm.",
+                          "encoder.final_norm.") \
+                 .replace("decoder.final_layer_norm.",
+                          "decoder.final_norm.")
+        if tgt not in own:
+            continue
+        # torch Linear [out, in] -> [in, out]; embeddings pass through
+        if arr.ndim == 2 and "relative_attention_bias" not in tgt \
+                and tgt != "shared.weight":
+            arr = arr.T
+        want = tuple(own[tgt].shape)
+        if tuple(arr.shape) != want:
+            raise ValueError(f"shape mismatch for {tgt}: checkpoint "
+                             f"{arr.shape} vs model {want}")
+        mapped[tgt] = arr.astype(dtype)
+    _check_fully_mapped(own, mapped, "T5")
+    model.set_state_dict(mapped)
+    return model

@@ -1,0 +1,337 @@
+"""T5-style encoder-decoder family (reference behavior: PaddleNLP
+``transformers/t5/modeling.py`` — relative-position-bias attention,
+pre-RMSNorm blocks, gated/ReLU FFN, tied embedding, encoder-decoder
+``generate``; the zoos are separate repos per SURVEY.md §2.4, so this is
+the in-repo equivalent, same TPU-first shape as ``llama.py``).
+
+TPU-first notes: the relative-position bias is a static [heads, S, S]
+tensor computed from bucketized distances (one gather, added to logits
+before softmax — XLA folds it into the attention fusion); decode reuses
+the shared :class:`KVCache` for decoder self-attention while the
+encoder states are computed once and closed over.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Linear, Embedding, Dropout
+from ..nn.layers.norm import RMSNorm
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..ops import math as pmath
+from ..autograd.tape import apply, no_grad
+from ..framework.core import Tensor
+from .llama import LlamaPretrainingCriterion
+from .generation import KVCache
+
+
+class T5Config:
+    def __init__(self, vocab_size=32128, d_model=512, d_kv=64, d_ff=2048,
+                 num_layers=6, num_decoder_layers=None, num_heads=8,
+                 relative_attention_num_buckets=32,
+                 relative_attention_max_distance=128, dropout_rate=0.1,
+                 layer_norm_epsilon=1e-6, feed_forward_proj="relu",
+                 initializer_factor=1.0, pad_token_id=0,
+                 decoder_start_token_id=0, eos_token_id=1,
+                 tie_word_embeddings=True, **kw):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.d_kv = d_kv
+        self.d_ff = d_ff
+        self.num_layers = num_layers
+        self.num_decoder_layers = num_decoder_layers or num_layers
+        self.num_heads = num_heads
+        self.relative_attention_num_buckets = relative_attention_num_buckets
+        self.relative_attention_max_distance = relative_attention_max_distance
+        self.dropout_rate = dropout_rate
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.feed_forward_proj = feed_forward_proj
+        self.initializer_factor = initializer_factor
+        self.pad_token_id = pad_token_id
+        self.decoder_start_token_id = decoder_start_token_id
+        self.eos_token_id = eos_token_id
+        self.tie_word_embeddings = tie_word_embeddings
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def t5_tiny(**kw):
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("d_kv", 16)
+    kw.setdefault("d_ff", 128)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    return T5Config(**kw)
+
+
+def _relative_bucket(rel, bidirectional, num_buckets, max_dist):
+    """numpy bucketization (static shapes → computed once per length)."""
+    rel = np.asarray(rel)
+    if bidirectional:
+        num_buckets //= 2
+        base = (rel > 0).astype(np.int64) * num_buckets
+        rel = np.abs(rel)
+    else:
+        base = np.zeros_like(rel)
+        rel = -np.minimum(rel, 0)
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    large = max_exact + (
+        np.log(np.maximum(rel, 1) / max_exact)
+        / np.log(max_dist / max_exact) * (num_buckets - max_exact)
+    ).astype(np.int64)
+    large = np.minimum(large, num_buckets - 1)
+    return base + np.where(is_small, rel, large)
+
+
+class T5Attention(Layer):
+    def __init__(self, config, is_decoder, has_relative_bias=False,
+                 is_cross=False):
+        super().__init__()
+        cfg = config
+        self.cfg = cfg
+        self.is_decoder = is_decoder
+        self.is_cross = is_cross
+        inner = cfg.num_heads * cfg.d_kv
+        init = Normal(0.0, cfg.initializer_factor * (cfg.d_model ** -0.5))
+        self.q = Linear(cfg.d_model, inner, weight_attr=init, bias_attr=False)
+        self.k = Linear(cfg.d_model, inner, weight_attr=init, bias_attr=False)
+        self.v = Linear(cfg.d_model, inner, weight_attr=init, bias_attr=False)
+        self.o = Linear(inner, cfg.d_model, weight_attr=init,
+                        bias_attr=False)
+        self.has_relative_bias = has_relative_bias
+        if has_relative_bias:
+            self.relative_attention_bias = Embedding(
+                cfg.relative_attention_num_buckets, cfg.num_heads,
+                weight_attr=init)
+
+    def _bias(self, q_len, k_len, q_offset=0):
+        """[1, heads, q_len, k_len] relative position bias."""
+        ctx = np.arange(q_len)[:, None] + q_offset
+        mem = np.arange(k_len)[None, :]
+        buckets = _relative_bucket(
+            mem - ctx, bidirectional=not self.is_decoder,
+            num_buckets=self.cfg.relative_attention_num_buckets,
+            max_dist=self.cfg.relative_attention_max_distance)
+        emb = self.relative_attention_bias(
+            Tensor(jnp.asarray(buckets)))            # [q, k, heads]
+        return emb.transpose([2, 0, 1]).unsqueeze(0)
+
+    def forward(self, hidden, kv_source=None, bias=None, cache=None):
+        cfg = self.cfg
+        b, s, _ = hidden.shape
+        src = hidden if kv_source is None else kv_source
+        q = self.q(hidden).reshape([b, s, cfg.num_heads, cfg.d_kv])
+        if self.is_cross and cache is not None:
+            # encoder states are fixed across decode: project K/V once
+            store = getattr(cache, "_cross", None)
+            if store is None:
+                store = cache._cross = {}
+            if id(self) not in store:
+                store[id(self)] = (
+                    self.k(src).reshape([b, src.shape[1], cfg.num_heads,
+                                         cfg.d_kv]).detach(),
+                    self.v(src).reshape([b, src.shape[1], cfg.num_heads,
+                                         cfg.d_kv]).detach())
+            k, v = store[id(self)]
+        else:
+            k = self.k(src).reshape([b, src.shape[1], cfg.num_heads,
+                                     cfg.d_kv])
+            v = self.v(src).reshape([b, src.shape[1], cfg.num_heads,
+                                     cfg.d_kv])
+        if cache is not None and not self.is_cross:
+            k, v = cache.update(self, k, v)          # decoder self-attn
+        # T5 applies NO 1/sqrt(d) scaling (folded into init); logits get
+        # the additive relative bias before softmax
+        def fn(qa, ka, va, *rest):
+            lg = jnp.einsum("bqhd,bkhd->bhqk", qa, ka)
+            if rest:
+                lg = lg + rest[0]
+            if self.is_decoder and not self.is_cross:
+                ql, kl = qa.shape[1], ka.shape[1]
+                qi = jnp.arange(ql)[:, None] + (kl - ql)
+                ki = jnp.arange(kl)[None, :]
+                lg = jnp.where(qi[None, None] >= ki[None, None], lg, -1e30)
+            w = jnp.exp(lg - jnp.max(lg, -1, keepdims=True))
+            w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-30)
+            return jnp.einsum("bhqk,bkhd->bqhd", w, va)
+        args = (q, k, v) + ((bias,) if bias is not None else ())
+        out = apply(fn, *args, op_name="t5_attention")
+        return self.o(out.reshape([b, s, cfg.num_heads * cfg.d_kv]))
+
+
+class T5FF(Layer):
+    def __init__(self, config):
+        super().__init__()
+        cfg = config
+        init = Normal(0.0, cfg.initializer_factor * (cfg.d_model ** -0.5))
+        self.gated = cfg.feed_forward_proj.startswith("gated")
+        self.wi = Linear(cfg.d_model, cfg.d_ff, weight_attr=init,
+                         bias_attr=False)
+        if self.gated:
+            self.wi_1 = Linear(cfg.d_model, cfg.d_ff, weight_attr=init,
+                               bias_attr=False)
+        self.wo = Linear(cfg.d_ff, cfg.d_model, weight_attr=init,
+                         bias_attr=False)
+        self.dropout = Dropout(cfg.dropout_rate)
+
+    def forward(self, x):
+        h = self.wi(x)
+        # gated variant uses gelu_new (tanh approximation), the HF
+        # 'gated-gelu' activation — exact gelu drifts ~1e-3
+        h = F.gelu(h, approximate=True) * self.wi_1(x) if self.gated \
+            else F.relu(h)
+        return self.wo(self.dropout(h))
+
+
+class T5Block(Layer):
+    def __init__(self, config, is_decoder, has_relative_bias):
+        super().__init__()
+        cfg = config
+        self.is_decoder = is_decoder
+        self.norm1 = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon)
+        self.self_attn = T5Attention(cfg, is_decoder, has_relative_bias)
+        if is_decoder:
+            self.norm_cross = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon)
+            self.cross_attn = T5Attention(cfg, is_decoder, is_cross=True)
+        self.norm2 = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon)
+        self.ff = T5FF(cfg)
+        self.dropout = Dropout(cfg.dropout_rate)
+
+    def forward(self, x, enc=None, bias=None, cache=None):
+        x = x + self.dropout(self.self_attn(self.norm1(x), bias=bias,
+                                            cache=cache))
+        if self.is_decoder and enc is not None:
+            x = x + self.dropout(self.cross_attn(self.norm_cross(x),
+                                                 kv_source=enc))
+        return x + self.dropout(self.ff(self.norm2(x)))
+
+
+class T5Stack(Layer):
+    def __init__(self, config, is_decoder):
+        super().__init__()
+        cfg = config
+        self.cfg = cfg
+        self.is_decoder = is_decoder
+        n = cfg.num_decoder_layers if is_decoder else cfg.num_layers
+        # T5 shares ONE relative bias table per stack (layer 0 owns it)
+        self.blocks = LayerList([
+            T5Block(cfg, is_decoder, has_relative_bias=(i == 0))
+            for i in range(n)])
+        self.final_norm = RMSNorm(cfg.d_model, cfg.layer_norm_epsilon)
+        self.dropout = Dropout(cfg.dropout_rate)
+
+    def forward(self, hidden, enc=None, cache=None):
+        s = hidden.shape[1]
+        q_off = cache.pos if (cache is not None and self.is_decoder) else 0
+        k_len = s + q_off
+        bias = self.blocks[0].self_attn._bias(s, k_len, q_offset=q_off)
+        hidden = self.dropout(hidden)
+        for blk in self.blocks:
+            hidden = blk(hidden, enc=enc, bias=bias, cache=cache)
+        if cache is not None and self.is_decoder:
+            cache.advance(s)
+        return self.final_norm(hidden)
+
+
+class T5ForConditionalGeneration(Layer):
+    """Encoder-decoder LM with tied embedding (logits scaled by
+    d_model^-0.5, the T5 tie convention)."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        cfg = config
+        self.shared = Embedding(cfg.vocab_size, cfg.d_model,
+                                weight_attr=Normal(0.0,
+                                                   cfg.initializer_factor))
+        self.encoder = T5Stack(cfg, is_decoder=False)
+        self.decoder = T5Stack(cfg, is_decoder=True)
+        # T5 v1.1 / Flan style: an independent (untied, unscaled) head
+        self.lm_head = None if cfg.tie_word_embeddings else Linear(
+            cfg.d_model, cfg.vocab_size,
+            weight_attr=Normal(0.0, cfg.initializer_factor),
+            bias_attr=False)
+        self.criterion = LlamaPretrainingCriterion()
+
+    @classmethod
+    def from_pretrained(cls, model_dir, dtype="float32", **overrides):
+        """Build from a LOCAL HF-format T5 checkpoint directory
+        (zero-egress; see models/pretrained.py)."""
+        from .pretrained import t5_config_from_hf, load_t5_from_hf
+        cfg = t5_config_from_hf(model_dir, **overrides)
+        model = cls(cfg)
+        return load_t5_from_hf(model, model_dir, dtype=dtype)
+
+    def _shift_right(self, labels):
+        arr = labels._data if isinstance(labels, Tensor) else labels
+        start = jnp.full((arr.shape[0], 1), self.config.decoder_start_token_id,
+                         arr.dtype)
+        shifted = jnp.concatenate([start, arr[:, :-1]], axis=1)
+        # ignore_index positions (-100, the criterion's convention) must
+        # become valid decoder inputs (HF masks them to pad_token_id)
+        shifted = jnp.where(shifted == -100,
+                            jnp.asarray(self.config.pad_token_id,
+                                        shifted.dtype), shifted)
+        return Tensor(shifted)
+
+    def encode(self, input_ids):
+        return self.encoder(self.shared(input_ids))
+
+    def forward(self, input_ids, decoder_input_ids=None, labels=None,
+                encoder_outputs=None, cache=None):
+        if encoder_outputs is None:
+            encoder_outputs = self.encode(input_ids)
+        if decoder_input_ids is None:
+            if labels is None:
+                raise ValueError("need decoder_input_ids or labels")
+            decoder_input_ids = self._shift_right(labels)
+        dec = self.decoder(self.shared(decoder_input_ids),
+                           enc=encoder_outputs, cache=cache)
+        if self.lm_head is not None:       # untied head: no tie scaling
+            logits = self.lm_head(dec)
+        else:
+            logits = pmath.matmul(dec * (self.config.d_model ** -0.5),
+                                  self.shared.weight, transpose_y=True)
+        if labels is None:
+            return logits
+        return self.criterion(logits, labels), logits
+
+    @no_grad()
+    def generate(self, input_ids, max_new_tokens=32, eos_token_id=None):
+        """Greedy encoder-decoder decode with a decoder-side KV cache
+        (the encoder runs ONCE)."""
+        was_training = self.training
+        self.eval()
+        try:
+            ids = input_ids if isinstance(input_ids, Tensor) \
+                else Tensor(jnp.asarray(np.asarray(input_ids)))
+            eos = self.config.eos_token_id if eos_token_id is None \
+                else eos_token_id
+            enc = self.encode(ids)
+            b = ids.shape[0]
+            cache = KVCache()
+            cur = Tensor(jnp.full((b, 1), self.config.decoder_start_token_id,
+                                  jnp.int32))
+            out = cur._data
+            finished = jnp.zeros((b,), bool)
+            for _ in range(max_new_tokens):
+                logits = self.forward(None, decoder_input_ids=cur,
+                                      encoder_outputs=enc, cache=cache)
+                nxt = jnp.argmax(logits._data[:, -1].astype(jnp.float32),
+                                 axis=-1).astype(out.dtype)
+                if eos is not None:
+                    nxt = jnp.where(finished, jnp.asarray(eos, out.dtype),
+                                    nxt)
+                    finished = jnp.logical_or(finished, nxt == eos)
+                out = jnp.concatenate([out, nxt[:, None]], axis=1)
+                cur = Tensor(nxt[:, None])
+                if eos is not None and bool(finished.all()):
+                    break
+            return Tensor(out)
+        finally:
+            if was_training:
+                self.train()

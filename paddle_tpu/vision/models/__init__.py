@@ -17,3 +17,7 @@ from .extras import (  # noqa: F401
 from .inception import (  # noqa: F401
     GoogLeNet, googlenet, InceptionV3, inception_v3,
 )
+from .vit import (  # noqa: F401
+    VisionTransformer, vit_small_patch16_224, vit_base_patch16_224,
+    vit_large_patch16_224,
+)

@@ -1,0 +1,134 @@
+"""Vision Transformer (reference: PaddleClas ``ppcls/arch/backbone/
+model_zoo/vision_transformer.py`` — ViT-B/16 family; the zoos are
+separate repos per SURVEY.md §2.4, so the in-repo equivalent follows the
+paddle.vision.models convention).
+
+TPU-first notes: patch embedding is ONE conv (= a [P²·C, D] matmul on
+the MXU after im2col), the encoder is pre-LN blocks whose attention
+rides the shared ``F.scaled_dot_product_attention`` path (flash kernel
+on TPU), and all sequence lengths are static (196 + 1 cls token for
+224²/16) so the whole forward is a single fused XLA program.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn
+from ...nn.initializer import Normal, Constant, TruncatedNormal
+
+
+class _MLP(nn.Layer):
+    def __init__(self, dim, hidden, dropout=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, hidden)
+        self.fc2 = nn.Linear(hidden, dim)
+        self.act = nn.GELU()
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(self.act(self.fc1(x)))))
+
+
+class _Attention(nn.Layer):
+    def __init__(self, dim, num_heads, attn_dropout=0.0, dropout=0.0):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = nn.Linear(dim, dim * 3)
+        self.proj = nn.Linear(dim, dim)
+        self.attn_dropout = attn_dropout
+        self.drop = nn.Dropout(dropout)
+
+    def forward(self, x):
+        from ...nn import functional as F
+        b, s, d = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = (qkv[:, :, i] for i in range(3))   # [b, s, h, hd]
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=False, dropout_p=self.attn_dropout,
+            training=self.training)
+        return self.drop(self.proj(out.reshape([b, s, d])))
+
+
+class _Block(nn.Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, dropout=0.0,
+                 attn_dropout=0.0, epsilon=1e-6):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.attn = _Attention(dim, num_heads, attn_dropout, dropout)
+        self.norm2 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.mlp = _MLP(dim, int(dim * mlp_ratio), dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        return x + self.mlp(self.norm2(x))
+
+
+class VisionTransformer(nn.Layer):
+    """ViT backbone + classification head (PaddleClas signature subset)."""
+
+    def __init__(self, img_size=224, patch_size=16, in_channels=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, dropout=0.0, attn_dropout=0.0,
+                 epsilon=1e-6):
+        super().__init__()
+        self.num_classes = num_classes
+        self.embed_dim = embed_dim
+        n_patches = (img_size // patch_size) ** 2
+        self.patch_embed = nn.Conv2D(in_channels, embed_dim,
+                                     kernel_size=patch_size,
+                                     stride=patch_size)
+        init = TruncatedNormal(std=0.02)
+        self.cls_token = self.create_parameter(
+            [1, 1, embed_dim], attr=None, dtype="float32",
+            default_initializer=Constant(0.0))
+        self.pos_embed = self.create_parameter(
+            [1, n_patches + 1, embed_dim], attr=None, dtype="float32",
+            default_initializer=init)
+        self.pos_drop = nn.Dropout(dropout)
+        self.blocks = nn.LayerList([
+            _Block(embed_dim, num_heads, mlp_ratio, dropout, attn_dropout,
+                   epsilon) for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.head = nn.Linear(embed_dim, num_classes,
+                              weight_attr=Normal(0.0, 0.02)) \
+            if num_classes > 0 else None
+
+    def forward_features(self, x):
+        from ...ops import manipulation as manip
+        b = x.shape[0]
+        x = self.patch_embed(x)                       # [b, D, H/P, W/P]
+        x = x.flatten(2).transpose([0, 2, 1])         # [b, N, D]
+        cls = manip.expand(self.cls_token, [b, 1, self.embed_dim])
+        x = manip.concat([cls, x], axis=1) + self.pos_embed
+        x = self.pos_drop(x)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.norm(x)
+
+    def forward(self, x):
+        feats = self.forward_features(x)
+        if self.head is None:
+            return feats
+        return self.head(feats[:, 0])                 # cls token
+
+
+def vit_base_patch16_224(**kwargs):
+    kwargs.setdefault("embed_dim", 768)
+    kwargs.setdefault("depth", 12)
+    kwargs.setdefault("num_heads", 12)
+    return VisionTransformer(**kwargs)
+
+
+def vit_large_patch16_224(**kwargs):
+    kwargs.setdefault("embed_dim", 1024)
+    kwargs.setdefault("depth", 24)
+    kwargs.setdefault("num_heads", 16)
+    return VisionTransformer(**kwargs)
+
+
+def vit_small_patch16_224(**kwargs):
+    kwargs.setdefault("embed_dim", 384)
+    kwargs.setdefault("depth", 12)
+    kwargs.setdefault("num_heads", 6)
+    return VisionTransformer(**kwargs)

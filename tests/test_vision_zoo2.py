@@ -75,3 +75,58 @@ def test_resnext_variants():
         x = paddle.to_tensor(
             np.random.RandomState(0).rand(1, 3, 32, 32).astype("float32"))
         assert tuple(m(x).shape) == (1, 3)
+
+
+def test_vit_forward_train_and_overfit():
+    """ViT family (PaddleClas vision_transformer): cls-token head,
+    static sequence, trains to overfit a tiny batch."""
+    paddle.seed(0)
+    m = M.vit_small_patch16_224(img_size=32, patch_size=8, num_classes=3,
+                                depth=2, dropout=0.1)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(4, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.array([0, 1, 2, 0]))
+    m.eval()
+    out = m(x)
+    assert tuple(out.shape) == (4, 3)
+    # features: 1 cls + (32/8)^2 patches
+    feats = m.forward_features(x)
+    assert tuple(feats.shape) == (4, 17, 384)
+    # dropout is live in train mode (stochastic forward)
+    m.train()
+    a = np.asarray(m(x).numpy())
+    b = np.asarray(m(x).numpy())
+    assert not np.allclose(a, b)
+
+    # overfit check without dropout noise, on a learnable task (pure
+    # noise images barely separate through 2 blocks in a few steps):
+    # each class gets a distinct channel-mean signature
+    sig = np.zeros((3, 3, 1, 1), np.float32)
+    sig[0, 0] = 1.5
+    sig[1, 1] = 1.5
+    sig[2, 2] = 1.5
+    xs = rng.rand(4, 3, 32, 32).astype("float32") + sig[[0, 1, 2, 0]]
+    xc = paddle.to_tensor(xs)
+    m2 = M.vit_small_patch16_224(img_size=32, patch_size=8, num_classes=3,
+                                 depth=2)
+    m2.train()
+    opt = paddle.optimizer.AdamW(learning_rate=2e-3,
+                                 parameters=m2.parameters())
+    ce = paddle.nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(40):
+        loss = ce(m2(xc), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_vit_variants_constructable():
+    for mk, dim in ((M.vit_base_patch16_224, 768),
+                    (M.vit_large_patch16_224, 1024)):
+        m = mk(img_size=16, patch_size=16, num_classes=2, depth=1)
+        assert m.embed_dim == dim
+        x = paddle.to_tensor(np.zeros((1, 3, 16, 16), np.float32))
+        assert tuple(m(x).shape) == (1, 2)
