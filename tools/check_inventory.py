@@ -161,6 +161,35 @@ INVENTORY = [
       "fused_bias_dropout_residual_layer_norm"]),
     ("Text datasets (cache-gated)", "paddle_tpu.text",
      ["UCIHousing", "Imdb", "Imikolov"]),
+    # -- round 3 additions ---------------------------------------------------
+    ("Kernel compile guard (wedge-proof)", "paddle_tpu.utils.guarded_compile",
+     ["prove", "kernel_allowed", "CANARIES"]),
+    ("Ulysses all-to-all context parallel", "paddle_tpu.distributed.fleet.utils",
+     ["ulysses_attention", "UlyssesAttention"]),
+    ("Continuous-batching serving", "paddle_tpu.inference",
+     ["ContinuousServingEngine"]),
+    ("Slot-paged KV cache", "paddle_tpu.models.generation",
+     ["SlotPagedKVCache"]),
+    ("Donation/aliasing sanitizers", "paddle_tpu.utils.donation",
+     ["donated_jit", "assert_no_aliases"]),
+    ("Device memory runtime", "paddle_tpu.device.memory",
+     ["memory_stats", "live_tensor_report", "memory_summary"]),
+    ("Auto-search mesh tuner wiring", "paddle_tpu.distributed.fleet",
+     ["_apply_auto_search"]),
+    ("Auto-parallel Engine (fit/eval/cost)", "paddle_tpu.distributed.auto_parallel",
+     ["Engine"]),
+    ("Static inference IO (save/load_inference_model)", "paddle_tpu.static",
+     ["save_inference_model", "load_inference_model"]),
+    ("GPT pipeline model", "paddle_tpu.models",
+     ["GPTForCausalLMPipe"]),
+    ("T5 encoder-decoder family", "paddle_tpu.models",
+     ["T5ForConditionalGeneration", "T5Config", "t5_tiny"]),
+    ("ViT family", "paddle_tpu.vision.models",
+     ["VisionTransformer", "vit_base_patch16_224"]),
+    ("Sparse op breadth", "paddle_tpu.sparse",
+     ["tanh", "transpose", "coalesce", "mask_as", "addmm"]),
+    ("Hermitian FFT family", "paddle_tpu.fft",
+     ["hfft2", "ihfft2", "hfftn", "ihfftn"]),
 ]
 
 
