@@ -12,6 +12,7 @@ arrays held on the handle until copy_to_cpu().
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 import jax
@@ -42,6 +43,8 @@ class Config:
             self.prefix = p
         self._use_tpu = True
         self.mem_opt = True
+        self.ir_debug = False
+        self.profile = False
 
     # knobs kept for API compat (XLA supersedes them)
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
@@ -54,7 +57,25 @@ class Config:
         self.mem_opt = True
 
     def switch_ir_optim(self, flag=True):
-        pass
+        pass                        # XLA always optimizes
+
+    def switch_ir_debug(self, flag=True):
+        """Dump the loaded program's StableHLO text next to the model
+        (``<prefix>.hlo.txt``) — the IR-inspection knob made real."""
+        self.ir_debug = bool(flag)
+
+    def enable_profile(self):
+        """Collect per-run wall times; read via Predictor.get_profile()."""
+        self.profile = True
+
+    def set_optim_cache_dir(self, path):
+        """Persistent compilation cache (reference: the optimization
+        cache dir) — compiled executables survive process restarts."""
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # default min-compile-time threshold (1s) silently skips small
+        # models — the knob must persist everything it is asked to
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
     def enable_tensorrt_engine(self, *a, **kw):
         raise NotImplementedError(
@@ -62,7 +83,7 @@ class Config:
             "StableHLO (already fused)")
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        pass                        # XLA's host runtime sizes its own pool
 
 
 class _IOHandle:
@@ -92,9 +113,42 @@ class Predictor:
     def __init__(self, config: Config):
         from ..jit import load as jit_load
         self._layer = jit_load(config.prefix)
-        n_inputs = len(self._layer._meta.get("input_specs", [])) or 1
-        self._inputs = [_IOHandle(f"input_{i}") for i in range(n_inputs)]
+        specs = self._layer._meta.get("input_specs", [])
+        names = []
+        for i, s in enumerate(specs):
+            n = s[2] if len(s) > 2 and s[2] else f"input_{i}"
+            while n in names:            # spec names may collide with
+                n += "_"                 # positional fallbacks — dedupe
+            names.append(n)
+        self._inputs = [_IOHandle(n) for n in (names or ["input_0"])]
         self._outputs = []
+        self._profile = [] if getattr(config, "profile", False) else None
+        if getattr(config, "ir_debug", False):
+            # IR debug dump is best-effort diagnostics: an unwritable
+            # model dir must not take down predictor construction
+            try:
+                try:
+                    text = self._layer._exported.mlir_module()
+                except Exception:
+                    text = str(self._layer._exported)
+                with open(config.prefix + ".hlo.txt", "w") as f:
+                    f.write(text)
+            except OSError as e:
+                import warnings
+                warnings.warn(f"ir_debug: cannot write HLO dump next to "
+                              f"the model ({e})", RuntimeWarning)
+
+    def get_profile(self):
+        """Per-run wall times (s) collected under Config.enable_profile."""
+        if self._profile is None:
+            raise RuntimeError("call Config.enable_profile() before "
+                               "create_predictor")
+        t = np.asarray(self._profile)
+        return {"runs": len(t),
+                "total_s": float(t.sum()) if len(t) else 0.0,
+                "mean_s": float(t.mean()) if len(t) else 0.0,
+                "p50_s": float(np.percentile(t, 50)) if len(t) else 0.0,
+                "p99_s": float(np.percentile(t, 99)) if len(t) else 0.0}
 
     def get_input_names(self):
         return [h.name for h in self._inputs]
@@ -106,6 +160,7 @@ class Predictor:
         raise KeyError(name)
 
     def run(self, inputs=None):
+        t0 = time.perf_counter() if self._profile is not None else None
         if inputs is not None:          # list-of-arrays convenience form
             for h, a in zip(self._inputs, inputs):
                 h.copy_from_cpu(np.asarray(a))
@@ -117,6 +172,9 @@ class Predictor:
             h = _IOHandle(f"output_{i}")
             h._value = o._data if isinstance(o, Tensor) else o
             self._outputs.append(h)
+        if self._profile is not None:
+            jax.block_until_ready([h._value for h in self._outputs])
+            self._profile.append(time.perf_counter() - t0)
         if inputs is not None:
             return [h.copy_to_cpu() for h in self._outputs]
         return True

@@ -156,3 +156,37 @@ def test_mfu_monitor():
     mon = MFUMonitor(step_flops=fl, chip="cpu")
     mon.step(tokens=64)
     assert mon.mfu() >= 0 and "MFU" in mon.summary()
+
+
+def test_config_knobs_are_real(tmp_path):
+    """switch_ir_debug dumps the program text; enable_profile collects
+    per-run latencies; named IO handles come from the saved InputSpecs
+    (the padded-knob cleanup, VERDICT round-2 copy-paste findings)."""
+    import os
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu import inference as paddle_infer
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.eval()
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(model, prefix,
+                    input_spec=[paddle.static.InputSpec([2, 4], "float32",
+                                                        "image")])
+    cfg = paddle_infer.Config(prefix)
+    cfg.switch_ir_debug(True)
+    cfg.enable_profile()
+    pred = paddle_infer.create_predictor(cfg)
+    assert pred.get_input_names() == ["image"]     # spec name survives
+    assert os.path.exists(prefix + ".hlo.txt")     # IR dump written
+    txt = open(prefix + ".hlo.txt").read()
+    assert "module" in txt or "func" in txt
+    x = np.ones((2, 4), np.float32)
+    for _ in range(3):
+        (out,) = pred.run([x])
+    assert out.shape == (2, 2)
+    prof = pred.get_profile()
+    assert prof["runs"] == 3 and prof["total_s"] > 0
+    assert prof["p99_s"] >= prof["p50_s"] > 0
