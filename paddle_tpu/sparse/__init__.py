@@ -432,9 +432,93 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     return Tensor(beta * base._data + alpha * prod._data)
 
 
+def _sparse_attention_impl(query, key, value, sparse_mask):
+    """paddle.sparse.nn.functional.attention — attention restricted to
+    ``sparse_mask``'s nonzero pattern (reference: the sparse-attention
+    phi kernel over CSR masks). TPU tier: dense QK^T with the pattern
+    applied as an additive mask — the MXU has no sparse systolic path,
+    so this mirrors the reference's cuSPARSE-fallback semantics while
+    keeping O(s²) compute on the MXU's fast path."""
+    q = _as_array(query)
+    k = _as_array(key)
+    v = _as_array(value)
+    b, h, s, d = q.shape
+    m = _coo(sparse_mask)._m if is_sparse(sparse_mask) else None
+    lg = jnp.einsum("bhqd,bhkd->bhqk", q, k) / (d ** 0.5)
+    if m is not None:
+        dense_mask = m.todense()
+        dense_mask = dense_mask.reshape(b, h, s, s)
+        lg = jnp.where(dense_mask != 0, lg, -1e30)
+    w = jax.nn.softmax(lg, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    return Tensor(out)
+
+
+class _SparseConvBase:
+    """Shared machinery for sparse 3-D convs (reference:
+    ``phi/kernels/sparse/conv_kernel``): correctness-first dense conv on
+    the gathered voxels — XLA runs the conv on the MXU; the sparse win
+    on TPU is memory (COO storage), not compute."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, subm=False):
+        from ..nn.initializer import XavierUniform
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * 3
+        self.kernel_size = tuple(int(x) for x in ks)
+        self.stride = stride if isinstance(stride, (list, tuple)) \
+            else (stride,) * 3
+        self.padding = padding if isinstance(padding, (list, tuple)) \
+            else (padding,) * 3
+        self.subm = subm
+        from ..framework.core import Parameter
+        self.weight = Parameter(XavierUniform()(
+            self.kernel_size + (in_channels, out_channels), "float32"))
+
+    def parameters(self):
+        return [self.weight]
+
+    def __call__(self, x):
+        # x: SparseCooTensor [N, D, H, W, C] (paddle sparse conv layout)
+        dense = _coo(x)._m.todense()
+        pad = [(0, 0)] + [(p, p) for p in self.padding] + [(0, 0)]
+        out = jax.lax.conv_general_dilated(
+            dense.astype(jnp.float32), self.weight._data,
+            window_strides=tuple(self.stride),
+            padding=pad[1:4],
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.subm:
+            # submanifold: output pattern == input pattern (per-voxel)
+            in_pat = (jnp.abs(dense).sum(-1, keepdims=True) != 0)
+            out = jnp.where(in_pat, out, 0.0)
+        nse = int((jnp.abs(out).sum(-1) != 0).sum()) * out.shape[-1]
+        bc = jsparse.bcoo_fromdense(out, nse=max(nse, 1))
+        return SparseCooTensor(bc)
+
+
 class nn:
-    """paddle.sparse.nn — sparse activations (subset)."""
+    """paddle.sparse.nn — sparse layers/activations (subset)."""
 
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+    class Conv3D(_SparseConvBase):
+        """paddle.sparse.nn.Conv3D over SparseCooTensor [N,D,H,W,C]."""
+
+        def __init__(self, in_channels, out_channels, kernel_size,
+                     stride=1, padding=0, **kw):
+            super().__init__(in_channels, out_channels, kernel_size,
+                             stride, padding, subm=False)
+
+    class SubmConv3D(_SparseConvBase):
+        """Submanifold sparse conv: output sparsity == input sparsity."""
+
+        def __init__(self, in_channels, out_channels, kernel_size,
+                     stride=1, padding=0, **kw):
+            super().__init__(in_channels, out_channels, kernel_size,
+                             stride, padding, subm=True)
+
+    class functional:
+        attention = staticmethod(_sparse_attention_impl)
+        relu = staticmethod(relu)

@@ -268,3 +268,49 @@ def test_fused_swiglu_matches_composition():
     silu = np.asarray(x) / (1 + np.exp(-np.asarray(x)))
     np.testing.assert_allclose(out, silu * np.asarray(g), rtol=1e-4,
                                atol=1e-5)
+
+
+def test_sparse_attention_matches_dense_mask():
+    b, h, s, d = 1, 2, 8, 4
+    q = RNG.randn(b, h, s, d).astype(np.float32)
+    k = RNG.randn(b, h, s, d).astype(np.float32)
+    v = RNG.randn(b, h, s, d).astype(np.float32)
+    mask = np.tril(np.ones((s, s), np.float32))            # causal pattern
+    full = np.broadcast_to(mask, (b * h, s, s)).reshape(b * h, s, s)
+    sm = _coo(np.ascontiguousarray(full.reshape(b * h, s, s)))
+    out = psp.nn.functional.attention(t(q), t(k), t(v), sm)
+    lg = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    lg = np.where(mask != 0, lg, -1e30)
+    w = np.exp(lg - lg.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    want = np.einsum("bhqk,bhkd->bhqd", w, v)
+    np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sparse_conv3d_and_subm():
+    rng = np.random.RandomState(0)
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    # a few active voxels
+    for (d_, h_, w_) in [(0, 0, 0), (1, 2, 3), (3, 3, 1)]:
+        dense[0, d_, h_, w_] = rng.randn(2)
+    x = _coo(dense)
+    conv = psp.nn.Conv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(x)
+    assert out.shape == [1, 4, 4, 4, 3]
+    # parity vs the dense conv on the same weights
+    import jax
+    import jax.numpy as jnp
+    want = jax.lax.conv_general_dilated(
+        jnp.asarray(dense), conv.weight._data, (1, 1, 1),
+        [(1, 1), (1, 1), (1, 1)],
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                               np.asarray(want), rtol=1e-4, atol=1e-5)
+
+    sub = psp.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+    sout = sub(x)
+    got = np.asarray(sout.to_dense().numpy())
+    in_pat = np.abs(dense).sum(-1) != 0
+    assert (np.abs(got).sum(-1) != 0).sum() <= in_pat.sum() * 1  # pattern kept
+    assert np.all((np.abs(got).sum(-1) != 0) <= in_pat)
