@@ -35,11 +35,12 @@ def _amp_enabled():
     return os.environ.get("BENCH_AMP", default) == "1"
 
 
-def _loader_batches(batch, n_batches, image_shape=(3, 32, 32)):
+def _loader_batches(batch, image_shape=(3, 32, 32)):
     """Config-1's input path as specified: CIFAR-10 (local cache) or the
     deterministic FakeData stand-in (zero-egress), through
     ``paddle.io.DataLoader`` with worker processes + C++ shm queue +
-    prefetch (reference ``buffered_reader.cc`` double buffering)."""
+    prefetch (reference ``buffered_reader.cc`` double buffering).
+    Yields forever; callers bound consumption themselves."""
     from paddle_tpu.io import DataLoader
     from paddle_tpu.vision.datasets import Cifar10, FakeData
     workers = int(os.environ.get("BENCH_WORKERS", "4"))
@@ -107,7 +108,7 @@ def bench_resnet():
     comp_dtype = x.dtype
     if use_loader:
         import numpy as np
-        batches = _loader_batches(batch, steps)
+        batches = _loader_batches(batch)
 
         def feed():
             xb, yb = next(batches)
@@ -148,7 +149,7 @@ def bench_data():
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     shape = (3, int(os.environ.get("BENCH_IMG", "320")),
              int(os.environ.get("BENCH_IMG", "320")))
-    batches = _loader_batches(batch, steps, image_shape=shape)
+    batches = _loader_batches(batch, image_shape=shape)
     dev = jax.devices()[0]
 
     next(batches)                                            # warm workers

@@ -218,10 +218,17 @@ class Engine:
         n = len(st["p"])
         st["p"] = [jax.device_put(data[f"p_{i}"], s)
                    for i, s in zip(range(n), st["p_sh"])]
-        st["m"] = [jax.device_put(data[f"m_{i}"], s)
-                   for i, s in zip(range(n), st["p_sh"])]
-        st["v"] = [jax.device_put(data[f"v_{i}"], s)
-                   for i, s in zip(range(n), st["p_sh"])]
+        # eval-prepared engines save params only; a params-only checkpoint
+        # must not leave moments computed for the OLD weights paired with
+        # the new ones — reset them
+        if "m_0" in data:
+            st["m"] = [jax.device_put(data[f"m_{i}"], s)
+                       for i, s in zip(range(n), st["p_sh"])]
+            st["v"] = [jax.device_put(data[f"v_{i}"], s)
+                       for i, s in zip(range(n), st["p_sh"])]
+        else:
+            st["m"] = [jnp.zeros_like(p) for p in st["p"]]
+            st["v"] = [jnp.zeros_like(p) for p in st["p"]]
         st["t"] = int(data["t"])
         self._sync_back()
         return self

@@ -511,6 +511,21 @@ def _p2p_store():
             "PADDLE_P2P_PORT")
     _P2P_STORE[0] = TCPStore(host=host, port=port, is_master=(rank == 0),
                              world_size=world)
+    # Elastic hygiene: _P2P_SEQ is process-local but messages persist in
+    # the rank-0 store, so a restarted worker pair (seq reset to 0) could
+    # consume a payload a previous incarnation deposited. Purge only keys
+    # this rank SENT: they are all from its previous life (the purge runs
+    # before any send in this life), so nothing live can be deleted —
+    # purging keys merely *addressed* to this rank could race a peer's
+    # legitimate first send on a fresh job.
+    try:
+        me = str(rank)
+        for key in _P2P_STORE[0].keys("p2p/"):
+            parts = key.split("/")
+            if len(parts) == 4 and parts[2].split(">", 1)[0] == me:
+                _P2P_STORE[0].delete_key(key)
+    except Exception:
+        pass  # best-effort; a fresh job has nothing to purge
     return _P2P_STORE[0]
 
 

@@ -92,6 +92,137 @@ def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp",
     return run
 
 
+def pipeline_spmd_1f1b_bwd(stage_fn, n_stages, n_micro, axis_name="pp",
+                           with_keys=False):
+    """Per-device interleaved fwd-recompute/backward runner — the memory
+    half of the reference's 1F1B schedule
+    (``fleet/meta_parallel/pipeline_parallel.py``: steady state holds at
+    most S in-flight activations per rank, vs GPipe's M).
+
+    Differentiating :func:`pipeline_spmd` with ``jax.grad`` reproduces
+    1F1B's *bubble* but not its *memory*: the scan saves every tick's
+    stage residuals, so peak activation memory is O(M·S). This runner is
+    the explicit alternative used as the backward of a ``custom_vjp``
+    (see :func:`_forward_1f1b`): ONE scan of ``M + 2(S-1)`` ticks where
+    every tick recomputes one microbatch's forward (rematerialisation —
+    the TPU-native trade of FLOPs for HBM) and back-propagates another,
+    keeping stage-input activations in a ``2S-1``-slot ring buffer that
+    forward writes and backward releases. Peak memory is
+    O(S)·microbatch + one tick's residuals, independent of M.
+
+    Tick math (stage ``s``, microbatch ``j``): forward fires at tick
+    ``j + s`` (same skew as the forward scan), backward at tick
+    ``j + 2(S-1) - s`` — cotangents enter at the last stage and ride
+    the reverse ``ppermute`` one hop per tick. A ring slot is reused
+    only after ``2S-1`` microbatches, strictly after its release.
+    """
+
+    def run(stacked_params, micro_inputs, d_out, base_key=None):
+        import jax.random as jrandom
+        params = jax.tree.map(lambda a: a[0], stacked_params)
+        stage = jax.lax.axis_index(axis_name)
+        m = micro_inputs.shape[0]
+        s_n = n_stages
+        ring_n = 2 * s_n - 1
+        ticks = m + 2 * (s_n - 1)
+        perm_up = [(i, i + 1) for i in range(s_n - 1)]
+        perm_dn = [(i + 1, i) for i in range(s_n - 1)]
+        act_shape = micro_inputs.shape[1:]
+        act_dtype = micro_inputs.dtype
+        is_last = stage == s_n - 1
+        const_key = jrandom.PRNGKey(0)
+
+        def apply(p, x, key):
+            return stage_fn(p, x, key) if with_keys else stage_fn(p, x)
+
+        def tick(carry, t):
+            recv_f, recv_b, ring, dparams, dx_buf = carry
+            # -- forward (recompute) half: microbatch t - stage ----------
+            fi = t - stage
+            f_act = jnp.logical_and(fi >= 0, fi < m)
+            fi_c = jnp.clip(fi, 0, m - 1)
+            x_in = jnp.where(stage == 0, micro_inputs[fi_c], recv_f)
+            kf = (_chunk_key(base_key, fi_c, stage) if with_keys
+                  else const_key)
+            y = apply(params, x_in, kf)
+            y = jnp.where(f_act, y, jnp.zeros_like(y))
+            ring = jnp.where(f_act, ring.at[fi_c % ring_n].set(x_in), ring)
+            # -- backward half: microbatch t - (2(S-1) - stage) ----------
+            bi = t - (2 * s_n - 2 - stage)
+            b_act = jnp.logical_and(bi >= 0, bi < m)
+            bi_c = jnp.clip(bi, 0, m - 1)
+            g_in = jnp.where(is_last, d_out[bi_c], recv_b)
+            x_sav = ring[bi_c % ring_n]
+            kb = (_chunk_key(base_key, bi_c, stage) if with_keys
+                  else const_key)
+            _, vjp = jax.vjp(lambda p, x: apply(p, x, kb), params, x_sav)
+            dp, dx = vjp(g_in)
+            dparams = jax.tree.map(
+                lambda acc, g: acc + jnp.where(b_act, g, jnp.zeros_like(g)),
+                dparams, dp)
+            dx = jnp.where(b_act, dx, jnp.zeros_like(dx))
+            dx_buf = jnp.where(jnp.logical_and(b_act, stage == 0),
+                               dx_buf.at[bi_c].set(dx), dx_buf)
+            recv_f = jax.lax.ppermute(y, axis_name, perm_up)
+            recv_b = jax.lax.ppermute(dx, axis_name, perm_dn)
+            return (recv_f, recv_b, ring, dparams, dx_buf), None
+
+        carry0 = (jnp.zeros(act_shape, act_dtype),
+                  jnp.zeros(act_shape, act_dtype),
+                  jnp.zeros((ring_n,) + act_shape, act_dtype),
+                  jax.tree.map(jnp.zeros_like, params),
+                  jnp.zeros((m,) + act_shape, act_dtype))
+        (_, _, _, dparams, dx_buf), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(ticks))
+        dstacked = jax.tree.map(lambda a: a[None], dparams)
+        return dstacked, jax.lax.psum(dx_buf, axis_name)
+
+    return run
+
+
+def _forward_1f1b(stage_fn, mesh, n_stages, n_micro, axis_name, with_keys):
+    """Differentiable pipelined forward whose VJP is the interleaved
+    1F1B-memory scan (:func:`pipeline_spmd_1f1b_bwd`) instead of
+    ``jax.grad``-through-scan. Forward results are bit-identical to the
+    default schedule (it IS the same forward runner); only the backward's
+    schedule/memory differ — gradients remain exact (rematerialised)."""
+    import numpy as np
+
+    fwd_run = pipeline_spmd(stage_fn, n_stages, n_micro, axis_name,
+                            with_keys=with_keys)
+    bwd_run = pipeline_spmd_1f1b_bwd(stage_fn, n_stages, n_micro, axis_name,
+                                     with_keys=with_keys)
+
+    def _p_specs(tree):
+        return jax.tree.map(lambda a: P(axis_name), tree)
+
+    @jax.custom_vjp
+    def call(stacked_params, micro_inputs, rng_key):
+        mapped = jax.shard_map(
+            fwd_run, mesh=mesh,
+            in_specs=(_p_specs(stacked_params), P(), P()), out_specs=P(),
+            axis_names={axis_name}, check_vma=False)
+        return jax.jit(mapped)(stacked_params, micro_inputs, rng_key)
+
+    def fwd(stacked_params, micro_inputs, rng_key):
+        return (call(stacked_params, micro_inputs, rng_key),
+                (stacked_params, micro_inputs, rng_key))
+
+    def bwd(res, d_out):
+        stacked_params, micro_inputs, rng_key = res
+        specs = _p_specs(stacked_params)
+        mapped = jax.shard_map(
+            bwd_run, mesh=mesh, in_specs=(specs, P(), P(), P()),
+            out_specs=(specs, P()), axis_names={axis_name}, check_vma=False)
+        dstacked, dmicro = jax.jit(mapped)(stacked_params, micro_inputs,
+                                           d_out, rng_key)
+        dkey = np.zeros(rng_key.shape, dtype=jax.dtypes.float0)
+        return dstacked, dmicro, dkey
+
+    call.defvjp(fwd, bwd)
+    return call
+
+
 def pipeline_spmd_interleaved(stage_fn, n_stages, n_micro, vpp,
                               axis_name="pp", with_keys=False):
     """Interleaved (VPP) per-device runner — the reference
@@ -157,7 +288,8 @@ def pipeline_spmd_interleaved(stage_fn, n_stages, n_micro, vpp,
 
 def pipeline_seq_forward(block_fn, stacked_params, micro_inputs, *, pre=None,
                          post=None, mesh=None, axis_name="pp",
-                         n_stages=None, vpp_degree=1, rng_key=None):
+                         n_stages=None, vpp_degree=1, rng_key=None,
+                         schedule="fthenb"):
     """Full-model pipelined forward for stage-heterogeneous LMs (reference:
     ``pp_layers.py`` stage partition with embedding on stage 0, head on
     stage S-1, ``SharedLayerDesc`` tied weights).
@@ -196,7 +328,8 @@ def pipeline_seq_forward(block_fn, stacked_params, micro_inputs, *, pre=None,
                         else jrandom.fold_in(rng_key, 0x5e90))
     h = pipeline_forward(block_fn, stacked_params, h, mesh=mesh,
                          axis_name=axis_name, n_stages=n_stages,
-                         vpp_degree=vpp_degree, rng_key=rng_key)
+                         vpp_degree=vpp_degree, rng_key=rng_key,
+                         schedule=schedule)
     if post is not None:
         h = _flat_apply(post, h, None if rng_key is None
                         else jrandom.fold_in(rng_key, 0x5e91))
@@ -241,10 +374,11 @@ class PipelinedModule:
     """
 
     def __init__(self, pipe_layer, mesh=None, axis_name="pp", n_stages=None,
-                 vpp_degree=None):
+                 vpp_degree=None, schedule="fthenb"):
         from . import mesh as mesh_mod
         from ..framework.functional import FunctionalModule
 
+        self.schedule = schedule
         self.axis_name = axis_name
         self.mesh = mesh or (mesh_mod.get_mesh() if mesh_mod.has_mesh()
                              else None)
@@ -355,7 +489,8 @@ class PipelinedModule:
                                     pre=pre, post=post, mesh=self.mesh,
                                     axis_name=self.axis_name,
                                     n_stages=self.n_stages,
-                                    vpp_degree=self.vpp, rng_key=rng_key)
+                                    vpp_degree=self.vpp, rng_key=rng_key,
+                                    schedule=self.schedule)
 
 
 class _EdgeSegments:
@@ -408,7 +543,7 @@ class _EdgeSegments:
 
 def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
                      axis_name="pp", n_stages=None, vpp_degree=1,
-                     rng_key=None):
+                     rng_key=None, schedule="fthenb"):
     """Pipelined forward over the global mesh's pp axis (differentiable,
     jit-compatible).
 
@@ -419,6 +554,15 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
     deterministic per-(microbatch, chunk) key — stochastic stages
     (dropout) produce the same result as a sequential run with the same
     base key, regardless of schedule or pp size.
+
+    ``schedule`` picks the *backward* memory profile (reference:
+    ``pipeline_scheduler_pass`` FThenB/1F1B — SURVEY.md §2.3):
+
+    * ``"fthenb"`` (default): ``jax.grad`` through the forward scan —
+      1F1B-like bubble, GPipe-like memory (O(M) saved residual sets).
+    * ``"1f1b"``: ``custom_vjp`` with the interleaved recompute/backward
+      scan — O(S) in-flight activations independent of M, one extra
+      forward of FLOPs (remat). Requires ``vpp_degree == 1``.
     """
     from . import mesh as mesh_mod
     mesh = mesh or mesh_mod.get_mesh()
@@ -427,6 +571,9 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
         raise ValueError(f"n_stages={n_stages} != mesh '{axis_name}' size "
                          f"{mesh_pp}: chunks would be silently dropped")
     n_stages = mesh_pp
+    if schedule not in ("fthenb", "1f1b"):
+        raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                         "(expected 'fthenb' or '1f1b')")
     with_keys = rng_key is not None
     if n_stages == 1:
         n_chunks = jax.tree.leaves(stacked_params)[0].shape[0]
@@ -442,6 +589,15 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
         m = micro_inputs.shape[0]
         return jax.vmap(seq_all)(micro_inputs, jnp.arange(m))
     n_micro = int(micro_inputs.shape[0])
+    if schedule == "1f1b":
+        if vpp_degree > 1:
+            raise ValueError("schedule='1f1b' supports vpp_degree == 1 only "
+                             "(interleaved-VPP keeps the default backward)")
+        import jax.random as jrandom
+        key = rng_key if with_keys else jrandom.PRNGKey(0)
+        call = _forward_1f1b(stage_fn, mesh, n_stages, n_micro, axis_name,
+                             with_keys)
+        return call(stacked_params, micro_inputs, key)
     if vpp_degree > 1:
         # chunk-major [c] → slot-major [(k, d) → d*v + k ... ]: device d's
         # slot k must hold chunk d + k·S, and P('pp') splits contiguously,

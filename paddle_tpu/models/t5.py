@@ -206,7 +206,7 @@ class T5Block(Layer):
                                             cache=cache))
         if self.is_decoder and enc is not None:
             x = x + self.dropout(self.cross_attn(self.norm_cross(x),
-                                                 kv_source=enc))
+                                                 kv_source=enc, cache=cache))
         return x + self.dropout(self.ff(self.norm2(x)))
 
 

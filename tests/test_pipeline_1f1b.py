@@ -1,0 +1,168 @@
+"""1F1B-memory pipeline schedule (VERDICT.md round-3 item 3; reference:
+``pipeline_scheduler_pass`` 1F1B + ``fleet/meta_parallel/
+pipeline_parallel.py`` steady-state memory contract).
+
+``schedule='1f1b'`` swaps the engine's backward from jax.grad-through-scan
+(which saves every tick's stage residuals — GPipe memory, O(M·S)) to an
+explicit interleaved recompute/backward scan holding at most ``2S-1``
+stage-input activations (O(S), independent of M). Gradients must be exact
+— rematerialisation changes memory, never math — and the compiled peak
+temp memory must actually drop at M >> S.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.engine import _chunk_key, pipeline_forward
+
+
+def _stage(params, x):
+    w1, b1, w2, b2 = params
+    h = jax.nn.gelu(x @ w1 + b1)
+    return jnp.tanh(h @ w2 + b2) + x
+
+
+def _stoch_stage(params, x, key):
+    w1, b1, w2, b2 = params
+    keep = jax.random.bernoulli(key, 0.8, x.shape)
+    h = jax.nn.gelu(x @ w1 + b1)
+    return (jnp.tanh(h @ w2 + b2) + x) * keep
+
+
+def _setup(n_chunks=4, n_micro=8, mb=2, d=8, hidden=16, seed=0):
+    rng = np.random.default_rng(seed)
+    params = (
+        jnp.asarray(rng.normal(size=(n_chunks, d, hidden)) * 0.3, jnp.float32),
+        jnp.asarray(rng.normal(size=(n_chunks, hidden)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(n_chunks, hidden, d)) * 0.3, jnp.float32),
+        jnp.asarray(rng.normal(size=(n_chunks, d)) * 0.1, jnp.float32),
+    )
+    micro = jnp.asarray(rng.normal(size=(n_micro, mb, d)), jnp.float32)
+    return params, micro
+
+
+def _sequential(params, micro, base_key=None):
+    out = []
+    for m in range(micro.shape[0]):
+        x = micro[m]
+        for c in range(params[0].shape[0]):
+            p = tuple(a[c] for a in params)
+            if base_key is None:
+                x = _stage(p, x)
+            else:
+                x = _stoch_stage(p, x, _chunk_key(base_key, m, c))
+        out.append(x)
+    return jnp.stack(out)
+
+
+def test_1f1b_forward_matches_sequential():
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup()
+        out = jax.jit(lambda p, x: pipeline_forward(
+            _stage, p, x, schedule="1f1b"))(params, micro)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_sequential(params, micro)),
+                                   rtol=1e-5, atol=1e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_1f1b_grads_match_fthenb_and_oracle():
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup()
+        g = jnp.asarray(np.random.default_rng(5).normal(size=micro.shape),
+                        jnp.float32)
+
+        def loss(p, x, sched):
+            return jnp.sum(pipeline_forward(_stage, p, x,
+                                            schedule=sched) * g)
+
+        g1, gx1 = jax.jit(jax.grad(lambda p, x: loss(p, x, "1f1b"),
+                                   argnums=(0, 1)))(params, micro)
+        g0, gx0 = jax.jit(jax.grad(lambda p, x: loss(p, x, "fthenb"),
+                                   argnums=(0, 1)))(params, micro)
+        gs, gxs = jax.grad(lambda p, x: jnp.sum(_sequential(p, x) * g),
+                           argnums=(0, 1))(params, micro)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g0)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx0),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gx1), np.asarray(gxs),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_1f1b_dropout_grads_match_sequential():
+    """Recompute must replay the SAME per-(micro, chunk) dropout mask."""
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup(n_micro=6)
+        base = jax.random.key(11)
+        g = jnp.asarray(np.random.default_rng(7).normal(size=micro.shape),
+                        jnp.float32)
+
+        def loss_pipe(p):
+            return jnp.sum(pipeline_forward(_stoch_stage, p, micro,
+                                            rng_key=base,
+                                            schedule="1f1b") * g)
+
+        def loss_seq(p):
+            return jnp.sum(_sequential(p, micro, base) * g)
+
+        gp = jax.jit(jax.grad(loss_pipe))(params)
+        gs = jax.grad(loss_seq)(params)
+        for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_1f1b_rejects_vpp():
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup(n_chunks=8)
+        with pytest.raises(ValueError, match="vpp"):
+            pipeline_forward(_stage, params, micro, vpp_degree=2,
+                             schedule="1f1b")
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_1f1b_peak_memory_below_fthenb():
+    """The schedule's whole point: at M=8, S=4 the compiled train step's
+    temp allocation (activation residuals) must be materially smaller
+    under 1f1b than under the default backward (VERDICT round-3 item 3
+    asks for exactly this ``memory_analysis`` comparison)."""
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        # big-ish stage so residuals dominate: d=64, hidden=256, mb=4
+        params, micro = _setup(n_chunks=4, n_micro=8, mb=4, d=64, hidden=256)
+
+        def make_loss(sched):
+            def loss(p, x):
+                return jnp.sum(pipeline_forward(_stage, p, x,
+                                                schedule=sched) ** 2)
+            return jax.jit(jax.grad(loss))
+
+        sizes = {}
+        for sched in ("fthenb", "1f1b"):
+            compiled = make_loss(sched).lower(params, micro).compile()
+            ma = compiled.memory_analysis()
+            assert ma is not None, "memory_analysis unavailable"
+            sizes[sched] = int(ma.temp_size_in_bytes)
+        # require a real gap, not noise: 1f1b's temp must be < 60% of
+        # fthenb's (M=8 residual sets vs a 2S-1=7-slot input ring; the
+        # ratio widens further with M and layers-per-chunk)
+        assert sizes["1f1b"] < 0.6 * sizes["fthenb"], sizes
+    finally:
+        mesh_mod.reset_mesh()

@@ -13,7 +13,7 @@ while true; do
   if timeout 150 python -c "import jax; assert jax.default_backend() not in ('cpu',); print('OK', jax.devices())" >> "$LOG" 2>&1; then
     echo "[tpu_watch] TPU reachable $(date -u +%H:%M:%SZ); running benches" >> "$LOG"
     {
-      echo '{"session": "round3", "captured_at": "'"$(date -u +%Y-%m-%dT%H:%M:%SZ)"'", "results": ['
+      echo '{"session": "round4", "captured_at": "'"$(date -u +%Y-%m-%dT%H:%M:%SZ)"'", "results": ['
       first=1
       for spec in resnet llama llama_decode data resnet+BENCH_DATA=loader; do
         mode=${spec%%+*}
@@ -34,7 +34,7 @@ while true; do
     echo "[tpu_watch] done; results in $OUT" >> "$LOG"
     # MFU sweep toward the 40% north star (VERDICT round-2 item 2):
     # 1B-class llama over batch/seq/remat; each line records the mfu aux
-    SWEEP=/root/repo/BENCH_SWEEP_R3.jsonl
+    SWEEP=/root/repo/BENCH_SWEEP_R4.jsonl
     : > "$SWEEP"
     for cfg in \
       "BENCH_PRESET=1b BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_REMAT=1" \
