@@ -108,6 +108,7 @@ class StaticFunction:
         self._instance = instance  # set when decorating an unbound method
         self._cache = {}
         self._bound = {}
+        self._converted = "unset"  # dy2static-converted fn, lazily built
         if not isinstance(function, Layer):
             functools.update_wrapper(self, function)
 
@@ -145,13 +146,15 @@ class StaticFunction:
 
     # -- trace + compile ----------------------------------------------------
     def _make_core(self, treedef, leaves, kwargs_static, params, bufs, sg_flags,
-                   tape_in_trace=False):
+                   tape_in_trace=False, call_fn=None):
         """Returns jitted core(p_arrs, b_arrs, key, t_arrs) -> (out, new_bufs).
 
         ``leaves`` gives the static (non-Tensor) leaves; Tensor slots are None
         and filled from t_arrs at call time. ``tape_in_trace`` keeps the tape
         recording during the trace (needed when the function calls
         paddle.grad — see autograd.tape.InTraceAutogradNeeded).
+        ``call_fn`` overrides the traced callable — used to swap in the
+        dy2static control-flow-converted function after a graph break.
         """
         static_leaves = [None if isinstance(l, Tensor) else l for l in leaves]
         tensor_slots = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
@@ -166,7 +169,10 @@ class StaticFunction:
                     tt.stop_gradient = sg
                     new_leaves[slot] = tt
                 new_args, new_kwargs = jax.tree.unflatten(treedef, new_leaves)
-                out = self._call_eager(*new_args, **new_kwargs)
+                if call_fn is not None:
+                    out = call_fn(*new_args, **new_kwargs)
+                else:
+                    out = self._call_eager(*new_args, **new_kwargs)
                 out_arrays = jax.tree.map(
                     lambda t: t._data if isinstance(t, Tensor) else t, out,
                     is_leaf=_is_tensor)
@@ -174,6 +180,36 @@ class StaticFunction:
                 return out_arrays, new_bufs
 
         return jax.jit(core)
+
+    # -- dy2static control-flow conversion ----------------------------------
+    def _conversion_target(self):
+        """(plain function, bound instance or None) for the AST converter."""
+        fn, inst = self._orig_fn, self._instance
+        if isinstance(fn, Layer):
+            fn = type(fn).forward
+            inst = self._orig_fn
+        if hasattr(fn, "__func__"):          # bound method
+            inst = fn.__self__
+            fn = fn.__func__
+        return fn, inst
+
+    def _get_converted(self):
+        """Control-flow-converted callable (reference ``convert_ifelse`` /
+        ``convert_while`` — SURVEY.md §3.2), or None when the function has
+        no convertible construct. Built lazily on the first graph break."""
+        if self._converted == "unset":
+            from . import dy2static
+            fn, inst = self._conversion_target()
+            try:
+                cfn = dy2static.convert_function(fn)
+            except dy2static.ConversionUnsupported:
+                self._converted = None
+            else:
+                if inst is not None:
+                    self._converted = functools.partial(cfn, inst)
+                else:
+                    self._converted = cfn
+        return self._converted
 
     def __call__(self, *args, **kwargs):
         params, bufs = self._state()
@@ -185,9 +221,14 @@ class StaticFunction:
         entry = self._cache.get(key)
         if entry is None:
             sg_flags = [t.stop_gradient for t in tensor_leaves]
-            core = self._make_core(treedef, leaves, kwargs, params, bufs, sg_flags)
+            # a spec that already needed control-flow conversion tells us
+            # the next spec will too — skip the doomed plain trace
+            conv = self._converted if callable(self._converted) else None
+            core = self._make_core(treedef, leaves, kwargs, params, bufs,
+                                   sg_flags, call_fn=conv)
             entry = {"core": core, "fallback": False, "breaks": 0,
-                     "pinned": pinned}
+                     "pinned": pinned, "converted": conv is not None,
+                     "call_fn": conv}
             self._cache[key] = entry
         if entry["fallback"]:
             return self._call_eager(*args, **kwargs)
@@ -202,23 +243,42 @@ class StaticFunction:
             return entry["core"](p_arrs, b_arrs, rng_key, t_arrs)
 
         from ..autograd.tape import InTraceAutogradNeeded
-        prev_static = _STATIC_ACTIVE[0]
-        _STATIC_ACTIVE[0] = True
-        try:
+
+        def attempt(call_fn):
             try:
-                out_vals, new_bufs = apply(runner, *params, *bufs,
-                                           *tensor_leaves,
-                                           op_name="to_static")
+                return apply(runner, *params, *bufs, *tensor_leaves,
+                             op_name="to_static")
             except InTraceAutogradNeeded:
                 # the traced fn calls paddle.grad: re-trace with the tape
                 # recording over tracers (unused vjps are DCE'd by XLA)
                 sg_flags = [t.stop_gradient for t in tensor_leaves]
                 entry["core"] = self._make_core(treedef, leaves, kwargs,
                                                 params, bufs, sg_flags,
-                                                tape_in_trace=True)
-                out_vals, new_bufs = apply(runner, *params, *bufs,
-                                           *tensor_leaves,
-                                           op_name="to_static")
+                                                tape_in_trace=True,
+                                                call_fn=call_fn)
+                return apply(runner, *params, *bufs, *tensor_leaves,
+                             op_name="to_static")
+
+        prev_static = _STATIC_ACTIVE[0]
+        _STATIC_ACTIVE[0] = True
+        try:
+            try:
+                out_vals, new_bufs = attempt(entry.get("call_fn"))
+            except _GRAPH_BREAK_ERRORS as e:
+                # a data-dependent branch: convert Python if/while on
+                # tensor values into lax.cond/while_loop (reference
+                # convert_ifelse/convert_while) and stay compiled
+                conv = (self._get_converted()
+                        if not entry.get("converted") else None)
+                if conv is None:
+                    raise
+                sg_flags = [t.stop_gradient for t in tensor_leaves]
+                entry["core"] = self._make_core(treedef, leaves, kwargs,
+                                                params, bufs, sg_flags,
+                                                call_fn=conv)
+                entry["converted"] = True
+                entry["call_fn"] = conv
+                out_vals, new_bufs = attempt(conv)
         except _GRAPH_BREAK_ERRORS as e:
             # latch the eager fallback only after a SECOND break, so one
             # transient tracer error doesn't permanently degrade the spec;
@@ -259,19 +319,36 @@ class StaticFunction:
         sg = [t.stop_gradient for t in tensor_leaves]
         prev_static = _STATIC_ACTIVE[0]
         _STATIC_ACTIVE[0] = True
+        last_break = None
         try:
-            for tape_in_trace in (False, True):
-                core = self._make_core(treedef, leaves, kwargs, params, bufs,
-                                       sg, tape_in_trace=tape_in_trace)
-                try:
-                    return core.lower([p._data for p in params],
-                                      [b._data for b in bufs],
-                                      prandom.next_key(),
-                                      [t._data for t in tensor_leaves])
-                except InTraceAutogradNeeded:
-                    continue   # retry with the tape recording in-trace
+            conv = self._converted if callable(self._converted) else None
+            for call_fn in ((conv,) if conv is not None else (None, "conv")):
+                if call_fn == "conv":
+                    call_fn = self._get_converted()
+                    if call_fn is None:
+                        break
+                for tape_in_trace in (False, True):
+                    core = self._make_core(treedef, leaves, kwargs, params,
+                                           bufs, sg,
+                                           tape_in_trace=tape_in_trace,
+                                           call_fn=call_fn)
+                    try:
+                        return core.lower([p._data for p in params],
+                                          [b._data for b in bufs],
+                                          prandom.next_key(),
+                                          [t._data for t in tensor_leaves])
+                    except InTraceAutogradNeeded:
+                        continue   # retry with the tape recording in-trace
+                    except _GRAPH_BREAK_ERRORS as e:
+                        if call_fn is not None:
+                            raise
+                        last_break = e
+                        break      # retry with control-flow conversion
         finally:
             _STATIC_ACTIVE[0] = prev_static
+        raise (last_break if last_break is not None else RuntimeError(
+            "get_concrete_program: could not lower (in-trace autograd "
+            "retries exhausted)"))
 
     def rollback(self):
         if isinstance(self._orig_fn, Layer):

@@ -1,0 +1,542 @@
+"""Dynamic-to-static control-flow conversion (reference:
+``python/paddle/jit/dy2static/transformers/`` — ``IfElseTransformer`` /
+``LoopTransformer`` rewriting tensor-dependent ``if``/``while`` into
+``cond`` / ``while_loop`` ops; SURVEY.md §2.2 "jit/dy2static", §3.2).
+
+TPU-native design: the reference rewrites Python AST into Program-IR
+control-flow ops. Here the jit tracer (``jit/api.py``) already handles
+straight-line code; this module supplies the missing piece — when tracing
+hits a *data-dependent branch* (``TracerBoolConversionError``), the
+function is AST-rewritten so that
+
+* ``if <tensor>:`` runs both arms through ``jax.lax.cond``, threading
+  every name either arm assigns as explicit operands/results, and
+* ``while <tensor>:`` runs through ``jax.lax.while_loop`` with the
+  body-assigned names as the carry (Python scalars entering the carry are
+  promoted to traced arrays, matching the reference's
+  ``to_static_variable`` promotion),
+
+while Python-valued conditions keep exact Python semantics (single-arm
+execution, native loop). The rewritten function replaces the eager
+fallback, so a model with a data-dependent branch stays ONE compiled
+program instead of silently de-optimizing (VERDICT round-3 item 4).
+
+Same caveats as the reference's converter: under a tensor condition both
+arms are traced (side effects on Python state leak from the untaken
+branch); arm results must match in shape/dtype; ``return``/``break``/
+``continue`` inside a converted region are not converted (that construct
+is left as plain Python — a tensor condition there still graph-breaks).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+
+class ConversionUnsupported(Exception):
+    """Raised when a function has no convertible control flow (or cannot
+    be source-rewritten at all) — callers fall back to eager."""
+
+
+class _Undef:
+    """Placeholder for a name with no binding yet when a converted region
+    threads it. Any actual *use* must fail the way the unconverted code
+    would (NameError), not silently act as a truthy object."""
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined>"
+
+    def __bool__(self):
+        raise NameError("variable is unbound on this path (it is only "
+                        "assigned inside an unexecuted branch)")
+
+    def __getattr__(self, name):
+        raise NameError("variable is unbound on this path (it is only "
+                        "assigned inside an unexecuted branch)")
+
+
+_UNDEF = _Undef()
+
+
+def _is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def _is_traced(x):
+    a = x._data if isinstance(x, Tensor) else x
+    return isinstance(a, jax.core.Tracer)
+
+
+def _scalar_pred(pred, ctx):
+    a = pred._data if isinstance(pred, Tensor) else pred
+    if getattr(a, "size", 1) != 1:
+        raise ValueError(
+            f"The truth value of a multi-element tensor {ctx} is ambiguous "
+            f"(shape {a.shape})")
+    return a.reshape(()) if getattr(a, "shape", ()) != () else a
+
+
+# ---------------------------------------------------------------------------
+# runtime: if / while dispatchers (injected into rewritten code)
+# ---------------------------------------------------------------------------
+
+def _flatten_vals(vals):
+    flat, treedef = jax.tree.flatten(tuple(vals), is_leaf=_is_tensor)
+    t_idx = [i for i, l in enumerate(flat) if isinstance(l, Tensor)]
+    arrs = tuple(flat[i]._data for i in t_idx)
+    sgs = [flat[i].stop_gradient for i in t_idx]
+    return flat, treedef, t_idx, arrs, sgs
+
+
+def _rebuild_vals(flat, treedef, t_idx, sgs, arrs):
+    nf = list(flat)
+    for i, a, sg in zip(t_idx, arrs, sgs):
+        t = Tensor(a)
+        t.stop_gradient = sg
+        nf[i] = t
+    return jax.tree.unflatten(treedef, nf)
+
+
+def _jst_peek(get):
+    """Resolve a read-only name exactly the way the original scope would
+    (``get`` is ``lambda: name`` — local/closure/global/builtin lookup is
+    the compiler's own), yielding ``_UNDEF`` when unbound (any later *use*
+    then raises NameError via :class:`_Undef`)."""
+    try:
+        return get()
+    except NameError:
+        return _UNDEF
+
+
+def _jst_if(pred, true_fn, false_fn, vals, names, n_out):
+    """``if`` dispatcher: Python condition → run ONE arm natively; traced
+    condition → ``lax.cond`` over both arms (reference ``convert_ifelse``).
+
+    ``vals``/``names``: the first ``n_out`` entries are the names either
+    arm assigns (threaded in AND out); the rest are names the arms only
+    read — passed as operands so the tape's cond node has edges to every
+    differentiable input (an in-trace ``paddle.grad`` needs them)."""
+    if not (_is_traced(pred) if isinstance(pred, Tensor)
+            else isinstance(pred, jax.core.Tracer)):
+        return tuple(true_fn(*vals)) if bool(pred) else tuple(false_fn(*vals))
+
+    p = _scalar_pred(pred, "used as an `if` condition")
+    flat, treedef, t_idx, arrs, sgs = _flatten_vals(vals)
+    statics = [None, None]
+
+    def arm(which, fn):
+        def g(arrs_in):
+            out = fn(*_rebuild_vals(flat, treedef, t_idx, sgs, arrs_in))
+            o_flat, o_def = jax.tree.flatten(tuple(out), is_leaf=_is_tensor)
+            o_arrs = tuple(l._data for l in o_flat if isinstance(l, Tensor))
+            statics[which] = (o_def, tuple(
+                None if isinstance(l, Tensor) else l for l in o_flat),
+                tuple(l.stop_gradient for l in o_flat
+                      if isinstance(l, Tensor)))
+            return o_arrs
+        return g
+
+    def cond_arrays(*arrs_in):
+        return jax.lax.cond(p != 0, arm(0, true_fn), arm(1, false_fn),
+                            tuple(arrs_in))
+
+    # route through the tape so an in-trace ``paddle.grad`` sees ONE
+    # differentiable node for the whole cond (jax.vjp through lax.cond)
+    from ..autograd.tape import apply as tape_apply
+    try:
+        out_ts = tape_apply(cond_arrays, *(flat[i] for i in t_idx),
+                            op_name="dy2static_cond")
+    except TypeError as e:
+        raise TypeError(
+            f"tensor-dependent `if`: the two branches must produce "
+            f"matching shapes/dtypes for {names}: {e}") from None
+    (o_def, o_static, o_sg), (f_def, f_static, _) = statics
+    if o_def != f_def or o_static != f_static:
+        raise ValueError(
+            f"tensor-dependent `if`: every variable in {list(names)} must "
+            f"be assigned a matching tensor in BOTH branches (one branch "
+            f"leaves it undefined or Python-valued)")
+    o_leaves = list(o_static)
+    it = iter(jax.tree.leaves(out_ts, is_leaf=_is_tensor))
+    o_leaves = [next(it) if l is None else l for l in o_leaves]
+    for l, sg in zip((x for x in o_leaves if isinstance(x, Tensor)), o_sg):
+        l.stop_gradient = sg
+    return jax.tree.unflatten(o_def, o_leaves)
+
+
+def _jst_while(cond_fn, body_fn, vals, names, n_carry):
+    """``while`` dispatcher: Python condition → native loop; traced
+    condition → ``lax.while_loop``. The first ``n_carry`` of ``vals`` are
+    the body-assigned names (the carry); the rest are read-only loop
+    invariants (operands for tape-edge completeness). Python int/float/
+    bool carry entries are promoted to traced arrays (reference
+    ``to_static_variable``) so counters work."""
+    c0 = cond_fn(*vals)
+    if not (_is_traced(c0) if isinstance(c0, Tensor)
+            else isinstance(c0, jax.core.Tracer)):
+        vals = list(vals)
+        while bool(c0):
+            vals[:n_carry] = tuple(body_fn(*vals))
+            c0 = cond_fn(*vals)
+        return tuple(vals[:n_carry])
+
+    def promote(vs):
+        return tuple(Tensor(jnp.asarray(v))
+                     if isinstance(v, (bool, int, float)) else v for v in vs)
+
+    carry = promote(vals[:n_carry])
+    rest = tuple(vals[n_carry:])
+    c_flat, c_def, c_idx, c_arrs, c_sgs = _flatten_vals(carry)
+    r_flat, r_def, r_idx, r_arrs, r_sgs = _flatten_vals(rest)
+    statics_in = tuple(None if isinstance(l, Tensor) else l for l in c_flat)
+
+    def while_arrays(*arrs_in):
+        ac, ar = arrs_in[:len(c_idx)], arrs_in[len(c_idx):]
+        rest_v = _rebuild_vals(r_flat, r_def, r_idx, r_sgs, ar)
+
+        def cond_w(carry_arrs):
+            cv = _rebuild_vals(c_flat, c_def, c_idx, c_sgs, carry_arrs)
+            c = cond_fn(*cv, *rest_v)
+            return _scalar_pred(c, "used as a `while` condition") != 0
+
+        def body_w(carry_arrs):
+            cv = _rebuild_vals(c_flat, c_def, c_idx, c_sgs, carry_arrs)
+            out = promote(body_fn(*cv, *rest_v))
+            o_flat, o_def = jax.tree.flatten(tuple(out), is_leaf=_is_tensor)
+            o_static = tuple(None if isinstance(l, Tensor) else l
+                             for l in o_flat)
+            if o_def != c_def or o_static != statics_in:
+                bad = [n for n, v in zip(names, out)
+                       if not isinstance(v, Tensor)] or list(names[:n_carry])
+                raise ValueError(
+                    f"tensor-dependent `while`: loop-carried variable(s) "
+                    f"{bad} changed structure or Python value across an "
+                    f"iteration — carry values must stay tensors of one "
+                    f"shape/dtype")
+            return tuple(l._data for l in o_flat if isinstance(l, Tensor))
+
+        return jax.lax.while_loop(cond_w, body_w, tuple(ac))
+
+    from ..autograd.tape import apply as tape_apply
+    try:
+        out_ts = tape_apply(while_arrays,
+                            *(c_flat[i] for i in c_idx),
+                            *(r_flat[i] for i in r_idx),
+                            op_name="dy2static_while")
+    except TypeError as e:
+        raise TypeError(
+            f"tensor-dependent `while`: the carry {names[:n_carry]} must "
+            f"keep one shape/dtype across iterations: {e}") from None
+    out_ts = jax.tree.leaves(out_ts, is_leaf=_is_tensor)
+    nf = list(c_flat)
+    for i, t, sg in zip(c_idx, out_ts, c_sgs):
+        t.stop_gradient = sg
+        nf[i] = t
+    return jax.tree.unflatten(c_def, nf)
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+# ---------------------------------------------------------------------------
+
+def _assigned_names(stmts):
+    """Names bound by Store at this function scope inside ``stmts`` —
+    skipping nested function/class/lambda/comprehension scopes."""
+    names = set()
+
+    def walk(node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.add(node.name)
+            return
+        if isinstance(node, (ast.Lambda, ast.ListComp, ast.SetComp,
+                             ast.DictComp, ast.GeneratorExp)):
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for s in stmts:
+        walk(s)
+    return names
+
+
+def _read_names(nodes):
+    """Names loaded at this scope inside ``nodes`` (statements or exprs) —
+    skipping nested function/class/lambda/comprehension scopes. Used to
+    pass read-only values into converted regions as explicit operands, so
+    the tape records edges to every differentiable input."""
+    names = set()
+
+    def walk(node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            names.add(node.id)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda, ast.ListComp,
+                             ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    for n in nodes:
+        walk(n)
+    return names
+
+
+def _has_escape(stmts):
+    """True if ``stmts`` contain return/yield/raise/assert/global/
+    nonlocal/del at this scope (not inside nested defs), or break/continue
+    that would escape this region — constructs the converter leaves as
+    plain Python (tracing both arms would run them unconditionally)."""
+
+    def walk(node, loop_depth):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return False
+        if isinstance(node, (ast.Return, ast.Global, ast.Nonlocal,
+                             ast.Delete, ast.Yield, ast.YieldFrom,
+                             ast.Raise, ast.Assert)):
+            return True
+        if isinstance(node, (ast.Break, ast.Continue)) and loop_depth == 0:
+            return True
+        inner = loop_depth + (1 if isinstance(node, (ast.For, ast.While,
+                                                     ast.AsyncFor)) else 0)
+        return any(walk(c, inner) for c in ast.iter_child_nodes(node))
+
+    return any(walk(s, 0) for s in stmts)
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _tuple(elts, ctx=None):
+    return ast.Tuple(elts=elts, ctx=ctx or ast.Load())
+
+
+def _guards(names):
+    """``try: n\nexcept NameError: n = _jst_UNDEF`` per name (UnboundLocal
+    is a NameError subclass, so function locals are covered)."""
+    out = []
+    for n in names:
+        out.append(ast.Try(
+            body=[ast.Expr(value=_name(n))],
+            handlers=[ast.ExceptHandler(
+                type=_name("NameError"), name=None,
+                body=[ast.Assign(targets=[_name(n, ast.Store())],
+                                 value=_name("_jst_UNDEF"))])],
+            orelse=[], finalbody=[]))
+    return out
+
+
+def _fn_def(fname, argnames, body, names):
+    ret = ast.Return(value=_tuple([_name(n) for n in names]))
+    return ast.FunctionDef(
+        name=fname,
+        args=ast.arguments(posonlyargs=[],
+                           args=[ast.arg(arg=a) for a in argnames],
+                           vararg=None, kwonlyargs=[], kw_defaults=[],
+                           kwarg=None, defaults=[]),
+        body=(body or [ast.Pass()]) + [ret],
+        decorator_list=[], returns=None, type_params=[])
+
+
+def _call_stmt(names, helper, call_args):
+    call = ast.Call(func=_name(helper), args=call_args, keywords=[])
+    if not names:
+        return ast.Expr(value=call)
+    return ast.Assign(
+        targets=[_tuple([_name(n, ast.Store()) for n in names],
+                        ast.Store())],
+        value=call)
+
+
+def _peek_expr(n):
+    """``_jst_peek(lambda: n)`` — resolves a read-only name through the
+    compiler's own local/closure/global/builtin lookup without creating a
+    local binding (a try/except-assign guard would make the name
+    function-local and shadow module globals/closures)."""
+    return ast.Call(func=_name("_jst_peek"),
+                    args=[ast.Lambda(
+                        args=ast.arguments(posonlyargs=[], args=[],
+                                           vararg=None, kwonlyargs=[],
+                                           kw_defaults=[], kwarg=None,
+                                           defaults=[]),
+                        body=_name(n))],
+                    keywords=[])
+
+
+class _Transformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.converted = 0
+
+    # keep nested function/class bodies untouched — they are their own
+    # tracing scope and converting them here would capture wrong names
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        # nested converted regions bind _jst_* helpers inside the arm —
+        # they are arm-local, never thread them through the outer cond
+        names = sorted(n for n in (_assigned_names(node.body)
+                                   | _assigned_names(node.orelse))
+                       if not n.startswith("_jst_"))
+        reads = sorted(n for n in (_read_names(node.body)
+                                   | _read_names(node.orelse))
+                       if n not in names and not n.startswith("_jst_"))
+        i = self.counter
+        self.counter += 1
+        self.converted += 1
+        cvar = f"_jst_c{i}"
+        params = names + reads
+        stmts = [ast.Assign(targets=[_name(cvar, ast.Store())],
+                            value=node.test),
+                 _fn_def(f"_jst_t{i}", params, node.body, names),
+                 _fn_def(f"_jst_f{i}", params, node.orelse, names)]
+        stmts += _guards(names)
+        stmts.append(_call_stmt(names, "_jst_if", [
+            _name(cvar), _name(f"_jst_t{i}"), _name(f"_jst_f{i}"),
+            _tuple([_name(n) for n in names] + [_peek_expr(n)
+                                                for n in reads]),
+            _tuple([ast.Constant(value=n) for n in params]),
+            ast.Constant(value=len(names))]))
+        return stmts
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escape(node.body):
+            return node
+        names = sorted(n for n in _assigned_names(node.body)
+                       if not n.startswith("_jst_"))
+        if not names:
+            return node      # no carry — nothing a traced loop could do
+        reads = sorted(n for n in (_read_names(node.body)
+                                   | _read_names([node.test]))
+                       if n not in names and not n.startswith("_jst_"))
+        i = self.counter
+        self.counter += 1
+        self.converted += 1
+        params = names + reads
+        cond_fn = ast.FunctionDef(
+            name=f"_jst_wc{i}",
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=a) for a in params],
+                               vararg=None, kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=[ast.Return(value=node.test)],
+            decorator_list=[], returns=None, type_params=[])
+        stmts = [cond_fn,
+                 _fn_def(f"_jst_wb{i}", params, node.body, names)]
+        stmts += _guards(names)
+        stmts.append(_call_stmt(names, "_jst_while", [
+            _name(f"_jst_wc{i}"), _name(f"_jst_wb{i}"),
+            _tuple([_name(n) for n in names] + [_peek_expr(n)
+                                                for n in reads]),
+            _tuple([ast.Constant(value=n) for n in params]),
+            ast.Constant(value=len(names))]))
+        return stmts
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+_CACHE: dict = {}
+
+
+def convert_function(fn):
+    """AST-rewrite ``fn`` so tensor-dependent if/while run as lax.cond /
+    lax.while_loop. Returns the rewritten function (cached per code
+    object). Raises :class:`ConversionUnsupported` when nothing was
+    convertible (no control flow, unavailable source, ...)."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        raise ConversionUnsupported(f"not a plain function: {fn!r}")
+    # the rewrite bakes closure cell VALUES in — two closures sharing one
+    # code object (factory-made functions) must not share a conversion
+    cacheable = not code.co_freevars
+    if cacheable:
+        hit = _CACHE.get(code)
+        if hit is not None:
+            return hit
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as e:
+        raise ConversionUnsupported(f"source unavailable: {e}") from None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise ConversionUnsupported("not a function definition")
+    fdef.decorator_list = []
+    tr = _Transformer()
+    tr.generic_visit(fdef)   # transform the body; visit_FunctionDef only
+    #                          guards defs NESTED inside it
+    if not tr.converted:
+        raise ConversionUnsupported(
+            "no convertible if/while (return/break/continue inside the "
+            "region, or no control flow at all)")
+
+    freevars = code.co_freevars
+    if freevars:
+        outer = ast.FunctionDef(
+            name="_jst_outer",
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=a) for a in freevars],
+                               vararg=None, kwonlyargs=[], kw_defaults=[],
+                               kwarg=None, defaults=[]),
+            body=[fdef, ast.Return(value=_name(fdef.name))],
+            decorator_list=[], returns=None, type_params=[])
+        module = ast.Module(body=[outer], type_ignores=[])
+    else:
+        module = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(module)
+
+    ns = dict(getattr(fn, "__globals__", {}))
+    ns.update(_jst_if=_jst_if, _jst_while=_jst_while, _jst_UNDEF=_UNDEF,
+              _jst_peek=_jst_peek)
+    filename = f"<dy2static {getattr(fn, '__qualname__', fn)}>"
+    exec(compile(module, filename, "exec"), ns)       # noqa: S102
+    if freevars:
+        cells = [c.cell_contents for c in (fn.__closure__ or ())]
+        new_fn = ns["_jst_outer"](*cells)
+    else:
+        new_fn = ns[fdef.name]
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__name__ = getattr(fn, "__name__", fdef.name)
+    new_fn.__qualname__ = getattr(fn, "__qualname__", fdef.name)
+    new_fn._jst_source = ast.unparse(module)
+    if cacheable:
+        _CACHE[code] = new_fn
+    return new_fn
+
+
+def converted_code(fn):
+    """The rewritten source (debugging aid — the reference exposes its
+    transformed code via ``StaticFunction.code``)."""
+    try:
+        return convert_function(fn)._jst_source
+    except ConversionUnsupported:
+        return None
