@@ -115,13 +115,13 @@ def _jst_peek(get):
         return _UNDEF
 
 
-def _jst_if(pred, true_fn, false_fn, vals, names, n_out):
+def _jst_if(pred, true_fn, false_fn, vals, names):
     """``if`` dispatcher: Python condition → run ONE arm natively; traced
     condition → ``lax.cond`` over both arms (reference ``convert_ifelse``).
 
-    ``vals``/``names``: the first ``n_out`` entries are the names either
-    arm assigns (threaded in AND out); the rest are names the arms only
-    read — passed as operands so the tape's cond node has edges to every
+    ``vals``/``names``: the assigned names (threaded in AND out — the
+    arms return exactly these) followed by names the arms only read,
+    passed as operands so the tape's cond node has edges to every
     differentiable input (an in-trace ``paddle.grad`` needs them)."""
     if not (_is_traced(pred) if isinstance(pred, Tensor)
             else isinstance(pred, jax.core.Tracer)):
@@ -420,8 +420,7 @@ class _Transformer(ast.NodeTransformer):
             _name(cvar), _name(f"_jst_t{i}"), _name(f"_jst_f{i}"),
             _tuple([_name(n) for n in names] + [_peek_expr(n)
                                                 for n in reads]),
-            _tuple([ast.Constant(value=n) for n in params]),
-            ast.Constant(value=len(names))]))
+            _tuple([ast.Constant(value=n) for n in params])]))
         return stmts
 
     def visit_While(self, node):
@@ -474,6 +473,12 @@ def convert_function(fn):
     code = getattr(fn, "__code__", None)
     if code is None:
         raise ConversionUnsupported(f"not a plain function: {fn!r}")
+    if getattr(fn, "__wrapped__", None) is not None:
+        # inspect.getsource unwraps to the INNER def — converting it would
+        # silently drop the wrapper's behavior
+        raise ConversionUnsupported(
+            "function carries a functools.wraps decorator (__wrapped__); "
+            "conversion would bypass the wrapper")
     # the rewrite bakes closure cell VALUES in — two closures sharing one
     # code object (factory-made functions) must not share a conversion
     cacheable = not code.co_freevars
@@ -513,7 +518,18 @@ def convert_function(fn):
         module = ast.Module(body=[fdef], type_ignores=[])
     ast.fix_missing_locations(module)
 
-    ns = dict(getattr(fn, "__globals__", {}))
+    # a live CHAIN to fn's module globals (not a snapshot): rebinding a
+    # module global after conversion must stay visible to the compiled
+    # path. dict-subclass __missing__ is honored by LOAD_GLOBAL.
+    class _Namespace(dict):
+        def __init__(self, base):
+            super().__init__()
+            self._base = base
+
+        def __missing__(self, key):
+            return self._base[key]
+
+    ns = _Namespace(getattr(fn, "__globals__", {}))
     ns.update(_jst_if=_jst_if, _jst_while=_jst_while, _jst_UNDEF=_UNDEF,
               _jst_peek=_jst_peek)
     filename = f"<dy2static {getattr(fn, '__qualname__', fn)}>"

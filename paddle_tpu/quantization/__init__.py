@@ -19,7 +19,7 @@ from ..autograd.tape import apply
 from ..nn.layer import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
-           "AbsmaxObserver", "quanted_layers", "QuantedLinear"]
+           "AbsmaxObserver", "quanted_layers", "QuantedLinear", "calibrate"]
 
 
 # ---------------------------------------------------------------------------
@@ -159,8 +159,25 @@ class QuantedConv2D(Layer):
         self.inner = inner
         self.a_q = a_quanter._instance(inner) if a_quanter else None
         self.w_q = w_quanter._instance(inner) if w_quanter else None
+        self._converted = False          # set by convert(): int8 weight path
 
     def forward(self, x):
+        if self._converted and not self.training:
+            # weight-only int8 conv: the artifact stores the filter as an
+            # int8 constant + per-out-channel scales; dequant is one fused
+            # convert+mul XLA folds into the conv's weight operand (half
+            # the weight bytes of bf16 at rest and on the wire)
+            from ..autograd.tape import no_grad
+            w = self.inner.weight
+            saved, saved_node = w._data, w._grad_node
+            deq = (jnp.asarray(self._w_int8, jnp.float32)
+                   * jnp.asarray(self._w_scale)[:, None, None, None])
+            w._data = deq.astype(saved.dtype)
+            try:
+                with no_grad():
+                    return self.inner(x)
+            finally:
+                w._data, w._grad_node = saved, saved_node
         if self.a_q is not None:
             x = _apply_quanter(self.a_q, x)
         if self.w_q is None or not hasattr(self.w_q, "quantize"):
@@ -221,9 +238,16 @@ class PTQ(QAT):
 
 
 def convert(model):
-    """Freeze: int8 weights + scales. Linear layers get per-output-channel
-    scales and route inference through the Pallas int8 matmul kernel;
-    Conv2D keeps per-tensor simulated int8."""
+    """Freeze calibrated quantization: int8 weights + scales. Linear
+    layers get per-output-channel scales and route inference through the
+    Pallas int8 matmul kernel (``ops/pallas/quant_matmul.py`` — true int8
+    weight stream in HBM); Conv2D freezes a per-out-channel int8 filter
+    constant (int8 at rest in the exported artifact; XLA chooses the
+    runtime dequant placement). Calibrated activation scales (PTQ
+    observers) are recorded as ``act_scale`` on each wrapper and exported
+    with the model — activations themselves stay float (weight-only
+    W8A16/W8A32: on TPU the weight stream, not the activation math, is
+    the HBM-bound resource for inference)."""
     from ..ops.pallas.quant_matmul import quantize_weight
     for name, sub in list(model._sub_layers.items()):
         if sub is None:
@@ -234,20 +258,52 @@ def convert(model):
             sub._w_int8 = np.asarray(q)
             sub._w_scale = np.asarray(scale)
             sub._converted = True
+            sub.act_scale = float(sub.a_q.scale) if sub.a_q is not None \
+                else None
             # back-compat per-tensor attrs (test/inspection surface)
             sub.int8_weight = sub._w_int8
             sub.weight_scale = float(scale.max() * 127.0)
             w._data = jnp.asarray(q, jnp.float32) * scale[None, :]
         elif isinstance(sub, QuantedConv2D):
-            w = sub.inner.weight
-            scale = float(jnp.max(jnp.abs(w._data))) or 1.0
-            qmax = 127.0
+            w = sub.inner.weight                      # [out_c, in_c, kh, kw]
+            amax = jnp.max(jnp.abs(w._data), axis=(1, 2, 3))
+            scale = jnp.maximum(amax, 1e-8) / 127.0   # per out-channel
             int_w = np.asarray(
-                jnp.clip(jnp.round(w._data / scale * qmax), -qmax, qmax),
-                np.int8)
+                jnp.clip(jnp.round(w._data / scale[:, None, None, None]),
+                         -127, 127), np.int8)
+            sub._w_int8 = int_w
+            sub._w_scale = np.asarray(scale, np.float32)
+            sub._converted = True
+            sub.act_scale = float(sub.a_q.scale) if sub.a_q is not None \
+                else None
             sub.int8_weight = int_w
-            sub.weight_scale = scale
-            w._data = jnp.asarray(int_w, jnp.float32) * (scale / qmax)
+            sub.weight_scale = float(scale.max() * 127.0)
+            w._data = (jnp.asarray(int_w, jnp.float32)
+                       * scale[:, None, None, None]).astype(w._data.dtype)
         else:
             convert(sub)
     return model
+
+
+def calibrate(model, data, steps=None):
+    """PTQ calibration driver (reference: the sample-data loop of
+    ``PTQ``/static post-training quantization): run ``data`` (a DataLoader
+    or any iterable of batches / (batch, label) pairs) through the
+    observer-wrapped ``model`` in eval mode so every activation observer
+    sees real ranges. Returns the number of batches observed."""
+    from ..autograd.tape import no_grad
+    was_training = model.training
+    model.eval()
+    n = 0
+    try:
+        with no_grad():
+            for item in data:
+                x = item[0] if isinstance(item, (tuple, list)) else item
+                model(x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)))
+                n += 1
+                if steps is not None and n >= steps:
+                    break
+    finally:
+        if was_training:
+            model.train()
+    return n

@@ -1,0 +1,137 @@
+"""int8 end-to-end (VERDICT.md round-3 item 5; reference:
+``python/paddle/quantization/`` PTQ observers → static quantization →
+int8 inference — SURVEY.md §2.2 "quantization").
+
+The full chain under test: PTQ observer wrapping → calibration over a
+DataLoader → ``convert`` (int8 weights + per-channel scales, calibrated
+activation scales recorded) → ``paddle.jit.save`` → ``paddle.inference``
+Config/Predictor → execution routed through the Pallas weight-only int8
+matmul (``ops/pallas/quant_matmul.py``), with accuracy pinned against the
+fp32 model (<1% top-1 delta on the CIFAR-shaped ResNet)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.io import DataLoader
+from paddle_tpu.jit import InputSpec
+from paddle_tpu.quantization import PTQ, QuantConfig, AbsmaxObserver, \
+    QuantedLinear, calibrate
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.vision.models import resnet18
+
+
+def _train_briefly(model, loader, steps=8):
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    crit = nn.CrossEntropyLoss()
+    it = iter(loader)
+    for _ in range(steps):
+        try:
+            xb, yb = next(it)
+        except StopIteration:
+            it = iter(loader)
+            xb, yb = next(it)
+        loss = crit(model(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    model.eval()
+
+
+def test_ptq_int8_resnet_end_to_end(tmp_path):
+    paddle.seed(7)
+    model = resnet18(num_classes=10)
+    ds = FakeData(size=128, image_shape=(3, 32, 32))
+    loader = DataLoader(ds, batch_size=16, shuffle=True, drop_last=True)
+    _train_briefly(model, loader, steps=6)
+
+    # fp32 reference predictions
+    xs = np.stack([np.asarray(ds[i][0]) for i in range(64)])
+    fp32_logits = model(paddle.to_tensor(xs)).numpy()
+    fp32_top1 = fp32_logits.argmax(-1)
+
+    # PTQ: observer wrapping → calibration over the loader → convert
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver(), weight=None))
+    ptq.quantize(model)
+    seen = calibrate(model, loader, steps=4)
+    assert seen == 4
+    quanted = [s for s in model.sublayers() if isinstance(s, QuantedLinear)]
+    assert quanted and all(q.a_q.scale > 0 for q in quanted), \
+        "calibration must populate activation observers"
+    ptq.convert(model)
+    assert all(q._converted and q.act_scale is not None for q in quanted)
+
+    int8_logits = model(paddle.to_tensor(xs)).numpy()
+    agree = float((int8_logits.argmax(-1) == fp32_top1).mean())
+    assert agree >= 0.99, f"top-1 delta {1-agree:.3%} exceeds 1%"
+
+    # export → Predictor: the served program must reproduce the converted
+    # model (int8 weights baked into the artifact as i8 constants)
+    prefix = str(tmp_path / "resnet_int8")
+    paddle.jit.save(model, prefix,
+                    input_spec=[InputSpec([8, 3, 32, 32], "float32", "x")])
+    cfg = Config(prefix)
+    cfg.switch_ir_debug(True)
+    pred = create_predictor(cfg)
+    with open(prefix + ".hlo.txt") as f:
+        assert "xi8" in f.read(), "program must embed int8 weight constants"
+    (got,) = pred.run([xs[:8]])
+    np.testing.assert_allclose(got, int8_logits[:8], rtol=2e-4, atol=2e-4)
+
+
+def test_int8_linear_routes_through_pallas_kernel(monkeypatch):
+    """The converted Linear must execute ops/pallas/quant_matmul.int8_matmul
+    (not a silent dequant fallback) and match the dequantized math."""
+    from paddle_tpu.ops.pallas import quant_matmul as qm
+
+    calls = []
+    real = qm.int8_matmul
+
+    def spy(x, w, s, **kw):
+        calls.append(w.dtype)
+        return real(x, w, s, **kw)
+
+    monkeypatch.setattr(qm, "int8_matmul", spy)
+
+    paddle.seed(11)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver(), weight=None))
+    ptq.quantize(model)
+    xs = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    calibrate(model, [xs], steps=1)
+    ptq.convert(model)
+    model.eval()
+
+    out = model(paddle.to_tensor(xs)).numpy()
+    assert calls and all(str(d) == "int8" for d in calls)
+
+    # manual weight-only reference: x @ (int8 * scale) + b
+    h = xs
+    for lyr in model.sublayers():
+        if isinstance(lyr, QuantedLinear):
+            w = lyr._w_int8.astype(np.float32) * lyr._w_scale[None, :]
+            h = h @ w + lyr.inner.bias.numpy()
+            h = np.maximum(h, 0) if lyr is not quanted_last(model) else h
+    np.testing.assert_allclose(out, h, rtol=1e-4, atol=1e-4)
+
+
+def quanted_last(model):
+    qs = [s for s in model.sublayers() if isinstance(s, QuantedLinear)]
+    return qs[-1]
+
+
+def test_quantized_conv_per_channel_scales():
+    paddle.seed(3)
+    conv_net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+    ptq = PTQ(QuantConfig(activation=AbsmaxObserver(), weight=None))
+    ptq.quantize(conv_net)
+    xs = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+    calibrate(conv_net, [xs], steps=1)
+    ptq.convert(conv_net)
+    conv_net.eval()
+    q = conv_net.sublayers()[0]
+    assert q._w_int8.dtype == np.int8 and q._w_scale.shape == (8,)
+    out = conv_net(paddle.to_tensor(xs)).numpy()
+    assert np.isfinite(out).all()
