@@ -20,6 +20,8 @@ memory at rest); the jitted engine threads the same specs through
 """
 from __future__ import annotations
 
+import contextlib
+
 import jax
 
 from ....framework.core import Parameter
@@ -146,7 +148,8 @@ class GroupShardedStage3:
                  exclude_layer=None):
         self._layer = layer
         self._optimizer = optimizer
-        self._offload = offload
+        self._offload = False
+        self._offload_params = []
         for p in layer.parameters():
             if p is None:
                 continue
@@ -156,17 +159,79 @@ class GroupShardedStage3:
                 p._data = _place(p._data, spec)
                 p.is_distributed = True
         if offload:
-            # host-memory sharding: params live on CPU between uses
-            cpu = jax.devices("cpu")[0]
-            for p in layer.parameters():
-                if p is not None:
-                    p._data = jax.device_put(p._data, cpu)
+            # Host-resident shards (reference ``offload=True``: params
+            # live in CPU memory between uses, streamed in per step).
+            # TPU-native: KEEP the sharded layout, move the residence to
+            # host memory via the sharding's memory kind; every __call__
+            # fetches device-resident copies for the step and re-homes
+            # afterwards. The host sharding recorded here stays the
+            # authority — values written elsewhere (an external
+            # optimizer.step) go home at the next forward.
+            staged = []
+            try:
+                for p in layer.parameters():
+                    if p is None or getattr(p, "_data", None) is None:
+                        continue
+                    if getattr(p, "_sharding_spec", None) is not None:
+                        sh = p._data.sharding
+                    else:
+                        # replicate small/undivisible params over the SAME
+                        # mesh — a committed single-device residence would
+                        # clash with mesh-sharded operands in one op
+                        sh = mesh_mod.replicated()
+                    host = sh.with_memory_kind("pinned_host")
+                    staged.append((p, jax.device_put(p._data, host),
+                                   sh.with_memory_kind("device"), host))
+            except Exception as e:
+                # nothing was mutated yet — the layer stays fully usable
+                raise NotImplementedError(
+                    "sharding stage-3 offload needs host memory-kind "
+                    f"support in the backend (got: {e!r}); rerun with "
+                    "offload=False") from e
+            for p, host_arr, dev_sh, host_sh in staged:
+                p._data = host_arr
+                self._offload_params.append((p, dev_sh, host_sh))
+            self._offload = True
+            if optimizer is not None:
+                # eagerly re-home after each step so the host copy is
+                # fresh the moment checkpointing/state_dict reads it
+                orig_step = optimizer.step
+
+                def step_and_rehome(*a, **k):
+                    out = orig_step(*a, **k)
+                    self._rehome()
+                    return out
+
+                optimizer.step = step_and_rehome
+
+    def _rehome(self):
+        """Move current param values to their recorded host residence."""
+        for p, _, host_sh in self._offload_params:
+            if p._data.sharding != host_sh:
+                p._data = jax.device_put(p._data, host_sh)
+
+    @contextlib.contextmanager
+    def _fetched(self):
+        """Context: device-resident copies of offloaded params for one
+        step; the recorded host shardings stay authoritative and current
+        values are re-homed after."""
+        if not self._offload:
+            yield
+            return
+        self._rehome()   # external updates since the last step go home
+        for p, dev_sh, _ in self._offload_params:
+            p._data = jax.device_put(p._data, dev_sh)
+        try:
+            yield
+        finally:
+            self._rehome()
 
     def __call__(self, *a, **k):
-        return self._layer(*a, **k)
+        with self._fetched():
+            return self._layer(*a, **k)
 
     def forward(self, *a, **k):
-        return self._layer(*a, **k)
+        return self.__call__(*a, **k)
 
     def __getattr__(self, item):
         return getattr(self._layer, item)

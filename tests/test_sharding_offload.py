@@ -1,0 +1,78 @@
+"""Stage-3 ``offload=True`` (VERDICT.md round-3 item 6; reference:
+``group_sharded_parallel(..., offload=True)`` — params resident in host
+memory between steps, streamed to the device per use).
+
+TPU-native contract under test: offload KEEPS the sharded layout and
+moves residence via the sharding's host memory kind; each forward fetches
+device copies and the host copy stays authoritative afterwards."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+
+def _model_and_opt(seed=41):
+    paddle.seed(seed)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    opt = paddle.optimizer.AdamW(learning_rate=0.01,
+                                 parameters=model.parameters())
+    return model, opt
+
+
+def test_offload_params_host_resident_and_trainable():
+    dist.mesh.reset_mesh()
+    dist.init_mesh({"sharding": 8})
+    try:
+        model, opt = _model_and_opt()
+        model, opt, _ = group_sharded_parallel(model, opt, level="p_g_os",
+                                               offload=True)
+        # at rest: sharded AND host-resident
+        kinds = {p._data.sharding.memory_kind for p in model.parameters()
+                 if getattr(p, "_sharding_spec", None) is not None}
+        assert kinds == {"pinned_host"}, kinds
+
+        rng = np.random.RandomState(2)
+        x = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(rng.randn(16, 2).astype("float32"))
+        losses = []
+        for _ in range(8):
+            loss = ((model(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.9, losses
+        # after training the updated values are back home on the host
+        kinds = {p._data.sharding.memory_kind for p in model.parameters()
+                 if getattr(p, "_sharding_spec", None) is not None}
+        assert kinds == {"pinned_host"}, kinds
+    finally:
+        dist.mesh.reset_mesh()
+
+
+def test_offload_matches_non_offload_numerics():
+    dist.mesh.reset_mesh()
+    dist.init_mesh({"sharding": 8})
+    try:
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, 8).astype("float32")
+        y = rng.randn(16, 2).astype("float32")
+        results = []
+        for offload in (False, True):
+            model, opt = _model_and_opt(seed=7)
+            model, opt, _ = group_sharded_parallel(model, opt,
+                                                   level="p_g_os",
+                                                   offload=offload)
+            for _ in range(4):
+                loss = ((model(paddle.to_tensor(x)) -
+                         paddle.to_tensor(y)) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            results.append(model(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(results[0], results[1], rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        dist.mesh.reset_mesh()
