@@ -30,3 +30,36 @@ def _seed_all():
     paddle.seed(102)
     np.random.seed(102)
     yield
+
+
+# ---------------------------------------------------------------------------
+# fast/slow tiers (VERDICT round-3 item 9): the full suite is ~50 min on the
+# 8-virtual-device CPU mesh, so per-commit signal needs a fast tier —
+# `pytest tests/ -m "not slow"` runs in ~2 min. Files measured >15 s in the
+# round-4 full run are marked slow here (file-level: coarse but maintainable;
+# re-measure with `pytest --durations=0` when adding suites).
+# ---------------------------------------------------------------------------
+
+_SLOW_FILES = {
+    "test_continuous_batching.py", "test_distributed.py",
+    "test_distribution.py", "test_fft_signal_vision_ops.py",
+    "test_functional_ops.py", "test_fused_multi_transformer.py",
+    "test_generation.py", "test_guarded_compile.py", "test_hf_pretrained.py",
+    "test_hybrid_3d.py", "test_io_vision.py", "test_launch_multiproc.py",
+    "test_llama_context_parallel.py", "test_models.py", "test_moe.py",
+    "test_nn.py", "test_nn_extras.py", "test_op_suite.py",
+    "test_op_surface_r3.py", "test_paged_attention.py",
+    "test_pallas_flash.py", "test_pipeline_1f1b.py",
+    "test_pipeline_dropout.py", "test_pipeline_transformer.py",
+    "test_quant_inference.py", "test_review_fixes.py", "test_rnn.py",
+    "test_serving.py", "test_sharding_offload.py", "test_sparse_quant.py",
+    "test_tcp_store.py", "test_training_e2e.py", "test_ulysses.py",
+    "test_vision_zoo2.py", "test_zero_memory.py",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import os.path
+    for item in items:
+        if os.path.basename(str(item.fspath)) in _SLOW_FILES:
+            item.add_marker(pytest.mark.slow)
