@@ -260,6 +260,55 @@ def bench_llama():
     }
 
 
+def bench_dispatch():
+    """Eager (dygraph) per-op dispatch overhead vs raw jax — SURVEY §7.3
+    item 1's top risk, measured. Reports µs/op for a no-grad elementwise
+    add (the pure dispatch path) plus the grad-enabled ratio and the
+    comparable raw-jax eager-vjp cost as aux lines."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    def clock(fn, n=2000, warmup=200):
+        for _ in range(warmup):
+            r = fn()
+        jax.block_until_ready([getattr(r, "_data", r)])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = fn()
+        jax.block_until_ready([getattr(r, "_data", r)])
+        return (time.perf_counter() - t0) / n * 1e6
+
+    xj = jnp.ones((256, 256))
+    yj = jnp.ones((256, 256))
+    xp = paddle.to_tensor(np.ones((256, 256), np.float32))
+    yp = paddle.to_tensor(np.ones((256, 256), np.float32))
+    xg = paddle.to_tensor(np.ones((256, 256), np.float32),
+                          stop_gradient=False)
+
+    raw = clock(lambda: jnp.add(xj, yj))
+    nograd = clock(lambda: xp + yp)
+    grad_on = clock(lambda: xg + yp)
+    raw_vjp = clock(lambda: jax.vjp(jnp.add, xj, yj)[0], n=500, warmup=50)
+
+    for name, val in (("raw_jnp_add_us", raw),
+                      ("eager_grad_add_us", grad_on),
+                      ("raw_jax_eager_vjp_us", raw_vjp),
+                      ("grad_vs_rawvjp_ratio", grad_on / raw_vjp)):
+        print(json.dumps({"aux_metric": name, "value": round(val, 2)}),
+              file=sys.stderr)
+    return {
+        "metric": "eager_dispatch_overhead_vs_jax",
+        "value": round(nograd / raw, 3),
+        "unit": "x (add, 256x256; paddle eager / raw jnp)",
+        "vs_baseline": None,
+    }
+
+
 def bench_llama_decode():
     """Serving-tier decode bench: batched autoregressive decode through the
     paged KV cache + Pallas paged_attention kernel (tokens/sec)."""
@@ -302,6 +351,7 @@ def _child_main():
     out = (bench_llama() if mode == "llama"
            else bench_llama_decode() if mode == "llama_decode"
            else bench_data() if mode == "data"
+           else bench_dispatch() if mode == "dispatch"
            else bench_resnet())
     import jax
     out["backend"] = jax.devices()[0].platform.lower()
@@ -411,10 +461,13 @@ def main():
                    else "llama_paged_decode_tokens_per_sec"
                    if mode == "llama_decode"
                    else "dataloader_hbm_samples_per_sec" if mode == "data"
+                   else "eager_dispatch_overhead_vs_jax"
+                   if mode == "dispatch"
                    else "resnet50_cifar10_train_throughput"),
         "value": None,
         "unit": ("tokens/sec" if mode in ("llama", "llama_decode")
                  else "samples/sec" if mode == "data"
+                 else "x" if mode == "dispatch"
                  else "images/sec"),
         "vs_baseline": None,
         "error": (" || ".join(e.replace("\n", " ")[:300]

@@ -82,6 +82,10 @@ def amp_cast_inputs(op_name, args):
     """Called by tape.apply: maybe cast Tensor args per AMP policy."""
     if not _state.enabled:
         return args
+    if op_name == "cast":
+        # the cast op IS the policy's tool — recasting its input would
+        # recurse forever (cast -> amp cast -> cast ...)
+        return args
     if _state.level == "O2":
         if op_name in _state.black:
             return _cast_tensors(args, jnp.float32)[0]

@@ -150,7 +150,47 @@ def apply(fn, *args, op_name: str | None = None, **kwargs):
     return _apply_inner(fn, name, args, kwargs)
 
 
+_FLAT_TYPES = (int, float, bool, str, bytes, type(None))
+_FAST_ARG_TYPES = (Tensor,) + _FLAT_TYPES
+_ARRAY_IMPL = []      # concrete jax array type, resolved on first dispatch
+
+
+_AMP_STATE = [None]
+
+
+def _amp_active():
+    """Cheap AMP-enabled probe: the amp module installs its cast hook at
+    import time, so hook-present != policy-active."""
+    st = _AMP_STATE[0]
+    if st is None:
+        try:
+            from ..amp import amp_state
+        except Exception:
+            return True    # unknown — take the general (safe) path
+        st = _AMP_STATE[0] = amp_state()
+    return st.enabled
+
+
 def _apply_inner(fn, name, args, kwargs):
+    # Fast path for the dominant dispatch shape (SURVEY §7.3 item 1:
+    # dygraph per-op overhead): flat positional Tensor/scalar args, no
+    # kwargs, no AMP recast, grads off or no diff inputs — skip the
+    # pytree flatten/unflatten/map machinery entirely (~40% of the
+    # no-grad dispatch cost measured round 4).
+    if (not kwargs and not _nan_check
+            and (_amp_cast_inputs is None or not _amp_active())
+            and all(isinstance(a, _FAST_ARG_TYPES)
+                    or isinstance(a, jax.Array) for a in args)):
+        if not (is_grad_enabled()
+                and any(_is_diff_tensor(a) for a in args)):
+            out = fn(*(a._data if isinstance(a, Tensor) else a
+                       for a in args))
+            if not _ARRAY_IMPL:
+                import jax.numpy as _jnp
+                _ARRAY_IMPL.append(type(_jnp.zeros(())))
+            if out.__class__ is _ARRAY_IMPL[0]:
+                return Tensor(out)
+            return jax.tree.map(lambda v: Tensor(v), out)
     # flatten args AND kwargs: Tensors passed by keyword unwrap (and
     # differentiate) exactly like positional ones — the reference API
     # accepts either form for every op

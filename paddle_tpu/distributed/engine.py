@@ -541,6 +541,19 @@ class _EdgeSegments:
         return self._run(self._post, x)
 
 
+def stacked_fsdp_spec(arr, pp_axis="pp", fsdp_axis="sharding"):
+    """PartitionSpec for a ``[n_chunks, lpc, *param]`` stacked block leaf:
+    pp on dim 0, ZeRO-3 ``fsdp_axis`` on the first weight dim of 2-D
+    weights when divisible (params-sharded-at-rest; GSPMD all-gathers on
+    use and reduce-scatters grads). Shared by the config-4 dryrun and the
+    hybrid tests so the placement rule lives in one place."""
+    from . import mesh as mesh_mod
+    n = mesh_mod.axis_size(fsdp_axis)
+    if n > 1 and arr.ndim >= 4 and arr.shape[2] % n == 0:
+        return P(pp_axis, None, fsdp_axis)
+    return P(pp_axis)
+
+
 def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
                      axis_name="pp", n_stages=None, vpp_degree=1,
                      rng_key=None, schedule="fthenb"):
