@@ -135,6 +135,9 @@ def unregister_post_backward_callback(cb):
         lst.remove(cb)
 
 
+_op_inspect = [None]   # auto_parallel completion hook: (op_name, out) -> None
+
+
 def apply(fn, *args, op_name: str | None = None, **kwargs):
     """Run pure-array function ``fn`` on (possibly) Tensor args; record a tape
     node if grad is enabled and any input requires grad. Returns Tensor(s)
@@ -144,10 +147,14 @@ def apply(fn, *args, op_name: str | None = None, **kwargs):
         import time as _time
         _t0 = _time.perf_counter()
         try:
-            return _apply_inner(fn, name, args, kwargs)
+            out = _apply_inner(fn, name, args, kwargs)
         finally:
             _profiler._record_op(name, _time.perf_counter() - _t0)
-    return _apply_inner(fn, name, args, kwargs)
+    else:
+        out = _apply_inner(fn, name, args, kwargs)
+    if _op_inspect[0] is not None:
+        _op_inspect[0](name, out)
+    return out
 
 
 _FLAT_TYPES = (int, float, bool, str, bytes, type(None))

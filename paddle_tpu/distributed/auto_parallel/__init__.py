@@ -29,10 +29,12 @@ __all__ = [
     "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
     "dtensor_from_fn", "reshard", "shard_optimizer", "get_mesh", "set_mesh",
     "Engine", "CostModel", "Tuner", "ModelSpec", "Plan",
+    "Completer", "ShardingReport",
 ]
 
 from .static_engine import Engine  # noqa: E402
 from .cost_model import CostModel, Tuner, ModelSpec, Plan  # noqa: E402
+from .completion import Completer, ShardingReport  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,7 @@ class Config:
         self._use_tpu = True
         self.mem_opt = True
         self.ir_debug = False
+        self.ir_optim = False
         self.profile = False
 
     # knobs kept for API compat (XLA supersedes them)
@@ -57,7 +58,11 @@ class Config:
         self.mem_opt = True
 
     def switch_ir_optim(self, flag=True):
-        pass                        # XLA always optimizes
+        """Run the program-level pass pipeline (canonicalize+cse via
+        ``static.pir``) on the loaded StableHLO before execution. XLA
+        optimizes again at compile time regardless; this knob exercises
+        the PIR-analogue pass infra and slims the program pre-compile."""
+        self.ir_optim = bool(flag)
 
     def switch_ir_debug(self, flag=True):
         """Dump the loaded program's StableHLO text next to the model
@@ -113,6 +118,19 @@ class Predictor:
     def __init__(self, config: Config):
         from ..jit import load as jit_load
         self._layer = jit_load(config.prefix)
+        if getattr(config, "ir_optim", False):
+            # best-effort: the knob's old contract was a no-op ("XLA always
+            # optimizes") — a pass-infra failure must degrade, not brick
+            # model load
+            try:
+                from ..static.pir import optimize_exported
+                self._layer._exported = optimize_exported(
+                    self._layer._exported)
+            except Exception as e:
+                import warnings
+                warnings.warn(f"ir_optim: pass pipeline unavailable "
+                              f"({e!r}); serving the unoptimized program",
+                              RuntimeWarning)
         specs = self._layer._meta.get("input_specs", [])
         names = []
         for i, s in enumerate(specs):

@@ -1,0 +1,204 @@
+"""Program-level IR inspection + pass/rewrite infrastructure (reference:
+PIR — ``paddle/fluid/pir/`` Program/pattern-rewriter and the inference
+``analysis`` fusion passes; SURVEY.md §2.1 "PIR", "Inference engine").
+
+TPU-native design: the lowered program IS StableHLO (SURVEY §7.0), so the
+pass infrastructure operates on the real MLIR module through jaxlib's IR
+bindings rather than on a re-invented graph format:
+
+* :class:`ProgramIR` wraps a lowered/exported program — walk it, take an
+  op histogram, match ops, rewrite, and round-trip back to an executable
+  ``jax.export.Exported`` (versioned portable artifact).
+* :class:`MLIRPipelinePass` runs real MLIR passes (``canonicalize``,
+  ``cse``, …) through ``jaxlib.mlir.passmanager`` — the analogue of the
+  reference's DCE/constant-fold/CSE program passes.
+* :class:`PatternRewritePass` is the Python-level pattern rewriter: match
+  by op name + predicate, mutate through a callback (the
+  ``PatternRewritePass``/``drr`` analogue for cases XLA doesn't already
+  cover).
+* :data:`registry` mirrors the reference's pass registry; the inference
+  ``Config.switch_ir_optim`` knob runs the default pipeline on the loaded
+  program before execution.
+
+Most of the reference's fusion pass zoo is absorbed by XLA (it fuses
+elementwise chains into matmuls at compile time) — these passes exist for
+the residue: program surgery, artifact slimming, inspection, and custom
+rewrites ahead of XLA.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["ProgramIR", "Pass", "MLIRPipelinePass", "PatternRewritePass",
+           "PassRegistry", "registry", "optimize_exported"]
+
+
+def _ir():
+    from jax._src.interpreters import mlir as jmlir
+    from jaxlib.mlir import ir
+    return jmlir, ir
+
+
+class ProgramIR:
+    """A lowered program as a live MLIR module.
+
+    Build from an ``Exported`` (``ProgramIR.from_exported``), a lowered
+    jit (``ProgramIR.from_lowered(jax.jit(f).lower(...))``), or StableHLO
+    text. ``to_exported()`` re-serializes into the original Exported's
+    calling convention (a versioned portable artifact — the edited
+    program executes anywhere the original did)."""
+
+    def __init__(self, module, context, exported=None):
+        self._module = module
+        self._ctx = context
+        self._exported = exported
+
+    # -- constructors -------------------------------------------------------
+    @classmethod
+    def parse(cls, text, exported=None):
+        jmlir, ir = _ir()
+        ctx = jmlir.make_ir_context()
+        return cls(ir.Module.parse(text, context=ctx), ctx, exported)
+
+    @classmethod
+    def from_exported(cls, exported):
+        return cls.parse(exported.mlir_module(), exported)
+
+    @classmethod
+    def from_lowered(cls, lowered):
+        return cls.parse(lowered.as_text())
+
+    # -- inspection ---------------------------------------------------------
+    @property
+    def text(self) -> str:
+        return str(self._module)
+
+    def walk(self, fn):
+        """Call ``fn(op)`` for every operation, outermost first."""
+
+        def go(op):
+            fn(op)
+            for region in op.regions:
+                for block in region.blocks:
+                    for child in block.operations:
+                        go(child.operation)
+
+        go(self._module.operation)
+
+    def ops(self, name=None):
+        """All operations, or those whose op name matches ``name``."""
+        out = []
+        self.walk(lambda op: out.append(op)
+                  if name is None or op.name == name else None)
+        return out
+
+    def op_histogram(self) -> dict:
+        """{op name: count} over the whole program — the quick 'what did
+        my model lower to' inspection the reference offers via IR print."""
+        hist: dict = {}
+
+        def count(op):
+            hist[op.name] = hist.get(op.name, 0) + 1
+
+        self.walk(count)
+        return hist
+
+    # -- rewrite ------------------------------------------------------------
+    def apply(self, passes) -> bool:
+        """Run passes (names from the registry, or Pass instances).
+        Returns True if any pass reported a change."""
+        changed = False
+        for p in passes:
+            if isinstance(p, str):
+                p = registry.get(p)
+            changed = bool(p.run(self)) or changed
+        return changed
+
+    def to_exported(self):
+        """Serialize the (possibly rewritten) module back into an
+        executable ``jax.export.Exported``."""
+        if self._exported is None:
+            raise ValueError("this ProgramIR was not built from an "
+                             "Exported; nothing to rebuild")
+        from jax._src.export import _export as _exp
+        return dataclasses.replace(
+            self._exported,
+            mlir_module_serialized=_exp._module_to_bytecode(self._module))
+
+
+class Pass:
+    """Base pass: subclass and implement ``run(program_ir) -> changed``."""
+
+    name = "pass"
+
+    def run(self, pir: ProgramIR) -> bool:
+        raise NotImplementedError
+
+
+class MLIRPipelinePass(Pass):
+    """Run a real MLIR pass pipeline on the module (``canonicalize``,
+    ``cse``, ...) — the reference's DCE/CSE/constant-fold program passes,
+    executed by MLIR itself."""
+
+    def __init__(self, name, pipeline):
+        self.name = name
+        self.pipeline = pipeline
+
+    def run(self, pir: ProgramIR) -> bool:
+        from jaxlib.mlir.passmanager import PassManager
+        jmlir, _ = _ir()
+        before = jmlir.module_to_bytecode(pir._module)   # cheaper than text
+        with pir._ctx:
+            PassManager.parse(f"builtin.module({self.pipeline})").run(
+                pir._module.operation)
+        return jmlir.module_to_bytecode(pir._module) != before
+
+
+class PatternRewritePass(Pass):
+    """Python-level pattern rewriter (reference ``PatternRewritePass`` /
+    drr): visit every op with ``matcher(op)``; when it returns True call
+    ``rewriter(op)`` (mutate attributes, move/erase the op through the
+    MLIR python API)."""
+
+    def __init__(self, name, matcher, rewriter):
+        self.name = name
+        self.matcher = matcher
+        self.rewriter = rewriter
+
+    def run(self, pir: ProgramIR) -> bool:
+        hits = [op for op in pir.ops() if self.matcher(op)]
+        for op in hits:
+            self.rewriter(op)
+        return bool(hits)
+
+
+class PassRegistry:
+    def __init__(self):
+        self._passes: dict = {}
+
+    def register(self, p: Pass):
+        self._passes[p.name] = p
+        return p
+
+    def get(self, name: str) -> Pass:
+        if name not in self._passes:
+            raise KeyError(f"unknown pass {name!r}; registered: "
+                           f"{sorted(self._passes)}")
+        return self._passes[name]
+
+    def names(self):
+        return sorted(self._passes)
+
+
+registry = PassRegistry()
+registry.register(MLIRPipelinePass("canonicalize", "canonicalize"))
+registry.register(MLIRPipelinePass("cse", "cse"))
+registry.register(MLIRPipelinePass("ir_optim", "canonicalize,cse"))
+
+
+def optimize_exported(exported, passes=("ir_optim",)):
+    """One-call helper: parse → run passes → rebuilt Exported. Used by the
+    inference Predictor when ``Config.switch_ir_optim(True)`` is set."""
+    pir = ProgramIR.from_exported(exported)
+    pir.apply(list(passes))
+    return pir.to_exported()

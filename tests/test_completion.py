@@ -1,0 +1,75 @@
+"""Auto-parallel completion inspection (VERDICT.md round-3 missing item
+6; reference: ``auto_parallel/static/completion.py`` dist-attr
+propagation + the ``test/auto_parallel/`` structural assertions).
+
+GSPMD does the propagation; the Completer makes it INSPECTABLE: resolved
+input/output specs and per-framework-op intermediate shardings captured
+through the tape dispatch hook."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel import (Completer, ProcessMesh,
+                                                  Shard, shard_tensor)
+
+
+def _mesh2d():
+    devs = np.array(jax.devices()[:8]).reshape(2, 4)
+    return Mesh(devs, ("dp", "mp"))
+
+
+def test_matmul_propagation_specs():
+    mesh = _mesh2d()
+    x = jax.device_put(jnp.ones((8, 16)), NamedSharding(mesh, P("dp", None)))
+    w = jax.device_put(jnp.ones((16, 32)), NamedSharding(mesh, P(None, "mp")))
+
+    def f(xt, wt):
+        return (xt @ wt).tanh()
+
+    report = Completer(mesh).complete(f, x, w)
+    assert report.input_spec(0) == ("dp", None)
+    assert report.input_spec(1) == (None, "mp")
+    # GSPMD completes the output to split over BOTH axes
+    assert report.output_spec(0) == ("dp", "mp")
+    # intermediates captured per framework op, with propagated placements
+    ops = dict(report.op_specs())
+    assert any(l.startswith("matmul") for l in ops), ops
+    assert any(l.startswith("tanh") for l in ops), ops
+    mm = [s for l, s in report.op_specs(r"^matmul")][0]
+    assert mm == ("dp", "mp"), mm
+    assert report.histogram()          # non-empty census
+
+
+def test_completion_through_layers_and_dist_tensors():
+    """The user-facing chain: shard_tensor placements + a real nn model
+    — the Completer reports what every Linear's output resolved to."""
+    mesh = _mesh2d()
+    pmesh = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 16))
+    x = shard_tensor(np.ones((8, 16), np.float32), pmesh,
+                     [Shard(0), paddle.distributed.auto_parallel.Replicate()])
+
+    report = Completer(mesh).complete(lambda t: net(t), x)
+    assert report.input_spec(0)[0] == "dp"
+    linears = report.op_specs(r"^linear")
+    assert len(linears) == 2
+    for label, spec in linears:
+        assert spec[0] == "dp", (label, spec)   # batch stays dp-split
+    assert report.output_spec(0)[0] == "dp"
+
+
+def test_replicated_fallback_is_visible():
+    """A reduction to scalar cannot stay sharded — the report shows the
+    fallback instead of hiding it (the 'no silent replication' check the
+    reference suites do on dist_attrs)."""
+    mesh = _mesh2d()
+    x = jax.device_put(jnp.ones((8, 16)), NamedSharding(mesh, P("dp", "mp")))
+    report = Completer(mesh).complete(lambda t: t.sum(), x)
+    assert report.output_spec(0) == ()
+    ops = report.op_specs(r"^sum")
+    assert ops and ops[0][1] in ((), None, "()"), ops

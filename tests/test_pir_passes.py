@@ -1,0 +1,106 @@
+"""Program-level pass/rewrite infra (VERDICT.md round-3 missing item 5;
+reference: PIR pattern rewriter + inference analysis passes — SURVEY.md
+§2.1 "PIR"). The lowered program is StableHLO; the infra must inspect it,
+rewrite it (MLIR pipelines and Python pattern rewrites), and round-trip
+back to an EXECUTABLE program."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import export as jexport
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static.pir import (MLIRPipelinePass, PatternRewritePass,
+                                   ProgramIR, optimize_exported, registry)
+
+
+def _export(fn, *example):
+    return jexport.export(jax.jit(fn))(*example)
+
+
+def test_inspect_op_histogram_and_walk():
+    def f(x):
+        return jnp.sin(x) * jnp.cos(x) + x
+
+    pir = ProgramIR.from_exported(_export(f, jnp.zeros((4,))))
+    hist = pir.op_histogram()
+    assert hist.get("stablehlo.sine") == 1
+    assert hist.get("stablehlo.cosine") == 1
+    assert len(pir.ops("stablehlo.multiply")) == 1
+    assert "stablehlo.sine" in pir.text
+
+
+def test_cse_pass_merges_duplicate_ops_and_executes():
+    def f(x):
+        return jnp.sin(x) + jnp.sin(x)     # two identical subtrees
+
+    exp = _export(f, jnp.zeros((4,)))
+    pir = ProgramIR.from_exported(exp)
+    assert pir.op_histogram().get("stablehlo.sine") == 2
+    changed = pir.apply(["ir_optim"])
+    assert changed
+    assert pir.op_histogram().get("stablehlo.sine") == 1
+    x = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    out = pir.to_exported().call(x)
+    np.testing.assert_allclose(np.asarray(out), 2 * np.sin(x), rtol=1e-6)
+
+
+def test_pattern_rewrite_pass_python_level():
+    """The drr-analogue: match by name+predicate, mutate via the MLIR
+    python API — here: retarget multiply to divide (program surgery XLA
+    would never do on its own)."""
+    def f(x, y):
+        return jnp.sin(x) * y
+
+    exp = _export(f, jnp.zeros((4,)), jnp.zeros((4,)))
+    pir = ProgramIR.from_exported(exp)
+
+    from jaxlib.mlir import ir
+
+    def to_divide(op):
+        with pir._ctx, ir.Location.unknown():
+            ir.InsertionPoint(op).insert(  # build divide next to multiply
+                new := ir.Operation.create(
+                    "stablehlo.divide", [r.type for r in op.results],
+                    list(op.operands)))
+            for old_r, new_r in zip(op.results, new.results):
+                old_r.replace_all_uses_with(new_r)
+            op.erase()
+
+    changed = pir.apply([PatternRewritePass(
+        "mul-to-div", lambda op: op.name == "stablehlo.multiply",
+        to_divide)])
+    assert changed
+    x = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    y = jnp.asarray([2.0, 2.0, 2.0, 2.0])
+    out = pir.to_exported().call(x, y)
+    np.testing.assert_allclose(np.asarray(out), np.sin(x) / 2, rtol=1e-6)
+
+
+def test_registry_and_unknown_pass():
+    assert {"canonicalize", "cse", "ir_optim"} <= set(registry.names())
+    with pytest.raises(KeyError, match="unknown pass"):
+        registry.get("nope")
+
+
+def test_predictor_ir_optim_knob(tmp_path):
+    """Config.switch_ir_optim(True) runs the pipeline on the loaded
+    program and the Predictor still serves correct outputs."""
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.jit import InputSpec
+
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(8, 8), nn.Tanh(), nn.Linear(8, 2))
+    net.eval()
+    xs = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    want = net(paddle.to_tensor(xs)).numpy()
+    prefix = str(tmp_path / "m")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([4, 8], "float32")])
+
+    cfg = Config(prefix)
+    cfg.switch_ir_optim(True)
+    pred = create_predictor(cfg)
+    (got,) = pred.run([xs])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
