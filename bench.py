@@ -416,10 +416,9 @@ def main():
         # VERDICT.md round-2 weak #1: a hung first Mosaic compile must
         # never happen in a process we can't afford to lose).
         try:
-            from paddle_tpu.utils.guarded_compile import (BENCH_KERNELS,
+            from paddle_tpu.utils.guarded_compile import (bench_kernels,
                                                           prove_all)
-            need = BENCH_KERNELS.get(os.environ.get("BENCH_MODEL", "resnet"),
-                                     [])
+            need = bench_kernels(os.environ.get("BENCH_MODEL", "resnet"))
             if need:
                 print(f"bench: proving kernels {need} in subprocess",
                       file=sys.stderr)

@@ -18,8 +18,10 @@ TPU-native design: instead of per-rank processes exchanging tensors with
   backward schedule and overlaps transfers with compute automatically.
 
 Constraint (same as the reference's p2p tensor-meta contract): every stage
-maps activations to the same shape/dtype. Bubble fraction matches 1F1B:
-``(S-1) / (M + S-1)`` for S stages, M microbatches.
+maps activations to ONE pytree of shapes/dtypes — any pytree (tuples/dicts
+of arrays), but uniform across stages; per-stage shape variance must be
+padded by the caller (lockstep SPMD rotates one buffer structure). Bubble
+fraction matches 1F1B: ``(S-1) / (M + S-1)`` for S stages, M microbatches.
 """
 from __future__ import annotations
 
@@ -57,37 +59,38 @@ def pipeline_spmd(stage_fn, n_stages, n_micro, axis_name="pp",
     def run(stacked_params, micro_inputs, base_key=None):
         params = jax.tree.map(lambda a: a[0], stacked_params)
         stage = jax.lax.axis_index(axis_name)
-        m = micro_inputs.shape[0]
+        m = jax.tree.leaves(micro_inputs)[0].shape[0]
         ticks = m + n_stages - 1
         perm = [(i, i + 1) for i in range(n_stages - 1)]
-        act_shape = micro_inputs.shape[1:]
-        act_dtype = micro_inputs.dtype
         is_last = stage == n_stages - 1
+        tmap = jax.tree.map
 
         def tick(carry, t):
             recv, out_buf = carry
             idx = t - stage                     # my microbatch this tick
             active = jnp.logical_and(idx >= 0, idx < m)
-            feed = micro_inputs[jnp.clip(t, 0, m - 1)]
-            x = jnp.where(stage == 0, feed, recv)
+            feed = tmap(lambda a: a[jnp.clip(t, 0, m - 1)], micro_inputs)
+            x = tmap(lambda f, r: jnp.where(stage == 0, f, r), feed, recv)
             if with_keys:
                 key = _chunk_key(base_key, jnp.clip(idx, 0, m - 1), stage)
                 y = stage_fn(params, x, key)
             else:
                 y = stage_fn(params, x)
-            y = jnp.where(active, y, jnp.zeros_like(y))
+            y = tmap(lambda a: jnp.where(active, a, jnp.zeros_like(a)), y)
             slot = jnp.clip(idx, 0, m - 1)
             write = jnp.logical_and(active, is_last)
-            out_buf = jnp.where(write, out_buf.at[slot].set(y), out_buf)
-            recv_next = jax.lax.ppermute(y, axis_name, perm)
+            out_buf = tmap(lambda b, a: jnp.where(write, b.at[slot].set(a),
+                                                  b), out_buf, y)
+            recv_next = tmap(lambda a: jax.lax.ppermute(a, axis_name, perm),
+                             y)
             return (recv_next, out_buf), None
 
-        out_buf = jnp.zeros((m,) + act_shape, act_dtype)
-        recv0 = jnp.zeros(act_shape, act_dtype)
+        out_buf = tmap(jnp.zeros_like, micro_inputs)
+        recv0 = tmap(lambda a: jnp.zeros(a.shape[1:], a.dtype), micro_inputs)
         (_, out_buf), _ = jax.lax.scan(tick, (recv0, out_buf),
                                        jnp.arange(ticks))
         # only the last stage wrote non-zeros; broadcast across pp ranks
-        return jax.lax.psum(out_buf, axis_name)
+        return tmap(lambda a: jax.lax.psum(a, axis_name), out_buf)
 
     return run
 
@@ -121,16 +124,15 @@ def pipeline_spmd_1f1b_bwd(stage_fn, n_stages, n_micro, axis_name="pp",
         import jax.random as jrandom
         params = jax.tree.map(lambda a: a[0], stacked_params)
         stage = jax.lax.axis_index(axis_name)
-        m = micro_inputs.shape[0]
+        m = jax.tree.leaves(micro_inputs)[0].shape[0]
         s_n = n_stages
         ring_n = 2 * s_n - 1
         ticks = m + 2 * (s_n - 1)
         perm_up = [(i, i + 1) for i in range(s_n - 1)]
         perm_dn = [(i + 1, i) for i in range(s_n - 1)]
-        act_shape = micro_inputs.shape[1:]
-        act_dtype = micro_inputs.dtype
         is_last = stage == s_n - 1
         const_key = jrandom.PRNGKey(0)
+        tmap = jax.tree.map
 
         def apply(p, x, key):
             return stage_fn(p, x, key) if with_keys else stage_fn(p, x)
@@ -141,41 +143,51 @@ def pipeline_spmd_1f1b_bwd(stage_fn, n_stages, n_micro, axis_name="pp",
             fi = t - stage
             f_act = jnp.logical_and(fi >= 0, fi < m)
             fi_c = jnp.clip(fi, 0, m - 1)
-            x_in = jnp.where(stage == 0, micro_inputs[fi_c], recv_f)
+            x_in = tmap(lambda mi, r: jnp.where(stage == 0, mi[fi_c], r),
+                        micro_inputs, recv_f)
             kf = (_chunk_key(base_key, fi_c, stage) if with_keys
                   else const_key)
             y = apply(params, x_in, kf)
-            y = jnp.where(f_act, y, jnp.zeros_like(y))
-            ring = jnp.where(f_act, ring.at[fi_c % ring_n].set(x_in), ring)
+            y = tmap(lambda a: jnp.where(f_act, a, jnp.zeros_like(a)), y)
+            ring = tmap(lambda rg, xa: jnp.where(
+                f_act, rg.at[fi_c % ring_n].set(xa), rg), ring, x_in)
             # -- backward half: microbatch t - (2(S-1) - stage) ----------
             bi = t - (2 * s_n - 2 - stage)
             b_act = jnp.logical_and(bi >= 0, bi < m)
             bi_c = jnp.clip(bi, 0, m - 1)
-            g_in = jnp.where(is_last, d_out[bi_c], recv_b)
-            x_sav = ring[bi_c % ring_n]
+            g_in = tmap(lambda d, r: jnp.where(is_last, d[bi_c], r),
+                        d_out, recv_b)
+            x_sav = tmap(lambda rg: rg[bi_c % ring_n], ring)
             kb = (_chunk_key(base_key, bi_c, stage) if with_keys
                   else const_key)
             _, vjp = jax.vjp(lambda p, x: apply(p, x, kb), params, x_sav)
             dp, dx = vjp(g_in)
-            dparams = jax.tree.map(
+            dparams = tmap(
                 lambda acc, g: acc + jnp.where(b_act, g, jnp.zeros_like(g)),
                 dparams, dp)
-            dx = jnp.where(b_act, dx, jnp.zeros_like(dx))
-            dx_buf = jnp.where(jnp.logical_and(b_act, stage == 0),
-                               dx_buf.at[bi_c].set(dx), dx_buf)
-            recv_f = jax.lax.ppermute(y, axis_name, perm_up)
-            recv_b = jax.lax.ppermute(dx, axis_name, perm_dn)
+            dx = tmap(lambda a: jnp.where(b_act, a, jnp.zeros_like(a)), dx)
+            dx_buf = tmap(lambda b, a: jnp.where(
+                jnp.logical_and(b_act, stage == 0), b.at[bi_c].set(a), b),
+                dx_buf, dx)
+            recv_f = tmap(lambda a: jax.lax.ppermute(a, axis_name, perm_up),
+                          y)
+            recv_b = tmap(lambda a: jax.lax.ppermute(a, axis_name, perm_dn),
+                          dx)
             return (recv_f, recv_b, ring, dparams, dx_buf), None
 
-        carry0 = (jnp.zeros(act_shape, act_dtype),
-                  jnp.zeros(act_shape, act_dtype),
-                  jnp.zeros((ring_n,) + act_shape, act_dtype),
+        def act0(a):
+            return jnp.zeros(a.shape[1:], a.dtype)
+
+        carry0 = (tmap(act0, micro_inputs),
+                  tmap(act0, micro_inputs),
+                  tmap(lambda a: jnp.zeros((ring_n,) + a.shape[1:], a.dtype),
+                       micro_inputs),
                   jax.tree.map(jnp.zeros_like, params),
-                  jnp.zeros((m,) + act_shape, act_dtype))
+                  tmap(jnp.zeros_like, micro_inputs))
         (_, _, _, dparams, dx_buf), _ = jax.lax.scan(
             tick, carry0, jnp.arange(ticks))
         dstacked = jax.tree.map(lambda a: a[None], dparams)
-        return dstacked, jax.lax.psum(dx_buf, axis_name)
+        return dstacked, tmap(lambda a: jax.lax.psum(a, axis_name), dx_buf)
 
     return run
 
@@ -599,9 +611,9 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
                 else:
                     x = stage_fn(p, x)
             return x
-        m = micro_inputs.shape[0]
+        m = jax.tree.leaves(micro_inputs)[0].shape[0]
         return jax.vmap(seq_all)(micro_inputs, jnp.arange(m))
-    n_micro = int(micro_inputs.shape[0])
+    n_micro = int(jax.tree.leaves(micro_inputs)[0].shape[0])
     if schedule == "1f1b":
         if vpp_degree > 1:
             raise ValueError("schedule='1f1b' supports vpp_degree == 1 only "
@@ -612,6 +624,10 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
                              with_keys)
         return call(stacked_params, micro_inputs, key)
     if vpp_degree > 1:
+        if not hasattr(micro_inputs, "shape"):
+            raise ValueError("the interleaved (VPP) schedule supports a "
+                             "single-array activation; pack pytree "
+                             "activations into one array or use vpp=1")
         # chunk-major [c] → slot-major [(k, d) → d*v + k ... ]: device d's
         # slot k must hold chunk d + k·S, and P('pp') splits contiguously,
         # so global order becomes [d=0: chunks 0, S, 2S…; d=1: 1, S+1, …]
@@ -627,6 +643,7 @@ def pipeline_forward(stage_fn, stacked_params, micro_inputs, *, mesh=None,
         run = pipeline_spmd(stage_fn, n_stages, n_micro, axis_name,
                             with_keys=with_keys)
     p_specs = jax.tree.map(lambda a: P(axis_name), stacked_params)
+    # bare P() is a pytree-prefix spec: replicates every activation leaf
     in_specs = (p_specs, P()) + ((P(),) if with_keys else ())
     mapped = jax.shard_map(
         run, mesh=mesh, in_specs=in_specs, out_specs=P(),

@@ -28,18 +28,40 @@ from ....framework.core import Parameter
 from ... import mesh as mesh_mod
 
 
-def shard_spec_for(shape, axis="sharding"):
-    """Shard the largest dim divisible by the axis size; else replicate."""
+def shard_spec_for(shape, axis="sharding", existing=None):
+    """Shard the largest dim divisible by the axis size; else replicate.
+
+    ``existing``: a PartitionSpec-like tuple already on the tensor (e.g.
+    the mp placement of a Column/RowParallelLinear or vocab-parallel
+    embedding weight). Dims it occupies are excluded and its entries are
+    PRESERVED in the returned spec — ZeRO-3 must compose with, never
+    clobber, the tensor-parallel layout."""
     n = mesh_mod.axis_size(axis)
     if n <= 1:
         return None
+    taken = list(existing) + [None] * (len(shape) - len(existing)) \
+        if existing is not None else [None] * len(shape)
+    flat_taken = [a for t in taken if t is not None
+                  for a in (t if isinstance(t, tuple) else (t,))]
+    if axis in flat_taken:
+        return tuple(taken)      # already sharded over this axis — keep
     dims = sorted(range(len(shape)), key=lambda i: -shape[i])
     for d in dims:
-        if shape[d] % n == 0 and shape[d] >= n:
-            spec = [None] * len(shape)
+        if taken[d] is None and shape[d] % n == 0 and shape[d] >= n:
+            spec = list(taken)
             spec[d] = axis
             return tuple(spec)
     return None
+
+
+def _existing_spec(arr):
+    """The PartitionSpec already placed on ``arr`` (None if uncommitted,
+    single-device, or fully replicated)."""
+    sh = getattr(arr, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    if spec is None or all(s is None for s in spec):
+        return None
+    return tuple(spec)
 
 
 def _place(arr, spec):
@@ -68,7 +90,8 @@ class DygraphShardingOptimizer:
             if slots is None or key in self._sharded:
                 continue
             for name, arr in slots.items():
-                spec = shard_spec_for(arr.shape)
+                spec = shard_spec_for(arr.shape,
+                                      existing=_existing_spec(arr))
                 slots[name] = _place(arr, spec)
             self._sharded.add(key)
 
@@ -92,7 +115,8 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
     def step(self):
         for p in self._inner_opt._parameter_list:
             if p.grad is not None:
-                spec = shard_spec_for(p.grad._data.shape)
+                spec = shard_spec_for(p.grad._data.shape,
+                                      existing=_existing_spec(p.grad._data))
                 p.grad._data = _place(p.grad._data, spec)
         super().step()
 
@@ -116,7 +140,8 @@ class GroupShardedStage2:
         for p in layer.parameters():
             if p is None:
                 continue
-            spec = shard_spec_for(p._data.shape)
+            spec = shard_spec_for(p._data.shape,
+                                  existing=_existing_spec(p._data))
             if spec is not None:
                 self._hooks.append(p.register_hook(
                     lambda g, _spec=spec: _place_tensor(g, _spec)))
@@ -153,7 +178,8 @@ class GroupShardedStage3:
         for p in layer.parameters():
             if p is None:
                 continue
-            spec = shard_spec_for(p._data.shape)
+            spec = shard_spec_for(p._data.shape,
+                                  existing=_existing_spec(p._data))
             if spec is not None:
                 p._sharding_spec = spec
                 p._data = _place(p._data, spec)

@@ -37,8 +37,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float(-1e30)   # large-negative instead of -inf: keeps exp()/where() NaN-free
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Tunable via env for the MFU sweep (BASELINE.md): block sizes set the
+# VMEM working set vs grid-parallelism trade on the MXU — 128 is the safe
+# default; 256/512 on Q can lift arithmetic intensity at long seq.
+import os as _os
+
+DEFAULT_BLOCK_Q = int(_os.environ.get("PADDLE_TPU_FA_BLOCK_Q", "128"))
+DEFAULT_BLOCK_K = int(_os.environ.get("PADDLE_TPU_FA_BLOCK_K", "128"))
 
 
 def _cdiv(a, b):
@@ -477,7 +482,12 @@ def _mosaic_allowed():
     if jax.default_backend() != "tpu":
         return True
     from ...utils.guarded_compile import kernel_allowed
-    return kernel_allowed("flash_attention", "flash attention kernel")
+    # non-default block sizes are a DIFFERENT Mosaic compile — key the
+    # proof on them so a sweep config can't ride the 128x128 proof
+    kid = "flash_attention"
+    if (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K) != (128, 128):
+        kid = f"flash_attention_q{DEFAULT_BLOCK_Q}k{DEFAULT_BLOCK_K}"
+    return kernel_allowed(kid, "flash attention kernel")
 
 
 def flash_attention(q, k, v, causal=True, sm_scale=None, q_offset=0,

@@ -70,13 +70,20 @@ if jax.default_backend() != "tpu":
 
 CANARIES = {
     "flash_attention": _REQUIRE_TPU + """
+import os
 import numpy as np, jax, jax.numpy as jnp
 from paddle_tpu.ops.pallas.flash_attention import (
     flash_attention, flash_attention_with_lse, mha_reference)
+# the proof must compile the ACTUAL block configuration: _fwd clamps
+# block_q/k to the sequence length, so a 256-long canary would silently
+# prove a clamped kernel for a 512-block sweep config
+seq = max(256,
+          2 * int(os.environ.get("PADDLE_TPU_FA_BLOCK_Q", "128")),
+          2 * int(os.environ.get("PADDLE_TPU_FA_BLOCK_K", "128")))
 rs = np.random.RandomState(0)
-q = jnp.asarray(rs.randn(1, 256, 4, 128), jnp.bfloat16)
-k = jnp.asarray(rs.randn(1, 256, 2, 128), jnp.bfloat16)   # GQA group 2
-v = jnp.asarray(rs.randn(1, 256, 2, 128), jnp.bfloat16)
+q = jnp.asarray(rs.randn(1, seq, 4, 128), jnp.bfloat16)
+k = jnp.asarray(rs.randn(1, seq, 2, 128), jnp.bfloat16)   # GQA group 2
+v = jnp.asarray(rs.randn(1, seq, 2, 128), jnp.bfloat16)
 def loss(q, k, v):
     out = flash_attention(q, k, v, causal=True, interpret=False)
     return out.astype(jnp.float32).sum()
@@ -167,12 +174,29 @@ print("PROOF_OK")
 }
 
 # Kernels each bench workload needs proven before its TPU child starts.
-BENCH_KERNELS = {
-    "resnet": [],
-    "llama": ["flash_attention"],
-    "llama_decode": ["flash_attention", "paged_attention"],
-    "data": [],
-}
+def _fa_kernel_id() -> str:
+    """The flash-attention kernel id for the current block-size config —
+    read from the SAME import-time module constants the call-site gate
+    (ops/pallas/flash_attention._mosaic_allowed) uses, so the proved id
+    and the gated id can never diverge (env changes after import are
+    consistently ignored by both)."""
+    import importlib
+    _fa_mod = importlib.import_module(
+        "paddle_tpu.ops.pallas.flash_attention")
+    bq, bk = _fa_mod.DEFAULT_BLOCK_Q, _fa_mod.DEFAULT_BLOCK_K
+    if (bq, bk) == (128, 128):
+        return "flash_attention"
+    return f"flash_attention_q{bq}k{bk}"
+
+
+def bench_kernels(mode: str):
+    """Kernel ids a bench mode must prove before spawning its child."""
+    return {
+        "resnet": [],
+        "llama": [_fa_kernel_id()],
+        "llama_decode": [_fa_kernel_id(), "paged_attention"],
+        "data": [],
+    }.get(mode, [])
 
 
 def _proof_dir() -> str:
@@ -186,6 +210,23 @@ def _proof_dir() -> str:
 
 def _marker(kernel_id: str, state: str) -> str:
     return os.path.join(_proof_dir(), f"{kernel_id}.{state}")
+
+
+def _canary_src(kernel_id: str, missing_ok: bool = False):
+    """Canary source for a kernel id. Configuration-suffixed ids (e.g.
+    ``flash_attention_q256k128`` from the block-size sweep) reuse the base
+    kernel's canary — the child inherits the env that selects the config,
+    so the proof compiles the ACTUAL variant while the id keeps the latch
+    distinct per configuration."""
+    if kernel_id in CANARIES:
+        return CANARIES[kernel_id]
+    base = max((k for k in CANARIES if kernel_id.startswith(k + "_")),
+               key=len, default=None)
+    if base is not None:
+        return CANARIES[base]
+    if missing_ok:
+        return None
+    raise KeyError(kernel_id)
 
 
 # Per-process memo of terminal proof states: one stat() per kernel per
@@ -236,7 +277,7 @@ def prove(kernel_id: str, timeout: float = 420.0, src: str | None = None,
     if st != _UNKNOWN:
         return st == _OK
     if src is None:
-        src = CANARIES[kernel_id]
+        src = _canary_src(kernel_id)
     child_env = dict(env if env is not None else os.environ)
     # Unconditional, NOT setdefault: if the child inherited strict it
     # would gate its own kernel, exercise only the XLA fallback, and
@@ -298,7 +339,7 @@ def kernel_allowed(kernel_id: str, what: str = "Pallas kernel",
         return False
     if mode == "trust":
         return True
-    if mode == "prove" and kernel_id in CANARIES:
+    if mode == "prove" and _canary_src(kernel_id, missing_ok=True):
         return prove(kernel_id)
     warnings.warn(
         f"{what} '{kernel_id}' has not been proven on this backend; "

@@ -117,8 +117,9 @@ def test_canaries_registered():
               "ring_attention"):
         assert k in gc.CANARIES
         assert "PROOF_OK" in gc.CANARIES[k]
-    for mode, kernels in gc.BENCH_KERNELS.items():
-        assert all(k in gc.CANARIES for k in kernels)
+    for mode in ("resnet", "llama", "llama_decode", "data"):
+        for k in gc.bench_kernels(mode):
+            assert gc._canary_src(k, missing_ok=True) is not None, k
 
 
 def test_cli(proof_dir, capsys):

@@ -41,6 +41,10 @@ while true; do
       "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1" \
       "BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=1" \
       "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=4096 BENCH_REMAT=1" \
+      "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=0" \
+      "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1 PADDLE_TPU_FA_BLOCK_Q=256" \
+      "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1 PADDLE_TPU_FA_BLOCK_Q=256 PADDLE_TPU_FA_BLOCK_K=256" \
+      "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=4096 BENCH_REMAT=1 PADDLE_TPU_FA_BLOCK_Q=512" \
       "BENCH_BATCH=16 BENCH_SEQ=2048" \
       "BENCH_BATCH=32 BENCH_SEQ=1024" ; do
       line=$(env $cfg BENCH_MODEL=llama BENCH_PROBE_TIMEOUT=150 \
