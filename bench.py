@@ -260,6 +260,58 @@ def bench_llama():
     }
 
 
+def bench_bert():
+    """Config-2 (BASELINE.json configs[1]): BERT/ERNIE-base fine-tune
+    step time through the @to_static → HLO path on one device."""
+    import time
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import (BertConfig,
+                                        BertForSequenceClassification)
+
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    seq = int(os.environ.get("BENCH_SEQ", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    paddle.seed(0)
+    cfg = BertConfig()                    # base size: L12 H768 A12
+    model = BertForSequenceClassification(cfg)
+    model.eval()                          # deterministic step timing
+    static = paddle.jit.to_static(model)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-5,
+                                 parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (batch, seq)))
+    labels = paddle.to_tensor(rng.integers(0, cfg.num_labels, (batch,)))
+    mask = paddle.to_tensor(
+        (rng.random((batch, seq)) < 0.9).astype(np.int64))
+
+    def step():
+        loss, _ = static(ids, attention_mask=mask, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    loss = step()                          # compile
+    jax.block_until_ready(loss._data)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    jax.block_until_ready(loss._data)
+    dt = (time.perf_counter() - t0) / steps
+    return {
+        "metric": "bert_base_finetune_step_ms",
+        "value": round(dt * 1e3, 2),
+        "unit": "ms/step",
+        "vs_baseline": None,
+        "config": {"batch": batch, "seq": seq},
+        "samples_per_sec": round(batch / dt, 2),
+    }
+
+
 def bench_dispatch():
     """Eager (dygraph) per-op dispatch overhead vs raw jax — SURVEY §7.3
     item 1's top risk, measured. Reports µs/op for a no-grad elementwise
@@ -352,6 +404,7 @@ def _child_main():
            else bench_llama_decode() if mode == "llama_decode"
            else bench_data() if mode == "data"
            else bench_dispatch() if mode == "dispatch"
+           else bench_bert() if mode == "bert"
            else bench_resnet())
     import jax
     out["backend"] = jax.devices()[0].platform.lower()
@@ -462,11 +515,13 @@ def main():
                    else "dataloader_hbm_samples_per_sec" if mode == "data"
                    else "eager_dispatch_overhead_vs_jax"
                    if mode == "dispatch"
+                   else "bert_base_finetune_step_ms" if mode == "bert"
                    else "resnet50_cifar10_train_throughput"),
         "value": None,
         "unit": ("tokens/sec" if mode in ("llama", "llama_decode")
                  else "samples/sec" if mode == "data"
                  else "x" if mode == "dispatch"
+                 else "ms/step" if mode == "bert"
                  else "images/sec"),
         "vs_baseline": None,
         "error": (" || ".join(e.replace("\n", " ")[:300]

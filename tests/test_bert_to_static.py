@@ -1,0 +1,71 @@
+"""Config-2 exit criterion (SURVEY.md §7.1 M1, BASELINE.json configs[1]):
+BERT/ERNIE-base fine-tune through ``@to_static`` — the dygraph↔static
+parity contract, with the compiled path actually taken (no graph-break
+fallback)."""
+import warnings
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models.bert import (BertForSequenceClassification,
+                                    ErnieForSequenceClassification,
+                                    ErnieConfig, bert_tiny)
+
+
+def _data(cfg, batch=8, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq))
+    labels = rng.integers(0, cfg.num_labels, (batch,))
+    mask = np.ones((batch, seq), np.int64)
+    mask[:, seq // 2:] = 0
+    return (paddle.to_tensor(ids), paddle.to_tensor(labels),
+            paddle.to_tensor(mask))
+
+
+def _finetune(model, ids, labels, mask, steps=6, static=False):
+    fwd = paddle.jit.to_static(model) if static else model
+    opt = paddle.optimizer.AdamW(learning_rate=5e-4,
+                                 parameters=model.parameters())
+    losses = []
+    for _ in range(steps):
+        loss, _ = fwd(ids, attention_mask=mask, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def test_bert_finetune_to_static_matches_eager():
+    cfg = bert_tiny()
+    paddle.seed(3)
+    eager = BertForSequenceClassification(cfg)
+    paddle.seed(3)
+    static = BertForSequenceClassification(cfg)
+    static.set_state_dict(eager.state_dict())
+    # dropout must be deterministic across both paths for exact parity
+    eager.eval()
+    static.eval()
+    ids, labels, mask = _data(cfg)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")      # a graph break fails the test
+        l_static = _finetune(static, ids, labels, mask, static=True)
+    l_eager = _finetune(eager, ids, labels, mask, static=False)
+    np.testing.assert_allclose(l_static, l_eager, rtol=2e-4, atol=2e-5)
+    assert l_static[-1] < l_static[0], l_static
+    sf = static.forward
+    assert all(not e["fallback"] for e in sf._cache.values())
+
+
+def test_ernie_finetune_to_static_learns():
+    cfg = ErnieConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=128,
+                      max_position_embeddings=64)
+    paddle.seed(5)
+    model = ErnieForSequenceClassification(cfg)
+    model.eval()
+    ids, labels, mask = _data(cfg)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        losses = _finetune(model, ids, labels, mask, steps=8, static=True)
+    assert losses[-1] < losses[0] * 0.9, losses

@@ -15,7 +15,7 @@ while true; do
     {
       echo '{"session": "round4", "captured_at": "'"$(date -u +%Y-%m-%dT%H:%M:%SZ)"'", "results": ['
       first=1
-      for spec in resnet llama llama_decode data resnet+BENCH_DATA=loader; do
+      for spec in resnet llama llama_decode bert data resnet+BENCH_DATA=loader; do
         mode=${spec%%+*}
         extra=""
         [ "$spec" != "$mode" ] && extra=${spec#*+}
