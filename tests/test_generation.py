@@ -73,3 +73,61 @@ def test_gpt_generate_cached_matches_uncached():
     out_full = model.generate(ids, max_new_tokens=3)
     model.supports_cache = True
     np.testing.assert_array_equal(out.numpy(), out_full.numpy())
+
+
+# ---------------------------------------------------------------------------
+# beam search (reference decode_strategy="beam_search")
+# ---------------------------------------------------------------------------
+
+def _seq_logprob(model, seq, prompt_len):
+    """Sum of per-token log-probs the model assigns to seq's generated
+    part (teacher forcing)."""
+    import jax.numpy as jnp
+    import jax
+    logits = model(paddle.to_tensor(seq[None, :-1]))._data.astype("float32")
+    lp = jax.nn.log_softmax(logits, axis=-1)[0]
+    tgt = jnp.asarray(seq[1:])
+    tok = jnp.take_along_axis(lp, tgt[:, None], axis=-1)[:, 0]
+    return float(tok[prompt_len - 1:].sum())
+
+
+def test_beam1_matches_greedy():
+    paddle.seed(4)
+    model = LlamaForCausalLM(llama_tiny())
+    ids = _ids(b=2, s=4, seed=7)
+    greedy = model.generate(ids, max_new_tokens=5)
+    beam1 = model.generate(ids, max_new_tokens=5, num_beams=1)
+    np.testing.assert_array_equal(greedy.numpy(), beam1.numpy())
+
+
+def test_beam_search_finds_no_worse_sequences():
+    paddle.seed(5)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    ids = _ids(b=2, s=4, seed=9)
+    greedy = model.generate(ids, max_new_tokens=6).numpy()
+    beams = model.generate(ids, max_new_tokens=6, num_beams=4).numpy()
+    assert beams.shape == greedy.shape
+    np.testing.assert_array_equal(beams[:, :4], ids.numpy())
+    for r in range(2):
+        g = _seq_logprob(model, greedy[r], 4)
+        b = _seq_logprob(model, beams[r], 4)
+        assert b >= g - 1e-4, (r, b, g)
+
+
+def test_beam_search_cache_matches_uncached():
+    paddle.seed(6)
+    model = LlamaForCausalLM(llama_tiny())
+    ids = _ids(b=2, s=3, seed=11)
+    cached = model.generate(ids, max_new_tokens=5, num_beams=3).numpy()
+    model.supports_cache = False
+    full = model.generate(ids, max_new_tokens=5, num_beams=3).numpy()
+    model.supports_cache = True
+    np.testing.assert_array_equal(cached, full)
+
+
+def test_beam_search_rejects_sampling():
+    paddle.seed(7)
+    model = LlamaForCausalLM(llama_tiny())
+    with pytest.raises(ValueError, match="do_sample"):
+        model.generate(_ids(), num_beams=2, do_sample=True)
