@@ -37,6 +37,26 @@ _initialized = [False]
 def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
     global _strategy
     _strategy = strategy or DistributedStrategy()
+    if not is_collective or (role_maker is not None
+                             and not getattr(role_maker, "_is_collective",
+                                             True)):
+        # parameter-server mode (reference fleet.init(is_collective=False)):
+        # no device mesh — role/endpoint bookkeeping only, servers and
+        # workers rendezvous over the PS RPC tier instead of collectives.
+        old_client, old_server = _ps_state.get("client"), _ps_state.get(
+            "server")
+        if old_client is not None:
+            old_client.close()
+        # keep a still-serving server (same-process server+trainer jobs,
+        # simulators); discard a shut-down one so a NEW job can't silently
+        # reuse its closed socket
+        if old_server is not None and old_server._shutdown.is_set():
+            old_server.stop()
+            old_server = None
+        _ps_state.update(role_maker=role_maker or PaddleCloudRoleMaker(
+            is_collective=False), mode="ps", server=old_server, client=None)
+        _initialized[0] = True
+        return
     init_parallel_env()
     if getattr(_strategy, "auto_search", False):
         _apply_auto_search(_strategy)
@@ -157,19 +177,78 @@ def barrier_worker():
     barrier()
 
 
-# -- parameter-server mode: explicitly out of TPU scope (SURVEY.md §7.4) -----
-def _ps_stub(name):
-    def fn(*a, **k):
-        raise NotImplementedError(
-            f"fleet.{name} belongs to parameter-server mode, which is not in "
-            "the TPU build (SURVEY.md §7.4); use collective mode")
-    return fn
+# -- parameter-server mode (reference: fleet PS path + the_one_ps.py;
+# SURVEY.md §2.3 "PS mode"). SURVEY §7.4 scoped this note-only; the
+# working TPU-native re-design lives in paddle_tpu.distributed.ps and
+# this is its role/lifecycle facade. ------------------------------------
+_ps_state: dict = {"mode": None, "role_maker": None, "server": None,
+                   "client": None}
 
 
-init_worker = _ps_stub("init_worker")
-init_server = _ps_stub("init_server")
-run_server = _ps_stub("run_server")
-stop_worker = _ps_stub("stop_worker")
+def _ps_role():
+    rm = _ps_state.get("role_maker")
+    if rm is None or _ps_state.get("mode") != "ps":
+        raise RuntimeError(
+            "fleet is not in parameter-server mode; call "
+            "fleet.init(PaddleCloudRoleMaker(is_collective=False)) or "
+            "fleet.init(is_collective=False) first")
+    return rm
+
+
+def is_server():
+    return _ps_role().is_server()
+
+
+def is_worker():
+    return _ps_role().is_worker()
+
+
+def init_server(*model_dirs, **kwargs):
+    """Bind this process's PSServer on its endpoint from the role maker.
+    A still-serving server kept across fleet.init() is reused — binding a
+    second socket on the same endpoint would EADDRINUSE."""
+    from ..ps import PSServer
+    srv = _ps_state.get("server")
+    if srv is not None and not srv._shutdown.is_set():
+        return srv
+    rm = _ps_role()
+    host, port = rm.server_endpoint().rsplit(":", 1)
+    _ps_state["server"] = PSServer(host=host, port=int(port))
+    return _ps_state["server"]
+
+
+def run_server():
+    """Blocking serve loop (reference fleet.run_server); returns after a
+    worker calls stop_worker() → SHUTDOWN."""
+    srv = _ps_state.get("server") or init_server()
+    srv.run()
+
+
+def init_worker():
+    """Create the trainer-side PSClient over all server endpoints."""
+    from ..ps import PSClient
+    rm = _ps_role()
+    _ps_state["client"] = PSClient(rm.server_endpoints(),
+                                   async_push=getattr(_strategy, "a_sync",
+                                                      False))
+    return _ps_state["client"]
+
+
+def ps_client():
+    c = _ps_state.get("client")
+    if c is None:
+        raise RuntimeError("call fleet.init_worker() first")
+    return c
+
+
+def stop_worker():
+    c = _ps_state.get("client")
+    if c is not None:
+        c.flush()
+        if _ps_role().worker_index() == 0:
+            c.shutdown_servers()
+        c.close()
+        _ps_state["client"] = None
 
 
 class UserDefinedRoleMaker:
@@ -178,5 +257,49 @@ class UserDefinedRoleMaker:
 
 
 class PaddleCloudRoleMaker:
+    """Parses the reference's PaddleCloud environment contract
+    (``TRAINING_ROLE``, ``PADDLE_PSERVERS_IP_PORT_LIST``,
+    ``PADDLE_TRAINERS_NUM``, ``POD_IP``/``PADDLE_PORT``) so PS jobs
+    launched by the reference's cluster scripts resolve roles unchanged."""
+
     def __init__(self, is_collective=True, **kwargs):
         self._is_collective = is_collective
+        import os
+        self._role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_eps = [e for e in eps.replace(";", ",").split(",") if e]
+        self._trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._pod_ip = os.environ.get("POD_IP", "127.0.0.1")
+        self._port = os.environ.get("PADDLE_PORT", "")
+
+    def is_server(self):
+        return self._role == "PSERVER"
+
+    def is_worker(self):
+        return self._role == "TRAINER"
+
+    def server_endpoints(self):
+        return list(self._server_eps)
+
+    def server_endpoint(self):
+        """This PSERVER's own bind endpoint: POD_IP:PADDLE_PORT when it
+        matches the server list; else the list entry with this PADDLE_PORT
+        (POD_IP unset on some clusters); else list[0]; else the local
+        pair."""
+        me = f"{self._pod_ip}:{self._port}"
+        if me in self._server_eps:
+            return me
+        if self._port:
+            for ep in self._server_eps:
+                if ep.rsplit(":", 1)[-1] == self._port:
+                    return ep
+        if self._server_eps:
+            return self._server_eps[0]
+        return me if self._port else "127.0.0.1:0"
+
+    def worker_index(self):
+        return self._trainer_id
+
+    def worker_num(self):
+        return self._trainers

@@ -1,0 +1,20 @@
+"""Parameter-server mode (reference: ``paddle/fluid/distributed/ps/`` +
+``python/paddle/distributed/ps/the_one_ps.py`` — SURVEY.md §2.1/§2.3).
+
+SURVEY §7.4 scoped this to note-only for the TPU build; this module
+closes the row with a working TPU-native re-design rather than a brpc
+port: host-resident sharded :class:`SparseTable`s behind a raw-numpy
+socket RPC (:class:`PSServer`/:class:`PSClient`), and a
+:class:`DistributedEmbedding` layer whose backward pushes sparse grads
+through the autograd tape's accumulation hook. The TPU device only ever
+sees dense pulled rows — the jit'd dense step is unchanged.
+
+Role wiring (``fleet.init(role_maker, is_collective=False)`` +
+``fleet.run_server()`` / ``init_worker()``) lives in
+``paddle_tpu.distributed.fleet``.
+"""
+from .table import SparseTable
+from .service import PSClient, PSServer
+from .layers import DistributedEmbedding
+
+__all__ = ["SparseTable", "PSClient", "PSServer", "DistributedEmbedding"]

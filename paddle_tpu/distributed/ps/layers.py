@@ -1,0 +1,118 @@
+"""Trainer-side sparse layers for parameter-server mode.
+
+Reference: ``python/paddle/distributed/ps/the_one_ps.py`` +
+``paddle.static.nn.sparse_embedding`` (SURVEY.md §2.3 "PS mode"): an
+embedding whose weight lives on the parameter servers; forward pulls
+only the rows this batch touches, backward pushes only their gradients.
+
+TPU-native shape: the pull happens on the host (eager, per batch), the
+pulled rows become a dense [unique, dim] device tensor, and everything
+downstream — gather, dense net, loss, backward — is ordinary tape
+autograd on device. The tape's gradient hook on the pulled-rows leaf is
+the push: sparse grads leave for the server the moment they are
+accumulated, which IS async-SGD when the client queues pushes.
+
+Modes (reference ``DistributedStrategy`` a_sync/geo):
+* ``"sync"``  — push blocks until the server applied the update.
+* ``"async"`` — pushes drain on a background thread (a_sync=True).
+* ``"geo"``   — trainer-local SGD on a cached copy; accumulated deltas
+  are merged into the server every ``geo_k`` steps and the cache is
+  refreshed (geo-SGD).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...nn.layer import Layer
+from ...ops.manipulation import gather as _gather
+from ...ops.manipulation import reshape as _reshape
+
+
+class DistributedEmbedding(Layer):
+    """Embedding backed by a :class:`~..ps.service.PSClient` table."""
+
+    def __init__(self, embedding_dim, client, table_id=None, mode="async",
+                 optimizer="adagrad", learning_rate=0.05,
+                 initializer="uniform", init_range=0.01, geo_k=8,
+                 name=None):
+        super().__init__(name_scope=name)
+        if mode not in ("sync", "async", "geo"):
+            raise ValueError(f"mode must be sync/async/geo, got {mode!r}")
+        self.embedding_dim = int(embedding_dim)
+        self.client = client
+        if table_id is None:
+            table_id = client.next_auto_table_id()
+        self.table_id = int(table_id)
+        self.mode = mode
+        self.geo_k = int(geo_k)
+        self._geo_lr = float(learning_rate)
+        # geo: key -> [row (local), delta (pending merge)]
+        self._geo_cache: dict[int, list] = {}
+        self._geo_step = 0
+        client.create_table(
+            self.table_id, dim=self.embedding_dim,
+            # geo trainers own the optimizer locally; the server only merges
+            optimizer="sgd" if mode == "geo" else optimizer,
+            lr=learning_rate, initializer=initializer,
+            init_range=init_range)
+
+    # -- geo-SGD cache ------------------------------------------------------
+    def _geo_rows(self, uniq):
+        missing = [k for k in uniq if int(k) not in self._geo_cache]
+        if missing:
+            pulled = self.client.pull(self.table_id,
+                                      np.asarray(missing, np.int64))
+            for k, r in zip(missing, pulled):
+                self._geo_cache[int(k)] = [r.copy(),
+                                           np.zeros_like(r)]
+        return np.stack([self._geo_cache[int(k)][0] for k in uniq])
+
+    def _geo_apply(self, uniq, grad):
+        for k, g in zip(uniq, grad):
+            ent = self._geo_cache[int(k)]
+            upd = self._geo_lr * g
+            ent[0] -= upd
+            ent[1] -= upd
+        self._geo_step += 1
+        if self._geo_step % self.geo_k == 0:
+            keys = np.fromiter(self._geo_cache.keys(), np.int64,
+                               len(self._geo_cache))
+            deltas = np.stack([self._geo_cache[int(k)][1] for k in keys])
+            touched = np.abs(deltas).sum(axis=1) > 0
+            if touched.any():
+                self.client.push_delta(self.table_id, keys[touched],
+                                       deltas[touched])
+            fresh = self.client.pull(self.table_id, keys)
+            for k, r in zip(keys, fresh):
+                self._geo_cache[int(k)] = [r.copy(), np.zeros_like(r)]
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, ids):
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor)
+                            else ids).astype(np.int64)
+        uniq, inv = np.unique(ids_np, return_inverse=True)
+        if self.mode == "geo":
+            rows_np = self._geo_rows(uniq)
+        else:
+            rows_np = self.client.pull(self.table_id, uniq)
+        rows = Tensor(jnp.asarray(rows_np), stop_gradient=False)
+
+        def _push(grad):
+            g = np.asarray(grad._data if isinstance(grad, Tensor)
+                           else grad, np.float32)
+            if self.mode == "geo":
+                self._geo_apply(uniq, g)
+            else:
+                self.client.push_grad(self.table_id, uniq, g)
+            return grad
+
+        if self.training:
+            rows.register_hook(_push)
+        out = _gather(rows, Tensor(jnp.asarray(inv, jnp.int32)), axis=0)
+        return _reshape(out, tuple(ids_np.shape) + (self.embedding_dim,))
+
+    def extra_repr(self):
+        return (f"dim={self.embedding_dim}, table={self.table_id}, "
+                f"mode={self.mode}, servers={len(self.client.endpoints)}")

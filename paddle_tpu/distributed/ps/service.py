@@ -1,0 +1,386 @@
+"""Parameter-server RPC tier: length-prefixed binary protocol over TCP.
+
+Reference: ``paddle/fluid/distributed/ps/service/`` (brpc handlers for
+pull_sparse/push_sparse, server registry — SURVEY.md §2.1). The brpc
+stack is GPU-cluster plumbing; here the wire is a ~60-byte fixed header
+plus raw little-endian numpy buffers, so a pull of 100k×64 rows is one
+25 MB read straight into an ndarray — no serialization layer to feed
+the host CPUs that should be feeding the TPU.
+
+Frame: ``[u32 len][u8 op][u32 table][u32 n][u32 dim]`` then ``n`` int64
+keys then (push ops) ``n*dim`` float32 payload. CONFIG/SAVE/LOAD carry a
+JSON body instead. Responses: ``[u32 len][u8 status]`` + payload.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from .table import SparseTable
+
+OP_CONFIG, OP_PULL, OP_PUSH_GRAD, OP_PUSH_DELTA = 0, 1, 2, 3
+OP_SAVE, OP_LOAD, OP_STATS, OP_SHUTDOWN = 4, 5, 6, 7
+_HDR = struct.Struct("<BIII")
+
+
+def _read_exact(sock, n):
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed")
+        got += r
+    return bytes(buf)
+
+
+def _send_frame(sock, *parts):
+    body = b"".join(parts)
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def _recv_frame(sock):
+    (n,) = struct.unpack("<I", _read_exact(sock, 4))
+    return _read_exact(sock, n)
+
+
+class PSServer:
+    """One parameter-server process/thread: hosts this shard's tables and
+    answers pull/push RPCs until SHUTDOWN."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._tables: dict[int, SparseTable] = {}
+        self._tlock = threading.Lock()
+        self._shutdown = threading.Event()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        frame = _recv_frame(self.request)
+                        resp = outer._dispatch(frame)
+                        _send_frame(self.request, resp)
+                        if frame[0] == OP_SHUTDOWN:
+                            return
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server((host, port), Handler)
+        self.host, self.port = self._srv.server_address[:2]
+        self._thread = None
+
+    @property
+    def endpoint(self):
+        return f"{self.host}:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Blocking serve (fleet.run_server)."""
+        self.start()
+        self._shutdown.wait()
+        self._srv.shutdown()
+
+    def stop(self):
+        self._shutdown.set()
+        # shutdown() blocks on an event only serve_forever() sets — calling
+        # it on a never-started server would wait forever
+        if self._thread is not None and self._thread.is_alive():
+            self._srv.shutdown()
+        self._srv.server_close()
+
+    # -- dispatch -----------------------------------------------------------
+    def _table(self, tid):
+        with self._tlock:
+            t = self._tables.get(tid)
+        if t is None:
+            raise KeyError(f"table {tid} not configured")
+        return t
+
+    def _dispatch(self, frame):
+        op, tid, n, dim = _HDR.unpack_from(frame)
+        body = frame[_HDR.size:]
+        try:
+            if op == OP_CONFIG:
+                cfg = json.loads(body.decode())
+                with self._tlock:
+                    t = self._tables.get(tid)
+                    if t is None:
+                        self._tables[tid] = SparseTable(**cfg)
+                    else:
+                        # a second trainer must see the LIVE config or an
+                        # error — never silently train under different
+                        # optimizer/lr than it asked for
+                        want = {"dim": int(cfg.get("dim", t.dim)),
+                                "optimizer": cfg.get("optimizer",
+                                                     t.optimizer),
+                                "lr": float(cfg.get("lr", t.lr)),
+                                "initializer": cfg.get("initializer",
+                                                       t.initializer)}
+                        have = {"dim": t.dim, "optimizer": t.optimizer,
+                                "lr": t.lr, "initializer": t.initializer}
+                        if want != have:
+                            return (b"\x01" + f"table {tid} already exists "
+                                    f"with {have}, requested {want}"
+                                    .encode())
+                return b"\x00"
+            if op == OP_PULL:
+                keys = np.frombuffer(body, "<i8", n)
+                rows = self._table(tid).pull(keys)
+                return b"\x00" + rows.astype("<f4", copy=False).tobytes()
+            if op in (OP_PUSH_GRAD, OP_PUSH_DELTA):
+                keys = np.frombuffer(body, "<i8", n)
+                vals = np.frombuffer(body, "<f4", n * dim,
+                                     offset=n * 8).reshape(n, dim)
+                t = self._table(tid)
+                (t.push_grad if op == OP_PUSH_GRAD else t.push_delta)(
+                    keys, vals)
+                return b"\x00"
+            if op == OP_SAVE:
+                path = json.loads(body.decode())["path"]
+                st = self._table(tid).state()
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                np.savez(path, **st)
+                return b"\x00"
+            if op == OP_LOAD:
+                path = json.loads(body.decode())["path"]
+                with np.load(path) as z:
+                    self._table(tid).load_state(
+                        {k: z[k] for k in ("keys", "rows", "acc")})
+                return b"\x00"
+            if op == OP_STATS:
+                with self._tlock:
+                    stats = {str(k): t.size() for k, t in self._tables.items()}
+                return b"\x00" + json.dumps(stats).encode()
+            if op == OP_SHUTDOWN:
+                self._shutdown.set()
+                threading.Thread(target=self._srv.shutdown,
+                                 daemon=True).start()
+                return b"\x00"
+            return b"\x01unknown op"
+        except Exception as e:            # noqa: BLE001 — report to client
+            return b"\x01" + repr(e).encode()[:500]
+
+
+class PSClient:
+    """Trainer-side stub: shards keys over servers by ``key % n_servers``
+    (the reference's sparse-shard rule), issues per-server RPCs, and
+    reassembles rows in request order. ``async_push=True`` queues pushes
+    to a background thread — the reference's async-SGD trainer loop."""
+
+    def __init__(self, endpoints, async_push=False):
+        if isinstance(endpoints, str):
+            endpoints = [e for e in endpoints.replace(";", ",").split(",")
+                         if e]
+        self.endpoints = list(endpoints)
+        self._created: set[int] = set()
+        self._socks = [None] * len(self.endpoints)
+        self._locks = [threading.Lock() for _ in self.endpoints]
+        self._async = bool(async_push)
+        self.push_errors = 0
+        self._last_push_error = None
+        self._pool = None
+        self._closed = False
+        self._q = None
+        self._pusher = None
+        if self._async:
+            import queue
+            self._q = queue.Queue(maxsize=256)
+            self._pusher = threading.Thread(target=self._drain, daemon=True)
+            self._pusher.start()
+
+    def _sock(self, i):
+        if self._socks[i] is None:
+            host, port = self.endpoints[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)), timeout=60)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _call(self, i, op, tid, n, dim, body):
+        with self._locks[i]:
+            try:
+                s = self._sock(i)
+                _send_frame(s, _HDR.pack(op, tid, n, dim), body)
+                resp = _recv_frame(s)
+            except (OSError, ConnectionError):
+                # a dead or mid-frame socket must not be reused — drop it
+                # so the next call reconnects cleanly
+                if self._socks[i] is not None:
+                    try:
+                        self._socks[i].close()
+                    except OSError:
+                        pass
+                    self._socks[i] = None
+                raise
+        if resp[:1] != b"\x00":
+            raise RuntimeError(f"PS error from {self.endpoints[i]}: "
+                               f"{resp[1:].decode(errors='replace')}")
+        return resp[1:]
+
+    def _shard(self, keys):
+        keys = np.asarray(keys, np.int64).ravel()
+        sid = keys % len(self.endpoints)
+        return keys, sid
+
+    # -- API ----------------------------------------------------------------
+    def create_table(self, table_id, **cfg):
+        body = json.dumps(cfg).encode()
+        for i in range(len(self.endpoints)):
+            self._call(i, OP_CONFIG, table_id, 0, 0, body)
+        self._created.add(int(table_id))
+
+    def next_auto_table_id(self):
+        """Smallest id this client hasn't configured — lets layers
+        auto-assign tables without colliding with user-created ids."""
+        return max(self._created, default=-1) + 1
+
+    def _fanout(self, shard_calls):
+        """Run one RPC per involved shard CONCURRENTLY — per-batch latency
+        on the embedding hot path must not scale with shard count."""
+        if len(shard_calls) == 1:
+            fn, = shard_calls
+            return [fn()]
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=len(self.endpoints),
+                thread_name_prefix="ps-client")
+        return [f.result() for f in
+                [self._pool.submit(fn) for fn in shard_calls]]
+
+    def pull(self, table_id, keys):
+        keys, sid = self._shard(keys)
+        masks = [(i, sid == i) for i in range(len(self.endpoints))]
+        masks = [(i, m) for i, m in masks if m.any()]
+
+        def one(i, mask):
+            sub = keys[mask]
+            raw = self._call(i, OP_PULL, table_id, len(sub), 0,
+                             sub.astype("<i8").tobytes())
+            return mask, np.frombuffer(raw, "<f4").reshape(len(sub), -1)
+
+        results = self._fanout([(lambda i=i, m=m: one(i, m))
+                                for i, m in masks])
+        out = None
+        for mask, rows in results:
+            if out is None:
+                out = np.empty((len(keys), rows.shape[1]), np.float32)
+            out[mask] = rows
+        return out if out is not None else np.empty((0, 0), np.float32)
+
+    def _push(self, op, table_id, keys, vals):
+        keys, sid = self._shard(keys)
+        vals = np.asarray(vals, np.float32).reshape(len(keys), -1)
+        dim = vals.shape[1]
+
+        def one(i, mask):
+            sub, sv = keys[mask], vals[mask]
+            self._call(i, op, table_id, len(sub), dim,
+                       sub.astype("<i8").tobytes()
+                       + sv.astype("<f4", copy=False).tobytes())
+
+        masks = [(i, sid == i) for i in range(len(self.endpoints))]
+        self._fanout([(lambda i=i, m=m: one(i, m))
+                      for i, m in masks if m.any()])
+
+    def push_grad(self, table_id, keys, grads):
+        if self._async:
+            if self._closed:
+                raise RuntimeError("PSClient is closed")
+            self._q.put((OP_PUSH_GRAD, table_id,
+                         np.array(keys, np.int64, copy=True),
+                         np.array(grads, np.float32, copy=True)))
+        else:
+            self._push(OP_PUSH_GRAD, table_id, keys, grads)
+
+    def push_delta(self, table_id, keys, deltas):
+        self._push(OP_PUSH_DELTA, table_id, keys, deltas)
+
+    def _drain(self):
+        import warnings
+        while True:
+            item = self._q.get()
+            if item is None:              # close() sentinel — exit thread
+                self._q.task_done()
+                return
+            op, tid, keys, vals = item
+            try:
+                self._push(op, tid, keys, vals)
+            except Exception as e:        # noqa: BLE001 — record, don't die
+                self.push_errors += 1
+                self._last_push_error = e
+                if self.push_errors == 1:
+                    warnings.warn(f"PS async push failed (further failures "
+                                  f"counted silently): {e!r}",
+                                  RuntimeWarning)
+            finally:
+                self._q.task_done()
+
+    def flush(self, raise_on_error=True):
+        """Wait for queued pushes; by default surface any drops — an async
+        job must not run to completion with a shard silently frozen."""
+        if self._q is not None:
+            self._q.join()
+        if raise_on_error and self.push_errors:
+            n, err = self.push_errors, self._last_push_error
+            self.push_errors, self._last_push_error = 0, None
+            raise RuntimeError(
+                f"{n} async sparse push(es) were dropped; last error: "
+                f"{err!r}")
+
+    def save(self, table_id, path_prefix):
+        for i in range(len(self.endpoints)):
+            body = json.dumps(
+                {"path": f"{path_prefix}.shard{i}.npz"}).encode()
+            self._call(i, OP_SAVE, table_id, 0, 0, body)
+
+    def load(self, table_id, path_prefix):
+        for i in range(len(self.endpoints)):
+            body = json.dumps(
+                {"path": f"{path_prefix}.shard{i}.npz"}).encode()
+            self._call(i, OP_LOAD, table_id, 0, 0, body)
+
+    def stats(self, shard=0):
+        return json.loads(self._call(shard, OP_STATS, 0, 0, 0, b"").decode())
+
+    def shutdown_servers(self):
+        for i in range(len(self.endpoints)):
+            try:
+                self._call(i, OP_SHUTDOWN, 0, 0, 0, b"")
+            except (RuntimeError, OSError, ConnectionError):
+                pass
+
+    def close(self):
+        self._closed = True
+        self.flush(raise_on_error=False)
+        if self._q is not None and self._pusher is not None \
+                and self._pusher.is_alive():
+            self._q.put(None)             # sentinel: stop the drain thread
+            self._pusher.join(timeout=10)
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        for s in self._socks:
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        self._socks = [None] * len(self.endpoints)

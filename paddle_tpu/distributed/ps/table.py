@@ -1,0 +1,153 @@
+"""Host-side sparse embedding tables for parameter-server mode.
+
+Reference: ``paddle/fluid/distributed/ps/table/`` (memory_sparse_table,
+ctr accessors — SURVEY.md §2.1 "Parameter server"): unbounded-id
+embedding rows created on first touch, with the optimizer applied ON THE
+SERVER so trainers exchange only (keys, grads) — never the full table.
+
+TPU-native rethink: the table is host-resident numpy (embedding tables
+at recsys scale never fit HBM); the device sees only the dense pulled
+rows, so the TPU step stays a pure dense jit program. Rows live in one
+growable 2-D arena + a key->slot dict so pull/push are vectorized
+fancy-indexing over the arena, not per-key Python."""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class SparseTable:
+    """One shard of a distributed embedding table.
+
+    ``optimizer``: applied server-side on ``push_grad`` —
+      * ``"sgd"``:      row -= lr * g
+      * ``"adagrad"``:  acc += g²; row -= lr * g / (sqrt(acc) + eps)
+        (the reference's default sparse accessor family).
+    ``push_delta`` merges trainer-local deltas (geo-SGD mode) without
+    touching optimizer state.
+    """
+
+    def __init__(self, dim, optimizer="adagrad", lr=0.05, eps=1e-8,
+                 initializer="uniform", init_range=0.01, seed=0):
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.eps = float(eps)
+        self.initializer = initializer
+        self.init_range = float(init_range)
+        self._seed = int(seed)
+        self._slots: dict[int, int] = {}
+        self._cap = 0
+        self._n = 0
+        self._rows = np.empty((0, self.dim), np.float32)
+        self._acc = np.empty((0, self.dim), np.float32)
+        self._lock = threading.Lock()
+
+    # -- storage ------------------------------------------------------------
+    def _grow(self, need):
+        cap = max(64, self._cap)
+        while cap < need:
+            cap *= 2
+        pad = cap - self._cap
+        self._rows = np.concatenate(
+            [self._rows, np.zeros((pad, self.dim), np.float32)])
+        self._acc = np.concatenate(
+            [self._acc, np.zeros((pad, self.dim), np.float32)])
+        self._cap = cap
+
+    def _init_rows(self, keys):
+        """Deterministic per-key init: the same key hashes to the same row
+        on every shard/restart, so sync-parity tests and elastic restarts
+        see identical tables. Vectorized counter-based hash (splitmix64
+        finalizer over key x column) — a cold 100k-key pull must not run
+        per-key Python under the table lock."""
+        if self.initializer == "zeros":
+            return np.zeros((len(keys), self.dim), np.float32)
+        k = (np.asarray(keys, np.int64).astype(np.uint64)[:, None]
+             * np.uint64(1000003) + np.uint64(self._seed))
+        z = k + np.arange(self.dim, dtype=np.uint64)[None, :] \
+            * np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z ^= z >> np.uint64(31)
+        unit = (z >> np.uint64(11)).astype(np.float64) * (2.0 ** -53)
+        return ((2.0 * unit - 1.0) * self.init_range).astype(np.float32)
+
+    def _index(self, keys, create):
+        idx = np.empty(len(keys), np.int64)
+        missing = []
+        for i, k in enumerate(keys):
+            slot = self._slots.get(int(k), -1)
+            if slot < 0 and create:
+                missing.append((i, int(k)))
+            idx[i] = slot
+        if missing:
+            need = self._n + len(missing)
+            if need > self._cap:
+                self._grow(need)
+            new_keys = [k for _, k in missing]
+            self._rows[self._n:need] = self._init_rows(new_keys)
+            for j, (i, k) in enumerate(missing):
+                slot = self._n + j
+                self._slots[k] = slot
+                idx[i] = slot
+            self._n = need
+        return idx
+
+    # -- RPC surface --------------------------------------------------------
+    def pull(self, keys: np.ndarray) -> np.ndarray:
+        with self._lock:
+            idx = self._index(keys, create=True)
+            return self._rows[idx].copy()
+
+    def push_grad(self, keys: np.ndarray, grads: np.ndarray) -> None:
+        grads = np.asarray(grads, np.float32).reshape(len(keys), self.dim)
+        uniq, inv = np.unique(np.asarray(keys, np.int64), return_inverse=True)
+        summed = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(summed, inv, grads)
+        with self._lock:
+            idx = self._index(uniq, create=True)
+            if self.optimizer == "adagrad":
+                self._acc[idx] += summed * summed
+                self._rows[idx] -= (self.lr * summed
+                                    / (np.sqrt(self._acc[idx]) + self.eps))
+            else:
+                self._rows[idx] -= self.lr * summed
+
+    def push_delta(self, keys: np.ndarray, deltas: np.ndarray) -> None:
+        deltas = np.asarray(deltas, np.float32).reshape(len(keys), self.dim)
+        with self._lock:
+            idx = self._index(np.asarray(keys, np.int64), create=True)
+            np.add.at(self._rows, idx, deltas)
+
+    # -- checkpoint ---------------------------------------------------------
+    def state(self):
+        with self._lock:
+            keys = np.fromiter(self._slots.keys(), np.int64,
+                               len(self._slots))
+            idx = np.fromiter(self._slots.values(), np.int64,
+                              len(self._slots))
+            return {"keys": keys, "rows": self._rows[idx],
+                    "acc": self._acc[idx]}
+
+    def clear(self):
+        with self._lock:
+            self._slots.clear()
+            self._cap = self._n = 0
+            self._rows = np.empty((0, self.dim), np.float32)
+            self._acc = np.empty((0, self.dim), np.float32)
+
+    def load_state(self, st):
+        """Full restore: the table becomes exactly the checkpoint (keys
+        created since the save are dropped, matching a real restart)."""
+        self.clear()
+        keys, rows, acc = st["keys"], st["rows"], st["acc"]
+        with self._lock:
+            idx = self._index(keys, create=True)
+            self._rows[idx] = rows
+            self._acc[idx] = acc
+
+    def size(self):
+        with self._lock:
+            return self._n

@@ -374,10 +374,7 @@ class GenerationMixin:
         was_training = self.training
         self.eval()
         try:
-            ids = input_ids if isinstance(input_ids, Tensor) \
-                else Tensor(np.asarray(input_ids, np.int64))
-            if max_length is not None:
-                max_new_tokens = max(max_length - ids.shape[1], 0)
+            ids = input_ids                   # prologue already normalized
             cache = kw.pop("cache", None)
             if cache is None and self.supports_cache:
                 if kw.pop("use_paged_cache", False):
@@ -421,8 +418,7 @@ class GenerationMixin:
         was_training = self.training
         self.eval()
         try:
-            ids = input_ids if isinstance(input_ids, Tensor) \
-                else Tensor(np.asarray(input_ids, np.int64))
+            ids = input_ids                   # generate() already normalized
             b, prompt = ids.shape
             n = int(num_beams)
             # expand rows to beams: [b*n, s]

@@ -410,8 +410,10 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
         tri = jnp.tril(iou, k=-1)          # iou with higher-scored boxes
         max_iou = tri.max(axis=1)          # per box: worst overlap above it
         if use_gaussian:
-            decay = jnp.exp(-(tri ** 2 - max_iou[None, :] ** 2)
-                            / gaussian_sigma)
+            # reference kernel: exp((compensate² - iou²) * sigma) — sigma
+            # MULTIPLIES (paddle's gaussian_sigma=2.0 is the paper's 1/σ)
+            decay = jnp.exp((max_iou[None, :] ** 2 - tri ** 2)
+                            * gaussian_sigma)
         else:
             decay = (1.0 - tri) / jnp.maximum(1.0 - max_iou[None, :], 1e-10)
         decay = jnp.where(jnp.tril(jnp.ones_like(tri), k=-1) > 0, decay,

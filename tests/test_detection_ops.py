@@ -188,22 +188,23 @@ def test_prior_box_shapes_and_normalization():
 
 
 def test_distribute_fpn_proposals():
-    rois = np.asarray([[0, 0, 10, 10],        # small -> low level
-                       [0, 0, 112, 112],      # ~sqrt(area)=112
-                       [0, 0, 500, 500]],     # big -> high level
+    # input order deliberately NOT monotone in level, so the concatenated
+    # per-level output is a non-trivial permutation of the input
+    rois = np.asarray([[0, 0, 500, 500],      # big -> high level
+                       [0, 0, 10, 10],        # small -> low level
+                       [0, 0, 112, 112],      # ~sqrt(area)=112 -> middle
+                       [0, 0, 11, 11]],       # small -> low level
                       np.float32)
     multi, restore, nums = V.distribute_fpn_proposals(
         _t(rois), min_level=2, max_level=5, refer_level=4, refer_scale=224,
-        rois_num=paddle.to_tensor(np.asarray([3], np.int32)))
+        rois_num=paddle.to_tensor(np.asarray([4], np.int32)))
     sizes = [m.shape[0] for m in multi]
-    assert sum(sizes) == 3 and len(multi) == 4
+    assert sum(sizes) == 4 and len(multi) == 4
     assert sizes[0] >= 1 and sizes[-1] >= 1       # spread across levels
-    # restore index reorders the concatenation back to input order
+    # contract: cat(multi)[restore] recovers the ORIGINAL roi order
     cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
-    np.testing.assert_allclose(cat[restore.numpy()[:, 0]]
-                               if False else cat[np.argsort(
-                                   np.argsort(restore.numpy()[:, 0]))],
-                               cat, atol=0)      # permutation sanity
     inv = restore.numpy()[:, 0]
-    np.testing.assert_allclose(np.sort(inv), np.arange(3))
+    assert not np.array_equal(inv, np.arange(4))  # permutation is real
+    np.testing.assert_allclose(cat[inv], rois, atol=0)
+    np.testing.assert_allclose(np.sort(inv), np.arange(4))
     assert [int(n.numpy()[0]) for n in nums] == sizes
