@@ -468,17 +468,31 @@ def main():
         # BEFORE the long-lived bench child exists (guarded_compile —
         # VERDICT.md round-2 weak #1: a hung first Mosaic compile must
         # never happen in a process we can't afford to lose).
-        try:
-            from paddle_tpu.utils.guarded_compile import (bench_kernels,
-                                                          prove_all)
-            need = bench_kernels(os.environ.get("BENCH_MODEL", "resnet"))
-            if need:
-                print(f"bench: proving kernels {need} in subprocess",
-                      file=sys.stderr)
-                print(f"bench: kernel proofs: {prove_all(need)}",
-                      file=sys.stderr)
-        except Exception as e:   # guard must never kill the bench
-            print(f"bench: kernel proving skipped: {e}", file=sys.stderr)
+        # BENCH_PROVE=0 skips proving entirely: round-4 evidence showed a
+        # hung Mosaic compile wedges the remote tunnel SERVER-side — the
+        # disposable subprocess protects this process but not the pool —
+        # so zero-Mosaic sessions must not even attempt the canary.
+        if os.environ.get("BENCH_PROVE", "1") == "0":
+            # the jax production paged kernel is ALSO a Mosaic compile —
+            # a zero-Mosaic session must pin decode to the pure-XLA tier,
+            # not merely skip the in-repo proof
+            os.environ.setdefault("PADDLE_TPU_PAGED_IMPL", "xla")
+            print("bench: BENCH_PROVE=0 — skipping kernel proofs; "
+                  "unproven Pallas kernels use their XLA fallbacks "
+                  f"(paged impl={os.environ['PADDLE_TPU_PAGED_IMPL']})",
+                  file=sys.stderr)
+        else:
+            try:
+                from paddle_tpu.utils.guarded_compile import (bench_kernels,
+                                                              prove_all)
+                need = bench_kernels(os.environ.get("BENCH_MODEL", "resnet"))
+                if need:
+                    print(f"bench: proving kernels {need} in subprocess",
+                          file=sys.stderr)
+                    print(f"bench: kernel proofs: {prove_all(need)}",
+                          file=sys.stderr)
+            except Exception as e:   # guard must never kill the bench
+                print(f"bench: kernel proving skipped: {e}", file=sys.stderr)
         for attempt, tmo in ((1, 1500), (2, 900)):
             obj, tail = _run_child(os.environ, tmo)
             if obj is not None:

@@ -244,11 +244,14 @@ def ps_client():
 def stop_worker():
     c = _ps_state.get("client")
     if c is not None:
-        c.flush()
-        if _ps_role().worker_index() == 0:
-            c.shutdown_servers()
-        c.close()
-        _ps_state["client"] = None
+        try:
+            c.flush()                    # surfaces dropped async pushes
+        finally:
+            # even a failed flush must not leave pservers serving forever
+            if _ps_role().worker_index() == 0:
+                c.shutdown_servers()
+            c.close()
+            _ps_state["client"] = None
 
 
 class UserDefinedRoleMaker:

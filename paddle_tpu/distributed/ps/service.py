@@ -195,7 +195,13 @@ class PSClient:
         self._async = bool(async_push)
         self.push_errors = 0
         self._last_push_error = None
-        self._pool = None
+        # eager: lazy creation would race between the drain thread and the
+        # main thread (ThreadPoolExecutor spawns workers on demand, so an
+        # unused pool costs nothing)
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = (ThreadPoolExecutor(max_workers=len(self.endpoints),
+                                         thread_name_prefix="ps-client")
+                      if len(self.endpoints) > 1 else None)
         self._closed = False
         self._q = None
         self._pusher = None
@@ -254,14 +260,8 @@ class PSClient:
     def _fanout(self, shard_calls):
         """Run one RPC per involved shard CONCURRENTLY — per-batch latency
         on the embedding hot path must not scale with shard count."""
-        if len(shard_calls) == 1:
-            fn, = shard_calls
-            return [fn()]
-        if self._pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            self._pool = ThreadPoolExecutor(
-                max_workers=len(self.endpoints),
-                thread_name_prefix="ps-client")
+        if len(shard_calls) == 1 or self._pool is None:
+            return [fn() for fn in shard_calls]
         return [f.result() for f in
                 [self._pool.submit(fn) for fn in shard_calls]]
 

@@ -152,6 +152,12 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
         # forced with PADDLE_TPU_PAGED_IMPL=jax.
         import os
         impl = os.environ.get("PADDLE_TPU_PAGED_IMPL", "auto").lower()
+        if impl == "xla":
+            # zero-Mosaic tier: sessions where the tunnel's Mosaic compile
+            # service is wedged (rounds 2-4) can still decode on-chip —
+            # every op here is plain XLA
+            return _paged_attention_xla(q, k_pages, v_pages, block_tables,
+                                        context_lens, sm_scale=sm_scale)
         if impl != "jax":
             from ...utils.guarded_compile import kernel_allowed
             if impl == "inrepo" or kernel_allowed(
@@ -173,6 +179,31 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens, *,
     return _paged_attention_pallas(q, k_pages, v_pages, block_tables,
                                    context_lens, sm_scale=sm_scale,
                                    interpret=interpret)
+
+
+def _paged_attention_xla(q, k_pages, v_pages, block_tables, context_lens,
+                         *, sm_scale):
+    """Vectorized jittable XLA decode attention over the paged cache: one
+    gather materializes each sequence's pages as dense KV, then masked
+    softmax-attention. O(batch·S_max) HBM for the gathered KV — the
+    fallback trades the paged kernel's memory win for wedge-free compiles."""
+    kv_heads, _, page_size, d = k_pages.shape
+    batch, heads, _ = q.shape
+    group = heads // kv_heads
+    # [kv_heads, batch, pages_per_seq, page_size, d] -> [b, kv, S, d]
+    ks = jnp.moveaxis(k_pages[:, block_tables], 1, 0).reshape(
+        batch, kv_heads, -1, d)
+    vs = jnp.moveaxis(v_pages[:, block_tables], 1, 0).reshape(
+        batch, kv_heads, -1, d)
+    qb = (q * sm_scale).reshape(batch, kv_heads, group, d)
+    s = jnp.einsum("bkgd,bksd->bkgs", qb.astype(jnp.float32),
+                   ks.astype(jnp.float32))
+    valid = (jnp.arange(ks.shape[2])[None, :]
+             < jnp.asarray(context_lens, jnp.int32)[:, None])
+    s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bkgs,bksd->bkgd", w, vs.astype(jnp.float32))
+    return o.reshape(batch, heads, d).astype(q.dtype)
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,

@@ -125,3 +125,29 @@ def test_paged_cache_overflow_raises():
     q = paddle.to_tensor(np.zeros((1, 9, 2, 8), np.float32))
     with pytest.raises(ValueError, match="overflow"):
         c.attend(object(), q, q, q)
+
+
+def test_xla_decode_tier_matches_reference():
+    """The pure-XLA decode tier (PADDLE_TPU_PAGED_IMPL=xla, used when the
+    session must avoid all Mosaic compiles) vs the dense oracle — jitted,
+    ragged context lengths, GQA."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.paged_attention import (
+        _paged_attention_xla, paged_attention_reference)
+
+    rng = np.random.default_rng(0)
+    kvh, npages, ps, d = 4, 12, 8, 32
+    b, h = 3, 8
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((kvh, npages, ps, d)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((kvh, npages, ps, d)), jnp.float32)
+    tbl = jnp.asarray(rng.integers(0, npages, (b, 4)), jnp.int32)
+    lens = jnp.asarray([5, 17, 32], jnp.int32)
+    out = jax.jit(lambda *a: _paged_attention_xla(
+        *a, sm_scale=1 / math.sqrt(d)))(q, kp, vp, tbl, lens)
+    ref = paged_attention_reference(q, kp, vp, tbl, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,77 @@
+#!/bin/bash
+# Round-4 evidence pack, take 2 — ZERO Mosaic compiles.
+# Take 1 (tools/evidence_r4.sh) proved the wedge mechanism: the tunnel was
+# healthy (ResNet 117k img/s on-chip), then the flash-attention canary — the
+# SAME kernel that passed on-chip in round 2 — hung its Mosaic compile and
+# wedged the remote pool for everything after. Killing the disposable
+# subprocess does not unwedge the server. So: this runner waits for the pool
+# to recover, then captures every number on pure-XLA paths (BENCH_PROVE=0;
+# quarantined Pallas kernels use their XLA fallbacks; decode forces
+# PADDLE_TPU_PAGED_IMPL=xla). No proof, no canary, no Mosaic — ever.
+set -u
+cd /root/repo
+PACK=/root/repo/BENCH_R4_PACK.jsonl      # appends after take-1's resnet row
+SWEEP=/root/repo/BENCH_SWEEP_R4.jsonl
+LOG=/tmp/evidence_r4b.log
+echo "[r4b] start $(date -u +%H:%M:%SZ)" >> "$LOG"
+
+run_one() {  # run_one <outfile> <label> <env...>
+  local out=$1 label=$2; shift 2
+  local line
+  line=$(env "$@" BENCH_PROVE=0 BENCH_PROBE_TIMEOUT=150 timeout 4000 python bench.py 2>>"$LOG" | tail -1)
+  if ! printf '%s' "$line" | python -c 'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
+    line='{"error": "bench produced no parseable JSON (timeout/kill?)"}'
+  fi
+  printf '{"label": "%s", "result": %s}\n' "$label" "$line" >> "$out"
+  echo "[r4b] $label -> $line" >> "$LOG"
+}
+
+# Wait for pool recovery.
+while true; do
+  if timeout 150 python -c "import jax; assert jax.default_backend() == 'tpu'; import jax.numpy as jnp; (jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()" >> "$LOG" 2>&1; then
+    echo "[r4b] TPU healthy $(date -u +%H:%M:%SZ)" >> "$LOG"
+    break
+  fi
+  echo "[r4b] probe failed $(date -u +%H:%M:%SZ); retry in 300s" >> "$LOG"
+  sleep 300
+done
+
+run_one "$PACK" llama_xla_fallback   BENCH_MODEL=llama
+run_one "$PACK" bert                 BENCH_MODEL=bert
+run_one "$PACK" llama_decode_xla     BENCH_MODEL=llama_decode PADDLE_TPU_PAGED_IMPL=xla
+run_one "$PACK" data_goodput         BENCH_MODEL=data
+run_one "$PACK" resnet_loader        BENCH_MODEL=resnet BENCH_DATA=loader
+run_one "$PACK" dispatch             BENCH_MODEL=dispatch
+
+# MFU sweep on the XLA-attention path (VERDICT r3 item 2).
+for cfg in \
+  "BENCH_PRESET=1b BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_REMAT=1" \
+  "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1" \
+  "BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=1" \
+  "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=4096 BENCH_REMAT=1" \
+  "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=0" \
+  "BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=1024 BENCH_REMAT=0" \
+  "BENCH_BATCH=16 BENCH_SEQ=2048" \
+  "BENCH_BATCH=32 BENCH_SEQ=1024" ; do
+  line=$(env $cfg BENCH_MODEL=llama BENCH_PROVE=0 BENCH_PROBE_TIMEOUT=150 \
+         timeout 4000 python bench.py 2>>"$LOG" | tail -1)
+  if ! printf '%s' "$line" | python -c 'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
+    line='{"error": "bench run produced no parseable JSON (timeout/kill?)"}'
+  fi
+  echo "{\"config\": \"$cfg xla-attn\", \"result\": $line}" >> "$SWEEP"
+  echo "[r4b] sweep $cfg -> $line" >> "$LOG"
+done
+
+python - <<'EOF'
+import json
+results = []
+with open("/root/repo/BENCH_R4_PACK.jsonl") as f:
+    for line in f:
+        line = line.strip()
+        if line:
+            results.append(json.loads(line))
+with open("/root/repo/BENCH_TPU_SESSION_R4.json", "w") as f:
+    json.dump({"session": "round4", "results": results}, f, indent=1)
+print("assembled", len(results), "results")
+EOF
+echo "[r4b] done $(date -u +%H:%M:%SZ)" >> "$LOG"
