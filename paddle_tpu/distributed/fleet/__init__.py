@@ -247,11 +247,16 @@ def stop_worker():
         try:
             c.flush()                    # surfaces dropped async pushes
         finally:
-            # even a failed flush must not leave pservers serving forever
-            if _ps_role().worker_index() == 0:
-                c.shutdown_servers()
-            c.close()
-            _ps_state["client"] = None
+            # even a failed flush must not leave pservers serving forever;
+            # and a failed role lookup must not mask the flush error or
+            # skip close() — cleanup is unconditional
+            try:
+                rm = _ps_state.get("role_maker")
+                if rm is None or rm.worker_index() == 0:
+                    c.shutdown_servers()
+            finally:
+                c.close()
+                _ps_state["client"] = None
 
 
 class UserDefinedRoleMaker:

@@ -473,6 +473,204 @@ def _xla_fallback(q, k, v, causal, sm_scale, q_offset, kv_offset,
     return jnp.concatenate(outs, axis=2)
 
 
+# ---------------------------------------------------------------------------
+# Pure-XLA flash attention (no Mosaic): lax.scan online-softmax forward +
+# custom_vjp blockwise-recompute backward. This is the training-path tier
+# for sessions where Mosaic compiles are off-limits (the round-2/3/4 tunnel
+# wedge) — flash MEMORY behavior (O(block²) logits temporaries, O(S)
+# residuals) from plain XLA ops the TPU compiler handles natively.
+# ---------------------------------------------------------------------------
+
+def _xfa_blocks(sq, sk):
+    bq = min(int(_os.environ.get("PADDLE_TPU_XFA_BLOCK_Q", "512")), sq)
+    bk = min(int(_os.environ.get("PADDLE_TPU_XFA_BLOCK_K", "1024")), sk)
+    return bq, bk
+
+
+def _xflash_fwd_impl(q, k, v, offs, causal, sm_scale):
+    """Grouped-GQA online-softmax forward. q [b,hq,sq,d]; k/v [b,hk,sk,d];
+    returns (out [b,hq,sq,d], lse fp32 [b,hq,sq]) with mha_reference's
+    conventions (natural-log lse; fully-masked rows -> out 0, lse NEG_INF)."""
+    b, hq, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    g = hq // hk
+    bq, bk = _xfa_blocks(sq, sk)
+    nq, nk = sq // bq, sk // bk
+    q_off = jnp.asarray(offs[0], jnp.int32)
+    kv_off = jnp.asarray(offs[1], jnp.int32)
+    qg = q.reshape(b, hk, g, sq, d)
+
+    def one_q_block(qi, qblk):                     # qblk [b,hk,g,bq,d]
+        m0 = jnp.full((b, hk, g, bq), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, hk, g, bq), jnp.float32)
+        a0 = jnp.zeros((b, hk, g, bq, d), jnp.float32)
+
+        def step(carry, kj):
+            m, l, acc = carry
+            kblk = jax.lax.dynamic_slice_in_dim(k, kj * bk, bk, axis=2)
+            vblk = jax.lax.dynamic_slice_in_dim(v, kj * bk, bk, axis=2)
+            s = jnp.einsum("bhgqd,bhkd->bhgqk", qblk, kblk,
+                           preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                qpos = q_off + qi * bq + jnp.arange(bq, dtype=jnp.int32)
+                kpos = kv_off + kj * bk + jnp.arange(bk, dtype=jnp.int32)
+                s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(-1))
+            # dead rows (everything masked): exponents of NEG_INF-vs-NEG_INF
+            # must not become exp(0)=1 — shift by 0 instead
+            m_eff = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+            p = jnp.exp(s - m_eff[..., None])
+            alpha = jnp.exp(m - m_eff)
+            l_new = l * alpha + p.sum(-1)
+            pv = jnp.einsum("bhgqk,bhkd->bhgqd", p.astype(v.dtype), vblk,
+                            preferred_element_type=jnp.float32)
+            return (m_new, l_new, acc * alpha[..., None] + pv), None
+
+        (m, l, acc), _ = jax.lax.scan(step, (m0, l0, a0),
+                                      jnp.arange(nk, dtype=jnp.int32))
+        l_safe = jnp.maximum(l, 1e-30)
+        out = (acc / l_safe[..., None]).astype(q.dtype)
+        m_eff = jnp.where(m <= NEG_INF / 2, 0.0, m)
+        lse = jnp.where(l <= 1e-30, NEG_INF, m_eff + jnp.log(l_safe))
+        return out, lse
+
+    qblocks = jnp.moveaxis(qg.reshape(b, hk, g, nq, bq, d), 3, 0)
+
+    def scan_q(_, xs):
+        qi, qblk = xs
+        return None, one_q_block(qi, qblk)
+
+    _, (outs, lses) = jax.lax.scan(
+        scan_q, None, (jnp.arange(nq, dtype=jnp.int32), qblocks))
+    out = jnp.moveaxis(outs, 0, 3).reshape(b, hq, sq, d)
+    lse = jnp.moveaxis(lses, 0, 3).reshape(b, hq, sq)
+    return out, lse
+
+
+def _xflash_bwd_impl(q, k, v, offs, out, lse, dout, causal, sm_scale,
+                     g_lse=None):
+    """Blockwise-recompute backward (FA2 structure in plain XLA): one scan
+    over q blocks carrying fp32 dk/dv accumulators, inner scan over kv
+    blocks; p is recomputed from lse so no S×S residual exists."""
+    b, hq, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    g = hq // hk
+    bq, bk = _xfa_blocks(sq, sk)
+    nq, nk = sq // bq, sk // bk
+    q_off = jnp.asarray(offs[0], jnp.int32)
+    kv_off = jnp.asarray(offs[1], jnp.int32)
+
+    delta = (dout.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+    # lse is a differentiable output (ring merge uses it): dlse/ds_j = p_j,
+    # so its cotangent folds into the delta term of ds = p*(dp - delta) —
+    # same handling as the Mosaic path's _bwd
+    if g_lse is not None and getattr(g_lse, "dtype", None) != \
+            jax.dtypes.float0:
+        delta = delta - g_lse.astype(jnp.float32)
+    shp5 = (b, hk, g, nq, bq)
+    qb = jnp.moveaxis(q.reshape(b, hk, g, nq, bq, d), 3, 0)
+    dob = jnp.moveaxis(dout.reshape(b, hk, g, nq, bq, d), 3, 0)
+    lseb = jnp.moveaxis(lse.reshape(*shp5), 3, 0)
+    deltab = jnp.moveaxis(delta.reshape(*shp5), 3, 0)
+
+    def per_q(carry, xs):
+        dk, dv = carry
+        qi, qblk, doblk, lseblk, dblk = xs
+        live = (lseblk > NEG_INF / 2).astype(jnp.float32)
+
+        def step(inner, kj):
+            dq_acc, dk, dv = inner
+            kblk = jax.lax.dynamic_slice_in_dim(k, kj * bk, bk, axis=2)
+            vblk = jax.lax.dynamic_slice_in_dim(v, kj * bk, bk, axis=2)
+            s = jnp.einsum("bhgqd,bhkd->bhgqk", qblk, kblk,
+                           preferred_element_type=jnp.float32) * sm_scale
+            if causal:
+                qpos = q_off + qi * bq + jnp.arange(bq, dtype=jnp.int32)
+                kpos = kv_off + kj * bk + jnp.arange(bk, dtype=jnp.int32)
+                s = jnp.where(qpos[:, None] >= kpos[None, :], s, NEG_INF)
+            p = jnp.exp(s - lseblk[..., None]) * live[..., None]
+            dp = jnp.einsum("bhgqd,bhkd->bhgqk", doblk, vblk,
+                            preferred_element_type=jnp.float32)
+            ds = p * (dp - dblk[..., None]) * sm_scale
+            pc, dsc = p.astype(v.dtype), ds.astype(q.dtype)
+            dq_blk = jnp.einsum("bhgqk,bhkd->bhgqd", dsc, kblk,
+                                preferred_element_type=jnp.float32)
+            dk_blk = jnp.einsum("bhgqk,bhgqd->bhkd", dsc, qblk,
+                                preferred_element_type=jnp.float32)
+            dv_blk = jnp.einsum("bhgqk,bhgqd->bhkd", pc, doblk,
+                                preferred_element_type=jnp.float32)
+            dk = jax.lax.dynamic_update_slice_in_dim(
+                dk, jax.lax.dynamic_slice_in_dim(dk, kj * bk, bk, 2)
+                + dk_blk, kj * bk, 2)
+            dv = jax.lax.dynamic_update_slice_in_dim(
+                dv, jax.lax.dynamic_slice_in_dim(dv, kj * bk, bk, 2)
+                + dv_blk, kj * bk, 2)
+            return (dq_acc + dq_blk, dk, dv), None
+
+        dq0 = jnp.zeros((b, hk, g, bq, d), jnp.float32)
+        (dq_blk, dk, dv), _ = jax.lax.scan(
+            step, (dq0, dk, dv), jnp.arange(nk, dtype=jnp.int32))
+        return (dk, dv), dq_blk
+
+    dk0 = jnp.zeros((b, hk, sk, d), jnp.float32)
+    dv0 = jnp.zeros((b, hk, sk, d), jnp.float32)
+    (dk, dv), dqs = jax.lax.scan(
+        per_q, (dk0, dv0),
+        (jnp.arange(nq, dtype=jnp.int32), qb, dob, lseb, deltab))
+    dq = jnp.moveaxis(dqs, 0, 3).reshape(b, hq, sq, d).astype(q.dtype)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _xflash(q, k, v, offs, causal, sm_scale):
+    out, _ = _xflash_fwd_impl(q, k, v, offs, causal, sm_scale)
+    return out
+
+
+def _xflash_fwd_rule(q, k, v, offs, causal, sm_scale):
+    out, lse = _xflash_fwd_impl(q, k, v, offs, causal, sm_scale)
+    return out, (q, k, v, offs, out, lse)
+
+
+def _xflash_bwd_rule(causal, sm_scale, res, g):
+    q, k, v, offs, out, lse = res
+    dq, dk, dv = _xflash_bwd_impl(q, k, v, offs, out, lse, g, causal,
+                                  sm_scale)
+    return dq, dk, dv, None
+
+
+_xflash.defvjp(_xflash_fwd_rule, _xflash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _xflash_with_lse(q, k, v, offs, causal, sm_scale):
+    return _xflash_fwd_impl(q, k, v, offs, causal, sm_scale)
+
+
+def _xflash_lse_fwd_rule(q, k, v, offs, causal, sm_scale):
+    out, lse = _xflash_fwd_impl(q, k, v, offs, causal, sm_scale)
+    return (out, lse), (q, k, v, offs, out, lse)
+
+
+def _xflash_lse_bwd_rule(causal, sm_scale, res, g):
+    q, k, v, offs, out, lse = res
+    dout, g_lse = g
+    dq, dk, dv = _xflash_bwd_impl(q, k, v, offs, out, lse, dout, causal,
+                                  sm_scale, g_lse=g_lse)
+    return dq, dk, dv, None
+
+
+_xflash_with_lse.defvjp(_xflash_lse_fwd_rule, _xflash_lse_bwd_rule)
+
+
+def _xflash_ok(q, k):
+    """The scan formulation needs block-divisible sequence axes; other
+    shapes stay on the chunked-reference fallback."""
+    sq, sk = q.shape[2], k.shape[2]
+    bq, bk = _xfa_blocks(sq, sk)
+    return sq % bq == 0 and sk % bk == 0
+
+
 def _mosaic_allowed():
     """First-compile guard (VERDICT.md round-2 weak #1): on a real TPU,
     dispatching this kernel from a long-lived process requires a prior
@@ -503,7 +701,13 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, q_offset=0,
     if not kernel_layout:
         q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     if not interpret and not _mosaic_allowed():
-        out = _xla_fallback(q, k, v, causal, sm_scale, q_offset, kv_offset)
+        if _xflash_ok(q, k):
+            offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                              jnp.asarray(kv_offset, jnp.int32)])
+            out = _xflash(q, k, v, offs, causal, sm_scale)
+        else:
+            out = _xla_fallback(q, k, v, causal, sm_scale, q_offset,
+                                kv_offset)
     else:
         offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                           jnp.asarray(kv_offset, jnp.int32)])
@@ -524,6 +728,10 @@ def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None, q_offset=0,
     if interpret is None:
         interpret = _default_interpret()
     if not interpret and not _mosaic_allowed():
+        if _xflash_ok(q, k):
+            offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                              jnp.asarray(kv_offset, jnp.int32)])
+            return _xflash_with_lse(q, k, v, offs, causal, sm_scale)
         return _xla_fallback(q, k, v, causal, sm_scale, q_offset, kv_offset,
                              with_lse=True)
     offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),

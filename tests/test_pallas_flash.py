@@ -192,3 +192,89 @@ def test_ring_attention_in_hybrid_mesh():
                                    rtol=3e-4, atol=3e-4)
     finally:
         mesh_mod.reset_mesh()
+
+
+class TestXlaFlashTier:
+    """Pure-XLA flash tier (_xflash): the training path for zero-Mosaic
+    sessions (rounds 2-4 tunnel wedge). Parity vs mha_reference with
+    multi-block scans forced via the block-size env knobs."""
+
+    def _check(self, b, hq, hk, sq, sk, d, causal, qo, ko, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+        monkeypatch.setenv("PADDLE_TPU_XFA_BLOCK_Q", "64")
+        monkeypatch.setenv("PADDLE_TPU_XFA_BLOCK_K", "32")
+        from paddle_tpu.ops.pallas.flash_attention import (
+            NEG_INF, _xflash, _xflash_with_lse, mha_reference)
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, hq, sq, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, hk, sk, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, hk, sk, d)), jnp.float32)
+        offs = jnp.asarray([qo, ko], jnp.int32)
+        out, lse = jax.jit(
+            lambda *a: _xflash_with_lse(*a, causal, 0.125))(q, k, v, offs)
+        ref, rlse = mha_reference(q, k, v, causal=causal, sm_scale=0.125,
+                                  q_offset=qo, kv_offset=ko, with_lse=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        live = np.asarray(rlse) > NEG_INF / 2
+        np.testing.assert_allclose(np.asarray(lse)[live],
+                                   np.asarray(rlse)[live], atol=2e-5)
+
+        def loss_x(q, k, v):
+            return (_xflash(q, k, v, offs, causal, 0.125) ** 2).sum()
+
+        def loss_r(q, k, v):
+            return (mha_reference(q, k, v, causal=causal, sm_scale=0.125,
+                                  q_offset=qo, kv_offset=ko) ** 2).sum()
+
+        gx = jax.jit(jax.grad(loss_x, (0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+        for a, b_ in zip(gx, gr):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b_), atol=5e-4)
+
+    def test_causal_mha(self, monkeypatch):
+        self._check(2, 4, 4, 128, 128, 32, True, 0, 0, monkeypatch)
+
+    def test_causal_gqa_uneven(self, monkeypatch):
+        self._check(2, 8, 2, 128, 96, 32, True, 0, 0, monkeypatch)
+
+    def test_full_attention(self, monkeypatch):
+        self._check(2, 4, 4, 128, 128, 32, False, 0, 0, monkeypatch)
+
+    def test_decode_offset(self, monkeypatch):
+        self._check(1, 4, 2, 64, 256, 32, True, 192, 0, monkeypatch)
+
+    def test_fully_masked_rows(self, monkeypatch):
+        self._check(1, 2, 2, 64, 64, 16, True, 0, 32, monkeypatch)
+
+    def test_lse_cotangent_flows(self, monkeypatch):
+        """Ring attention differentiates through lse (shard merging) — the
+        XLA tier must propagate the lse cotangent like the Mosaic bwd."""
+        import jax
+        import jax.numpy as jnp
+        monkeypatch.setenv("PADDLE_TPU_XFA_BLOCK_Q", "32")
+        monkeypatch.setenv("PADDLE_TPU_XFA_BLOCK_K", "32")
+        from paddle_tpu.ops.pallas.flash_attention import (
+            _xflash_with_lse, mha_reference)
+        rng = np.random.default_rng(2)
+        q = jnp.asarray(rng.standard_normal((1, 2, 64, 16)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, 64, 16)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 64, 16)), jnp.float32)
+        offs = jnp.asarray([0, 0], jnp.int32)
+
+        def loss_x(q, k, v):
+            out, lse = _xflash_with_lse(q, k, v, offs, True, 0.25)
+            return (out ** 2).sum() + (lse * 0.3).sum()
+
+        def loss_r(q, k, v):
+            out, lse = mha_reference(q, k, v, causal=True, sm_scale=0.25,
+                                     with_lse=True)
+            return (out ** 2).sum() + (lse * 0.3).sum()
+
+        gx = jax.jit(jax.grad(loss_x, (0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+        for a, b in zip(gx, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4)

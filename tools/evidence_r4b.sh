@@ -52,7 +52,10 @@ for cfg in \
   "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=0" \
   "BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=1024 BENCH_REMAT=0" \
   "BENCH_BATCH=16 BENCH_SEQ=2048" \
-  "BENCH_BATCH=32 BENCH_SEQ=1024" ; do
+  "BENCH_BATCH=32 BENCH_SEQ=1024" \
+  "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1 PADDLE_TPU_XFA_BLOCK_Q=256" \
+  "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1 PADDLE_TPU_XFA_BLOCK_Q=512 PADDLE_TPU_XFA_BLOCK_K=512" \
+  "BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1 PADDLE_TPU_XFA_BLOCK_Q=1024 PADDLE_TPU_XFA_BLOCK_K=2048" ; do
   line=$(env $cfg BENCH_MODEL=llama BENCH_PROVE=0 BENCH_PROBE_TIMEOUT=150 \
          timeout 4000 python bench.py 2>>"$LOG" | tail -1)
   if ! printf '%s' "$line" | python -c 'import json,sys; json.loads(sys.stdin.read())' 2>/dev/null; then
