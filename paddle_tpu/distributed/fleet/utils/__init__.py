@@ -2,6 +2,8 @@
 ``python/paddle/distributed/fleet/utils/__init__.py``)."""
 from __future__ import annotations
 
+import functools
+
 import jax
 
 from ....framework.core import Tensor
@@ -29,6 +31,7 @@ def recompute(function, *args, **kwargs):
     """
     kwargs.pop("use_reentrant", None)
     kwargs.pop("preserve_rng_state", None)
+    policy_name = kwargs.pop("policy", None)
     leaves, treedef = jax.tree.flatten(list(args), is_leaf=_is_tensor)
     tracing = any(isinstance(l._data if isinstance(l, Tensor) else l,
                              jax.core.Tracer) for l in leaves)
@@ -39,7 +42,21 @@ def recompute(function, *args, **kwargs):
     static_leaves = [None if isinstance(l, Tensor) else l for l in leaves]
     sg_flags = [leaves[i].stop_gradient for i in tensor_slots]
 
-    @jax.checkpoint
+    from ....flags import flag as _flag
+    policy_name = policy_name or _flag("FLAGS_recompute_policy", "full")
+    try:
+        policy = {
+            "full": None,   # jax.checkpoint default: nothing saveable
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "dots_batch": jax.checkpoint_policies.dots_saveable,
+            "everything": jax.checkpoint_policies.everything_saveable,
+        }[policy_name]
+    except KeyError:
+        raise ValueError(
+            f"unknown recompute policy {policy_name!r}; expected one of "
+            "full/dots/dots_batch/everything") from None
+
+    @functools.partial(jax.checkpoint, policy=policy)
     def pure(*arrs):
         new_leaves = list(static_leaves)
         for slot, a, sg in zip(tensor_slots, arrs, sg_flags):

@@ -21,6 +21,12 @@ _DEFAULTS: dict[str, Any] = {
     "FLAGS_max_inplace_grad_add": 0,
     "FLAGS_conv_workspace_size_limit": 512,
     "FLAGS_use_flash_attention": True,   # Pallas FA kernel in sdpa (TPU only)
+    # jax.checkpoint policy used by fleet.utils.recompute: "full" (drop
+    # everything — reference recompute_granularity='full'), "dots" (save
+    # non-batch matmul outputs, recompute elementwise — much cheaper
+    # recompute at similar activation memory on TPU), "everything"
+    # (checkpoint is a no-op; debugging)
+    "FLAGS_recompute_policy": "full",
     # capture each op's primal replay closure on its GradNode so
     # paddle.grad(create_graph=True) works; disable to shed the extra
     # pinned input arrays on retained graphs when higher-order grads are

@@ -67,6 +67,8 @@ run_one "$PACK" dispatch         1200 BENCH_MODEL=dispatch
 sweep_one "1b b4 s2048 remat plain"  BENCH_PRESET=1b BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_REMAT=1 FLAGS_use_flash_attention=0
 sweep_one "1b b8 s2048 remat plain"  BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1 FLAGS_use_flash_attention=0
 sweep_one "1b b16 s2048 remat plain" BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=1 FLAGS_use_flash_attention=0
+sweep_one "1b b8 s2048 dots plain"   BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=dots FLAGS_use_flash_attention=0
+sweep_one "1b b16 s2048 dots plain"  BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=dots FLAGS_use_flash_attention=0
 sweep_one "1b b8 s2048 norem plain"  BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=0 FLAGS_use_flash_attention=0
 sweep_one "1b b16 s1024 norem plain" BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=1024 BENCH_REMAT=0 FLAGS_use_flash_attention=0
 sweep_one "r2shape b16 s2048 plain"  BENCH_BATCH=16 BENCH_SEQ=2048 FLAGS_use_flash_attention=0
@@ -104,12 +106,20 @@ fi
 
 python - <<'EOF'
 import json
-results = []
+# dedup by label keeping the LAST row — earlier takes leave failed rows
+# (e.g. take-3's llama timeout) that a later take supersedes
+by_label = {}
+order = []
 with open("/root/repo/BENCH_R4_PACK.jsonl") as f:
     for line in f:
         line = line.strip()
-        if line:
-            results.append(json.loads(line))
+        if not line:
+            continue
+        row = json.loads(line)
+        if row["label"] not in by_label:
+            order.append(row["label"])
+        by_label[row["label"]] = row
+results = [by_label[l] for l in order]
 with open("/root/repo/BENCH_TPU_SESSION_R4.json", "w") as f:
     json.dump({"session": "round4", "results": results}, f, indent=1)
 print("assembled", len(results), "results")
