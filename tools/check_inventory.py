@@ -188,6 +188,22 @@ INVENTORY = [
      ["VisionTransformer", "vit_base_patch16_224"]),
     ("Sparse op breadth", "paddle_tpu.sparse",
      ["tanh", "transpose", "coalesce", "mask_as", "addmm"]),
+    ("Parameter-server mode (ps tables/RPC)", "paddle_tpu.distributed.ps",
+     ["SparseTable", "PSServer", "PSClient", "DistributedEmbedding"]),
+    ("PIR pass infra (StableHLO rewriter)", "paddle_tpu.static.pir",
+     ["ProgramIR", "Pass", "PassRegistry", "PatternRewritePass",
+      "MLIRPipelinePass", "optimize_exported"]),
+    ("Auto-parallel completion (dist-attr)", "paddle_tpu.distributed.auto_parallel",
+     ["Completer", "completion"]),
+    ("dy2static control-flow conversion", "paddle_tpu.jit.dy2static",
+     ["convert_function", "ConversionUnsupported"]),
+    ("1F1B/SPMD pipeline engine", "paddle_tpu.distributed.engine",
+     ["pipeline_spmd", "pipeline_spmd_1f1b_bwd", "pipeline_spmd_interleaved",
+      "PipelinedModule"]),
+    ("Generation (beam search, paged KV)", "paddle_tpu.models.generation",
+     ["GenerationMixin", "KVCache", "PagedKVCache", "SlotPagedKVCache"]),
+    ("Detection op surface", "paddle_tpu.vision.ops",
+     ["matrix_nms", "roi_pool", "roi_align", "deform_conv2d", "nms"]),
     ("Hermitian FFT family", "paddle_tpu.fft",
      ["hfft2", "ihfft2", "hfftn", "ihfftn"]),
 ]

@@ -20,12 +20,17 @@ LOG=/tmp/evidence_r4d.log
 echo "[r4d] start $(date -u +%H:%M:%SZ)" >> "$LOG"
 
 wait_healthy() {
+  # Gentle probing: a killed probe mid-claim may itself leave "grant
+  # unclaimed" state on the relay, so probe rarely and give each probe
+  # long enough to ride out a slow grant (the 04:35Z experiment showed
+  # 580 s is still not enough when wedged — but a recovering pool
+  # answers in seconds).
   while true; do
-    if timeout 120 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; (jnp.ones((64,64))@jnp.ones((64,64))).block_until_ready()" >/dev/null 2>&1; then
+    if timeout 550 python -c "import jax; assert jax.default_backend()=='tpu'; import jax.numpy as jnp; (jnp.ones((64,64))@jnp.ones((64,64))).block_until_ready()" >/dev/null 2>&1; then
       echo "[r4d] pool healthy $(date -u +%H:%M:%SZ)" >> "$LOG"; return 0
     fi
-    echo "[r4d] pool down $(date -u +%H:%M:%SZ); retry in 180s" >> "$LOG"
-    sleep 180
+    echo "[r4d] pool down $(date -u +%H:%M:%SZ); retry in 600s" >> "$LOG"
+    sleep 600
   done
 }
 
