@@ -526,6 +526,12 @@ def main():
         if errors:
             obj["note"] = "cpu fallback: " + " | ".join(e.splitlines()[0]
                                                         for e in errors)[:400]
+            # a wedged tunnel at measurement time must not hide earlier
+            # on-chip evidence — point the record at the session pack
+            pack = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "BENCH_TPU_SESSION_R4.json")
+            if os.path.exists(pack):
+                obj["on_chip_evidence"] = "BENCH_TPU_SESSION_R4.json"
         print(json.dumps(obj))
         return 0
     errors.append(f"cpu fallback: {tail}")

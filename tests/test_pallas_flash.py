@@ -278,3 +278,37 @@ class TestXlaFlashTier:
         for a, b in zip(gx, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=5e-4)
+
+
+class TestChunkedFallbackTier:
+    """The chunked-reference tier (_xla_fallback with sq > chunk) — the
+    path long sequences take when the scan formulation is pinned off
+    (PADDLE_TPU_XFA=0, added after the round-4 remote-compile wedge)."""
+
+    def test_chunked_matches_unchunked(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (_xla_fallback,
+                                                           mha_reference)
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.standard_normal((1, 2, 256, 16)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, 256, 16)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 256, 16)), jnp.float32)
+        out = _xla_fallback(q, k, v, True, 0.25, 0, 0, chunk=64)
+        ref = mha_reference(q, k, v, causal=True, sm_scale=0.25)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        o2, l2 = _xla_fallback(q, k, v, True, 0.25, 0, 0, with_lse=True,
+                               chunk=64)
+        r2, rl2 = mha_reference(q, k, v, causal=True, sm_scale=0.25,
+                                with_lse=True)
+        np.testing.assert_allclose(np.asarray(o2), np.asarray(r2), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(l2), np.asarray(rl2), atol=2e-5)
+
+    def test_xfa_env_pin_forces_chunked(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_XFA", "0")
+        from paddle_tpu.ops.pallas.flash_attention import _xflash_ok
+        import jax.numpy as jnp
+        q = jnp.zeros((1, 2, 512, 16))
+        assert not _xflash_ok(q, q)
+        monkeypatch.setenv("PADDLE_TPU_XFA", "1")
+        assert _xflash_ok(q, q)
