@@ -527,11 +527,27 @@ def main():
             obj["note"] = "cpu fallback: " + " | ".join(e.splitlines()[0]
                                                         for e in errors)[:400]
             # a wedged tunnel at measurement time must not hide earlier
-            # on-chip evidence — point the record at the session pack
-            pack = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                "BENCH_TPU_SESSION_R4.json")
-            if os.path.exists(pack):
-                obj["on_chip_evidence"] = "BENCH_TPU_SESSION_R4.json"
+            # on-chip evidence — point the record at the newest session
+            # pack, and only when that pack actually holds a successful
+            # on-chip run of THIS metric
+            import glob
+            here = os.path.dirname(os.path.abspath(__file__))
+            packs = sorted(glob.glob(os.path.join(here,
+                                                  "BENCH_TPU_SESSION*.json")),
+                           key=os.path.getmtime)
+            if packs:
+                try:
+                    with open(packs[-1]) as f:
+                        rows = json.load(f).get("results", [])
+                    hit = any(r.get("result", {}).get("metric") ==
+                              obj.get("metric")
+                              and r["result"].get("backend") == "tpu"
+                              and r["result"].get("value") is not None
+                              for r in rows)
+                except Exception:
+                    hit = False
+                if hit:
+                    obj["on_chip_evidence"] = os.path.basename(packs[-1])
         print(json.dumps(obj))
         return 0
     errors.append(f"cpu fallback: {tail}")

@@ -451,18 +451,26 @@ def _xla_fallback(q, k, v, causal, sm_scale, q_offset, kv_offset,
                   with_lse=False, chunk=1024):
     """Safe non-Mosaic path (kernel layout). Chunks the query axis so the
     fp32 logits temporary is O(chunk*sk), not O(sq*sk) — an unproven
-    kernel at long sequence lengths must degrade to slow, not to OOM."""
+    kernel at long sequence lengths must degrade to slow, not to OOM.
+    Each chunk is wrapped in ``jax.checkpoint`` so the backward also
+    recomputes its logits/probabilities per chunk: without it jax AD
+    saves every chunk's O(chunk*sk) softmax residuals, which together
+    re-materialize the full S×S memory this tier exists to avoid."""
     sq = q.shape[2]
     if sq <= chunk:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              q_offset=q_offset, kv_offset=kv_offset,
                              with_lse=with_lse)
+
+    @functools.partial(jax.checkpoint, static_argnums=(3,))
+    def one_chunk(qc, k, v, start):
+        return mha_reference(qc, k, v, causal=causal, sm_scale=sm_scale,
+                             q_offset=q_offset + start, kv_offset=kv_offset,
+                             with_lse=with_lse)
+
     outs, lses = [], []
     for start in range(0, sq, chunk):
-        res = mha_reference(q[:, :, start:start + chunk], k, v,
-                            causal=causal, sm_scale=sm_scale,
-                            q_offset=q_offset + start, kv_offset=kv_offset,
-                            with_lse=with_lse)
+        res = one_chunk(q[:, :, start:start + chunk], k, v, start)
         if with_lse:
             outs.append(res[0])
             lses.append(res[1])

@@ -304,6 +304,35 @@ class TestChunkedFallbackTier:
         np.testing.assert_allclose(np.asarray(o2), np.asarray(r2), atol=2e-5)
         np.testing.assert_allclose(np.asarray(l2), np.asarray(rl2), atol=2e-5)
 
+    def test_chunked_grads_match(self):
+        """The chunk remat (jax.checkpoint per chunk) must not change
+        gradients — and grads must flow through k/v, which are shared
+        across every chunk call."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (_xla_fallback,
+                                                           mha_reference)
+        rng = np.random.default_rng(4)
+        q = jnp.asarray(rng.standard_normal((1, 2, 256, 16)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, 256, 16)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 256, 16)), jnp.float32)
+
+        def loss_c(q, k, v):
+            out, lse = _xla_fallback(q, k, v, True, 0.25, 0, 0,
+                                     with_lse=True, chunk=64)
+            return (out ** 2).sum() + (lse * 0.1).sum()
+
+        def loss_r(q, k, v):
+            out, lse = mha_reference(q, k, v, causal=True, sm_scale=0.25,
+                                     with_lse=True)
+            return (out ** 2).sum() + (lse * 0.1).sum()
+
+        gc = jax.jit(jax.grad(loss_c, (0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+        for a, b in zip(gc, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
     def test_xfa_env_pin_forces_chunked(self, monkeypatch):
         monkeypatch.setenv("PADDLE_TPU_XFA", "0")
         from paddle_tpu.ops.pallas.flash_attention import _xflash_ok
