@@ -23,12 +23,36 @@ HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
 _global_mesh: Mesh | None = None
 
 
+def _slice_major(devices):
+    """Order devices slice-major for multi-slice (DCN-connected) systems.
+
+    Reference analogue: multi-node Fleet keeps NCCL rings node-local and
+    crosses nodes only on the outer (dp) axis. On TPU the slow links are
+    DCN between slices; jax exposes slice membership as
+    ``device.slice_index``. Returns ``(ordered_devices, n_slices)`` with
+    each slice's devices contiguous, so a row-major reshape puts slice
+    boundaries on the OUTERMOST mesh axis and every inner axis (mp/sep/
+    sharding/pp collectives) rides ICI only.
+    """
+    by_slice: dict[int, list] = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", 0) or 0, []).append(d)
+    groups = [by_slice[k] for k in sorted(by_slice)]
+    if len(groups) > 1 and len({len(g) for g in groups}) != 1:
+        raise ValueError(
+            f"uneven DCN slices: {[len(g) for g in groups]} devices per "
+            "slice — a hybrid mesh needs equal-size slices")
+    return [d for g in groups for d in g], len(groups)
+
+
 def init_mesh(degrees: dict[str, int] | None = None, devices=None) -> Mesh:
     """Build (and install) the global mesh from parallelism degrees.
 
     ``degrees`` maps axis name -> size; unspecified hybrid axes get 1. A
     remainder of devices is folded into dp. With no args: 1-D dp mesh over
-    all devices.
+    all devices. On multi-slice systems devices are ordered slice-major
+    and the dp degree must be a multiple of the slice count, so only the
+    outermost (DCN) axis crosses slices.
     """
     global _global_mesh
     devices = list(devices) if devices is not None else jax.devices()
@@ -46,6 +70,13 @@ def init_mesh(degrees: dict[str, int] | None = None, devices=None) -> Mesh:
     if prod != n:
         raise ValueError(f"degrees {dict(zip(HYBRID_AXES, sizes))} use {prod} "
                          f"devices, but {n} are available")
+    devices, n_slices = _slice_major(devices)
+    if n_slices > 1 and sizes[0] % n_slices != 0:
+        raise ValueError(
+            f"multi-slice mesh: dp degree {sizes[0]} must be a multiple of "
+            f"the DCN slice count {n_slices} — inner axes (pp/sharding/sep/"
+            "mp) must not straddle slices (their collectives would ride "
+            "DCN instead of ICI)")
     arr = np.array(devices).reshape(sizes)
     _global_mesh = Mesh(arr, HYBRID_AXES)
     return _global_mesh

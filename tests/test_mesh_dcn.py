@@ -1,0 +1,77 @@
+"""Multi-slice (DCN) mesh topology: device ordering and validation.
+
+Reference analogue: multi-node Fleet keeps comm rings node-local and
+crosses nodes only on the dp axis (SURVEY.md §2.3 comm backend — ICI
+intra-pod / DCN inter-slice). jax exposes slice membership as
+``device.slice_index``; ``init_mesh`` must order devices slice-major and
+refuse degree layouts whose inner axes would straddle slices.
+"""
+import pytest
+
+from paddle_tpu.distributed import mesh as mesh_mod
+
+
+class FakeDev:
+    def __init__(self, id, slice_index):
+        self.id = id
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"d{self.id}@s{self.slice_index}"
+
+
+def _devs(n, n_slices):
+    per = n // n_slices
+    # interleaved on purpose — jax.devices() order is not guaranteed
+    # slice-contiguous on multi-slice systems
+    return [FakeDev(i, i % n_slices) for i in range(n)]
+
+
+def test_slice_major_groups_contiguously():
+    devs = _devs(8, 2)
+    ordered, ns = mesh_mod._slice_major(devs)
+    assert ns == 2
+    assert [d.slice_index for d in ordered] == [0] * 4 + [1] * 4
+    # stable within a slice (keeps jax's ICI-friendly enumeration order)
+    assert [d.id for d in ordered] == [0, 2, 4, 6, 1, 3, 5, 7]
+
+
+def test_single_slice_passthrough():
+    devs = [FakeDev(i, 0) for i in range(4)]
+    ordered, ns = mesh_mod._slice_major(devs)
+    assert ns == 1 and [d.id for d in ordered] == [0, 1, 2, 3]
+
+
+def test_missing_slice_index_treated_as_one_slice():
+    class Bare:
+        pass
+    ordered, ns = mesh_mod._slice_major([Bare(), Bare()])
+    assert ns == 1
+
+
+def test_uneven_slices_rejected():
+    devs = [FakeDev(0, 0), FakeDev(1, 0), FakeDev(2, 1)]
+    with pytest.raises(ValueError, match="uneven DCN slices"):
+        mesh_mod._slice_major(devs)
+
+
+def test_inner_axis_straddling_rejected():
+    saved = mesh_mod._global_mesh
+    try:
+        # dp=1, mp=8 over 2 slices: mp would cross the DCN boundary
+        with pytest.raises(ValueError, match="multiple of the DCN slice"):
+            mesh_mod.init_mesh({"dp": 1, "mp": 8}, devices=_devs(8, 2))
+    finally:
+        mesh_mod._global_mesh = saved
+
+
+def test_dp_across_slices_allowed():
+    saved = mesh_mod._global_mesh
+    try:
+        m = mesh_mod.init_mesh({"dp": 2, "mp": 4}, devices=_devs(8, 2))
+        arr = m.devices
+        # dp index 0 -> slice 0, dp index 1 -> slice 1; mp stays intra-slice
+        assert all(d.slice_index == 0 for d in arr[0].reshape(-1))
+        assert all(d.slice_index == 1 for d in arr[1].reshape(-1))
+    finally:
+        mesh_mod._global_mesh = saved
