@@ -456,21 +456,32 @@ def _xla_fallback(q, k, v, causal, sm_scale, q_offset, kv_offset,
     recomputes its logits/probabilities per chunk: without it jax AD
     saves every chunk's O(chunk*sk) softmax residuals, which together
     re-materialize the full S×S memory this tier exists to avoid."""
-    sq = q.shape[2]
+    sq, sk = q.shape[2], k.shape[2]
     if sq <= chunk:
         return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale,
                              q_offset=q_offset, kv_offset=kv_offset,
                              with_lse=with_lse)
 
-    @functools.partial(jax.checkpoint, static_argnums=(3,))
-    def one_chunk(qc, k, v, start):
-        return mha_reference(qc, k, v, causal=causal, sm_scale=sm_scale,
-                             q_offset=q_offset + start, kv_offset=kv_offset,
-                             with_lse=with_lse)
+    @functools.partial(jax.checkpoint, static_argnums=(3, 4))
+    def one_chunk(qc, k, v, start, hi):
+        # the kv trim happens INSIDE the checkpoint boundary: the saved
+        # residual stays the one shared full k/v buffer, the sliced
+        # copies are recomputed in backward (slicing outside would pin
+        # every chunk's kv prefix live simultaneously — O(sq²·d/chunk))
+        return mha_reference(qc, k[:, :, :hi], v[:, :, :hi], causal=causal,
+                             sm_scale=sm_scale, q_offset=q_offset + start,
+                             kv_offset=kv_offset, with_lse=with_lse)
 
+    # causal + static offsets: chunk [start, start+chunk) can only attend
+    # to kv positions <= q_offset+start+chunk-1, so trim the kv suffix —
+    # the triangle costs half the FLOPs of the full rectangle
+    trim = causal and isinstance(q_offset, int) and isinstance(kv_offset, int)
     outs, lses = [], []
     for start in range(0, sq, chunk):
-        res = one_chunk(q[:, :, start:start + chunk], k, v, start)
+        hi = sk
+        if trim:
+            hi = max(min(sk, q_offset + start + chunk - kv_offset), 1)
+        res = one_chunk(q[:, :, start:start + chunk], k, v, start, hi)
         if with_lse:
             outs.append(res[0])
             lses.append(res[1])

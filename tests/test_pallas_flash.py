@@ -304,6 +304,22 @@ class TestChunkedFallbackTier:
         np.testing.assert_allclose(np.asarray(o2), np.asarray(r2), atol=2e-5)
         np.testing.assert_allclose(np.asarray(l2), np.asarray(rl2), atol=2e-5)
 
+    def test_chunked_offsets_trimmed_kv(self):
+        """Bottom-right-aligned causal (decode convention, q_offset>0):
+        the kv-trim must respect global positions, not local indices."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (_xla_fallback,
+                                                           mha_reference)
+        rng = np.random.default_rng(5)
+        q = jnp.asarray(rng.standard_normal((1, 2, 128, 16)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((1, 2, 256, 16)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((1, 2, 256, 16)), jnp.float32)
+        out = _xla_fallback(q, k, v, True, 0.25, 128, 0, chunk=32)
+        ref = mha_reference(q, k, v, causal=True, sm_scale=0.25,
+                            q_offset=128, kv_offset=0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
     def test_chunked_grads_match(self):
         """The chunk remat (jax.checkpoint per chunk) must not change
         gradients — and grads must flow through k/v, which are shared
