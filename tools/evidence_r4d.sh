@@ -130,3 +130,28 @@ with open("/root/repo/BENCH_TPU_SESSION_R4.json", "w") as f:
 print("assembled", len(results), "results")
 EOF
 echo "[r4d] done $(date -u +%H:%M:%SZ)" >> "$LOG"
+
+# Appended while the runner waited on pool recovery (append-only is safe
+# for an executing bash script): a lower-memory dots-policy row — the
+# b8/b16 dots rows above may exceed 16 GB HBM at the 1b preset — plus a
+# re-assembly so these rows land in the session JSON too.
+sweep_one "1b b4 s2048 dots plain" BENCH_PRESET=1b BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_REMAT=dots FLAGS_use_flash_attention=0
+sweep_one "1b b4 s4096 dots chunked" BENCH_PRESET=1b BENCH_BATCH=4 BENCH_SEQ=4096 BENCH_REMAT=dots PADDLE_TPU_XFA=0
+python - <<'EOF2'
+import json
+by_label, order = {}, []
+with open("/root/repo/BENCH_R4_PACK.jsonl") as f:
+    for line in f:
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        if row["label"] not in by_label:
+            order.append(row["label"])
+        by_label[row["label"]] = row
+with open("/root/repo/BENCH_TPU_SESSION_R4.json", "w") as f:
+    json.dump({"session": "round4",
+               "results": [by_label[l] for l in order]}, f, indent=1)
+print("re-assembled")
+EOF2
+echo "[r4d] appended rows done $(date -u +%H:%M:%SZ)" >> "$LOG"
