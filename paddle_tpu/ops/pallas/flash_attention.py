@@ -682,17 +682,72 @@ def _xflash_lse_bwd_rule(causal, sm_scale, res, g):
 _xflash_with_lse.defvjp(_xflash_lse_fwd_rule, _xflash_lse_bwd_rule)
 
 
+def _scanq(q, k, v, causal, sm_scale, q_offset, kv_offset,
+           with_lse=False, chunk=1024):
+    """Single-level scan tier: ``lax.scan`` over q-chunks, full-K plain
+    attention per chunk, ``jax.checkpoint`` body. Compared to the other
+    non-Mosaic tiers: graph size is CONSTANT in sequence length (the
+    unrolled chunked tier emits one subgraph per chunk) and there is no
+    scan-in-scan / custom_vjp structure (the _xflash formulation that
+    hung the round-4 remote compile). Memory O(chunk·sk) fwd and bwd
+    (remat body; k/v are closure constants whose cotangents the scan
+    transpose accumulates). Requires sq % chunk == 0 (callers fall back
+    to the chunked tier otherwise)."""
+    b, h, sq, d = q.shape
+    nq = sq // chunk
+    qb = jnp.moveaxis(q.reshape(b, h, nq, chunk, d), 2, 0)
+    q_off = jnp.asarray(q_offset, jnp.int32)
+
+    @jax.checkpoint
+    def body(qi, qc):
+        return mha_reference(qc, k, v, causal=causal, sm_scale=sm_scale,
+                             q_offset=q_off + qi * chunk,
+                             kv_offset=kv_offset, with_lse=True)
+
+    def step(carry, xs):
+        qi, qc = xs
+        return carry, body(qi, qc)
+
+    _, (outs, lses) = jax.lax.scan(
+        step, None, (jnp.arange(nq, dtype=jnp.int32), qb))
+    out = jnp.moveaxis(outs, 0, 2).reshape(b, h, sq, d)
+    if with_lse:
+        return out, jnp.moveaxis(lses, 0, 2).reshape(b, h, sq)
+    return out
+
+
+def _xfa_mode():
+    """PADDLE_TPU_XFA selects the non-Mosaic training tier:
+    ``1`` (default) the scan-formulation online-softmax flash (_xflash);
+    ``scanq`` the single-level scan-over-q-chunks tier; ``0`` the
+    unrolled chunked-reference tier. The knob exists because the round-4
+    on-chip session saw the scan formulation hang the remote XLA
+    compile — the bench runner pins known-safe tiers without touching
+    FLAGS."""
+    mode = _os.environ.get("PADDLE_TPU_XFA", "1")
+    if mode not in ("0", "1", "scanq"):
+        raise ValueError(f"PADDLE_TPU_XFA={mode!r}: expected 0, 1 or scanq")
+    return mode
+
+
+def _xfa_chunk():
+    return max(int(_os.environ.get("PADDLE_TPU_XFA_CHUNK", "1024")), 1)
+
+
 def _xflash_ok(q, k):
     """The scan formulation needs block-divisible sequence axes; other
-    shapes stay on the chunked-reference fallback. ``PADDLE_TPU_XFA=0``
-    forces the chunked tier: the round-4 on-chip session saw the scan
-    formulation hang the remote XLA compile, so the bench runner needs a
-    way to pin the known-safe path without touching FLAGS."""
-    if _os.environ.get("PADDLE_TPU_XFA", "1") == "0":
+    shapes stay on the chunked-reference fallback."""
+    if _xfa_mode() != "1":
         return False
     sq, sk = q.shape[2], k.shape[2]
     bq, bk = _xfa_blocks(sq, sk)
     return sq % bq == 0 and sk % bk == 0
+
+
+def _scanq_ok(q):
+    chunk = _xfa_chunk()
+    return (_xfa_mode() == "scanq" and q.shape[2] % chunk == 0
+            and q.shape[2] > chunk)
 
 
 def _mosaic_allowed():
@@ -729,6 +784,9 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, q_offset=0,
             offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                               jnp.asarray(kv_offset, jnp.int32)])
             out = _xflash(q, k, v, offs, causal, sm_scale)
+        elif _scanq_ok(q):
+            out = _scanq(q, k, v, causal, sm_scale, q_offset, kv_offset,
+                         chunk=_xfa_chunk())
         else:
             out = _xla_fallback(q, k, v, causal, sm_scale, q_offset,
                                 kv_offset)
@@ -756,6 +814,9 @@ def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None, q_offset=0,
             offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                               jnp.asarray(kv_offset, jnp.int32)])
             return _xflash_with_lse(q, k, v, offs, causal, sm_scale)
+        if _scanq_ok(q):
+            return _scanq(q, k, v, causal, sm_scale, q_offset, kv_offset,
+                          with_lse=True, chunk=_xfa_chunk())
         return _xla_fallback(q, k, v, causal, sm_scale, q_offset, kv_offset,
                              with_lse=True)
     offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),

@@ -357,3 +357,68 @@ class TestChunkedFallbackTier:
         assert not _xflash_ok(q, q)
         monkeypatch.setenv("PADDLE_TPU_XFA", "1")
         assert _xflash_ok(q, q)
+
+
+class TestScanQTier:
+    """Single-level scan tier (_scanq): lax.scan over q-chunks, full-K
+    per chunk, remat body — constant graph size in sequence length, no
+    scan-in-scan/custom_vjp (the structures suspected in the round-4
+    remote-compile hang)."""
+
+    def _all(self, b, hq, hk, sq, sk, d, causal, qo, chunk):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (_scanq,
+                                                           mha_reference)
+        rng = np.random.default_rng(6)
+        q = jnp.asarray(rng.standard_normal((b, hq, sq, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, hk, sk, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, hk, sk, d)), jnp.float32)
+        out, lse = jax.jit(lambda q, k, v: _scanq(
+            q, k, v, causal, 0.25, qo, 0, with_lse=True, chunk=chunk))(
+                q, k, v)
+        ref, rlse = mha_reference(q, k, v, causal=causal, sm_scale=0.25,
+                                  q_offset=qo, with_lse=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(rlse),
+                                   atol=2e-5)
+
+        def loss_s(q, k, v):
+            return (_scanq(q, k, v, causal, 0.25, qo, 0,
+                           chunk=chunk) ** 2).sum()
+
+        def loss_r(q, k, v):
+            return (mha_reference(q, k, v, causal=causal, sm_scale=0.25,
+                                  q_offset=qo) ** 2).sum()
+
+        gs = jax.jit(jax.grad(loss_s, (0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_r, (0, 1, 2))(q, k, v)
+        for a, b_ in zip(gs, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=5e-5)
+
+    def test_causal_mha(self):
+        self._all(1, 2, 2, 256, 256, 16, True, 0, 64)
+
+    def test_noncausal_gqa(self):
+        self._all(1, 4, 2, 128, 128, 16, False, 0, 32)
+
+    def test_decode_aligned_offset(self):
+        self._all(1, 2, 2, 128, 256, 16, True, 128, 32)
+
+    def test_selection_knob(self, monkeypatch):
+        import importlib
+        import jax.numpy as jnp
+        # the package re-exports the flash_attention FUNCTION under the
+        # same name as the submodule, so plain `import ... as fa` binds
+        # the function — load the module object explicitly
+        fa = importlib.import_module(
+            "paddle_tpu.ops.pallas.flash_attention")
+        q = jnp.zeros((1, 2, 2048, 16))
+        monkeypatch.setenv("PADDLE_TPU_XFA", "scanq")
+        assert fa._scanq_ok(q) and not fa._xflash_ok(q, q)
+        monkeypatch.setenv("PADDLE_TPU_XFA", "1")
+        assert not fa._scanq_ok(q) and fa._xflash_ok(q, q)
+        monkeypatch.setenv("PADDLE_TPU_XFA", "0")
+        assert not fa._scanq_ok(q) and not fa._xflash_ok(q, q)
