@@ -155,3 +155,24 @@ with open("/root/repo/BENCH_TPU_SESSION_R4.json", "w") as f:
 print("re-assembled")
 EOF2
 echo "[r4d] appended rows done $(date -u +%H:%M:%SZ)" >> "$LOG"
+# scanq-tier rows (appended): constant-graph-size scan tier
+sweep_one "1b b8 s2048 remat scanq" BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=1 PADDLE_TPU_XFA=scanq
+sweep_one "1b b8 s4096 remat scanq" BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=4096 BENCH_REMAT=1 PADDLE_TPU_XFA=scanq
+python - <<'EOF3'
+import json
+by_label, order = {}, []
+with open("/root/repo/BENCH_R4_PACK.jsonl") as f:
+    for line in f:
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        if row["label"] not in by_label:
+            order.append(row["label"])
+        by_label[row["label"]] = row
+with open("/root/repo/BENCH_TPU_SESSION_R4.json", "w") as f:
+    json.dump({"session": "round4",
+               "results": [by_label[l] for l in order]}, f, indent=1)
+print("re-assembled (scanq rows)")
+EOF3
+echo "[r4d] scanq rows done $(date -u +%H:%M:%SZ)" >> "$LOG"
