@@ -229,14 +229,34 @@ def bench_llama():
     labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                          jnp.int32)
 
+    # BENCH_ACCUM=n: gradient accumulation over n microbatches — an
+    # activation-memory lever for the 1b preset on a 16 GB chip (the
+    # microbatch fwd+bwd serialize on the grad-sum dependency, so peak
+    # activation memory is that of batch/n, at full arithmetic)
+    accum = max(int(os.environ.get("BENCH_ACCUM", "1")), 1)
+    assert batch % accum == 0, "BENCH_ACCUM must divide BENCH_BATCH"
+
     def train_step(p_arrs, key, ids, labels):
-        def loss_fn(ps):
+        def loss_fn(ps, mb_ids, mb_labels):
             cps = [a.astype(jnp.bfloat16) if amp and a.dtype == jnp.float32
                    else a for a in ps]
-            (loss, _), _ = fm(cps, [], key, ids, labels=labels)
+            (loss, _), _ = fm(cps, [], key, mb_ids, labels=mb_labels)
             return loss
 
-        loss, grads = jax.value_and_grad(loss_fn)(p_arrs)
+        if accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(p_arrs, ids, labels)
+        else:
+            mb = batch // accum
+            loss = 0.0
+            grads = None
+            for i in range(accum):
+                sl = slice(i * mb, (i + 1) * mb)
+                l_i, g_i = jax.value_and_grad(loss_fn)(
+                    p_arrs, ids[sl], labels[sl])
+                loss = loss + l_i / accum
+                grads = g_i if grads is None else [
+                    a + b for a, b in zip(grads, g_i)]
+            grads = [g / accum for g in grads]
         new_p = [p - 1e-4 * g.astype(p.dtype) for p, g in zip(p_arrs, grads)]
         return loss, new_p
 

@@ -176,3 +176,26 @@ with open("/root/repo/BENCH_TPU_SESSION_R4.json", "w") as f:
 print("re-assembled (scanq rows)")
 EOF3
 echo "[r4d] scanq rows done $(date -u +%H:%M:%SZ)" >> "$LOG"
+# grad-accumulation rows (appended): no-remat at effective batch 8/16 —
+# avoids the +33% recompute FLOPs that cap full-remat MFU
+sweep_one "1b b8 s2048 norem accum2" BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=0 BENCH_ACCUM=2 FLAGS_use_flash_attention=0
+sweep_one "1b b8 s2048 dots accum2"  BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=dots BENCH_ACCUM=2 FLAGS_use_flash_attention=0
+sweep_one "1b b16 s2048 norem accum4" BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=0 BENCH_ACCUM=4 FLAGS_use_flash_attention=0
+python - <<'EOF4'
+import json
+by_label, order = {}, []
+with open("/root/repo/BENCH_R4_PACK.jsonl") as f:
+    for line in f:
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        if row["label"] not in by_label:
+            order.append(row["label"])
+        by_label[row["label"]] = row
+with open("/root/repo/BENCH_TPU_SESSION_R4.json", "w") as f:
+    json.dump({"session": "round4",
+               "results": [by_label[l] for l in order]}, f, indent=1)
+print("re-assembled (accum rows)")
+EOF4
+echo "[r4d] accum rows done $(date -u +%H:%M:%SZ)" >> "$LOG"
