@@ -246,16 +246,25 @@ def bench_llama():
         if accum == 1:
             loss, grads = jax.value_and_grad(loss_fn)(p_arrs, ids, labels)
         else:
+            # lax.scan carrying the accumulator: the carry dependency
+            # forces microbatches to run strictly one after another, so
+            # the peak-memory property holds by construction (an
+            # unrolled Python loop would let XLA overlap forwards)
             mb = batch // accum
-            loss = 0.0
-            grads = None
-            for i in range(accum):
-                sl = slice(i * mb, (i + 1) * mb)
-                l_i, g_i = jax.value_and_grad(loss_fn)(
-                    p_arrs, ids[sl], labels[sl])
-                loss = loss + l_i / accum
-                grads = g_i if grads is None else [
-                    a + b for a, b in zip(grads, g_i)]
+            ids_mb = ids.reshape(accum, mb, ids.shape[1])
+            labels_mb = labels.reshape(accum, mb, labels.shape[1])
+
+            def acc_step(carry, xs):
+                loss_acc, grads_acc = carry
+                l_i, g_i = jax.value_and_grad(loss_fn)(p_arrs, *xs)
+                return (loss_acc + l_i,
+                        [a + b for a, b in zip(grads_acc, g_i)]), None
+
+            zeros = (jnp.zeros((), jnp.float32),
+                     [jnp.zeros_like(p) for p in p_arrs])
+            (loss, grads), _ = jax.lax.scan(acc_step, zeros,
+                                            (ids_mb, labels_mb))
+            loss = loss / accum
             grads = [g / accum for g in grads]
         new_p = [p - 1e-4 * g.astype(p.dtype) for p, g in zip(p_arrs, grads)]
         return loss, new_p
