@@ -583,6 +583,25 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
     dk = prandom.next_key() if (dropout_p > 0.0 and training) else None
 
+    # long-sequence memory safety: with flash unavailable (quarantined
+    # kernel, disabled flag, CPU) a no-mask/no-dropout attention at
+    # seq >= 4096 would materialize an S×S fp32 logits tensor — route it
+    # through the pure-XLA tier dispatcher instead (flash-like memory:
+    # per-chunk remat + causal kv-prefix trim, or the scan tiers per
+    # PADDLE_TPU_XFA)
+    if (attn_mask is None and (dropout_p == 0.0 or not training)
+            and query.shape[1] >= 4096):
+        from ...ops.pallas.flash_attention import xla_attention
+
+        def chunked_fn(q, k, v):
+            qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+            q_off = kt.shape[2] - qt.shape[2] if is_causal else 0
+            out = xla_attention(qt, kt, vt, causal=is_causal,
+                                q_offset=q_off)
+            return jnp.swapaxes(out, 1, 2)
+
+        return apply(chunked_fn, query, key, value, op_name="sdpa_chunked")
+
     def fn(q, k, v, *mask):
         scale = 1.0 / np.sqrt(q.shape[-1])
         # [b, s, h, d] -> [b, h, s, d]

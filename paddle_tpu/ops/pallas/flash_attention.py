@@ -61,14 +61,19 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, q_offset=0,
     Returns ``out`` or ``(out, lse)``; lse is fp32 [b, h, sq].
     """
     b, hq, sq, d = q.shape
-    hk = k.shape[1]
+    hk, sk = k.shape[1], k.shape[2]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if hk != hq:
-        k = jnp.repeat(k, hq // hk, axis=1)
-        v = jnp.repeat(v, hq // hk, axis=1)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32)) * sm_scale
+        # GQA via grouped einsum — no materialized K/V head repeats
+        g = hq // hk
+        qg = q.reshape(b, hk, g, sq, d).astype(jnp.float32)
+        logits = jnp.einsum("bhgqd,bhkd->bhgqk", qg,
+                            k.astype(jnp.float32)).reshape(b, hq, sq, sk)
+        logits = logits * sm_scale
+    else:
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * sm_scale
     if causal:
         qi = jnp.arange(sq)[:, None] + q_offset
         ki = jnp.arange(k.shape[2])[None, :] + kv_offset
@@ -77,7 +82,14 @@ def mha_reference(q, k, v, causal=True, sm_scale=None, q_offset=0,
     dead = m <= NEG_INF          # fully-masked row: zero output (kernel contract)
     p = jnp.where(dead, 0.0, jnp.exp(logits - m))
     l = jnp.sum(p, axis=-1, keepdims=True)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)) / jnp.maximum(l, 1e-30)
+    if hk != hq:
+        pg = p.reshape(b, hk, hq // hk, sq, sk)
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", pg,
+                         v.astype(jnp.float32)).reshape(b, hq, sq, d)
+        out = out / jnp.maximum(l, 1e-30)
+    else:
+        out = jnp.einsum("bhqk,bhkd->bhqd", p,
+                         v.astype(jnp.float32)) / jnp.maximum(l, 1e-30)
     out = out.astype(q.dtype)
     if not with_lse:
         return out
@@ -750,6 +762,29 @@ def _scanq_ok(q):
             and q.shape[2] > chunk)
 
 
+def xla_attention(q, k, v, causal=True, sm_scale=None, q_offset=0,
+                  kv_offset=0, with_lse=False):
+    """Non-Mosaic attention in kernel layout [b, h, s, d]: the single
+    dispatch point for the pure-XLA tiers (``PADDLE_TPU_XFA`` selects
+    _xflash / _scanq / the unrolled chunked tier). Used by
+    ``flash_attention`` when the Mosaic kernel is quarantined and by the
+    SDPA long-sequence memory-safety route — callers get tier
+    improvements without re-implementing the selection."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if _xflash_ok(q, k):
+        offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                          jnp.asarray(kv_offset, jnp.int32)])
+        if with_lse:
+            return _xflash_with_lse(q, k, v, offs, causal, sm_scale)
+        return _xflash(q, k, v, offs, causal, sm_scale)
+    if _scanq_ok(q):
+        return _scanq(q, k, v, causal, sm_scale, q_offset, kv_offset,
+                      with_lse=with_lse, chunk=_xfa_chunk())
+    return _xla_fallback(q, k, v, causal, sm_scale, q_offset, kv_offset,
+                         with_lse=with_lse)
+
+
 def _mosaic_allowed():
     """First-compile guard (VERDICT.md round-2 weak #1): on a real TPU,
     dispatching this kernel from a long-lived process requires a prior
@@ -780,16 +815,7 @@ def flash_attention(q, k, v, causal=True, sm_scale=None, q_offset=0,
     if not kernel_layout:
         q, k, v = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
     if not interpret and not _mosaic_allowed():
-        if _xflash_ok(q, k):
-            offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
-                              jnp.asarray(kv_offset, jnp.int32)])
-            out = _xflash(q, k, v, offs, causal, sm_scale)
-        elif _scanq_ok(q):
-            out = _scanq(q, k, v, causal, sm_scale, q_offset, kv_offset,
-                         chunk=_xfa_chunk())
-        else:
-            out = _xla_fallback(q, k, v, causal, sm_scale, q_offset,
-                                kv_offset)
+        out = xla_attention(q, k, v, causal, sm_scale, q_offset, kv_offset)
     else:
         offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                           jnp.asarray(kv_offset, jnp.int32)])
@@ -810,14 +836,7 @@ def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None, q_offset=0,
     if interpret is None:
         interpret = _default_interpret()
     if not interpret and not _mosaic_allowed():
-        if _xflash_ok(q, k):
-            offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
-                              jnp.asarray(kv_offset, jnp.int32)])
-            return _xflash_with_lse(q, k, v, offs, causal, sm_scale)
-        if _scanq_ok(q):
-            return _scanq(q, k, v, causal, sm_scale, q_offset, kv_offset,
-                          with_lse=True, chunk=_xfa_chunk())
-        return _xla_fallback(q, k, v, causal, sm_scale, q_offset, kv_offset,
+        return xla_attention(q, k, v, causal, sm_scale, q_offset, kv_offset,
                              with_lse=True)
     offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                       jnp.asarray(kv_offset, jnp.int32)])

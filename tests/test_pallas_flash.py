@@ -422,3 +422,33 @@ class TestScanQTier:
         assert not fa._scanq_ok(q) and fa._xflash_ok(q, q)
         monkeypatch.setenv("PADDLE_TPU_XFA", "0")
         assert not fa._scanq_ok(q) and not fa._xflash_ok(q, q)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_sdpa_long_seq_routes_chunked(causal, monkeypatch):
+    """F.scaled_dot_product_attention: no-mask attention at seq>=4096
+    with flash unavailable must route through the pure-XLA tier
+    dispatcher (O(chunk*S) memory) and match the full-scores
+    reference. A spy asserts the route is actually taken."""
+    import importlib
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+    calls = []
+    real = fa.xla_attention
+    monkeypatch.setattr(fa, "xla_attention",
+                        lambda *a, **kw: (calls.append(1), real(*a, **kw))[1])
+
+    rng = np.random.default_rng(8)
+    q = paddle.to_tensor(rng.standard_normal((1, 4096, 1, 8)).astype("float32"))
+    k = paddle.to_tensor(rng.standard_normal((1, 4096, 1, 8)).astype("float32"))
+    v = paddle.to_tensor(rng.standard_normal((1, 4096, 1, 8)).astype("float32"))
+    out = F.scaled_dot_product_attention(q, k, v, is_causal=causal)
+    assert calls, "long-seq SDPA did not take the xla_attention route"
+    ref = fa.mha_reference(jnp.swapaxes(q._data, 1, 2),
+                           jnp.swapaxes(k._data, 1, 2),
+                           jnp.swapaxes(v._data, 1, 2), causal=causal)
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.asarray(jnp.swapaxes(ref, 1, 2)),
+                               atol=3e-5)
