@@ -28,10 +28,10 @@ from ...profiler.mfu import PEAK_FLOPS, transformer_train_flops
 # profiler.mfu so tuner estimates and measured MFU agree; HBM bytes,
 # ICI GB/s per link — conservative public numbers)
 CHIPS = {
-    "v4": dict(flops=PEAK_FLOPS["v4"], hbm=32e9, ici=100e9),
-    "v5e": dict(flops=PEAK_FLOPS["v5e"], hbm=16e9, ici=50e9),
-    "v5p": dict(flops=PEAK_FLOPS["v5p"], hbm=95e9, ici=100e9),
-    "v6e": dict(flops=PEAK_FLOPS["v6e"], hbm=32e9, ici=100e9),
+    "v4": dict(flops=PEAK_FLOPS["v4"], hbm=32e9, ici=100e9, dcn=6.25e9),
+    "v5e": dict(flops=PEAK_FLOPS["v5e"], hbm=16e9, ici=50e9, dcn=6.25e9),
+    "v5p": dict(flops=PEAK_FLOPS["v5p"], hbm=95e9, ici=100e9, dcn=6.25e9),
+    "v6e": dict(flops=PEAK_FLOPS["v6e"], hbm=32e9, ici=100e9, dcn=6.25e9),
 }
 
 
@@ -99,11 +99,17 @@ class Plan:
 
 class CostModel:
     def __init__(self, chip="v5p", mfu_target=0.45, micro_batches=8,
-                 recompute=True):
+                 recompute=True, n_slices=1):
+        """``n_slices``: DCN-connected slice count. mesh.init_mesh puts
+        slice boundaries on the outermost (dp) axis, so when the dp
+        degree spans slices its grad collectives ride DCN bandwidth,
+        not ICI — the cost model must price that or multi-slice plans
+        look free."""
         self.hw = CHIPS[chip] if isinstance(chip, str) else chip
         self.eff = mfu_target
         self.micro = micro_batches
         self.recompute = recompute
+        self.n_slices = max(int(n_slices), 1)
 
     # -- memory ---------------------------------------------------------------
     def memory_per_chip(self, m: ModelSpec, d: dict):
@@ -139,12 +145,27 @@ class CostModel:
         if d["mp"] > 1:
             vol = 2 * m.num_layers * toks_per_chip * m.hidden * 2  # bf16
             tp = 2 * vol * (d["mp"] - 1) / d["mp"] / ici
-        # grads: reduce-scatter + all-gather over the dp·sharding group
+        # grads: reduce-scatter + all-gather over the dp·sharding group.
+        # Multi-slice: the group decomposes hierarchically — intra-slice
+        # legs ride ICI, the inter-slice leg rides DCN (mesh.init_mesh
+        # guarantees only the outer dp axis crosses slices)
         data = d["dp"] * d["sharding"]
         dpc = 0.0
         if data > 1:
             gbytes = m.n_params * 2 / (d["mp"] * d["pp"])
-            dpc = 2 * gbytes * (data - 1) / data / ici
+            if self.n_slices > 1:
+                # hierarchical allreduce: intra-slice reduce-scatter on
+                # ICI leaves each chip a gbytes/intra shard; only that
+                # shard crosses DCN. Keyed on the mesh contract (slice
+                # boundaries live on the dp axis; Tuner._valid rejects
+                # dp not divisible by n_slices).
+                intra = max(data // self.n_slices, 1)
+                s = self.n_slices
+                dpc = (2 * gbytes * (intra - 1) / intra / ici
+                       + 2 * (gbytes / intra) * (s - 1) / s
+                       / self.hw["dcn"])
+            else:
+                dpc = 2 * gbytes * (data - 1) / data / ici
         # sep (context parallel): ring K/V exchange per layer
         sp = 0.0
         if d["sep"] > 1:
@@ -174,8 +195,8 @@ class Tuner:
     AXES = ("dp", "pp", "sharding", "sep", "mp")
 
     def __init__(self, cost_model: CostModel | None = None, chip="v5p",
-                 max_mp=8, max_pp=16):
-        self.cm = cost_model or CostModel(chip=chip)
+                 max_mp=8, max_pp=16, n_slices=1):
+        self.cm = cost_model or CostModel(chip=chip, n_slices=n_slices)
         self.max_mp = max_mp
         self.max_pp = max_pp
 
@@ -197,6 +218,10 @@ class Tuner:
         if d["sep"] > 1 and m.seq_len % d["sep"]:
             return False
         if m.global_batch % (d["dp"] * d["sharding"]):
+            return False
+        # mesh.init_mesh contract: slice boundaries sit on the dp axis,
+        # so multi-slice plans need dp divisible by the slice count
+        if self.cm.n_slices > 1 and d["dp"] % self.cm.n_slices:
             return False
         return True
 

@@ -106,7 +106,9 @@ def _apply_auto_search(strategy):
         spec = ModelSpec.from_config(model, seq_len=seq_len,
                                      global_batch=global_batch or n)
     try:
-        plan = Tuner(chip=chip).tune(spec, n, top_k=1)[0]
+        n_slices = len({getattr(d, "slice_index", 0) or 0
+                        for d in jax.devices()})
+        plan = Tuner(chip=chip, n_slices=n_slices).tune(spec, n, top_k=1)[0]
     except ValueError as e:
         print(f"fleet.init: auto_search found no valid plan ({e}); "
               f"keeping dp-only", file=sys.stderr)

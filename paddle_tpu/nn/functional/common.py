@@ -590,7 +590,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     # per-chunk remat + causal kv-prefix trim, or the scan tiers per
     # PADDLE_TPU_XFA)
     if (attn_mask is None and (dropout_p == 0.0 or not training)
-            and query.shape[1] >= 4096):
+            and query.shape[1] > 1
+            and query.shape[1] * key.shape[1] >= 4096 * 4096):
         from ...ops.pallas.flash_attention import xla_attention
 
         def chunked_fn(q, k, v):

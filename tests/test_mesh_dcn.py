@@ -75,3 +75,16 @@ def test_dp_across_slices_allowed():
         assert all(d.slice_index == 1 for d in arr[1].reshape(-1))
     finally:
         mesh_mod._global_mesh = saved
+
+
+def test_cost_model_prices_dcn():
+    """A dp group spanning slices must cost more than the same group on
+    one slice — the inter-slice leg rides DCN, not ICI."""
+    from paddle_tpu.distributed.auto_parallel.cost_model import (CostModel,
+                                                                 ModelSpec)
+    m = ModelSpec(num_layers=22, hidden=2048, intermediate=5632,
+                  vocab=32000, seq_len=2048, global_batch=64)
+    d = {"dp": 8, "pp": 1, "sharding": 1, "sep": 1, "mp": 1}
+    one = CostModel(chip="v5p", n_slices=1).step_time(m, d)[1]["dp_raw_s"]
+    two = CostModel(chip="v5p", n_slices=2).step_time(m, d)[1]["dp_raw_s"]
+    assert two > one * 2, (one, two)
