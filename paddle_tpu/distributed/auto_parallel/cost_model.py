@@ -163,7 +163,7 @@ class CostModel:
                 s = self.n_slices
                 dpc = (2 * gbytes * (intra - 1) / intra / ici
                        + 2 * (gbytes / intra) * (s - 1) / s
-                       / self.hw["dcn"])
+                       / self.hw.get("dcn", 6.25e9))
             else:
                 dpc = 2 * gbytes * (data - 1) / data / ici
         # sep (context parallel): ring K/V exchange per layer

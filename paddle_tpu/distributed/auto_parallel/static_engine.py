@@ -195,7 +195,9 @@ class Engine:
                          if k in plat), "v5e")
         spec = ModelSpec.from_config(cfg, seq_len=seq_len,
                                      global_batch=global_batch or 8)
-        cm = CostModel(chip=chip)
+        from ..mesh import _slice_major
+        n_slices = _slice_major(jax.devices())[1]
+        cm = CostModel(chip=chip, n_slices=n_slices)
         t, breakdown = cm.step_time(spec, degrees)
         return {"step_time_s": t, "mem_per_chip": cm.memory_per_chip(
             spec, degrees), "degrees": degrees, **breakdown}

@@ -106,8 +106,8 @@ def _apply_auto_search(strategy):
         spec = ModelSpec.from_config(model, seq_len=seq_len,
                                      global_batch=global_batch or n)
     try:
-        n_slices = len({getattr(d, "slice_index", 0) or 0
-                        for d in jax.devices()})
+        from ..mesh import _slice_major
+        n_slices = _slice_major(jax.devices())[1]
         plan = Tuner(chip=chip, n_slices=n_slices).tune(spec, n, top_k=1)[0]
     except ValueError as e:
         print(f"fleet.init: auto_search found no valid plan ({e}); "

@@ -6,6 +6,7 @@ from .layers.common import (  # noqa: F401
     Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
     Identity, Upsample, UpsamplingNearest2D, UpsamplingBilinear2D, PixelShuffle,
     Pad1D, Pad2D, Pad3D, ZeroPad2D, Bilinear, CosineSimilarity, Unfold,
+    ChannelShuffle,
 )
 from .layers.conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa: F401
 from .layers.norm import (  # noqa: F401
@@ -26,7 +27,7 @@ from .layers.activation import (  # noqa: F401
 from .layers.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
     BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
-    TripletMarginLoss, HingeEmbeddingLoss,
+    TripletMarginLoss, HingeEmbeddingLoss, HuberLoss, GaussianNLLLoss,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,

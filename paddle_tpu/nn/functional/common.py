@@ -526,6 +526,22 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     return apply(fn, x, op_name="pixel_shuffle")
 
 
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """reference: ``paddle.nn.functional.channel_shuffle``."""
+    def fn(a):
+        if data_format == "NHWC":
+            n, h, w, c = a.shape
+            a = a.reshape(n, h, w, groups, c // groups)
+            a = jnp.swapaxes(a, 3, 4)
+            return a.reshape(n, h, w, c)
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = jnp.swapaxes(a, 1, 2)
+        return a.reshape(n, c, h, w)
+
+    return apply(fn, x, op_name="channel_shuffle")
+
+
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     ks = _tuple(kernel_sizes, 2)
     st = _tuple(strides, 2)

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...framework.core import Tensor
 from ...autograd.tape import apply
@@ -117,6 +118,26 @@ def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
         return _reduce(loss, reduction)
 
     return apply(fn, input, label, op_name="smooth_l1_loss")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """Paddle's huber_loss argument order; the Huber form itself is
+    smooth_l1 (reference: ``paddle.nn.functional.huber_loss``)."""
+    return smooth_l1_loss(input, label, reduction, delta)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Negative log likelihood of a diagonal Gaussian
+    (reference: ``paddle.nn.functional.gaussian_nll_loss``)."""
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * float(np.log(2 * np.pi))
+        return _reduce(loss, reduction)
+
+    return apply(fn, input, label, variance, op_name="gaussian_nll_loss")
 
 
 def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):

@@ -157,6 +157,16 @@ class PixelShuffle(Layer):
         return F.pixel_shuffle(x, self.r, self.data_format)
 
 
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
 class _PadNd(Layer):
     def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW"):
         super().__init__()

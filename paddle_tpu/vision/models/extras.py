@@ -225,14 +225,8 @@ def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
 
 
 def _channel_shuffle(x, groups):
-    from ...autograd.tape import apply
-    import jax.numpy as jnp
-
-    def fn(a):
-        n, c, h, w = a.shape
-        a = a.reshape(n, groups, c // groups, h, w)
-        return jnp.swapaxes(a, 1, 2).reshape(n, c, h, w)
-    return apply(fn, x, op_name="channel_shuffle")
+    from ...nn.functional import channel_shuffle
+    return channel_shuffle(x, groups)
 
 
 class _ShuffleUnit(nn.Layer):

@@ -308,3 +308,34 @@ def test_second_review_fixes():
     _cmp(got, want, rtol=1e-5)
     got_l = nn.AdaptiveAvgPool3D(3)(t(x5))
     _cmp(got_l, want, rtol=1e-5)
+
+
+def test_channel_shuffle_huber_gaussian_nll():
+    """Round-4 API-parity additions: nn.ChannelShuffle / HuberLoss /
+    GaussianNLLLoss (+ functionals)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    x = paddle.to_tensor(
+        np.arange(1 * 4 * 2 * 2, dtype="float32").reshape(1, 4, 2, 2))
+    y = nn.ChannelShuffle(2)(x)
+    # NCHW groups=2: channels [0,1,2,3] -> [0,2,1,3]
+    np.testing.assert_allclose(np.asarray(y._data)[0, :, 0, 0],
+                               np.asarray(x._data)[0, [0, 2, 1, 3], 0, 0])
+
+    a = paddle.to_tensor(np.array([0.0, 3.0], dtype="float32"))
+    b = paddle.to_tensor(np.array([0.5, 0.0], dtype="float32"))
+    h = nn.HuberLoss(reduction="none", delta=1.0)(a, b)
+    np.testing.assert_allclose(np.asarray(h._data), [0.125, 2.5], atol=1e-6)
+
+    var = paddle.to_tensor(np.array([1.0, 4.0], dtype="float32"))
+    g = nn.GaussianNLLLoss(reduction="none")(a, b, var)
+    expect = 0.5 * (np.log([1.0, 4.0]) + np.array([0.25, 9.0]) / [1.0, 4.0])
+    np.testing.assert_allclose(np.asarray(g._data), expect, atol=1e-6)
+
+    # grads flow
+    a.stop_gradient = False
+    loss = nn.HuberLoss()(a, b)
+    loss.backward()
+    assert a.grad is not None
