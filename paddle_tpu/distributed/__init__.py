@@ -14,8 +14,8 @@ from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, destroy_process_group,
     all_reduce, all_gather, all_gather_object, reduce_scatter,
     alltoall, alltoall_single, broadcast, broadcast_object_list,
-    reduce, scatter, barrier, send, recv, isend, irecv,
-    P2POp, batch_isend_irecv, stream,
+    reduce, scatter, gather, scatter_object_list, barrier,
+    send, recv, isend, irecv, P2POp, batch_isend_irecv, stream,
 )
 from .parallel import DataParallel, shard_tensor_on_axis  # noqa: F401
 from .spawn import spawn  # noqa: F401

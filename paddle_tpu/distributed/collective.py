@@ -470,6 +470,35 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     return _Task()
 
 
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather tensors from every rank to ``dst`` (reference:
+    ``paddle.distributed.gather``); non-dst ranks receive nothing."""
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        if gather_list is not None:
+            gather_list.append(Tensor(_np(tensor)))
+        return _Task()
+    got = _exchange("gather", _np(tensor), group)
+    dst_group_rank = group.get_group_rank(dst) if dst in group.ranks else dst
+    if group.rank == dst_group_rank and gather_list is not None:
+        for i in range(group.nranks):
+            gather_list.append(Tensor(jnp.asarray(got[i])))
+    return _Task()
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter a list of picklable objects from ``src`` (reference:
+    ``paddle.distributed.scatter_object_list``)."""
+    group = group or _get_default_group()
+    if group.nranks == 1:
+        out_object_list.append(in_object_list[0])
+        return
+    got = _exchange("scatter_object_list", in_object_list, group)
+    src_group_rank = group.get_group_rank(src) if src in group.ranks else src
+    out_object_list.append(got[src_group_rank][group.rank])
+
+
 def barrier(group=None):
     group = group or _get_default_group()
     if group.nranks == 1:

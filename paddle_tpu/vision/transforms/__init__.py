@@ -30,6 +30,39 @@ class BaseTransform:
         raise NotImplementedError
 
 
+def _inverse_warp(arr, ys, xs, interpolation="nearest", fill=0,
+                  out_shape=None):
+    """Sample ``arr`` (HWC or HW numpy) at source coordinates (ys, xs) —
+    the shared inverse-map warp behind RandomRotation / RandomAffine /
+    RandomPerspective. Out-of-bounds pixels get ``fill``."""
+    h, w = arr.shape[:2]
+    shape = ((out_shape or ys.shape) + arr.shape[2:])
+
+    def gather(yi, xi):
+        inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        src = arr.astype(np.float32)[np.clip(yi, 0, h - 1),
+                                     np.clip(xi, 0, w - 1)]
+        m = inb[..., None] if arr.ndim == 3 else inb
+        return np.where(m, src, float(fill))
+
+    if interpolation == "nearest":
+        out = gather(np.round(ys).astype(np.int64),
+                     np.round(xs).astype(np.int64))
+    else:
+        y0 = np.floor(ys).astype(np.int64)
+        x0 = np.floor(xs).astype(np.int64)
+        wy = (ys - y0)[..., None] if arr.ndim == 3 else ys - y0
+        wx = (xs - x0)[..., None] if arr.ndim == 3 else xs - x0
+        out = (gather(y0, x0) * (1 - wy) * (1 - wx)
+               + gather(y0, x0 + 1) * (1 - wy) * wx
+               + gather(y0 + 1, x0) * wy * (1 - wx)
+               + gather(y0 + 1, x0 + 1) * wy * wx)
+    out = out.reshape(shape)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
 def _to_hwc_array(img):
     if isinstance(img, Tensor):
         return img.numpy()
@@ -411,28 +444,190 @@ class RandomRotation(BaseTransform):
         # inverse map: output pixel -> source coordinate
         ys = cy + (yy - ocy) * np.cos(ang) - (xx - ocx) * np.sin(ang)
         xs = cx + (yy - ocy) * np.sin(ang) + (xx - ocx) * np.cos(ang)
-        shape = ((oh, ow) + arr.shape[2:])
+        return _inverse_warp(arr, ys, xs, self.interpolation, self.fill,
+                             out_shape=(oh, ow))
 
-        def gather(yi, xi):
-            inb = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-            src = arr.astype(np.float32)[np.clip(yi, 0, h - 1),
-                                         np.clip(xi, 0, w - 1)]
-            m = inb[..., None] if arr.ndim == 3 else inb
-            return np.where(m, src, float(self.fill))
 
-        if self.interpolation == "nearest":
-            out = gather(np.round(ys).astype(np.int64),
-                         np.round(xs).astype(np.int64))
-        else:
-            y0 = np.floor(ys).astype(np.int64)
-            x0 = np.floor(xs).astype(np.int64)
-            wy = (ys - y0)[..., None] if arr.ndim == 3 else ys - y0
-            wx = (xs - x0)[..., None] if arr.ndim == 3 else xs - x0
-            out = (gather(y0, x0) * (1 - wy) * (1 - wx)
-                   + gather(y0, x0 + 1) * (1 - wy) * wx
-                   + gather(y0 + 1, x0) * wy * (1 - wx)
-                   + gather(y0 + 1, x0 + 1) * wy * wx)
-        out = out.reshape(shape)
-        if arr.dtype == np.uint8:
-            out = np.clip(out, 0, 255).astype(np.uint8)
+class RandomErasing(BaseTransform):
+    """Randomly erase a rectangle (reference:
+    ``paddle.vision.transforms.RandomErasing``). Operates on tensors or
+    HWC arrays; ``value`` may be a float, per-channel sequence, or
+    'random'."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return img
+        arr = _to_hwc_array(img)
+        if not (self.inplace and isinstance(img, np.ndarray)):
+            arr = arr.copy()
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w and eh > 0 and ew > 0:
+                y = np.random.randint(0, h - eh + 1)
+                x = np.random.randint(0, w - ew + 1)
+                c = arr.shape[2] if arr.ndim == 3 else 1
+                if isinstance(self.value, str) and self.value == "random":
+                    patch = np.random.standard_normal((eh, ew, c))
+                else:
+                    patch = np.broadcast_to(
+                        np.asarray(self.value, np.float32), (eh, ew, c))
+                patch = patch.reshape((eh, ew, c) if arr.ndim == 3
+                                      else (eh, ew))
+                if arr.dtype == np.uint8:
+                    patch = np.clip(patch, 0, 255).astype(np.uint8)
+                arr[y:y + eh, x:x + ew] = patch
+                break
+        return arr
+
+
+class GaussianBlur(BaseTransform):
+    """Separable Gaussian blur (reference:
+    ``paddle.vision.transforms.GaussianBlur``); sigma drawn uniformly
+    from the given range per call."""
+
+    def __init__(self, kernel_size=3, sigma=(0.1, 2.0), keys=None):
+        super().__init__(keys)
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        if isinstance(sigma, (int, float)):
+            sigma = (float(sigma), float(sigma))
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        dtype = arr.dtype
+        out = arr.astype(np.float32)
+        sig = np.random.uniform(*self.sigma)
+
+        def kernel(k):
+            r = np.arange(k) - (k - 1) / 2.0
+            g = np.exp(-(r ** 2) / (2 * sig * sig))
+            return g / g.sum()
+
+        kx, ky = kernel(self.kernel_size[0]), kernel(self.kernel_size[1])
+        # reflect-pad + correlate along each axis
+        py, px = len(ky) // 2, len(kx) // 2
+        if out.ndim == 2:
+            out = out[..., None]
+        pad = np.pad(out, ((py, py), (0, 0), (0, 0)), mode="reflect")
+        out = sum(pad[i:i + out.shape[0]] * ky[i]
+                  for i in range(len(ky)))
+        pad = np.pad(out, ((0, 0), (px, px), (0, 0)), mode="reflect")
+        out = sum(pad[:, i:i + out.shape[1]] * kx[i]
+                  for i in range(len(kx)))
+        out = out.reshape(arr.shape)
+        if dtype == np.uint8:
+            out = np.clip(np.round(out), 0, 255).astype(np.uint8)
         return out
+
+
+class RandomAffine(BaseTransform):
+    """Random affine (rotation, translation, scale, shear) via the shared
+    inverse-map warp (reference: ``paddle.vision.transforms.RandomAffine``)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            degrees = (-float(degrees), float(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _to_hwc_array(img)
+        h, w = arr.shape[:2]
+        ang = np.deg2rad(np.random.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        shx = shy = 0.0
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, (int, float)):
+                sh = (-float(sh), float(sh))
+            shx = np.deg2rad(np.random.uniform(sh[0], sh[1]))
+            if len(sh) == 4:
+                shy = np.deg2rad(np.random.uniform(sh[2], sh[3]))
+        if self.center is not None:
+            cx, cy = float(self.center[0]), float(self.center[1])
+        else:
+            cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        # forward matrix M = T(center+t) @ R(ang) @ Shear @ S(sc) @ T(-center)
+        cos, sin = np.cos(ang), np.sin(ang)
+        rs = np.array([[cos, -sin], [sin, cos]]) @ \
+            np.array([[1.0, np.tan(shx)], [np.tan(shy), 1.0]]) * sc
+        inv = np.linalg.inv(rs)
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        dx = xx - cx - tx
+        dy = yy - cy - ty
+        xs = cx + inv[0, 0] * dx + inv[0, 1] * dy
+        ys = cy + inv[1, 0] * dx + inv[1, 1] * dy
+        return _inverse_warp(arr, ys, xs, self.interpolation, self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    """Random four-point perspective warp (reference:
+    ``paddle.vision.transforms.RandomPerspective``)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return img
+        arr = _to_hwc_array(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx, dy = w * d / 2, h * d / 2
+        src = np.array([[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]],
+                       np.float64)
+        # inward-only corner jitter (reference semantics): the warped
+        # quad stays convex, so the homography is always well-posed
+        ox = np.random.uniform(0, max(dx, 1e-9), 4)
+        oy = np.random.uniform(0, max(dy, 1e-9), 4)
+        if d == 0:
+            ox = oy = np.zeros(4)
+        inward = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1]], np.float64)
+        dst = src + inward * np.stack([ox, oy], axis=1)
+        # homography mapping dst -> src (inverse map for output sampling)
+        A, b = [], []
+        for (xd, yd), (xs_, ys_) in zip(dst, src):
+            A.append([xd, yd, 1, 0, 0, 0, -xs_ * xd, -xs_ * yd])
+            A.append([0, 0, 0, xd, yd, 1, -ys_ * xd, -ys_ * yd])
+            b.extend([xs_, ys_])
+        hcoef = np.linalg.solve(np.asarray(A, np.float64),
+                                np.asarray(b, np.float64))
+        H = np.append(hcoef, 1.0).reshape(3, 3)
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        den = H[2, 0] * xx + H[2, 1] * yy + H[2, 2]
+        xs = (H[0, 0] * xx + H[0, 1] * yy + H[0, 2]) / den
+        ys = (H[1, 0] * xx + H[1, 1] * yy + H[1, 2]) / den
+        return _inverse_warp(arr, ys, xs, self.interpolation, self.fill)

@@ -453,3 +453,29 @@ class TestRecompute:
         loss = st(x).sum()
         loss.backward()
         assert inner[0].weight.grad is not None
+
+
+class TestGatherScatterObjects:
+    def test_gather_to_dst(self):
+        def worker():
+            r = dist.get_rank()
+            out = []
+            dist.gather(paddle.to_tensor(np.array([float(r)], "float32")),
+                        out, dst=1)
+            return [t.numpy()[0] for t in out]
+
+        res = dist.spawn(worker, nprocs=3).results
+        assert res[1] == [0.0, 1.0, 2.0]
+        assert res[0] == [] and res[2] == []
+
+    def test_scatter_object_list(self):
+        def worker():
+            r = dist.get_rank()
+            out = []
+            payload = [{"rank": i, "x": i * 2} for i in range(3)] \
+                if r == 0 else None
+            dist.scatter_object_list(out, payload, src=0)
+            return out[0]
+
+        res = dist.spawn(worker, nprocs=3).results
+        assert [v["x"] for v in res] == [0, 2, 4]

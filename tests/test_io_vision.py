@@ -225,3 +225,35 @@ def test_cached_vision_datasets(tmp_path):
 
     with pytest.raises(IOError, match="place the reference archive"):
         VOC2012(data_file=str(tmp_path / "missing.npz"))
+
+
+def test_round4_transforms():
+    """RandomErasing / GaussianBlur / RandomAffine / RandomPerspective."""
+    import numpy as np
+    from paddle_tpu.vision import transforms as T
+
+    np.random.seed(3)
+    img = np.random.randint(0, 255, (32, 48, 3), np.uint8)
+
+    er = T.RandomErasing(prob=1.0, value=0)(img)
+    assert er.shape == img.shape and er.dtype == np.uint8
+    assert (er != img).any(), "nothing erased at prob=1"
+
+    bl = T.GaussianBlur(kernel_size=5, sigma=1.5)(img)
+    assert bl.shape == img.shape and bl.dtype == np.uint8
+    # blur must reduce local variance
+    assert np.diff(bl.astype(int), axis=0).std() < \
+        np.diff(img.astype(int), axis=0).std()
+
+    # identity affine == identity warp
+    ident = T.RandomAffine(degrees=(0, 0))(img)
+    np.testing.assert_array_equal(ident, img)
+    aff = T.RandomAffine(degrees=30, translate=(0.1, 0.1), scale=(0.8, 1.2),
+                         shear=10, interpolation="bilinear")(img)
+    assert aff.shape == img.shape
+
+    # distortion_scale=0 -> identity homography
+    same = T.RandomPerspective(prob=1.0, distortion_scale=0.0)(img)
+    np.testing.assert_array_equal(same, img)
+    warped = T.RandomPerspective(prob=1.0, distortion_scale=0.5)(img)
+    assert warped.shape == img.shape and (warped != img).any()
