@@ -253,3 +253,23 @@ class GradScaler:
 
 
 from . import debugging  # noqa: F401,E402  (full module: paddle.amp.debugging)
+
+
+def _device_platform(device=None):
+    import jax
+    if device is None:
+        return jax.devices()[0].platform.lower()
+    s = str(device).lower()
+    for p in ("tpu", "axon", "gpu", "cuda", "cpu"):
+        if p in s:
+            return {"cuda": "gpu"}.get(p, p)
+    return s
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the MXU-native dtype on TPU and runs everywhere XLA does."""
+    return _device_platform(device) in ("tpu", "axon", "gpu", "cpu")
+
+
+def is_float16_supported(device=None):
+    return _device_platform(device) in ("tpu", "axon", "gpu")

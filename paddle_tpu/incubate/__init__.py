@@ -10,6 +10,46 @@ from . import asp  # noqa: F401
 from ..distributed.fleet.utils import recompute as _recompute  # noqa: F401
 
 
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Legacy incubate name for ``paddle.geometric.send_u_recv``
+    (reference: ``incubate.graph_send_recv`` predates the geometric
+    namespace)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: ``incubate.softmax_mask_fuse`` (a fused CUDA kernel);
+    on TPU the add+softmax chain is XLA's fusion job — one traced op."""
+    import jax
+    import jax.numpy as jnp
+    from ..autograd.tape import apply
+
+    def fn(a, m):
+        return jax.nn.softmax((a + m).astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+
+    return apply(fn, x, mask, op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal-masked softmax (reference fused kernel): mask is the upper
+    triangle above the diagonal."""
+    import jax
+    import jax.numpy as jnp
+    from ..autograd.tape import apply
+
+    def fn(a):
+        s = a.shape[-1]
+        keep = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        z = jnp.where(keep, a.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(z, axis=-1).astype(a.dtype)
+
+    return apply(fn, x, op_name="softmax_mask_fuse_upper_triangle")
+
+
 def identity_loss(x, reduction="none"):
     from ..ops import math as pmath
     if reduction in ("mean",):

@@ -135,9 +135,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
             "save_inference_model needs fetch_vars to be (or contain) the "
             "Layer/callable that computes the fetches; a bare fetched "
             "Tensor has no captured graph in this build — pass the model")
-    specs = [v if isinstance(v, InputSpec) else InputSpec.from_tensor(v)
-             for v in (feed_vars if isinstance(feed_vars, (list, tuple))
-                       else [feed_vars])]
+    specs = _to_input_specs(feed_vars)
     pjit.save(target, path_prefix, input_spec=specs)
     return path_prefix
 
@@ -162,6 +160,150 @@ def load_inference_model(path_prefix, executor, **kwargs):
 
 def name_scope(prefix=None):
     return contextlib.nullcontext()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference: ``paddle.static.save(program, path)`` persists the
+    program's persistable variables. Program facades in this build hold
+    no parameters (SURVEY.md §7.0 — jit traces close over nn.Layer
+    state), so training state saves through ``paddle.save(
+    layer.state_dict(), path)`` and deployable graphs through
+    ``static.save_inference_model`` / ``paddle.jit.save``."""
+    raise NotImplementedError(save.__doc__)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference: ``paddle.static.load``; see :func:`save` — use
+    ``paddle.load`` + ``set_state_dict`` or ``load_inference_model``."""
+    raise NotImplementedError(load.__doc__)
+
+
+def cpu_places(device_count=None):
+    from ..framework.core import CPUPlace
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", "1"))
+    return [CPUPlace() for _ in range(n)]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference: pins ops to a device inside a program. Single-backend
+    build: a no-op context (XLA owns placement)."""
+    yield
+
+
+class Scope:
+    """Minimal variable scope (reference ``paddle.static.global_scope()``
+    — name → variable holder used by inference IO helpers)."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, _ScopeVar())
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+class _ScopeVar:
+    def __init__(self):
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value, place=None):
+        self._value = value
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+def _to_input_specs(feed_vars):
+    return [v if isinstance(v, InputSpec) else InputSpec.from_tensor(v)
+            for v in (feed_vars if isinstance(feed_vars, (list, tuple))
+                      else [feed_vars])]
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference: prunes/standardizes a Program for export. The facade
+    records feeds; pruning is the jit tracer's job — returns the program
+    with feed specs attached."""
+    program._inputs = _to_input_specs(feed_vars)
+    return program
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: ``paddle.static.py_func`` — run arbitrary Python inside
+    a program. TPU-native: ``jax.pure_callback`` hosts the Python call
+    inside the compiled graph; ``out`` supplies the result
+    shape/dtype template (InputSpec or Tensor)."""
+    import jax
+    import numpy as np
+    from ..framework.core import Tensor
+    from ..autograd.tape import apply
+
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    import jax.numpy as jnp
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape),
+                                   jnp.dtype(o.dtype)) for o in outs]
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def _host(py_fn, out_shapes):
+        def host(*np_arrs):
+            res = py_fn(*[Tensor(np.asarray(a)) for a in np_arrs])
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r.numpy() if isinstance(r, Tensor)
+                                    else r) for r in res)
+        return host
+
+    def fn(*arrs):
+        if backward_func is None:
+            # gradient-opaque host call: stop_gradient-ing the callback
+            # inputs keeps jax.vjp from needing a (nonexistent) JVP rule
+            # for pure_callback; grads through it are zero, matching
+            # "no backward_func provided"
+            arrs = tuple(jax.lax.stop_gradient(a) for a in arrs)
+            res = jax.pure_callback(_host(func, shapes), tuple(shapes),
+                                    *arrs)
+            return res if len(res) > 1 else res[0]
+
+        @jax.custom_vjp
+        def call(*a):
+            res = jax.pure_callback(_host(func, shapes), tuple(shapes), *a)
+            return res if len(res) > 1 else res[0]
+
+        def fwd(*a):
+            return call(*a), a
+
+        def bwd(resids, g):
+            gs = tuple(g) if isinstance(g, tuple) else (g,)
+            in_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                              for a in resids)
+            res = jax.pure_callback(_host(backward_func, in_shapes),
+                                    in_shapes, *resids, *gs)
+            return tuple(res)
+
+        call.defvjp(fwd, bwd)
+        return call(*arrs)
+
+    return apply(fn, *xs, op_name="py_func")
 
 
 from . import nn  # noqa: E402,F401  (control flow: cond/while_loop/switch_case)

@@ -146,3 +146,30 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     raise NotImplementedError(
         "static.nn.fc: build models with paddle.nn.Linear; static-graph "
         "parameter creation is out of the TPU build's scope (SURVEY.md §7.0)")
+
+
+def conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "static.nn.conv2d: build models with paddle.nn.Conv2D; "
+        "static-graph parameter creation is out of the TPU build's scope "
+        "(SURVEY.md §7.0)")
+
+
+def batch_norm(*args, **kwargs):
+    raise NotImplementedError(
+        "static.nn.batch_norm: build models with paddle.nn.BatchNorm2D; "
+        "static-graph parameter creation is out of the TPU build's scope "
+        "(SURVEY.md §7.0)")
+
+
+def embedding(*args, **kwargs):
+    raise NotImplementedError(
+        "static.nn.embedding: build models with paddle.nn.Embedding; "
+        "static-graph parameter creation is out of the TPU build's scope "
+        "(SURVEY.md §7.0)")
+
+
+def sequence_expand(*args, **kwargs):
+    raise NotImplementedError(
+        "static.nn.sequence_expand: LoD sequence ops are legacy-fluid; "
+        "use dense padded batches + masks in this build (SURVEY.md §7.4)")

@@ -267,3 +267,73 @@ def test_dropout_variants_train_eval():
         out_train = np.asarray(fn(arg, p=0.5, training=True).numpy())
         assert out_train.shape == np.asarray(arg.numpy()).shape
         assert not np.allclose(out_train, np.asarray(arg.numpy()))
+
+
+def test_round4_static_and_incubate_api():
+    """static scope/py_func/places + incubate graph_send_recv /
+    softmax_mask_fuse round-4 parity additions."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    import paddle_tpu.incubate as incubate
+
+    # scope + guard
+    s = static.Scope()
+    with static.scope_guard(s):
+        assert static.global_scope() is s
+        s.var("w").set(np.ones(3))
+        assert (s.find_var("w").get_tensor() == 1).all()
+    assert static.global_scope() is not s
+    assert len(static.cpu_places(2)) == 2
+
+    # py_func through jit (pure_callback keeps it compiled)
+    x = paddle.to_tensor(np.arange(4, dtype="float32"))
+    out_t = static.InputSpec([4], "float32")
+
+    def host_fn(t):
+        return paddle.to_tensor(t.numpy() * 3.0)
+
+    y = static.py_func(host_fn, x, out_t)
+    np.testing.assert_allclose(y.numpy(), np.arange(4) * 3.0)
+
+    @paddle.jit.to_static
+    def traced(a):
+        return static.py_func(host_fn, a, out_t) + 1.0
+
+    np.testing.assert_allclose(traced(x).numpy(), np.arange(4) * 3 + 1)
+
+    # incubate shims
+    xg = paddle.to_tensor(np.eye(3, dtype="float32"))
+    src = paddle.to_tensor(np.array([0, 1, 2], "int32"))
+    dst = paddle.to_tensor(np.array([1, 1, 0], "int32"))
+    agg = incubate.graph_send_recv(xg, src, dst, pool_type="sum")
+    np.testing.assert_allclose(agg.numpy()[1], [1.0, 1.0, 0.0])
+
+    logits = paddle.to_tensor(np.zeros((1, 1, 2, 2), "float32"))
+    m = paddle.to_tensor(np.array([[[[0.0, -1e30], [0.0, 0.0]]]], "float32"))
+    sm = incubate.softmax_mask_fuse(logits, m)
+    np.testing.assert_allclose(sm.numpy()[0, 0, 0], [1.0, 0.0], atol=1e-6)
+    tri = incubate.softmax_mask_fuse_upper_triangle(logits)
+    np.testing.assert_allclose(tri.numpy()[0, 0, 0], [1.0, 0.0], atol=1e-6)
+    import paddle_tpu.amp as amp
+    assert amp.is_bfloat16_supported() is True
+    assert amp.is_float16_supported("cpu") is False
+    assert amp.is_float16_supported("gpu:0") is True
+
+    # py_func with grad-enabled inputs: opaque (zero grad) without a
+    # backward_func, custom host backward with one
+    xa = paddle.to_tensor(np.arange(4, dtype="float32"))
+    xa.stop_gradient = False
+    y0 = static.py_func(host_fn, xa, out_t)
+    y0.sum().backward()   # must not raise; grads are zero
+    np.testing.assert_allclose(xa.grad.numpy(), np.zeros(4))
+
+    def host_bwd(inp, g):
+        return paddle.to_tensor(g.numpy() * 3.0)
+
+    xb = paddle.to_tensor(np.arange(4, dtype="float32"))
+    xb.stop_gradient = False
+    y1 = static.py_func(host_fn, xb, out_t, backward_func=host_bwd)
+    y1.sum().backward()
+    np.testing.assert_allclose(xb.grad.numpy(), np.full(4, 3.0))
