@@ -15,7 +15,7 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from .framework import (  # noqa: F401
-    Tensor, Parameter, to_tensor, CPUPlace, TPUPlace, CUDAPlace,
+    Tensor, Parameter, to_tensor, CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
     set_device, get_device, device_count,
     is_compiled_with_cuda, is_compiled_with_xpu,
     bfloat16, float16, float32, float64, int8, int16, int32, int64, uint8,

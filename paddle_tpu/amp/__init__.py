@@ -260,9 +260,10 @@ def _device_platform(device=None):
     if device is None:
         return jax.devices()[0].platform.lower()
     s = str(device).lower()
-    for p in ("tpu", "axon", "gpu", "cuda", "cpu"):
+    for p in ("tpu", "axon", "xpu", "gpu", "cuda", "cpu"):
         if p in s:
-            return {"cuda": "gpu"}.get(p, p)
+            # this build aliases every accelerator place to the TPU
+            return {"cuda": "gpu", "xpu": "tpu"}.get(p, p)
     return s
 
 

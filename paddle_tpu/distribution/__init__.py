@@ -14,7 +14,8 @@ from __future__ import annotations
 
 from .distribution import Distribution, ExponentialFamily, Independent
 from .families import (
-    Bernoulli, Beta, Binomial, Categorical, Cauchy, Dirichlet, Exponential,
+    Bernoulli, Beta, Binomial, Categorical, Cauchy, ContinuousBernoulli,
+    Dirichlet, Exponential,
     Gamma, Geometric, Gumbel, Laplace, LogNormal, Multinomial,
     MultivariateNormal, Normal, Poisson, StudentT, Uniform,
 )
@@ -28,7 +29,8 @@ from .kl import kl_divergence, register_kl
 
 __all__ = [
     "Distribution", "ExponentialFamily", "Independent",
-    "Bernoulli", "Beta", "Binomial", "Categorical", "Cauchy", "Dirichlet",
+    "Bernoulli", "Beta", "Binomial", "Categorical", "Cauchy",
+    "ContinuousBernoulli", "Dirichlet",
     "Exponential", "Gamma", "Geometric", "Gumbel", "Laplace", "LogNormal",
     "Multinomial", "MultivariateNormal", "Normal", "Poisson", "StudentT",
     "Uniform",

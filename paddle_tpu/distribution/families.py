@@ -837,3 +837,89 @@ class StudentT(Distribution):
                     - jax.lax.lgamma((df + 1) / 2)
                     + jnp.log(s))
         return apply(fn, self.df, self.scale, op_name="studentt_entropy")
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """reference ``python/paddle/distribution/continuous_bernoulli.py`` —
+    CB(λ) on [0,1]: p(x|λ) = C(λ)·λ^x·(1-λ)^(1-x), with normalizer
+    C(λ) = 2·artanh(1-2λ)/(1-2λ) (→ 2 as λ→1/2). Sampling is exact via
+    the closed-form inverse CDF."""
+
+    _EPS = 1e-6
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_param = _param(probs)
+        self._lims = lims
+        super().__init__(_bshape(self.probs_param))
+
+    def _safe(self, p):
+        # pull λ out of the unstable neighborhood of 1/2 for the
+        # closed-form branches; the jnp.where selects the Taylor value
+        # there instead
+        lo, hi = self._lims
+        mid = (p >= lo) & (p <= hi)
+        return mid, jnp.where(mid, 0.25, jnp.clip(p, self._EPS,
+                                                  1 - self._EPS))
+
+    def _log_norm(self, p):
+        mid, ps = self._safe(p)
+        c = jnp.log(2 * jnp.arctanh(1 - 2 * ps) / (1 - 2 * ps))
+        # Taylor at 1/2: log C ≈ log 2 + 4(λ-1/2)²/3
+        return jnp.where(mid, jnp.log(2.0) + 4 * (p - 0.5) ** 2 / 3, c)
+
+    def _mean_expr(self, p):
+        mid, ps = self._safe(p)
+        m = ps / (2 * ps - 1) + 1 / (2 * jnp.arctanh(1 - 2 * ps))
+        return jnp.where(mid, 0.5 + (p - 0.5) / 3, m)
+
+    @property
+    def mean(self):
+        return apply(self._mean_expr, self.probs_param, op_name="cb_mean")
+
+    @property
+    def variance(self):
+        def fn(p):
+            mid, ps = self._safe(p)
+            v = ps * (ps - 1) / (1 - 2 * ps) ** 2 \
+                + 1 / (2 * jnp.arctanh(1 - 2 * ps)) ** 2
+            return jnp.where(mid, 1 / 12 - (p - 0.5) ** 2 / 15, v)
+        return apply(fn, self.probs_param, op_name="cb_var")
+
+    def log_prob(self, value):
+        def fn(p, v):
+            pc = jnp.clip(p, self._EPS, 1 - self._EPS)
+            return (v * jnp.log(pc) + (1 - v) * jnp.log1p(-pc)
+                    + self._log_norm(p))
+        return apply(fn, self.probs_param, _param(value),
+                     op_name="cb_log_prob")
+
+    def icdf(self, value):
+        def fn(p, u):
+            mid, ps = self._safe(p)
+            x = jnp.log1p(u * (2 * ps - 1) / (1 - ps)) \
+                / jnp.log(ps / (1 - ps))
+            return jnp.clip(jnp.where(mid, u, x), 0.0, 1.0)
+        return apply(fn, self.probs_param, _param(value), op_name="cb_icdf")
+
+    def cdf(self, value):
+        def fn(p, x):
+            mid, ps = self._safe(p)
+            c = (ps ** x * (1 - ps) ** (1 - x) + ps - 1) / (2 * ps - 1)
+            return jnp.clip(jnp.where(mid, x, c), 0.0, 1.0)
+        return apply(fn, self.probs_param, _param(value), op_name="cb_cdf")
+
+    def sample(self, shape=()):
+        full = self._extend_shape(shape)
+        u = jax.random.uniform(self._key(), full)
+        return self.icdf(Tensor(u))
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def entropy(self):
+        def fn(p):
+            pc = jnp.clip(p, self._EPS, 1 - self._EPS)
+            mean = self._mean_expr(p)
+            return -(mean * jnp.log(pc) + (1 - mean) * jnp.log1p(-pc)
+                     + self._log_norm(p))
+        return apply(fn, self.probs_param, op_name="cb_entropy")

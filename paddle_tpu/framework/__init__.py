@@ -1,6 +1,6 @@
 from .core import (  # noqa: F401
     Tensor, Parameter, EagerParamBase, to_tensor, Place, CPUPlace, TPUPlace,
-    CUDAPlace, set_device, get_device, current_place, device_count,
+    CUDAPlace, XPUPlace, set_device, get_device, current_place, device_count,
     is_compiled_with_cuda, is_compiled_with_xpu,
 )
 from .dtype import (  # noqa: F401

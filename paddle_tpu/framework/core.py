@@ -54,6 +54,7 @@ class TPUPlace(Place):
 
 
 CUDAPlace = TPUPlace  # API-compat alias: 'gpu' means 'the accelerator' here.
+XPUPlace = TPUPlace   # same alias: any accelerator place maps to the TPU.
 
 _current_place: Place | None = None
 

@@ -265,7 +265,7 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
                                    jnp.dtype(o.dtype)) for o in outs]
     xs = x if isinstance(x, (list, tuple)) else [x]
 
-    def _host(py_fn, out_shapes):
+    def _host(py_fn):
         def host(*np_arrs):
             res = py_fn(*[Tensor(np.asarray(a)) for a in np_arrs])
             res = res if isinstance(res, (list, tuple)) else [res]
@@ -280,13 +280,12 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
             # for pure_callback; grads through it are zero, matching
             # "no backward_func provided"
             arrs = tuple(jax.lax.stop_gradient(a) for a in arrs)
-            res = jax.pure_callback(_host(func, shapes), tuple(shapes),
-                                    *arrs)
+            res = jax.pure_callback(_host(func), tuple(shapes), *arrs)
             return res if len(res) > 1 else res[0]
 
         @jax.custom_vjp
         def call(*a):
-            res = jax.pure_callback(_host(func, shapes), tuple(shapes), *a)
+            res = jax.pure_callback(_host(func), tuple(shapes), *a)
             return res if len(res) > 1 else res[0]
 
         def fwd(*a):
@@ -296,8 +295,8 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
             gs = tuple(g) if isinstance(g, tuple) else (g,)
             in_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                               for a in resids)
-            res = jax.pure_callback(_host(backward_func, in_shapes),
-                                    in_shapes, *resids, *gs)
+            res = jax.pure_callback(_host(backward_func), in_shapes,
+                                    *resids, *gs)
             return tuple(res)
 
         call.defvjp(fwd, bwd)

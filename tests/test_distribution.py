@@ -331,3 +331,38 @@ def test_binomial_multinomial_entropy():
     m = Multinomial(8, t([0.2, 0.3, 0.5]))
     ent = float(m.entropy().numpy())
     assert abs(ent - st.multinomial(8, [0.2, 0.3, 0.5]).entropy()) < 0.2
+
+
+class TestContinuousBernoulli:
+    def test_log_prob_normalizes(self):
+        """∫p(x)dx == 1 (trapezoid over [0,1]) away from and at λ=1/2."""
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import ContinuousBernoulli
+        for lam in (0.2, 0.5, 0.9):
+            d = ContinuousBernoulli(paddle.to_tensor(float(lam)))
+            xs = np.linspace(0, 1, 2001, dtype="float32")
+            pdf = np.exp(d.log_prob(paddle.to_tensor(xs)).numpy())
+            trapz = getattr(np, "trapezoid", np.trapz)
+            assert abs(trapz(pdf, xs) - 1.0) < 1e-3, lam
+
+    def test_moments_match_samples(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import ContinuousBernoulli
+        paddle.seed(11)
+        for lam in (0.15, 0.5, 0.8):
+            d = ContinuousBernoulli(paddle.to_tensor(float(lam)))
+            s = d.sample([20000]).numpy()
+            assert abs(s.mean() - float(d.mean.numpy())) < 5e-3, lam
+            assert abs(s.var() - float(d.variance.numpy())) < 5e-3, lam
+            assert (s >= 0).all() and (s <= 1).all()
+
+    def test_cdf_icdf_roundtrip(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.distribution import ContinuousBernoulli
+        d = ContinuousBernoulli(paddle.to_tensor(0.3))
+        u = paddle.to_tensor(np.linspace(0.05, 0.95, 7, dtype="float32"))
+        x = d.icdf(u)
+        np.testing.assert_allclose(d.cdf(x).numpy(), u.numpy(), atol=1e-5)

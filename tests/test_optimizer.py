@@ -221,3 +221,15 @@ def test_lbfgs_partial_params_and_wd():
     from paddle_tpu.nn import ClipGradByGlobalNorm
     with _pytest.raises(ValueError):
         opt.LBFGS(parameters=[w1], grad_clip=ClipGradByGlobalNorm(1.0))
+
+
+def test_linear_lr():
+    import paddle_tpu as paddle
+    sched = paddle.optimizer.lr.LinearLR(0.1, total_steps=4,
+                                         start_factor=0.5, end_factor=1.0)
+    vals = []
+    for _ in range(6):
+        vals.append(round(sched(), 6))
+        sched.step()
+    assert vals[0] == 0.05 and vals[4] == 0.1 and vals[5] == 0.1
+    assert vals[1] == 0.0625 and vals[2] == 0.075
