@@ -308,6 +308,61 @@ class Tensor:
         return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
                       stop_gradient=self.stop_gradient)
 
+    def cuda(self, device_id=None, blocking=True):
+        """API-compat: 'cuda' means 'the accelerator' in this build."""
+        devs = jax.devices()
+        return Tensor(jax.device_put(self._data,
+                                     devs[(device_id or 0) % len(devs)]),
+                      stop_gradient=self.stop_gradient)
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
+    @property
+    def nbytes(self):
+        return self._data.dtype.itemsize * int(self.size)
+
+    def data_ptr(self):
+        """Opaque buffer identity (reference returns the device pointer;
+        jax.Array exposes no stable address — id() serves the common
+        'same storage?' comparisons)."""
+        return id(self._data)
+
+    def is_sparse(self):
+        return False
+
+    def coalesce(self):
+        """Dense tensors are their own coalesced form; sparse COO
+        tensors override this in paddle_tpu.sparse."""
+        return self
+
+    def apply_(self, func):
+        """In-place elementwise python function (reference
+        ``Tensor.apply_`` — host-side, eager only)."""
+        import numpy as np
+        arr = np.vectorize(func)(self.numpy()).astype(
+            np.asarray(self.numpy()).dtype)
+        self._replace_(jnp.asarray(arr))
+        return self
+
+    def apply(self, func):
+        return Tensor(jnp.asarray(self.clone().apply_(func)._data),
+                      stop_gradient=self.stop_gradient)
+
+    def exponential_(self, lam=1.0):
+        """In-place exponential sampling (reference
+        ``Tensor.exponential_``)."""
+        from . import random as prandom
+        u = jax.random.uniform(prandom.next_key(), self._data.shape,
+                               minval=1e-7, maxval=1.0)
+        self._replace_((-jnp.log(u) / lam).astype(self._data.dtype))
+        return self
+
+    def floor_divide_(self, y):
+        y = y._data if isinstance(y, Tensor) else y
+        self._replace_(jnp.floor_divide(self._data, y))
+        return self
+
     def to(self, *args, **kwargs):
         t = self
         for a in list(args) + list(kwargs.values()):

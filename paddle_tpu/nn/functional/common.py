@@ -701,3 +701,111 @@ def bilinear(x1, x2, weight, bias=None, name=None):
 
     args = (x1, x2, weight) + ((bias,) if bias is not None else ())
     return apply(fn, *args, op_name="bilinear")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """reference: ``paddle.nn.functional.zeropad2d`` — [left, right,
+    top, bottom] zero padding."""
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout that drops whole channels (reference:
+    ``paddle.nn.functional.feature_alpha_dropout``)."""
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = prandom.next_key()
+
+    def fn(a):
+        mshape = a.shape[:2] + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, mshape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return apply(fn, x, op_name="feature_alpha_dropout")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """reference: ``paddle.nn.functional.class_center_sample`` (PLSC) —
+    sample the positive class centers plus random negatives; returns
+    (remapped_label, sampled_class_indices). Host-side sampling (eager
+    data-prep op in the reference too)."""
+    import numpy as np_
+    yv = np_.asarray(label.numpy() if hasattr(label, "numpy")
+                     else label).reshape(-1)
+    pos = np_.unique(yv)
+    n_extra = max(int(num_samples) - pos.size, 0)
+    rest = np_.setdiff1d(np_.arange(num_classes), pos, assume_unique=False)
+    if n_extra > 0 and rest.size:
+        # negatives drawn through the framework's seeded key tree, not
+        # numpy's global RNG — deterministic under paddle.seed() and
+        # identical across same-seed data-parallel workers
+        seed = int(jax.random.randint(prandom.next_key(), (), 0, 2 ** 31 - 1))
+        extra = np_.random.default_rng(seed).permutation(rest)[:n_extra]
+        sampled = np_.concatenate([pos, np_.sort(extra)])
+    else:
+        sampled = pos
+    remap = np_.full(num_classes, -1, np_.int64)
+    remap[sampled] = np_.arange(sampled.size)
+    from ...framework.core import Tensor as _T
+    return (_T(jnp.asarray(remap[yv], jnp.int32)),
+            _T(jnp.asarray(sampled, jnp.int32)))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset=None,
+                     sparse_csr_columns=None, sparse_mask=None,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """reference: ``paddle.nn.functional.sparse_attention`` — attention
+    restricted to a per-(batch, head) CSR pattern (offset [B,H,S+1],
+    columns [B,H,nnz]), with optional ``key_padding_mask`` [B,S] and
+    additive ``attn_mask`` [S,S]. The MXU has no sparse systolic path
+    (same rationale as paddle_tpu.sparse's attention tier), so the
+    pattern becomes an additive dense mask over one fused
+    einsum+softmax chain."""
+    def _np(t):
+        return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+    b, h, s, _ = query.shape
+    if sparse_mask is not None:
+        from ...sparse import is_sparse as _is_sp
+        dense = _np(sparse_mask.to_dense() if _is_sp(sparse_mask)
+                    else sparse_mask).reshape(b, h, s, s)
+        allowed = dense != 0
+    elif sparse_csr_offset is not None and sparse_csr_columns is not None:
+        offs = _np(sparse_csr_offset).reshape(b, h, s + 1).astype(np.int64)
+        cols = _np(sparse_csr_columns).reshape(b, h, -1).astype(np.int64)
+        allowed = np.zeros((b, h, s, s), bool)
+        for bi in range(b):
+            for hi in range(h):
+                for row in range(s):
+                    lo, hi_ = offs[bi, hi, row], offs[bi, hi, row + 1]
+                    allowed[bi, hi, row, cols[bi, hi, lo:hi_]] = True
+    else:
+        raise ValueError("sparse_attention needs sparse_mask or CSR "
+                         "offset+columns")
+    if key_padding_mask is not None:
+        # [B, S]: zero/False marks padded keys — disallowed for every query
+        keep = _np(key_padding_mask).astype(bool)
+        allowed = allowed & keep[:, None, None, :]
+    add = None
+    if attn_mask is not None:
+        add = jnp.asarray(_np(attn_mask), jnp.float32)
+    allowed_j = jnp.asarray(allowed)
+
+    def fn(q, k, v):
+        lg = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / (q.shape[-1] ** 0.5)
+        if add is not None:
+            lg = lg + add
+        lg = jnp.where(allowed_j, lg, -1e30)
+        w = jax.nn.softmax(lg, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", w,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    return apply(fn, query, key, value, op_name="sparse_attention")

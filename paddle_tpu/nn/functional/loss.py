@@ -323,3 +323,58 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply(fn, log_probs, labels, input_lengths, label_lengths,
                  op_name="ctc_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference: ``paddle.nn.functional.npair_loss`` — N-pair metric
+    loss: cross entropy over anchor·positiveᵀ similarities + L2 on the
+    embeddings."""
+    def fn(a, p, y):
+        # reference: l2loss * 0.25 * l2_reg (Beta=0.25 in the paper)
+        l2 = 0.25 * l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / a.shape[0]
+        sim = a @ p.T
+        yv = y.reshape(-1)
+        same = (yv[:, None] == yv[None, :]).astype(jnp.float32)
+        tgt = same / jnp.maximum(same.sum(-1, keepdims=True), 1)
+        logp = jax.nn.log_softmax(sim.astype(jnp.float32), axis=-1)
+        ce = -(tgt * logp).sum(-1).mean()
+        return ce + l2
+    return apply(fn, anchor, positive, labels, op_name="npair_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """reference: ``paddle.nn.functional.dice_loss`` — 1 - Dice
+    coefficient; ``input`` is per-class probabilities, ``label`` int
+    class ids with trailing dim 1."""
+    def fn(p, y):
+        nclass = p.shape[-1]
+        yv = jax.nn.one_hot(y.reshape(*p.shape[:-1]), nclass, dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = (p * yv).sum(red)
+        union = p.sum(red) + yv.sum(red)
+        return (1 - (2 * inter + epsilon) / (union + epsilon)).mean()
+    return apply(fn, input, label, op_name="dice_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """reference: ``paddle.nn.functional.margin_cross_entropy`` — the
+    ArcFace/CosFace-family margin softmax
+    (cos(m1·θ + m2) − m3 on the target class, scaled). The reference's
+    model-parallel path shards classes over ``group``; here GSPMD owns
+    sharding, so ``group`` only needs to be None/world."""
+    def fn(lg, y):
+        cos = jnp.clip(lg.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(cos)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        yh = jax.nn.one_hot(y.reshape(-1), lg.shape[-1], dtype=cos.dtype)
+        adj = scale * jnp.where(yh > 0, target, cos)
+        logp = jax.nn.log_softmax(adj, axis=-1)
+        ce = -(yh * logp).sum(-1)
+        sm = jax.nn.softmax(adj, axis=-1)
+        ce = {"mean": ce.mean(), "sum": ce.sum(), "none": ce}[reduction]
+        return (ce, sm)
+
+    loss, sm = apply(fn, logits, label, op_name="margin_cross_entropy")
+    return (loss, sm) if return_softmax else loss

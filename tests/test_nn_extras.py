@@ -339,3 +339,113 @@ def test_channel_shuffle_huber_gaussian_nll():
     loss = nn.HuberLoss()(a, b)
     loss.backward()
     assert a.grad is not None
+
+
+def test_round4_functional_additions():
+    """npair/dice/margin-CE losses, zeropad2d, feature_alpha_dropout,
+    class_center_sample, sparse_attention F-alias + new Tensor methods."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(5)
+    # margin_cross_entropy degenerates to scaled CE at zero margins
+    cos = paddle.to_tensor((np.random.rand(4, 10) * 2 - 1).astype("float32"))
+    lb = paddle.to_tensor(np.array([1, 2, 3, 4]))
+    l0 = F.margin_cross_entropy(cos, lb, margin1=1.0, margin2=0.0,
+                                margin3=0.0, scale=1.0)
+    ref = F.cross_entropy(cos, lb)
+    np.testing.assert_allclose(l0.numpy(), ref.numpy(), rtol=1e-5)
+    # margins make the target harder -> loss goes up
+    l1 = F.margin_cross_entropy(cos, lb, margin2=0.5, scale=1.0)
+    assert float(l1.numpy()) > float(l0.numpy())
+
+    probs = paddle.to_tensor(np.eye(4, 3, dtype="float32")[None])
+    lab = paddle.to_tensor(np.array([[0, 1, 2, 0]])[..., None])
+    d = F.dice_loss(probs, lab, epsilon=0.0)
+    assert 0.0 < float(d.numpy()) < 1.0
+
+    a = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    a.stop_gradient = False
+    p = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(np.array([0, 1, 0, 2]))
+    loss = F.npair_loss(a, p, y)
+    loss.backward()
+    assert a.grad is not None
+
+    x = paddle.to_tensor(np.ones((1, 2, 3, 3), "float32"))
+    assert F.zeropad2d(x, [1, 2, 3, 4]).shape == [1, 2, 10, 6]
+
+    rl, sc = F.class_center_sample(y, num_classes=10, num_samples=6)
+    assert sc.shape[0] == 6
+    assert sorted(set(rl.numpy().tolist())) == [0, 1, 2]
+
+    # sparse_attention == dense softmax attention under an all-ones mask
+    import paddle_tpu.sparse as sparse
+    q = paddle.to_tensor(np.random.randn(1, 1, 4, 8).astype("float32"))
+    mask = sparse.sparse_coo_tensor(
+        np.array([[i for i in range(4) for _ in range(4)],
+                  [j for _ in range(4) for j in range(4)]]),
+        np.ones(16, "float32"), shape=[4, 4])
+    out = F.sparse_attention(q, q, q, sparse_mask=mask)
+    ref = F.scaled_dot_product_attention(
+        paddle.to_tensor(np.swapaxes(q.numpy(), 1, 2)),
+        paddle.to_tensor(np.swapaxes(q.numpy(), 1, 2)),
+        paddle.to_tensor(np.swapaxes(q.numpy(), 1, 2)), is_causal=False)
+    np.testing.assert_allclose(out.numpy(),
+                               np.swapaxes(ref.numpy(), 1, 2), atol=2e-5)
+
+    # multi-head CSR pattern (b=1, h=2): head 0 causal, head 1 full —
+    # causal head must equal causal SDPA, full head the full SDPA
+    qm = paddle.to_tensor(np.random.randn(1, 2, 4, 8).astype("float32"))
+    offs = np.zeros((1, 2, 5), "int32")
+    cols_list = [[], []]
+    for row in range(4):
+        causal_cols = list(range(row + 1))
+        offs[0, 0, row + 1] = offs[0, 0, row] + len(causal_cols)
+        cols_list[0] += causal_cols
+        offs[0, 1, row + 1] = offs[0, 1, row] + 4
+        cols_list[1] += list(range(4))
+    pad = max(len(c) for c in cols_list)
+    cols = np.zeros((1, 2, pad), "int32")
+    for h_, c in enumerate(cols_list):
+        cols[0, h_, :len(c)] = c
+    outm = F.sparse_attention(qm, qm, qm,
+                              sparse_csr_offset=paddle.to_tensor(offs),
+                              sparse_csr_columns=paddle.to_tensor(cols))
+    qs = paddle.to_tensor(np.swapaxes(qm.numpy(), 1, 2))
+    ref_c = np.swapaxes(F.scaled_dot_product_attention(
+        qs, qs, qs, is_causal=True).numpy(), 1, 2)
+    ref_f = np.swapaxes(F.scaled_dot_product_attention(
+        qs, qs, qs, is_causal=False).numpy(), 1, 2)
+    np.testing.assert_allclose(outm.numpy()[:, 0], ref_c[:, 0], atol=2e-5)
+    np.testing.assert_allclose(outm.numpy()[:, 1], ref_f[:, 1], atol=2e-5)
+
+    # key_padding_mask: disallowing the last key == attending over :3
+    kp = np.array([[1, 1, 1, 0]], "float32")
+    outp = F.sparse_attention(qm, qm, qm,
+                              sparse_csr_offset=paddle.to_tensor(offs),
+                              sparse_csr_columns=paddle.to_tensor(cols),
+                              key_padding_mask=paddle.to_tensor(kp))
+    q3 = paddle.to_tensor(np.swapaxes(qm.numpy()[:, :, :3], 1, 2))
+    ref3 = np.swapaxes(F.scaled_dot_product_attention(
+        paddle.to_tensor(np.swapaxes(qm.numpy(), 1, 2)), q3, q3,
+        is_causal=False).numpy(), 1, 2)
+    np.testing.assert_allclose(outp.numpy()[:, 1], ref3[:, 1], atol=2e-5)
+
+    # Tensor methods
+    t = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    assert t.element_size() == 4 and t.nbytes == 24
+    assert t.is_sparse() is False and t.coalesce() is t
+    assert isinstance(t.data_ptr(), int)
+    t2 = t.clone().apply_(lambda v: v * 2)
+    np.testing.assert_allclose(t2.numpy(), t.numpy() * 2)
+    t3 = t.apply(lambda v: v + 1)
+    np.testing.assert_allclose(t3.numpy(), t.numpy() + 1)
+    np.testing.assert_allclose(t.numpy(),
+                               np.arange(6, dtype="float32").reshape(2, 3))
+    e = paddle.to_tensor(np.zeros(2000, "float32")).exponential_(lam=2.0)
+    assert abs(float(e.numpy().mean()) - 0.5) < 0.1
+    f = paddle.to_tensor(np.array([7.0, 9.0])).floor_divide_(2.0)
+    np.testing.assert_allclose(f.numpy(), [3.0, 4.0])
+    assert paddle.to_tensor(np.ones(2, "float32")).cuda().shape == [2]
