@@ -28,6 +28,7 @@ from .layers.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, NLLLoss, BCELoss,
     BCEWithLogitsLoss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
     TripletMarginLoss, HingeEmbeddingLoss, HuberLoss, GaussianNLLLoss,
+    AdaptiveLogSoftmaxWithLoss,
 )
 from .layers.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,

@@ -378,3 +378,100 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
 
     loss, sm = apply(fn, logits, label, op_name="margin_cross_entropy")
     return (loss, sm) if return_softmax else loss
+
+
+def _adaptive_args(input_, head_weight, tail_weights, cutoffs, head_bias,
+                   label=None):
+    """Shared arg flattening + validation for the adaptive-softmax
+    functionals (ONE pack/unpack protocol — forward and log_prob must
+    agree on the parameter layout)."""
+    if len(tail_weights) != len(cutoffs) - 1:
+        raise ValueError(
+            f"adaptive softmax: {len(tail_weights)} tail cluster(s) for "
+            f"cutoffs {cutoffs} — expected len(cutoffs)-1")
+    args = [input_] + ([label] if label is not None else []) + [head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    for pair in tail_weights:
+        args.extend(pair)
+    return args
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """reference: ``paddle.nn.functional.adaptive_log_softmax_with_loss``
+    — hierarchical (adaptive) softmax. ``head_weight`` [in, c0+K] scores
+    the first ``cutoffs[0]`` frequent classes plus K cluster tokens;
+    ``tail_weights[k]`` is a low-rank pair [[in, h], [h, csz]] for
+    cluster k. Returns ``(output, loss)``: per-sample target
+    log-probability and its mean NLL. Vectorized with masks — no
+    data-dependent branching, so it stays one compiled program."""
+    cuts = [0] + list(cutoffs)
+    c0 = cuts[1]
+    n_classes = cuts[-1]
+    # eager bounds check (reference raises on out-of-range labels; a
+    # masked gather would silently train on garbage). Concrete labels
+    # only — traced label values can't be inspected.
+    lab_arr = getattr(label, "_data", label)
+    if not isinstance(lab_arr, jax.core.Tracer):
+        lv = np.asarray(lab_arr).reshape(-1)
+        if lv.size and (lv.min() < 0 or lv.max() >= n_classes):
+            raise ValueError(
+                f"adaptive_log_softmax_with_loss: label values must be in "
+                f"[0, {n_classes}); got [{lv.min()}, {lv.max()}]")
+
+    def fn(x, y, hw, *rest):
+        hb = rest[0] if head_bias is not None else None
+        toff = 1 if head_bias is not None else 0
+        tails = [(rest[j], rest[j + 1]) for j in range(toff, len(rest), 2)]
+        head = x @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head.astype(jnp.float32), axis=-1)
+        yv = y.reshape(-1).astype(jnp.int32)
+        # head part: classes < c0 read directly
+        safe_head = jnp.clip(yv, 0, c0 - 1)
+        out = jnp.take_along_axis(head_lp, safe_head[:, None], axis=1)[:, 0]
+        for k, (w1, w2) in enumerate(tails):
+            lo, hi = cuts[k + 1], cuts[k + 2]
+            csz = hi - lo
+            tail_lp = jax.nn.log_softmax(
+                ((x @ w1) @ w2).astype(jnp.float32), axis=-1)
+            in_k = (yv >= lo) & (yv < hi)
+            idx = jnp.clip(yv - lo, 0, csz - 1)
+            t = jnp.take_along_axis(tail_lp, idx[:, None], axis=1)[:, 0]
+            cluster_lp = head_lp[:, c0 + k]
+            out = jnp.where(in_k, cluster_lp + t, out)
+        loss = -out.mean()
+        return out, loss
+
+    args = _adaptive_args(input, head_weight, tail_weights, cutoffs,
+                          head_bias, label=label)
+    return apply(fn, *args, op_name="adaptive_log_softmax_with_loss")
+
+
+def adaptive_log_softmax_log_prob(input, head_weight, tail_weights, cutoffs,
+                                  head_bias=None, name=None):
+    """Full [N, n_classes] log-distribution of the adaptive softmax —
+    the ``AdaptiveLogSoftmaxWithLoss.log_prob`` computation."""
+    cuts = [0] + list(cutoffs)
+    c0 = cuts[1]
+
+    def fn(x, hw, *rest):
+        hb = rest[0] if head_bias is not None else None
+        toff = 1 if head_bias is not None else 0
+        tails = [(rest[j], rest[j + 1]) for j in range(toff, len(rest), 2)]
+        head = x @ hw
+        if hb is not None:
+            head = head + hb
+        head_lp = jax.nn.log_softmax(head.astype(jnp.float32), axis=-1)
+        parts = [head_lp[:, :c0]]
+        for k, (w1, w2) in enumerate(tails):
+            tail_lp = jax.nn.log_softmax(
+                ((x @ w1) @ w2).astype(jnp.float32), axis=-1)
+            parts.append(head_lp[:, c0 + k][:, None] + tail_lp)
+        return jnp.concatenate(parts, axis=-1)
+
+    args = _adaptive_args(input, head_weight, tail_weights, cutoffs,
+                          head_bias)
+    return apply(fn, *args, op_name="adaptive_log_softmax_log_prob")

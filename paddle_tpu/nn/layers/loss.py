@@ -156,3 +156,56 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: ``paddle.nn.AdaptiveLogSoftmaxWithLoss`` — hierarchical
+    softmax over frequency-sorted classes; forward returns
+    ``(output, loss)``."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = [int(c) for c in cutoffs]
+        if (not cutoffs or cutoffs != sorted(set(cutoffs))
+                or cutoffs[0] <= 0 or cutoffs[-1] > n_classes):
+            raise ValueError(
+                "cutoffs must be unique increasing ints in (0, n_classes]")
+        if cutoffs[-1] != n_classes:
+            cutoffs = cutoffs + [n_classes]
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs
+        self.div_value = div_value
+        n_clusters = len(cutoffs) - 1
+        # create_parameter: the repo-wide seeded init path (XavierUniform
+        # through the framework key tree; Constant(0) bias convention)
+        self.head_weight = self.create_parameter(
+            (in_features, cutoffs[0] + n_clusters))
+        self.head_bias = self.create_parameter(
+            (cutoffs[0] + n_clusters,), is_bias=True) if head_bias else None
+        self.tail_weights = []
+        for k in range(n_clusters):
+            hsz = max(1, int(in_features // (div_value ** (k + 1))))
+            csz = cutoffs[k + 1] - cutoffs[k]
+            pair = [self.create_parameter((in_features, hsz)),
+                    self.create_parameter((hsz, csz))]
+            self.tail_weights.append(pair)
+            self.add_parameter(f"tail_{k}_proj", pair[0])
+            self.add_parameter(f"tail_{k}_out", pair[1])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-distribution."""
+        return F.adaptive_log_softmax_log_prob(
+            input, self.head_weight, self.tail_weights, self.cutoffs,
+            head_bias=self.head_bias)
+
+    def predict(self, input):
+        lp = self.log_prob(input)
+        from ...ops.logic import argmax
+        return argmax(lp, axis=-1)

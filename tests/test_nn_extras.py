@@ -449,3 +449,65 @@ def test_round4_functional_additions():
     f = paddle.to_tensor(np.array([7.0, 9.0])).floor_divide_(2.0)
     np.testing.assert_allclose(f.numpy(), [3.0, 4.0])
     assert paddle.to_tensor(np.ones(2, "float32")).cuda().shape == [2]
+
+
+def test_adaptive_log_softmax_with_loss():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(7)
+    np.random.seed(7)
+    m = nn.AdaptiveLogSoftmaxWithLoss(in_features=16, n_classes=20,
+                                      cutoffs=[4, 10], div_value=2.0,
+                                      head_bias=True)
+    x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
+    y = paddle.to_tensor(np.array([0, 3, 4, 9, 10, 19, 2, 12]))
+    out, loss = m(x, y)
+    assert out.shape == [8]
+    np.testing.assert_allclose(float(loss.numpy()),
+                               -float(out.numpy().mean()), rtol=1e-6)
+
+    # the full log-distribution must normalize and agree with forward
+    lp = m.log_prob(x)
+    assert lp.shape == [8, 20]
+    np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1), np.ones(8),
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        out.numpy(), np.take_along_axis(lp.numpy(),
+                                        y.numpy()[:, None], 1)[:, 0],
+        atol=1e-5)
+    pred = m.predict(x)
+    np.testing.assert_array_equal(pred.numpy(), lp.numpy().argmax(-1))
+
+    # trains: grads reach head and tails
+    x.stop_gradient = False
+    _, loss2 = m(x, y)
+    loss2.backward()
+    assert m.head_weight.grad is not None
+    assert m.tail_weights[0][0].grad is not None
+
+
+def test_adaptive_log_softmax_validation_and_determinism():
+    import numpy as np
+    import pytest as pt
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    with pt.raises(ValueError, match="cutoffs"):
+        nn.AdaptiveLogSoftmaxWithLoss(8, 10, cutoffs=[0, 5])
+    with pt.raises(ValueError, match="cutoffs"):
+        nn.AdaptiveLogSoftmaxWithLoss(8, 10, cutoffs=[-2, 5])
+
+    # seeded init: same paddle.seed -> identical weights
+    paddle.seed(12)
+    m1 = nn.AdaptiveLogSoftmaxWithLoss(8, 10, cutoffs=[4])
+    paddle.seed(12)
+    m2 = nn.AdaptiveLogSoftmaxWithLoss(8, 10, cutoffs=[4])
+    np.testing.assert_array_equal(m1.head_weight.numpy(),
+                                  m2.head_weight.numpy())
+
+    # out-of-range labels raise eagerly
+    x = paddle.to_tensor(np.random.randn(2, 8).astype("float32"))
+    with pt.raises(ValueError, match="label values"):
+        m1(x, paddle.to_tensor(np.array([0, 10])))
