@@ -206,3 +206,45 @@ class ESC50(_CachedAudioDataset):
     """ESC-50 environmental sounds (reference paddle.audio.datasets.ESC50)."""
 
     _name = "esc50"
+
+
+# namespace packaging (reference: paddle.audio.{datasets,features,
+# functional,backends} submodules) — this build keeps one module; expose
+# the same access paths as lightweight namespace objects.
+import types as _types
+
+datasets = _types.SimpleNamespace(TESS=TESS, ESC50=ESC50)
+
+
+def _load_wav(path, sr=None, mono=True, dtype="float32"):
+    """Minimal WAV loader (reference backend ``soundfile.load``) — PCM
+    16/32-bit and float32, stdlib ``wave`` only (zero-egress image)."""
+    import wave as _wave
+    with _wave.open(str(path), "rb") as w:
+        nch, sw, rate, nframes = (w.getnchannels(), w.getsampwidth(),
+                                  w.getframerate(), w.getnframes())
+        raw = w.readframes(nframes)
+    if sr is not None and int(sr) != rate:
+        raise ValueError(
+            f"audio.load: file is {rate} Hz but sr={sr} was requested — "
+            "the wave backend does not resample; load at native rate and "
+            "resample explicitly")
+    if sw == 2:
+        arr = np.frombuffer(raw, np.int16).astype(np.float32) / 32768.0
+    elif sw == 4:
+        arr = np.frombuffer(raw, np.int32).astype(np.float32) / 2147483648.0
+    else:
+        raise ValueError(f"unsupported WAV sample width {sw}")
+    arr = arr.reshape(-1, nch).T
+    if mono and nch > 1:
+        arr = arr.mean(0, keepdims=True)
+    return Tensor(jnp.asarray(arr.astype(dtype))), rate
+
+
+backends = _types.SimpleNamespace(
+    list_available_backends=lambda: ["wave"],
+    get_current_backend=lambda: "wave",
+    set_backend=lambda name: None,
+    load=_load_wav,
+)
+load = _load_wav

@@ -13,3 +13,27 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
     run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """reference: ``paddle.autograd.jacobian`` (3.0 dygraph flavor takes
+    computed ys; common usage passes (func, xs) via incubate). This
+    facade accepts the (func, xs) form and delegates to the dense
+    incubate implementation."""
+    if callable(ys):
+        from ..incubate.autograd import Jacobian
+        return Jacobian(ys, xs if isinstance(xs, (list, tuple)) else [xs])
+    raise NotImplementedError(
+        "paddle.autograd.jacobian over already-computed outputs needs the "
+        "functional form: pass the function as the first argument "
+        "(jacobian(func, xs)), or use paddle.incubate.autograd.Jacobian")
+
+
+def hessian(ys, xs, batch_axis=None):
+    """See :func:`jacobian` — functional (func, xs) form."""
+    if callable(ys):
+        from ..incubate.autograd import Hessian
+        return Hessian(ys, xs if isinstance(xs, (list, tuple)) else [xs])
+    raise NotImplementedError(
+        "paddle.autograd.hessian needs the functional form "
+        "(hessian(func, xs)); see paddle.incubate.autograd.Hessian")

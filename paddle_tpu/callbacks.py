@@ -13,7 +13,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "LogWriterCallback"]
+           "LRScheduler", "LogWriterCallback" "ReduceLROnPlateau", "VisualDL"]
 
 
 class Callback:
@@ -205,3 +205,69 @@ class LogWriterCallback(Callback):
     def on_train_end(self, logs=None):
         if self._f is not None and not self._f.closed:
             self._f.close()
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce optimizer LR when a monitored metric plateaus (reference:
+    ``paddle.callbacks.ReduceLROnPlateau``)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = mode
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._best = None
+        self._wait = 0
+        self._cooldown_ctr = 0
+
+    def _better(self, cur, best):
+        if self.mode == "max" or (self.mode == "auto"
+                                  and "acc" in self.monitor):
+            return cur > best + self.min_delta
+        return cur < best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cooldown_ctr > 0:
+            # hold period after a reduction: track the best but never
+            # count toward patience
+            self._cooldown_ctr -= 1
+            self._wait = 0
+            if self._best is None or self._better(cur, self._best):
+                self._best = cur
+            return
+        if self._best is None or self._better(cur, self._best):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                lr = opt.get_lr() if hasattr(opt, "get_lr") else opt._learning_rate
+                new_lr = max(lr * self.factor, self.min_lr)
+                if hasattr(opt, "set_lr"):
+                    opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+            self._wait = 0
+            self._cooldown_ctr = self.cooldown
+
+
+class VisualDL(Callback):
+    """reference: ``paddle.callbacks.VisualDL`` — VisualDL is explicitly
+    not rebuilt (SURVEY.md §7.4); this stub raises with guidance."""
+
+    def __init__(self, log_dir="vdl_log"):
+        raise NotImplementedError(
+            "VisualDL is not in the TPU build (SURVEY.md §7.4); use the "
+            "profiler's chrome-trace export or metric callbacks instead")

@@ -245,3 +245,217 @@ class Imikolov(_CachedDataset):
                 for i in range(len(s) - n + 1):
                     out.append(tuple(s[i:i + n]))
             self.samples = out
+
+
+class Movielens(_CachedDataset):
+    """MovieLens-1M ratings (reference ``paddle.text.Movielens`` —
+    ``ml-1m.zip`` with ``ratings.dat``/``users.dat``/``movies.dat``,
+    ``::``-separated). Samples: (user_id, gender_id, age_id,
+    occupation_id, movie_id, category_ids, title_ids, rating)."""
+
+    _filename = "ml-1m.zip"
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def _load(self):
+        import zipfile
+        with zipfile.ZipFile(self.data_file) as z:
+            root = "ml-1m/"
+            names = z.namelist()
+            if root + "ratings.dat" not in names:
+                root = next((n[:-len("ratings.dat")] for n in names
+                             if n.endswith("ratings.dat")), "")
+
+            def lines(name):
+                return z.read(root + name).decode(
+                    "latin-1").strip().splitlines()
+
+            users = {}
+            for ln in lines("users.dat"):
+                uid, gender, age, occ, _zip = ln.split("::")
+                users[int(uid)] = (0 if gender == "M" else 1,
+                                   self.AGES.index(int(age)), int(occ))
+            cats, words = {}, {}
+            movies = {}
+            for ln in lines("movies.dat"):
+                mid, title, genres = ln.split("::")
+                cat_ids = [cats.setdefault(c, len(cats))
+                           for c in genres.split("|")]
+                tw = [words.setdefault(w, len(words))
+                      for w in title.lower().split()]
+                movies[int(mid)] = (cat_ids, tw)
+            n = 0
+            self.samples = []
+            for ln in lines("ratings.dat"):
+                uid, mid, rating, _ts = ln.split("::")
+                uid, mid = int(uid), int(mid)
+                if uid not in users or mid not in movies:
+                    continue
+                # reference split: 9:1 train/test round-robin
+                is_test = n % 10 == 9
+                n += 1
+                if (self.mode == "test") != is_test:
+                    continue
+                g, a, o = users[uid]
+                c, tw = movies[mid]
+                self.samples.append((uid, g, a, o, mid, c, tw,
+                                     float(rating)))
+        self.categories_dict = cats
+        self.movie_title_dict = words
+
+
+class _WMTBase(_CachedDataset):
+    """Shared WMT en↔de/fr pair loader: archives hold parallel line files;
+    samples are (src_ids, trg_ids_with_bos, trg_ids_with_eos) like the
+    reference's trainer feed. Vocab is frequency-sorted per language with
+    <s>, <e>, <unk> reserved."""
+
+    _src_suffix = None
+    _trg_suffix = None
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def _build_vocab(self, lines, size):
+        from collections import Counter
+        freq = Counter()
+        for ln in lines:
+            freq.update(ln.split())
+        keep = [w for w, _ in sorted(freq.items(),
+                                     key=lambda kv: (-kv[1], kv[0]))]
+        vocab = {"<s>": self.BOS, "<e>": self.EOS, "<unk>": self.UNK}
+        for w in keep[:max(size - 3, 0)]:
+            vocab[w] = len(vocab)
+        return vocab
+
+    def _pairs_from_tar(self):
+        import tarfile
+        src_lines, trg_lines = [], []
+        want = self.mode  # train/test/dev naming inside the archives
+        with tarfile.open(self.data_file) as tf:
+            members = {m.name: m for m in tf.getmembers() if m.isfile()}
+            src_name = next((n for n in sorted(members)
+                             if want in n and n.endswith(self._src_suffix)),
+                            None)
+            trg_name = next((n for n in sorted(members)
+                             if want in n and n.endswith(self._trg_suffix)),
+                            None)
+            if src_name is None or trg_name is None:
+                raise IOError(
+                    f"{type(self).__name__}: no '{want}' *{self._src_suffix}"
+                    f"/*{self._trg_suffix} pair inside {self.data_file}")
+            src_lines = tf.extractfile(members[src_name]).read().decode(
+                "utf-8", "ignore").strip().splitlines()
+            trg_lines = tf.extractfile(members[trg_name]).read().decode(
+                "utf-8", "ignore").strip().splitlines()
+        return src_lines, trg_lines
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang=None, **kw):
+        self._src_size = src_dict_size
+        self._trg_size = trg_dict_size
+        super().__init__(data_file, mode, **kw)
+
+    def _load(self):
+        src_lines, trg_lines = self._pairs_from_tar()
+        if self.mode == "train":
+            vs, vt = src_lines, trg_lines
+        else:
+            # vocab ALWAYS from the train pair so train/test share word
+            # ids (same contract as Imdb/Imikolov above)
+            saved = self.mode
+            self.mode = "train"
+            try:
+                vs, vt = self._pairs_from_tar()
+            finally:
+                self.mode = saved
+        self.src_dict = self._build_vocab(vs, self._src_size)
+        self.trg_dict = self._build_vocab(vt, self._trg_size)
+
+        def ids(ln, vocab):
+            return [vocab.get(w, self.UNK) for w in ln.split()]
+
+        self.samples = []
+        for s, t in zip(src_lines, trg_lines):
+            ti = ids(t, self.trg_dict)
+            self.samples.append((ids(s, self.src_dict),
+                                 [self.BOS] + ti, ti + [self.EOS]))
+
+
+class WMT14(_WMTBase):
+    """reference ``paddle.text.WMT14`` (en→fr)."""
+
+    _filename = "wmt14.tgz"
+    _src_suffix = ".en"
+    _trg_suffix = ".fr"
+
+
+class WMT16(_WMTBase):
+    """reference ``paddle.text.WMT16`` (en↔de multi-lingual archive)."""
+
+    _filename = "wmt16.tar.gz"
+    _src_suffix = ".en"
+    _trg_suffix = ".de"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", **kw):
+        if lang == "de":
+            self._src_suffix, self._trg_suffix = ".de", ".en"
+        super().__init__(data_file, mode, src_dict_size, trg_dict_size, **kw)
+
+
+class Conll05st(_CachedDataset):
+    """reference ``paddle.text.Conll05st`` — semantic role labeling rows.
+    Expects the test split's column files (words / props) inside the
+    archive; samples are (words, predicate, labels) id lists."""
+
+    _filename = "conll05st-tests.tar.gz"
+
+    def _load(self):
+        import tarfile
+        with tarfile.open(self.data_file) as tf:
+            members = {m.name: m for m in tf.getmembers() if m.isfile()}
+            w_name = next((n for n in sorted(members) if "words" in n), None)
+            p_name = next((n for n in sorted(members) if "props" in n), None)
+            if w_name is None or p_name is None:
+                raise IOError(f"Conll05st: words/props files not found in "
+                              f"{self.data_file}")
+            import gzip
+            def read(name):
+                raw = tf.extractfile(members[name]).read()
+                if name.endswith(".gz"):
+                    raw = gzip.decompress(raw)
+                return raw.decode("utf-8", "ignore")
+            sents, cur_w, cur_p = [], [], []
+            for wln, pln in zip(read(w_name).splitlines(),
+                                read(p_name).splitlines()):
+                if not wln.strip():
+                    if cur_w:
+                        sents.append((cur_w, cur_p))
+                    cur_w, cur_p = [], []
+                    continue
+                cur_w.append(wln.strip().lower())
+                cur_p.append(pln.split())
+            if cur_w:
+                sents.append((cur_w, cur_p))
+        # props format: col 0 = verb lemma or '-', cols 1..P = one label
+        # column per predicate — ONE sample per predicate, tagged with
+        # the predicate's token index
+        raw = []
+        for words, prows in sents:
+            pred_rows = [i for i, pr in enumerate(prows) if pr[0] != "-"]
+            n_pred = max(len(pr) for pr in prows) - 1
+            for k in range(n_pred):
+                labels = [pr[1 + k] if len(pr) > 1 + k else "*"
+                          for pr in prows]
+                pred_idx = pred_rows[k] if k < len(pred_rows) else 0
+                raw.append((words, pred_idx, labels))
+        self.word_dict = {w: i for i, w in enumerate(
+            sorted({w for s, _, _ in raw for w in s}))}
+        self.label_dict = {l: i for i, l in enumerate(
+            sorted({l for _, _, ls in raw for l in ls}))}
+        self.samples = [([self.word_dict[w] for w in s], p,
+                         [self.label_dict[l] for l in ls])
+                        for s, p, ls in raw]
+
+
+Conll05 = Conll05st

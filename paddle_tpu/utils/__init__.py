@@ -132,3 +132,5 @@ def dataset_cache_path(filename):
     MNIST/Cifar resolve from) for the zero-egress build."""
     return os.path.join(os.path.expanduser("~/.cache/paddle/dataset"),
                         filename)
+
+from . import unique_name  # noqa: F401

@@ -1,0 +1,43 @@
+"""reference: ``paddle.utils.unique_name`` — process-wide unique name
+generation (``generate``/``guard``/``switch``); ``guard('prefix')``
+namespaces generated names by the prefix."""
+from __future__ import annotations
+
+import contextlib
+
+_counters: dict[str, int] = {}
+_prefix: list[str] = [""]
+
+
+def generate(key="tmp"):
+    full = _prefix[0] + key
+    n = _counters.get(full, 0)
+    _counters[full] = n + 1
+    return f"{full}_{n}"
+
+
+def switch(new_generator=None):
+    """Swap the counter state; returns the old (counters, prefix)."""
+    global _counters
+    old = (_counters, _prefix[0])
+    if isinstance(new_generator, tuple):
+        _counters, _prefix[0] = new_generator
+    elif isinstance(new_generator, dict):
+        _counters, _prefix[0] = new_generator, ""
+    elif isinstance(new_generator, str):
+        # reference: guard('prefix') namespaces names as 'prefix_name_N'
+        _counters = {}
+        _prefix[0] = new_generator if new_generator.endswith("_") \
+            else new_generator + "_"
+    else:
+        _counters, _prefix[0] = {}, ""
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

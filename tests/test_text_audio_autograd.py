@@ -214,3 +214,181 @@ def test_incubate_segment_alias():
         paddle.to_tensor(np.array([0, 0, 1, 1], np.int64)))
     np.testing.assert_allclose(np.asarray(out.numpy()),
                                [[2, 2], [2, 2]])
+
+
+def test_round4_namespace_additions():
+    """audio.datasets/backends, vision.image_load, utils.unique_name,
+    autograd.jacobian/hessian facades, callbacks.ReduceLROnPlateau."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    import paddle_tpu.audio as audio
+    assert audio.datasets.TESS is not None
+    assert audio.backends.list_available_backends() == ["wave"]
+    # wav round-trip through the stdlib backend
+    import wave, tempfile, os
+    path = os.path.join(tempfile.mkdtemp(), "t.wav")
+    sig = (np.sin(np.linspace(0, 40, 1600)) * 20000).astype(np.int16)
+    with wave.open(path, "wb") as w:
+        w.setnchannels(1); w.setsampwidth(2); w.setframerate(16000)
+        w.writeframes(sig.tobytes())
+    t, sr = audio.load(path)
+    assert sr == 16000 and t.shape == [1, 1600]
+    np.testing.assert_allclose(t.numpy()[0], sig / 32768.0, atol=1e-4)
+
+    from paddle_tpu.utils import unique_name
+    a, b = unique_name.generate("fc"), unique_name.generate("fc")
+    assert a != b
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
+    with unique_name.guard("block1"):
+        n1 = unique_name.generate("fc")
+    with unique_name.guard("block2"):
+        n2 = unique_name.generate("fc")
+    assert n1 == "block1_fc_0" and n2 == "block2_fc_0"
+
+    import paddle_tpu.autograd as ag
+    f = lambda x: (x ** 2).sum()
+    x = paddle.to_tensor(np.arange(3, dtype="float32"))
+    h = ag.hessian(f, x)
+    np.testing.assert_allclose(np.asarray(h.numpy()), np.eye(3) * 2,
+                               atol=1e-5)
+    j = ag.jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(j.numpy()).ravel(), [0, 2, 4],
+                               atol=1e-5)
+
+    import paddle_tpu.callbacks as cb
+    r = cb.ReduceLROnPlateau(monitor="loss", patience=1, verbose=0)
+
+    class FakeOpt:
+        _lr = 0.1
+        def get_lr(self): return self._lr
+        def set_lr(self, v): self._lr = v
+    class FakeModel:
+        _optimizer = FakeOpt()
+    r.model = FakeModel()
+    r.on_eval_end({"loss": [1.0]})
+    r.on_eval_end({"loss": [1.0]})   # no improvement -> patience hit
+    assert abs(FakeModel._optimizer.get_lr() - 0.01) < 1e-9
+    # cooldown holds further reductions
+    r2 = cb.ReduceLROnPlateau(monitor="loss", patience=1, cooldown=2,
+                              verbose=0)
+    r2.model = FakeModel()
+    FakeModel._optimizer.set_lr(0.1)
+    r2.on_eval_end({"loss": [1.0]})
+    r2.on_eval_end({"loss": [1.0]})          # reduce #1 -> cooldown starts
+    assert abs(FakeModel._optimizer.get_lr() - 0.01) < 1e-9
+    r2.on_eval_end({"loss": [1.0]})          # cooldown tick 1: no change
+    r2.on_eval_end({"loss": [1.0]})          # cooldown tick 2: no change
+    assert abs(FakeModel._optimizer.get_lr() - 0.01) < 1e-9
+    import pytest as pt
+    with pt.raises(NotImplementedError):
+        cb.VisualDL()
+
+
+def test_image_load_stdlib_png_decoder():
+    """The zero-egress PNG path must agree with PIL on a round-trip."""
+    import io, os, tempfile
+    import numpy as np
+    from PIL import Image
+    import paddle_tpu.vision as vision
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (13, 17, 3), np.uint8)
+    path = os.path.join(tempfile.mkdtemp(), "t.png")
+    Image.fromarray(img).save(path)
+    # PIL-backed load
+    np.testing.assert_array_equal(vision.image_load(path), img)
+    # force the stdlib decoder
+    import paddle_tpu.vision as v
+    import builtins, unittest.mock as mock
+    real_import = builtins.__import__
+    def no_pil(name, *a, **k):
+        if name == "PIL":
+            raise ImportError("forced")
+        return real_import(name, *a, **k)
+    with mock.patch("builtins.__import__", side_effect=no_pil):
+        got = v.image_load(path)
+    np.testing.assert_array_equal(got, img)
+
+
+def test_round4_text_datasets():
+    """Movielens/WMT16/Conll05st parsers against synthetic archives in
+    the canonical layouts (zero-egress: real archives unavailable)."""
+    import io, os, tarfile, tempfile, zipfile
+    import paddle_tpu.text as text
+
+    tmp = tempfile.mkdtemp()
+
+    # --- MovieLens-1M layout
+    mlpath = os.path.join(tmp, "ml-1m.zip")
+    with zipfile.ZipFile(mlpath, "w") as z:
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::4::12345\n2::F::35::7::54321\n")
+        z.writestr("ml-1m/movies.dat",
+                   "10::Toy Story (1995)::Animation|Comedy\n"
+                   "20::Heat (1995)::Action\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::10::5::978300760\n2::20::3::978302109\n"
+                   "1::20::4::978301968\n")
+    ds = text.Movielens(data_file=mlpath, mode="train")
+    assert len(ds) == 3                      # 3 ratings, none in test split
+    uid, g, age, occ, mid, cats, title, rating = ds[0]
+    assert (uid, g, age, occ, mid, rating) == (1, 0, 2, 4, 10, 5.0)
+    assert len(ds.categories_dict) == 3      # Animation, Comedy, Action
+
+    # --- WMT16 layout (parallel .en/.de line files)
+    wmtpath = os.path.join(tmp, "wmt16.tar.gz")
+    with tarfile.open(wmtpath, "w:gz") as tf:
+        for name, payload in [
+                ("wmt16/train.en", "a cat sat\nthe dog ran\n"),
+                ("wmt16/train.de", "eine katze sass\nder hund lief\n")]:
+            data = payload.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    wmt = text.WMT16(data_file=wmtpath, mode="train", src_dict_size=50,
+                     trg_dict_size=50)
+    assert len(wmt) == 2
+    src, trg_in, trg_out = wmt[0]
+    assert trg_in[0] == text.WMT16.BOS and trg_out[-1] == text.WMT16.EOS
+    assert len(src) == 3 and len(trg_in) == 4
+    # de->en direction swaps the pair
+    wmt_de = text.WMT16(data_file=wmtpath, mode="train", lang="de")
+    assert [wmt.src_dict.get(w) is not None for w in ["a", "cat"]] == [True] * 2
+    assert "katze" in wmt_de.src_dict
+
+    # --- Conll05 layout (words + props column files)
+    cpath = os.path.join(tmp, "conll05st-tests.tar.gz")
+    with tarfile.open(cpath, "w:gz") as tf:
+        for name, payload in [
+                ("conll05st/test.wsj.words", "The\ncat\nsat\n\nDogs\nran\n\n"),
+                ("conll05st/test.wsj.props", "- B-A0\n- I-A0\n sat B-V\n\n"
+                                             "- B-A0\n ran B-V\n\n")]:
+            data = payload.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    c = text.Conll05st(data_file=cpath, mode="test")
+    assert len(c) == 2
+    words, pred_idx, labs = c[0]
+    assert len(words) == 3 and len(labs) == 3
+    assert pred_idx == 2                      # 'sat' row carries the verb
+    assert text.Conll05 is text.Conll05st
+
+    # train/test WMT vocab must share word ids (vocab from train pair)
+    with tarfile.open(os.path.join(tmp, "wmt16b.tar.gz"), "w:gz") as tf:
+        for name, payload in [
+                ("wmt16/train.en", "a cat sat\nthe dog ran\n"),
+                ("wmt16/train.de", "eine katze sass\nder hund lief\n"),
+                ("wmt16/test.en", "dog sat\n"),
+                ("wmt16/test.de", "hund sass\n")]:
+            data = payload.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    tr = text.WMT16(data_file=os.path.join(tmp, "wmt16b.tar.gz"),
+                    mode="train")
+    te = text.WMT16(data_file=os.path.join(tmp, "wmt16b.tar.gz"),
+                    mode="test")
+    assert tr.src_dict == te.src_dict and tr.trg_dict == te.trg_dict
