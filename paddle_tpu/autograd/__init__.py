@@ -22,7 +22,8 @@ def jacobian(ys, xs, batch_axis=None):
     incubate implementation."""
     if callable(ys):
         from ..incubate.autograd import Jacobian
-        return Jacobian(ys, xs if isinstance(xs, (list, tuple)) else [xs])
+        return Jacobian(ys, xs if isinstance(xs, (list, tuple)) else [xs],
+                        is_batched=batch_axis is not None)
     raise NotImplementedError(
         "paddle.autograd.jacobian over already-computed outputs needs the "
         "functional form: pass the function as the first argument "
@@ -33,7 +34,8 @@ def hessian(ys, xs, batch_axis=None):
     """See :func:`jacobian` — functional (func, xs) form."""
     if callable(ys):
         from ..incubate.autograd import Hessian
-        return Hessian(ys, xs if isinstance(xs, (list, tuple)) else [xs])
+        return Hessian(ys, xs if isinstance(xs, (list, tuple)) else [xs],
+                       is_batched=batch_axis is not None)
     raise NotImplementedError(
         "paddle.autograd.hessian needs the functional form "
         "(hessian(func, xs)); see paddle.incubate.autograd.Hessian")

@@ -13,7 +13,7 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "LogWriterCallback" "ReduceLROnPlateau", "VisualDL"]
+           "LRScheduler", "LogWriterCallback", "ReduceLROnPlateau", "VisualDL"]
 
 
 class Callback:

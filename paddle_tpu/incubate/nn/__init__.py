@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from . import functional  # noqa: F401
 from .fused_transformer import FusedMultiTransformer  # noqa: F401
+from ...nn.layer import Layer
 from ...nn.layers.transformer import TransformerEncoderLayer as _TEL
 
 
@@ -22,3 +23,76 @@ class FusedMultiHeadAttention(object):
         raise NotImplementedError(
             "Use paddle.nn.MultiHeadAttention — XLA emits the fused kernel; "
             "the separate fused layer exists only for CUDA in the reference")
+
+
+class FusedLinear(Layer):
+    """paddle.incubate.nn.FusedLinear — Linear with the fused-gemm API
+    (transpose_weight); XLA's epilogue fusion is the TPU analogue."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = self.create_parameter((out_features,), attr=bias_attr,
+                                          is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        from .functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self.transpose_weight)
+
+
+class FusedFeedForward(Layer):
+    """paddle.incubate.nn.FusedFeedForward — transformer FFN block over
+    the fused_feedforward functional."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.activation = activation
+        self.epsilon = epsilon
+        def mk(shape, attr, **kw):
+            # attr=False is the reference no-parameter marker
+            return None if attr is False else self.create_parameter(
+                shape, attr=attr, **kw)
+
+        self.linear1_weight = mk((d_model, dim_feedforward),
+                                 linear1_weight_attr)
+        self.linear1_bias = mk((dim_feedforward,), linear1_bias_attr,
+                               is_bias=True)
+        self.linear2_weight = mk((dim_feedforward, d_model),
+                                 linear2_weight_attr)
+        self.linear2_bias = mk((d_model,), linear2_bias_attr, is_bias=True)
+        one = __import__("paddle_tpu").nn.initializer.Constant(1.0)
+        self.ln1_scale = mk((d_model,), ln1_scale_attr,
+                            default_initializer=one)
+        self.ln1_bias = mk((d_model,), ln1_bias_attr, is_bias=True)
+        self.ln2_scale = mk((d_model,), ln2_scale_attr,
+                            default_initializer=one)
+        self.ln2_bias = mk((d_model,), ln2_bias_attr, is_bias=True)
+
+    def forward(self, src):
+        from .functional import fused_feedforward
+        return fused_feedforward(
+            src, self.linear1_weight, self.linear1_bias,
+            self.linear2_weight, self.linear2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate, activation=self.activation,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            pre_layer_norm=self.normalize_before,
+            ln1_epsilon=self.epsilon, ln2_epsilon=self.epsilon,
+            training=self.training)

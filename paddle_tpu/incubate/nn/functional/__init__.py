@@ -42,9 +42,36 @@ def fused_multi_head_attention(*a, **kw):
         "scaled_dot_product_attention (Pallas flash kernel on TPU)")
 
 
-def fused_feedforward(*a, **kw):
-    raise NotImplementedError(
-        "fused_feedforward: compose Linear+activation — XLA fuses the chain")
+def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
+                      linear2_bias, dropout1_rate=0.5, dropout2_rate=0.5,
+                      activation="relu", ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, pre_layer_norm=False,
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5, training=True,
+                      name=None):
+    """paddle.incubate.nn.functional.fused_feedforward — the transformer
+    FFN block (LN? → linear1 → act → dropout → linear2 → dropout →
+    +residual → LN?). One traced chain; XLA emits the fused kernels the
+    reference hand-writes in CUDA."""
+    import paddle_tpu.nn.functional as F
+    from ....nn.functional.norm import layer_norm
+
+    def maybe_ln(t, scale, bias, eps):
+        if scale is None and bias is None:
+            return t
+        return layer_norm(t, t.shape[-1], weight=scale, bias=bias,
+                          epsilon=eps)
+
+    residual = x
+    h = maybe_ln(x, ln1_scale, ln1_bias, ln1_epsilon) if pre_layer_norm else x
+    h = F.linear(h, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, dropout1_rate, training=training)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = maybe_ln(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):

@@ -522,3 +522,21 @@ class nn:
     class functional:
         attention = staticmethod(_sparse_attention_impl)
         relu = staticmethod(relu)
+
+
+def softmax(x, axis=-1, name=None):
+    """Pattern-restricted softmax (reference:
+    ``paddle.sparse.nn.functional.softmax`` / phi sparse softmax):
+    normalizes over the STORED entries of each row; the zero pattern is
+    preserved."""
+    m = _coo(x)._m
+    if axis not in (-1, len(m.shape) - 1):
+        raise NotImplementedError("sparse.softmax supports the last axis")
+    dense = m.todense()
+    # mask non-stored entries with -inf, softmax, then re-gather values
+    mask = jnp.zeros(m.shape, bool).at[tuple(m.indices.T)].set(True)
+    z = jnp.where(mask, dense, -jnp.inf)
+    sm = jax.nn.softmax(z, axis=-1)
+    vals = sm[tuple(m.indices.T)]
+    out = SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out

@@ -56,14 +56,16 @@ def image_load(path, backend=None):
                 rec = line
             elif f == 2:               # up
                 rec = (line + prev) % 256
-            else:
+            elif f == 1:               # sub: per-channel cumulative sum
+                cols = line.reshape(w, nch)
+                rec = np.cumsum(cols, axis=0, dtype=np.int64) % 256
+                rec = rec.reshape(stride).astype(np.int32)
+            else:                      # average / paeth need the scalar loop
                 rec = np.zeros(stride, np.int32)
                 for i in range(stride):
                     a = rec[i - nch] if i >= nch else 0
                     b = int(prev[i])
-                    if f == 1:
-                        rec[i] = (line[i] + a) % 256
-                    elif f == 3:
+                    if f == 3:
                         rec[i] = (line[i] + (a + b) // 2) % 256
                     else:                       # paeth
                         c = int(prev[i - nch]) if i >= nch else 0

@@ -173,3 +173,64 @@ def test_fused_functional_shims():
     paddle.seed(3)
     IF.fused_dropout_add(xt, y, p=0.4).sum().backward()
     assert np.isfinite(np.asarray(xt.grad.numpy())).all()
+
+
+def test_fused_feedforward_and_linear():
+    """FusedFeedForward matches the hand-composed FFN chain; FusedLinear
+    honors transpose_weight."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.incubate.nn import FusedFeedForward, FusedLinear
+    from paddle_tpu.incubate.nn.functional import (fused_feedforward,
+                                                   fused_linear)
+
+    paddle.seed(3)
+    x = paddle.to_tensor(np.random.randn(2, 5, 8).astype("float32"))
+    ffn = FusedFeedForward(8, 16, dropout_rate=0.0, act_dropout_rate=0.0)
+    ffn.eval()
+    out = ffn(x)
+    # manual chain (post-LN variant)
+    h = F.linear(x, ffn.linear1_weight, ffn.linear1_bias)
+    h = F.relu(h)
+    h = F.linear(h, ffn.linear2_weight, ffn.linear2_bias)
+    from paddle_tpu.nn.functional.norm import layer_norm
+    want = layer_norm(x + h, 8, weight=ffn.ln2_scale, bias=ffn.ln2_bias)
+    np.testing.assert_allclose(out.numpy(), want.numpy(), atol=1e-5)
+
+    # pre-LN variant changes the result
+    ffn2 = FusedFeedForward(8, 16, dropout_rate=0.0, normalize_before=True)
+    ffn2.eval()
+    assert not np.allclose(ffn2(x).numpy(), out.numpy())
+
+    lin = FusedLinear(8, 4, transpose_weight=True)
+    assert list(lin.weight.shape) == [4, 8]
+    got = lin(x)
+    want = x.numpy() @ lin.weight.numpy().T + lin.bias.numpy()
+    np.testing.assert_allclose(got.numpy(), want, atol=1e-5)
+
+    # grads flow through the functional
+    x.stop_gradient = False
+    loss = fused_feedforward(
+        x, ffn.linear1_weight, ffn.linear1_bias, ffn.linear2_weight,
+        ffn.linear2_bias, dropout1_rate=0.0, dropout2_rate=0.0,
+        ln2_scale=ffn.ln2_scale, ln2_bias=ffn.ln2_bias).sum()
+    loss.backward()
+    assert x.grad is not None
+
+
+def test_sparse_softmax():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.sparse as sparse
+
+    dense = np.array([[1.0, 0.0, 2.0], [0.0, 3.0, 0.0]], "float32")
+    coo = sparse.sparse_coo_tensor(np.nonzero(dense),
+                                   dense[dense != 0], shape=[2, 3])
+    sm = sparse.softmax(coo)
+    out = sm.to_dense().numpy()
+    # row 0 normalizes over {1, 2} only; zero pattern preserved
+    e = np.exp(np.array([1.0, 2.0]) - 2.0)
+    np.testing.assert_allclose(out[0, [0, 2]], e / e.sum(), atol=1e-6)
+    assert out[0, 1] == 0.0
+    np.testing.assert_allclose(out[1], [0.0, 1.0, 0.0], atol=1e-6)
