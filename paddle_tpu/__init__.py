@@ -92,3 +92,44 @@ def run_check():
     n = len(jax.devices())
     print(f"paddle_tpu is installed successfully! {n} device(s) "
           f"({jax.default_backend()}) available.")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: ``paddle.set_printoptions`` — numpy print formatting
+    governs how Tensor reprs render in this build."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        if sci_mode:
+            # numpy has no "force scientific" flag — use a formatter
+            prec = precision if precision is not None else 8
+            kw["formatter"] = {
+                "float_kind": lambda v: f"{v:.{prec}e}"}
+        else:
+            kw["suppress"] = True
+            kw["formatter"] = None
+    _np.set_printoptions(**kw)
+
+
+def _module_inplace(name):
+    def fn(x, *a, **kw):
+        return getattr(x, name)(*a, **kw)
+    fn.__name__ = name
+    fn.__doc__ = f"paddle.{name} — module-level alias of Tensor.{name}"
+    return fn
+
+
+scatter_ = _module_inplace("scatter_")
+tril_ = _module_inplace("tril_")
+triu_ = _module_inplace("triu_")
+normal_ = _module_inplace("normal_")
+bernoulli_ = _module_inplace("bernoulli_")

@@ -63,6 +63,12 @@ def monkey_patch_tensor():
     T.__rtruediv__ = lambda s, o: ops.divide(o, s) if isinstance(o, Tensor) \
         else apply(lambda a: o / a, s, op_name="rdiv")
     T.__floordiv__ = lambda s, o: ops.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: ops.floor_divide(o, s) \
+        if isinstance(o, Tensor) \
+        else apply(lambda a: jnp.floor_divide(o, a), s,
+                   op_name="rfloordiv")
+    T.__dlpack__ = lambda s, **kw: s._data.__dlpack__(**kw)
+    T.__dlpack_device__ = lambda s: s._data.__dlpack_device__()
     T.__mod__ = lambda s, o: ops.mod(s, o)
     T.__pow__ = lambda s, o: ops.pow(s, o)
     T.__rpow__ = lambda s, o: apply(lambda a: jnp.power(o, a), s, op_name="rpow")
@@ -106,6 +112,7 @@ def monkey_patch_tensor():
         broadcast_to flip rot90 roll repeat_interleave pad cast
         take_along_axis put_along_axis index_select index_sample gather gather_nd
         scatter scatter_nd_add index_add index_put masked_select masked_fill
+        tril triu
         masked_scatter where nonzero unique unique_consecutive
         norm dist histogram bincount increment lcm gcd heaviside hypot
         nan_to_num multiplex divide_no_nan tensordot
@@ -130,7 +137,7 @@ def monkey_patch_tensor():
     for name in """add subtract multiply divide scale clip exp sqrt rsqrt
                    reciprocal floor ceil round abs sin cos tanh sigmoid neg
                    erfinv pow mod remainder lerp masked_fill index_put
-                   put_along_axis index_add""".split():
+                   put_along_axis index_add scatter tril triu""".split():
         fn = getattr(ops, name, None)
         if fn is not None and not hasattr(T, name + "_"):
             setattr(T, name + "_", _swap(fn))
@@ -159,6 +166,15 @@ def monkey_patch_tensor():
                                _LogNormal(mean, std))
     T.normal_ = lambda s, mean=0.0, std=1.0: s._replace_(
         (ops.randn(s.shape, dtype=s.dtype) * std + mean)._data)
+    from ..framework import random as _prandom
+    import jax as _jax
+
+    def _bernoulli_(s, p=0.5):
+        keep = _jax.random.bernoulli(_prandom.next_key(), p,
+                                     tuple(s.shape))
+        return s._replace_(keep.astype(s.dtype))
+
+    T.bernoulli_ = _bernoulli_
 
 
 monkey_patch_tensor()

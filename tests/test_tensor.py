@@ -121,3 +121,44 @@ def test_rng_state_roundtrip():
     paddle.set_rng_state(st)
     b = paddle.randn([2])
     np.testing.assert_allclose(a.numpy(), b.numpy())
+
+
+def test_round4_long_tail_surface():
+    """Module in-place aliases, rfloordiv/dlpack dunders, tril/triu
+    methods, bernoulli_, set_printoptions."""
+    import numpy as np
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.full((2, 2), 7.0, np.float32))
+    np.testing.assert_allclose((15 // t).numpy(), 2.0)
+    np.testing.assert_allclose(np.from_dlpack(t), 7.0)
+
+    m = paddle.to_tensor(np.ones((3, 3), np.float32))
+    np.testing.assert_allclose(m.tril().numpy(),
+                               np.tril(np.ones((3, 3))))
+    np.testing.assert_allclose(m.triu().numpy(),
+                               np.triu(np.ones((3, 3))))
+    paddle.tril_(m)
+    assert m.numpy()[0, 2] == 0.0 and m.numpy()[2, 0] == 1.0
+
+    paddle.seed(9)
+    x = paddle.to_tensor(np.zeros((2000,), np.float32))
+    paddle.bernoulli_(x, 0.3)
+    assert 0.2 < float(x.numpy().mean()) < 0.4
+    assert set(np.unique(x.numpy())) <= {0.0, 1.0}
+
+    y = paddle.to_tensor(np.zeros((4,), np.float32))
+    paddle.normal_(y, mean=2.0, std=0.0)
+    np.testing.assert_allclose(y.numpy(), 2.0)
+
+    s = paddle.to_tensor(np.zeros((3, 2), np.float32))
+    idx = paddle.to_tensor(np.array([1, 0]))
+    upd = paddle.to_tensor(np.ones((2, 2), np.float32))
+    paddle.scatter_(s, idx, upd)
+    np.testing.assert_allclose(s.numpy()[[0, 1]], 1.0)
+
+    try:
+        paddle.set_printoptions(precision=2, sci_mode=True)
+        assert "e+" in repr(np.array([1.5]))
+    finally:
+        np.set_printoptions(precision=8, suppress=False, formatter=None)
