@@ -94,29 +94,31 @@ def run_check():
           f"({jax.default_backend()}) available.")
 
 
+_printoptions_state = {}
+
+
 def set_printoptions(precision=None, threshold=None, edgeitems=None,
                      sci_mode=None, linewidth=None):
     """reference: ``paddle.set_printoptions`` — numpy print formatting
-    governs how Tensor reprs render in this build."""
+    governs how Tensor reprs render in this build. Options persist
+    across calls (paddle semantics): a later call that sets only e.g.
+    ``linewidth`` keeps the earlier ``sci_mode``."""
     import numpy as _np
-    kw = {}
-    if precision is not None:
-        kw["precision"] = precision
-    if threshold is not None:
-        kw["threshold"] = threshold
-    if edgeitems is not None:
-        kw["edgeitems"] = edgeitems
-    if linewidth is not None:
-        kw["linewidth"] = linewidth
-    if sci_mode is not None:
-        if sci_mode:
-            # numpy has no "force scientific" flag — use a formatter
-            prec = precision if precision is not None else 8
-            kw["formatter"] = {
-                "float_kind": lambda v: f"{v:.{prec}e}"}
-        else:
-            kw["suppress"] = True
-            kw["formatter"] = None
+    st = _printoptions_state
+    for k, v in (("precision", precision), ("threshold", threshold),
+                 ("edgeitems", edgeitems), ("linewidth", linewidth),
+                 ("sci_mode", sci_mode)):
+        if v is not None:
+            st[k] = v
+    kw = {k: st[k] for k in ("precision", "threshold", "edgeitems",
+                             "linewidth") if k in st}
+    if st.get("sci_mode"):
+        # numpy has no "force scientific" flag — use a formatter
+        prec = st.get("precision", 8)
+        kw["formatter"] = {"float_kind": lambda v: f"{v:.{prec}e}"}
+    elif "sci_mode" in st:
+        kw["suppress"] = True
+        kw["formatter"] = None
     _np.set_printoptions(**kw)
 
 

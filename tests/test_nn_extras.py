@@ -320,6 +320,8 @@ def test_channel_shuffle_huber_gaussian_nll():
     x = paddle.to_tensor(
         np.arange(1 * 4 * 2 * 2, dtype="float32").reshape(1, 4, 2, 2))
     y = nn.ChannelShuffle(2)(x)
+    yf = F.channel_shuffle(x, 2)
+    np.testing.assert_array_equal(y.numpy(), yf.numpy())
     # NCHW groups=2: channels [0,1,2,3] -> [0,2,1,3]
     np.testing.assert_allclose(np.asarray(y._data)[0, :, 0, 0],
                                np.asarray(x._data)[0, [0, 2, 1, 3], 0, 0])
@@ -328,9 +330,13 @@ def test_channel_shuffle_huber_gaussian_nll():
     b = paddle.to_tensor(np.array([0.5, 0.0], dtype="float32"))
     h = nn.HuberLoss(reduction="none", delta=1.0)(a, b)
     np.testing.assert_allclose(np.asarray(h._data), [0.125, 2.5], atol=1e-6)
+    hf = F.huber_loss(a, b, delta=1.0, reduction="none")
+    np.testing.assert_allclose(hf.numpy(), h.numpy())
 
     var = paddle.to_tensor(np.array([1.0, 4.0], dtype="float32"))
     g = nn.GaussianNLLLoss(reduction="none")(a, b, var)
+    gf = F.gaussian_nll_loss(a, b, var, reduction="none")
+    np.testing.assert_allclose(gf.numpy(), g.numpy())
     expect = 0.5 * (np.log([1.0, 4.0]) + np.array([0.25, 9.0]) / [1.0, 4.0])
     np.testing.assert_allclose(np.asarray(g._data), expect, atol=1e-6)
 
@@ -464,6 +470,14 @@ def test_adaptive_log_softmax_with_loss():
     x = paddle.to_tensor(np.random.randn(8, 16).astype("float32"))
     y = paddle.to_tensor(np.array([0, 3, 4, 9, 10, 19, 2, 12]))
     out, loss = m(x, y)
+    from paddle_tpu.nn.functional import (adaptive_log_softmax_with_loss,
+                                          adaptive_log_softmax_log_prob)
+    out2, loss2 = adaptive_log_softmax_with_loss(
+        x, y, m.head_weight, m.tail_weights, m.cutoffs,
+        head_bias=m.head_bias)
+    np.testing.assert_allclose(out.numpy(), out2.numpy(), atol=1e-6)
+    lp_direct = adaptive_log_softmax_log_prob(
+        x, m.head_weight, m.tail_weights, m.cutoffs, head_bias=m.head_bias)
     assert out.shape == [8]
     np.testing.assert_allclose(float(loss.numpy()),
                                -float(out.numpy().mean()), rtol=1e-6)

@@ -160,5 +160,8 @@ def test_round4_long_tail_surface():
     try:
         paddle.set_printoptions(precision=2, sci_mode=True)
         assert "e+" in repr(np.array([1.5]))
+        paddle.set_printoptions(linewidth=120)   # must keep sci_mode
+        assert "e+" in repr(np.array([1.5]))
     finally:
+        paddle._printoptions_state.clear()
         np.set_printoptions(precision=8, suppress=False, formatter=None)

@@ -21,6 +21,10 @@ def main():
     with open(os.path.join(root, "paddle_tpu", "ops", "ops.yaml"), "w") as f:
         f.write(schema.to_yaml(reg))
 
+    with open(os.path.join(root, "paddle_tpu", "ops", "backward.yaml"),
+              "w") as f:
+        f.write(schema.backward_yaml(reg))
+
     s = schema.summary(reg)
     lines = ["# Op surface (generated — tools/gen_op_schema.py)", "",
              f"{s['total_ops']} public ops "
@@ -41,7 +45,7 @@ def main():
     with open(os.path.join(root, "docs", "OPS.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
     print(f"exported {s['total_ops']} ops "
-          f"({s['tensor_methods']} methods) -> ops.yaml, docs/OPS.md")
+          f"({s['tensor_methods']} methods) -> ops.yaml, backward.yaml, docs/OPS.md")
 
 
 if __name__ == "__main__":
