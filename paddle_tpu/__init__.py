@@ -135,3 +135,43 @@ tril_ = _module_inplace("tril_")
 triu_ = _module_inplace("triu_")
 normal_ = _module_inplace("normal_")
 bernoulli_ = _module_inplace("bernoulli_")
+
+
+def disable_signal_handler():
+    """reference: ``paddle.disable_signal_handler`` — this build installs
+    no signal handlers, so there is nothing to disable (no-op)."""
+
+
+# reference namespace aliases: paddle.base (the post-2.5 name of the
+# fluid glue layer) and dtype objects
+from . import framework as base  # noqa: F401,E402
+import sys as _sys_mod  # noqa: E402
+
+_sys_mod.modules[__name__ + ".base"] = base
+import numpy as _np_mod  # noqa: E402
+
+
+class _DTypeMeta(type):
+    # this build's dtype singletons are numpy scalar TYPES (np.float32)
+    # while user code also passes np.dtype instances — isinstance must
+    # accept both, as paddle.dtype does for its singletons
+    def __instancecheck__(cls, obj):
+        return (isinstance(obj, _np_mod.dtype)
+                or (isinstance(obj, type)
+                    and issubclass(obj, _np_mod.generic)))
+
+
+class dtype(metaclass=_DTypeMeta):
+    """paddle.dtype — constructor normalizes any dtype spelling."""
+
+    def __new__(cls, v="float32"):
+        from .framework.dtype import convert_dtype
+        return convert_dtype(v)
+
+
+from .framework.dtype import bool_ as bool  # noqa: F401,E402,A001
+
+# star-import hygiene: everything public EXCEPT `bool` (rebinding the
+# caller's builtin bool to np.bool_ would break isinstance(x, bool))
+__all__ = [_n for _n in dict(globals()) if not _n.startswith("_")
+           and _n != "bool"]

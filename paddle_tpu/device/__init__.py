@@ -125,3 +125,10 @@ class cuda:
             name = str(d)
             total_memory = (d.memory_stats() or {}).get("bytes_limit", 0)
         return Props()
+
+
+# import-statement compatibility: ``import paddle.device.cuda`` must
+# resolve even though cuda is a namespace class here
+import sys as _sys
+
+_sys.modules[__name__ + ".cuda"] = cuda
