@@ -4,9 +4,18 @@ fused_multi_transformer).
 
 Run:  python examples/serve_continuous.py
 """
+import os
+import sys
 import threading
 
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
 import numpy as np
+
+import jax
+
+if os.environ.get("FORCE_CPU", "1") == "1":
+    jax.config.update("jax_platforms", "cpu")
 
 import paddle_tpu as paddle
 from paddle_tpu.inference import ContinuousServingEngine
