@@ -12,9 +12,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
 
-import jax
-
 if os.environ.get("FORCE_CPU", "1") == "1":
+    import jax
+
     jax.config.update("jax_platforms", "cpu")
 
 import paddle_tpu as paddle
