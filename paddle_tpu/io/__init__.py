@@ -364,8 +364,14 @@ def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
             batch = collate_fn(samples)
             result_queue.put((bidx, batch, None))
         except Exception as e:  # propagate
+            from .native import QueueClosed
+            if isinstance(e, QueueClosed):
+                break           # consumer is shutting down; exit quietly
             import traceback
-            result_queue.put((bidx, None, f"{e}\n{traceback.format_exc()}"))
+            try:
+                result_queue.put((bidx, None, f"{e}\n{traceback.format_exc()}"))
+            except QueueClosed:
+                break
 
 
 class _MultiprocessIter:
@@ -374,6 +380,9 @@ class _MultiprocessIter:
 
     def __init__(self, loader):
         self.loader = loader
+        _LIVE_ITERS.add(self)
+        self._shutdown_lock = threading.Lock()
+        self._shut = False
         self.batches = list(iter(loader.batch_sampler))
         self.n = len(self.batches)
         ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
@@ -421,6 +430,7 @@ class _MultiprocessIter:
         if self._next >= self.n:
             self._shutdown()
             raise StopIteration
+        from .native import QueueClosed
         while self._next not in self._pending:
             try:
                 bidx, batch, err = self.result_queue.get(timeout=5)
@@ -430,6 +440,8 @@ class _MultiprocessIter:
                     raise RuntimeError(
                         "DataLoader workers exited unexpectedly")
                 continue
+            except QueueClosed:
+                raise StopIteration    # interrupted for shutdown
             if err is not None:
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
@@ -438,10 +450,30 @@ class _MultiprocessIter:
         self._next += 1
         return _to_device(batch)
 
+    def interrupt(self):
+        """Wake any thread blocked in ``__next__``/worker ``put`` so the
+        pool can be torn down without closing a mapped segment under a
+        live waiter (io/native shmq_interrupt contract). Returns True when
+        a native interrupt was actually delivered (shm transport); the
+        mp.Queue fallback has no wakeup and returns False."""
+        if self._shm is not None:
+            self._shm.interrupt()
+            return True
+        return False
+
     def _shutdown(self):
+        # both the consumer and the prefetch thread's exit path call this;
+        # closing the shm segment twice (double munmap) is a segfault
+        with self._shutdown_lock:
+            if self._shut:
+                return
+            self._shut = True
+        self.interrupt()
         for p in self.workers:
             if p.is_alive():
                 p.terminate()
+        for p in self.workers:
+            p.join(timeout=5)
         if self._shm is not None:
             self._shm.close()
             self._shm = None
@@ -514,12 +546,36 @@ def _prefetch_run(wref, inner, q, stop, done):
                     pass
 
 
+def _retire_live_iters():
+    """atexit: shut down every still-live iterator in interrupt→join→close
+    order. A daemon prefetch thread that wakes inside the C shm pop during
+    interpreter finalization aborts the whole process (pthread_exit's
+    forced unwind through the ctypes frame hits std::terminate), so the
+    pools must be retired while the interpreter is still fully alive.
+    Prefetch WRAPPERS go first — their shutdown joins the producer thread
+    before the inner pool (and its shm mapping) is torn down."""
+    live = list(_LIVE_ITERS)
+    for it in sorted(live, key=lambda x: not isinstance(x, _PrefetchIter)):
+        try:
+            it.shutdown()
+        except Exception:
+            pass
+
+
+import atexit as _atexit
+import weakref as _weakref
+
+_LIVE_ITERS = _weakref.WeakSet()
+_atexit.register(_retire_live_iters)
+
+
 class _PrefetchIter:
     """Depth-k device prefetch wrapper (buffered_reader analogue)."""
 
     def __init__(self, inner, depth=2):
         import weakref
         self.inner = inner
+        _LIVE_ITERS.add(self)
         self.depth = depth
         self.q = queue.Queue(maxsize=depth)
         self.done = object()
@@ -535,8 +591,19 @@ class _PrefetchIter:
     def shutdown(self):
         """Unblock and retire the prefetch thread (mid-epoch break path:
         without this, an abandoned iterator leaks the thread blocked on a
-        full queue — and through it the worker processes)."""
+        full queue — and through it the worker processes). Order matters:
+        interrupt → join → close. Closing the shm segment while the
+        producer thread is still inside ``shmq_pop`` unmaps the semaphore
+        it is sleeping on (and a daemon thread waking in C during
+        interpreter finalization aborts the process)."""
         self._stop.set()
+        interrupt = getattr(self.inner, "interrupt", None)
+        has_native_interrupt = False
+        if interrupt:
+            try:
+                has_native_interrupt = bool(interrupt())
+            except Exception:
+                pass
         try:
             while True:
                 self.q.get_nowait()
@@ -544,12 +611,26 @@ class _PrefetchIter:
             pass
         close = getattr(self.inner, "close", None) or \
             getattr(self.inner, "shutdown", None)
-        if close:
-            try:
-                close()
-            except Exception:
-                pass
-        self.thread.join(timeout=5)
+        if has_native_interrupt:
+            # shm transport: the interrupt already woke the producer thread
+            # (QueueClosed); it exits in ms — join BEFORE close so the
+            # mapping is never destroyed under a live shmq_pop
+            self.thread.join(timeout=6)
+            if close:
+                try:
+                    close()
+                except Exception:
+                    pass
+        else:
+            # mp.Queue fallback: nothing can wake the producer's blocking
+            # get but worker teardown itself — close first (as before),
+            # then join; shmq_close's own drain covers any shm edge case
+            if close:
+                try:
+                    close()
+                except Exception:
+                    pass
+            self.thread.join(timeout=6)
 
     def __iter__(self):
         return self

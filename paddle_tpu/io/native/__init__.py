@@ -11,8 +11,10 @@ unavailable (``available()`` is the gate).
 from __future__ import annotations
 
 import ctypes
+import itertools
 import os
 import pickle
+import struct
 import subprocess
 import sys
 import tempfile
@@ -55,6 +57,11 @@ def _load():
             lib.shmq_push.restype = ctypes.c_int
             lib.shmq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_uint64, ctypes.c_int]
+            lib.shmq_pushv.restype = ctypes.c_int
+            lib.shmq_pushv.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64, ctypes.c_char_p,
+                                       ctypes.c_uint64, ctypes.c_uint64,
+                                       ctypes.c_int]
             lib.shmq_pop.restype = ctypes.c_int64
             lib.shmq_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                      ctypes.c_uint64, ctypes.c_int,
@@ -64,6 +71,7 @@ def _load():
                 getattr(lib, f).restype = ctypes.c_uint64
                 getattr(lib, f).argtypes = [ctypes.c_void_p]
             lib.shmq_close.argtypes = [ctypes.c_void_p]
+            lib.shmq_interrupt.argtypes = [ctypes.c_void_p]
             _LIB = lib
         except (OSError, subprocess.CalledProcessError) as e:
             _LIB_ERR = e
@@ -75,14 +83,30 @@ def available() -> bool:
     return sys.platform == "linux" and _load() is not None
 
 
+class QueueClosed(Exception):
+    """The queue was interrupted for shutdown; no further transfers."""
+
+
 class ShmQueue:
     """Blocking shared-memory queue of pickled python objects.
 
     Parent: ``ShmQueue(name, create=True)``; workers: ``ShmQueue(name)``.
+
+    Messages larger than one ring slot are transparently split across
+    slot-sized chunks (the reference's shared-mem blobs have no fixed blob
+    cap either — ``memory/allocation/mmap_allocator`` sizes the segment to
+    the tensor). Each chunk carries a ``(producer msg id, index, total)``
+    frame header; the consumer reassembles, so multiple workers can
+    interleave chunked pushes on the same ring safely. Message completion
+    order — not push order — determines ``get`` order, which is fine for
+    the DataLoader (it reorders by batch index anyway).
     """
 
     DEFAULT_SLOTS = 8
     DEFAULT_SLOT_BYTES = 64 << 20     # tmpfs pages are lazy — virtual only
+
+    _HDR = struct.Struct("<4sQII")    # magic, msg_id, chunk_idx, n_chunks
+    _MAGIC = b"PTQ1"
 
     def __init__(self, name, create=False, slots=DEFAULT_SLOTS,
                  slot_bytes=DEFAULT_SLOT_BYTES):
@@ -97,33 +121,84 @@ class ShmQueue:
         if not self._h:
             raise RuntimeError(f"shmq_{'create' if create else 'open'} failed "
                                f"for {self.name}")
+        self._slot_bytes = int(lib.shmq_slot_bytes(self._h))
+        if self._slot_bytes <= self._HDR.size:
+            lib.shmq_close(self._h)
+            self._h = None
+            raise ValueError(f"slot_bytes={self._slot_bytes} must exceed the "
+                             f"{self._HDR.size}-byte frame header")
         self._recv_buf = ctypes.create_string_buffer(1 << 20)
+        self._msg_counter = itertools.count()
+        self._partial = {}            # msg_id -> [n_seen, [chunks]]
 
     def put(self, obj, timeout=None):
+        if not self._h:
+            raise QueueClosed(self.name)
         blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
         to_ms = -1 if timeout is None else int(timeout * 1000)
-        rc = self._lib.shmq_push(self._h, blob, len(blob), to_ms)
-        if rc == -1:
-            raise TimeoutError(f"ShmQueue.put timed out ({self.name})")
-        if rc == -2:
-            raise ValueError(f"batch of {len(blob)} bytes exceeds slot size "
-                             f"{self._lib.shmq_slot_bytes(self._h)}")
+        payload = self._slot_bytes - self._HDR.size
+        n_chunks = max(1, -(-len(blob) // payload))
+        msg_id = (os.getpid() << 24) | (next(self._msg_counter) & 0xFFFFFF)
+        for i in range(n_chunks):
+            hdr = self._HDR.pack(self._MAGIC, msg_id, i, n_chunks)
+            off = i * payload
+            n = min(payload, len(blob) - off)
+            if not self._h:
+                raise QueueClosed(self.name)
+            # two-part push: the C side copies blob[off:off+n] straight from
+            # the pickle buffer — no per-chunk slice/concat of 64 MiB blobs
+            rc = self._lib.shmq_pushv(self._h, hdr, len(hdr), blob, off, n,
+                                      to_ms)
+            if rc == -1:
+                raise TimeoutError(f"ShmQueue.put timed out ({self.name})")
+            if rc == -2:
+                raise ValueError(f"chunk of {len(hdr) + n} bytes exceeds "
+                                 f"slot size {self._slot_bytes}")
+            if rc == -4:
+                raise QueueClosed(self.name)
         return True
 
     def get(self, timeout=None):
         to_ms = -1 if timeout is None else int(timeout * 1000)
         need = ctypes.c_uint64(0)
         while True:
+            if not self._h:
+                raise QueueClosed(self.name)
             n = self._lib.shmq_pop(self._h, self._recv_buf,
                                    len(self._recv_buf), to_ms,
                                    ctypes.byref(need))
             if n == -1:
                 raise TimeoutError(f"ShmQueue.get timed out ({self.name})")
+            if n == -4:
+                raise QueueClosed(self.name)
             if n == -3:
                 self._recv_buf = ctypes.create_string_buffer(
                     int(need.value))
                 continue
-            return pickle.loads(self._recv_buf.raw[:n])
+            raw = self._recv_buf.raw[:n]
+            magic, msg_id, idx, total = self._HDR.unpack_from(raw)
+            if magic != self._MAGIC:
+                raise RuntimeError(
+                    f"ShmQueue frame corruption on {self.name}")
+            chunk = raw[self._HDR.size:]
+            if total == 1:
+                return pickle.loads(chunk)
+            seen, chunks = self._partial.setdefault(
+                msg_id, [0, [None] * total])
+            if chunks[idx] is None:
+                chunks[idx] = chunk
+                self._partial[msg_id][0] = seen + 1
+            if self._partial[msg_id][0] == total:
+                del self._partial[msg_id]
+                return pickle.loads(b"".join(chunks))
+
+    def interrupt(self):
+        """Wake every blocked producer/consumer with :class:`QueueClosed`.
+        Call before ``close`` whenever another thread may still be inside
+        ``get``/``put`` — closing unmaps the pages a blocked waiter would
+        wake up on."""
+        if getattr(self, "_h", None):
+            self._lib.shmq_interrupt(self._h)
 
     def qsize(self):
         return int(self._lib.shmq_size(self._h))

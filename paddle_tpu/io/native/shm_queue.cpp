@@ -39,6 +39,7 @@ struct Ctrl {
   sem_t cmu;       // consumer mutex
   uint64_t pushed; // stats
   uint64_t popped;
+  uint64_t closing; // set by shmq_interrupt: waiters drain with -4
 };
 
 constexpr uint64_t kMagic = 0x70616464746f7571ULL;  // "paddtouq"
@@ -49,10 +50,21 @@ struct Handle {
   uint64_t map_len;
   int fd;
   bool owner;
+  volatile long active;  // threads currently inside pop/push on this handle
   char name[128];
 };
 
-uint64_t slot_stride(const Ctrl* c) { return 8 + c->slot_bytes; }
+struct ActiveGuard {
+  Handle* h;
+  explicit ActiveGuard(Handle* hh) : h(hh) { __sync_fetch_and_add(&h->active, 1); }
+  ~ActiveGuard() { __sync_fetch_and_sub(&h->active, 1); }
+};
+
+// slot layout: [len:8][ready:8][payload:slot_bytes]. `ready` is written
+// LAST by the producer (release) and awaited by the consumer: item_sem
+// counts COMPLETED pushes globally, but slots are read in tail order, so
+// a slow producer's reserved-but-unfinished slot must not be popped torn.
+uint64_t slot_stride(const Ctrl* c) { return 16 + c->slot_bytes; }
 
 int timed_wait(sem_t* s, int timeout_ms) {
   if (timeout_ms < 0) {
@@ -79,7 +91,7 @@ void* shmq_create(const char* name, uint64_t slots, uint64_t slot_bytes) {
   shm_unlink(name);  // stale segment from a crashed run
   int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
   if (fd < 0) return nullptr;
-  uint64_t len = sizeof(Ctrl) + slots * (8 + slot_bytes);
+  uint64_t len = sizeof(Ctrl) + slots * (16 + slot_bytes);
   if (ftruncate(fd, (off_t)len) != 0) { close(fd); shm_unlink(name); return nullptr; }
   void* mem = mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   if (mem == MAP_FAILED) { close(fd); shm_unlink(name); return nullptr; }
@@ -88,6 +100,7 @@ void* shmq_create(const char* name, uint64_t slots, uint64_t slot_bytes) {
   c->slot_bytes = slot_bytes;
   c->head = c->tail = 0;
   c->pushed = c->popped = 0;
+  c->closing = 0;
   sem_init(&c->free_sem, 1, (unsigned)slots);
   sem_init(&c->item_sem, 1, 0);
   sem_init(&c->pmu, 1, 1);
@@ -99,6 +112,7 @@ void* shmq_create(const char* name, uint64_t slots, uint64_t slot_bytes) {
   h->map_len = len;
   h->fd = fd;
   h->owner = true;
+  h->active = 0;
   strncpy(h->name, name, sizeof(h->name) - 1);
   return h;
 }
@@ -119,39 +133,69 @@ void* shmq_open(const char* name) {
   h->map_len = (uint64_t)st.st_size;
   h->fd = fd;
   h->owner = false;
+  h->active = 0;
   strncpy(h->name, name, sizeof(h->name) - 1);
   return h;
 }
 
-// 0 ok; -1 timeout; -2 payload larger than slot
-int shmq_push(void* hv, const void* buf, uint64_t len, int timeout_ms) {
+// 0 ok; -1 timeout; -2 payload larger than slot; -4 queue closing.
+// Two-part write (header + payload at an offset into one buffer) so the
+// Python wrapper can frame chunked messages without concatenating 64 MiB
+// slices per chunk.
+int shmq_pushv(void* hv, const void* hdr, uint64_t hdr_len, const void* buf,
+               uint64_t off, uint64_t len, int timeout_ms) {
   Handle* h = (Handle*)hv;
+  ActiveGuard ag(h);
   Ctrl* c = h->ctrl;
-  if (len > c->slot_bytes) return -2;
+  uint64_t total = hdr_len + len;
+  if (total > c->slot_bytes) return -2;
+  if (c->closing) return -4;
   if (timed_wait(&c->free_sem, timeout_ms) != 0) return -1;
+  if (c->closing) { sem_post(&c->free_sem); return -4; }
   timed_wait(&c->pmu, -1);
   uint64_t slot = c->head % c->slots;
   c->head++;
   uint8_t* p = h->data + slot * slot_stride(c);
   sem_post(&c->pmu);
-  memcpy(p, &len, 8);
-  memcpy(p + 8, buf, len);
+  memcpy(p, &total, 8);
+  if (hdr_len) memcpy(p + 16, hdr, hdr_len);
+  if (len) memcpy(p + 16 + hdr_len, (const uint8_t*)buf + off, len);
   __sync_synchronize();
-  c->pushed++;
+  uint64_t one = 1;
+  memcpy(p + 8, &one, 8);  // ready: release the slot to the consumer
+  __sync_synchronize();
+  __sync_fetch_and_add(&c->pushed, 1);
   sem_post(&c->item_sem);
   return 0;
 }
 
+int shmq_push(void* hv, const void* buf, uint64_t len, int timeout_ms) {
+  return shmq_pushv(hv, nullptr, 0, buf, 0, len, timeout_ms);
+}
+
 // >=0: payload length; -1 timeout; -3 caller buffer too small (len returned
-// via *need)
+// via *need); -4 queue closing
 int64_t shmq_pop(void* hv, void* out, uint64_t cap, int timeout_ms,
                  uint64_t* need) {
   Handle* h = (Handle*)hv;
+  ActiveGuard ag(h);
   Ctrl* c = h->ctrl;
   if (timed_wait(&c->item_sem, timeout_ms) != 0) return -1;
+  if (c->closing) { sem_post(&c->item_sem); return -4; }
   timed_wait(&c->cmu, -1);
   uint64_t slot = c->tail % c->slots;
   uint8_t* p = h->data + slot * slot_stride(c);
+  // item_sem counted a COMPLETED push somewhere, but tail order may reach
+  // a slot whose producer is still copying — await its ready flag
+  uint64_t ready = 0;
+  struct timespec ms = {0, 200000};  // 0.2 ms
+  while (true) {
+    memcpy(&ready, p + 8, 8);
+    if (ready) break;
+    if (c->closing) { sem_post(&c->cmu); sem_post(&c->item_sem); return -4; }
+    nanosleep(&ms, nullptr);
+  }
+  __sync_synchronize();
   uint64_t len;
   memcpy(&len, p, 8);
   if (len > cap) {
@@ -161,7 +205,9 @@ int64_t shmq_pop(void* hv, void* out, uint64_t cap, int timeout_ms,
     sem_post(&c->item_sem);
     return -3;
   }
-  memcpy(out, p + 8, len);
+  memcpy(out, p + 16, len);
+  uint64_t zero = 0;
+  memcpy(p + 8, &zero, 8);  // clear ready before the slot is reused
   c->tail++;
   c->popped++;
   sem_post(&c->cmu);
@@ -179,8 +225,42 @@ uint64_t shmq_size(void* hv) {
 uint64_t shmq_pushed(void* hv) { return ((Handle*)hv)->ctrl->pushed; }
 uint64_t shmq_popped(void* hv) { return ((Handle*)hv)->ctrl->popped; }
 
+// Wake every blocked producer/consumer; they return -4 instead of touching
+// slot memory again. MUST precede shmq_close whenever another thread may
+// still be inside shmq_pop/shmq_push on the same segment — closing unmaps
+// the pages a blocked sem_timedwait would otherwise wake up on (the
+// teardown abort this interrupt exists to prevent).
+void shmq_interrupt(void* hv) {
+  Ctrl* c = ((Handle*)hv)->ctrl;
+  c->closing = 1;
+  __sync_synchronize();
+  for (uint64_t i = 0; i < c->slots + 64; ++i) {
+    sem_post(&c->item_sem);
+    sem_post(&c->free_sem);
+  }
+}
+
 void shmq_close(void* hv) {
   Handle* h = (Handle*)hv;
+  // a sibling thread may still be inside pop/push (its semaphore lives in
+  // the mapping we are about to destroy) — interrupt + drain before unmap.
+  // Only the OWNER may set the shared closing flag: a worker closing its
+  // handle on normal exit must not shut the queue down for everyone.
+  if (h->owner) {
+    h->ctrl->closing = 1;
+    __sync_synchronize();
+    if (__sync_fetch_and_add(&h->active, 0) != 0) {
+      for (uint64_t i = 0; i < h->ctrl->slots + 64; ++i) {
+        sem_post(&h->ctrl->item_sem);
+        sem_post(&h->ctrl->free_sem);
+      }
+    }
+  }
+  struct timespec ms = {0, 1000000};
+  for (int spin = 0; spin < 10000; ++spin) {  // cap ~10 s
+    if (__sync_fetch_and_add(&h->active, 0) == 0) break;
+    nanosleep(&ms, nullptr);
+  }
   bool owner = h->owner;
   char name[128];
   strncpy(name, h->name, sizeof(name));
