@@ -24,7 +24,7 @@ _HYBRID_DEFAULTS = {
     "pp_configs": {
         "micro_batch_size": 1,
         "accumulate_steps": 1,
-        "schedule_mode": "1F1B",  # FThenB | 1F1B
+        "schedule_mode": "1F1B",  # FThenB | 1F1B | ZBH1
         "p2p_overlap": True,
     },
     "mp_configs": {
@@ -82,6 +82,13 @@ class DistributedStrategy:
         self.find_unused_parameters = False
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # dp-axis meta-optimizers (reference dgc_optimizer / localsgd_
+        # optimizer); realized by fleet.meta_optimizers wrappers
+        self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "rampup_step": 1,
+                            "sparsity": [0.999]}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.fuse_all_reduce_ops = True  # advisory on TPU (XLA fuses)
         self.nccl_comm_num = 1           # accepted, meaningless on ICI
         # auto-parallel mesh search (reference: strategy.auto / the

@@ -75,7 +75,19 @@ class PipelineParallel(Layer):
                 "Dropout" in type(sub).__name__ and getattr(sub, "p", 0) > 0
                 for sub in self._layers.sublayers(include_self=True))
             from ....distributed.engine import PipelinedModule
-            pm = PipelinedModule(self._layers)
+            # strategy schedule_mode → engine backward schedule; VPP
+            # models keep the default backward (the custom-vjp schedules
+            # support vpp_degree == 1 only — rejecting at call time would
+            # break interleaved models that trained fine under FThenB)
+            sched = {"FThenB": "fthenb", "1F1B": "1f1b",
+                     "ZBH1": "zb"}.get(str(self.schedule_mode), "fthenb")
+            if getattr(self._layers, "_vpp", 1) > 1 and sched != "fthenb":
+                import sys
+                print(f"PipelineParallel: schedule_mode="
+                      f"{self.schedule_mode} with interleaved VPP keeps "
+                      "the default backward (fthenb)", file=sys.stderr)
+                sched = "fthenb"
+            pm = PipelinedModule(self._layers, schedule=sched)
         except ValueError as e:
             import sys
             print(f"PipelineParallel: eager fallback ({e})", file=sys.stderr)

@@ -120,9 +120,11 @@ class ShardingPass(PassBase):
 
 @register_pass("pipeline_scheduler")
 class PipelineSchedulerPass(PassBase):
-    """Selects the microbatch schedule. The SPMD engine's scan schedule
-    (distributed/engine.py) realizes FThenB/1F1B identically (XLA overlaps);
-    VPP maps to stacking virtual stages on the stage axis."""
+    """Selects the microbatch schedule, all realized by the SPMD engine
+    (distributed/engine.py): FThenB (grad-through-scan), 1F1B
+    (recompute/backward custom_vjp, O(S) memory), VPP (interleaved
+    virtual stages), ZBH1 (1F1B with the backward split into B on the
+    wire chain and W deferred one tick off it)."""
 
     SCHEDULES = ("FThenB", "1F1B", "VPP", "ZBH1")
 

@@ -1,0 +1,158 @@
+"""Heterogeneous pipeline stages (VERDICT round-4 item 7; reference:
+``pp_utils/p2p_communication.py`` negotiates per-stage recv shapes via a
+tensor-meta exchange, so stages with different widths/params pipeline
+fine). ``pipeline_forward_hetero`` gives the SPMD engine the same
+freedom: per-stage bodies picked by ``lax.switch``, per-stage param
+leaves slot-packed/zero-padded into one shardable stack, activations
+padded to the max wire shape INSIDE the engine (not by the caller), for
+all three backward schedules."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.engine import pipeline_forward_hetero
+
+
+def _mk(rng, i, o, extra=False):
+    p = {"w": jnp.asarray(rng.normal(size=(i, o)) * 0.4, jnp.float32),
+         "b": jnp.asarray(rng.normal(size=(o,)) * 0.1, jnp.float32)}
+    if extra:
+        p["g"] = jnp.asarray(rng.normal(size=(o,)) * 0.05, jnp.float32)
+    return p
+
+
+def _f_plain(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _f_extra(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"]) * (1 + p["g"])
+
+
+def _setup():
+    rng = np.random.default_rng(0)
+    # widths 8 -> 12 -> 16 -> 12 -> 8; stage 1 has an extra leaf the
+    # others lack (different param SIGNATURES, not just shapes)
+    widths = [(8, 12), (12, 16), (16, 12), (12, 8)]
+    params = [_mk(rng, *widths[0]), _mk(rng, *widths[1], extra=True),
+              _mk(rng, *widths[2]), _mk(rng, *widths[3])]
+    fns = [_f_plain, _f_extra, _f_plain, _f_plain]
+    micro = jnp.asarray(rng.normal(size=(4, 2, 8)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=(4, 2, 8)), jnp.float32)
+    return fns, params, micro, g
+
+
+def _seq(fns, ps, x):
+    outs = []
+    for m in range(x.shape[0]):
+        h = x[m]
+        for s in range(len(fns)):
+            h = fns[s](ps[s], h)
+        outs.append(h)
+    return jnp.stack(outs)
+
+
+@pytest.mark.parametrize("sched", ["fthenb", "1f1b", "zb"])
+def test_hetero_stage_widths_parity(sched):
+    fns, params, micro, g = _setup()
+    o_ref = _seq(fns, params, micro)
+    go_ref = jax.grad(lambda ps: jnp.sum(_seq(fns, ps, micro) * g))(params)
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        out = jax.jit(lambda ps, x: pipeline_forward_hetero(
+            fns, ps, x, schedule=sched))(params, micro)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(o_ref),
+                                   rtol=1e-5, atol=1e-5)
+        gp = jax.jit(jax.grad(lambda ps: jnp.sum(pipeline_forward_hetero(
+            fns, ps, micro, schedule=sched) * g)))(params)
+        for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(go_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_hetero_layer_stages_parity():
+    """A Pipe-style model built from REAL Layers with per-stage widths:
+    embedding-ish widening stage, two different-width MLP stages, and a
+    narrowing head stage — through FunctionalModule per stage."""
+    from paddle_tpu import nn
+    from paddle_tpu.framework.functional import FunctionalModule
+
+    paddle.seed(3)
+    stages = [
+        nn.Sequential(nn.Linear(8, 24), nn.GELU()),
+        nn.Sequential(nn.Linear(24, 32), nn.GELU(), nn.Linear(32, 24)),
+        nn.Sequential(nn.LayerNorm(24), nn.Linear(24, 16)),
+        nn.Sequential(nn.Linear(16, 8)),
+    ]
+    fms = [FunctionalModule(s) for s in stages]
+    params = [fm.param_arrays() for fm in fms]
+    key = jax.random.PRNGKey(0)
+    fns = [lambda p, x, fm=fm: fm(p, [], key, x)[0] for fm in fms]
+
+    rng = np.random.default_rng(5)
+    micro = jnp.asarray(rng.normal(size=(4, 2, 8)), jnp.float32)
+    g = jnp.asarray(rng.normal(size=(4, 2, 8)), jnp.float32)
+    o_ref = _seq(fns, params, micro)
+    go_ref = jax.grad(lambda ps: jnp.sum(_seq(fns, ps, micro) * g))(params)
+
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        for sched in ("fthenb", "1f1b"):
+            out = jax.jit(lambda ps, x: pipeline_forward_hetero(
+                fns, ps, x, schedule=sched))(params, micro)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(o_ref),
+                                       rtol=1e-5, atol=1e-5, err_msg=sched)
+            gp = jax.jit(jax.grad(lambda ps: jnp.sum(pipeline_forward_hetero(
+                fns, ps, micro, schedule=sched) * g)))(params)
+            for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(go_ref)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=sched)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_hetero_dropout_keys():
+    """Stochastic hetero stages reproduce the sequential run given the
+    same base key (per-(micro, stage) key threading)."""
+    from paddle_tpu.distributed.engine import _chunk_key
+
+    rng = np.random.default_rng(2)
+    params = [_mk(rng, 8, 16), _mk(rng, 16, 8)]
+
+    def s0(p, x, key):
+        keep = jax.random.bernoulli(key, 0.8, (x.shape[0], 16))
+        return jnp.tanh(x @ p["w"] + p["b"]) * keep
+
+    def s1(p, x, key):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    fns = [s0, s1]
+    micro = jnp.asarray(rng.normal(size=(4, 2, 8)), jnp.float32)
+    base = jax.random.key(11)
+    g = jnp.asarray(rng.normal(size=(4, 2, 8)), jnp.float32)
+
+    def seq(ps):
+        outs = []
+        for m in range(micro.shape[0]):
+            h = micro[m]
+            for s in range(2):
+                h = fns[s](ps[s], h, _chunk_key(base, m, s))
+            outs.append(h)
+        return jnp.stack(outs)
+
+    mesh_mod.init_mesh({"pp": 2, "dp": 4})
+    try:
+        gp = jax.jit(jax.grad(lambda ps: jnp.sum(pipeline_forward_hetero(
+            fns, ps, micro, rng_key=base, schedule="1f1b") * g)))(params)
+        gs = jax.grad(lambda ps: jnp.sum(seq(ps) * g))(params)
+        for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        mesh_mod.reset_mesh()

@@ -1,0 +1,168 @@
+"""ZB-H1 pipeline schedule (VERDICT round-4 item 5; reference:
+``pipeline_scheduler_pass`` ZBH1 — the zero-bubble family's H1 member:
+backward split into B (activation grad, on the inter-stage wire) and W
+(weight grad, deferred to fill bubble slots), at 1F1B-equal memory).
+
+``schedule='zb'`` reuses the 1F1B-memory recompute scan but linearizes
+each microbatch ONCE and evaluates the two transpose halves in different
+ticks: dx immediately (the ppermute chain consumes it), dW one tick
+later from the carried residuals — so the dW matmuls sit outside the
+recv→B→send dependency chain. Gradients must be exact; compiled temp
+memory must stay in the 1F1B class (far below fthenb's O(M) residuals).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.engine import _chunk_key, pipeline_forward
+
+
+def _stage(params, x):
+    w1, b1, w2, b2 = params
+    h = jax.nn.gelu(x @ w1 + b1)
+    return jnp.tanh(h @ w2 + b2) + x
+
+
+def _stoch_stage(params, x, key):
+    w1, b1, w2, b2 = params
+    keep = jax.random.bernoulli(key, 0.8, x.shape)
+    h = jax.nn.gelu(x @ w1 + b1)
+    return (jnp.tanh(h @ w2 + b2) + x) * keep
+
+
+def _setup(n_chunks=4, n_micro=8, mb=2, d=8, hidden=16, seed=0):
+    rng = np.random.default_rng(seed)
+    params = (
+        jnp.asarray(rng.normal(size=(n_chunks, d, hidden)) * 0.3, jnp.float32),
+        jnp.asarray(rng.normal(size=(n_chunks, hidden)) * 0.1, jnp.float32),
+        jnp.asarray(rng.normal(size=(n_chunks, hidden, d)) * 0.3, jnp.float32),
+        jnp.asarray(rng.normal(size=(n_chunks, d)) * 0.1, jnp.float32),
+    )
+    micro = jnp.asarray(rng.normal(size=(n_micro, mb, d)), jnp.float32)
+    return params, micro
+
+
+def _sequential(params, micro, base_key=None):
+    out = []
+    for m in range(micro.shape[0]):
+        x = micro[m]
+        for c in range(params[0].shape[0]):
+            p = tuple(a[c] for a in params)
+            if base_key is None:
+                x = _stage(p, x)
+            else:
+                x = _stoch_stage(p, x, _chunk_key(base_key, m, c))
+        out.append(x)
+    return jnp.stack(out)
+
+
+def test_zb_forward_matches_sequential():
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup()
+        out = jax.jit(lambda p, x: pipeline_forward(
+            _stage, p, x, schedule="zb"))(params, micro)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_sequential(params, micro)),
+                                   rtol=1e-5, atol=1e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_zb_grads_match_fthenb_and_oracle():
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup()
+        g = jnp.asarray(np.random.default_rng(5).normal(size=micro.shape),
+                        jnp.float32)
+
+        def loss(p, x, sched):
+            return jnp.sum(pipeline_forward(_stage, p, x,
+                                            schedule=sched) * g)
+
+        gz, gxz = jax.jit(jax.grad(lambda p, x: loss(p, x, "zb"),
+                                   argnums=(0, 1)))(params, micro)
+        g0, gx0 = jax.jit(jax.grad(lambda p, x: loss(p, x, "fthenb"),
+                                   argnums=(0, 1)))(params, micro)
+        gs, gxs = jax.grad(lambda p, x: jnp.sum(_sequential(p, x) * g),
+                           argnums=(0, 1))(params, micro)
+        for a, b in zip(jax.tree.leaves(gz), jax.tree.leaves(g0)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+        for a, b in zip(jax.tree.leaves(gz), jax.tree.leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gxz), np.asarray(gx0),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(gxz), np.asarray(gxs),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_zb_dropout_grads_match_sequential():
+    """The B tick's linearization and the W tick's deferred transpose
+    must replay the SAME per-(micro, chunk) dropout mask."""
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup(n_micro=6)
+        base = jax.random.key(11)
+        g = jnp.asarray(np.random.default_rng(7).normal(size=micro.shape),
+                        jnp.float32)
+
+        def loss_pipe(p):
+            return jnp.sum(pipeline_forward(_stoch_stage, p, micro,
+                                            rng_key=base,
+                                            schedule="zb") * g)
+
+        def loss_seq(p):
+            return jnp.sum(_sequential(p, micro, base) * g)
+
+        gp = jax.jit(jax.grad(loss_pipe))(params)
+        gs = jax.grad(loss_seq)(params)
+        for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_zb_rejects_vpp():
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup(n_chunks=8)
+        with pytest.raises(ValueError, match="vpp"):
+            pipeline_forward(_stage, params, micro, vpp_degree=2,
+                             schedule="zb")
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_zb_memory_in_1f1b_class():
+    """ZBH1's contract vs the schedule family (VERDICT round-4 item 5
+    asks for the memory_analysis comparison at M=8, S=4): temp memory
+    far below fthenb's O(M) residual sets, and within a small constant
+    of 1f1b (the extra carried (residuals, cotangent) slot — H1 keeps
+    1F1B-class memory, unlike ZB-V's 2x)."""
+    mesh_mod.init_mesh({"pp": 4, "dp": 2})
+    try:
+        params, micro = _setup(n_chunks=4, n_micro=8, mb=4, d=64, hidden=256)
+
+        def make_loss(sched):
+            def loss(p, x):
+                return jnp.sum(pipeline_forward(_stage, p, x,
+                                                schedule=sched) ** 2)
+            return jax.jit(jax.grad(loss))
+
+        sizes = {}
+        for sched in ("fthenb", "1f1b", "zb"):
+            compiled = make_loss(sched).lower(params, micro).compile()
+            ma = compiled.memory_analysis()
+            assert ma is not None, "memory_analysis unavailable"
+            sizes[sched] = int(ma.temp_size_in_bytes)
+        assert sizes["zb"] < 0.6 * sizes["fthenb"], sizes
+        assert sizes["zb"] < 2.0 * sizes["1f1b"], sizes
+    finally:
+        mesh_mod.reset_mesh()
