@@ -151,8 +151,40 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    strategy = strategy or get_strategy()
+    # dp-axis meta-optimizers wrap first (reference meta-optimizer
+    # resolution: dgc/localsgd apply to the data-parallel exchange)
+    if getattr(strategy, "dgc", False):
+        from ...optimizer import Momentum, SGD
+        # reference contract: the DGC meta-optimizer engages only for
+        # Momentum/SGD inner optimizers (its update rule IS momentum
+        # SGD); anything else keeps its own math rather than being
+        # silently replaced
+        lr = getattr(optimizer, "_learning_rate", 0.001)
+        if isinstance(optimizer, (Momentum, SGD)) and not callable(lr):
+            from .meta_optimizers import DGCMomentumOptimizer
+            cfg = dict(getattr(strategy, "dgc_configs", {}) or {})
+            optimizer = DGCMomentumOptimizer(
+                learning_rate=float(lr),
+                momentum=getattr(optimizer, "_momentum", 0.9),
+                parameters=optimizer._parameter_list,
+                rampup_begin_step=cfg.get("rampup_begin_step", 0),
+                rampup_step=cfg.get("rampup_step", 1),
+                sparsity=cfg.get("sparsity", [0.999]))
+        else:
+            import sys
+            print("fleet: strategy.dgc=True ignored — DGC applies to "
+                  "Momentum/SGD with a static learning rate; the inner "
+                  f"optimizer is {type(optimizer).__name__}",
+                  file=sys.stderr)
+    if getattr(strategy, "localsgd", False):
+        from .meta_optimizers import LocalSGDOptimizer
+        cfg = dict(getattr(strategy, "localsgd_configs", {}) or {})
+        optimizer = LocalSGDOptimizer(optimizer,
+                                      k_steps=cfg.get("k_steps", 1),
+                                      begin_step=cfg.get("begin_step", 1))
     return HybridParallelOptimizer(optimizer, get_hybrid_communicate_group(),
-                                   strategy or get_strategy())
+                                   strategy)
 
 
 # -- worker topology helpers (reference Fleet API) ---------------------------

@@ -1,0 +1,141 @@
+"""DGC + LocalSGD dp-axis meta-optimizers (reference:
+``fleet/meta_optimizers/dgc_optimizer.py`` / ``localsgd_optimizer.py``;
+VERDICT round-4 item 8). DGC's convergence-relevant math — momentum
+correction, residual accumulation, top-k selection, dense rampup — is
+checked against a NumPy oracle; the wire format is XLA's (dense masked
+allreduce), by design."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DGCMomentumOptimizer, LocalSGDOptimizer)
+
+
+def _param(shape, seed):
+    rng = np.random.default_rng(seed)
+    p = paddle.to_tensor(rng.normal(size=shape).astype("float32"))
+    p.stop_gradient = False
+    return p
+
+
+def _set_grad(p, g):
+    t = paddle.to_tensor(np.asarray(g, dtype="float32"))
+    p.grad = t
+
+
+def test_dgc_matches_numpy_oracle():
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=(20,)).astype(np.float32)
+    p = paddle.to_tensor(w0.copy())
+    p.stop_gradient = False
+    lr, mom, sparsity = 0.1, 0.9, 0.8      # keep top 20% of 20 -> 4
+    opt = DGCMomentumOptimizer(learning_rate=lr, momentum=mom,
+                               parameters=[p], rampup_begin_step=0,
+                               sparsity=[sparsity])
+
+    w = w0.copy()
+    u = np.zeros_like(w)
+    v = np.zeros_like(w)
+    vel = np.zeros_like(w)
+    for step in range(5):
+        g = rng.normal(size=w.shape).astype(np.float32)
+        _set_grad(p, g)
+        opt.step()
+        # oracle: momentum correction -> residual -> top-k -> SGD momentum
+        u = mom * u + g
+        v = v + u
+        keep_n = max(1, int(round((1 - sparsity) * w.size)))
+        thresh = np.sort(np.abs(v))[w.size - keep_n]
+        mask = np.abs(v) >= thresh
+        update = np.where(mask, v, 0.0)
+        v = np.where(mask, 0.0, v)
+        u = np.where(mask, 0.0, u)
+        vel = mom * vel + update
+        w = w - lr * vel
+        np.testing.assert_allclose(p.numpy(), w, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"step {step}")
+
+
+def test_dgc_rampup_is_dense():
+    """Before rampup_begin_step the exchange is DENSE (no compression,
+    no residual state) — reference rampup contract."""
+    p = _param((10,), 1)
+    opt = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                               parameters=[p], rampup_begin_step=2,
+                               sparsity=[0.9])
+    w_before = p.numpy().copy()
+    g = np.full(10, 0.5, np.float32)
+    _set_grad(p, g)
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), w_before - 0.5, rtol=1e-6)
+    assert not opt._v, "dense warmup must not accumulate residuals"
+
+
+def test_dgc_residual_eventually_transmits():
+    """A small-but-persistent gradient coordinate must eventually exceed
+    the top-k threshold through residual accumulation — THE property
+    that makes DGC converge."""
+    p = _param((8,), 2)
+    opt = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                               parameters=[p], sparsity=[0.875])  # top-1
+    w0 = p.numpy().copy()
+    # coordinate 0 large once; coordinate 7 small every step
+    for step in range(6):
+        g = np.zeros(8, np.float32)
+        g[0] = 1.0 if step == 0 else 0.0
+        g[7] = 0.3
+        _set_grad(p, g)
+        opt.step()
+    # after 6 steps the accumulated 0.3*k at coord 7 must have been
+    # selected at least once (1.8 total minus residual in flight)
+    moved = w0[7] - p.numpy()[7]
+    assert moved > 0.5, moved
+
+
+def test_localsgd_counts_and_averages(monkeypatch):
+    p = _param((4,), 3)
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    ls = LocalSGDOptimizer(inner, k_steps=3)
+
+    calls = []
+    monkeypatch.setattr(
+        "paddle_tpu.distributed.fleet.meta_optimizers._world_size",
+        lambda: 2)
+    from paddle_tpu.distributed import collective as coll
+    monkeypatch.setattr(coll, "all_reduce",
+                        lambda t, *a, **k: calls.append(1) or
+                        setattr(t, "_data", t._data * 2))  # sum of 2 equals
+    for step in range(7):
+        _set_grad(p, np.ones(4, np.float32))
+        ls.step()
+        inner.clear_grad()
+    # averaging at steps 3 and 6 only (1 param x 2 events)
+    assert len(calls) == 2, calls
+
+
+def test_fleet_strategy_wires_dgc_and_localsgd():
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8}
+    strategy.dgc = True
+    strategy.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.95]}
+    fleet.init(is_collective=True, strategy=strategy)
+    p = _param((6,), 4)
+    mopt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                     parameters=[p])
+    dopt = fleet.distributed_optimizer(mopt, strategy)
+    assert isinstance(dopt._inner_opt, DGCMomentumOptimizer)
+    _set_grad(p, np.ones(6, np.float32))
+    before = p.numpy().copy()
+    dopt.step()
+    assert not np.allclose(p.numpy(), before)
+
+    strategy2 = fleet.DistributedStrategy()
+    strategy2.localsgd = True
+    strategy2.localsgd_configs = {"k_steps": 4}
+    p2 = _param((6,), 5)
+    sopt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[p2])
+    dopt2 = fleet.distributed_optimizer(sopt, strategy2)
+    assert isinstance(dopt2._inner_opt, LocalSGDOptimizer)
+    assert dopt2._inner_opt._k == 4
