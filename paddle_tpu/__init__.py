@@ -172,6 +172,7 @@ class dtype(metaclass=_DTypeMeta):
 from .framework.dtype import bool_ as bool  # noqa: F401,E402,A001
 
 # star-import hygiene: everything public EXCEPT `bool` (rebinding the
-# caller's builtin bool to np.bool_ would break isinstance(x, bool))
+# caller's builtin bool to np.bool_ would break isinstance(x, bool)) and
+# `annotations` (the __future__ Feature object, not an API)
 __all__ = [_n for _n in dict(globals()) if not _n.startswith("_")
-           and _n != "bool"]
+           and _n not in ("bool", "annotations")]

@@ -323,10 +323,17 @@ class Tensor:
         return self._data.dtype.itemsize * int(self.size)
 
     def data_ptr(self):
-        """Opaque buffer identity (reference returns the device pointer;
-        jax.Array exposes no stable address — id() serves the common
-        'same storage?' comparisons)."""
-        return id(self._data)
+        """Opaque buffer identity (reference returns the device pointer).
+        Uses the device buffer's real address when the backend exposes it,
+        so two Tensor wrappers over ONE jax buffer compare equal and ids
+        recycled by GC can't alias; falls back to id() where the runtime
+        hides the pointer (meaningful only for same-object comparison
+        within a live scope there)."""
+        try:
+            return self._data.unsafe_buffer_pointer()
+        except (AttributeError, NotImplementedError, RuntimeError,
+                ValueError):   # ValueError: sharded/multi-device arrays
+            return id(self._data)
 
     def is_sparse(self):
         return False

@@ -132,10 +132,13 @@ class ShmQueue:
         self._partial = {}            # msg_id -> [n_seen, [chunks]]
 
     def put(self, obj, timeout=None):
+        import time as _time
         if not self._h:
             raise QueueClosed(self.name)
         blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        to_ms = -1 if timeout is None else int(timeout * 1000)
+        # `timeout` bounds the WHOLE message, not each chunk: track a
+        # deadline so an n-chunk put can't block n× the requested budget
+        deadline = None if timeout is None else _time.monotonic() + timeout
         payload = self._slot_bytes - self._HDR.size
         n_chunks = max(1, -(-len(blob) // payload))
         msg_id = (os.getpid() << 24) | (next(self._msg_counter) & 0xFFFFFF)
@@ -145,6 +148,10 @@ class ShmQueue:
             n = min(payload, len(blob) - off)
             if not self._h:
                 raise QueueClosed(self.name)
+            if deadline is None:
+                to_ms = -1
+            else:
+                to_ms = max(0, int((deadline - _time.monotonic()) * 1000))
             # two-part push: the C side copies blob[off:off+n] straight from
             # the pickle buffer — no per-chunk slice/concat of 64 MiB blobs
             rc = self._lib.shmq_pushv(self._h, hdr, len(hdr), blob, off, n,
@@ -181,6 +188,14 @@ class ShmQueue:
                 raise RuntimeError(
                     f"ShmQueue frame corruption on {self.name}")
             chunk = raw[self._HDR.size:]
+            # producers are sequential per process: a chunk of msg N from
+            # pid P means any incomplete older msg from P is abandoned
+            # (its put timed out mid-message) — evict, don't leak
+            pid, ctr = msg_id >> 24, msg_id & 0xFFFFFF
+            stale = [m for m in self._partial
+                     if m >> 24 == pid and (m & 0xFFFFFF) < ctr]
+            for m in stale:
+                del self._partial[m]
             if total == 1:
                 return pickle.loads(chunk)
             seen, chunks = self._partial.setdefault(

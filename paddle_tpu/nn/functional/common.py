@@ -605,9 +605,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     # through the pure-XLA tier dispatcher instead (flash-like memory:
     # per-chunk remat + causal kv-prefix trim, or the scan tiers per
     # PADDLE_TPU_XFA)
+    # trigger on EITHER the seq product (any single [sq, sk] logits plane
+    # at 4096^2 is flash territory regardless of b*h) OR total logits
+    # bytes (b=8, h=32, s=2048 is ~4.3 GB of fp32 logits with a tiny
+    # seq product)
+    n_logits = (query.shape[0] * query.shape[2]
+                * query.shape[1] * key.shape[1])
     if (attn_mask is None and (dropout_p == 0.0 or not training)
             and query.shape[1] > 1
-            and query.shape[1] * key.shape[1] >= 4096 * 4096):
+            and (query.shape[1] * key.shape[1] >= 4096 * 4096
+                 or n_logits * 4 >= 1 << 30)):   # >= 1 GiB of fp32 logits
         from ...ops.pallas.flash_attention import xla_attention
 
         def chunked_fn(q, k, v):
@@ -780,12 +787,16 @@ def sparse_attention(query, key, value, sparse_csr_offset=None,
     elif sparse_csr_offset is not None and sparse_csr_columns is not None:
         offs = _np(sparse_csr_offset).reshape(b, h, s + 1).astype(np.int64)
         cols = _np(sparse_csr_columns).reshape(b, h, -1).astype(np.int64)
+        # vectorized CSR expansion: nnz entry j of (bi, hi) belongs to the
+        # row whose offset range contains j
         allowed = np.zeros((b, h, s, s), bool)
-        for bi in range(b):
-            for hi in range(h):
-                for row in range(s):
-                    lo, hi_ = offs[bi, hi, row], offs[bi, hi, row + 1]
-                    allowed[bi, hi, row, cols[bi, hi, lo:hi_]] = True
+        nnz = cols.shape[-1]
+        j = np.arange(nnz)
+        # rows[bi, hi, j] = searchsorted(offs[bi, hi], j, side='right') - 1
+        rows = (offs[..., None, 1:-1] <= j[:, None]).sum(-1)  # [B,H,nnz]
+        valid = j < offs[..., -1:]                            # inside nnz
+        bi, hi, ji = np.nonzero(valid)
+        allowed[bi, hi, rows[bi, hi, ji], cols[bi, hi, ji]] = True
     else:
         raise ValueError("sparse_attention needs sparse_mask or CSR "
                          "offset+columns")
@@ -798,6 +809,11 @@ def sparse_attention(query, key, value, sparse_csr_offset=None,
         add = jnp.asarray(_np(attn_mask), jnp.float32)
     allowed_j = jnp.asarray(allowed)
 
+    # a row with NO allowed keys (empty CSR row, or key_padding_mask
+    # masking every key) must output zero, not a uniform average over all
+    # keys — the -1e30 fill alone would softmax to uniform
+    dead_row = jnp.asarray(~allowed.any(-1))          # [B, H, S]
+
     def fn(q, k, v):
         lg = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) / (q.shape[-1] ** 0.5)
@@ -805,6 +821,7 @@ def sparse_attention(query, key, value, sparse_csr_offset=None,
             lg = lg + add
         lg = jnp.where(allowed_j, lg, -1e30)
         w = jax.nn.softmax(lg, axis=-1)
+        w = jnp.where(dead_row[..., None], 0.0, w)
         return jnp.einsum("bhqk,bhkd->bhqd", w,
                           v.astype(jnp.float32)).astype(q.dtype)
 

@@ -273,6 +273,18 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
                                     else r) for r in res)
         return host
 
+    # reference contract: backward_func is called with (forward inputs,
+    # forward OUTPUTS, output grads), with any var listed in
+    # skip_vars_in_backward_input dropped from the first two groups
+    # (matched by identity against ``x``/``out``); it returns the grads
+    # of the (unfiltered) forward inputs.
+    skip = (list(skip_vars_in_backward_input)
+            if skip_vars_in_backward_input is not None else [])
+    keep_x = [i for i, v in enumerate(xs)
+              if not any(v is s for s in skip)]
+    keep_out = [i for i, v in enumerate(outs)
+                if not any(v is s for s in skip)]
+
     def fn(*arrs):
         if backward_func is None:
             # gradient-opaque host call: stop_gradient-ing the callback
@@ -289,14 +301,18 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
             return res if len(res) > 1 else res[0]
 
         def fwd(*a):
-            return call(*a), a
+            y = call(*a)
+            ys = y if isinstance(y, tuple) else (y,)
+            return y, (a, ys)
 
         def bwd(resids, g):
+            a, ys = resids
             gs = tuple(g) if isinstance(g, tuple) else (g,)
-            in_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
-                              for a in resids)
-            res = jax.pure_callback(_host(backward_func), in_shapes,
-                                    *resids, *gs)
+            args = ([a[i] for i in keep_x] + [ys[i] for i in keep_out]
+                    + list(gs))
+            in_shapes = tuple(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                              for v in a)
+            res = jax.pure_callback(_host(backward_func), in_shapes, *args)
             return tuple(res)
 
         call.defvjp(fwd, bwd)

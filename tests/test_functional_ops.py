@@ -329,11 +329,25 @@ def test_round4_static_and_incubate_api():
     y0.sum().backward()   # must not raise; grads are zero
     np.testing.assert_allclose(xa.grad.numpy(), np.zeros(4))
 
-    def host_bwd(inp, g):
-        return paddle.to_tensor(g.numpy() * 3.0)
+    # reference contract: backward_func(inputs, OUTPUTS, out_grads)
+    def host_bwd(inp, out, g):
+        return paddle.to_tensor(g.numpy() * 3.0 + 0.0 * out.numpy())
 
     xb = paddle.to_tensor(np.arange(4, dtype="float32"))
     xb.stop_gradient = False
     y1 = static.py_func(host_fn, xb, out_t, backward_func=host_bwd)
     y1.sum().backward()
     np.testing.assert_allclose(xb.grad.numpy(), np.full(4, 3.0))
+
+    # skip_vars_in_backward_input drops the named var from the callback
+    # args (here: the forward output — backward sees (input, grad) only)
+    def host_bwd_skip(inp, g):
+        return paddle.to_tensor(g.numpy() * inp.numpy())
+
+    xc = paddle.to_tensor(np.arange(4, dtype="float32"))
+    xc.stop_gradient = False
+    yc = static.py_func(host_fn, xc, out_t, backward_func=host_bwd_skip,
+                        skip_vars_in_backward_input=[out_t])
+    yc.sum().backward()
+    np.testing.assert_allclose(xc.grad.numpy(),
+                               np.arange(4, dtype="float32"))
