@@ -88,8 +88,13 @@ run_one resnet_loader    1200 BENCH_MODEL=resnet BENCH_DATA=loader
 run_one dispatch         1200 BENCH_MODEL=dispatch
 
 # Phase B: MFU sweep at the 1b preset, plain attention, highest-expected-
-# MFU configs first (playbook: accum = no-remat arithmetic at microbatch
-# memory; dots policy saves projections; full remat pays +33% FLOPs).
+# MFU configs first (playbook: bf16 state frees ~6.6 GB for no-remat
+# arithmetic; accum = no-remat arithmetic at microbatch memory; dots
+# policy saves projections; full remat pays +33% FLOPs).
+sweep_one "1b b8 s2048 norem bf16state"   BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=0 BENCH_PARAM_DTYPE=bf16 FLAGS_use_flash_attention=0
+sweep_one "1b b16 s2048 norem bf16state"  BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=0 BENCH_PARAM_DTYPE=bf16 FLAGS_use_flash_attention=0
+sweep_one "1b b16 s2048 accum2 bf16state" BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=0 BENCH_ACCUM=2 BENCH_PARAM_DTYPE=bf16 FLAGS_use_flash_attention=0
+sweep_one "1b b32 s2048 accum4 bf16state" BENCH_PRESET=1b BENCH_BATCH=32 BENCH_SEQ=2048 BENCH_REMAT=0 BENCH_ACCUM=4 BENCH_PARAM_DTYPE=bf16 FLAGS_use_flash_attention=0
 sweep_one "1b b8 s2048 norem accum2"  BENCH_PRESET=1b BENCH_BATCH=8 BENCH_SEQ=2048 BENCH_REMAT=0 BENCH_ACCUM=2 FLAGS_use_flash_attention=0
 sweep_one "1b b16 s2048 norem accum4" BENCH_PRESET=1b BENCH_BATCH=16 BENCH_SEQ=2048 BENCH_REMAT=0 BENCH_ACCUM=4 FLAGS_use_flash_attention=0
 sweep_one "1b b4 s2048 dots plain"    BENCH_PRESET=1b BENCH_BATCH=4 BENCH_SEQ=2048 BENCH_REMAT=dots FLAGS_use_flash_attention=0
