@@ -1,0 +1,18 @@
+"""Child script: the config-5-shaped FIVE-axis composition — dp=2, pp=2,
+sharding=2, sep=2, mp=2 ALL >1 in one jitted program on 32 virtual CPU
+devices (SURVEY.md §2.4 config 5 / §3.4; VERDICT round-4 weak #7: sep
+was never >1 together with the rest). Delegates to the shared
+multi-axis parity harness in ``__graft_entry__._config4_impl`` (same
+oracle, parity, and structural sharding assertions — sep shards the
+microbatch sequence dim)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import _config4_impl
+
+if __name__ == "__main__":
+    _config4_impl(degrees={"dp": 2, "pp": 2, "sharding": 2, "sep": 2,
+                           "mp": 2},
+                  seq=32, seed=5, label="config5")

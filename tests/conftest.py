@@ -42,7 +42,7 @@ def _seed_all():
 
 _SLOW_FILES = {
     "test_bert_to_static.py", "test_config4_16dev.py",
-    "test_detection_ops.py",
+    "test_config5_32dev.py", "test_detection_ops.py",
     "test_continuous_batching.py", "test_distributed.py",
     "test_distribution.py", "test_fft_signal_vision_ops.py",
     "test_functional_ops.py", "test_fused_multi_transformer.py",

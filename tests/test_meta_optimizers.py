@@ -115,27 +115,34 @@ def test_localsgd_counts_and_averages(monkeypatch):
 
 
 def test_fleet_strategy_wires_dgc_and_localsgd():
-    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed import fleet, mesh as mesh_mod
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 8}
     strategy.dgc = True
     strategy.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.95]}
     fleet.init(is_collective=True, strategy=strategy)
-    p = _param((6,), 4)
-    mopt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
-                                     parameters=[p])
-    dopt = fleet.distributed_optimizer(mopt, strategy)
-    assert isinstance(dopt._inner_opt, DGCMomentumOptimizer)
-    _set_grad(p, np.ones(6, np.float32))
-    before = p.numpy().copy()
-    dopt.step()
-    assert not np.allclose(p.numpy(), before)
+    try:
+        p = _param((6,), 4)
+        mopt = paddle.optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                                         parameters=[p])
+        dopt = fleet.distributed_optimizer(mopt, strategy)
+        assert isinstance(dopt._inner_opt, DGCMomentumOptimizer)
+        _set_grad(p, np.ones(6, np.float32))
+        before = p.numpy().copy()
+        dopt.step()
+        assert not np.allclose(p.numpy(), before)
 
-    strategy2 = fleet.DistributedStrategy()
-    strategy2.localsgd = True
-    strategy2.localsgd_configs = {"k_steps": 4}
-    p2 = _param((6,), 5)
-    sopt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[p2])
-    dopt2 = fleet.distributed_optimizer(sopt, strategy2)
-    assert isinstance(dopt2._inner_opt, LocalSGDOptimizer)
-    assert dopt2._inner_opt._k == 4
+        strategy2 = fleet.DistributedStrategy()
+        strategy2.localsgd = True
+        strategy2.localsgd_configs = {"k_steps": 4}
+        p2 = _param((6,), 5)
+        sopt = paddle.optimizer.SGD(learning_rate=0.01, parameters=[p2])
+        dopt2 = fleet.distributed_optimizer(sopt, strategy2)
+        assert isinstance(dopt2._inner_opt, LocalSGDOptimizer)
+        assert dopt2._inner_opt._k == 4
+    finally:
+        # neither the installed dp=8 mesh nor the dgc=True module-global
+        # strategy may leak into later test files (test_models; any test
+        # calling distributed_optimizer without its own fleet.init)
+        mesh_mod.reset_mesh()
+        fleet._strategy = None

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Summarize an MFU sweep JSONL (BENCH_SWEEP_R*.jsonl): one line per
+config sorted by MFU, plus the winner in BASELINE.md-ready form.
+
+Usage: python tools/summarize_sweep.py [sweep.jsonl]
+"""
+import json
+import sys
+
+
+def main(path="/root/repo/BENCH_SWEEP_R5.jsonl"):
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                r = row.get("result", {})
+                rows.append((row.get("config", "?"), r))
+    except FileNotFoundError:
+        print(f"no sweep file at {path}")
+        return 1
+    scored = []
+    for cfg, r in rows:
+        if r.get("value") is None:
+            scored.append((None, cfg, r.get("error", "no value")[:80]))
+        else:
+            scored.append((r.get("mfu_pct"), cfg,
+                           f"{r['value']:.0f} tok/s  mfu={r.get('mfu_pct')}%"
+                           f"  chip={r.get('chip', r.get('backend'))}"))
+    scored.sort(key=lambda t: (t[0] is None, -(t[0] or 0)))
+    for mfu, cfg, desc in scored:
+        print(f"{cfg:38s} {desc}")
+    winners = [t for t in scored if t[0] is not None]
+    if winners:
+        mfu, cfg, desc = winners[0]
+        print(f"\nWINNER: {cfg} -> {desc}")
+        if mfu >= 35:
+            print("north-star gate: >=35% MFU MET")
+        else:
+            print(f"north-star gate: {mfu}% < 35% — keep tuning")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:]))
