@@ -151,6 +151,8 @@ class FunctionalModule:
         named = [(n, p) for n, p in self.layer.named_parameters()
                  if p is not None]
         assert [id(p) for _, p in named] == [id(p) for p in self.params]
+        from ..distributed import mesh as mesh_mod
+        live = mesh_mod.has_mesh()
         specs = []
         for name, p in named:
             spec = ()
@@ -159,6 +161,15 @@ class FunctionalModule:
                     spec = tuple(s)
                     break
             spec = list(spec) + [None] * (len(p.shape) - len(spec))
+            if live:
+                # a rule axis that does not divide the dim would fail at
+                # device_put (e.g. 4 experts over a dp=8 ep axis): such a
+                # param replicates on that axis instead
+                for d, ax in enumerate(spec):
+                    if ax is not None:
+                        n_ax = mesh_mod.axis_size(ax)
+                        if n_ax > 1 and p.shape[d] % n_ax != 0:
+                            spec[d] = None
             if fsdp_axis is not None and fsdp_size > 1 and len(p.shape) >= 2:
                 for d, (sz, ax) in enumerate(zip(p.shape, spec)):
                     if ax is None and sz % fsdp_size == 0 and sz >= fsdp_size:

@@ -32,7 +32,58 @@ from .....nn.initializer import XavierUniform
 from ..... import flags  # noqa: F401
 from .....distributed import mesh as mesh_mod
 
-__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ExpertFFN"]
+__all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ExpertFFN",
+           "plan_dispatch", "dispatch_combine"]
+
+
+def plan_dispatch(logits, capacity, top_k):
+    """GShard dispatch plan (pure jnp, static shapes): router logits
+    [S, E] → (softmax probs [S, E], dispatch one-hot [S, E, C], combine
+    weights [S, E, C]). Shared by :class:`MoELayer` and the model-zoo
+    sparse blocks (models/mixtral.py) so the routing math lives once."""
+    s, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    _, top_idx = jax.lax.top_k(probs, top_k)             # [S, k]
+    # one-hot per choice: [k, S, E]
+    choice = jax.nn.one_hot(top_idx.T, e, dtype=jnp.float32)
+    # position of each (choice, token) within its expert queue — cumsum
+    # ordered by choice rank then token index (reference: gshard ordering)
+    flat = choice.reshape(-1, e)                          # [k*S, E]
+    pos = jnp.cumsum(flat, axis=0) - flat                 # rank in queue
+    pos = jnp.sum(pos * flat, axis=-1)                    # [k*S]
+    keep = (pos < capacity) & (jnp.sum(flat, -1) > 0)
+    pos = pos.astype(jnp.int32)
+    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity,
+                            dtype=jnp.float32)            # [k*S, C]
+    disp = flat[:, :, None] * pos_oh[:, None, :]          # [k*S, E, C]
+    disp = disp.reshape(top_k, s, e, capacity).sum(0)
+    gate_w = jnp.sum(choice.reshape(top_k, s, e) *
+                     probs[None], axis=-1)                # [k, S]
+    # per-token weight to each chosen expert (top-k indices are distinct,
+    # so summing over k is exact), normalized over the token's top-k
+    w = jnp.einsum("ks,kse->se", gate_w,
+                   choice.reshape(top_k, s, e))           # [S, E]
+    w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-9)
+    combine = disp * w[:, :, None]
+    return probs, disp, combine
+
+
+def dispatch_combine(tok, logits, capacity, top_k, expert_fn, ep_axis=None,
+                     tracer_ref=None):
+    """Full MoE data path around :func:`plan_dispatch`: tokens [S, d] →
+    expert batches [E, C, d] (EP-constrained over ``ep_axis`` when given
+    and tracing) → ``expert_fn`` → combined output [S, d]. Returns
+    ``(out, probs, dispatched_frac)`` so callers derive their own aux
+    loss. Shared by :class:`MoELayer` and models/mixtral.py."""
+    probs, disp, combine = plan_dispatch(logits, capacity, top_k)
+    expert_in = jnp.einsum("sec,sd->ecd", disp, tok)
+    if ep_axis and isinstance(tracer_ref, jax.core.Tracer):
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, mesh_mod.sharding(ep_axis, None, None))
+    expert_out = expert_fn(expert_in)
+    out = jnp.einsum("sec,ecd->sd", combine, expert_out)
+    frac = jnp.mean(disp.sum(-1), axis=0)               # [E]
+    return out, probs, frac
 
 
 # ---------------------------------------------------------------------------
@@ -169,31 +220,7 @@ class MoELayer(Layer):
     # -- dispatch plan (pure jnp; shapes static) ----------------------------
     def _plan(self, logits, capacity):
         """logits [S, E] → dispatch [S, E, C] one-hot, combine [S, E, C]."""
-        s, e = logits.shape
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        _, top_idx = jax.lax.top_k(probs, self.top_k)        # [S, k]
-        # one-hot per choice: [k, S, E]
-        choice = jax.nn.one_hot(top_idx.T, e, dtype=jnp.float32)
-        # position of each (choice, token) within its expert queue — cumsum
-        # ordered by choice rank then token index (reference: gshard ordering)
-        flat = choice.reshape(-1, e)                          # [k*S, E]
-        pos = jnp.cumsum(flat, axis=0) - flat                 # rank in queue
-        pos = jnp.sum(pos * flat, axis=-1)                    # [k*S]
-        keep = (pos < capacity) & (jnp.sum(flat, -1) > 0)
-        pos = pos.astype(jnp.int32)
-        pos_oh = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity,
-                                dtype=jnp.float32)            # [k*S, C]
-        disp = flat[:, :, None] * pos_oh[:, None, :]          # [k*S, E, C]
-        disp = disp.reshape(self.top_k, s, e, capacity).sum(0)
-        gate_w = jnp.sum(choice.reshape(self.top_k, s, e) *
-                         probs[None], axis=-1)                # [k, S]
-        # per-token weight to each chosen expert (top-k indices are distinct,
-        # so summing over k is exact), normalized over the token's top-k
-        w = jnp.einsum("ks,kse->se", gate_w,
-                       choice.reshape(self.top_k, s, e))      # [S, E]
-        w = w / jnp.maximum(w.sum(-1, keepdims=True), 1e-9)
-        combine = disp * w[:, :, None]
-        return probs, disp, combine
+        return plan_dispatch(logits, capacity, self.top_k)
 
     def forward(self, x):
         orig_shape = x.shape
@@ -213,15 +240,10 @@ class MoELayer(Layer):
             def fn(xa, gw, w1, b1, w2, b2):
                 tok = xa.reshape(s, d)
                 logits = tok.astype(jnp.float32) @ gw.astype(jnp.float32)
-                probs, disp, combine = self._plan(logits, capacity)
-                expert_in = jnp.einsum("sec,sd->ecd", disp, tok)
-                if ep:
-                    expert_in = jax.lax.with_sharding_constraint(
-                        expert_in, mesh_mod.sharding(ep, None, None)) \
-                        if isinstance(xa, jax.core.Tracer) else expert_in
-                expert_out = f.forward_arrays(expert_in, w1, b1, w2, b2)
-                out = jnp.einsum("sec,ecd->sd", combine, expert_out)
-                frac = jnp.mean(disp.sum(-1), axis=0)        # [E] dispatched frac
+                out, probs, frac = dispatch_combine(
+                    tok, logits, capacity, self.top_k,
+                    lambda ein: f.forward_arrays(ein, w1, b1, w2, b2),
+                    ep_axis=ep, tracer_ref=xa)
                 aux = self.gate.aux_loss(probs, frac)
                 return (out.reshape(orig_shape).astype(xa.dtype),
                         (aux if aux is not None else jnp.zeros((), jnp.float32)))

@@ -21,11 +21,15 @@ from .bert import (BertConfig, BertModel, BertForSequenceClassification,
                    ErnieForSequenceClassification, bert_base, bert_tiny)
 from .ppyoloe import (PPYOLOE, DetectionLoss, ppyoloe_lite, CSPBackbone,
                       FPNNeck, ETHead)
+from .mixtral import (MixtralConfig, MixtralModel, MixtralForCausalLM,
+                      MixtralSparseMoeBlock, mixtral_8x7b, mixtral_tiny)
 
 __all__ = [
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
     "LlamaPretrainingCriterion", "LlamaForCausalLMPipe",
     "build_llama_pipe", "llama3_8b", "llama_tiny",
+    "MixtralConfig", "MixtralModel", "MixtralForCausalLM",
+    "MixtralSparseMoeBlock", "mixtral_8x7b", "mixtral_tiny",
     "T5Config", "T5ForConditionalGeneration", "t5_tiny",
     "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTForCausalLMPipe",
     "gpt3_1p3b", "gpt_tiny",

@@ -1,0 +1,245 @@
+"""Mixtral model family — sparse-MoE decoder LM (reference behavior:
+PaddleNLP ``mixtral/modeling.py`` — Llama-style attention/RMSNorm/RoPE
+with the dense SwiGLU MLP replaced by a top-k routed mixture of SwiGLU
+experts + router load-balancing aux loss).
+
+TPU-first design: same philosophy as models/llama.py — plain eager
+layers, parallelism via ``sharding_rules()`` name→PartitionSpec maps.
+The sparse block reuses the GShard dispatch plan from
+``incubate.distributed.models.moe`` (one-hot dispatch/combine einsums,
+static capacity) with STACKED expert weights ``[E, h, m]`` so the
+per-expert matmuls stay batched on the MXU, and the expert dim is
+EP-shardable over the mesh (XLA lowers the expert resharding to the
+all-to-all the reference implements with global_scatter/gather)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer, LayerList
+from ..nn.layers.common import Linear, Embedding
+from ..nn.layers.norm import RMSNorm
+from ..nn.initializer import Normal, XavierUniform
+from ..ops import math as pmath
+from ..autograd.tape import apply
+from .generation import GenerationMixin
+from .llama import (LlamaAttention, LlamaConfig, LlamaPretrainingCriterion,
+                    shard_activation)
+
+
+class MixtralConfig(LlamaConfig):
+    def __init__(self, num_local_experts=8, num_experts_per_tok=2,
+                 router_aux_loss_coef=0.02, moe_capacity_factor=2.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.num_local_experts = num_local_experts
+        self.num_experts_per_tok = num_experts_per_tok
+        self.router_aux_loss_coef = router_aux_loss_coef
+        self.moe_capacity_factor = moe_capacity_factor
+
+
+def mixtral_8x7b(**kw):
+    """Mixtral-8x7B shape (46.7B total / 12.9B active params)."""
+    kw.setdefault("vocab_size", 32000)
+    kw.setdefault("hidden_size", 4096)
+    kw.setdefault("intermediate_size", 14336)
+    kw.setdefault("num_hidden_layers", 32)
+    kw.setdefault("num_attention_heads", 32)
+    kw.setdefault("num_key_value_heads", 8)
+    kw.setdefault("max_position_embeddings", 32768)
+    kw.setdefault("rope_theta", 1e6)
+    return MixtralConfig(**kw)
+
+
+def mixtral_tiny(**kw):
+    """CI-sized config exercising routing + GQA + RoPE + SwiGLU experts."""
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 96)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("num_key_value_heads", 2)
+    kw.setdefault("max_position_embeddings", 128)
+    kw.setdefault("num_local_experts", 4)
+    return MixtralConfig(**kw)
+
+
+class MixtralSparseMoeBlock(Layer):
+    """Top-k routed SwiGLU experts with stacked weights [E, h, m]/[E, m, h].
+
+    Dispatch is the shared GShard data path (``moe.dispatch_combine``):
+    static capacity ``C = ceil(S · cap_factor · k / E)``, overflow
+    tokens keep their residual path only (combine weight 0) — the
+    TPU-native static-shape form of the reference's per-token gather.
+    ``forward`` RETURNS ``(out, aux)`` — the router load-balance aux
+    loss (switch-style ``E · Σ mean(P_e)·frac_e`` scaled by
+    ``router_aux_loss_coef``) must ride the return value so it crosses
+    the ``jax.checkpoint`` boundary under ``use_recompute`` (a
+    ``self.aux_loss`` side-channel would leak an inner-trace tracer);
+    the attribute is still set for eager standalone inspection."""
+
+    def __init__(self, config):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        e = config.num_local_experts
+        self.num_experts = e
+        self.top_k = config.num_experts_per_tok
+        self.capacity_factor = config.moe_capacity_factor
+        self.aux_coef = config.router_aux_loss_coef
+        self.gate = Linear(h, e, weight_attr=Normal(
+            0.0, config.initializer_range), bias_attr=False)
+        self.w_gate = self.create_parameter(
+            [e, h, m], default_initializer=XavierUniform())
+        self.w_up = self.create_parameter(
+            [e, h, m], default_initializer=XavierUniform())
+        self.w_down = self.create_parameter(
+            [e, m, h], default_initializer=XavierUniform())
+        self.aux_loss = None
+
+    def forward(self, x):
+        from ..incubate.distributed.models.moe import dispatch_combine
+        from ..distributed import mesh as mesh_mod
+
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        s = 1
+        for n in orig_shape[:-1]:
+            s *= n
+        e, k = self.num_experts, self.top_k
+        capacity = max(1, math.ceil(s * self.capacity_factor * k / e))
+        # EP only when the expert count actually divides the axis-shard
+        # product (4 experts on a dp=8 mesh must replicate, not crash)
+        dp = mesh_mod.axis_size("dp") if mesh_mod.has_mesh() else 1
+        ep = "dp" if dp > 1 and e % dp == 0 else None
+
+        def fn(xa, gw, wg, wu, wd):
+            tok = xa.reshape(s, d)
+            logits = tok.astype(jnp.float32) @ gw.astype(jnp.float32)
+
+            def experts(ein):                      # [E, C, h] -> [E, C, h]
+                hidd = jax.nn.silu(
+                    jnp.einsum("ecd,edm->ecm", ein, wg)) \
+                    * jnp.einsum("ecd,edm->ecm", ein, wu)
+                return jnp.einsum("ecm,emd->ecd", hidd, wd)
+
+            out, probs, frac = dispatch_combine(tok, logits, capacity, k,
+                                                experts, ep_axis=ep,
+                                                tracer_ref=xa)
+            aux = self.aux_coef * e * jnp.sum(
+                jnp.mean(probs, axis=0) * frac)
+            return (out.reshape(orig_shape).astype(xa.dtype), aux)
+
+        out, aux = apply(fn, x, self.gate.weight, self.w_gate, self.w_up,
+                         self.w_down, op_name="mixtral_moe")
+        self.aux_loss = aux
+        return out, aux
+
+
+class MixtralDecoderLayer(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.block_sparse_moe = MixtralSparseMoeBlock(config)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       config.rms_norm_eps)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                config.rms_norm_eps)
+
+    def forward(self, hidden, attn_mask=None, position_ids=None, cache=None):
+        hidden = hidden + self.self_attn(self.input_layernorm(hidden),
+                                         attn_mask, position_ids, cache)
+        moe_out, aux = self.block_sparse_moe(
+            self.post_attention_layernorm(hidden))
+        return hidden + moe_out, aux
+
+
+class MixtralModel(Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=Normal(0.0, config.initializer_range))
+        self.layers = LayerList(
+            [MixtralDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                cache=None):
+        hidden = self.embed_tokens(input_ids)
+        hidden = shard_activation(hidden)
+        recompute = (self.config.use_recompute and self.training
+                     and cache is None)
+        if recompute:
+            from ..distributed.fleet.utils import recompute as remat
+        auxes = []
+        for layer in self.layers:
+            if recompute:
+                # the aux loss crosses the jax.checkpoint boundary as a
+                # RETURN value — outer-trace legal, differentiable
+                hidden, aux = remat(layer, hidden, attn_mask, position_ids)
+            else:
+                hidden, aux = layer(hidden, attn_mask, position_ids, cache)
+            auxes.append(aux)
+            hidden = shard_activation(hidden)
+        self._aux_losses = auxes
+        hidden = self.norm(hidden)
+        if cache is not None:
+            cache.advance(input_ids.shape[1])
+        return hidden
+
+    def aux_losses(self):
+        """Per-layer router aux losses of the LAST forward (values
+        returned through any recompute boundary, not attribute
+        side-channels)."""
+        return list(getattr(self, "_aux_losses", []))
+
+
+class MixtralForCausalLM(GenerationMixin, Layer):
+    supports_cache = True
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.mixtral = MixtralModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=Normal(0.0, config.initializer_range),
+                bias_attr=False)
+        self.criterion = LlamaPretrainingCriterion()
+
+    def forward(self, input_ids, labels=None, attn_mask=None,
+                position_ids=None, cache=None):
+        hidden = self.mixtral(input_ids, attn_mask, position_ids, cache)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = pmath.matmul(hidden, self.mixtral.embed_tokens.weight,
+                                  transpose_y=True)
+        if labels is None:
+            return logits
+        loss = self.criterion(logits, labels)
+        for aux in self.mixtral.aux_losses():
+            loss = loss + aux
+        return loss, logits
+
+    @staticmethod
+    def sharding_rules():
+        """Llama rules + the stacked expert weights sharded over the ep
+        axis ('dp' — the reference's default ep group) on dim 0; router
+        gates replicated."""
+        mp = "mp"
+        return [
+            (r"embed_tokens\.weight$", (mp, None)),
+            (r"(q_proj|k_proj|v_proj)\.weight$", (None, mp)),
+            (r"o_proj\.weight$", (mp, None)),
+            (r"lm_head\.weight$", (None, mp)),
+            (r"(w_gate|w_up|w_down)$", ("dp", None, None)),
+            (r".*", ()),   # norms, routers etc. replicated
+        ]

@@ -48,7 +48,8 @@ _SLOW_FILES = {
     "test_functional_ops.py", "test_fused_multi_transformer.py",
     "test_generation.py", "test_guarded_compile.py", "test_hf_pretrained.py",
     "test_hybrid_3d.py", "test_io_vision.py", "test_launch_multiproc.py",
-    "test_llama_context_parallel.py", "test_models.py", "test_moe.py",
+    "test_llama_context_parallel.py", "test_mixtral.py",
+    "test_models.py", "test_moe.py",
     "test_nn.py", "test_nn_extras.py", "test_op_suite.py",
     "test_op_surface_r3.py", "test_paged_attention.py",
     "test_pallas_flash.py", "test_pipeline_1f1b.py",

@@ -1,0 +1,191 @@
+"""Mixtral sparse-MoE LM family (reference behavior: PaddleNLP
+``mixtral/modeling.py`` — top-k routed SwiGLU experts + router
+load-balance aux loss on a Llama-style trunk). The sparse block reuses
+the shared GShard dispatch plan; parity is checked against a per-token
+dense-routing oracle at over-provisioned capacity (no drops)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (MixtralConfig, MixtralForCausalLM,
+                               MixtralSparseMoeBlock, mixtral_tiny)
+
+
+def test_moe_block_matches_dense_routing_oracle():
+    """At capacity >= S·k/E every routed token is kept, so the einsum
+    dispatch must equal the naive per-token top-k mixture."""
+    paddle.seed(0)
+    cfg = mixtral_tiny(moe_capacity_factor=8.0)    # over-provisioned
+    blk = MixtralSparseMoeBlock(cfg)
+    blk.eval()
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(2, 6, cfg.hidden_size))
+                         .astype("float32"))
+    out, _aux = blk(x)
+    out = out.numpy()
+
+    gw = blk.gate.weight.numpy()
+    wg, wu, wd = (blk.w_gate.numpy(), blk.w_up.numpy(), blk.w_down.numpy())
+    tok = x.numpy().reshape(-1, cfg.hidden_size)
+    probs = np.asarray(jax.nn.softmax(tok @ gw, axis=-1))
+    want = np.zeros_like(tok)
+    for i, t in enumerate(tok):
+        top = np.argsort(-probs[i])[:cfg.num_experts_per_tok]
+        w = probs[i, top] / probs[i, top].sum()
+        for ww, e in zip(w, top):
+            h = (np.asarray(jax.nn.silu(t @ wg[e]))) * (t @ wu[e])
+            want[i] += ww * (h @ wd[e])
+    np.testing.assert_allclose(out.reshape(-1, cfg.hidden_size), want,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_mixtral_train_step_decreases_loss_with_aux():
+    paddle.seed(1)
+    cfg = mixtral_tiny()
+    model = MixtralForCausalLM(cfg)
+    model.train()
+    opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                 parameters=model.parameters())
+    rng = np.random.default_rng(1)
+    ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (2, 16))
+                           .astype("int32"))
+    labels = paddle.to_tensor(rng.integers(0, cfg.vocab_size, (2, 16))
+                              .astype("int32"))
+    losses = []
+    for _ in range(8):
+        loss, _ = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    # the aux loss is real and participates: every layer produced one
+    auxes = model.mixtral.aux_losses()
+    assert len(auxes) == cfg.num_hidden_layers
+    assert all(float(a.numpy() if hasattr(a, "numpy") else a) >= 0
+               for a in auxes)
+
+
+def test_mixtral_recompute_trains_with_aux_grads():
+    """use_recompute: the aux loss must cross the jax.checkpoint
+    boundary as a RETURN value (a side-channel attribute would leak an
+    inner-trace tracer) and the router must still receive gradient."""
+    from paddle_tpu.framework.functional import FunctionalModule
+
+    paddle.seed(4)
+    cfg = mixtral_tiny(use_recompute=True)
+    model = MixtralForCausalLM(cfg)
+    model.train()
+    fm = FunctionalModule(model, training=True)
+    rng = np.random.default_rng(4)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)),
+                         jnp.int32)
+    key = fm.next_key()
+
+    def loss_fn(ps):
+        (loss, _), _ = fm(ps, [], key, ids, labels=labels)
+        return loss
+
+    loss, grads = jax.jit(jax.value_and_grad(loss_fn))(fm.param_arrays())
+    assert np.isfinite(float(loss))
+    # router (gate) weights get non-zero gradient through the aux loss
+    gate_idx = [i for i, (n, p) in enumerate(
+        (n, p) for n, p in model.named_parameters() if p is not None)
+        if "gate.weight" in n]
+    assert gate_idx, "no router gate params found"
+    assert any(float(jnp.abs(grads[i]).sum()) > 0 for i in gate_idx), \
+        "router received zero gradient under recompute"
+
+
+def test_mixtral_ep_nondivisible_replicates():
+    """4 experts on a dp=8 mesh must replicate (not crash) — param_specs
+    drops non-divisible rule axes and the block skips the EP constraint."""
+    from jax.sharding import NamedSharding
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.framework.functional import FunctionalModule
+
+    paddle.seed(5)
+    cfg = mixtral_tiny(num_local_experts=4)     # 4 % 8 != 0
+    model = MixtralForCausalLM(cfg)
+    model.train()
+    fm = FunctionalModule(model, training=True)
+    mesh = mesh_mod.init_mesh({"dp": 8})
+    try:
+        specs = fm.param_specs(MixtralForCausalLM.sharding_rules())
+        p_arrs = [jax.device_put(a, NamedSharding(mesh, s))
+                  for a, s in zip(fm.param_arrays(), specs)]   # no raise
+        rng = np.random.default_rng(5)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 8)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 8)),
+                             jnp.int32)
+        key = fm.next_key()
+
+        def loss_fn(ps):
+            (loss, _), _ = fm(ps, [], key, ids, labels=labels)
+            return loss
+
+        with mesh:
+            loss = jax.jit(loss_fn)(p_arrs)
+        assert np.isfinite(float(loss))
+    finally:
+        mesh_mod.reset_mesh()
+
+
+def test_mixtral_generate_smoke():
+    paddle.seed(2)
+    cfg = mixtral_tiny()
+    model = MixtralForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (1, 4))
+        .astype("int32"))
+    out = model.generate(ids, max_new_tokens=6)
+    out = out[0] if isinstance(out, tuple) else out
+    assert out.shape[-1] >= 10
+
+
+def test_mixtral_ep_sharded_step():
+    """Expert-parallel training step: expert dim of the stacked weights
+    sharded over 'dp' on the 8-device mesh, one jitted fwd+bwd."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.framework.functional import FunctionalModule
+
+    paddle.seed(3)
+    cfg = mixtral_tiny(num_local_experts=8)
+    model = MixtralForCausalLM(cfg)
+    model.train()
+    fm = FunctionalModule(model, training=True)
+    mesh = mesh_mod.init_mesh({"dp": 8})
+    try:
+        specs = fm.param_specs(MixtralForCausalLM.sharding_rules())
+        shards = [NamedSharding(mesh, s) for s in specs]
+        p_arrs = [jax.device_put(a, sh)
+                  for a, sh in zip(fm.param_arrays(), shards)]
+        rng = np.random.default_rng(3)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 8)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 8)),
+                             jnp.int32)
+        key = fm.next_key()
+
+        def loss_fn(ps):
+            (loss, _), _ = fm(ps, [], key, ids, labels=labels)
+            return loss
+
+        step = jax.jit(jax.value_and_grad(loss_fn), in_shardings=(shards,))
+        with mesh:
+            loss, grads = step(p_arrs)
+        assert np.isfinite(float(loss))
+        assert all(np.isfinite(jax.device_get(g)).all() for g in grads)
+        # the expert dim actually sharded over dp
+        we = next(a for a, s in zip(p_arrs, specs)
+                  if a.ndim == 3 and s == P("dp", None, None))
+        assert any(sh.data.shape[0] < we.shape[0]
+                   for sh in we.addressable_shards), \
+            "expert weights were not ep-sharded"
+    finally:
+        mesh_mod.reset_mesh()
