@@ -164,8 +164,11 @@ class FunctionalModule:
             if live:
                 # a rule axis that does not divide the dim would fail at
                 # device_put (e.g. 4 experts over a dp=8 ep axis): such a
-                # param replicates on that axis instead
-                for d, ax in enumerate(spec):
+                # param replicates on that axis instead. (spec may be
+                # LONGER than the rank when a rule over-matches — those
+                # trailing axes fail at P() construction with the clear
+                # rank error, not an IndexError here.)
+                for d, ax in enumerate(spec[:len(p.shape)]):
                     if ax is not None:
                         n_ax = mesh_mod.axis_size(ax)
                         if n_ax > 1 and p.shape[d] % n_ax != 0:

@@ -33,7 +33,25 @@ from ..... import flags  # noqa: F401
 from .....distributed import mesh as mesh_mod
 
 __all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate", "ExpertFFN",
-           "plan_dispatch", "dispatch_combine"]
+           "plan_dispatch", "dispatch_combine", "ep_axis_for",
+           "moe_capacity"]
+
+
+def ep_axis_for(num_experts, ep_axis="dp"):
+    """The mesh axis to shard the expert dim over, or None: requires an
+    installed mesh whose ``ep_axis`` is >1 AND divides ``num_experts``
+    (4 experts over a dp=8 axis must replicate, not crash at lowering).
+    The single EP-eligibility policy for every MoE caller."""
+    if not ep_axis or not mesh_mod.has_mesh():
+        return None
+    n = mesh_mod.axis_size(ep_axis)
+    return ep_axis if n > 1 and num_experts % n == 0 else None
+
+
+def moe_capacity(n_tokens, num_experts, top_k, capacity_factor):
+    """Static per-expert capacity ``C = ceil(S·cf·k/E)`` (≥1)."""
+    return max(1, math.ceil(n_tokens * capacity_factor * top_k
+                            / num_experts))
 
 
 def plan_dispatch(logits, capacity, top_k):
@@ -229,9 +247,8 @@ class MoELayer(Layer):
         for n in orig_shape[:-1]:
             s *= n
         e = self.num_experts
-        capacity = max(1, math.ceil(s * self.capacity_factor * self.top_k / e))
-        ep = self.ep_axis if (mesh_mod.has_mesh()
-                              and mesh_mod.axis_size(self.ep_axis) > 1) else None
+        capacity = moe_capacity(s, e, self.top_k, self.capacity_factor)
+        ep = ep_axis_for(e, self.ep_axis)
 
         gate_w = self.gate.weight
         if self.fused is not None:

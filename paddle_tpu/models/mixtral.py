@@ -99,8 +99,9 @@ class MixtralSparseMoeBlock(Layer):
         self.aux_loss = None
 
     def forward(self, x):
-        from ..incubate.distributed.models.moe import dispatch_combine
-        from ..distributed import mesh as mesh_mod
+        from ..incubate.distributed.models.moe import (dispatch_combine,
+                                                       ep_axis_for,
+                                                       moe_capacity)
 
         orig_shape = x.shape
         d = orig_shape[-1]
@@ -108,11 +109,8 @@ class MixtralSparseMoeBlock(Layer):
         for n in orig_shape[:-1]:
             s *= n
         e, k = self.num_experts, self.top_k
-        capacity = max(1, math.ceil(s * self.capacity_factor * k / e))
-        # EP only when the expert count actually divides the axis-shard
-        # product (4 experts on a dp=8 mesh must replicate, not crash)
-        dp = mesh_mod.axis_size("dp") if mesh_mod.has_mesh() else 1
-        ep = "dp" if dp > 1 and e % dp == 0 else None
+        capacity = moe_capacity(s, e, k, self.capacity_factor)
+        ep = ep_axis_for(e, "dp")
 
         def fn(xa, gw, wg, wu, wd):
             tok = xa.reshape(s, d)
