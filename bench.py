@@ -427,6 +427,67 @@ def bench_bert():
     }
 
 
+def bench_comm():
+    """Gradient-communication bench (BENCH_MODEL=comm): a simulated dp-N
+    bucketed+quantized gradient all-reduce over a synthetic parameter set,
+    vs the per-tensor fp32 baseline. Emits ``dp_allreduce_wire_bytes``
+    (the quantized wire volume) with the fp32 baseline, compression
+    ratio, call counts and max quantization error riding along — the
+    CommStats counters the distributed.comm layer maintains."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.comm import (GradientBucketer, get_comm_stats,
+                                             reset_comm_stats)
+
+    nprocs = int(os.environ.get("BENCH_DP", "4"))
+    steps = int(os.environ.get("BENCH_STEPS", "5"))
+    # synthetic grad set shaped like a small model: 16 weight matrices +
+    # 16 vectors, ~4.3 MB fp32 per rank
+    shapes = [(256, 256)] * 16 + [(1024,)] * 16
+
+    def run(quant, fuse_mb):
+        reset_comm_stats()
+
+        def worker():
+            r = dist.get_rank()
+            rng = np.random.default_rng(r)
+            params = [paddle.to_tensor(np.zeros(s, np.float32))
+                      for s in shapes]
+            for p in params:
+                p.grad = paddle.to_tensor(
+                    rng.normal(size=p.shape).astype(np.float32))
+            b = GradientBucketer(params, fuse_grad_size_in_MB=fuse_mb,
+                                 quantization=quant, error_feedback=True)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                b.sync_grads()
+            return time.perf_counter() - t0
+
+        times = dist.spawn(worker, nprocs=nprocs).results
+        return get_comm_stats().as_dict(), max(times)
+
+    base, t_base = run(None, 0)        # per-tensor fp32 (the legacy path)
+    quant, t_quant = run("int8", 32)   # bucketed blockwise-int8
+    return {
+        "metric": "dp_allreduce_wire_bytes",
+        "value": quant["wire_bytes"],
+        "unit": "bytes",
+        "vs_baseline": None,
+        "fp32_wire_bytes": base["wire_bytes"],
+        "compression_ratio": round(base["wire_bytes"]
+                                   / max(quant["wire_bytes"], 1), 3),
+        "calls_fp32": base["calls"],
+        "calls_int8": quant["calls"],
+        "max_quant_error": quant["quant_max_error"],
+        "sync_seconds_fp32": round(t_base, 3),
+        "sync_seconds_int8": round(t_quant, 3),
+        "dp": nprocs,
+        "steps": steps,
+    }
+
+
 def bench_dispatch():
     """Eager (dygraph) per-op dispatch overhead vs raw jax — SURVEY §7.3
     item 1's top risk, measured. Reports µs/op for a no-grad elementwise
@@ -520,6 +581,7 @@ def _child_main():
            else bench_data() if mode == "data"
            else bench_dispatch() if mode == "dispatch"
            else bench_bert() if mode == "bert"
+           else bench_comm() if mode == "comm"
            else bench_resnet())
     import jax
     out["backend"] = jax.devices()[0].platform.lower()
@@ -676,12 +738,14 @@ def main():
                    else "eager_dispatch_overhead_vs_jax"
                    if mode == "dispatch"
                    else "bert_base_finetune_step_ms" if mode == "bert"
+                   else "dp_allreduce_wire_bytes" if mode == "comm"
                    else "resnet50_cifar10_train_throughput"),
         "value": None,
         "unit": ("tokens/sec" if mode in ("llama", "llama_decode")
                  else "samples/sec" if mode == "data"
                  else "x" if mode == "dispatch"
                  else "ms/step" if mode == "bert"
+                 else "bytes" if mode == "comm"
                  else "images/sec"),
         "vs_baseline": None,
         "error": (" || ".join(e.replace("\n", " ")[:300]

@@ -31,21 +31,49 @@ _FLOAT0 = jax.dtypes.float0
 # grad mode
 # ---------------------------------------------------------------------------
 
-_grad_enabled = [True]
+# THREAD-LOCAL, not process-global: the thread-rank simulator runs N
+# ranks as threads, and each rank enters/leaves no_grad independently
+# (every Optimizer.step is @no_grad). With a shared flag, two ranks'
+# interleaved enter/exit could restore the OTHER rank's saved state and
+# leave gradients disabled for the whole process (A on→off, B off→off,
+# A →on, B →off: poisoned). Thread-local save/restore is race-free.
+import threading as _grad_threading
+
+_grad_mode = _grad_threading.local()
 
 
 def is_grad_enabled() -> bool:
-    return _grad_enabled[0]
+    return getattr(_grad_mode, "enabled", True)
+
+
+def _set_grad_mode(mode: bool):
+    _grad_mode.enabled = bool(mode)
+
+
+def _push_grad_mode(mode: bool):
+    # saved states live on a PER-THREAD stack, never on the context
+    # instance: one @no_grad decorator instance is shared by every caller
+    # of the function it wraps, so instance state would race across
+    # threads the same way the old global flag did
+    stack = getattr(_grad_mode, "stack", None)
+    if stack is None:
+        stack = _grad_mode.stack = []
+    stack.append(is_grad_enabled())
+    _set_grad_mode(mode)
+
+
+def _pop_grad_mode():
+    stack = getattr(_grad_mode, "stack", None)
+    _set_grad_mode(stack.pop() if stack else True)
 
 
 def set_grad_enabled(mode: bool):
     class _Ctx(contextlib.AbstractContextManager):
         def __init__(self, mode):
-            self._prev = _grad_enabled[0]
-            _grad_enabled[0] = bool(mode)
+            _push_grad_mode(mode)
 
         def __exit__(self, *exc):
-            _grad_enabled[0] = self._prev
+            _pop_grad_mode()
             return False
 
     return _Ctx(mode)
@@ -55,23 +83,21 @@ class no_grad(contextlib.ContextDecorator):
     """paddle.no_grad — context manager AND decorator."""
 
     def __enter__(self):
-        self._prev = _grad_enabled[0]
-        _grad_enabled[0] = False
+        _push_grad_mode(False)
         return self
 
     def __exit__(self, *exc):
-        _grad_enabled[0] = self._prev
+        _pop_grad_mode()
         return False
 
 
 class enable_grad(contextlib.ContextDecorator):
     def __enter__(self):
-        self._prev = _grad_enabled[0]
-        _grad_enabled[0] = True
+        _push_grad_mode(True)
         return self
 
     def __exit__(self, *exc):
-        _grad_enabled[0] = self._prev
+        _pop_grad_mode()
         return False
 
 

@@ -44,6 +44,13 @@ from .auto_parallel import (  # noqa: F401
 # communication subpackage alias (paddle.distributed.communication.*)
 from . import collective as communication  # noqa: F401
 
+# bucketed + quantized gradient communication layer (EQuARX-style)
+from . import comm  # noqa: F401
+from .comm import (  # noqa: F401
+    GradientBucketer, all_reduce_quantized, reduce_scatter_quantized,
+    get_comm_stats, reset_comm_stats,
+)
+
 
 def get_backend():
     return "xla"

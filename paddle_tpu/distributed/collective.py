@@ -306,6 +306,15 @@ def _np(tensor):
     return np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
 
 
+def _record_comm(kind: str, nbytes: int, group: Group):
+    """CommStats accounting for the dense collectives: one record per
+    issuing rank, wire == logical (no compression on this path)."""
+    if group.nranks <= 1:
+        return
+    from .comm.stats import get_comm_stats
+    get_comm_stats().record(kind, logical_bytes=nbytes, wire_bytes=nbytes)
+
+
 def _write_back(tensor: Tensor, arr):
     tensor._data = jnp.asarray(np.asarray(arr), dtype=tensor.dtype)
     return tensor
@@ -333,6 +342,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     group = group or _get_default_group()
     if group.nranks == 1:
         return _Task()
+    _record_comm("all_reduce", _np(tensor).nbytes, group)
     if simulator.active_world() is None:
         dev = _device_reduce(_np(tensor), _normalize_op(op), group)
         if dev is not None:
@@ -349,6 +359,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if group.nranks == 1:
         tensor_list.append(Tensor(tensor._data) if isinstance(tensor, Tensor) else Tensor(tensor))
         return _Task()
+    _record_comm("all_gather", _np(tensor).nbytes, group)
     got = _exchange("all_gather", _np(tensor), group)
     for i in range(group.nranks):
         tensor_list.append(Tensor(jnp.asarray(got[i])))
@@ -372,6 +383,7 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
         _write_back(tensor, _np(src))
         return _Task()
     stacked = np.stack([_np(t) for t in tensor_list])  # [nranks, ...] local inputs
+    _record_comm("reduce_scatter", stacked.nbytes, group)
     mine = group.rank
     if simulator.active_world() is None:
         dev = _device_reduce_scatter(stacked, op, group)

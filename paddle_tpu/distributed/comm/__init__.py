@@ -1,0 +1,47 @@
+"""paddle_tpu.distributed.comm — bucketed + quantized gradient
+communication (EQuARX-style blockwise int8 collectives, arXiv:2506.17615;
+policy-programmable comm in the spirit of Piper, arXiv:2606.11169).
+
+Three layers:
+
+* :class:`GradientBucketer` — flattens per-parameter gradients into
+  fixed-size dtype-homogeneous fusion buckets (``fuse_grad_size_in_MB``)
+  with a rank-deterministic layout, so one collective covers many
+  tensors;
+* quantized collectives — :func:`all_reduce_quantized` /
+  :func:`reduce_scatter_quantized` with blockwise-int8 or bf16 wire
+  formats, fp32 passthrough, and optional error feedback;
+* :class:`CommStats` — calls / logical vs wire bytes / compression ratio
+  / max quantization error, queryable from ``paddle_tpu.profiler
+  .comm_stats()`` and emitted by ``bench.py`` (BENCH_MODEL=comm).
+
+Policy wiring: ``DistributedStrategy.comm_quantization`` +
+``fuse_grad_size_in_MB`` + ``comm_configs`` route ``DataParallel``,
+``HybridParallelOptimizer``, the DGC/LocalSGD meta-optimizers and the
+stage-2 sharding optimizer through this subsystem instead of per-tensor
+fp32 calls.
+"""
+from __future__ import annotations
+
+from .stats import CommStats, get_comm_stats, reset_comm_stats  # noqa: F401
+from .quantization import (  # noqa: F401
+    DEFAULT_BLOCK_SIZE, quantize_blockwise, dequantize_blockwise,
+    quantize_blockwise_jax, dequantize_blockwise_jax, SCHEMES,
+)
+from .collectives import (  # noqa: F401
+    all_reduce_quantized, reduce_scatter_quantized, allreduce_array,
+    reduce_scatter_array, PASSTHROUGH,
+)
+from .bucketer import GradientBucketer  # noqa: F401
+
+
+def comm_config_from_strategy(strategy) -> dict:
+    """Kwargs for :class:`GradientBucketer` from a DistributedStrategy
+    (tolerates None / strategies predating the comm knobs)."""
+    cfg = dict(getattr(strategy, "comm_configs", {}) or {})
+    return {
+        "fuse_grad_size_in_MB": getattr(strategy, "fuse_grad_size_in_MB", 32),
+        "quantization": getattr(strategy, "comm_quantization", None),
+        "block_size": cfg.get("block_size", DEFAULT_BLOCK_SIZE),
+        "error_feedback": cfg.get("error_feedback", False),
+    }

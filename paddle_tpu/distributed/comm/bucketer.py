@@ -1,0 +1,221 @@
+"""GradientBucketer — fixed-size fusion buckets for gradient exchange.
+
+The reference amortizes NCCL launch overhead with the C++ reducer's
+grad buckets (``reducer.cc``, ``fuse_grad_size_in_MB``); here the same
+fusion amortizes the per-collective rendezvous/host round trip of the
+imperative tier AND gives the quantized wire codec long contiguous
+vectors to blockwise-compress.
+
+Layout contract: buckets are built from the parameter list's *order,
+shapes and dtypes only* — never from gradient values or presence — so
+every rank derives the identical layout and the per-bucket collectives
+pair correctly (``signature()`` is the testable witness). Buckets are
+dtype-homogeneous; a bucket closes when adding the next same-dtype
+parameter would exceed ``fuse_grad_size_in_MB`` (0 → one bucket per
+parameter, the legacy per-tensor wire pattern). Each parameter owns a
+``[offset, offset+numel)`` view into its bucket's flat buffer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import collective as _collective
+from ...framework.core import Tensor
+from .collectives import PASSTHROUGH, allreduce_array, reduce_scatter_array
+from .quantization import DEFAULT_BLOCK_SIZE
+
+
+class _Bucket:
+    __slots__ = ("dtype", "items", "numel")
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.items = []   # (param_index, offset, numel, shape)
+        self.numel = 0
+
+    @property
+    def nbytes(self):
+        return self.numel * self.dtype.itemsize
+
+
+class GradientBucketer:
+    def __init__(self, parameters, fuse_grad_size_in_MB=32, quantization=None,
+                 block_size: int = DEFAULT_BLOCK_SIZE, error_feedback=False):
+        self._params = [p for p in parameters if p is not None]
+        self._fuse_bytes = max(0.0, float(fuse_grad_size_in_MB)) * 2 ** 20
+        self.quantization = (None if quantization in PASSTHROUGH
+                             else quantization)
+        self.block_size = int(block_size)
+        self.error_feedback = bool(error_feedback)
+        self._residuals = {}    # bucket index -> fp32 residual (error feedback)
+        self._buckets = self._build()
+
+    @classmethod
+    def from_strategy(cls, parameters, strategy):
+        """Build with the ``DistributedStrategy`` comm knobs."""
+        cfg = dict(getattr(strategy, "comm_configs", {}) or {})
+        return cls(parameters,
+                   fuse_grad_size_in_MB=getattr(strategy,
+                                                "fuse_grad_size_in_MB", 32),
+                   quantization=getattr(strategy, "comm_quantization", None),
+                   block_size=cfg.get("block_size", DEFAULT_BLOCK_SIZE),
+                   error_feedback=cfg.get("error_feedback", False))
+
+    # -- layout --------------------------------------------------------------
+    def _build(self):
+        # With int8 quantization each parameter is aligned to a block
+        # boundary so no quantization block spans two parameters — a small
+        # tensor must never inherit the scale of a large-gradient neighbor
+        # (per-block scales are EQuARX's accuracy lever; crossing tensor
+        # boundaries would defeat it). Alignment padding is zeros on the
+        # wire and depends only on shapes/dtypes, so layout determinism
+        # across ranks is preserved.
+        align = self.block_size if self.quantization == "int8" else 1
+        buckets: list[_Bucket] = []
+        open_by_dtype: dict = {}
+        for i, p in enumerate(self._params):
+            arr = getattr(p, "_data", p)
+            dt = np.dtype(arr.dtype)
+            numel = int(np.prod(arr.shape)) if arr.shape else 1
+            b = open_by_dtype.get(dt)
+            if (b is None or
+                    (b.numel and (b.numel + numel) * dt.itemsize
+                     > self._fuse_bytes)):
+                b = _Bucket(dt)
+                buckets.append(b)
+                open_by_dtype[dt] = b
+            b.items.append((i, b.numel, numel, tuple(arr.shape)))
+            b.numel += -(-numel // align) * align
+        return buckets
+
+    @property
+    def buckets(self):
+        return list(self._buckets)
+
+    @property
+    def num_buckets(self):
+        return len(self._buckets)
+
+    def signature(self):
+        """Hashable layout descriptor — identical across ranks by
+        construction; tested as such."""
+        return tuple((str(b.dtype),
+                      tuple((it[0], it[1], it[2]) for it in b.items))
+                     for b in self._buckets)
+
+    # -- exchange ------------------------------------------------------------
+    def _flatten(self, bucket, arrays):
+        flat = np.zeros(bucket.numel, bucket.dtype)
+        for (i, off, numel, _shape) in bucket.items:
+            a = arrays[i]
+            if a is not None:
+                flat[off:off + numel] = np.asarray(a, bucket.dtype).ravel()
+        return flat
+
+    def _quantizable(self, bucket):
+        return (self.quantization is not None
+                and np.issubdtype(bucket.dtype, np.floating))
+
+    def _residual(self, key, numel):
+        if not self.error_feedback:
+            return None
+        r = self._residuals.get(key)
+        if r is None or r.size != numel:
+            r = self._residuals[key] = np.zeros(numel, np.float32)
+        return r
+
+    def sync_arrays(self, arrays, group=None, op=None,
+                    use_reduce_scatter=False):
+        """Reduce ``arrays`` (aligned with the parameter list; ``None``
+        entries contribute zeros) across ``group`` — one collective per
+        bucket — and return the reduced list (``None`` preserved).
+
+        ``use_reduce_scatter=True`` runs the stage-2 wire pattern:
+        reduce-scatter (each rank reduces its shard) followed by an
+        all-gather of the shards, so the wire carries 2/n of the
+        all-reduce gather-tier volume per direction while every rank
+        still ends with the full reduced vector.
+        """
+        group = group or _collective._get_default_group()
+        op = op if op is not None else _collective.ReduceOp.AVG
+        out = [None] * len(self._params)
+        for bi, bucket in enumerate(self._buckets):
+            flat = self._flatten(bucket, arrays)
+            if self._quantizable(bucket):
+                red = self._sync_flat_quantized(bi, bucket, flat, group, op,
+                                                use_reduce_scatter)
+            else:
+                red = self._sync_flat_plain(bucket, flat, group, op,
+                                            use_reduce_scatter)
+            red = np.asarray(red).ravel()
+            for (i, off, numel, shape) in bucket.items:
+                if arrays[i] is not None:
+                    out[i] = red[off:off + numel].reshape(shape).astype(
+                        bucket.dtype, copy=False)
+        return out
+
+    def _sync_flat_quantized(self, bi, bucket, flat, group, op, use_rs):
+        residual = self._residual(bi, flat.size)
+        if not use_rs or group.nranks == 1:
+            return allreduce_array(flat.astype(np.float32, copy=False),
+                                   group=group, op=op,
+                                   scheme=self.quantization,
+                                   block_size=self.block_size,
+                                   residual=residual)
+        n = group.nranks
+        shard_len = -(-flat.size // n)
+        padded = np.zeros(n * shard_len, np.float32)
+        padded[:flat.size] = flat
+        if residual is not None and residual.size != padded.size:
+            residual = self._residuals[bi] = np.zeros(padded.size, np.float32)
+        shard = reduce_scatter_array(padded.reshape(n, shard_len),
+                                     group=group, op=op,
+                                     scheme=self.quantization,
+                                     block_size=self.block_size,
+                                     residual=residual)
+        return self._gather_shards(shard, group)[:flat.size]
+
+    def _sync_flat_plain(self, bucket, flat, group, op, use_rs):
+        if not use_rs or group.nranks == 1:
+            t = Tensor(flat)
+            _collective.all_reduce(t, op=op, group=group)
+            return t.numpy()
+        n = group.nranks
+        shard_len = -(-flat.size // n)
+        padded = np.zeros(n * shard_len, flat.dtype)
+        padded[:flat.size] = flat
+        stacked = padded.reshape(n, shard_len)
+        out = Tensor(np.zeros(shard_len, flat.dtype))
+        _collective.reduce_scatter(out, [Tensor(stacked[i]) for i in range(n)],
+                                   op=op, group=group)
+        return self._gather_shards(out.numpy(), group)[:flat.size]
+
+    @staticmethod
+    def _gather_shards(shard, group):
+        outs: list = []
+        _collective.all_gather(outs, Tensor(np.asarray(shard)), group=group)
+        return np.concatenate([np.asarray(t.numpy()).ravel() for t in outs])
+
+    # -- parameter/gradient conveniences -------------------------------------
+    def sync_grads(self, group=None, op=None, use_reduce_scatter=False):
+        """Exchange the wrapped parameters' gradients in place (the
+        bucketed replacement for per-tensor ``all_reduce(p.grad)``)."""
+        import jax.numpy as jnp
+        arrays = [p.grad._data if getattr(p, "grad", None) is not None
+                  else None for p in self._params]
+        red = self.sync_arrays(arrays, group=group, op=op,
+                               use_reduce_scatter=use_reduce_scatter)
+        for p, r in zip(self._params, red):
+            if r is not None:
+                p.grad._data = jnp.asarray(r, dtype=p.grad._data.dtype)
+        return self
+
+    def sync_params(self, group=None, op=None):
+        """Average/reduce the parameter *values* (LocalSGD's averaging)."""
+        import jax.numpy as jnp
+        arrays = [p._data for p in self._params]
+        red = self.sync_arrays(arrays, group=group, op=op)
+        for p, r in zip(self._params, red):
+            if r is not None:
+                p._data = jnp.asarray(r, dtype=p._data.dtype)
+        return self

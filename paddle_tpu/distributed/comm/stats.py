@@ -1,0 +1,71 @@
+"""Communication instrumentation: one global byte/call counter.
+
+Every imperative collective (plain or quantized) records one entry per
+*issuing rank* — ``logical_bytes`` is what the exchange would cost in the
+tensor's native dtype, ``wire_bytes`` what actually crossed the wire
+(int8 payload + per-block scales for the quantized path). The counter is
+process-global and thread-safe so the thread-rank simulator's N ranks
+aggregate into one record, queryable from ``paddle_tpu.profiler
+.comm_stats()`` and emitted by ``bench.py`` (BENCH_MODEL=comm).
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+
+class CommStats:
+    """Counters for collective communication volume and compression."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with getattr(self, "_lock", threading.Lock()):
+            self.calls = 0
+            self.logical_bytes = 0
+            self.wire_bytes = 0
+            self.quant_max_error = 0.0
+            self.by_kind = defaultdict(lambda: {"calls": 0, "logical_bytes": 0,
+                                                "wire_bytes": 0})
+
+    def record(self, kind: str, logical_bytes: int, wire_bytes: int,
+               max_error: float = 0.0):
+        with self._lock:
+            self.calls += 1
+            self.logical_bytes += int(logical_bytes)
+            self.wire_bytes += int(wire_bytes)
+            if max_error > self.quant_max_error:
+                self.quant_max_error = float(max_error)
+            k = self.by_kind[kind]
+            k["calls"] += 1
+            k["logical_bytes"] += int(logical_bytes)
+            k["wire_bytes"] += int(wire_bytes)
+
+    @property
+    def compression_ratio(self) -> float:
+        """logical/wire — >1 means the wire was cheaper than fp32."""
+        return self.logical_bytes / self.wire_bytes if self.wire_bytes else 1.0
+
+    def as_dict(self):
+        with self._lock:
+            return {
+                "calls": self.calls,
+                "logical_bytes": self.logical_bytes,
+                "wire_bytes": self.wire_bytes,
+                "compression_ratio": round(self.compression_ratio, 4),
+                "quant_max_error": self.quant_max_error,
+                "by_kind": {k: dict(v) for k, v in self.by_kind.items()},
+            }
+
+
+_GLOBAL = CommStats()
+
+
+def get_comm_stats() -> CommStats:
+    return _GLOBAL
+
+
+def reset_comm_stats():
+    _GLOBAL.reset()

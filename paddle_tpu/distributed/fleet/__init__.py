@@ -170,7 +170,13 @@ def distributed_optimizer(optimizer, strategy=None):
                 parameters=optimizer._parameter_list,
                 rampup_begin_step=cfg.get("rampup_begin_step", 0),
                 rampup_step=cfg.get("rampup_step", 1),
-                sparsity=cfg.get("sparsity", [0.999]))
+                sparsity=cfg.get("sparsity", [0.999]),
+                grad_clip=getattr(optimizer, "_grad_clip", None),
+                fuse_grad_size_in_MB=getattr(strategy,
+                                             "fuse_grad_size_in_MB", 32),
+                comm_quantization=getattr(strategy, "comm_quantization",
+                                          None),
+                comm_configs=getattr(strategy, "comm_configs", None))
         else:
             import sys
             print("fleet: strategy.dgc=True ignored — DGC applies to "
@@ -180,9 +186,13 @@ def distributed_optimizer(optimizer, strategy=None):
     if getattr(strategy, "localsgd", False):
         from .meta_optimizers import LocalSGDOptimizer
         cfg = dict(getattr(strategy, "localsgd_configs", {}) or {})
-        optimizer = LocalSGDOptimizer(optimizer,
-                                      k_steps=cfg.get("k_steps", 1),
-                                      begin_step=cfg.get("begin_step", 1))
+        optimizer = LocalSGDOptimizer(
+            optimizer, k_steps=cfg.get("k_steps", 1),
+            begin_step=cfg.get("begin_step", 1),
+            fuse_grad_size_in_MB=getattr(strategy, "fuse_grad_size_in_MB",
+                                         32),
+            comm_quantization=getattr(strategy, "comm_quantization", None),
+            comm_configs=getattr(strategy, "comm_configs", None))
     return HybridParallelOptimizer(optimizer, get_hybrid_communicate_group(),
                                    strategy)
 

@@ -58,6 +58,11 @@ _RECOMPUTE_DEFAULTS = {
     "enable_offload": False,
 }
 
+_COMM_DEFAULTS = {
+    "block_size": 256,        # elements per quantization block
+    "error_feedback": False,  # carry compression error into the next round
+}
+
 
 def _merge(defaults, override):
     out = copy.deepcopy(defaults)
@@ -91,6 +96,13 @@ class DistributedStrategy:
         self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.fuse_all_reduce_ops = True  # advisory on TPU (XLA fuses)
         self.nccl_comm_num = 1           # accepted, meaningless on ICI
+        # gradient-communication policy (distributed.comm): fusion bucket
+        # size for the imperative dp/sharding exchange (0 → per-tensor),
+        # wire quantization scheme (None/"fp32" | "bf16" | "int8"), and
+        # codec sub-config
+        self.fuse_grad_size_in_MB = 32
+        self.comm_quantization = None
+        self._comm_configs = copy.deepcopy(_COMM_DEFAULTS)
         # auto-parallel mesh search (reference: strategy.auto / the
         # rule-based tuner): with auto_search=True and a model spec in
         # auto_search_configs, fleet.init runs the cost-model Tuner over
@@ -125,6 +137,14 @@ class DistributedStrategy:
         self._recompute_configs = _merge(_RECOMPUTE_DEFAULTS, configs)
 
     @property
+    def comm_configs(self):
+        return self._comm_configs
+
+    @comm_configs.setter
+    def comm_configs(self, configs):
+        self._comm_configs = _merge(_COMM_DEFAULTS, configs)
+
+    @property
     def sharding_configs(self):
         return self._sharding_configs
 
@@ -150,6 +170,9 @@ class DistributedStrategy:
             "recompute": self.recompute,
             "recompute_configs": self._recompute_configs,
             "sharding": self.sharding, "sharding_configs": self._sharding_configs,
+            "fuse_grad_size_in_MB": self.fuse_grad_size_in_MB,
+            "comm_quantization": self.comm_quantization,
+            "comm_configs": self._comm_configs,
         }
 
     def __repr__(self):
@@ -165,4 +188,7 @@ class DistributedStrategy:
         s.recompute_configs = d.get("recompute_configs", {})
         s.sharding = d.get("sharding", False)
         s.sharding_configs = d.get("sharding_configs", {})
+        s.fuse_grad_size_in_MB = d.get("fuse_grad_size_in_MB", 32)
+        s.comm_quantization = d.get("comm_quantization", None)
+        s.comm_configs = d.get("comm_configs", {})
         return s

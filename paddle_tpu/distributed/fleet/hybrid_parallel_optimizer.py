@@ -6,7 +6,11 @@ to DygraphShardingOptimizer; SURVEY.md §2.3 "Fleet facade").
 TPU-native: eager tensors are *global* arrays over the mesh, so a global
 norm computed with ordinary ops is already correct across every axis — the
 reference's cross-group norm allreduce ladder collapses. What remains is
-(a) stage-1 sharding delegation, (b) distributed-param handling for clip.
+(a) stage-1 sharding delegation, (b) distributed-param handling for clip,
+(c) in per-rank execution (thread simulator / one process per host), the
+data-parallel gradient exchange itself — routed through the
+``distributed.comm`` bucketer so one (optionally quantized) collective
+covers many tensors instead of a per-tensor fp32 call each.
 """
 from __future__ import annotations
 
@@ -17,6 +21,7 @@ class HybridParallelOptimizer:
     def __init__(self, optimizer, hcg=None, strategy=None):
         self._hcg = hcg
         self._strategy = strategy
+        self._comm_bucketer = None
         sharding_degree = 1
         if strategy is not None:
             sharding_degree = strategy.degrees().get("sharding", 1)
@@ -29,13 +34,47 @@ class HybridParallelOptimizer:
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
+    # -- per-rank dp gradient exchange ---------------------------------------
+    def _maybe_sync_dp_grads(self):
+        """Bucketed (and, per strategy, quantized) dp grad exchange for the
+        per-rank tiers. The SPMD/mesh perf path never reaches this (XLA
+        inserts the reduction); meta-optimizers that own their exchange
+        (DGC/LocalSGD) are left alone; world size 1 is a no-op. AVG over
+        already-AVG'd identical grads is idempotent, so composition with
+        ``DataParallel``'s backward hook stays correct."""
+        s = self._strategy
+        if s is None or s.degrees().get("dp", 1) <= 1:
+            return
+        from .meta_optimizers import DGCMomentumOptimizer, LocalSGDOptimizer
+        if isinstance(self._inner_opt, (DGCMomentumOptimizer,
+                                        LocalSGDOptimizer)):
+            return
+        import jax
+        from .. import simulator
+        from ..parallel_env import get_world_size
+        if simulator.active_world() is None and jax.process_count() <= 1:
+            return
+        if get_world_size() <= 1:
+            return
+        params = [p for p in getattr(self._inner_opt, "_parameter_list", [])
+                  if p is not None and getattr(p, "trainable", True)]
+        if not params:
+            return
+        from ..comm import GradientBucketer
+        b = self._comm_bucketer
+        if b is None or [id(p) for p in b._params] != [id(p) for p in params]:
+            b = self._comm_bucketer = GradientBucketer.from_strategy(params, s)
+        from ..collective import ReduceOp
+        b.sync_grads(op=ReduceOp.AVG)
+
     def step(self):
+        self._maybe_sync_dp_grads()
         self._inner_opt.step()
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         loss.backward()
-        self._inner_opt.step()
+        self.step()
         self._inner_opt.clear_grad()
         return None, None
 

@@ -75,10 +75,14 @@ class DygraphShardingOptimizer:
     placed sharded over the 'sharding' axis after creation (reference: each
     rank updates its shard then broadcasts — here the broadcast is XLA's)."""
 
-    def __init__(self, optimizer, hcg=None):
+    def __init__(self, optimizer, hcg=None, comm_config=None):
         self._inner_opt = optimizer
         self._hcg = hcg
         self._sharded = set()
+        # gradient-communication policy for the per-rank tiers (bucketed /
+        # quantized exchange); None → read the fleet strategy lazily
+        self._comm_config = comm_config
+        self._comm_bucketer = None
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
@@ -110,9 +114,39 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
     """Stage 2 = stage 1 + grad sharding. Eagerly grads live transiently; the
     reduce-scatter happens inside the jitted step (engine.py threads grad
     shardings); the eager wrapper additionally places grads sharded before
-    the update to bound peak memory."""
+    the update to bound peak memory.
+
+    In per-rank execution (thread simulator / one process per host) the
+    ZeRO-2 wire pattern runs explicitly through ``distributed.comm``: a
+    bucketed (optionally quantized) reduce-scatter — each rank reduces its
+    shard — followed by an all-gather of the shards, so the eager update
+    below still sees the full reduced gradient."""
+
+    def _maybe_exchange_grads(self):
+        import jax
+        from ... import simulator
+        from ...parallel_env import get_world_size
+        if simulator.active_world() is None and jax.process_count() <= 1:
+            return
+        if get_world_size() <= 1:
+            return
+        params = [p for p in self._inner_opt._parameter_list
+                  if p is not None]
+        if not any(getattr(p, "grad", None) is not None for p in params):
+            return
+        from ...comm import GradientBucketer, comm_config_from_strategy
+        from ...collective import ReduceOp
+        b = self._comm_bucketer
+        if b is None or [id(p) for p in b._params] != [id(p) for p in params]:
+            cfg = self._comm_config
+            if cfg is None:
+                from .. import get_strategy
+                cfg = comm_config_from_strategy(get_strategy())
+            b = self._comm_bucketer = GradientBucketer(params, **cfg)
+        b.sync_grads(op=ReduceOp.AVG, use_reduce_scatter=True)
 
     def step(self):
+        self._maybe_exchange_grads()
         for p in self._inner_opt._parameter_list:
             if p.grad is not None:
                 spec = shard_spec_for(p.grad._data.shape,

@@ -90,12 +90,23 @@ class DataParallel(Layer):
 
     # -- per-rank grad sync (simulated / multi-process) ----------------------
     def _sync_gradients(self):
+        """The reducer flush: bucketed (and, per the fleet strategy's
+        ``comm_quantization`` knob, quantized) gradient exchange through
+        ``distributed.comm`` — one collective per fusion bucket instead of
+        one per tensor (reference ``reducer.cc`` grad buckets)."""
         if not self._grad_sync_enabled or not self._sim_mode:
             return
-        for p in self._layers.parameters():
-            if p is not None and p.grad is not None and p.trainable:
-                collective.all_reduce(p.grad, op=collective.ReduceOp.AVG,
-                                      group=self.group)
+        params = [p for p in self._layers.parameters()
+                  if p is not None and p.trainable]
+        if not any(p.grad is not None for p in params):
+            return
+        from .comm import GradientBucketer
+        b = getattr(self, "_comm_bucketer", None)
+        if b is None or [id(p) for p in b._params] != [id(p) for p in params]:
+            from . import fleet
+            b = self._comm_bucketer = GradientBucketer.from_strategy(
+                params, fleet.get_strategy())
+        b.sync_grads(group=self.group, op=collective.ReduceOp.AVG)
 
     @contextlib.contextmanager
     def no_sync(self):

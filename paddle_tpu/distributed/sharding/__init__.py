@@ -16,20 +16,26 @@ from ..fleet.meta_parallel.sharding import (
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
                            offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
                            segment_size=2 ** 20, sync_comm=False, dp_group=None,
-                           exclude_layer=None):
-    """level: 'os' (stage 1), 'os_g' (stage 2), 'p_g_os' (stage 3)."""
+                           exclude_layer=None, comm_config=None):
+    """level: 'os' (stage 1), 'os_g' (stage 2), 'p_g_os' (stage 3).
+
+    ``comm_config``: optional dict for the per-rank gradient exchange
+    (``fuse_grad_size_in_MB``, ``quantization``, ``block_size``,
+    ``error_feedback`` — see ``distributed.comm.GradientBucketer``);
+    defaults to the fleet strategy's comm knobs.
+    """
     if level == "os":
-        opt = DygraphShardingOptimizer(optimizer)
+        opt = DygraphShardingOptimizer(optimizer, comm_config=comm_config)
         return model, opt, scaler
     if level == "os_g":
-        opt = GroupShardedOptimizerStage2(optimizer)
+        opt = GroupShardedOptimizerStage2(optimizer, comm_config=comm_config)
         wrapped = GroupShardedStage2(model, opt, group=group,
                                      sync_buffers=sync_buffers,
                                      buffer_max_size=buffer_max_size,
                                      dp_group=dp_group)
         return wrapped, opt, scaler
     if level == "p_g_os":
-        opt = GroupShardedOptimizerStage2(optimizer)
+        opt = GroupShardedOptimizerStage2(optimizer, comm_config=comm_config)
         wrapped = GroupShardedStage3(model, opt, group=group,
                                      sync_buffers=sync_buffers,
                                      segment_size=segment_size, offload=offload,

@@ -129,6 +129,14 @@ class ShmQueue:
                              f"{self._HDR.size}-byte frame header")
         self._recv_buf = ctypes.create_string_buffer(1 << 20)
         self._msg_counter = itertools.count()
+        # producer identity = pid MIXED WITH a per-process random nonce:
+        # a recycled pid alone would let a new worker's msg ids collide
+        # with stale incomplete partials of a dead worker (its counter
+        # restarts at 0, so ctr-based eviction never fires and chunks of
+        # two different messages could merge). 16 nonce bits make that a
+        # 1/65536 event instead of a certainty on pid reuse.
+        self._producer_id = (os.getpid() << 16) | int.from_bytes(
+            os.urandom(2), "little")
         self._partial = {}            # msg_id -> [n_seen, [chunks]]
 
     def put(self, obj, timeout=None):
@@ -141,7 +149,8 @@ class ShmQueue:
         deadline = None if timeout is None else _time.monotonic() + timeout
         payload = self._slot_bytes - self._HDR.size
         n_chunks = max(1, -(-len(blob) // payload))
-        msg_id = (os.getpid() << 24) | (next(self._msg_counter) & 0xFFFFFF)
+        msg_id = (self._producer_id << 24) | (next(self._msg_counter)
+                                              & 0xFFFFFF)
         for i in range(n_chunks):
             hdr = self._HDR.pack(self._MAGIC, msg_id, i, n_chunks)
             off = i * payload
@@ -189,11 +198,13 @@ class ShmQueue:
                     f"ShmQueue frame corruption on {self.name}")
             chunk = raw[self._HDR.size:]
             # producers are sequential per process: a chunk of msg N from
-            # pid P means any incomplete older msg from P is abandoned
-            # (its put timed out mid-message) — evict, don't leak
-            pid, ctr = msg_id >> 24, msg_id & 0xFFFFFF
+            # producer P (pid+nonce) means any incomplete older msg from P
+            # is abandoned (its put timed out mid-message) — evict, don't
+            # leak. A dead producer's partials keep a different nonce, so
+            # they can never merge with a pid-recycling successor's chunks.
+            src, ctr = msg_id >> 24, msg_id & 0xFFFFFF
             stale = [m for m in self._partial
-                     if m >> 24 == pid and (m & 0xFFFFFF) < ctr]
+                     if m >> 24 == src and (m & 0xFFFFFF) < ctr]
             for m in stale:
                 del self._partial[m]
             if total == 1:

@@ -180,19 +180,48 @@ int64_t shmq_pop(void* hv, void* out, uint64_t cap, int timeout_ms,
   Handle* h = (Handle*)hv;
   ActiveGuard ag(h);
   Ctrl* c = h->ctrl;
+  // the caller's timeout bounds the WHOLE pop — compute the absolute
+  // deadline up front so the ready-flag spin below inherits whatever
+  // budget the item_sem wait left over
+  struct timespec deadline;
+  if (timeout_ms >= 0) {
+    clock_gettime(CLOCK_REALTIME, &deadline);
+    deadline.tv_sec += timeout_ms / 1000;
+    deadline.tv_nsec += (long)(timeout_ms % 1000) * 1000000L;
+    if (deadline.tv_nsec >= 1000000000L) {
+      deadline.tv_sec += 1;
+      deadline.tv_nsec -= 1000000000L;
+    }
+  }
   if (timed_wait(&c->item_sem, timeout_ms) != 0) return -1;
   if (c->closing) { sem_post(&c->item_sem); return -4; }
   timed_wait(&c->cmu, -1);
   uint64_t slot = c->tail % c->slots;
   uint8_t* p = h->data + slot * slot_stride(c);
   // item_sem counted a COMPLETED push somewhere, but tail order may reach
-  // a slot whose producer is still copying — await its ready flag
+  // a slot whose producer is still copying — await its ready flag. The
+  // wait is bounded by the pop deadline: a producer killed between slot
+  // reservation (head++) and setting `ready` would otherwise leave the
+  // consumer spinning forever while holding cmu, so the Python side's
+  // workers-alive check could never fire. On expiry re-post item_sem and
+  // cmu (the item is NOT consumed; a later pop may retry) and return -1.
   uint64_t ready = 0;
   struct timespec ms = {0, 200000};  // 0.2 ms
   while (true) {
     memcpy(&ready, p + 8, 8);
     if (ready) break;
     if (c->closing) { sem_post(&c->cmu); sem_post(&c->item_sem); return -4; }
+    if (timeout_ms >= 0) {
+      struct timespec now;
+      clock_gettime(CLOCK_REALTIME, &now);
+      if (now.tv_sec > deadline.tv_sec ||
+          (now.tv_sec == deadline.tv_sec &&
+           now.tv_nsec >= deadline.tv_nsec)) {
+        sem_post(&c->cmu);
+        sem_post(&c->item_sem);
+        return -1;
+      }
+    }
     nanosleep(&ms, nullptr);
   }
   __sync_synchronize();

@@ -23,8 +23,20 @@ import jax
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "RecordEvent", "load_profiler_result",
-    "benchmark",
+    "benchmark", "comm_stats",
 ]
+
+
+def comm_stats(reset=False):
+    """Snapshot of the gradient-communication counters
+    (``distributed.comm.CommStats``): collective calls, logical vs wire
+    bytes, compression ratio, max quantization error. ``reset=True``
+    zeroes the counters after reading (per-window accounting)."""
+    from ..distributed.comm import get_comm_stats, reset_comm_stats
+    d = get_comm_stats().as_dict()
+    if reset:
+        reset_comm_stats()
+    return d
 
 
 class ProfilerTarget(enum.Enum):
