@@ -163,7 +163,61 @@ def bench_resnet():
     }
     if n_workers is not None:
         out["workers"] = n_workers
+    if not use_loader:
+        # step donates (p, b): thread them through the probe's closure
+        st = [p_arrs, b_arrs]
+
+        def _probe_step():
+            loss, st[0], st[1] = step(st[0], st[1], key, x, y)
+            return loss
+
+        out["telemetry_overhead_pct"] = _telemetry_overhead_pct(
+            _probe_step, lambda r: r.block_until_ready(),
+            steps=min(steps, 10))
     return out
+
+
+def _telemetry_overhead_pct(run_step, sync, steps=10):
+    """Cost of the observability layer itself, measured in-situ: the same
+    jitted step with the full per-step telemetry surface in the loop
+    (span begin/end + step-time histogram + counter + gauge) vs bare.
+    Emitted with every resnet bench so a regression in the telemetry hot
+    path shows up as a perf delta, not as silent slow training."""
+    from paddle_tpu.profiler.telemetry import get_registry, get_tracer
+
+    reg = get_registry()
+    hist = reg.histogram("bench_step_seconds", "bench overhead probe")
+    ctr = reg.counter("bench_steps_total", "bench overhead probe")
+    gauge = reg.gauge("bench_last_step_seconds", "bench overhead probe")
+    tracer = get_tracer()
+
+    def timed(instrumented):
+        t0 = time.perf_counter()
+        r = None
+        for _ in range(steps):
+            if instrumented:
+                sp = tracer.begin("bench_step")
+                t1 = time.perf_counter()
+                r = run_step()
+                d = time.perf_counter() - t1
+                tracer.end(sp)
+                hist.observe(d)
+                ctr.inc()
+                gauge.set(d)
+            else:
+                r = run_step()
+        sync(r)
+        return time.perf_counter() - t0
+
+    timed(False)                       # warm both paths
+    t_plain = timed(False)
+    tracer.enable()
+    try:
+        t_instr = timed(True)
+    finally:
+        tracer.disable()
+        tracer.drain()                 # don't leak probe spans to exports
+    return round((t_instr - t_plain) / max(t_plain, 1e-9) * 100, 3)
 
 
 def bench_data():
@@ -574,6 +628,39 @@ def bench_llama_decode():
 # Orchestration: never hang, never exit without a JSON line.
 # --------------------------------------------------------------------------
 
+def _emit_telemetry_snapshot(out):
+    """Every bench run ships its telemetry: a one-line per-family summary
+    on stderr plus a full JSONL snapshot (BENCH_TELEMETRY_JSONL path, or
+    bench_telemetry.jsonl next to this file). Regressions in the
+    observability layer itself are caught by ``telemetry_overhead_pct``
+    riding on the resnet record."""
+    try:
+        from paddle_tpu.profiler.telemetry import get_registry
+        reg = get_registry()
+        snap = reg.collect()
+        summary = {}
+        for name, fam in snap.items():
+            if fam["type"] == "histogram":
+                summary[name] = {
+                    k or "_": {"count": s["count"],
+                               "p50_ms": round(s["p50"] * 1e3, 3),
+                               "p99_ms": round(s["p99"] * 1e3, 3)}
+                    for k, s in fam["series"].items()}
+            else:
+                summary[name] = {k or "_": v
+                                 for k, v in fam["series"].items()}
+        print(json.dumps({"aux_metric": "telemetry_snapshot",
+                          "families": summary}), file=sys.stderr)
+        path = os.environ.get(
+            "BENCH_TELEMETRY_JSONL",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "bench_telemetry.jsonl"))
+        reg.export_jsonl(path, extra={"metric": out.get("metric"),
+                                      "value": out.get("value")})
+    except Exception as e:   # telemetry must never kill a bench record
+        print(f"bench: telemetry snapshot skipped: {e}", file=sys.stderr)
+
+
 def _child_main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
     out = (bench_llama() if mode == "llama"
@@ -585,6 +672,7 @@ def _child_main():
            else bench_resnet())
     import jax
     out["backend"] = jax.devices()[0].platform.lower()
+    _emit_telemetry_snapshot(out)
     print(json.dumps(out))
     return 0
 

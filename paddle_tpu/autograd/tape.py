@@ -137,6 +137,12 @@ _amp_cast_inputs = None
 _nan_check = False
 _profiler = None     # paddle_tpu.profiler.Profiler when recording
 
+# telemetry observers: fn(op_name, seconds) called after every dispatch
+# while installed (profiler.telemetry.enable_op_telemetry). Kept separate
+# from _profiler so the metrics registry can watch ops without a Profiler
+# window being open; the empty-list check keeps the off path free.
+_op_observers: list = []
+
 # callbacks fired once after a top-level backward() finishes (DataParallel
 # grad sync uses this — the analogue of the reference reducer's
 # post-backward allreduce flush, ``paddle/fluid/imperative/reducer.cc``).
@@ -169,13 +175,19 @@ def apply(fn, *args, op_name: str | None = None, **kwargs):
     node if grad is enabled and any input requires grad. Returns Tensor(s)
     mirroring fn's output structure."""
     name = op_name or getattr(fn, "__name__", "op")
-    if _profiler is not None and _profiler._recording:
+    _prof = _profiler if (_profiler is not None
+                          and _profiler._recording) else None
+    if _prof is not None or _op_observers:
         import time as _time
         _t0 = _time.perf_counter()
         try:
             out = _apply_inner(fn, name, args, kwargs)
         finally:
-            _profiler._record_op(name, _time.perf_counter() - _t0)
+            _dt = _time.perf_counter() - _t0
+            if _prof is not None:
+                _prof._record_op(name, _dt)
+            for _ob in _op_observers:
+                _ob(name, _dt)
     else:
         out = _apply_inner(fn, name, args, kwargs)
     if _op_inspect[0] is not None:

@@ -13,7 +13,8 @@ import time
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
-           "LRScheduler", "LogWriterCallback", "ReduceLROnPlateau", "VisualDL"]
+           "LRScheduler", "LogWriterCallback", "ReduceLROnPlateau", "VisualDL",
+           "TelemetryCallback"]
 
 
 class Callback:
@@ -261,6 +262,108 @@ class ReduceLROnPlateau(Callback):
                     print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
             self._wait = 0
             self._cooldown_ctr = self.cooldown
+
+
+class TelemetryCallback(Callback):
+    """Training-side bridge into the unified metrics registry
+    (``paddle.profiler.metrics()``): per-step wall time, throughput,
+    MFU and device memory high-water — the step-breakdown substrate
+    every perf PR measures against.
+
+    Records per train batch:
+
+    * ``paddle_train_step_seconds`` (histogram) + ``paddle_train_steps_total``
+    * ``paddle_train_tokens_per_sec`` / ``paddle_train_samples_per_sec``
+      gauges, when ``tokens_per_batch`` / ``samples_per_batch`` are given
+    * ``paddle_train_mfu_ratio`` gauge, when ``step_flops`` is given
+      (:class:`profiler.mfu.MFUMonitor` accounting — achieved / peak)
+    * ``paddle_device_live_bytes_high_water`` gauge (PJRT allocator peak)
+
+    While training runs, per-op dispatch telemetry is enabled on the
+    autograd tape (``paddle_op_dispatch_total{op=...}``), so one fit()
+    populates the tape, io, and train layers of the registry together.
+    """
+
+    def __init__(self, step_flops=None, tokens_per_batch=None,
+                 samples_per_batch=None, chip=None, n_chips=1,
+                 track_memory=True, track_ops=True):
+        super().__init__()
+        self.step_flops = step_flops
+        self.tokens_per_batch = tokens_per_batch
+        self.samples_per_batch = samples_per_batch
+        self.chip = chip
+        self.n_chips = n_chips
+        self.track_memory = track_memory
+        self.track_ops = track_ops
+        self._m = None
+        self._monitor = None
+        self._t_batch = None
+
+    def _metrics(self):
+        if self._m is None:
+            from .profiler.telemetry import get_registry
+            r = get_registry()
+            self._m = {
+                "step": r.histogram("paddle_train_step_seconds",
+                                    "train-loop wall time per step"),
+                "steps": r.counter("paddle_train_steps_total",
+                                   "train steps completed"),
+                "tok_s": r.gauge("paddle_train_tokens_per_sec",
+                                 "rolling training token throughput"),
+                "smp_s": r.gauge("paddle_train_samples_per_sec",
+                                 "rolling training sample throughput"),
+                "mfu": r.gauge("paddle_train_mfu_ratio",
+                               "achieved FLOP/s / peak FLOP/s"),
+                "mem": r.gauge("paddle_device_live_bytes_high_water",
+                               "peak device bytes in use seen during "
+                               "training"),
+            }
+        return self._m
+
+    def on_train_begin(self, logs=None):
+        self._metrics()
+        if self.track_ops:
+            from .profiler.telemetry import enable_op_telemetry
+            enable_op_telemetry()
+        if self.step_flops:
+            from .profiler.mfu import MFUMonitor, chip_kind
+            chip = self.chip
+            if chip is None:
+                try:
+                    chip = chip_kind()
+                except Exception:
+                    chip = "cpu"
+            self._monitor = MFUMonitor(self.step_flops, chip=chip,
+                                       n_chips=self.n_chips)
+
+    def on_train_end(self, logs=None):
+        if self.track_ops:
+            from .profiler.telemetry import disable_op_telemetry
+            disable_op_telemetry()
+
+    def on_train_batch_begin(self, step, logs=None):
+        self._t_batch = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._t_batch is None:
+            return
+        dt = max(time.perf_counter() - self._t_batch, 1e-9)
+        m = self._metrics()
+        m["step"].observe(dt)
+        m["steps"].inc()
+        if self.tokens_per_batch:
+            m["tok_s"].set(self.tokens_per_batch / dt)
+        if self.samples_per_batch:
+            m["smp_s"].set(self.samples_per_batch / dt)
+        if self._monitor is not None:
+            self._monitor.step(tokens=self.tokens_per_batch or 0)
+            m["mfu"].set(self._monitor.mfu())
+        if self.track_memory:
+            try:
+                from .device.memory import max_memory_allocated
+                m["mem"].set_max(max_memory_allocated())
+            except Exception:
+                pass      # backend without allocator stats
 
 
 class VisualDL(Callback):

@@ -13,6 +13,33 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
+_TELEMETRY = None      # lazily bound registry families
+
+
+def _telemetry():
+    """Bridge into the unified metrics registry (profiler.telemetry):
+    every CommStats.record also lands in Prometheus-exposable counters,
+    so comm volume shows up next to step time / serving latency in one
+    ``paddle.profiler.metrics()`` snapshot."""
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        from ...profiler.telemetry import get_registry
+        r = get_registry()
+        _TELEMETRY = {
+            "calls": r.counter("paddle_comm_collectives_total",
+                               "collective calls issued (per issuing rank)",
+                               labels=("kind",)),
+            "logical": r.counter("paddle_comm_logical_bytes_total",
+                                 "bytes the exchange would cost in the "
+                                 "tensor's native dtype", labels=("kind",)),
+            "wire": r.counter("paddle_comm_wire_bytes_total",
+                              "bytes that actually crossed the wire",
+                              labels=("kind",)),
+            "qerr": r.gauge("paddle_comm_quant_max_error",
+                            "max quantization error seen since reset"),
+        }
+    return _TELEMETRY
+
 
 class CommStats:
     """Counters for collective communication volume and compression."""
@@ -42,6 +69,12 @@ class CommStats:
             k["calls"] += 1
             k["logical_bytes"] += int(logical_bytes)
             k["wire_bytes"] += int(wire_bytes)
+        tele = _telemetry()
+        tele["calls"].inc(kind=kind)
+        tele["logical"].inc(int(logical_bytes), kind=kind)
+        tele["wire"].inc(int(wire_bytes), kind=kind)
+        if max_error:
+            tele["qerr"].set_max(float(max_error))
 
     @property
     def compression_ratio(self) -> float:

@@ -19,6 +19,47 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 
+_TELEMETRY = None      # lazily bound registry families
+
+
+def _telemetry():
+    """Serving latency/occupancy metrics in the unified registry:
+    queue-wait (enqueue → admission), TTFT (enqueue → first token),
+    per-decode-step and per-token latency histograms, plus active-slot /
+    free-slot / free-page gauges for the continuous scheduler."""
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        from ..profiler.telemetry import get_registry
+        r = get_registry()
+        _TELEMETRY = {
+            "requests": r.counter("paddle_serving_requests_total",
+                                  "generate() requests accepted",
+                                  labels=("engine",)),
+            "queue_wait": r.histogram(
+                "paddle_serving_queue_wait_seconds",
+                "enqueue -> scheduler admission", labels=("engine",)),
+            "ttft": r.histogram("paddle_serving_ttft_seconds",
+                                "enqueue -> first generated token",
+                                labels=("engine",)),
+            "decode_step": r.histogram(
+                "paddle_serving_decode_step_seconds",
+                "one fixed-shape decode step over all active slots"),
+            "token": r.histogram(
+                "paddle_serving_token_latency_seconds",
+                "per-token decode latency (step time / active slots)"),
+            "tokens": r.counter("paddle_serving_tokens_generated_total",
+                                "tokens generated", labels=("engine",)),
+            "qdepth": r.gauge("paddle_serving_queue_depth",
+                              "requests waiting in the engine queue"),
+            "active": r.gauge("paddle_serving_active_slots",
+                              "continuous-scheduler slots decoding"),
+            "free_slots": r.gauge("paddle_serving_free_slots",
+                                  "continuous-scheduler slots free"),
+            "free_pages": r.gauge("paddle_serving_free_pages",
+                                  "KV-cache pages not backing live context"),
+        }
+    return _TELEMETRY
+
 
 class _Request:
     def __init__(self, ids, max_new_tokens, kwargs):
@@ -30,6 +71,8 @@ class _Request:
         self.done = threading.Event()
         self.result = None
         self.error = None
+        self.t_submit = time.perf_counter()
+        self.t_first = None            # first-token time (TTFT)
 
 
 class ServingEngine:
@@ -42,6 +85,7 @@ class ServingEngine:
     """
 
     _STOP = object()
+    _ENGINE = "static"             # telemetry label
 
     def __init__(self, model, max_batch_size=8, batch_window_s=0.005,
                  use_paged_cache=True, page_size=16):
@@ -64,7 +108,10 @@ class ServingEngine:
         ids = input_ids.numpy() if isinstance(input_ids, Tensor) \
             else np.asarray(input_ids)
         req = _Request(ids, max_new_tokens, kwargs)
+        tele = _telemetry()
+        tele["requests"].inc(engine=self._ENGINE)
         self._q.put(req)
+        tele["qdepth"].set(self._q.qsize())
         deadline = None if timeout is None else time.monotonic() + timeout
         while not req.done.is_set():
             remaining = (None if deadline is None
@@ -168,10 +215,15 @@ class ServingEngine:
                 pass
 
     def _serve(self):
+        tele = _telemetry()
         while self._running:
             group = self._collect()
             if group is None:
                 break
+            t_admit = time.perf_counter()
+            for r in group:
+                tele["queue_wait"].observe(t_admit - r.t_submit,
+                                           engine=self._ENGINE)
             try:
                 batch = np.concatenate([r.ids for r in group], axis=0)
                 kwargs = dict(group[0].kwargs)
@@ -184,6 +236,16 @@ class ServingEngine:
                 arr = np.asarray(out.numpy())
                 self.batches_run += 1
                 prompt_len = group[0].ids.shape[1]
+                # the static window batcher emits the whole completion at
+                # once, so first-token time == completion time
+                t_done = time.perf_counter()
+                for r in group:
+                    r.t_first = t_done
+                    tele["ttft"].observe(t_done - r.t_submit,
+                                         engine=self._ENGINE)
+                tele["tokens"].inc(
+                    (arr.shape[1] - prompt_len) * arr.shape[0],
+                    engine=self._ENGINE)
                 eos = kwargs.get("eos_token_id")
                 row = 0
                 for r in group:
@@ -244,6 +306,7 @@ class ContinuousServingEngine:
     """
 
     _STOP = ServingEngine._STOP
+    _ENGINE = "continuous"         # telemetry label
 
     def __init__(self, model, max_batch_size=8, page_size=16, max_len=2048,
                  pad_token_id=0):
@@ -288,9 +351,12 @@ class ContinuousServingEngine:
     # -- scheduler ----------------------------------------------------------
     def _admit(self, cache, free, active, pending):
         from ..models.generation import _sample_logits
+        tele = _telemetry()
         while free and pending:
             row = pending.pop(0)
             slot = free.pop(0)
+            tele["queue_wait"].observe(
+                time.perf_counter() - row.req.t_submit, engine=self._ENGINE)
             cache.begin_prefill(slot)
             s = row.prompt.shape[0]
             logits = self.model.forward(
@@ -308,6 +374,12 @@ class ContinuousServingEngine:
     def _push_token(self, cache, free, active, slot, token):
         row = active[slot]
         row.generated.append(token)
+        tele = _telemetry()
+        tele["tokens"].inc(engine=self._ENGINE)
+        if row.req.t_first is None:
+            row.req.t_first = time.perf_counter()
+            tele["ttft"].observe(row.req.t_first - row.req.t_submit,
+                                 engine=self._ENGINE)
         eos = row.req.kwargs.get("eos_token_id")
         if (eos is not None and token == eos) or \
                 len(row.generated) >= row.req.max_new_tokens:
@@ -392,12 +464,22 @@ class ContinuousServingEngine:
                             active[i] = None
                             cache.free(i)
                             free.append(i)
+                tele = _telemetry()
                 try:
                     if self._running:
                         self._admit(cache, free, active, pending)
                     mask = np.asarray([r is not None for r in active])
+                    n_active = int(mask.sum())
+                    tele["active"].set(n_active)
+                    tele["free_slots"].set(len(free))
+                    # pages not backing live context (page_size-granular)
+                    used_pages = int(np.ceil(cache.lens / cache.page_size)
+                                     .sum())
+                    tele["free_pages"].set(
+                        self.max_batch * cache.pages_per_seq - used_pages)
                     if not mask.any():
                         continue
+                    t_step = time.perf_counter()
                     # ONE fixed-shape decode step for every active slot
                     cache.begin_decode(mask)
                     cur = np.full((self.max_batch, 1), self.pad_token_id,
@@ -411,6 +493,11 @@ class ContinuousServingEngine:
                                                 position_ids=pos)
                     lg = logits._data[:, -1].astype(jnp.float32)
                     self.decode_steps += 1
+                    step_dt = time.perf_counter() - t_step
+                    tele["decode_step"].observe(step_dt)
+                    # every active slot earned one token this step
+                    for _ in range(n_active):
+                        tele["token"].observe(step_dt / max(n_active, 1))
                     greedy = np.asarray(jnp.argmax(lg, axis=-1))
                     for i, r in enumerate(list(active)):
                         if r is None:

@@ -21,6 +21,34 @@ import jax
 from ..framework.core import Tensor
 from ..framework import random as prandom
 
+_TELEMETRY = None      # lazily bound registry families
+
+
+def _telemetry():
+    """DataLoader metrics in the unified registry: how long the train
+    loop WAITED for each batch (a stalled input pipeline shows up here
+    long before it shows in step time), prefetch-queue depth (0 = the
+    accelerator is starved, full = input-bound nowhere), and worker
+    failures."""
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        from ..profiler.telemetry import get_registry
+        r = get_registry()
+        _TELEMETRY = {
+            "wait": r.histogram("paddle_dataloader_batch_wait_seconds",
+                                "train-loop wall time blocked waiting for "
+                                "the next batch"),
+            "batches": r.counter("paddle_dataloader_batches_total",
+                                 "batches handed to the consumer"),
+            "depth": r.gauge("paddle_dataloader_queue_depth",
+                             "prefetch queue depth at the last batch "
+                             "handoff"),
+            "failures": r.counter("paddle_dataloader_worker_failures_total",
+                                  "worker pools torn down because a worker "
+                                  "process died or raised"),
+        }
+    return _TELEMETRY
+
 
 # ---------------------------------------------------------------------------
 # datasets
@@ -436,6 +464,7 @@ class _MultiprocessIter:
                 bidx, batch, err = self.result_queue.get(timeout=5)
             except (TimeoutError, queue.Empty):
                 if not any(p.is_alive() for p in self.workers):
+                    _telemetry()["failures"].inc()
                     self._shutdown()
                     raise RuntimeError(
                         "DataLoader workers exited unexpectedly")
@@ -443,6 +472,7 @@ class _MultiprocessIter:
             except QueueClosed:
                 raise StopIteration    # interrupted for shutdown
             if err is not None:
+                _telemetry()["failures"].inc()
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
             self._pending[bidx] = batch
@@ -734,13 +764,26 @@ class DataLoader:
         self._yielded = base
 
         def counted():
+            import time as _time
+            tele = _telemetry()
+            it = iter(inner_it)
+            q = getattr(inner_it, "q", None)   # prefetch queue, if any
             try:
-                for item in inner_it:
+                while True:
+                    t0 = _time.perf_counter()
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        self._yielded = 0      # clean epoch end
+                        break
+                    tele["wait"].observe(_time.perf_counter() - t0)
+                    tele["batches"].inc()
+                    if q is not None:
+                        tele["depth"].set(q.qsize())
                     # count BEFORE handing out: a checkpoint inside the
                     # loop body sees the current batch as consumed
                     self._yielded += 1
                     yield item
-                self._yielded = 0      # clean epoch end
             finally:
                 stop = getattr(inner_it, "shutdown", None)
                 if stop:               # break/early-stop: retire prefetch

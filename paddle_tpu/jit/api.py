@@ -12,6 +12,7 @@ fallback, matching SOT's fallback semantics.
 from __future__ import annotations
 
 import functools
+import time
 import warnings
 
 import numpy as np
@@ -27,6 +28,34 @@ _static_mode = [False]  # paddle.enable_static (legacy static-graph mode flag)
 _TRACING = [False]
 _STATIC_ACTIVE = [False]   # inside StaticFunction.__call__'s trace (the only
                            # context with an InTraceAutogradNeeded handler)
+
+_JIT_METRICS = None        # lazily bound registry families
+
+
+def _jit_metrics():
+    global _JIT_METRICS
+    if _JIT_METRICS is None:
+        from ..profiler.telemetry import get_registry
+        r = get_registry()
+        _JIT_METRICS = {
+            "cache": r.counter(
+                "paddle_jit_cache_total",
+                "to_static program-cache lookups", labels=("event",)),
+            "compile": r.histogram(
+                "paddle_jit_compile_seconds",
+                "trace+compile+first-run seconds per to_static cache miss"),
+            "breaks": r.counter(
+                "paddle_jit_graph_breaks_total",
+                "tracer graph breaks (data-dependent Python control flow)"),
+            "fallback": r.counter(
+                "paddle_jit_eager_fallback_total",
+                "to_static calls served eager by a latched dy2static "
+                "fallback"),
+            "converted": r.counter(
+                "paddle_jit_dy2static_conversions_total",
+                "specs rebuilt through dy2static control-flow conversion"),
+        }
+    return _JIT_METRICS
 
 _GRAPH_BREAK_ERRORS = (
     jax.errors.TracerBoolConversionError,
@@ -218,7 +247,10 @@ class StaticFunction:
         leaves, treedef = jax.tree.flatten((args, kwargs), is_leaf=_is_tensor)
         tensor_leaves = [l for l in leaves if isinstance(l, Tensor)]
         key, pinned = _spec_key(args, kwargs, training)
+        tm = _jit_metrics()
         entry = self._cache.get(key)
+        tm["cache"].inc(event="hit" if entry is not None else "miss")
+        t_miss = None if entry is not None else time.perf_counter()
         if entry is None:
             sg_flags = [t.stop_gradient for t in tensor_leaves]
             # a spec that already needed control-flow conversion tells us
@@ -231,6 +263,7 @@ class StaticFunction:
                      "call_fn": conv}
             self._cache[key] = entry
         if entry["fallback"]:
+            tm["fallback"].inc()
             return self._call_eager(*args, **kwargs)
 
         rng_key = prandom.next_key()
@@ -268,10 +301,12 @@ class StaticFunction:
                 # a data-dependent branch: convert Python if/while on
                 # tensor values into lax.cond/while_loop (reference
                 # convert_ifelse/convert_while) and stay compiled
+                tm["breaks"].inc()
                 conv = (self._get_converted()
                         if not entry.get("converted") else None)
                 if conv is None:
                     raise
+                tm["converted"].inc()
                 sg_flags = [t.stop_gradient for t in tensor_leaves]
                 entry["core"] = self._make_core(treedef, leaves, kwargs,
                                                 params, bufs, sg_flags,
@@ -284,6 +319,8 @@ class StaticFunction:
             # transient tracer error doesn't permanently degrade the spec;
             # genuinely dynamic code (use static.nn.cond/while_loop to stay
             # compiled) latches on the next call
+            tm["breaks"].inc()
+            tm["fallback"].inc()
             entry["breaks"] += 1
             entry["fallback"] = entry["breaks"] >= 2
             warnings.warn(
@@ -296,6 +333,11 @@ class StaticFunction:
             _STATIC_ACTIVE[0] = prev_static
 
         entry["breaks"] = 0     # a clean traced call re-arms the retry
+        if t_miss is not None:
+            # a miss pays trace + XLA compile + first run; later hits on
+            # this spec are pure cache dispatch — the spread between this
+            # histogram and steady-state step time IS the compile cost
+            tm["compile"].observe(time.perf_counter() - t_miss)
         with no_grad():
             for b, nb in zip(bufs, new_bufs):
                 b._data = nb._data if isinstance(nb, Tensor) else nb

@@ -35,6 +35,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept either name so
+# the kernels (and their CPU interpret-mode tests) work across versions
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 NEG_INF = float(-1e30)   # large-negative instead of -inf: keeps exp()/where() NaN-free
 
 # Tunable via env for the MFU sweep (BASELINE.md): block sizes set the
@@ -212,7 +217,7 @@ def _fwd(q, k, v, causal, sm_scale, q_offset, kv_offset, block_q, block_k,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -371,7 +376,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((b, hq, sq_pad, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -397,7 +402,7 @@ def _bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
                    jax.ShapeDtypeStruct((b, hq, sk_pad, d), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

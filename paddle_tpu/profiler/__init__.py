@@ -20,10 +20,18 @@ from collections import defaultdict
 
 import jax
 
+from .telemetry import (  # noqa: F401  (re-exported facade)
+    MetricRegistry, SpanTracer, Span, get_registry, get_tracer,
+    metrics, metrics_text, enable_op_telemetry, disable_op_telemetry,
+    op_telemetry, spans_to_chrome,
+)
+
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "RecordEvent", "load_profiler_result",
     "benchmark", "comm_stats",
+    "MetricRegistry", "SpanTracer", "get_registry", "get_tracer",
+    "metrics", "metrics_text", "enable_op_telemetry", "disable_op_telemetry",
 ]
 
 
@@ -83,19 +91,26 @@ def _default_scheduler(step):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
-    """on_trace_ready callback: dump the collected host-op summary as a
-    chrome-tracing JSON next to the jax xplane dump."""
+    """on_trace_ready callback: dump the recorded spans as a
+    chrome-tracing JSON next to the jax xplane dump. Events carry REAL
+    per-span begin timestamps, durations and per-thread ``tid`` from the
+    span tracer (readable in Perfetto) — not a fabricated sequential
+    timeline from cumulative op totals."""
     def handler(prof):
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"worker_{os.getpid()}"
         path = os.path.join(dir_name, f"{name}.pt.trace.json")
-        events = []
-        t = 0
-        for op, (cnt, total) in sorted(prof._op_stats.items()):
-            events.append({"name": op, "ph": "X", "pid": 0, "tid": 0,
-                           "ts": t, "dur": max(total * 1e6, 1),
-                           "args": {"calls": cnt}})
-            t += max(total * 1e6, 1)
+        events = spans_to_chrome(prof._drain_spans())
+        if not events:
+            # timer_only / span-less window: fall back to the op summary
+            # (still one event per op, zero-based synthetic timeline,
+            # flagged as such so consumers can tell)
+            t = 0
+            for op, (cnt, total) in sorted(prof._op_stats.items()):
+                events.append({"name": op, "ph": "X", "pid": 0, "tid": 0,
+                               "ts": t, "dur": max(total * 1e6, 1),
+                               "args": {"calls": cnt, "synthetic_ts": True}})
+                t += max(total * 1e6, 1)
         with open(path, "w") as f:
             json.dump({"traceEvents": events,
                        "displayTimeUnit": "ms"}, f)
@@ -108,18 +123,22 @@ def export_protobuf(dir_name, worker_name=None):
 
 
 class RecordEvent:
-    """User annotation: shows in the device trace via TraceAnnotation and in
-    the host op table. Usable as context manager or begin()/end()."""
+    """User annotation: a real nested span in the host trace (span tracer:
+    wall-clock begin/duration, thread id, parent linkage) plus a
+    TraceAnnotation in the device trace. Usable as context manager or
+    begin()/end()."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ann = None
         self._t0 = None
+        self._span = None
 
     def begin(self):
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
         self._t0 = time.perf_counter()
+        self._span = get_tracer().begin(self.name, kind="user")
         prof = Profiler._current
         if prof is not None and prof._recording:
             prof._open_events.append(self)
@@ -128,6 +147,9 @@ class RecordEvent:
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
+        if self._span is not None:
+            get_tracer().end(self._span)
+            self._span = None
         prof = Profiler._current
         if prof is not None and prof._recording and self._t0 is not None:
             dt = time.perf_counter() - self._t0
@@ -180,15 +202,30 @@ class Profiler:
         self._jax_tracing = False
         self._trace_dir = None
         self._exported_path = None
+        self._spans = []
 
     # -- tape hook ----------------------------------------------------------
     def _record_op(self, op_name, dt):
         cnt, total = self._op_stats[op_name]
         self._op_stats[op_name] = (cnt + 1, total + dt)
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.add_complete(op_name, dt, kind="op")
+
+    def _drain_spans(self):
+        """Spans recorded since the last drain (tracer + carried-over)."""
+        self._spans.extend(get_tracer().drain())
+        out, self._spans = self._spans, []
+        return out
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         Profiler._current = self
+        # session hygiene: a prior profiler with no on_trace_ready leaves
+        # its completed spans queued in the process-global tracer — this
+        # session's exports must not inherit them
+        get_tracer().drain()
+        self._spans = []
         from ..autograd import tape
         tape._profiler = self
         self._transition(self._scheduler(self._step))
@@ -212,11 +249,15 @@ class Profiler:
         self._t_step = now
         self._step += 1
         new = self._scheduler(self._step)
-        if (new != self._state):
-            ret = self._state == ProfilerState.RECORD_AND_RETURN
+        # fire once per RETURNING step, not once per state CHANGE: a
+        # scheduler yielding RECORD_AND_RETURN on consecutive steps must
+        # export each completed window, not silently skip all but the
+        # first (each export drains the spans/ops of its own window)
+        ret = self._state == ProfilerState.RECORD_AND_RETURN
+        if new != self._state:
             self._transition(new)
-            if ret and self._on_trace_ready:
-                self._on_trace_ready(self)
+        if ret and self._on_trace_ready:
+            self._on_trace_ready(self)
 
     def _transition(self, new):
         rec_states = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
@@ -230,6 +271,7 @@ class Profiler:
 
     def _start_recording(self):
         self._recording = True
+        get_tracer().enable()
         if not self._timer_only and any(t != ProfilerTarget.CPU
                                         for t in self.targets):
             self._trace_dir = os.environ.get("PADDLE_PROFILER_XPLANE_DIR",
@@ -242,6 +284,11 @@ class Profiler:
 
     def _stop_recording(self):
         self._recording = False
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.disable()
+            # completed spans of this window stay queued in the tracer
+            # until the export handler (or the next one) drains them
         if self._jax_tracing:
             try:
                 jax.profiler.stop_trace()
@@ -310,11 +357,17 @@ class _Benchmark:
     def end(self):
         if self._t0 is not None:
             self._elapsed = time.perf_counter() - self._t0
+            self._t0 = None            # timer stopped; elapsed is final
 
     def ips(self):
-        if not self._elapsed:
-            self.end()
-        denom = self._elapsed or 1e-9
+        # while the timer is RUNNING, throughput is live (elapsed up to
+        # now) — the old implicit end() latched _elapsed on the first
+        # read and every later ips() reported that stale window
+        if self._t0 is not None:
+            elapsed = time.perf_counter() - self._t0
+        else:
+            elapsed = self._elapsed
+        denom = elapsed or 1e-9
         return (self._samples or self._steps) / denom
 
     def step_info(self, unit="samples"):
