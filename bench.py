@@ -177,46 +177,61 @@ def bench_resnet():
     return out
 
 
-def _telemetry_overhead_pct(run_step, sync, steps=10):
+def _telemetry_overhead_pct(run_step, sync, steps=10, instrumented_step=None,
+                            setup=None, teardown=None):
     """Cost of the observability layer itself, measured in-situ: the same
     jitted step with the full per-step telemetry surface in the loop
     (span begin/end + step-time histogram + counter + gauge) vs bare.
     Emitted with every resnet bench so a regression in the telemetry hot
-    path shows up as a perf delta, not as silent slow training."""
-    from paddle_tpu.profiler.telemetry import get_registry, get_tracer
+    path shows up as a perf delta, not as silent slow training.
 
-    reg = get_registry()
-    hist = reg.histogram("bench_step_seconds", "bench overhead probe")
-    ctr = reg.counter("bench_steps_total", "bench overhead probe")
-    gauge = reg.gauge("bench_last_step_seconds", "bench overhead probe")
-    tracer = get_tracer()
+    ``instrumented_step`` overrides the default full-telemetry step —
+    callers (the flight-recorder overhead guard) time their own
+    instrumentation surface against the same bare loop; ``setup`` /
+    ``teardown`` bracket the instrumented timing window."""
+    if instrumented_step is None:
+        from paddle_tpu.profiler.telemetry import get_registry, get_tracer
 
-    def timed(instrumented):
+        reg = get_registry()
+        hist = reg.histogram("bench_step_seconds", "bench overhead probe")
+        ctr = reg.counter("bench_steps_total", "bench overhead probe")
+        gauge = reg.gauge("bench_last_step_seconds", "bench overhead probe")
+        tracer = get_tracer()
+
+        def instrumented_step():
+            sp = tracer.begin("bench_step")
+            t1 = time.perf_counter()
+            r = run_step()
+            d = time.perf_counter() - t1
+            tracer.end(sp)
+            hist.observe(d)
+            ctr.inc()
+            gauge.set(d)
+            return r
+
+        setup = tracer.enable
+
+        def teardown():
+            tracer.disable()
+            tracer.drain()             # don't leak probe spans to exports
+
+    def timed(fn):
         t0 = time.perf_counter()
         r = None
         for _ in range(steps):
-            if instrumented:
-                sp = tracer.begin("bench_step")
-                t1 = time.perf_counter()
-                r = run_step()
-                d = time.perf_counter() - t1
-                tracer.end(sp)
-                hist.observe(d)
-                ctr.inc()
-                gauge.set(d)
-            else:
-                r = run_step()
+            r = fn()
         sync(r)
         return time.perf_counter() - t0
 
-    timed(False)                       # warm both paths
-    t_plain = timed(False)
-    tracer.enable()
+    timed(run_step)                    # warm both paths
+    t_plain = timed(run_step)
+    if setup is not None:
+        setup()
     try:
-        t_instr = timed(True)
+        t_instr = timed(instrumented_step)
     finally:
-        tracer.disable()
-        tracer.drain()                 # don't leak probe spans to exports
+        if teardown is not None:
+            teardown()
     return round((t_instr - t_plain) / max(t_plain, 1e-9) * 100, 3)
 
 

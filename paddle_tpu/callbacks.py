@@ -298,6 +298,7 @@ class TelemetryCallback(Callback):
         self._m = None
         self._monitor = None
         self._t_batch = None
+        self._flight = None
 
     def _metrics(self):
         if self._m is None:
@@ -322,6 +323,8 @@ class TelemetryCallback(Callback):
 
     def on_train_begin(self, logs=None):
         self._metrics()
+        from .profiler import flight_recorder
+        self._flight = flight_recorder
         if self.track_ops:
             from .profiler.telemetry import enable_op_telemetry
             enable_op_telemetry()
@@ -347,6 +350,10 @@ class TelemetryCallback(Callback):
     def on_train_batch_end(self, step, logs=None):
         if self._t_batch is None:
             return
+        if self._flight is not None:
+            # flight-recorder liveness: the watchdog's "is training still
+            # stepping" signal (no-op bool check when the recorder is off)
+            self._flight.heartbeat()
         dt = max(time.perf_counter() - self._t_batch, 1e-9)
         m = self._metrics()
         m["step"].observe(dt)

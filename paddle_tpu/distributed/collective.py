@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
+from ..profiler import flight_recorder as _flight
 from . import simulator
 from .parallel_env import get_rank, get_world_size
 
@@ -342,16 +343,21 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
     group = group or _get_default_group()
     if group.nranks == 1:
         return _Task()
-    _record_comm("all_reduce", _np(tensor).nbytes, group)
-    if simulator.active_world() is None:
-        dev = _device_reduce(_np(tensor), _normalize_op(op), group)
-        if dev is not None:
-            _write_back(tensor, dev)
-            return _Task()
-    got = _exchange("all_reduce", _np(tensor), group)
-    vals = [got[i] for i in range(group.nranks)]
-    _write_back(tensor, _reduce_fn(op)(vals))
-    return _Task()
+    arr = _np(tensor)
+    _record_comm("all_reduce", arr.nbytes, group)
+    ev = _flight.collective_begin("all_reduce", arr.nbytes, group.ranks)
+    try:
+        if simulator.active_world() is None:
+            dev = _device_reduce(arr, _normalize_op(op), group)
+            if dev is not None:
+                _write_back(tensor, dev)
+                return _Task()
+        got = _exchange("all_reduce", arr, group)
+        vals = [got[i] for i in range(group.nranks)]
+        _write_back(tensor, _reduce_fn(op)(vals))
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
@@ -359,11 +365,16 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     if group.nranks == 1:
         tensor_list.append(Tensor(tensor._data) if isinstance(tensor, Tensor) else Tensor(tensor))
         return _Task()
-    _record_comm("all_gather", _np(tensor).nbytes, group)
-    got = _exchange("all_gather", _np(tensor), group)
-    for i in range(group.nranks):
-        tensor_list.append(Tensor(jnp.asarray(got[i])))
-    return _Task()
+    arr = _np(tensor)
+    _record_comm("all_gather", arr.nbytes, group)
+    ev = _flight.collective_begin("all_gather", arr.nbytes, group.ranks)
+    try:
+        got = _exchange("all_gather", arr, group)
+        for i in range(group.nranks):
+            tensor_list.append(Tensor(jnp.asarray(got[i])))
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def all_gather_object(object_list, obj, group=None):
@@ -371,9 +382,13 @@ def all_gather_object(object_list, obj, group=None):
     if group.nranks == 1:
         object_list.append(obj)
         return
-    got = _exchange("all_gather_object", obj, group)
-    for i in range(group.nranks):
-        object_list.append(got[i])
+    ev = _flight.collective_begin("all_gather_object", 0, group.ranks)
+    try:
+        got = _exchange("all_gather_object", obj, group)
+        for i in range(group.nranks):
+            object_list.append(got[i])
+    finally:
+        _flight.collective_end(ev)
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -384,21 +399,26 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=Tru
         return _Task()
     stacked = np.stack([_np(t) for t in tensor_list])  # [nranks, ...] local inputs
     _record_comm("reduce_scatter", stacked.nbytes, group)
-    mine = group.rank
-    if simulator.active_world() is None:
-        dev = _device_reduce_scatter(stacked, op, group)
-        if dev is not None:
-            _write_back(tensor, dev)
-            return _Task()
-        dev = _device_reduce(stacked, _normalize_op(op), group)
-        if dev is not None:
-            _write_back(tensor, dev[mine])
-            return _Task()
-    got = _exchange("reduce_scatter", stacked, group)
-    all_stacked = [got[i] for i in range(group.nranks)]  # per-rank [nranks, ...]
-    reduced = _reduce_fn(op)([s[mine] for s in all_stacked])
-    _write_back(tensor, reduced)
-    return _Task()
+    ev = _flight.collective_begin("reduce_scatter", stacked.nbytes,
+                                  group.ranks)
+    try:
+        mine = group.rank
+        if simulator.active_world() is None:
+            dev = _device_reduce_scatter(stacked, op, group)
+            if dev is not None:
+                _write_back(tensor, dev)
+                return _Task()
+            dev = _device_reduce(stacked, _normalize_op(op), group)
+            if dev is not None:
+                _write_back(tensor, dev[mine])
+                return _Task()
+        got = _exchange("reduce_scatter", stacked, group)
+        all_stacked = [got[i] for i in range(group.nranks)]  # per-rank [nranks, ...]
+        reduced = _reduce_fn(op)([s[mine] for s in all_stacked])
+        _write_back(tensor, reduced)
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
@@ -407,17 +427,21 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         out_tensor_list.extend(Tensor(t._data) for t in in_tensor_list)
         return _Task()
     stacked = np.stack([_np(t) for t in in_tensor_list])
-    if simulator.active_world() is None:
-        dev = _device_alltoall(stacked, group)
-        if dev is not None:
-            for i in range(group.nranks):
-                out_tensor_list.append(Tensor(jnp.asarray(dev[i])))
-            return _Task()
-    got = _exchange("alltoall", stacked, group)
-    mine = group.rank
-    for i in range(group.nranks):
-        out_tensor_list.append(Tensor(jnp.asarray(got[i][mine])))
-    return _Task()
+    ev = _flight.collective_begin("alltoall", stacked.nbytes, group.ranks)
+    try:
+        if simulator.active_world() is None:
+            dev = _device_alltoall(stacked, group)
+            if dev is not None:
+                for i in range(group.nranks):
+                    out_tensor_list.append(Tensor(jnp.asarray(dev[i])))
+                return _Task()
+        got = _exchange("alltoall", stacked, group)
+        mine = group.rank
+        for i in range(group.nranks):
+            out_tensor_list.append(Tensor(jnp.asarray(got[i][mine])))
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
@@ -428,44 +452,64 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         _write_back(out_tensor, _np(in_tensor))
         return _Task()
     arr = _np(in_tensor)
-    splits = in_split_sizes or [arr.shape[0] // n] * n
-    offs = np.cumsum([0] + list(splits))
-    chunks = [arr[offs[i]:offs[i + 1]] for i in range(n)]
-    got = _exchange("alltoall_single", chunks, group)
-    mine = group.rank
-    out = np.concatenate([got[i][mine] for i in range(n)], axis=0)
-    _write_back(out_tensor, out)
-    return _Task()
+    ev = _flight.collective_begin("alltoall_single", arr.nbytes, group.ranks)
+    try:
+        splits = in_split_sizes or [arr.shape[0] // n] * n
+        offs = np.cumsum([0] + list(splits))
+        chunks = [arr[offs[i]:offs[i + 1]] for i in range(n)]
+        got = _exchange("alltoall_single", chunks, group)
+        mine = group.rank
+        out = np.concatenate([got[i][mine] for i in range(n)], axis=0)
+        _write_back(out_tensor, out)
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def broadcast(tensor, src, group=None, sync_op=True):
     group = group or _get_default_group()
     if group.nranks == 1:
         return _Task()
-    got = _exchange("broadcast", _np(tensor), group)
-    src_group_rank = group.get_group_rank(src) if src in group.ranks else src
-    _write_back(tensor, got[src_group_rank])
-    return _Task()
+    arr = _np(tensor)
+    ev = _flight.collective_begin("broadcast", arr.nbytes, group.ranks)
+    try:
+        got = _exchange("broadcast", arr, group)
+        src_group_rank = group.get_group_rank(src) if src in group.ranks \
+            else src
+        _write_back(tensor, got[src_group_rank])
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def broadcast_object_list(object_list, src, group=None):
     group = group or _get_default_group()
     if group.nranks == 1:
         return
-    got = _exchange("broadcast_object_list", list(object_list), group)
-    src_group_rank = group.get_group_rank(src) if src in group.ranks else src
-    object_list[:] = got[src_group_rank]
+    ev = _flight.collective_begin("broadcast_object_list", 0, group.ranks)
+    try:
+        got = _exchange("broadcast_object_list", list(object_list), group)
+        src_group_rank = group.get_group_rank(src) if src in group.ranks \
+            else src
+        object_list[:] = got[src_group_rank]
+    finally:
+        _flight.collective_end(ev)
 
 
 def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
     group = group or _get_default_group()
     if group.nranks == 1:
         return _Task()
-    got = _exchange("reduce", _np(tensor), group)
-    if get_rank() == dst:
-        vals = [got[i] for i in range(group.nranks)]
-        _write_back(tensor, _reduce_fn(op)(vals))
-    return _Task()
+    arr = _np(tensor)
+    ev = _flight.collective_begin("reduce", arr.nbytes, group.ranks)
+    try:
+        got = _exchange("reduce", arr, group)
+        if get_rank() == dst:
+            vals = [got[i] for i in range(group.nranks)]
+            _write_back(tensor, _reduce_fn(op)(vals))
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
@@ -475,11 +519,17 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
             _write_back(tensor, _np(tensor_list[0]))
         return _Task()
     payload = [_np(t) for t in tensor_list] if tensor_list else None
-    got = _exchange("scatter", payload, group)
-    src_group_rank = group.get_group_rank(src) if src in group.ranks else src
-    chunks = got[src_group_rank]
-    _write_back(tensor, chunks[group.rank])
-    return _Task()
+    nbytes = sum(a.nbytes for a in payload) if payload else 0
+    ev = _flight.collective_begin("scatter", nbytes, group.ranks)
+    try:
+        got = _exchange("scatter", payload, group)
+        src_group_rank = group.get_group_rank(src) if src in group.ranks \
+            else src
+        chunks = got[src_group_rank]
+        _write_back(tensor, chunks[group.rank])
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
@@ -490,12 +540,18 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
         if gather_list is not None:
             gather_list.append(Tensor(_np(tensor)))
         return _Task()
-    got = _exchange("gather", _np(tensor), group)
-    dst_group_rank = group.get_group_rank(dst) if dst in group.ranks else dst
-    if group.rank == dst_group_rank and gather_list is not None:
-        for i in range(group.nranks):
-            gather_list.append(Tensor(jnp.asarray(got[i])))
-    return _Task()
+    arr = _np(tensor)
+    ev = _flight.collective_begin("gather", arr.nbytes, group.ranks)
+    try:
+        got = _exchange("gather", arr, group)
+        dst_group_rank = group.get_group_rank(dst) if dst in group.ranks \
+            else dst
+        if group.rank == dst_group_rank and gather_list is not None:
+            for i in range(group.nranks):
+                gather_list.append(Tensor(jnp.asarray(got[i])))
+        return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
@@ -506,16 +562,25 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
     if group.nranks == 1:
         out_object_list.append(in_object_list[0])
         return
-    got = _exchange("scatter_object_list", in_object_list, group)
-    src_group_rank = group.get_group_rank(src) if src in group.ranks else src
-    out_object_list.append(got[src_group_rank][group.rank])
+    ev = _flight.collective_begin("scatter_object_list", 0, group.ranks)
+    try:
+        got = _exchange("scatter_object_list", in_object_list, group)
+        src_group_rank = group.get_group_rank(src) if src in group.ranks \
+            else src
+        out_object_list.append(got[src_group_rank][group.rank])
+    finally:
+        _flight.collective_end(ev)
 
 
 def barrier(group=None):
     group = group or _get_default_group()
     if group.nranks == 1:
         return
-    _exchange("barrier", None, group)
+    ev = _flight.collective_begin("barrier", 0, group.ranks)
+    try:
+        _exchange("barrier", None, group)
+    finally:
+        _flight.collective_end(ev)
 
 
 # ---------------------------------------------------------------------------
@@ -593,44 +658,54 @@ def _gid(group: Group) -> str:
 def send(tensor, dst=0, group=None, sync_op=True):
     w = simulator.active_world()
     group = group or _get_default_group()
-    if w is not None:
-        gkey = tuple(group.ranks)  # group identity = rank set (ids differ per rank)
-        seq = w.next_tag("p2p_send", (gkey, simulator.current_rank(), dst))[2]
-        w.rendezvous.put((gkey, simulator.current_rank(), dst, seq),
-                         _np(tensor))
+    arr = _np(tensor)
+    ev = _flight.collective_begin("send", arr.nbytes, group.ranks)
+    try:
+        if w is not None:
+            gkey = tuple(group.ranks)  # group identity = rank set (ids differ per rank)
+            seq = w.next_tag("p2p_send",
+                             (gkey, simulator.current_rank(), dst))[2]
+            w.rendezvous.put((gkey, simulator.current_rank(), dst, seq), arr)
+            return _Task()
+        if get_world_size() <= 1:
+            raise RuntimeError("send/recv needs a multi-process launch or "
+                               "the thread simulator")
+        store = _p2p_store()
+        me, gid = get_rank(), _gid(group)
+        k = ("s", gid, me, dst)
+        seq = _P2P_SEQ[k] = _P2P_SEQ.get(k, -1) + 1
+        store.set(f"p2p/{gid}/{me}>{dst}/{seq}", _p2p_pack(arr))
         return _Task()
-    if get_world_size() <= 1:
-        raise RuntimeError("send/recv needs a multi-process launch or the "
-                           "thread simulator")
-    store = _p2p_store()
-    me, gid = get_rank(), _gid(group)
-    k = ("s", gid, me, dst)
-    seq = _P2P_SEQ[k] = _P2P_SEQ.get(k, -1) + 1
-    store.set(f"p2p/{gid}/{me}>{dst}/{seq}", _p2p_pack(_np(tensor)))
-    return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
     w = simulator.active_world()
     group = group or _get_default_group()
-    if w is not None:
-        gkey = tuple(group.ranks)
-        seq = w.next_tag("p2p_recv", (gkey, src, simulator.current_rank()))[2]
-        val = w.rendezvous.get((gkey, src, simulator.current_rank(), seq))
+    ev = _flight.collective_begin("recv", _np(tensor).nbytes, group.ranks)
+    try:
+        if w is not None:
+            gkey = tuple(group.ranks)
+            seq = w.next_tag("p2p_recv",
+                             (gkey, src, simulator.current_rank()))[2]
+            val = w.rendezvous.get((gkey, src, simulator.current_rank(), seq))
+            _write_back(tensor, val)
+            return _Task()
+        if get_world_size() <= 1:
+            raise RuntimeError("send/recv needs a multi-process launch or "
+                               "the thread simulator")
+        store = _p2p_store()
+        me, gid = get_rank(), _gid(group)
+        k = ("r", gid, src, me)
+        seq = _P2P_SEQ[k] = _P2P_SEQ.get(k, -1) + 1
+        key = f"p2p/{gid}/{src}>{me}/{seq}"
+        val = _p2p_unpack(store.get(key, wait=True))
+        store.delete_key(key)
         _write_back(tensor, val)
         return _Task()
-    if get_world_size() <= 1:
-        raise RuntimeError("send/recv needs a multi-process launch or the "
-                           "thread simulator")
-    store = _p2p_store()
-    me, gid = get_rank(), _gid(group)
-    k = ("r", gid, src, me)
-    seq = _P2P_SEQ[k] = _P2P_SEQ.get(k, -1) + 1
-    key = f"p2p/{gid}/{src}>{me}/{seq}"
-    val = _p2p_unpack(store.get(key, wait=True))
-    store.delete_key(key)
-    _write_back(tensor, val)
-    return _Task()
+    finally:
+        _flight.collective_end(ev)
 
 
 isend = send

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ...profiler import flight_recorder as _flight
 from .. import simulator
 from .. import collective as _collective
 from .quantization import (DEFAULT_BLOCK_SIZE, decode_wire, encode_wire,
@@ -71,7 +72,11 @@ def allreduce_array(flat: np.ndarray, group=None, op=None, scheme="int8",
         return _postreduce([decoded], op, 1)
     if payload is None:   # device-tier branch reached with a >1 group
         payload, _ = encode_wire(send, scheme, block_size)
-    got = _collective._exchange(kind, payload, group)
+    ev = _flight.collective_begin(kind, wire, group.ranks)
+    try:
+        got = _collective._exchange(kind, payload, group)
+    finally:
+        _flight.collective_end(ev)
     vals = [decode_wire(got[i], flat.size, block_size) for i in range(n)]
     return _postreduce(vals, op, n)
 
@@ -101,7 +106,11 @@ def reduce_scatter_array(stacked: np.ndarray, group=None, op=None,
     if n == 1:
         return _postreduce([decoded.reshape(stacked.shape)[0]], op, 1)
     mine = group.rank
-    got = _collective._exchange(kind, payload, group)
+    ev = _flight.collective_begin(kind, wire, group.ranks)
+    try:
+        got = _collective._exchange(kind, payload, group)
+    finally:
+        _flight.collective_end(ev)
     slices = [decode_wire(got[i], flat.size, block_size)
               .reshape(stacked.shape)[mine] for i in range(n)]
     return _postreduce(slices, op, n)

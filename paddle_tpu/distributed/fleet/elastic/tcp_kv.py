@@ -11,6 +11,53 @@ import json
 import time
 
 
+class MemKVStore:
+    """In-process KV with the TcpKVStore interface — the thread-rank
+    simulator tier of cross-rank aggregation (flight-recorder snapshot
+    gathering in tests / single-host jobs). Values take the same JSON
+    round trip as the TCP store so anything published here would also
+    survive the wire."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._d: dict = {}
+
+    def put(self, key, value):
+        raw = json.dumps({"value": value, "ts": time.time()})
+        with self._lock:
+            self._d[key] = raw
+
+    def get(self, key):
+        with self._lock:
+            raw = self._d.get(key)
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)["value"]
+        except ValueError:
+            return None
+
+    def delete(self, key):
+        with self._lock:
+            self._d.pop(key, None)
+
+    def keys(self, prefix=""):
+        with self._lock:
+            return [k for k in self._d if k.startswith(prefix)]
+
+    def age(self, key):
+        with self._lock:
+            raw = self._d.get(key)
+        try:
+            return time.time() - json.loads(raw)["ts"]
+        except (TypeError, ValueError):
+            return None
+
+    def close(self):
+        pass
+
+
 class TcpKVStore:
     """FileKVStore-interface adapter over ``distributed.native.TCPStore``.
 

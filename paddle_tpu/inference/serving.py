@@ -61,6 +61,18 @@ def _telemetry():
     return _TELEMETRY
 
 
+def _engine_state(engine) -> dict:
+    """Request-queue / scheduler state snapshot for flight-recorder dumps
+    (a post-hang dump must show what the serving tier was doing)."""
+    state = {"engine": engine._ENGINE, "running": engine._running,
+             "queue_depth": engine._q.qsize()}
+    for attr in ("batches_run", "decode_steps", "prefills", "max_batch"):
+        v = getattr(engine, attr, None)
+        if v is not None:
+            state[attr] = v
+    return state
+
+
 class _Request:
     def __init__(self, ids, max_new_tokens, kwargs):
         self.ids = np.asarray(ids)
@@ -149,6 +161,14 @@ class ServingEngine:
         except queue.Empty:
             pass
         self._running = True
+        import weakref
+        from ..profiler import flight_recorder as _flight
+        self._flight_key = f"serving_{self._ENGINE}_{id(self):x}"
+        wr = weakref.ref(self)     # the provider registry must not keep a
+        #                            stopped-but-unstopped engine alive
+        _flight.register_state_provider(
+            self._flight_key,
+            lambda: _engine_state(wr()) if wr() is not None else {})
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
@@ -157,6 +177,11 @@ class ServingEngine:
         if not self._running and self._thread is None:
             return
         self._running = False
+        key = getattr(self, "_flight_key", None)
+        if key is not None:
+            from ..profiler import flight_recorder as _flight
+            _flight.unregister_state_provider(key)
+            self._flight_key = None
         self._q.put(self._STOP)
         if self._thread is not None:
             self._thread.join(timeout=30)

@@ -465,6 +465,11 @@ class _MultiprocessIter:
             except (TimeoutError, queue.Empty):
                 if not any(p.is_alive() for p in self.workers):
                     _telemetry()["failures"].inc()
+                    from ..profiler import flight_recorder as _flight
+                    _flight.record_event(
+                        "dataloader_worker_failure",
+                        error="DataLoader workers exited unexpectedly",
+                        exitcodes=[p.exitcode for p in self.workers])
                     self._shutdown()
                     raise RuntimeError(
                         "DataLoader workers exited unexpectedly")
@@ -473,6 +478,12 @@ class _MultiprocessIter:
                 raise StopIteration    # interrupted for shutdown
             if err is not None:
                 _telemetry()["failures"].inc()
+                # the traceback goes into the flight ring too — a
+                # post-hang dump must explain input-pipeline deaths, not
+                # just count them
+                from ..profiler import flight_recorder as _flight
+                _flight.record_event("dataloader_worker_failure",
+                                     traceback=str(err))
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
             self._pending[bidx] = batch

@@ -25,6 +25,12 @@ from .telemetry import (  # noqa: F401  (re-exported facade)
     metrics, metrics_text, enable_op_telemetry, disable_op_telemetry,
     op_telemetry, spans_to_chrome,
 )
+from . import flight_recorder  # noqa: F401
+from .flight_recorder import (  # noqa: F401  (re-exported facade)
+    FlightRecorder, Watchdog, get_flight_recorder, gather_metrics,
+    publish_snapshot, merge_chrome_traces, merge_rank_snapshots,
+    desync_report, straggler_report,
+)
 
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
@@ -32,6 +38,9 @@ __all__ = [
     "benchmark", "comm_stats",
     "MetricRegistry", "SpanTracer", "get_registry", "get_tracer",
     "metrics", "metrics_text", "enable_op_telemetry", "disable_op_telemetry",
+    "FlightRecorder", "Watchdog", "get_flight_recorder", "gather_metrics",
+    "publish_snapshot", "merge_chrome_traces", "merge_rank_snapshots",
+    "desync_report", "straggler_report",
 ]
 
 

@@ -333,14 +333,22 @@ class MetricRegistry:
         return "\n".join(lines) + ("\n" if lines else "")
 
     def export_jsonl(self, path, extra=None) -> dict:
-        """Append one JSON snapshot line to ``path``; returns the record."""
+        """Append one JSON snapshot line to ``path``; returns the record.
+
+        Multi-process safe: the whole line goes down in a single
+        ``os.write`` on an ``O_APPEND`` fd, so concurrent ranks
+        appending to one file (bench_telemetry.jsonl) can interleave
+        only whole lines, never partial ones."""
         rec = {"unix_time": time.time(), "metrics": self.collect()}
         if extra:
             rec.update(extra)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
-        with open(path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            os.write(fd, (json.dumps(rec) + "\n").encode())
+        finally:
+            os.close(fd)
         return rec
 
     def reset(self):

@@ -5,6 +5,13 @@
 # asks for benches FIRST — this is the closest achievable: benches fire in
 # the first healthy window). Only one process may touch the TPU, so this
 # watcher is the sole chip client until it exits.
+# `tpu_watch.sh metrics [path]`: follow the live metrics_text() dump the
+# flight-recorder watchdog rewrites each poll (PADDLE_METRICS_TEXT_PATH;
+# see docs/OBSERVABILITY.md "Flight recorder & distributed diagnosis").
+if [ "$1" = "metrics" ]; then
+  exec tail -F "${2:-${PADDLE_METRICS_TEXT_PATH:-/tmp/paddle_metrics.prom}}"
+fi
+
 OUT=${1:-/root/repo/BENCH_TPU_SESSION.json}
 LOG=/tmp/tpu_watch.log
 cd /root/repo

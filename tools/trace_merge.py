@@ -1,0 +1,146 @@
+"""Merge per-rank flight-recorder dumps / chrome traces into one view.
+
+Two input shapes, auto-detected per file:
+
+* chrome traces (``{"traceEvents": [...]}``, e.g. the Profiler's
+  ``worker_*.pt.trace.json`` per-rank exports) — merged into ONE trace
+  with one pid per rank (``--trace out.json``);
+* flight-recorder dumps (``flight_rank*.json``, schema
+  ``paddle_flight_recorder/1``) — merged into a cross-rank
+  desync/straggler report (``--report out.json``) that names the rank
+  and collective seq id a hang is stuck on.
+
+The rank of a file comes from its payload (dumps carry ``rank``) or
+from a ``rank<N>`` substring in the filename, else its position.
+
+Usage:
+    python tools/trace_merge.py --trace merged.json rank*.trace.json
+    python tools/trace_merge.py --report report.json flight_rank*.json
+    python tools/trace_merge.py --report r.json --trace t.json <mixed...>
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_FR = None
+
+
+def _fr():
+    """The flight_recorder module. It is stdlib-only, so load it straight
+    from its file — the CLI must not drag in jax just to merge JSON."""
+    global _FR
+    if _FR is None:
+        mod = sys.modules.get("paddle_tpu.profiler.flight_recorder")
+        if mod is not None:              # already imported (tests)
+            _FR = mod
+        else:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "paddle_tpu", "profiler",
+                                "flight_recorder.py")
+            spec = importlib.util.spec_from_file_location(
+                "_flight_recorder_cli", path)
+            _FR = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(_FR)
+    return _FR
+
+
+def _rank_of(path, payload, fallback):
+    if isinstance(payload, dict) and isinstance(payload.get("rank"), int):
+        return payload["rank"]
+    m = re.search(r"rank[_-]?(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else fallback
+
+
+def load_inputs(paths):
+    """Split the input files into ({rank: trace}, {rank: dump})."""
+    traces, dumps = {}, {}
+    idx = 0
+    for pattern in paths:
+        hits = sorted(glob.glob(pattern)) or [pattern]
+        for path in hits:
+            with open(path) as f:
+                payload = json.load(f)
+            rank = _rank_of(path, payload, idx)
+            idx += 1
+            if isinstance(payload, dict) and "traceEvents" in payload:
+                traces[rank] = payload
+            elif isinstance(payload, dict) and "events" in payload:
+                dumps[rank] = payload
+            else:
+                print(f"trace_merge: skipping {path} (neither a chrome "
+                      "trace nor a flight dump)", file=sys.stderr)
+    return traces, dumps
+
+
+def build_report(dumps: dict) -> dict:
+    fr = _fr()
+    events_by_rank = {r: d.get("collectives", d.get("events", []))
+                      for r, d in dumps.items()}
+    return {
+        "schema": fr.REPORT_SCHEMA,
+        "source": "trace_merge",
+        "ranks": sorted(dumps),
+        "reasons": {r: d.get("reason") for r, d in dumps.items()},
+        "stalled_heartbeat_ranks": sorted(
+            {r for d in dumps.values() for r in d.get("stalled_ranks", [])}),
+        "desync": fr.desync_report(events_by_rank, world=sorted(dumps)),
+        "straggler": fr.straggler_report(events_by_rank),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-rank flight dumps / traces")
+    ap.add_argument("inputs", nargs="+",
+                    help="per-rank json files (globs ok)")
+    ap.add_argument("--trace", help="write merged chrome trace here")
+    ap.add_argument("--report", help="write cross-rank report here")
+    args = ap.parse_args(argv)
+    if not args.trace and not args.report:
+        ap.error("need --trace and/or --report")
+
+    traces, dumps = load_inputs(args.inputs)
+    fr = _fr()
+
+    if args.trace:
+        if not traces:
+            print("trace_merge: no chrome traces among the inputs",
+                  file=sys.stderr)
+            return 2
+        merged = fr.merge_chrome_traces(traces)
+        with open(args.trace, "w") as f:
+            json.dump(merged, f)
+        print(f"trace_merge: {len(traces)} rank trace(s) -> {args.trace} "
+              f"({len(merged['traceEvents'])} events)")
+
+    if args.report:
+        if not dumps:
+            print("trace_merge: no flight dumps among the inputs",
+                  file=sys.stderr)
+            return 2
+        report = build_report(dumps)
+        with open(args.report, "w") as f:
+            json.dump(report, f)
+        stalled = report["desync"]["stalled"]
+        if stalled:
+            for s in stalled:
+                print(f"trace_merge: DESYNC rank {s['rank']} never entered "
+                      f"seq {s['missing_seq']} "
+                      f"(op={s['op']}, last_seq={s['last_seq']})")
+        else:
+            print("trace_merge: no desync across "
+                  f"{len(dumps)} rank dump(s)")
+        print(f"trace_merge: report -> {args.report}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
