@@ -608,7 +608,13 @@ def bench_dispatch():
 
 def bench_llama_decode():
     """Serving-tier decode bench: batched autoregressive decode through the
-    paged KV cache + Pallas paged_attention kernel (tokens/sec)."""
+    paged KV cache + Pallas paged_attention kernel (tokens/sec).
+
+    ``BENCH_SHARED_PREFIX=1`` switches to the engine-level variant: the
+    batch shares a common system prompt served through
+    ``ContinuousServingEngine``'s prefix cache (one warm-up request fills
+    the index; the timed requests prefill only their unique tails), and
+    the record carries the measured prefix hit rate."""
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -616,6 +622,7 @@ def bench_llama_decode():
     batch = int(os.environ.get("BENCH_BATCH", "8"))
     prompt = int(os.environ.get("BENCH_PROMPT", "128"))
     new = int(os.environ.get("BENCH_NEW_TOKENS", "128"))
+    shared_prefix = os.environ.get("BENCH_SHARED_PREFIX", "0") == "1"
 
     paddle.seed(0)
     cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
@@ -624,6 +631,45 @@ def bench_llama_decode():
                       max_position_embeddings=max(2048, prompt + new))
     model = LlamaForCausalLM(cfg)
     rng = np.random.default_rng(0)
+
+    if shared_prefix:
+        import threading
+        from paddle_tpu.inference import ContinuousServingEngine
+        tail = int(os.environ.get("BENCH_TAIL", "16"))
+        sys_prompt = rng.integers(0, cfg.vocab_size, prompt - tail)
+        prompts = [np.concatenate([sys_prompt,
+                                   rng.integers(0, cfg.vocab_size, tail)])
+                   .astype(np.int64)[None] for _ in range(batch)]
+        eng = ContinuousServingEngine(
+            model, max_batch_size=batch, max_len=prompt + new,
+            enable_prefix_cache=True)
+        with eng:
+            # first request prefills + registers the shared blocks
+            eng.generate(prompts[0], max_new_tokens=new, timeout=1800)
+            t0 = time.perf_counter()
+            threads = [threading.Thread(
+                target=lambda p=p: eng.generate(p, max_new_tokens=new,
+                                                timeout=1800))
+                for p in prompts[1:]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            cache = eng._cache
+            lookups = max(cache.prefix_hits + cache.prefix_misses, 1)
+            hit_rate = round(cache.prefix_hits / lookups, 3)
+            cached = cache.cached_tokens_total
+        return {
+            "metric": "llama_paged_decode_tokens_per_sec",
+            "value": round((batch - 1) * new / dt, 2),
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "shared_prefix": True,
+            "prefix_hit_rate": hit_rate,
+            "prefix_cached_tokens": int(cached),
+        }
+
     ids = paddle.to_tensor(rng.integers(0, cfg.vocab_size,
                                         (batch, prompt)).astype(np.int64))
     model.generate(ids, max_new_tokens=4, use_paged_cache=True)  # warmup
@@ -636,6 +682,90 @@ def bench_llama_decode():
         "value": round(batch * new / dt, 2),
         "unit": "tokens/sec",
         "vs_baseline": None,
+    }
+
+
+def bench_serving():
+    """Engine-level serving fast-path bench (``BENCH_MODEL=serving``):
+    TTFT and decode throughput through ``ContinuousServingEngine`` with a
+    shared system prompt, prefix cache ON vs OFF in the same run — the
+    paper's production story (millions of users share system prompts /
+    few-shot templates; arxiv 2605.25645 shows prefix reuse is the
+    dominant TTFT lever on TPU)."""
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.inference import ContinuousServingEngine
+
+    n_req = int(os.environ.get("BENCH_REQUESTS", "8"))
+    sys_len = int(os.environ.get("BENCH_SYS_PROMPT", "128"))
+    tail = int(os.environ.get("BENCH_TAIL", "8"))
+    new = int(os.environ.get("BENCH_NEW_TOKENS", "8"))
+    chunk = int(os.environ.get("BENCH_CHUNK_TOKENS", "64"))
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=256,
+                      intermediate_size=704, num_hidden_layers=4,
+                      num_attention_heads=8, num_key_value_heads=4,
+                      max_position_embeddings=max(2048, sys_len + tail + new))
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    sys_prompt = rng.integers(0, cfg.vocab_size, sys_len)
+    prompts = [np.concatenate([sys_prompt,
+                               rng.integers(0, cfg.vocab_size, tail)])
+               .astype(np.int64)[None] for _ in range(n_req)]
+
+    def run(prefix_cache):
+        eng = ContinuousServingEngine(
+            model, max_batch_size=4, max_len=sys_len + tail + new + 16,
+            enable_prefix_cache=prefix_cache, prefill_chunk_tokens=chunk)
+        stats = {}
+        with eng:
+            # request 0 warms compiled programs AND (when enabled) fills
+            # the prefix index with the shared system-prompt blocks
+            eng.generate(prompts[0], max_new_tokens=new, timeout=1800)
+            ttfts = []
+            for p in prompts[1:]:
+                t0 = time.perf_counter()
+                eng.generate(p, max_new_tokens=1, timeout=1800)
+                ttfts.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            threads = [threading.Thread(
+                target=lambda p=p: eng.generate(p, max_new_tokens=new,
+                                                timeout=1800))
+                for p in prompts[1:]]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            cache = eng._cache
+            stats = {
+                "ttft_ms": round(float(np.mean(ttfts)) * 1e3, 2),
+                "tokens_per_sec": round((n_req - 1) * new / dt, 2),
+                "prefix_hits": int(cache.prefix_hits),
+                "prefix_misses": int(cache.prefix_misses),
+                "cached_tokens": int(cache.cached_tokens_total),
+            }
+        return stats
+
+    off = run(False)
+    on = run(True)
+    return {
+        "metric": "serving_prefix_ttft_speedup",
+        "value": round(off["ttft_ms"] / max(on["ttft_ms"], 1e-6), 2),
+        "unit": "x (mean TTFT, prefix cache off / on, shared sys prompt)",
+        "vs_baseline": None,
+        "ttft_cached_ms": on["ttft_ms"],
+        "ttft_nocache_ms": off["ttft_ms"],
+        "tokens_per_sec_cached": on["tokens_per_sec"],
+        "tokens_per_sec_nocache": off["tokens_per_sec"],
+        "prefix_hits": on["prefix_hits"],
+        "prefix_cached_tokens": on["cached_tokens"],
+        "config": {"requests": n_req, "sys_prompt": sys_len, "tail": tail,
+                   "new_tokens": new, "chunk_tokens": chunk},
     }
 
 
@@ -664,8 +794,14 @@ def _emit_telemetry_snapshot(out):
             else:
                 summary[name] = {k or "_": v
                                  for k, v in fam["series"].items()}
-        print(json.dumps({"aux_metric": "telemetry_snapshot",
-                          "families": summary}), file=sys.stderr)
+        aux = {"aux_metric": "telemetry_snapshot"}
+        hits = summary.get("paddle_serving_prefix_hits", {}).get("_", 0)
+        misses = summary.get("paddle_serving_prefix_misses", {}).get("_", 0)
+        if hits or misses:
+            # prefix-cache regressions must show up in EVERY bench run
+            aux["prefix_hit_rate"] = round(hits / max(hits + misses, 1), 3)
+        aux["families"] = summary
+        print(json.dumps(aux), file=sys.stderr)
         path = os.environ.get(
             "BENCH_TELEMETRY_JSONL",
             os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -680,6 +816,7 @@ def _child_main():
     mode = os.environ.get("BENCH_MODEL", "resnet")
     out = (bench_llama() if mode == "llama"
            else bench_llama_decode() if mode == "llama_decode"
+           else bench_serving() if mode == "serving"
            else bench_data() if mode == "data"
            else bench_dispatch() if mode == "dispatch"
            else bench_bert() if mode == "bert"
@@ -837,6 +974,7 @@ def main():
         "metric": ("llama_1b_train_tokens_per_sec" if mode == "llama"
                    else "llama_paged_decode_tokens_per_sec"
                    if mode == "llama_decode"
+                   else "serving_prefix_ttft_speedup" if mode == "serving"
                    else "dataloader_hbm_samples_per_sec" if mode == "data"
                    else "eager_dispatch_overhead_vs_jax"
                    if mode == "dispatch"
@@ -846,7 +984,7 @@ def main():
         "value": None,
         "unit": ("tokens/sec" if mode in ("llama", "llama_decode")
                  else "samples/sec" if mode == "data"
-                 else "x" if mode == "dispatch"
+                 else "x" if mode in ("dispatch", "serving")
                  else "ms/step" if mode == "bert"
                  else "bytes" if mode == "comm"
                  else "images/sec"),

@@ -33,7 +33,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry", "get_registry",
     "metrics", "metrics_text", "Span", "SpanTracer", "get_tracer",
     "enable_op_telemetry", "disable_op_telemetry", "op_telemetry",
-    "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_LATENCY_BUCKETS", "DEFAULT_RATIO_BUCKETS",
 ]
 
 # Prometheus-style cumulative latency bounds (seconds). ``inf`` is
@@ -42,6 +42,10 @@ DEFAULT_LATENCY_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+# Bounds for [0, 1]-valued observations (utilization / occupancy ratios —
+# e.g. the serving engine's chunk-budget utilization histogram).
+DEFAULT_RATIO_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
 
 _INF = float("inf")
 

@@ -195,6 +195,7 @@ def bench_kernels(mode: str):
         "resnet": [],
         "llama": [_fa_kernel_id()],
         "llama_decode": [_fa_kernel_id(), "paged_attention"],
+        "serving": [_fa_kernel_id(), "paged_attention"],
         "data": [],
     }.get(mode, [])
 
