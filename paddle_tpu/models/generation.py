@@ -371,7 +371,11 @@ class SlotPagedKVCache:
         self._n_blocks[slot] = matched
         cached = matched * self.page_size
         self.lens[slot] = cached
-        missed = max(len(prompt) // self.page_size - matched, 0)
+        # misses are real index lookups that came back empty — with the
+        # cache disabled there are no lookups, so the hit rate stays
+        # meaningful across mixed on/off runs
+        missed = (max(len(prompt) // self.page_size - matched, 0)
+                  if self.enable_prefix_cache else 0)
         self.prefix_hits += matched
         self.prefix_misses += missed
         self.cached_tokens_total += cached
