@@ -167,6 +167,31 @@ def unregister_post_backward_callback(cb):
         lst.remove(cb)
 
 
+# grad-ready callbacks: fn(tensor) fired DURING run_backward the moment a
+# leaf tensor's gradient is final for the current backward (every reachable
+# consumer of that leaf has been processed). This is the signal the
+# ready-bucket comm scheduler (distributed/comm/bucketer.py) keys on to
+# dispatch a bucket's collective while the rest of backward still runs —
+# the analogue of the reference reducer's per-variable Hook
+# (``reducer.cc::AddDistHook``), where post-backward callbacks above are
+# the analogue of its finalize flush. Thread-local for the same reason:
+# each simulated rank observes only its own backward.
+
+
+def register_grad_ready_callback(cb):
+    lst = getattr(_post_backward_tls, "ready_callbacks", None)
+    if lst is None:
+        lst = _post_backward_tls.ready_callbacks = []
+    lst.append(cb)
+    return cb
+
+
+def unregister_grad_ready_callback(cb):
+    lst = getattr(_post_backward_tls, "ready_callbacks", None)
+    if lst and cb in lst:
+        lst.remove(cb)
+
+
 _op_inspect = [None]   # auto_parallel completion hook: (op_name, out) -> None
 
 
@@ -338,6 +363,12 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
     ``egr::Backward``). ``capture``: id(tensor) -> slot, used by paddle.grad;
     when given + accumulate=False, grads are written there instead of ``.grad``."""
     grads = grads or [None] * len(tensors)
+    # grad-ready firing is an accumulate-mode feature (paddle.grad capture
+    # never owns .grad finality); snapshot the list so callbacks that
+    # unregister themselves mid-backward don't skew iteration
+    ready_cbs = (list(getattr(_post_backward_tls, "ready_callbacks", ()))
+                 if accumulate else [])
+    seed_leaves = []   # root tensors that got their grad in the seed loop
     # ---- seed
     seeds = []  # (node, out_idx, grad) or leaf accumulation
     for t, g in zip(tensors, grads):
@@ -354,6 +385,8 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
                 capture[id(t)] = _accum(capture[id(t)], g)
             elif accumulate and not t.stop_gradient:
                 t.grad = Tensor(_accum(t.grad._data if t.grad is not None else None, g))
+                if ready_cbs:
+                    seed_leaves.append(t)
         else:
             if accumulate and t._retain_grads and not t.stop_gradient:
                 # a non-leaf backward root with retain_grads gets the seed grad
@@ -361,6 +394,9 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
             seeds.append((t._grad_node, t._out_idx, g))
 
     if not seeds:
+        for t in seed_leaves:
+            for cb in ready_cbs:
+                cb(t)
         return
 
     # ---- collect reachable graph
@@ -384,6 +420,20 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
         for (_, prod, _) in node_objs[nid].edges:
             if prod is not None and id(prod) in nodes:
                 consumers[id(prod)] += 1
+
+    # ---- leaf finality counts (grad-ready hooks): a leaf's gradient is
+    # final once every reachable edge pointing at it has been processed —
+    # only then may the ready callbacks (comm overlap) read t.grad
+    leaf_pending: dict[int, int] = {}
+    if ready_cbs:
+        for nid in nodes:
+            for (t, prod, _) in node_objs[nid].edges:
+                if prod is None and not t.stop_gradient:
+                    leaf_pending[id(t)] = leaf_pending.get(id(t), 0) + 1
+        for t in seed_leaves:
+            if id(t) not in leaf_pending:
+                for cb in ready_cbs:
+                    cb(t)
 
     out_grads: dict[int, dict[int, Any]] = {nid: {} for nid in nodes}
     for node, idx, g in seeds:
@@ -409,7 +459,18 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
             n.pure_fn = None    # free the replay closure's pinned inputs too
         out_grads[id(n)] = None  # free
         for (t, prod, pidx), g in zip(n.edges, in_grads):
+            # finality bookkeeping counts the edge even when its cotangent
+            # is symbolically zero (None/float0) — the leaf is "done" with
+            # this consumer either way
+            final = False
+            if ready_cbs and prod is None and not t.stop_gradient:
+                c = leaf_pending[id(t)] - 1
+                leaf_pending[id(t)] = c
+                final = c == 0
             if g is None or (hasattr(g, "dtype") and g.dtype == _FLOAT0):
+                if final:
+                    for cb in ready_cbs:
+                        cb(t)
                 continue
             g = _run_hooks(t, g)
             is_capture = capture is not None and id(t) in capture
@@ -418,6 +479,9 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
             if prod is None or t._retain_grads:
                 if accumulate and not t.stop_gradient and not is_capture:
                     t.grad = Tensor(_accum(t.grad._data if t.grad is not None else None, g))
+            if final:
+                for cb in ready_cbs:
+                    cb(t)
             if prod is not None and id(prod) in nodes:
                 d = out_grads[id(prod)]
                 d[pidx] = _accum(d.get(pidx), g)

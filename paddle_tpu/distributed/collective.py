@@ -139,6 +139,36 @@ class _Task:
 # ---------------------------------------------------------------------------
 
 
+_SIM_WIRE = [None]   # (lat_seconds, bytes_per_second) | False when off
+
+
+def _sim_wire_cost():
+    """Optional simulated-wire fidelity for the thread-rank tier: the
+    in-memory rendezvous is instantaneous, so comm/compute overlap has
+    nothing to hide on a laptop — these knobs model a real interconnect's
+    per-collective latency (``PADDLE_SIM_WIRE_LAT_US``) and bandwidth
+    (``PADDLE_SIM_WIRE_GBPS``) as idle sleep after each exchange. Off by
+    default (no behavior change); ``BENCH_MODEL=comm`` enables it for the
+    overlapped-vs-barrier comparison (both variants pay the same cost)."""
+    v = _SIM_WIRE[0]
+    if v is None:
+        import os
+        lat = float(os.environ.get("PADDLE_SIM_WIRE_LAT_US", "0")) * 1e-6
+        gbps = float(os.environ.get("PADDLE_SIM_WIRE_GBPS", "0"))
+        v = _SIM_WIRE[0] = (lat, gbps * 2 ** 30) if (lat or gbps) else False
+    return v
+
+
+def _payload_nbytes(v):
+    if isinstance(v, (bytes, bytearray)):
+        return len(v)
+    if hasattr(v, "nbytes"):
+        return int(v.nbytes)
+    if isinstance(v, (tuple, list)):
+        return sum(_payload_nbytes(x) for x in v)
+    return 0
+
+
 def _exchange(kind: str, value, group: Group):
     """All ranks in ``group`` deposit ``value``; returns {group_rank: value}."""
     w = simulator.active_world()
@@ -148,6 +178,13 @@ def _exchange(kind: str, value, group: Group):
         # object; ids differ but the ranks tuple is the collective's name)
         tag = w.next_tag(kind, tuple(group.ranks))
         got = w.rendezvous.exchange(tag, rank, value, tuple(group.ranks))
+        wire = _sim_wire_cost()
+        if wire:
+            import time as _time
+            lat, bps = wire
+            recv = sum(_payload_nbytes(v) for r, v in got.items()
+                       if r != rank)
+            _time.sleep(lat + (recv / bps if bps else 0.0))
         return {group.get_group_rank(r): v for r, v in got.items()}
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils

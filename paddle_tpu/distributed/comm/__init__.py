@@ -32,7 +32,7 @@ from .collectives import (  # noqa: F401
     all_reduce_quantized, reduce_scatter_quantized, allreduce_array,
     reduce_scatter_array, PASSTHROUGH,
 )
-from .bucketer import GradientBucketer  # noqa: F401
+from .bucketer import GradientBucketer, ReadyBucketScheduler  # noqa: F401
 
 
 def comm_config_from_strategy(strategy) -> dict:

@@ -17,12 +17,39 @@ parameter, the legacy per-tensor wire pattern). Each parameter owns a
 """
 from __future__ import annotations
 
+import os
+import threading
+import time
+import zlib
+
 import numpy as np
 
 from .. import collective as _collective
+from .. import simulator
 from ...framework.core import Tensor
 from .collectives import PASSTHROUGH, allreduce_array, reduce_scatter_array
 from .quantization import DEFAULT_BLOCK_SIZE
+
+_OVERLAP_TELEMETRY = None
+
+
+def _overlap_telemetry():
+    """Lazily bound registry families for the comm/compute overlap path."""
+    global _OVERLAP_TELEMETRY
+    if _OVERLAP_TELEMETRY is None:
+        from ...profiler.telemetry import get_registry
+        r = get_registry()
+        _OVERLAP_TELEMETRY = {
+            "buckets": r.counter(
+                "paddle_comm_overlap_buckets_total",
+                "gradient buckets dispatched by the ready-bucket scheduler",
+                labels=("where",)),
+            "wait": r.histogram(
+                "paddle_comm_overlap_wait_seconds",
+                "seconds blocked on in-flight gradient collectives at the "
+                "step boundary"),
+        }
+    return _OVERLAP_TELEMETRY
 
 
 class _Bucket:
@@ -105,6 +132,16 @@ class GradientBucketer:
 
     # -- exchange ------------------------------------------------------------
     def _flatten(self, bucket, arrays):
+        # single-tensor buckets (fuse 0, or one large embedding grad that
+        # fills a bucket alone) skip the assembly buffer: no zero-fill and
+        # no copy-in — the device->host transfer already yields a fresh
+        # flat vector with the identical layout (offset 0, no alignment
+        # padding possible when the bucket holds exactly its one tensor)
+        if len(bucket.items) == 1:
+            (i, _off, numel, _shape) = bucket.items[0]
+            a = arrays[i]
+            if a is not None and numel == bucket.numel:
+                return np.asarray(a, bucket.dtype).reshape(-1)
         flat = np.zeros(bucket.numel, bucket.dtype)
         for (i, off, numel, _shape) in bucket.items:
             a = arrays[i]
@@ -136,23 +173,38 @@ class GradientBucketer:
         all-reduce gather-tier volume per direction while every rank
         still ends with the full reduced vector.
         """
+        out = [None] * len(self._params)
+        for bi in range(len(self._buckets)):
+            red = self.exchange_bucket(bi, arrays, group=group, op=op,
+                                       use_reduce_scatter=use_reduce_scatter)
+            self._scatter_bucket(bi, red, arrays, out)
+        return out
+
+    def exchange_bucket(self, bi, arrays, group=None, op=None,
+                        use_reduce_scatter=False):
+        """Run ONE bucket's collective and return the reduced flat vector.
+
+        This is the unit the ready-bucket scheduler dispatches
+        asynchronously; ``sync_arrays`` is the barrier composition of it
+        over every bucket."""
         group = group or _collective._get_default_group()
         op = op if op is not None else _collective.ReduceOp.AVG
-        out = [None] * len(self._params)
-        for bi, bucket in enumerate(self._buckets):
-            flat = self._flatten(bucket, arrays)
-            if self._quantizable(bucket):
-                red = self._sync_flat_quantized(bi, bucket, flat, group, op,
-                                                use_reduce_scatter)
-            else:
-                red = self._sync_flat_plain(bucket, flat, group, op,
+        bucket = self._buckets[bi]
+        flat = self._flatten(bucket, arrays)
+        if self._quantizable(bucket):
+            red = self._sync_flat_quantized(bi, bucket, flat, group, op,
                                             use_reduce_scatter)
-            red = np.asarray(red).ravel()
-            for (i, off, numel, shape) in bucket.items:
-                if arrays[i] is not None:
-                    out[i] = red[off:off + numel].reshape(shape).astype(
-                        bucket.dtype, copy=False)
-        return out
+        else:
+            red = self._sync_flat_plain(bucket, flat, group, op,
+                                        use_reduce_scatter)
+        return np.asarray(red).ravel()
+
+    def _scatter_bucket(self, bi, red, arrays, out):
+        bucket = self._buckets[bi]
+        for (i, off, numel, shape) in bucket.items:
+            if arrays[i] is not None:
+                out[i] = red[off:off + numel].reshape(shape).astype(
+                    bucket.dtype, copy=False)
 
     def _sync_flat_quantized(self, bi, bucket, flat, group, op, use_rs):
         residual = self._residual(bi, flat.size)
@@ -219,3 +271,291 @@ class GradientBucketer:
             if r is not None:
                 p._data = jnp.asarray(r, dtype=p._data.dtype)
         return self
+
+
+# ---------------------------------------------------------------------------
+# ready-bucket overlap scheduling
+# ---------------------------------------------------------------------------
+
+
+class _AsyncBucketWork:
+    """Handle for one in-flight bucket collective queued on a scheduler's
+    persistent rank worker — the thread-rank simulator's analogue of an
+    async collective handle."""
+
+    __slots__ = ("_done", "_result", "_error", "name")
+
+    def __init__(self, name):
+        self._done = threading.Event()
+        self._result = None
+        self._error = None
+        self.name = name
+
+    def _finish(self, result, error):
+        self._result = result
+        self._error = error
+        self._done.set()
+
+    def wait(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"in-flight gradient collective '{self.name}' did not "
+                f"complete within {timeout}s — a peer rank likely skipped "
+                f"this step (its bucket was never dispatched); disable "
+                f"overlap (DistributedStrategy.comm_overlap=False / "
+                f"PADDLE_COMM_OVERLAP=0) for uneven-step workloads")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+def _inflight_limit():
+    """Concurrent in-flight bucket collectives per scheduler. One lane
+    serializes the whole wire pipeline behind a single blocking exchange
+    (overlap then hides at most one bucket's latency); real async
+    collectives keep several transfers in flight, so the sim tier does
+    too. Bounded — a thread per bucket starves the GIL-heavy backward."""
+    return max(1, int(os.environ.get("PADDLE_COMM_OVERLAP_INFLIGHT", "4")))
+
+
+class _RankWorker:
+    """A small persistent dispatch pool per scheduler (persistent — thread
+    churn measurably starves the GIL-heavy backward; per-scheduler — tags
+    are namespaced per (scheduler, bucket, round), so lanes of different
+    schedulers never pair). Buckets leave the queue in ready order but may
+    complete out of order across lanes: each bucket's collective
+    rendezvouses on its own namespaced tag, so cross-rank pairing is
+    order-independent and the pipelined exchange cannot deadlock — every
+    dispatched bucket eventually gets a lane, and a genuinely skipped
+    rank surfaces as the handle's wait timeout."""
+
+    def __init__(self, rank, name, nthreads=None):
+        import queue
+        self._q = queue.Queue()
+        self._rank = rank
+        self._threads = []
+        for i in range(nthreads or _inflight_limit()):
+            t = threading.Thread(target=self._run, name=f"{name}.{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def submit(self, fn, handle):
+        self._q.put((fn, handle))
+
+    def close(self):
+        for _ in self._threads:
+            self._q.put(None)
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, handle = item
+            try:
+                handle._finish(fn(), None)
+            except BaseException as e:  # noqa: BLE001 — re-raised at wait()
+                handle._finish(None, e)
+
+
+class _DoneWork:
+    """Handle for a bucket exchanged inline (non-simulator tiers: the
+    device dispatch itself is async under jax)."""
+
+    __slots__ = ("_result",)
+
+    def __init__(self, result):
+        self._result = result
+
+    def wait(self, timeout=None):
+        return self._result
+
+
+class ReadyBucketScheduler:
+    """Ready-bucket overlap driver over a :class:`GradientBucketer`.
+
+    Fed by the tape's grad-ready hooks
+    (``autograd.tape.register_grad_ready_callback``): the moment the last
+    gradient of a bucket lands during backward, the bucket's (optionally
+    quantized) collective is dispatched asynchronously — a worker thread
+    in the thread-rank simulator tier, inline (jax async dispatch) on the
+    device tiers — and :meth:`finish` at the step boundary waits only on
+    the outstanding handles, dispatches any partial leftovers, and writes
+    the reduced gradients back. Numerics are bit-identical to the barrier
+    path: the same ``exchange_bucket`` runs per bucket, only earlier.
+
+    ``name`` must be unique per concurrently-active scheduler (e.g. a
+    ``DataParallel`` reducer and a ``HybridParallelOptimizer`` exchange on
+    the same rank): it namespaces the simulator collective tags.
+    """
+
+    def __init__(self, bucketer, name="dp", group=None, op=None,
+                 use_reduce_scatter=False, wait_timeout=None):
+        self._b = bucketer
+        self._name = name
+        self._group = group
+        self._op = op
+        self._use_rs = bool(use_reduce_scatter)
+        if wait_timeout is None:
+            wait_timeout = float(
+                os.environ.get("PADDLE_COMM_OVERLAP_TIMEOUT_S", "120"))
+        self._wait_timeout = wait_timeout
+        self._param_slot = {id(p): i for i, p in enumerate(bucketer._params)}
+        self._bucket_of = {}
+        for bi, bucket in enumerate(bucketer._buckets):
+            for it in bucket.items:
+                self._bucket_of[it[0]] = bi
+        # tag namespace base: deterministic across ranks (name + bucket +
+        # round), disjoint from main-thread seq counters (negative)
+        self._ns = (zlib.crc32(name.encode()) & 0x3FF) + 1
+        self._round = 0
+        self._worker = None
+        self._reset_round()
+
+    def close(self):
+        """Stop the persistent dispatch thread (called when a consumer
+        replaces a stale scheduler)."""
+        if self._worker is not None:
+            self._worker.close()
+            self._worker = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def bucketer(self):
+        return self._b
+
+    def matches(self, params):
+        """True when ``params`` is exactly the layout this scheduler was
+        built over (the rebuild test the consumers run per step)."""
+        return [id(p) for p in self._b._params] == [id(p) for p in params]
+
+    def _reset_round(self):
+        self._pending = {bi: {it[0] for it in b.items}
+                         for bi, b in enumerate(self._b._buckets)}
+        self._arrays = [None] * len(self._b._params)
+        self._inflight = {}
+        self._dispatched = set()
+
+    # -- in-backward path ----------------------------------------------------
+    def mark_ready(self, t):
+        """Grad-ready hook target. Ignores tensors outside the parameter
+        set; dispatches a bucket the moment its last parameter reports."""
+        i = self._param_slot.get(id(t))
+        if i is None:
+            return
+        bi = self._bucket_of[i]
+        if bi in self._dispatched:
+            # a second backward before the step boundary (grad
+            # accumulation without no_sync): the in-flight round is stale.
+            # Every rank hits this deterministically on its first re-fired
+            # param, so all drop the round together and the accumulated
+            # gradients are re-exchanged fresh — step-boundary semantics
+            # are preserved, only the wasted round's overlap is lost.
+            self.discard()
+        pend = self._pending[bi]
+        pend.discard(i)
+        if t.grad is not None:
+            self._arrays[i] = t.grad._data
+        if not pend:
+            self._dispatch(bi, where="in_backward")
+
+    def _dispatch(self, bi, where):
+        group = self._group or _collective._get_default_group()
+        op = self._op
+        arrays = self._arrays
+        _overlap_telemetry()["buckets"].inc(where=where)
+        world = simulator.active_world()
+        rank = simulator.current_rank()
+        if world is not None:
+            # ≤4 collectives per bucket exchange (rs + gather tiers); 32
+            # slots of headroom per (bucket, round) namespace
+            base = -(((self._ns << 34)
+                      + (self._round * self._b.num_buckets + bi + 1)) << 5)
+
+            class _SeqNamespace(dict):
+                def get(self, key, default=0):
+                    return dict.get(self, key, base)
+
+            def work():
+                simulator.adopt_rank(rank, _SeqNamespace())
+                return self._b.exchange_bucket(
+                    bi, arrays, group=group, op=op,
+                    use_reduce_scatter=self._use_rs)
+
+            if self._worker is None:
+                self._worker = _RankWorker(
+                    rank, name=f"comm-overlap:{self._name}:r{rank}")
+            handle = _AsyncBucketWork(f"{self._name}:b{bi}")
+            self._inflight[bi] = handle
+            self._worker.submit(work, handle)
+        else:
+            self._inflight[bi] = _DoneWork(self._b.exchange_bucket(
+                bi, arrays, group=group, op=op,
+                use_reduce_scatter=self._use_rs))
+        self._dispatched.add(bi)
+
+    # -- step boundary -------------------------------------------------------
+    def finish(self):
+        """Wait on in-flight buckets, dispatch partial leftovers at the
+        barrier, write reduced gradients back onto ``p.grad``. Returns
+        True when any bucket was exchanged this round."""
+        b = self._b
+        for bi, bucket in enumerate(b._buckets):
+            if bi in self._dispatched:
+                continue
+            # leftovers (params whose ready hook never fired — unused this
+            # step, or grads carried from an earlier backward): read grads
+            # straight off the parameters, barrier-style
+            got = False
+            for it in bucket.items:
+                i = it[0]
+                if self._arrays[i] is None:
+                    g = getattr(b._params[i], "grad", None)
+                    if g is not None:
+                        self._arrays[i] = g._data
+                if self._arrays[i] is not None:
+                    got = True
+            if got:
+                self._dispatch(bi, where="at_barrier")
+        t0 = time.perf_counter()
+        exchanged = False
+        try:
+            for bi in sorted(self._inflight):
+                red = self._inflight[bi].wait(self._wait_timeout)
+                self._apply_bucket(bi, red)
+                exchanged = True
+        finally:
+            _overlap_telemetry()["wait"].observe(time.perf_counter() - t0)
+            self._round += 1
+            self._reset_round()
+        return exchanged
+
+    def discard(self):
+        """Drop the current round without applying results (stale grads —
+        cleared, or superseded by a second backward). Waits out in-flight
+        work so the rendezvous stays aligned across ranks."""
+        for work in self._inflight.values():
+            try:
+                work.wait(self._wait_timeout)
+            except Exception:
+                pass
+        self._round += 1
+        self._reset_round()
+
+    def _apply_bucket(self, bi, red):
+        import jax.numpy as jnp
+        bucket = self._b._buckets[bi]
+        red = np.asarray(red).ravel()
+        for (i, off, numel, shape) in bucket.items:
+            p = self._b._params[i]
+            if getattr(p, "grad", None) is not None:
+                seg = red[off:off + numel].reshape(shape).astype(
+                    bucket.dtype, copy=False)
+                p.grad._data = jnp.asarray(seg, dtype=p.grad._data.dtype)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 
 
 _HYBRID_DEFAULTS = {
@@ -103,6 +104,12 @@ class DistributedStrategy:
         self.fuse_grad_size_in_MB = 32
         self.comm_quantization = None
         self._comm_configs = copy.deepcopy(_COMM_DEFAULTS)
+        # comm/compute overlap (ready-bucket scheduling): each fusion
+        # bucket's collective dispatches the moment its last gradient
+        # lands in backward; False restores the barrier-at-step exchange.
+        # PADDLE_COMM_OVERLAP=0 flips the process-wide default.
+        self.comm_overlap = os.environ.get(
+            "PADDLE_COMM_OVERLAP", "1").lower() not in ("0", "false", "off")
         # auto-parallel mesh search (reference: strategy.auto / the
         # rule-based tuner): with auto_search=True and a model spec in
         # auto_search_configs, fleet.init runs the cost-model Tuner over
@@ -173,6 +180,7 @@ class DistributedStrategy:
             "fuse_grad_size_in_MB": self.fuse_grad_size_in_MB,
             "comm_quantization": self.comm_quantization,
             "comm_configs": self._comm_configs,
+            "comm_overlap": self.comm_overlap,
         }
 
     def __repr__(self):
@@ -191,4 +199,5 @@ class DistributedStrategy:
         s.fuse_grad_size_in_MB = d.get("fuse_grad_size_in_MB", 32)
         s.comm_quantization = d.get("comm_quantization", None)
         s.comm_configs = d.get("comm_configs", {})
+        s.comm_overlap = d.get("comm_overlap", s.comm_overlap)
         return s

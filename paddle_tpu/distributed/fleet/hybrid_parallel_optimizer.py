@@ -22,6 +22,7 @@ class HybridParallelOptimizer:
         self._hcg = hcg
         self._strategy = strategy
         self._comm_bucketer = None
+        self._overlap_sched = None
         sharding_degree = 1
         if strategy is not None:
             sharding_degree = strategy.degrees().get("sharding", 1)
@@ -30,9 +31,80 @@ class HybridParallelOptimizer:
             if stage == 1:
                 optimizer = DygraphShardingOptimizer(optimizer, hcg)
         self._inner_opt = optimizer
+        self._maybe_install_overlap()
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
+
+    # -- comm/compute overlap ------------------------------------------------
+    def _dp_exchange_applies(self):
+        """Same eligibility gate as ``_maybe_sync_dp_grads`` (per-rank
+        tiers only, dp>1, not a meta-optimizer that owns its exchange)."""
+        s = self._strategy
+        if s is None or s.degrees().get("dp", 1) <= 1:
+            return False
+        from .meta_optimizers import DGCMomentumOptimizer, LocalSGDOptimizer
+        if isinstance(self._inner_opt, (DGCMomentumOptimizer,
+                                        LocalSGDOptimizer)):
+            return False
+        import jax
+        from .. import simulator
+        from ..parallel_env import get_world_size
+        if simulator.active_world() is None and jax.process_count() <= 1:
+            return False
+        return get_world_size() > 1
+
+    def _maybe_install_overlap(self):
+        """Register a tape grad-ready hook so each fusion bucket's dp
+        collective dispatches DURING backward (ready-bucket scheduling);
+        ``step()`` then only waits on the handles. Installed at
+        construction — the optimizer exists before the first backward, the
+        reducer-hook shape of the reference."""
+        if not getattr(self._strategy, "comm_overlap", True):
+            return
+        if not self._dp_exchange_applies():
+            return
+        import weakref
+        from ...autograd import tape
+        ref = weakref.ref(self)
+
+        def _ready(t):
+            opt = ref()
+            if opt is None:
+                tape.unregister_grad_ready_callback(_ready)
+                return
+            opt._on_grad_ready(t)
+
+        self._overlap_cb = tape.register_grad_ready_callback(_ready)
+
+    def _overlap_params(self):
+        return [p for p in getattr(self._inner_opt, "_parameter_list", [])
+                if p is not None and getattr(p, "trainable", True)]
+
+    def _on_grad_ready(self, t):
+        sched = self._overlap_sched
+        if sched is None:
+            params = self._overlap_params()
+            if not params:
+                return
+            from ..comm import GradientBucketer, ReadyBucketScheduler
+            from ..collective import ReduceOp
+            sched = self._overlap_sched = ReadyBucketScheduler(
+                GradientBucketer.from_strategy(params, self._strategy),
+                name="hpo", op=ReduceOp.AVG)
+        sched.mark_ready(t)
+
+    def _consume_overlap(self):
+        """True when a live overlap round covered the dp exchange."""
+        sched = self._overlap_sched
+        if sched is None:
+            return False
+        if not sched.matches(self._overlap_params()):
+            sched.close()
+            self._overlap_sched = None     # layout changed — rebuild
+            return False
+        sched.finish()
+        return True
 
     # -- per-rank dp gradient exchange ---------------------------------------
     def _maybe_sync_dp_grads(self):
@@ -68,7 +140,8 @@ class HybridParallelOptimizer:
         b.sync_grads(op=ReduceOp.AVG)
 
     def step(self):
-        self._maybe_sync_dp_grads()
+        if not self._consume_overlap():
+            self._maybe_sync_dp_grads()
         self._inner_opt.step()
 
     def minimize(self, loss, startup_program=None, parameters=None,

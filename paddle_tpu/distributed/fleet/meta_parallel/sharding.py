@@ -120,29 +120,93 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
     ZeRO-2 wire pattern runs explicitly through ``distributed.comm``: a
     bucketed (optionally quantized) reduce-scatter — each rank reduces its
     shard — followed by an all-gather of the shards, so the eager update
-    below still sees the full reduced gradient."""
+    below still sees the full reduced gradient. With
+    ``DistributedStrategy.comm_overlap`` (the default) each bucket's
+    reduce-scatter dispatches the moment its last gradient lands in
+    backward (tape grad-ready hooks); ``step()`` waits only on the
+    in-flight handles."""
 
-    def _maybe_exchange_grads(self):
+    _overlap_sched = None
+    _overlap_cb = None
+
+    def __init__(self, optimizer, hcg=None, comm_config=None):
+        super().__init__(optimizer, hcg, comm_config)
+        self._maybe_install_overlap()
+
+    def _per_rank_tier(self):
         import jax
         from ... import simulator
         from ...parallel_env import get_world_size
         if simulator.active_world() is None and jax.process_count() <= 1:
+            return False
+        return get_world_size() > 1
+
+    def _build_bucketer(self, params):
+        from ...comm import GradientBucketer, comm_config_from_strategy
+        cfg = self._comm_config
+        if cfg is None:
+            from .. import get_strategy
+            cfg = comm_config_from_strategy(get_strategy())
+        return GradientBucketer(params, **cfg)
+
+    def _maybe_install_overlap(self):
+        """Called once from step-0 OR lazily at the first grad-ready event:
+        the stage-2 wrapper is built inside the rank context, so the hook
+        registers on the right thread."""
+        if self._overlap_cb is not None:
             return
-        if get_world_size() <= 1:
+        from .. import get_strategy
+        if not getattr(get_strategy(), "comm_overlap", True):
+            self._overlap_cb = False
+            return
+        if not self._per_rank_tier():
+            self._overlap_cb = False
+            return
+        import weakref
+        from ....autograd import tape
+        ref = weakref.ref(self)
+
+        def _ready(t):
+            opt = ref()
+            if opt is None:
+                tape.unregister_grad_ready_callback(_ready)
+                return
+            opt._on_grad_ready(t)
+
+        self._overlap_cb = tape.register_grad_ready_callback(_ready)
+
+    def _on_grad_ready(self, t):
+        sched = self._overlap_sched
+        if sched is None:
+            params = [p for p in self._inner_opt._parameter_list
+                      if p is not None]
+            if not params:
+                return
+            from ...collective import ReduceOp
+            from ...comm import ReadyBucketScheduler
+            sched = self._overlap_sched = ReadyBucketScheduler(
+                self._build_bucketer(params), name="sharding2",
+                op=ReduceOp.AVG, use_reduce_scatter=True)
+        sched.mark_ready(t)
+
+    def _maybe_exchange_grads(self):
+        if not self._per_rank_tier():
             return
         params = [p for p in self._inner_opt._parameter_list
                   if p is not None]
         if not any(getattr(p, "grad", None) is not None for p in params):
             return
-        from ...comm import GradientBucketer, comm_config_from_strategy
+        sched = self._overlap_sched
+        if sched is not None:
+            if sched.matches(params):
+                sched.finish()
+                return
+            sched.close()
+            self._overlap_sched = None      # layout changed — rebuild
         from ...collective import ReduceOp
         b = self._comm_bucketer
         if b is None or [id(p) for p in b._params] != [id(p) for p in params]:
-            cfg = self._comm_config
-            if cfg is None:
-                from .. import get_strategy
-                cfg = comm_config_from_strategy(get_strategy())
-            b = self._comm_bucketer = GradientBucketer(params, **cfg)
+            b = self._comm_bucketer = self._build_bucketer(params)
         b.sync_grads(op=ReduceOp.AVG, use_reduce_scatter=True)
 
     def step(self):

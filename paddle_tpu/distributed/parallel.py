@@ -53,6 +53,8 @@ class DataParallel(Layer):
         self.group = group
         self.find_unused_parameters = find_unused_parameters
         self._sim_mode = simulator.in_simulation() or jax.process_count() > 1
+        self._overlap_scheduler = None
+        self._strategy = strategy
         if self._sim_mode:
             if self.group is None:
                 self.group = collective._get_default_group()
@@ -66,9 +68,18 @@ class DataParallel(Layer):
                 if dp is None:
                     tape.unregister_post_backward_callback(_cb)
                     return
-                dp._sync_gradients()
+                dp._post_backward()
 
             self._cb = tape.register_post_backward_callback(_cb)
+
+            def _ready(t):
+                dp = ref()
+                if dp is None:
+                    tape.unregister_grad_ready_callback(_ready)
+                    return
+                dp._on_grad_ready(t)
+
+            self._ready_cb = tape.register_grad_ready_callback(_ready)
         else:
             # mesh mode: ensure params are replicated over the mesh so that
             # dp-sharded activations trigger GSPMD grad reduction
@@ -89,6 +100,56 @@ class DataParallel(Layer):
         return self._layers(*inputs, **kwargs)
 
     # -- per-rank grad sync (simulated / multi-process) ----------------------
+    def _dp_strategy(self):
+        if self._strategy is not None:
+            return self._strategy
+        from . import fleet
+        return fleet.get_strategy()
+
+    def _on_grad_ready(self, t):
+        """Tape grad-ready hook: route the just-finalized gradient into the
+        ready-bucket scheduler so its bucket's collective can dispatch
+        while backward still runs (the reference reducer's per-variable
+        hook → ``MarkVarReady`` path)."""
+        if not self._grad_sync_enabled or not self._sim_mode:
+            return
+        sched = self._overlap_scheduler
+        if sched is False:       # overlap disabled — latched once per model
+            return
+        if sched is None:
+            strategy = self._dp_strategy()
+            if not getattr(strategy, "comm_overlap", True):
+                self._overlap_scheduler = False
+                return
+            params = [p for p in self._layers.parameters()
+                      if p is not None and p.trainable]
+            if not params:
+                return
+            from .comm import GradientBucketer, ReadyBucketScheduler
+            sched = self._overlap_scheduler = ReadyBucketScheduler(
+                GradientBucketer.from_strategy(params, strategy),
+                name="dp", group=self.group, op=collective.ReduceOp.AVG)
+        sched.mark_ready(t)
+
+    def _post_backward(self):
+        """The reducer flush: consume the overlap round when one is live
+        (wait on in-flight buckets, dispatch leftovers), else run the
+        legacy barrier exchange."""
+        if not self._grad_sync_enabled or not self._sim_mode:
+            return
+        sched = self._overlap_scheduler
+        if sched is not None and sched is not False:
+            params = [p for p in self._layers.parameters()
+                      if p is not None and p.trainable]
+            if sched.matches(params):
+                sched.finish()
+                return
+            # parameter set changed under the scheduler — rebuild next
+            # backward; this one syncs barrier-style for full coverage
+            sched.close()
+            self._overlap_scheduler = None
+        self._sync_gradients()
+
     def _sync_gradients(self):
         """The reducer flush: bucketed (and, per the fleet strategy's
         ``comm_quantization`` knob, quantized) gradient exchange through
@@ -103,9 +164,8 @@ class DataParallel(Layer):
         from .comm import GradientBucketer
         b = getattr(self, "_comm_bucketer", None)
         if b is None or [id(p) for p in b._params] != [id(p) for p in params]:
-            from . import fleet
             b = self._comm_bucketer = GradientBucketer.from_strategy(
-                params, fleet.get_strategy())
+                params, self._dp_strategy())
         b.sync_grads(group=self.group, op=collective.ReduceOp.AVG)
 
     @contextlib.contextmanager

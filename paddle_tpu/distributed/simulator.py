@@ -22,26 +22,48 @@ _tls = threading.local()
 
 
 class _Rendezvous:
-    """Blocking all-to-all meeting point, one slot list per (tag, round)."""
+    """Blocking all-to-all meeting point, one slot list per (tag, round).
+
+    Each tag gets its OWN condition variable (all sharing one lock): with
+    comm/compute overlap, dozens of async bucket collectives wait
+    concurrently, and a single shared condition turns every deposit into
+    an O(waiters) thundering herd — per-tag conditions wake only that
+    collective's participants."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
-        self._cond = threading.Condition()
+        self._lock = threading.Lock()
+        self._conds: dict[Any, threading.Condition] = {}
         self._slots: dict[Any, dict[int, Any]] = {}
         self._done: dict[Any, int] = {}
         self.failed = False  # set when any rank dies; unblocks waiters
+
+    def _cond_for(self, tag):
+        # caller holds self._lock
+        c = self._conds.get(tag)
+        if c is None:
+            c = self._conds[tag] = threading.Condition(self._lock)
+        return c
+
+    def abort(self):
+        """Mark the world failed and unblock every waiter."""
+        with self._lock:
+            self.failed = True
+            for c in self._conds.values():
+                c.notify_all()
 
     def exchange(self, tag, rank: int, value, participants: tuple[int, ...]):
         """Deposit ``value`` for ``rank``; block until every participant has
         deposited; return {rank: value} for the full group."""
         n = len(participants)
-        with self._cond:
+        with self._lock:
+            cond = self._cond_for(tag)
             slot = self._slots.setdefault(tag, {})
             slot[rank] = value
             if len(slot) == n:
-                self._cond.notify_all()
+                cond.notify_all()
             else:
-                self._cond.wait_for(
+                cond.wait_for(
                     lambda: self.failed or len(self._slots.get(tag, {})) == n,
                     timeout=60)
                 if self.failed:
@@ -52,25 +74,29 @@ class _Rendezvous:
                         f"collective '{tag}' timed out: "
                         f"{sorted(self._slots.get(tag, {}))} of {participants}")
             result = dict(self._slots[tag])
-            # last reader cleans the slot
+            # last reader cleans the slot (and its condition)
             self._done[tag] = self._done.get(tag, 0) + 1
             if self._done[tag] == n:
                 del self._slots[tag]
                 del self._done[tag]
+                self._conds.pop(tag, None)
             return result
 
     def put(self, tag, value):
-        with self._cond:
-            self._slots.setdefault(("p2p", tag), {})[0] = value
-            self._cond.notify_all()
+        key = ("p2p", tag)
+        with self._lock:
+            self._slots.setdefault(key, {})[0] = value
+            self._cond_for(key).notify_all()
 
     def get(self, tag):
         key = ("p2p", tag)
-        with self._cond:
-            self._cond.wait_for(lambda: key in self._slots, timeout=120)
+        with self._lock:
+            cond = self._cond_for(key)
+            cond.wait_for(lambda: key in self._slots, timeout=120)
             if key not in self._slots:
                 raise TimeoutError(f"recv '{tag}' timed out")
             v = self._slots.pop(key)[0]
+            self._conds.pop(key, None)
             return v
 
 
@@ -108,6 +134,20 @@ def in_simulation() -> bool:
     return current_rank() is not None
 
 
+def adopt_rank(rank: int, seqs: dict | None = None):
+    """Adopt a simulated rank identity on the CURRENT thread.
+
+    Used by the comm-overlap dispatch threads (distributed/comm/bucketer.py):
+    an async bucket collective runs on a worker thread spawned by a rank's
+    backward, and must rendezvous AS that rank. ``seqs`` seeds the thread's
+    collective-sequence counters — overlap dispatch passes a namespaced
+    dict whose counters start from a negative per-(scheduler, bucket,
+    round) base so worker tags can never collide with the owning thread's
+    (positive, monotonic) sequence numbers on the same group."""
+    _tls.rank = rank
+    _tls.seqs = seqs if seqs is not None else {}
+
+
 def run(fn: Callable, nprocs: int, args=(), propagate=True):
     """Run ``fn(*args)`` on ``nprocs`` simulated ranks; returns list of per-rank
     return values. Exceptions in any rank re-raise in the caller."""
@@ -127,9 +167,7 @@ def run(fn: Callable, nprocs: int, args=(), propagate=True):
         except BaseException as e:  # noqa: BLE001 — reported to caller
             errors[rank] = e
             # unblock peers waiting on this rank
-            with world.rendezvous._cond:
-                world.rendezvous.failed = True
-                world.rendezvous._cond.notify_all()
+            world.rendezvous.abort()
         finally:
             _tls.rank = None
 

@@ -13,12 +13,63 @@ from .io import DataLoader, Dataset
 from .metric import Metric
 
 
+def _pad_rows(x, target):
+    """Pad the leading (batch) dim up to ``target`` by repeating the last
+    sample. Inputs only — labels are never padded (outputs are sliced
+    back before the loss sees them)."""
+    if isinstance(x, (list, tuple)):
+        return type(x)(_pad_rows(v, target) for v in x)
+    if isinstance(x, Tensor) and x.ndim > 0 and x.shape[0] < target:
+        arr = np.asarray(x.numpy())
+        pad = np.repeat(arr[-1:], target - arr.shape[0], axis=0)
+        return Tensor(np.concatenate([arr, pad]))
+    return x
+
+
+def _slice_rows(out, n):
+    """Drop pad rows from network outputs (backward sends the pad rows a
+    zero cotangent, so gradients match the unpadded batch)."""
+    if isinstance(out, (list, tuple)):
+        return type(out)(_slice_rows(v, n) for v in out)
+    if isinstance(out, Tensor) and out.ndim > 0 and out.shape[0] > n:
+        return out[:n]
+    return out
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
         self._optimizer = None
         self._loss = None
         self._metrics = []
+
+    # -- trailing-partial-batch shape bucketing ------------------------------
+    def _pad_partial_enabled(self):
+        """Pad the last (smaller) batch of each epoch up to the compiled
+        spec instead of tracing a second program per epoch. Only engages
+        where it matters (a @to_static network — eager nets don't compile
+        per spec) and where it is numerically safe (no batch-coupled
+        normalization whose statistics would see the pad rows)."""
+        if getattr(self.network, "_static_forward", None) is None:
+            return False
+        net = self.network
+        subs = (net.sublayers(include_self=True)
+                if hasattr(net, "sublayers") else [net])
+        return not any("BatchNorm" in type(l).__name__ for l in subs)
+
+    def _maybe_pad_partial(self, x, st):
+        if not st["enabled"]:
+            return x, None
+        lead = x[0] if isinstance(x, (list, tuple)) else x
+        if not isinstance(lead, Tensor) or lead.ndim == 0:
+            return x, None
+        n = lead.shape[0]
+        if st["spec"] is None:       # first batch defines the compiled spec
+            st["spec"] = n
+            return x, None
+        if n >= st["spec"]:
+            return x, None
+        return _pad_rows(x, st["spec"]), n
 
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
         self._optimizer = optimizer
@@ -74,6 +125,7 @@ class Model:
                 c.save_dir = save_dir
         cbs.on_train_begin({})
         it = 0
+        pad_state = {"enabled": self._pad_partial_enabled(), "spec": None}
         for epoch in range(epochs):
             self.network.train()
             for m in self._metrics:
@@ -89,7 +141,10 @@ class Model:
                 if have_cbs:
                     cbs.on_train_batch_begin(step, {})
                 x, y = self._unpack(batch)
+                x, true_n = self._maybe_pad_partial(x, pad_state)
                 out = self.network(x)
+                if true_n is not None:
+                    out = _slice_rows(out, true_n)
                 loss = self._loss(out, y) if self._loss else out
                 loss.backward()
                 if (step + 1) % accumulate_grad_batches == 0:

@@ -12,6 +12,7 @@ fallback, matching SOT's fallback semantics.
 from __future__ import annotations
 
 import functools
+import os
 import time
 import warnings
 
@@ -63,6 +64,73 @@ _GRAPH_BREAK_ERRORS = (
     jax.errors.TracerArrayConversionError,
     jax.errors.TracerIntegerConversionError,
 )
+
+# persistent (disk) compilation cache state: None = not yet attempted,
+# False = unavailable/disabled, str = active cache dir
+_PERSISTENT_CACHE = [None]
+_DISK_HIT_LISTENER = [False]
+
+
+def _install_disk_hit_listener():
+    """Count disk-cache restores into the existing jit cache metric
+    (``paddle_jit_cache_total{event="disk_hit"}``): jax records a
+    monitoring event on every compilation-cache read hit."""
+    if _DISK_HIT_LISTENER[0]:
+        return
+    try:
+        from jax import monitoring as _monitoring
+
+        def _on_event(event, *a, **k):
+            if event == "/jax/compilation_cache/cache_hits":
+                _jit_metrics()["cache"].inc(event="disk_hit")
+
+        _monitoring.register_event_listener(_on_event)
+        _DISK_HIT_LISTENER[0] = True
+    except Exception:
+        pass
+
+
+def enable_persistent_cache(path=None):
+    """Wire jax's persistent compilation cache so repeated runs skip XLA
+    recompiles entirely (the training/serving cold-start lever): compiled
+    executables are keyed on HLO+flags and restored from ``path`` across
+    processes. ``path`` defaults to ``PADDLE_JIT_CACHE_DIR``; returns True
+    when active. Restores are counted as
+    ``paddle_jit_cache_total{event="disk_hit"}``."""
+    if path is None:
+        path = os.environ.get("PADDLE_JIT_CACHE_DIR")
+    if not path:
+        _PERSISTENT_CACHE[0] = False
+        return False
+    path = str(path)
+    if _PERSISTENT_CACHE[0] == path:
+        return True
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        # default thresholds skip tiny/fast programs — a framework whose
+        # eager tier jits small regions wants everything cached
+        for knob, val in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                          ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+            try:
+                jax.config.update(knob, val)
+            except Exception:
+                pass
+        os.makedirs(path, exist_ok=True)
+        # the cache latches DISABLED at the first compile of the process
+        # (lazy _initialize_cache); a reset re-reads the (now set) dir so
+        # late wiring — after paddle's import-time jits — still engages
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _jax_cc)
+            _jax_cc.reset_cache()
+        except Exception:
+            pass
+    except Exception:
+        _PERSISTENT_CACHE[0] = False
+        return False
+    _install_disk_hit_listener()
+    _PERSISTENT_CACHE[0] = path
+    return True
 
 
 def enable_static():
@@ -241,6 +309,8 @@ class StaticFunction:
         return self._converted
 
     def __call__(self, *args, **kwargs):
+        if _PERSISTENT_CACHE[0] is None:     # PADDLE_JIT_CACHE_DIR, once
+            enable_persistent_cache()
         params, bufs = self._state()
         layer = self._layer()
         training = layer.training if layer is not None else True

@@ -9,6 +9,8 @@ whole-tree train step in ``paddle_tpu/parallel/engine.py`` (the perf path).
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -37,6 +39,10 @@ class Optimizer:
         self._slots: dict[int, dict] = {}
         self._step_t: dict[int, int] = {}
         self._name = name
+        # fused donated step (optimizer/fused.py): None = auto (env
+        # PADDLE_FUSED_STEP / min-params heuristic), True/False = forced
+        self.fuse_step = None
+        self._fused_engine = None
 
     # -- lr -----------------------------------------------------------------
     def get_lr(self):
@@ -94,6 +100,19 @@ class Optimizer:
         self._slots[id(p)] = new_slots
 
     # -- the eager step ------------------------------------------------------
+    def _use_fused(self, n_params: int) -> bool:
+        if self.fuse_step is not None:
+            return bool(self.fuse_step)
+        env = os.environ.get("PADDLE_FUSED_STEP", "auto").lower()
+        if env in ("0", "false", "off"):
+            return False
+        if env in ("1", "true", "on"):
+            return True
+        # auto: below the threshold the one-off trace+compile costs more
+        # than the per-param dispatches it saves
+        return n_params >= int(
+            os.environ.get("PADDLE_FUSED_STEP_MIN_PARAMS", "16"))
+
     @no_grad()
     def step(self):
         # accept plain Tensors with stop_gradient=False, like the
@@ -104,6 +123,17 @@ class Optimizer:
         if self._grad_clip is not None:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
+        if params_grads and self._use_fused(len(params_grads)):
+            from .fused import FusedStepEngine
+            if self._fused_engine is None:
+                self._fused_engine = FusedStepEngine(self)
+            # fused path consumes what it can; exotic groups (L1, master
+            # weights, duplicate params) come back for the eager loop
+            params_grads = self._fused_engine.step(params_grads, lr)
+        if params_grads:
+            from .fused import opt_telemetry
+            opt_telemetry()["dispatches"].inc(len(params_grads),
+                                              mode="eager")
         for p, g in params_grads:
             group_lr = lr * getattr(p, "optimize_attr",
                                     {}).get("learning_rate", 1.0)
@@ -176,6 +206,20 @@ class SGD(Optimizer):
         if wd:
             g = g + wd * p
         return p - lr * g, slots
+
+    def _fused_delta(self, p, g, slots, lr, t, wd, decay=None):
+        # staged fused step (optimizer/fused.py): ``decay`` is ``wd*p``
+        # precomputed by a SEPARATE compiled program — inside one program
+        # the CPU backend contracts add(mul(wd,p), g) into an fma even
+        # across an HLO optimization_barrier (the barrier lowers to a
+        # no-op before LLVM's contraction pass), which rounds differently
+        # from the eager loop's two ops. ``lr*(g+decay)`` is a mul fed BY
+        # an add (not an fma pattern), and the final ``p - delta``
+        # compiles separately too, so plain SGD stays bit-identical to
+        # the eager per-param loop.
+        if decay is not None:
+            g = g + decay
+        return lr * g, slots
 
 
 class Momentum(Optimizer):

@@ -1,0 +1,198 @@
+"""Fused, buffer-donated optimizer step.
+
+The eager ``Optimizer.step`` loop issues O(params) host dispatches per
+step (each ``_apply`` is a handful of jnp calls per tensor) — on a
+100+-parameter model that Python-side dispatch tail is a measurable slice
+of step time (the Gemma-on-TPU study's "fused weight update" gap). This
+module collapses it to O(1) compiled calls: parameters are grouped by
+update signature (dtype, per-group lr multiplier, weight-decay
+coefficient), and each group runs ONE jitted program that unrolls the
+optimizer's functional ``_apply`` over the whole group, with the old
+parameter and slot buffers donated to XLA (``utils.donation.donated_jit``)
+so the update is in-place in HBM.
+
+Exotic param groups fall back to the eager per-parameter loop: L1
+regularization (gradient rewrite outside the functional core),
+``multi_precision`` master weights, and duplicate parameter occurrences
+(donating one buffer twice is undefined).
+
+Numerics: inside one compiled program XLA contracts mul+add chains into
+FMAs (on CPU this happens in the LLVM backend, so even an HLO
+``optimization_barrier`` between the mul and the add does not stop it)
+and evaluates scalar schedule math (e.g. Adam's bias-correction powers)
+in f32 where the eager loop's python floats carry f64, so a generic
+fused ``_apply`` can differ from the eager per-op loop at f32 rounding
+level (~1e-5 relative worst case observed). Optimizers that define
+``_fused_delta`` (SGD) instead split the update so no compiled program
+ever contains a contractible mul+add pair: an optional decay program
+(``wd*p`` alone), a delta program (``lr*(g+decay)`` — a mul fed by an
+add, not an fma pattern), and a bare ``p - delta`` combine. SGD thus
+stays BIT-IDENTICAL to the eager loop (the overlap/fused parity
+contract the dp-sim tests pin down), at 2-3 dispatches per group —
+still O(1).
+
+Engagement policy (``Optimizer._use_fused``): ``PADDLE_FUSED_STEP`` —
+``auto`` (default: fuse when the step covers at least
+``PADDLE_FUSED_STEP_MIN_PARAMS``, default 16, parameters — below that the
+one-off trace costs more than the dispatches it saves), ``1`` force on,
+``0`` off. Per-instance override: ``opt.fuse_step = True/False``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_OPT_TELEMETRY = None
+
+
+def opt_telemetry():
+    """Lazily bound dispatch counters: ``mode="eager"`` counts per-param
+    updates, ``mode="fused"`` counts compiled group calls — the ratio is
+    the host-dispatch collapse ``BENCH_MODEL=comm`` reports."""
+    global _OPT_TELEMETRY
+    if _OPT_TELEMETRY is None:
+        from ..profiler.telemetry import get_registry
+        r = get_registry()
+        _OPT_TELEMETRY = {
+            "dispatches": r.counter(
+                "paddle_opt_step_dispatches_total",
+                "optimizer update dispatches (eager: one per parameter; "
+                "fused: one per compiled group call)", labels=("mode",)),
+        }
+    return _OPT_TELEMETRY
+
+
+class FusedStepEngine:
+    """Per-optimizer cache of jitted, donated group-update programs."""
+
+    def __init__(self, optimizer):
+        self._opt = optimizer
+        self._jitted = {}     # (lr_mult, wd) -> donated-jit callable
+
+    def step(self, params_grads, lr):
+        """Run the fusable subset of ``params_grads`` through compiled
+        group updates; return the (possibly empty) eager leftover list."""
+        opt = self._opt
+        groups: dict = {}
+        leftover = []
+        seen = set()
+        for p, g in params_grads:
+            slots = opt._get_slots(p)
+            reg = getattr(p, "regularizer", None) or opt.regularization
+            if (getattr(reg, "_l1", False) or "master" in slots
+                    or id(p) in seen):
+                leftover.append((p, g))
+                continue
+            seen.add(id(p))
+            lr_mult = float(getattr(p, "optimize_attr",
+                                    {}).get("learning_rate", 1.0))
+            if getattr(p, "regularizer", None) is None:
+                wd = opt._wd_coeff(p)
+            else:
+                wd = float(getattr(p.regularizer, "_coeff", 0.0))
+            groups.setdefault((lr_mult, wd), []).append((p, g))
+        for key, pg in groups.items():
+            self._run_group(key, pg, lr)
+        return leftover
+
+    def _run_group(self, key, pg, lr):
+        opt = self._opt
+        lr_mult, wd = key
+        ps = [p for p, _ in pg]
+        g_arrs = [g._data for _, g in pg]
+        p_arrs = [p._data for p in ps]
+        slot_list = _dedupe_donated([opt._slots[id(p)] for p in ps],
+                                    p_arrs, g_arrs)
+        ts = []
+        for p in ps:
+            opt._step_t[id(p)] += 1
+            ts.append(opt._step_t[id(p)])
+        fns = self._jitted.get(key)
+        if fns is None:
+            fns = self._jitted[key] = self._build(wd)
+        # lr and t travel as traced arrays so LR schedules / step advance
+        # never retrace; shape changes (param-set growth) retrace via
+        # jit's own cache
+        lr_arr = jnp.asarray(lr * lr_mult, jnp.float32)
+        t_arr = jnp.asarray(ts, jnp.float32)
+        tele = opt_telemetry()["dispatches"]
+        if len(fns) == 3:     # staged delta path: decay?, deltas, combine
+            decay_fn, delta_fn, combine_fn = fns
+            decay_arrs = decay_fn(p_arrs) if decay_fn is not None else None
+            deltas, new_slots = delta_fn(p_arrs, g_arrs, decay_arrs,
+                                         slot_list, lr_arr, t_arr)
+            new_ps = combine_fn(p_arrs, deltas)
+            tele.inc(2 if decay_fn is None else 3, mode="fused")
+        else:
+            (fn,) = fns
+            new_ps, new_slots = fn(p_arrs, g_arrs, slot_list, lr_arr, t_arr)
+            tele.inc(mode="fused")
+        for p, new_p, ns in zip(ps, new_ps, new_slots):
+            p._data = new_p
+            opt._slots[id(p)] = ns
+
+    def _build(self, wd):
+        from ..utils.donation import donated_jit
+        delta_fn = getattr(self._opt, "_fused_delta", None)
+        if delta_fn is not None:
+            # the weight-decay product compiles ALONE: sharing a program
+            # with the ``g + decay`` add would let the backend contract
+            # the pair into an fma and break eager bit-parity (see
+            # module docstring)
+            decay_jit = None
+            if wd:
+                def decay_terms(p_arrs):
+                    return [wd * p for p in p_arrs]
+                decay_jit = jax.jit(decay_terms)
+
+            def deltas(p_arrs, g_arrs, decay_arrs, slot_list, lr, t_arr):
+                out_d, out_s = [], []
+                for k in range(len(p_arrs)):
+                    d, ns = delta_fn(
+                        p_arrs[k], g_arrs[k], slot_list[k], lr, t_arr[k],
+                        wd, decay=None if decay_arrs is None
+                        else decay_arrs[k])
+                    out_d.append(d)
+                    out_s.append(ns)
+                return out_d, out_s
+
+            def combine(p_arrs, d_arrs):
+                return [p - d for p, d in zip(p_arrs, d_arrs)]
+
+            # p survives the decay/delta programs (the combine needs it),
+            # so only slots (and the dead decay terms) are donated there;
+            # the combine donates p (deltas die by refcount — donating
+            # both would leave half unusable)
+            return (decay_jit,
+                    donated_jit(deltas, donate_argnums=(2, 3)),
+                    donated_jit(combine, donate_argnums=(0,)))
+
+        apply_fn = self._opt._apply
+
+        def fused(p_arrs, g_arrs, slot_list, lr, t_arr):
+            new_ps, new_slots = [], []
+            for k in range(len(p_arrs)):
+                new_p, ns = apply_fn(p_arrs[k], g_arrs[k], slot_list[k],
+                                     lr, t_arr[k], wd)
+                new_ps.append(new_p)
+                new_slots.append(ns)
+            return new_ps, new_slots
+
+        return (donated_jit(fused, donate_argnums=(0, 2)),)
+
+
+def _dedupe_donated(slot_list, p_arrs, g_arrs):
+    """Donated buffers must be unique: fresh slot inits can alias (e.g. a
+    shared zeros constant for moment1/moment2) — replace repeat
+    occurrences with a private copy before donation."""
+    seen = {id(a) for a in p_arrs} | {id(a) for a in g_arrs}
+    out = []
+    for slots in slot_list:
+        fixed = {}
+        for name, arr in slots.items():
+            if id(arr) in seen:
+                arr = jnp.array(arr, copy=True)
+            seen.add(id(arr))
+            fixed[name] = arr
+        out.append(fixed)
+    return out

@@ -11,6 +11,14 @@ def test_inventory_complete():
     assert not failures, failures
 
 
+def test_strategy_fields_documented():
+    """Every public DistributedStrategy field is mentioned in
+    docs/PERF.md, so future knobs stay documented."""
+    from check_inventory import check_strategy_docs
+    missing = check_strategy_docs(verbose=False)
+    assert not missing, f"undocumented DistributedStrategy fields: {missing}"
+
+
 def test_paddle_flops():
     import numpy as np
     import paddle_tpu as paddle

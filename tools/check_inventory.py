@@ -254,7 +254,42 @@ INVENTORY = [
      ["ContinuousServingEngine", "DEFAULT_PREFILL_CHUNK_TOKENS"]),
     ("Serving bench (prefix cache on/off)", "bench",
      ["bench_serving", "bench_llama_decode"]),
+    # -- overlapped backward + fused step (ISSUE 5) --------------------------
+    ("Ready-bucket comm overlap", "paddle_tpu.distributed.comm",
+     ["ReadyBucketScheduler", "GradientBucketer"]),
+    ("Grad-ready tape hooks", "paddle_tpu.autograd.tape",
+     ["register_grad_ready_callback", "unregister_grad_ready_callback"]),
+    ("Fused donated optimizer step", "paddle_tpu.optimizer.fused",
+     ["FusedStepEngine", "opt_telemetry"]),
+    ("Persistent jit compilation cache", "paddle_tpu.jit.api",
+     ["enable_persistent_cache"]),
 ]
+
+# DistributedStrategy fields exempt from the docs/PERF.md mention rule
+# (none today — add a field here only with a reason it cannot matter to
+# performance tuning).
+STRATEGY_DOC_EXEMPT: set = set()
+
+
+def check_strategy_docs(verbose=True):
+    """Every public ``DistributedStrategy`` field must be mentioned in
+    docs/PERF.md — a knob nobody can discover is a knob nobody tunes.
+    Returns the list of undocumented fields (empty = pass)."""
+    from paddle_tpu.distributed.fleet.distributed_strategy import (
+        DistributedStrategy)
+    perf_path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                             "PERF.md")
+    with open(perf_path) as f:
+        perf = f.read()
+    fields = sorted(k for k in vars(DistributedStrategy())
+                    if not k.startswith("_") and k not in STRATEGY_DOC_EXEMPT)
+    missing = [f for f in fields if f not in perf]
+    if verbose:
+        for f in missing:
+            print(f"FAIL DistributedStrategy.{f} has no docs/PERF.md mention")
+        print(f"{len(fields) - len(missing)}/{len(fields)} strategy fields "
+              f"documented")
+    return missing
 
 
 def check(verbose=True):
@@ -282,4 +317,4 @@ def check(verbose=True):
 if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
-    sys.exit(1 if check() else 0)
+    sys.exit(1 if (check() or check_strategy_docs()) else 0)
