@@ -22,6 +22,8 @@ from .spawn import spawn  # noqa: F401
 from . import mesh  # noqa: F401
 from .mesh import init_mesh, get_mesh, HYBRID_AXES  # noqa: F401
 from . import simulator  # noqa: F401
+from .simulator import RankFailure, SimulatedRankKill  # noqa: F401
+from . import fault  # noqa: F401  (deterministic fault injection)
 from .native import TCPStore  # noqa: F401  (C++ rendezvous store)
 
 # fleet namespace (hybrid parallelism facade)

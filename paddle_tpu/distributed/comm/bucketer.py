@@ -343,9 +343,17 @@ class _RankWorker:
     def submit(self, fn, handle):
         self._q.put((fn, handle))
 
-    def close(self):
+    def close(self, join_timeout=2.0):
+        """Retire the lanes. Best-effort join so a failure-path shrink
+        (RankFailure/TimeoutError) doesn't leak `_RankWorker` threads
+        into the next world generation — lanes blocked in a dead-rank
+        exchange have already been woken by ``mark_dead``."""
         for _ in self._threads:
             self._q.put(None)
+        for t in self._threads:
+            if t is not threading.current_thread():
+                t.join(timeout=join_timeout)
+        self._threads = []
 
     def _run(self):
         while True:
@@ -531,11 +539,47 @@ class ReadyBucketScheduler:
                 red = self._inflight[bi].wait(self._wait_timeout)
                 self._apply_bucket(bi, red)
                 exchanged = True
+        except TimeoutError as e:
+            # release the worker lanes so the process can shrink/retry
+            # without leaking _RankWorker threads, and attach the flight
+            # recorder's desync view (which rank never entered which seq)
+            # so the timeout is diagnosable instead of a bare hang report
+            self.close()
+            raise TimeoutError(f"{e}{self._desync_diagnosis()}") from None
+        except BaseException:
+            # structured failures (simulator.RankFailure, an injected
+            # kill) propagate as-is — but never with lanes still parked
+            self.close()
+            raise
         finally:
             _overlap_telemetry()["wait"].observe(time.perf_counter() - t0)
             self._round += 1
             self._reset_round()
         return exchanged
+
+    def _desync_diagnosis(self) -> str:
+        """Flight-recorder desync summary for timeout messages (empty
+        when the recorder is disabled or has no cross-rank view)."""
+        try:
+            from ...profiler import flight_recorder as _flight
+            if not _flight.is_enabled():
+                return ("\n(enable PADDLE_FLIGHT_RECORDER=1 for a per-rank "
+                        "desync report)")
+            fr = _flight.get_flight_recorder()
+            group = self._group or _collective._get_default_group()
+            rep = _flight.desync_report(fr.collective_events(by_rank=True),
+                                        world=group.ranks)
+            lines = [f"rank {s['rank']} last entered seq {s['last_seq']}, "
+                     f"never entered seq {s['missing_seq']} "
+                     f"(op {s['op']!r}, entered by {s['entered_by']})"
+                     for s in rep.get("stalled", [])]
+            if not lines:
+                return ("\nflight recorder desync report: no stalled rank "
+                        f"(frontier seq {rep.get('frontier_seq')})")
+            return "\nflight recorder desync report:\n  " + \
+                "\n  ".join(lines)
+        except Exception:
+            return ""                # diagnosis must never mask the timeout
 
     def discard(self):
         """Drop the current round without applying results (stale grads —

@@ -1,0 +1,240 @@
+"""Deterministic fault injection for elastic/chaos testing (ROADMAP 5).
+
+A :class:`FaultPlan` is a list of :class:`Fault` directives — kill rank R
+at step S, kill rank R before its N-th tracked collective, or delay rank
+R by T seconds — installed programmatically (:func:`install`) or via the
+``PADDLE_FAULT_PLAN`` env knob. Training loops call :func:`check_step`
+at every step boundary; the thread-rank simulator calls the collective
+hook at every rendezvous exchange entry (``simulator._FAULT_HOOK`` —
+installed only while a plan is active, so the no-plan path stays a
+single ``None`` check).
+
+Kill semantics in the simulator: the victim rank is marked dead in the
+``SimWorld`` *before* :class:`SimulatedRankKill` unwinds its thread, so
+survivors blocked in ``_Rendezvous.exchange`` (or the overlap
+scheduler's ``finish()``) immediately surface a structured
+:class:`RankFailure` naming the dead rank — no hang, no timeout. Delay
+faults just sleep: the rank straggles but lives, which must produce a
+flight-recorder straggler report and NO shrink.
+
+Env grammar (``;``-separated directives, ``kind:key=value,...``)::
+
+    PADDLE_FAULT_PLAN="kill:rank=2,step=5"
+    PADDLE_FAULT_PLAN="kill:rank=2,seq=12;delay:rank=1,step=3,seconds=0.5"
+
+Every fault fires at most once. Each firing is recorded as a
+flight-recorder event and counted in
+``paddle_elastic_events_total{kind="kill"|"delay"}``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import simulator
+from .simulator import RankFailure, SimulatedRankKill  # noqa: F401 (re-export)
+
+__all__ = [
+    "Fault", "FaultPlan", "RankFailure", "SimulatedRankKill",
+    "install", "clear", "active_plan", "check_step", "elastic_telemetry",
+]
+
+_ELASTIC_TELEMETRY = None
+
+
+def elastic_telemetry():
+    """Registry families shared by the fault harness and the elastic
+    train loop (supervisor.py)."""
+    global _ELASTIC_TELEMETRY
+    if _ELASTIC_TELEMETRY is None:
+        from ..profiler.telemetry import get_registry
+        r = get_registry()
+        _ELASTIC_TELEMETRY = {
+            "events": r.counter(
+                "paddle_elastic_events_total",
+                "elastic/fault lifecycle events (kill, delay, "
+                "failure_detected, shrink, regrow, restore, checkpoint)",
+                labels=("kind",)),
+            "ckpt_async": r.histogram(
+                "paddle_ckpt_async_seconds",
+                "wall seconds each async checkpoint write spent off the "
+                "critical path"),
+        }
+    return _ELASTIC_TELEMETRY
+
+
+class Fault:
+    """One directive. ``kind`` is ``"kill"`` or ``"delay"``; exactly one
+    of ``step`` (fires at that step boundary) / ``seq`` (fires before the
+    rank's seq-th tracked collective, 1-based) selects the trigger;
+    ``seconds`` is the sleep for delay faults."""
+
+    __slots__ = ("kind", "rank", "step", "seq", "seconds", "fired")
+
+    def __init__(self, kind, rank, step=None, seq=None, seconds=0.0):
+        if kind not in ("kill", "delay"):
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             "(expected 'kill' or 'delay')")
+        if (step is None) == (seq is None):
+            raise ValueError("a fault needs exactly one trigger: "
+                             "step=... or seq=...")
+        if kind == "delay" and seconds <= 0:
+            raise ValueError("delay fault needs seconds > 0")
+        self.kind = kind
+        self.rank = int(rank)
+        self.step = None if step is None else int(step)
+        self.seq = None if seq is None else int(seq)
+        self.seconds = float(seconds)
+        self.fired = False
+
+    def __repr__(self):
+        trig = (f"step={self.step}" if self.step is not None
+                else f"seq={self.seq}")
+        extra = f", seconds={self.seconds:g}" if self.kind == "delay" else ""
+        return f"Fault({self.kind}:rank={self.rank},{trig}{extra})"
+
+
+class FaultPlan:
+    """An ordered set of faults plus the per-rank collective counters the
+    seq triggers consume. Thread-safe: the simulator calls the collective
+    hook from rank main threads AND overlap worker lanes."""
+
+    def __init__(self, faults=()):
+        self.faults = list(faults)
+        self._lock = threading.Lock()
+        self._coll_seq: dict = {}        # rank -> collectives entered
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse the ``PADDLE_FAULT_PLAN`` grammar (see module doc)."""
+        faults = []
+        for directive in spec.split(";"):
+            directive = directive.strip()
+            if not directive:
+                continue
+            kind, _, argstr = directive.partition(":")
+            kind = kind.strip()
+            kw = {}
+            for pair in argstr.split(","):
+                pair = pair.strip()
+                if not pair:
+                    continue
+                k, _, v = pair.partition("=")
+                k = k.strip()
+                if k not in ("rank", "step", "seq", "seconds"):
+                    raise ValueError(
+                        f"unknown fault key {k!r} in {directive!r} "
+                        "(expected rank/step/seq/seconds)")
+                kw[k] = float(v) if k == "seconds" else int(v)
+            if "rank" not in kw:
+                raise ValueError(f"fault {directive!r} needs rank=")
+            faults.append(Fault(kind, kw.pop("rank"), **kw))
+        return cls(faults)
+
+    def collective_seq(self, rank) -> int:
+        with self._lock:
+            return self._coll_seq.get(rank, 0)
+
+    # -- trigger evaluation --------------------------------------------------
+    def _due_step(self, rank, step):
+        with self._lock:
+            for f in self.faults:
+                if (not f.fired and f.rank == rank and f.step is not None
+                        and f.step == step):
+                    f.fired = True
+                    return f
+        return None
+
+    def _due_collective(self, rank):
+        with self._lock:
+            seq = self._coll_seq.get(rank, 0) + 1
+            self._coll_seq[rank] = seq
+            for f in self.faults:
+                if (not f.fired and f.rank == rank and f.seq is not None
+                        and seq >= f.seq):
+                    f.fired = True
+                    return f
+        return None
+
+
+_ACTIVE: list = [None]       # [FaultPlan | None]; env plan parsed lazily
+_ENV_PARSED = [False]
+
+
+def install(plan: "FaultPlan | str | None") -> "FaultPlan | None":
+    """Install a plan (object or spec string) and arm the simulator hook.
+    ``None`` uninstalls (same as :func:`clear`)."""
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    _ACTIVE[0] = plan
+    _ENV_PARSED[0] = True         # an explicit install overrides the env
+    simulator._FAULT_HOOK[0] = _collective_hook if plan else None
+    return plan
+
+
+def clear():
+    """Remove any installed plan and disarm the hook."""
+    _ACTIVE[0] = None
+    _ENV_PARSED[0] = False
+    simulator._FAULT_HOOK[0] = None
+
+
+def active_plan() -> "FaultPlan | None":
+    """The installed plan, else one parsed from ``PADDLE_FAULT_PLAN``
+    (parsed once; re-read after :func:`clear`)."""
+    if _ACTIVE[0] is None and not _ENV_PARSED[0]:
+        _ENV_PARSED[0] = True
+        spec = os.environ.get("PADDLE_FAULT_PLAN")
+        if spec:
+            _ACTIVE[0] = FaultPlan.parse(spec)
+            simulator._FAULT_HOOK[0] = _collective_hook
+    return _ACTIVE[0]
+
+
+def _rank() -> int:
+    r = simulator.current_rank()
+    if r is not None:
+        return r
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def _fire(fault: Fault, where: str):
+    from ..profiler import flight_recorder as _flight
+    elastic_telemetry()["events"].inc(kind=fault.kind)
+    _flight.record_event("fault_injected", fault=repr(fault), where=where)
+    if fault.kind == "delay":
+        time.sleep(fault.seconds)
+        return
+    # kill: mark dead FIRST so blocked survivors detect immediately,
+    # then unwind this rank's thread
+    w = simulator.active_world()
+    if w is not None:
+        w.mark_dead(fault.rank)
+    raise SimulatedRankKill(fault.rank, where)
+
+
+def check_step(step: int):
+    """Step-boundary hook for training loops: fires any step-triggered
+    fault due for the calling rank at ``step``. No-op without a plan."""
+    plan = active_plan()
+    if plan is None:
+        return
+    f = plan._due_step(_rank(), step)
+    if f is not None:
+        _fire(f, where=f"step {step}")
+
+
+def _collective_hook(rank, tag):
+    """Installed as ``simulator._FAULT_HOOK`` while a plan is active:
+    counts the rank's rendezvous entries and fires seq-triggered
+    faults."""
+    plan = _ACTIVE[0]
+    if plan is None:
+        return
+    f = plan._due_collective(rank)
+    if f is not None:
+        _fire(f, where=f"collective {tag!r}")

@@ -16,7 +16,12 @@ import os
 import threading
 import time
 
-from .supervisor import TrainingSupervisor, CheckpointManager  # noqa: F401
+from .supervisor import (  # noqa: F401
+    TrainingSupervisor, CheckpointManager, ElasticTrainLoop, ElasticWorld,
+    WorldChanged,
+)
+from .tcp_kv import MemKVStore, TcpKVStore  # noqa: F401
+from ...simulator import RankFailure  # noqa: F401 (structured detection)
 
 ELASTIC_EXIT_CODE = 101      # reference: trainers exit with this on scale event
 

@@ -1,41 +1,201 @@
-"""Checkpoint-restart supervision (SURVEY.md §5.3 "TPU equivalent": slice
-failure → restart loop + checkpoint-resume + deterministic data skip).
+"""Checkpoint-restart supervision + in-run elastic shrink/regrow
+(SURVEY.md §5.3; ROADMAP item 5).
 
-The reference recovers NCCL-job failures by killing and relaunching trainers
-from the launcher; on TPU the same supervisor drives in-process retry with
-state restored from the latest complete checkpoint.
+Three tiers of recovery live here:
+
+* :class:`CheckpointManager` — step-tagged checkpoints with an atomic
+  completion marker (directory rename), retention, orphan-tmp sweeping,
+  an **async** writer that snapshots device state on the caller's thread
+  and writes off the critical path, and a **sharded** variant that rides
+  ``paddle.distributed.checkpoint`` (per-shard ``.npy`` + metadata, so
+  restore onto a *different* world size reuses the re-shard-on-load
+  path).
+* :class:`TrainingSupervisor` — single-process restart-from-checkpoint
+  (the reference's kill-and-relaunch loop, in-process).
+* :class:`ElasticTrainLoop` — the full elastic loop: KV-store membership
+  (:class:`ElasticWorld`) with generation barriers, structured failure
+  detection (``simulator.RankFailure`` surfaced by survivors the moment
+  a peer dies — fed by fault injection in tests, by the flight-recorder
+  watchdog / membership TTL in real runs), deterministic mesh shrink to
+  the survivors, restore from the latest complete checkpoint, and regrow
+  at the next checkpoint boundary.
 """
 from __future__ import annotations
 
 import os
 import shutil
+import threading
 import time
 
 from ....framework import io as fio
 
 
+def _ckpt_telemetry():
+    from ...fault import elastic_telemetry
+    return elastic_telemetry()
+
+
+class _AsyncSaveHandle:
+    """Join handle for one in-flight checkpoint write."""
+
+    def __init__(self, thread, errbox, path):
+        self._thread = thread
+        self._err = errbox
+        self.path = path
+
+    def wait(self, timeout=None):
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"async checkpoint write to {self.path} still running")
+        if self._err and self._err[0] is not None:
+            raise self._err[0]
+        return self.path
+
+    result = wait
+
+    def done(self):
+        return not self._thread.is_alive()
+
+
 class CheckpointManager:
-    """Step-tagged checkpoints with atomic completion marker + retention."""
+    """Step-tagged checkpoints with atomic completion marker + retention.
+
+    Completion contract: a checkpoint exists iff ``step_<N>`` (no
+    ``.tmp`` suffix) exists — writers stage into ``step_<N>.tmp`` and
+    ``os.replace`` on success, so readers can never observe a partial
+    save. A writer killed mid-save leaves only an orphaned ``.tmp``
+    directory, which :meth:`sweep_orphans` (and retention, for stale
+    steps) removes.
+    """
 
     def __init__(self, directory, keep=3):
         self.directory = directory
         self.keep = keep
+        self._pending: _AsyncSaveHandle | None = None
         os.makedirs(directory, exist_ok=True)
 
     def _dir(self, step):
         return os.path.join(self.directory, f"step_{step}")
 
+    # -- write paths ---------------------------------------------------------
     def save(self, step, state):
+        """Synchronous save (blocks until durable)."""
+        self.wait_pending()
+        d = self._dir(step)
+        self._write_pickle(step, fio._pack(state))
+        return d
+
+    def save_async(self, step, state):
+        """Off-critical-path save: device→host snapshot happens NOW (on
+        the caller's thread, so the captured state is step-consistent);
+        serialization + fsync-rename run on a background thread. At most
+        one write is in flight — a second save waits the first. Returns
+        a handle with ``.wait()``; ``paddle_ckpt_async_seconds`` records
+        each write's off-path wall time."""
+        self.wait_pending()
+        payload = fio._pack(state)            # snapshot before returning
+        errbox = [None]
+
+        def write():
+            t0 = time.perf_counter()
+            try:
+                self._write_pickle(step, payload)
+            except BaseException as e:  # noqa: BLE001 — re-raised at wait()
+                errbox[0] = e
+            finally:
+                try:
+                    _ckpt_telemetry()["ckpt_async"].observe(
+                        time.perf_counter() - t0)
+                except Exception:
+                    pass
+
+        th = threading.Thread(target=write, daemon=True,
+                              name=f"paddle-ckpt-async-{step}")
+        th.start()
+        self._pending = _AsyncSaveHandle(th, errbox, self._dir(step))
+        return self._pending
+
+    def _complete(self, tmp, d):
+        """Publish staging dir ``tmp`` as complete checkpoint ``d``.
+        ``os.replace`` onto a non-empty directory fails (ENOTEMPTY),
+        and a complete ``d`` legitimately exists when a run that
+        restored from an earlier step re-writes later ones — move it
+        aside first, then drop it once the new dir is in place. The
+        aside name ends in ``.tmp`` so crash hygiene sweeps it."""
+        old = None
+        if os.path.isdir(d):
+            old = d + ".old.tmp"
+            shutil.rmtree(old, ignore_errors=True)
+            os.replace(d, old)
+        os.replace(tmp, d)                      # atomic completion
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+
+    def _write_pickle(self, step, payload):
+        import pickle
         d = self._dir(step)
         tmp = d + ".tmp"
         if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-        os.makedirs(tmp)
-        fio.save(state, os.path.join(tmp, "state.pdz"))
-        os.replace(tmp, d)                      # atomic completion
+            shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        with open(os.path.join(tmp, "state.pdz"), "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+        self._complete(tmp, d)
         self._retain()
         return d
 
+    def save_sharded(self, step, state, async_save=False, **kw):
+        """Sharded save through ``paddle.distributed.checkpoint`` — each
+        host writes its addressable shards; restore onto a different
+        mesh/world reuses that module's re-shard-on-load. The step dir
+        gains the same atomic rename marker as the pickle path. With
+        ``async_save`` the device→host snapshot is taken by
+        ``save_state_dict`` immediately and the rename happens when the
+        background writer finishes."""
+        from ... import checkpoint as dckpt
+        self.wait_pending()
+        d = self._dir(step)
+        tmp = d + ".tmp"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp, exist_ok=True)
+        inner = dckpt.save_state_dict(state, tmp, async_save=async_save,
+                                      save_id=step, **kw)
+        if not async_save:
+            self._complete(tmp, d)
+            self._retain()
+            return d
+        errbox = [None]
+
+        def finish():
+            t0 = time.perf_counter()
+            try:
+                inner.wait()
+                self._complete(tmp, d)
+                self._retain()
+            except BaseException as e:  # noqa: BLE001
+                errbox[0] = e
+            finally:
+                try:
+                    _ckpt_telemetry()["ckpt_async"].observe(
+                        time.perf_counter() - t0)
+                except Exception:
+                    pass
+
+        th = threading.Thread(target=finish, daemon=True,
+                              name=f"paddle-ckpt-sharded-{step}")
+        th.start()
+        self._pending = _AsyncSaveHandle(th, errbox, d)
+        return self._pending
+
+    def wait_pending(self):
+        """Block until the in-flight async save (if any) is durable."""
+        h, self._pending = self._pending, None
+        if h is not None:
+            h.wait()
+
+    # -- read paths ----------------------------------------------------------
     def steps(self):
         out = []
         for name in os.listdir(self.directory):
@@ -47,18 +207,71 @@ class CheckpointManager:
         return sorted(out)
 
     def latest_step(self):
+        self.wait_pending()
         s = self.steps()
         return s[-1] if s else None
 
     def load(self, step=None):
+        self.wait_pending()
         step = step if step is not None else self.latest_step()
         if step is None:
             return None, None
-        return step, fio.load(os.path.join(self._dir(step), "state.pdz"))
+        d = self._dir(step)
+        pkl = os.path.join(d, "state.pdz")
+        if not os.path.exists(pkl) and os.path.exists(
+                os.path.join(d, "metadata.json")):
+            raise ValueError(
+                f"checkpoint step {step} is sharded (metadata.json); load "
+                "it with load_sharded(state_template, step=...)")
+        return step, fio.load(pkl)
 
+    def load_sharded(self, state_template, step=None, **kw):
+        """Fill ``state_template`` (a state dict with the target tensors
+        already constructed — their CURRENT shardings decide placement)
+        from a sharded checkpoint; re-shard-on-load handles a different
+        save-time mesh. Returns ``(step, state_template)``."""
+        from ... import checkpoint as dckpt
+        self.wait_pending()
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None, None
+        dckpt.load_state_dict(state_template, self._dir(step), **kw)
+        return step, state_template
+
+    # -- hygiene -------------------------------------------------------------
     def _retain(self):
         for s in self.steps()[:-self.keep]:
             shutil.rmtree(self._dir(s), ignore_errors=True)
+        # crash hygiene: a rank killed mid-save leaves step_<N>.tmp
+        # behind. Any tmp at or below the newest COMPLETE step can't
+        # belong to a live writer (steps are monotonic; one write in
+        # flight per manager), so sweep it here — newer tmps may be a
+        # peer's in-flight save and are left for sweep_orphans().
+        done = self.steps()
+        newest = done[-1] if done else None
+        for name in os.listdir(self.directory):
+            if not (name.startswith("step_") and name.endswith(".tmp")):
+                continue
+            try:
+                s = int(name[len("step_"):-len(".tmp")])
+            except ValueError:
+                continue
+            if newest is not None and s <= newest:
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    def sweep_orphans(self):
+        """Remove EVERY ``step_*.tmp`` staging dir. Only call when no
+        writer can be mid-save (e.g. at an elastic rebuild barrier, after
+        every survivor waited its own pending write — anything left was
+        abandoned by a dead rank)."""
+        removed = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_") and name.endswith(".tmp"):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+                removed.append(name)
+        return removed
 
 
 class TrainingSupervisor:
@@ -87,3 +300,495 @@ class TrainingSupervisor:
                     raise
                 if self.backoff_seconds:
                     time.sleep(self.backoff_seconds)
+
+
+# ---------------------------------------------------------------------------
+# KV-backed membership + generation barrier
+# ---------------------------------------------------------------------------
+
+
+class ElasticWorld:
+    """Rank membership over any elastic KV store (``MemKVStore`` in the
+    thread simulator, ``TcpKVStore``/``FileKVStore`` across hosts).
+
+    Liveness = a fresh member key (heartbeat within ``ttl``) without a
+    dead marker. World changes are coordinated by integer *generations*:
+    any rank may propose ``gen+1`` (failure detector, rejoiner); everyone
+    then meets in :meth:`agree`, a two-phase barrier — phase A collects
+    acks until they exactly cover the live membership, the leader (lowest
+    live rank) runs the purge callback (rendezvous cleanup, orphan
+    checkpoint sweep) and publishes the authoritative world; phase B
+    releases everyone on that published world, after which each rank
+    resets its simulator collective counters so tags pair deterministically
+    in the new generation."""
+
+    def __init__(self, store, job_id="elastic", rank=None, ttl=5.0,
+                 heartbeat_interval=None, poll=0.005):
+        from ...parallel_env import get_rank
+        self.store = store
+        self.job_id = job_id
+        self.rank = get_rank() if rank is None else int(rank)
+        self.ttl = float(ttl)
+        self.poll = float(poll)
+        self.heartbeat_interval = (heartbeat_interval
+                                   if heartbeat_interval is not None
+                                   else max(self.ttl / 4.0, 0.05))
+        self._stop = threading.Event()
+        self._hb = None
+
+    def _k(self, *parts):
+        return "/".join((self.job_id,) + tuple(str(p) for p in parts))
+
+    # -- membership ----------------------------------------------------------
+    def join(self):
+        """(Re)register this rank: clear any dead marker left by a
+        previous life, revive it in the active simulator world, and start
+        heartbeating."""
+        self.store.delete(self._k("dead", self.rank))
+        from ... import simulator
+        w = simulator.active_world()
+        if w is not None:
+            w.revive(self.rank)
+        self.store.put(self._k("member", self.rank), self.rank)
+        if self._hb is None or not self._hb.is_alive():
+            self._stop.clear()
+
+            def beat():
+                while not self._stop.wait(self.heartbeat_interval):
+                    self.store.put(self._k("member", self.rank), self.rank)
+
+            self._hb = threading.Thread(target=beat, daemon=True,
+                                        name=f"elastic-hb-r{self.rank}")
+            self._hb.start()
+
+    def leave(self):
+        self._stop.set()
+        if self._hb is not None:
+            self._hb.join(timeout=2)
+            self._hb = None
+        self.store.delete(self._k("member", self.rank))
+
+    def die(self):
+        """This rank is going away NON-gracefully (injected kill): mark
+        itself dead so survivors' membership converges immediately
+        instead of waiting out the TTL."""
+        self.mark_dead(self.rank)
+        self.leave()
+
+    def mark_dead(self, rank):
+        self.store.put(self._k("dead", rank), True)
+
+    def dead_ranks(self):
+        out = set()
+        for key in self.store.keys(self._k("dead", "")):
+            try:
+                out.add(int(key.rsplit("/", 1)[-1]))
+            except ValueError:
+                pass
+        return out
+
+    def members(self):
+        """Live ranks: fresh member key, no dead marker."""
+        dead = self.dead_ranks()
+        out = set()
+        for key in self.store.keys(self._k("member", "")):
+            try:
+                r = int(key.rsplit("/", 1)[-1])
+            except ValueError:
+                continue
+            age = self.store.age(key)
+            if r not in dead and age is not None and age <= self.ttl:
+                out.add(r)
+        return out
+
+    def stale_members(self):
+        """Ranks whose member key exists but whose heartbeat exceeded the
+        TTL — the membership-TTL failure signal (used when a failure is
+        detected as a bare timeout with no rank attribution)."""
+        out = set()
+        for key in self.store.keys(self._k("member", "")):
+            try:
+                r = int(key.rsplit("/", 1)[-1])
+            except ValueError:
+                continue
+            age = self.store.age(key)
+            if age is not None and age > self.ttl:
+                out.add(r)
+        return out
+
+    # -- generations ---------------------------------------------------------
+    def stored_gen(self) -> int:
+        g = self.store.get(self._k("gen"))
+        return int(g) if g is not None else 0
+
+    def propose(self, gen: int) -> int:
+        """Request a rebuild at generation >= ``gen``. Idempotent —
+        concurrent proposers converge on the max."""
+        g = max(int(gen), self.stored_gen())
+        self.store.put(self._k("gen"), g)
+        return g
+
+    def published_world(self, gen):
+        w = self.store.get(self._k("world", gen))
+        return None if w is None else [int(r) for r in w]
+
+    def publish_progress(self, step):
+        self.store.put(self._k("progress", self.rank), int(step))
+
+    def progress(self):
+        out = {}
+        for key in self.store.keys(self._k("progress", "")):
+            try:
+                out[int(key.rsplit("/", 1)[-1])] = int(self.store.get(key))
+            except (TypeError, ValueError):
+                pass
+        return out
+
+    def agree(self, gen, purge_cb=None, timeout=60.0, settle=3):
+        """Generation barrier; returns the agreed (leader-published)
+        sorted world. ``purge_cb(world)`` runs exactly once, on the
+        leader, between the ack phase and the release phase — every
+        member is parked inside the barrier at that point, so it is the
+        only safe window for cross-rank cleanup (rendezvous purge,
+        checkpoint orphan sweep)."""
+        self.store.put(self._k("a", gen, self.rank), True)
+        deadline = time.monotonic() + timeout
+        stable = 0
+        world = None
+        while True:
+            # a later generation supersedes this barrier (e.g. a second
+            # failure while agreeing): bail out and let the caller re-agree
+            g2 = self.stored_gen()
+            if g2 > gen:
+                raise WorldChanged(g2)
+            acks = set()
+            for key in self.store.keys(self._k("a", gen, "")):
+                try:
+                    acks.add(int(key.rsplit("/", 1)[-1]))
+                except ValueError:
+                    pass
+            mem = self.members()
+            # superset, not equality: a rank that acked and then died (or
+            # acked a moment before marking itself dead) must not wedge
+            # the barrier — the authoritative world is the live members
+            if mem and acks >= mem:
+                stable += 1
+                if stable >= settle:
+                    world = sorted(mem)
+                    break
+            else:
+                stable = 0
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"elastic barrier gen {gen} timed out: acks={sorted(acks)}"
+                    f" members={sorted(mem)}")
+            time.sleep(self.poll)
+        if self.rank == world[0]:
+            if purge_cb is not None:
+                purge_cb(world)
+            self.store.put(self._k("world", gen), world)
+        else:
+            while self.published_world(gen) is None:
+                g2 = self.stored_gen()
+                if g2 > gen:
+                    raise WorldChanged(g2)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"elastic barrier gen {gen}: leader never published")
+                time.sleep(self.poll)
+            world = self.published_world(gen)
+        from ... import simulator
+        simulator.reset_seqs()
+        return world
+
+    def decide(self, gen, key, fn, timeout=30.0):
+        """Single-writer agreement helper: the gen's world leader computes
+        ``fn()`` and publishes it; everyone else polls the published
+        value."""
+        world = self.published_world(gen) or []
+        if world and self.rank == world[0]:
+            val = fn()
+            self.store.put(self._k(key, gen), val)
+            return val
+        deadline = time.monotonic() + timeout
+        while True:
+            v = self.store.get(self._k(key, gen))
+            if v is not None:
+                return v
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"elastic decide({key}, gen {gen}) "
+                                   "timed out")
+            time.sleep(self.poll)
+
+
+class WorldChanged(RuntimeError):
+    """A newer generation was proposed while this rank was mid-protocol."""
+
+    def __init__(self, gen):
+        self.gen = gen
+        super().__init__(f"world superseded by generation {gen}")
+
+
+# ---------------------------------------------------------------------------
+# the elastic train loop
+# ---------------------------------------------------------------------------
+
+
+def _env_on(name, default="1"):
+    return os.environ.get(name, default) not in ("0", "false", "False", "no")
+
+
+class ElasticTrainLoop(TrainingSupervisor):
+    """In-run elastic training: survive rank death by shrinking the mesh
+    to the survivors, restoring from the latest complete checkpoint, and
+    resuming deterministically; re-admit ranks at checkpoint boundaries.
+
+    Contract (per rank, typically under ``dist.spawn``)::
+
+        loop = ElasticTrainLoop(ckpt_dir, store=MemKVStore(), ...)
+        result = loop.run(build_fn, data_fn, total_steps)
+
+    * ``build_fn() -> (model, optimizer, loss_fn)`` — deterministic
+      same-seed construction on every rank (replicated-params DP).
+    * ``data_fn(step) -> (x, y)`` — the GLOBAL numpy batch for ``step``,
+      identical on every rank; the loop row-splits it across the live
+      world by *position*, so a given world size always sees the same
+      shards regardless of which global ranks survived — this is what
+      makes a post-shrink trajectory bit-match a fresh restart on the
+      same world size.
+
+    Failure → shrink: a dead peer surfaces as ``simulator.RankFailure``
+    (structured: rank/seq/op) out of ``backward()``/``opt.step()``; the
+    survivor marks it dead in the KV store, proposes the next
+    generation, meets the others at the barrier (the leader purges
+    rendezvous state and orphaned checkpoint tmps), rebuilds
+    model/optimizer/comm on the survivor world, restores the latest
+    complete checkpoint, and replays from its step. Regrow: a rejoining
+    rank proposes a generation; running ranks notice at their next
+    checkpoint boundary and rebuild the same way.
+
+    Checkpoints are written by world position 0 only, asynchronously by
+    default (``save_async``; ``sharded_checkpoint=True`` routes through
+    ``distributed.checkpoint`` for true per-shard restore-and-reshard).
+    ``PADDLE_ELASTIC=0`` disables in-run shrink (failures re-raise —
+    the classic supervisor restart path); ``PADDLE_CKPT_INTERVAL_STEPS``
+    sets the default checkpoint cadence.
+    """
+
+    def __init__(self, checkpoint_dir, store=None, job_id="elastic-train",
+                 ckpt_interval=None, keep=3, max_restarts=8, min_ranks=1,
+                 ttl=5.0, barrier_timeout=60.0, async_checkpoint=True,
+                 sharded_checkpoint=False):
+        super().__init__(checkpoint_dir, max_restarts=max_restarts, keep=keep)
+        if store is None:
+            from .tcp_kv import MemKVStore
+            store = MemKVStore()
+        self.store = store
+        self.job_id = job_id
+        if ckpt_interval is None:
+            ckpt_interval = int(os.environ.get("PADDLE_CKPT_INTERVAL_STEPS",
+                                               "10"))
+        self.ckpt_interval = int(ckpt_interval)
+        self.min_ranks = int(min_ranks)
+        self.ttl = float(ttl)
+        self.barrier_timeout = float(barrier_timeout)
+        self.async_checkpoint = bool(async_checkpoint)
+        self.sharded_checkpoint = bool(sharded_checkpoint)
+
+    # -- internals -----------------------------------------------------------
+    def _events(self):
+        return _ckpt_telemetry()["events"]
+
+    def _purge_cb(self, ew):
+        def purge(world):
+            from ... import simulator
+            w = simulator.active_world()
+            if w is not None:
+                w.rendezvous.purge()
+            self.ckpt.sweep_orphans()
+        return purge
+
+    def _save_checkpoint(self, step, model, opt, world, pos):
+        if pos != 0:
+            return
+        state = {"model": model.state_dict(), "opt": opt.state_dict(),
+                 "step": step, "world": list(world)}
+        self._events().inc(kind="checkpoint")
+        if self.sharded_checkpoint:
+            self.ckpt.save_sharded(step, state,
+                                   async_save=self.async_checkpoint)
+        elif self.async_checkpoint:
+            self.ckpt.save_async(step, state)
+        else:
+            self.ckpt.save(step, state)
+
+    def _restore(self, model, opt, step):
+        from ....profiler import flight_recorder as _flight
+        if self.sharded_checkpoint:
+            template = {"model": model.state_dict(),
+                        "opt": opt.state_dict(),
+                        "step": 0, "world": []}
+            _, state = self.ckpt.load_sharded(template, step=step)
+        else:
+            _, state = self.ckpt.load(step=step)
+        model.set_state_dict(state["model"])
+        opt.set_state_dict(state["opt"])
+        self._events().inc(kind="restore")
+        _flight.record_event("elastic_restore", step=step)
+        return int(state.get("step", step))
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, build_fn, data_fn, total_steps, restore_step=None):
+        import numpy as np
+
+        from ....framework.core import Tensor
+        from ....profiler import flight_recorder as _flight
+        from ... import collective, fault as _fault, simulator
+        from ...parallel import DataParallel
+        from ...parallel_env import get_rank
+        from ...simulator import RankFailure, SimulatedRankKill
+
+        rank = get_rank()
+        ew = ElasticWorld(self.store, self.job_id, rank=rank, ttl=self.ttl)
+        ew.join()
+        gen = ew.stored_gen()
+        initial_gen = gen
+        pub = ew.published_world(gen)
+        if pub is not None and rank not in pub:
+            # late join (regrow / scale-out): force a rebuild everyone
+            # will meet at their next checkpoint boundary
+            gen = ew.propose(gen + 1)
+            self._events().inc(kind="regrow")
+        losses: dict = {}
+        last_step = 0
+        elastic_on = _env_on("PADDLE_ELASTIC")
+
+        while True:
+            try:
+                world = ew.agree(gen, purge_cb=self._purge_cb(ew),
+                                 timeout=self.barrier_timeout)
+            except WorldChanged as wc:
+                gen = wc.gen
+                continue
+            if len(world) < self.min_ranks:
+                ew.leave()
+                raise RuntimeError(
+                    f"elastic world shrank to {world} (< min_ranks="
+                    f"{self.min_ranks}); giving up")
+            pos = world.index(rank)
+            nworld = len(world)
+            group = collective.new_group(world)
+            model, opt, loss_fn = build_fn()
+            dp = DataParallel(model, group=group)
+            target = ew.decide(
+                gen, "restore",
+                lambda: (restore_step
+                         if (restore_step is not None
+                             and gen == initial_gen)
+                         else (self.ckpt.latest_step() or -1)),
+                timeout=self.barrier_timeout)
+            start = 0
+            if target is not None and int(target) >= 0:
+                start = self._restore(model, opt, int(target))
+            _flight.record_event("elastic_world", world=list(world),
+                                 generation=gen, start_step=start)
+            rebuild = None
+            try:
+                s = start
+                while s < total_steps:
+                    _fault.check_step(s)
+                    last_step = s
+                    xg, yg = data_fn(s)
+                    xs = np.array_split(np.asarray(xg), nworld)
+                    ys = np.array_split(np.asarray(yg), nworld)
+                    loss = loss_fn(dp(Tensor(xs[pos])), Tensor(ys[pos]))
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    losses[s] = float(np.asarray(loss.numpy()))
+                    _flight.heartbeat()
+                    s += 1
+                    if self.ckpt_interval and s % self.ckpt_interval == 0 \
+                            and s < total_steps:
+                        self._save_checkpoint(s, model, opt, world, pos)
+                        ew.publish_progress(s)
+                        g2 = ew.stored_gen()
+                        if g2 > gen:
+                            rebuild = g2     # regrow/admin world change
+                            break
+                if rebuild is None:
+                    dp.shutdown()
+                    self.ckpt.wait_pending()
+                    ew.publish_progress(total_steps)
+                    ew.leave()
+                    return {"status": "done", "rank": rank,
+                            "world": world, "generation": gen,
+                            "losses": losses}
+                # world change at a checkpoint boundary
+                dp.shutdown()
+                self.ckpt.wait_pending()
+                gen = rebuild
+                self._events().inc(kind="regrow")
+                _flight.record_event("elastic_regrow", generation=gen)
+                continue
+            except SimulatedRankKill:
+                # this rank IS the casualty: it is already marked dead in
+                # the simulator (fault.py); make the KV view agree and
+                # unwind without touching the world
+                try:
+                    dp.shutdown()
+                except Exception:
+                    pass
+                ew.die()
+                return {"status": "killed", "rank": rank,
+                        "step": last_step, "losses": losses}
+            except (RankFailure, TimeoutError) as e:
+                try:
+                    dp.shutdown()
+                except Exception:
+                    pass
+                failed = getattr(e, "rank", None)
+                if failed == rank:
+                    # a kill on one of our own overlap lanes can surface
+                    # as a RankFailure naming US (the lane that got the
+                    # injected kill marked this rank dead; a sibling lane
+                    # then saw the death first): this rank is the
+                    # casualty, not a survivor
+                    ew.die()
+                    return {"status": "killed", "rank": rank,
+                            "step": last_step, "losses": losses}
+                if failed is None:
+                    # bare timeout: fall back to the membership-TTL and
+                    # simulator-death signals for attribution
+                    w = simulator.active_world()
+                    stale = (set(w.dead_ranks) if w is not None else set()) \
+                        | ew.stale_members()
+                    stale &= set(world)
+                    stale.discard(rank)
+                    if not stale or not elastic_on:
+                        ew.leave()
+                        raise
+                    failed_set = stale
+                else:
+                    failed_set = {failed}
+                if not elastic_on:
+                    ew.leave()
+                    raise
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    ew.leave()
+                    raise
+                self._events().inc(kind="failure_detected")
+                _flight.record_event(
+                    "elastic_rank_failure", failed=sorted(failed_set),
+                    seq=getattr(e, "seq", None), op=getattr(e, "op", None),
+                    detected_by=rank)
+                try:
+                    self.ckpt.wait_pending()
+                except Exception:
+                    pass               # a torn async save never completes
+                for r in failed_set:
+                    ew.mark_dead(r)
+                gen = ew.propose(gen + 1)
+                self._events().inc(kind="shrink")
+                continue

@@ -168,6 +168,27 @@ class DataParallel(Layer):
                 params, self._dp_strategy())
         b.sync_grads(group=self.group, op=collective.ReduceOp.AVG)
 
+    def shutdown(self):
+        """Retire this wrapper explicitly (elastic shrink/regrow rebuild):
+        unregister the thread-local tape callbacks and close the overlap
+        scheduler's worker lanes. Without this, an abandoned generation's
+        post-backward callback would flush stale buckets over the OLD
+        group (which may contain a dead rank) into the new world's
+        backward."""
+        cb = getattr(self, "_cb", None)
+        if cb is not None:
+            tape.unregister_post_backward_callback(cb)
+            self._cb = None
+        rcb = getattr(self, "_ready_cb", None)
+        if rcb is not None:
+            tape.unregister_grad_ready_callback(rcb)
+            self._ready_cb = None
+        sched = self._overlap_scheduler
+        if sched is not None and sched is not False:
+            sched.close()
+        self._overlap_scheduler = False
+        self._grad_sync_enabled = False
+
     @contextlib.contextmanager
     def no_sync(self):
         """Skip grad sync inside (grad accumulation); reference ``no_sync``."""

@@ -20,6 +20,41 @@ from typing import Any, Callable
 
 _tls = threading.local()
 
+# fault-injection hook (distributed/fault.py installs it when a FaultPlan
+# is active): fn(rank, tag) called at every rendezvous exchange entry.
+# Plain-list indirection keeps the no-plan path a single None check and
+# avoids a module import cycle (fault.py imports simulator).
+_FAULT_HOOK: list = [None]
+
+
+class RankFailure(RuntimeError):
+    """A peer rank died while this rank was blocked on a collective.
+
+    The structured replacement for a bare hang/timeout: names the dead
+    rank, the collective tag/seq it never entered, and the op kind — the
+    signal the elastic train loop keys its shrink protocol on."""
+
+    def __init__(self, rank, seq=None, op=None, message=None):
+        self.rank = rank
+        self.seq = seq
+        self.op = op
+        super().__init__(
+            message or f"rank {rank} failed (never entered collective "
+                       f"seq {seq!r}, op {op!r})")
+
+
+class SimulatedRankKill(BaseException):
+    """Raised inside a simulated rank's thread(s) when a FaultPlan kills
+    it. BaseException on purpose: library code catching ``Exception``
+    must not swallow a kill — only the elastic loop (or the simulator's
+    worker harness) handles it, mirroring a real SIGKILL's
+    uncatchability."""
+
+    def __init__(self, rank, where):
+        self.rank = rank
+        self.where = where
+        super().__init__(f"simulated kill of rank {rank} at {where}")
+
 
 class _Rendezvous:
     """Blocking all-to-all meeting point, one slot list per (tag, round).
@@ -37,6 +72,7 @@ class _Rendezvous:
         self._slots: dict[Any, dict[int, Any]] = {}
         self._done: dict[Any, int] = {}
         self.failed = False  # set when any rank dies; unblocks waiters
+        self.dead: set = set()  # ranks killed by fault injection (elastic)
 
     def _cond_for(self, tag):
         # caller holds self._lock
@@ -52,11 +88,52 @@ class _Rendezvous:
             for c in self._conds.values():
                 c.notify_all()
 
+    def mark_dead(self, rank: int):
+        """Record an elastic rank death and wake every waiter so blocked
+        survivors surface a structured :class:`RankFailure` instead of a
+        hang (unlike :meth:`abort`, the world stays usable — groups that
+        exclude the dead rank keep exchanging)."""
+        with self._lock:
+            self.dead.add(rank)
+            for c in self._conds.values():
+                c.notify_all()
+
+    def revive(self, rank: int):
+        """Re-admit a previously dead rank (elastic regrow)."""
+        with self._lock:
+            self.dead.discard(rank)
+
+    def purge(self):
+        """Drop all parked exchange state (slots/conds of collectives the
+        dead rank never completed). Only safe at an elastic rebuild
+        barrier, when every surviving rank is out of the collective path
+        (the KV-store membership barrier guarantees exactly that)."""
+        with self._lock:
+            for c in self._conds.values():
+                c.notify_all()
+            self._slots.clear()
+            self._done.clear()
+            self._conds.clear()
+
+    def _dead_participant(self, participants):
+        for r in participants:
+            if r in self.dead:
+                return r
+        return None
+
     def exchange(self, tag, rank: int, value, participants: tuple[int, ...]):
         """Deposit ``value`` for ``rank``; block until every participant has
         deposited; return {rank: value} for the full group."""
+        hook = _FAULT_HOOK[0]
+        if hook is not None:
+            hook(rank, tag)      # may kill/delay this rank (fault.py)
         n = len(participants)
         with self._lock:
+            dead = self._dead_participant(participants)
+            if dead is not None:
+                raise RankFailure(dead, seq=tag[-1] if isinstance(tag, tuple)
+                                  else tag,
+                                  op=tag[0] if isinstance(tag, tuple) else None)
             cond = self._cond_for(tag)
             slot = self._slots.setdefault(tag, {})
             slot[rank] = value
@@ -64,11 +141,19 @@ class _Rendezvous:
                 cond.notify_all()
             else:
                 cond.wait_for(
-                    lambda: self.failed or len(self._slots.get(tag, {})) == n,
+                    lambda: self.failed
+                    or self._dead_participant(participants) is not None
+                    or len(self._slots.get(tag, {})) == n,
                     timeout=60)
                 if self.failed:
                     raise RuntimeError(
                         f"collective '{tag}' aborted: a peer rank failed")
+                dead = self._dead_participant(participants)
+                if dead is not None and len(
+                        self._slots.get(tag, {})) != n:
+                    raise RankFailure(
+                        dead, seq=tag[-1] if isinstance(tag, tuple) else tag,
+                        op=tag[0] if isinstance(tag, tuple) else None)
                 if len(self._slots.get(tag, {})) != n:
                     raise TimeoutError(
                         f"collective '{tag}' timed out: "
@@ -107,6 +192,17 @@ class SimWorld:
         self.nprocs = nprocs
         self.rendezvous = _Rendezvous(nprocs)
         self._counter_lock = threading.Lock()
+
+    # -- elastic membership (fault injection / shrink / regrow) -------------
+    @property
+    def dead_ranks(self) -> set:
+        return set(self.rendezvous.dead)
+
+    def mark_dead(self, rank: int):
+        self.rendezvous.mark_dead(rank)
+
+    def revive(self, rank: int):
+        self.rendezvous.revive(rank)
 
     def next_tag(self, kind: str, group_key):
         # per-thread per-group sequence number keeps concurrent collectives
@@ -148,6 +244,17 @@ def adopt_rank(rank: int, seqs: dict | None = None):
     _tls.seqs = seqs if seqs is not None else {}
 
 
+def reset_seqs():
+    """Reset THIS thread's per-group collective sequence counters.
+
+    Elastic rebuild primitive: after a shrink/regrow barrier every
+    surviving rank resets its counters together (the rebuilt world may
+    reuse a previous generation's group rank-set, and ranks that lived
+    through different failure paths hold divergent counters — aligned
+    restart keeps tags pairing deterministically)."""
+    _tls.seqs = {}
+
+
 def run(fn: Callable, nprocs: int, args=(), propagate=True):
     """Run ``fn(*args)`` on ``nprocs`` simulated ranks; returns list of per-rank
     return values. Exceptions in any rank re-raise in the caller."""
@@ -164,6 +271,12 @@ def run(fn: Callable, nprocs: int, args=(), propagate=True):
         _tls.seqs = {}
         try:
             results[rank] = fn(*args)
+        except SimulatedRankKill as e:
+            # an injected kill that escaped the rank's own handling: the
+            # rank is already marked dead (fault.py does it before
+            # raising), so survivors get structured RankFailures — do NOT
+            # abort the world, the elastic loop may shrink and continue
+            results[rank] = e
         except BaseException as e:  # noqa: BLE001 — reported to caller
             errors[rank] = e
             # unblock peers waiting on this rank

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import threading
 
 import numpy as np
 
@@ -53,11 +54,23 @@ def _unpack(obj, return_numpy=False):
 
 
 def save(obj, path, protocol=4, **configs):
+    # write-temp-then-replace: a writer killed mid-save (rank preemption,
+    # crash) must never leave a half-written file a later load() could
+    # deserialize — the target path only ever points at a complete pickle
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(path, **configs):

@@ -222,10 +222,23 @@ class SubsetRandomSampler(Sampler):
 
 
 class BatchSampler(Sampler):
+    """Default batch sampler, now deterministically resumable: with a
+    ``seed`` the shuffle order is a pure function of ``(seed, epoch)``,
+    and ``state_dict()``/``set_state_dict()`` (epoch, consumed batches,
+    seed) let a restored loader skip exactly the batches already handed
+    out instead of replaying the epoch (the elastic loop /
+    ``TrainingSupervisor`` resume contract). Without a seed the legacy
+    behavior (global-RNG shuffle) is unchanged — resumable only for
+    unshuffled iteration."""
+
     def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
-                 drop_last=False):
+                 drop_last=False, seed=None):
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self._own_sampler = sampler is None
         if sampler is not None:
             self.sampler = sampler
         elif shuffle:
@@ -233,21 +246,66 @@ class BatchSampler(Sampler):
         else:
             self.sampler = SequenceSampler(dataset)
 
+    _consumed = 0       # batches yielded so far this epoch
+    _resume_from = 0    # one-shot skip armed by set_state_dict
+
+    def _index_iter(self):
+        if self.shuffle and self.seed is not None and self._own_sampler:
+            n = len(self.sampler.data_source)
+            rng = np.random.RandomState((int(self.seed) + self.epoch)
+                                        % (2 ** 31))
+            return iter(rng.permutation(n).tolist())
+        return iter(self.sampler)
+
     def __iter__(self):
+        skip, self._resume_from = self._resume_from, 0
+        if skip and self.shuffle and self._own_sampler and self.seed is None:
+            raise ValueError(
+                "BatchSampler resume with shuffle=True needs a seed "
+                "(the shuffle order is otherwise unreproducible)")
+        produced = 0
         batch = []
-        for idx in self.sampler:
+        for idx in self._index_iter():
             batch.append(idx)
             if len(batch) == self.batch_size:
-                yield batch
+                produced += 1
+                if produced > skip:
+                    self._consumed = produced
+                    yield batch
                 batch = []
         if batch and not self.drop_last:
-            yield batch
+            produced += 1
+            if produced > skip:
+                self._consumed = produced
+                yield batch
+        if skip > produced:
+            raise ValueError(
+                f"sampler resume state skips {skip} batches but this epoch "
+                f"has only {produced} — the checkpoint was taken with a "
+                "different batch size / dataset")
+        self._consumed = 0             # exhausted: next epoch is fresh
 
     def __len__(self):
         n = len(self.sampler)
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = int(epoch)
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "consumed_batches": self._consumed,
+                "seed": self.seed}
+
+    def set_state_dict(self, state):
+        self.epoch = int(state.get("epoch", 0))
+        if state.get("seed") is not None:
+            self.seed = state["seed"]
+        self._resume_from = int(state.get("consumed_batches", 0))
+        self._consumed = self._resume_from
+
+    load_state_dict = set_state_dict
 
 
 class DistributedBatchSampler(BatchSampler):
@@ -703,7 +761,7 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False, seed=None):
         self.dataset = dataset
         self.num_workers = int(os.environ.get("PADDLE_TPU_NUM_WORKERS",
                                               num_workers))
@@ -723,7 +781,7 @@ class DataLoader:
         else:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
-                                              drop_last=drop_last)
+                                              drop_last=drop_last, seed=seed)
 
     _yielded = 0        # batches handed to the TRAIN LOOP this epoch
 
@@ -731,9 +789,13 @@ class DataLoader:
         """Deterministic-resume state. The consumed count is tracked at
         the LOADER boundary (batches handed to the train loop), so the
         buffered reader's prefetch depth cannot over-report (reference:
-        dataloader/sampler state in train checkpoints)."""
-        epoch = getattr(self.batch_sampler, "epoch", 0)
-        return {"epoch": epoch, "consumed_batches": self._yielded}
+        dataloader/sampler state in train checkpoints). Carries the
+        sampler's epoch and shuffle seed when it exposes them."""
+        sd = getattr(self.batch_sampler, "state_dict", None)
+        state = dict(sd()) if sd is not None else {
+            "epoch": getattr(self.batch_sampler, "epoch", 0)}
+        state["consumed_batches"] = self._yielded
+        return state
 
     def set_state_dict(self, state):
         ss = getattr(self.batch_sampler, "set_state_dict", None)
@@ -741,8 +803,8 @@ class DataLoader:
             if state and state.get("consumed_batches"):
                 raise ValueError(
                     "DataLoader resume needs a sampler with set_state_dict "
-                    "(DistributedBatchSampler); the default BatchSampler "
-                    "cannot skip consumed batches")
+                    "(BatchSampler / DistributedBatchSampler); this custom "
+                    "sampler cannot skip consumed batches")
             return
         ss(state)
         self._yielded = int(state.get("consumed_batches", 0))

@@ -1,0 +1,146 @@
+"""Fault-injection harness unit tier (ISSUE 6): FaultPlan grammar,
+trigger semantics, env wiring, and the simulator kill/delay hooks."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fault
+from paddle_tpu.distributed.fault import (
+    Fault, FaultPlan, RankFailure, SimulatedRankKill,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+class TestParser:
+    def test_single_kill_at_step(self):
+        plan = FaultPlan.parse("kill:rank=2,step=5")
+        (f,) = plan.faults
+        assert (f.kind, f.rank, f.step, f.seq) == ("kill", 2, 5, None)
+        assert not f.fired
+
+    def test_multi_directive_with_whitespace(self):
+        plan = FaultPlan.parse(
+            " kill:rank=2,seq=12 ; delay: rank=1, step=3, seconds=0.5 ;")
+        assert len(plan.faults) == 2
+        k, d = plan.faults
+        assert (k.kind, k.rank, k.seq) == ("kill", 2, 12)
+        assert (d.kind, d.rank, d.step, d.seconds) == ("delay", 1, 3, 0.5)
+
+    def test_repr_round_trips_the_directive(self):
+        plan = FaultPlan.parse("delay:rank=1,seq=8,seconds=0.25")
+        assert "delay:rank=1,seq=8" in repr(plan.faults[0])
+
+    @pytest.mark.parametrize("spec,match", [
+        ("explode:rank=0,step=1", "unknown fault kind"),
+        ("kill:rank=0,when=1", "unknown fault key"),
+        ("kill:step=1", "needs rank="),
+        ("kill:rank=0", "exactly one trigger"),
+        ("kill:rank=0,step=1,seq=2", "exactly one trigger"),
+        ("delay:rank=0,step=1", "seconds > 0"),
+    ])
+    def test_rejects_malformed(self, spec, match):
+        with pytest.raises(ValueError, match=match):
+            FaultPlan.parse(spec)
+
+    def test_env_plan_parsed_lazily(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_FAULT_PLAN", "kill:rank=1,step=7")
+        fault.clear()                      # re-arm env parsing
+        plan = fault.active_plan()
+        assert plan is not None and plan.faults[0].rank == 1
+        # parsed once: a changed env is not re-read until clear()
+        monkeypatch.setenv("PADDLE_FAULT_PLAN", "kill:rank=3,step=1")
+        assert fault.active_plan() is plan
+
+
+class TestTriggers:
+    def test_step_kill_fires_once_and_marks_dead(self):
+        fault.install("kill:rank=0,step=2")
+        fault.check_step(0)
+        fault.check_step(1)                # not yet
+        with pytest.raises(SimulatedRankKill) as ei:
+            fault.check_step(2)
+        assert ei.value.rank == 0
+        fault.check_step(2)                # fired=True: never again
+
+    def test_delay_sleeps_without_raising(self):
+        fault.install("delay:rank=0,step=1,seconds=0.2")
+        t0 = time.monotonic()
+        fault.check_step(1)
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_kill_and_delay_count_in_telemetry(self):
+        c = fault.elastic_telemetry()["events"]
+        k0, d0 = c.value(kind="kill"), c.value(kind="delay")
+        fault.install("delay:rank=0,step=1,seconds=0.01;kill:rank=0,step=2")
+        fault.check_step(1)
+        with pytest.raises(SimulatedRankKill):
+            fault.check_step(2)
+        assert c.value(kind="kill") == k0 + 1
+        assert c.value(kind="delay") == d0 + 1
+
+    def test_install_accepts_plan_object_and_none(self):
+        plan = FaultPlan([Fault("kill", 0, step=1)])
+        assert fault.install(plan) is plan
+        assert fault.active_plan() is plan
+        fault.install(None)
+        assert fault.active_plan() is None
+
+
+class TestSimulatorWiring:
+    def test_seq_kill_surfaces_rank_failure_on_survivor(self):
+        """Rank 1 dies before its 2nd collective; rank 0, blocked in the
+        rendezvous, gets a structured RankFailure naming rank 1 — not a
+        hang, not a bare timeout."""
+        fault.install("kill:rank=1,seq=2")
+
+        def worker():
+            r = dist.get_rank()
+            t = paddle.to_tensor(np.ones(4, np.float32))
+            try:
+                for _ in range(3):
+                    dist.all_reduce(t)
+                return "finished"
+            except SimulatedRankKill:
+                return "killed"
+            except RankFailure as e:
+                return ("failure", e.rank)
+
+        res = dist.spawn(worker, nprocs=2).results
+        assert res[1] == "killed"
+        assert res[0] == ("failure", 1)
+
+    def test_collective_counter_is_per_rank(self):
+        fault.install("kill:rank=1,seq=3")
+        plan = fault.active_plan()
+
+        def worker():
+            r = dist.get_rank()
+            t = paddle.to_tensor(np.ones(2, np.float32))
+            try:
+                for _ in range(4):
+                    dist.all_reduce(t)
+                return "finished"
+            except (SimulatedRankKill, RankFailure):
+                return "stopped"
+
+        dist.spawn(worker, nprocs=2)
+        assert plan.collective_seq(1) == 3      # died entering its 3rd
+        assert plan.collective_seq(0) >= 3
+
+    def test_no_plan_is_zero_overhead_hook(self):
+        from paddle_tpu.distributed import simulator
+        fault.clear()
+        assert simulator._FAULT_HOOK[0] is None
+        fault.install("kill:rank=0,step=99")
+        assert simulator._FAULT_HOOK[0] is not None
+        fault.clear()
+        assert simulator._FAULT_HOOK[0] is None

@@ -19,6 +19,14 @@ def test_strategy_fields_documented():
     assert not missing, f"undocumented DistributedStrategy fields: {missing}"
 
 
+def test_env_knobs_documented():
+    """Every PADDLE_* env knob referenced in paddle_tpu/ is mentioned in
+    a docs/*.md file (same discoverability rule as the strategy fields)."""
+    from check_inventory import check_env_docs
+    missing = check_env_docs(verbose=False)
+    assert not missing, f"undocumented PADDLE_* env knobs: {missing}"
+
+
 def test_paddle_flops():
     import numpy as np
     import paddle_tpu as paddle

@@ -187,7 +187,10 @@ def test_dataloader_mid_epoch_checkpoint_prefetch_accurate():
     assert again == full
 
 
-def test_dataloader_resume_rejects_default_sampler():
+def test_dataloader_resume_default_and_custom_sampler():
+    """The default BatchSampler is resumable since the elastic PR
+    (state_dict/set_state_dict with epoch + consumed + seed); a custom
+    sampler without set_state_dict still rejects a consumed-batch skip."""
     import numpy as np
     import pytest as _pytest
     from paddle_tpu.io import DataLoader
@@ -200,8 +203,22 @@ def test_dataloader_resume_rejects_default_sampler():
             return np.float32(i)
 
     dl = DataLoader(DS(), batch_size=4)
+    dl.set_state_dict({"epoch": 0, "consumed_batches": 1})
+    vals = [np.asarray(b.numpy()).tolist() for b in dl]
+    assert vals == [[4.0, 5.0, 6.0, 7.0]]        # first batch skipped
+
+    # a loader whose batch_sampler lacks set_state_dict entirely
+    class Legacy:
+        def __iter__(self):
+            return iter([[0, 1], [2, 3]])
+
+        def __len__(self):
+            return 2
+
+    dl3 = DataLoader(DS(), batch_size=2)
+    dl3.batch_sampler = Legacy()
     with _pytest.raises(ValueError, match="set_state_dict"):
-        dl.set_state_dict({"epoch": 0, "consumed_batches": 2})
+        dl3.set_state_dict({"epoch": 0, "consumed_batches": 2})
 
 
 def test_cached_vision_datasets(tmp_path):

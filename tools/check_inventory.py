@@ -263,6 +263,20 @@ INVENTORY = [
      ["FusedStepEngine", "opt_telemetry"]),
     ("Persistent jit compilation cache", "paddle_tpu.jit.api",
      ["enable_persistent_cache"]),
+    # -- elastic fault tolerance (ISSUE 6) -----------------------------------
+    ("Fault injection harness", "paddle_tpu.distributed.fault",
+     ["Fault", "FaultPlan", "install", "clear", "active_plan", "check_step",
+      "SimulatedRankKill", "RankFailure", "elastic_telemetry"]),
+    ("Structured failure detection (simulator)",
+     "paddle_tpu.distributed.simulator",
+     ["RankFailure", "SimulatedRankKill", "reset_seqs"]),
+    ("Elastic shrink/regrow train loop",
+     "paddle_tpu.distributed.fleet.elastic",
+     ["ElasticTrainLoop", "ElasticWorld", "WorldChanged", "RankFailure",
+      "TrainingSupervisor", "CheckpointManager"]),
+    ("Async/sharded checkpoint manager",
+     "paddle_tpu.distributed.fleet.elastic.supervisor",
+     ["CheckpointManager", "ElasticTrainLoop", "ElasticWorld"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -292,6 +306,47 @@ def check_strategy_docs(verbose=True):
     return missing
 
 
+# PADDLE_* env knobs exempt from the docs-mention rule. Add a knob here
+# only with a reason it cannot matter to a user tuning or operating the
+# system (none today).
+ENV_DOC_EXEMPT: set = set()
+
+
+def check_env_docs(verbose=True):
+    """Every ``PADDLE_*`` env knob referenced anywhere in ``paddle_tpu/``
+    must be mentioned in at least one ``docs/*.md`` file — an env knob
+    nobody can discover is a knob nobody tunes (the PR-5
+    DistributedStrategy-field rule, applied to the env surface). Returns
+    the list of undocumented knobs (empty = pass)."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    pat = re.compile(r"PADDLE_[A-Z0-9_]*[A-Z0-9]")
+    found = set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, name), errors="replace") as f:
+                found.update(pat.findall(f.read()))
+    docs_text = ""
+    docs_dir = os.path.join(root, "docs")
+    for name in sorted(os.listdir(docs_dir)):
+        if name.endswith(".md"):
+            with open(os.path.join(docs_dir, name), errors="replace") as f:
+                docs_text += f.read()
+    missing = sorted(k for k in found
+                     if k not in docs_text and k not in ENV_DOC_EXEMPT)
+    if verbose:
+        for k in missing:
+            print(f"FAIL env knob {k} has no docs/*.md mention")
+        print(f"{len(found) - len(missing)}/{len(found)} env knobs "
+              f"documented")
+    return missing
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -317,4 +372,5 @@ def check(verbose=True):
 if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
-    sys.exit(1 if (check() or check_strategy_docs()) else 0)
+    sys.exit(1 if (check() or check_strategy_docs() or check_env_docs())
+             else 0)
