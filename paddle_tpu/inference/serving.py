@@ -25,6 +25,11 @@ from ..framework.core import Tensor
 #: engine via ``prefill_chunk_tokens=`` or PADDLE_SERVING_CHUNK_TOKENS)
 DEFAULT_PREFILL_CHUNK_TOKENS = 256
 
+#: default per-tick token budget of the ragged continuous-batching
+#: scheduler (``token_budget=`` / PADDLE_SERVING_TOKEN_BUDGET): every
+#: live decode slot contributes 1 token, prefill spans fill the rest
+DEFAULT_SERVING_TOKEN_BUDGET = 256
+
 _TELEMETRY = None      # lazily bound registry families
 
 
@@ -37,6 +42,18 @@ def _chunk_bucket(n_valid, cap):
     while b < n_valid:
         b *= 2
     return min(b, max(int(cap), 1)) if n_valid <= cap else int(cap)
+
+
+def _token_bucket(n, cap):
+    """Pad a ragged tick's packed token batch to the next power of two
+    (min 1, capped at the token budget). Unlike the chunk buckets there
+    is no floor of 8: a decode-only tick with two live slots runs a
+    2-token program, not an 8-token one — padded-token waste on
+    decode-heavy ticks is what the ragged scheduler exists to remove."""
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max(int(cap), 1)) if n <= cap else int(cap)
 
 
 def _telemetry():
@@ -92,6 +109,15 @@ def _telemetry():
                 "paddle_serving_page_pool_occupancy",
                 "fraction of the shared KV page pool backing live or "
                 "prefix-cached context"),
+            "budget_util": r.histogram(
+                "paddle_serving_token_budget_utilization",
+                "useful-token fraction of each padded ragged step "
+                "(1 - utilization = padding waste)",
+                buckets=DEFAULT_RATIO_BUCKETS),
+            "ragged_tokens": r.counter(
+                "paddle_serving_ragged_tokens_total",
+                "tokens executed through the ragged program family",
+                labels=("kind",)),
         }
     return _TELEMETRY
 
@@ -102,10 +128,18 @@ def _engine_state(engine) -> dict:
     state = {"engine": engine._ENGINE, "running": engine._running,
              "queue_depth": engine._q.qsize()}
     for attr in ("batches_run", "decode_steps", "prefills", "max_batch",
-                 "prefill_chunks", "cancelled_rows"):
+                 "prefill_chunks", "cancelled_rows", "ragged_steps",
+                 "token_budget", "ragged_prefill_tokens",
+                 "ragged_decode_tokens", "padded_tokens_total",
+                 "useful_tokens_total"):
         v = getattr(engine, attr, None)
         if v is not None:
             state[attr] = v
+    buckets = getattr(engine, "ragged_buckets_used", None)
+    if buckets:
+        state["ragged_buckets_used"] = sorted(buckets)
+    if getattr(engine, "enable_ragged", None) is not None:
+        state["ragged"] = engine.enable_ragged
     cache = getattr(engine, "_cache", None)
     if cache is not None:
         state["prefix_cache"] = {
@@ -390,6 +424,18 @@ class ContinuousServingEngine:
     out = engine.generate(prompt_ids, max_new_tokens=64)   # blocks
     engine.stop()
 
+    **Ragged continuous batching (default).** Each tick packs up to
+    ``token_budget`` tokens into ONE flat batch — every live decode
+    slot's single token plus as many prefill tokens as fit (per-span cap
+    ``prefill_chunk_tokens``) — and runs them through the single ragged
+    paged-attention program family (Ragged Paged Attention, arxiv
+    2604.15464). The batch is padded to a bounded bucket set, so the
+    whole mixed prefill+decode workload compiles a small fixed family of
+    programs and decode liveness no longer trades against the prefill
+    chunk budget. ``PADDLE_SERVING_RAGGED=0`` / ``enable_ragged=False``
+    restores the legacy two-program scheduler (one prefill chunk + one
+    fixed-shape decode step per tick).
+
     Prefix caching defaults on; disable with ``enable_prefix_cache=False``
     or ``PADDLE_SERVING_PREFIX_CACHE=0`` (legacy per-request prefill
     behavior, still chunked). ``prefill_chunk_tokens`` >= ``max_len``
@@ -401,7 +447,8 @@ class ContinuousServingEngine:
 
     def __init__(self, model, max_batch_size=8, page_size=16, max_len=2048,
                  pad_token_id=0, prefill_chunk_tokens=None,
-                 enable_prefix_cache=None, num_pages=None):
+                 enable_prefix_cache=None, num_pages=None,
+                 token_budget=None, enable_ragged=None):
         self.model = model
         self.max_batch = int(max_batch_size)
         self.page_size = int(page_size)
@@ -416,6 +463,19 @@ class ContinuousServingEngine:
                 "PADDLE_SERVING_CHUNK_TOKENS",
                 str(DEFAULT_PREFILL_CHUNK_TOKENS)))
         self.chunk_tokens = max(int(prefill_chunk_tokens), 1)
+        if enable_ragged is None:
+            enable_ragged = os.environ.get(
+                "PADDLE_SERVING_RAGGED", "1") != "0"
+        self.enable_ragged = bool(enable_ragged)
+        if token_budget is None:
+            token_budget = int(os.environ.get(
+                "PADDLE_SERVING_TOKEN_BUDGET",
+                str(DEFAULT_SERVING_TOKEN_BUDGET)))
+        # every live decode slot is entitled to its 1 token per tick, so
+        # the effective budget never starves decode — clamping here (not
+        # per tick) keeps the compiled bucket set fixed for the engine's
+        # lifetime
+        self.token_budget = max(int(token_budget), self.max_batch, 1)
         self.num_pages = num_pages
         self._q: queue.Queue = queue.Queue()
         self._thread = None
@@ -426,9 +486,34 @@ class ContinuousServingEngine:
         self.prefills = 0              # rows admitted (one per sequence)
         self.prefill_chunks = 0        # chunk forwards run
         self.cancelled_rows = 0
+        self.ragged_steps = 0          # ragged packed forwards run
+        self.ragged_prefill_tokens = 0
+        self.ragged_decode_tokens = 0
+        # padded-vs-useful accounting for BOTH schedulers (the bench's
+        # waste-ratio metric): padded counts every token position a
+        # compiled program processed, useful only the real ones
+        self.padded_tokens_total = 0
+        self.useful_tokens_total = 0
+        #: bucket sizes actually compiled — the inventory guard asserts
+        #: this stays inside :meth:`declared_token_buckets`
+        self.ragged_buckets_used: set = set()
         # scheduling trace for liveness tests / debugging: ("chunk",
         # slot, n_valid, done) and ("decode", n_active) events in order
+        # (the ragged scheduler emits both per packed tick)
         self.events: deque = deque(maxlen=4096)
+
+    def declared_token_buckets(self):
+        """The ragged scheduler's full compiled-shape family: every tick's
+        flat token batch is padded to one of these sizes, so the number
+        of compiled programs is bounded for the engine's lifetime
+        regardless of traffic mix (enforced by tools/check_inventory.py's
+        serving-program guard)."""
+        out, b = set(), 1
+        while b < self.token_budget:
+            out.add(b)
+            b *= 2
+        out.add(self.token_budget)
+        return out
 
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  timeout=None, **kwargs):
@@ -494,8 +579,11 @@ class ContinuousServingEngine:
         row = active[slot]
         start = int(cache.lens[slot])
         n_valid = min(self.chunk_tokens, row.prompt.shape[0] - start)
-        padded = min(_chunk_bucket(n_valid, self.chunk_tokens),
-                     self.max_len - start)
+        # the padded shape comes ONLY from the fixed bucket set — never
+        # clamped to max_len - start, which would compile a dedicated
+        # program per request tail (pad positions past the slot's page
+        # table scatter to the scratch page, so over-padding is safe)
+        padded = _chunk_bucket(n_valid, self.chunk_tokens)
         chunk = np.full(padded, self.pad_token_id, row.prompt.dtype)
         chunk[:n_valid] = row.prompt[start:start + n_valid]
         # pad positions clip to the last valid position (their rope /
@@ -507,6 +595,8 @@ class ContinuousServingEngine:
         logits = self.model.forward(Tensor(chunk[None]), cache=cache,
                                     position_ids=pos)
         self.prefill_chunks += 1
+        self.padded_tokens_total += padded
+        self.useful_tokens_total += n_valid
         tele["chunk_util"].observe(n_valid / max(padded, 1))
         done = start + n_valid >= row.prompt.shape[0]
         self.events.append(("chunk", slot, n_valid, done))
@@ -573,6 +663,205 @@ class ContinuousServingEngine:
         return cache
 
     def _serve_impl(self):
+        if self.enable_ragged:
+            return self._serve_ragged()
+        return self._serve_legacy()
+
+    def _serve_ragged(self):
+        """Token-budget continuous batching: ONE ragged forward per tick
+        covering every live decode slot's token plus as many prefill
+        tokens as fit in ``token_budget`` (per-span cap
+        ``chunk_tokens``), padded to the fixed bucket set — the single
+        ragged program family replaces the legacy chunk+decode pair."""
+        from ..models.generation import _sample_logits
+
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            cache = self._new_cache()
+            free: deque = deque(range(self.max_batch))
+            active: list = [None] * self.max_batch
+            pending: deque = deque()
+            prefill_q: deque = deque()    # slots mid-prefill, FIFO
+
+            def enqueue(item):
+                """False = stop token; otherwise split into rows."""
+                if item is self._STOP or item is None:
+                    return False
+                item._rows = [_Row(item, row) for row in item.ids]
+                pending.extend(item._rows)
+                return True
+
+            def drop_slot(i):
+                active[i] = None
+                cache.free(i)
+                if i in prefill_q:
+                    prefill_q.remove(i)
+                free.append(i)
+
+            while True:
+                draining = not self._running
+                if draining and all(r is None for r in active):
+                    break
+                # block only when idle; otherwise drain without waiting
+                if not draining and not pending and \
+                        all(r is None for r in active):
+                    if not enqueue(self._q.get()):
+                        self._running = False
+                        continue     # drain in-flight rows before exit
+                if not draining:
+                    try:
+                        while True:
+                            if not enqueue(self._q.get_nowait()):
+                                self._running = False
+                                break
+                    except queue.Empty:
+                        pass
+                if not self._running and pending:
+                    # stop(): un-admitted rows fail fast — including any
+                    # already-admitted SIBLING rows of the same request
+                    # (the base engine's contract, see _serve_legacy)
+                    dropped = {row.req for row in pending}
+                    for row in pending:
+                        row.req.error = RuntimeError("ServingEngine stopped")
+                        row.req.done.set()
+                    pending.clear()
+                    for i, r in enumerate(active):
+                        if r is not None and r.req in dropped:
+                            drop_slot(i)
+                # cancellation sweep (step boundary): free slots/pages a
+                # timed-out client still holds
+                for i, r in enumerate(active):
+                    if r is not None and r.req.cancelled:
+                        r.done = True
+                        self.cancelled_rows += 1
+                        drop_slot(i)
+                tele = _telemetry()
+                try:
+                    if self._running:
+                        self._admit(cache, free, active, pending, prefill_q)
+                    # ---- pack the tick: decode tokens first, then as
+                    # many prefill tokens as the budget admits ----------
+                    decode_slots = [i for i, r in enumerate(active)
+                                    if r is not None and r.state == "decode"]
+                    spans = []        # (slot, q_start, start, n, kind)
+                    off = 0
+                    for i in decode_slots:
+                        spans.append((i, off, int(cache.lens[i]), 1,
+                                      "decode"))
+                        off += 1
+                    remaining = self.token_budget - off
+                    for slot in list(prefill_q):
+                        if remaining <= 0:
+                            break
+                        row = active[slot]
+                        start = int(cache.lens[slot])
+                        n = min(self.chunk_tokens,
+                                row.prompt.shape[0] - start, remaining)
+                        if n <= 0:
+                            break
+                        spans.append((slot, off, start, n, "prefill"))
+                        off += n
+                        remaining -= n
+                    tele["active"].set(sum(r is not None for r in active))
+                    tele["free_slots"].set(len(free))
+                    tele["free_pages"].set(cache.free_page_count)
+                    tele["pool_occupancy"].set(
+                        cache.used_page_count / max(cache.num_pages - 1, 1))
+                    if not spans:
+                        continue
+                    total = off
+                    padded = _token_bucket(total, self.token_budget)
+                    flat = np.full(padded, self.pad_token_id, np.int64)
+                    pos = np.zeros(padded, np.int32)
+                    for slot, qs, start, n, kind in spans:
+                        row = active[slot]
+                        if kind == "decode":
+                            flat[qs] = (row.generated[-1] if row.generated
+                                        else row.prompt[-1])
+                            pos[qs] = start
+                        else:
+                            flat[qs:qs + n] = row.prompt[start:start + n]
+                            pos[qs:qs + n] = np.arange(start, start + n)
+                    t_step = time.perf_counter()
+                    cache.begin_ragged(
+                        [(slot, qs, n) for slot, qs, _, n, _ in spans])
+                    logits = self.model.forward(Tensor(flat[None]),
+                                                cache=cache,
+                                                position_ids=pos)
+                    lg = logits._data[0].astype(jnp.float32)  # [padded, V]
+                    greedy = np.asarray(jnp.argmax(lg, axis=-1))
+                    step_dt = time.perf_counter() - t_step
+                    self.ragged_steps += 1
+                    self.ragged_buckets_used.add(padded)
+                    self.padded_tokens_total += padded
+                    self.useful_tokens_total += total
+                    tele["budget_util"].observe(total / max(padded, 1))
+                    n_decode = len(decode_slots)
+                    n_prefill = total - n_decode
+                    self.ragged_decode_tokens += n_decode
+                    self.ragged_prefill_tokens += n_prefill
+                    if n_decode:
+                        tele["ragged_tokens"].inc(n_decode, kind="decode")
+                    if n_prefill:
+                        tele["ragged_tokens"].inc(n_prefill, kind="prefill")
+
+                    def sample(idx, kw):
+                        if kw.get("do_sample", False):
+                            return int(np.asarray(_sample_logits(
+                                lg[idx:idx + 1], True, kw.get("top_k", 0),
+                                kw.get("top_p", 1.0),
+                                kw.get("temperature", 1.0)))[0])
+                        return int(greedy[idx])
+
+                    # prefill spans: advance, register finished prompts,
+                    # hand completed rows to the decode path
+                    for slot, qs, start, n, kind in spans:
+                        if kind != "prefill":
+                            continue
+                        row = active[slot]
+                        self.prefill_chunks += 1
+                        done = start + n >= row.prompt.shape[0]
+                        self.events.append(("chunk", slot, n, done))
+                        if not done:
+                            continue
+                        prefill_q.remove(slot)
+                        cache.commit_prefix(slot)
+                        row.state = "decode"
+                        self._push_token(cache, free, active, slot,
+                                         sample(qs + n - 1, row.req.kwargs))
+                    # decode tokens: one per live slot, sampled from the
+                    # same packed forward
+                    if decode_slots:
+                        self.decode_steps += 1
+                        self.events.append(("decode", n_decode))
+                        tele["decode_step"].observe(step_dt)
+                        for _ in range(n_decode):
+                            tele["token"].observe(step_dt / n_decode)
+                        for slot, qs, start, n, kind in spans:
+                            if kind != "decode":
+                                continue
+                            row = active[slot]
+                            if row is None or row.done:
+                                continue
+                            self._push_token(cache, free, active, slot,
+                                             sample(qs, row.req.kwargs))
+                except Exception as e:      # fail everything in flight
+                    reqs = {r.req for r in pending}
+                    reqs |= {r.req for r in active if r is not None}
+                    for req in reqs:
+                        req.error = e
+                        req.done.set()
+                    pending.clear()
+                    prefill_q.clear()
+                    active = [None] * self.max_batch
+                    free = deque(range(self.max_batch))
+                    cache = self._new_cache()
+        finally:
+            if was_training:
+                self.model.train()
+
+    def _serve_legacy(self):
         from ..models.generation import _sample_logits
 
         was_training = self.model.training
@@ -670,6 +959,11 @@ class ContinuousServingEngine:
                                                 position_ids=pos)
                     lg = logits._data[:, -1].astype(jnp.float32)
                     self.decode_steps += 1
+                    # the fixed-shape decode step burns a token position
+                    # for every slot, live or not — the padding waste the
+                    # ragged scheduler exists to remove
+                    self.padded_tokens_total += self.max_batch
+                    self.useful_tokens_total += n_active
                     self.events.append(("decode", n_active))
                     step_dt = time.perf_counter() - t_step
                     tele["decode_step"].observe(step_dt)

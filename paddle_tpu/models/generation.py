@@ -424,6 +424,29 @@ class SlotPagedKVCache:
             self._make_writable(int(i),
                                 int(self.lens[i]) // self.page_size)
 
+    def begin_ragged(self, spans):
+        """Arm the next forward as ONE ragged mixed prefill+decode step
+        (Ragged Paged Attention, arxiv 2604.15464). ``spans`` is a list
+        of ``(slot, q_start, n_new)``: slot's next ``n_new`` context
+        tokens sit at ``q_start`` of the flat ``[1, tokens]`` batch
+        (``n_new == 1`` is a decode token). ``q_start`` must be
+        non-decreasing across spans; tokens outside every span are
+        bucket padding — their K/V scatters to the scratch page and
+        their output is discarded. Pages are allocated and
+        copy-on-write-resolved here, once per step, for every span."""
+        spans = [(int(s), int(qs), int(n)) for s, qs, n in spans]
+        for slot, _, n_new in spans:
+            start = int(self.lens[slot])
+            if start + n_new > self.max_len:
+                raise ValueError(f"slot overflow: {start}+{n_new} > "
+                                 f"{self.max_len}")
+            self._ensure_blocks(slot, start + n_new)
+            for blk in range(start // self.page_size,
+                             -(-(start + n_new) // self.page_size)):
+                self._make_writable(slot, blk)
+        self._mode = ("ragged", spans)
+        self._idx = None
+
     def free(self, slot):
         slot = int(slot)
         for i in range(int(self._n_blocks[slot])):
@@ -445,6 +468,9 @@ class SlotPagedKVCache:
         if mode == "prefill":
             n = self._prefill_valid
             self.lens[arg] += int(s) if n is None else min(int(s), n)
+        elif mode == "ragged":
+            for slot, _, n_new in arg:
+                self.lens[slot] += n_new
         else:
             self.lens[arg] += 1
 
@@ -476,9 +502,11 @@ class SlotPagedKVCache:
             if start + n_valid > self.max_len:
                 raise ValueError(f"slot overflow: {start}+{n_valid} > "
                                  f"{self.max_len}")
-            if start + s > self.pages_per_seq * self.page_size:
-                raise ValueError(f"padded chunk {start}+{s} exceeds the "
-                                 f"slot's page table")
+            # NB: start + s (PADDED chunk) may exceed the slot's page
+            # table near max_len — pad positions scatter to the scratch
+            # page regardless, so the engine can keep every chunk shape
+            # inside its fixed bucket set instead of compiling a
+            # per-request tail shape
             if self._idx is None:    # indices shared by every layer
                 self._ensure_blocks(slot, start + n_valid)
                 for blk in range(start // self.page_size,
@@ -507,19 +535,75 @@ class SlotPagedKVCache:
                 # allocated blocks are the scratch page — those keys sit
                 # at pad positions and are never attended by valid
                 # queries.
-                n_pages = -(-(start + s) // self.page_size)
+                n_pages = min(-(-(start + s) // self.page_size),
+                              self.pages_per_seq)
                 tb = jnp.asarray(self._tables[slot, :n_pages])
-                kf = Tensor(jnp.moveaxis(new_kp[:, tb], 0, 2)
-                            .reshape(n_pages * self.page_size, kv_heads,
-                                     d)[None, :start + s])
-                vf = Tensor(jnp.moveaxis(new_vp[:, tb], 0, 2)
-                            .reshape(n_pages * self.page_size, kv_heads,
-                                     d)[None, :start + s])
+                kf_flat = jnp.moveaxis(new_kp[:, tb], 0, 2).reshape(
+                    n_pages * self.page_size, kv_heads, d)
+                vf_flat = jnp.moveaxis(new_vp[:, tb], 0, 2).reshape(
+                    n_pages * self.page_size, kv_heads, d)
+                if n_pages * self.page_size < start + s:
+                    # bucket-padded chunk ran past the table: keep sdpa's
+                    # bottom-right causal alignment by zero-padding the
+                    # key axis — the extra rows sit past every valid
+                    # query's window, only pad queries (output discarded)
+                    # ever attend them
+                    pad = start + s - n_pages * self.page_size
+                    kf_flat = jnp.pad(kf_flat, ((0, pad), (0, 0), (0, 0)))
+                    vf_flat = jnp.pad(vf_flat, ((0, pad), (0, 0), (0, 0)))
+                kf = Tensor(kf_flat[None, :start + s])
+                vf = Tensor(vf_flat[None, :start + s])
             else:
                 kf, vf = k, v
             return F.scaled_dot_product_attention(
                 q, kf, vf, attn_mask=None, is_causal=True,
                 training=training)
+
+        if mode == "ragged":
+            # ONE program for the whole tick: decode tokens and prefill
+            # spans of several sequences packed into a flat [1, tokens]
+            # batch (token-budget scheduler). K/V scatter first, then
+            # the ragged kernel reads every span's full context back
+            # from the pages — causal masking inside each span comes
+            # from the kernel's per-token context bound.
+            assert b == 1, "ragged step packs one flat token batch"
+            spans = arg
+            if self._idx is None:       # indices shared by every layer
+                page_ids = np.zeros(s, np.int64)     # default: scratch
+                slot_ids = np.zeros(s, np.int64)
+                for slot, qs, n_new in spans:
+                    pos = np.arange(self.lens[slot],
+                                    self.lens[slot] + n_new)
+                    page_ids[qs:qs + n_new] = \
+                        self._tables[slot, pos // self.page_size]
+                    slot_ids[qs:qs + n_new] = pos % self.page_size
+                self._idx = (
+                    jnp.asarray(page_ids), jnp.asarray(slot_ids),
+                    jnp.asarray(self._tables),
+                    jnp.asarray([sl for sl, _, _ in spans], jnp.int32),
+                    jnp.asarray([qs for _, qs, _ in spans], jnp.int32),
+                    jnp.asarray([n for _, _, n in spans], jnp.int32),
+                    jnp.asarray([int(self.lens[sl]) + n
+                                 for sl, _, n in spans], jnp.int32))
+            (page_ids, slot_ids, tables, seq_slots, q_starts, q_lens,
+             ctx_lens) = self._idx
+            kt = jnp.moveaxis(ka[0], 1, 0)          # [kv, s, d]
+            vt = jnp.moveaxis(va[0], 1, 0)
+            new_kp = k_pages.at[:, page_ids, slot_ids].set(kt)
+            new_vp = v_pages.at[:, page_ids, slot_ids].set(vt)
+            self._pools[id(layer)] = (new_kp, new_vp)
+
+            from ..ops.pallas.ragged_paged_attention import (
+                ragged_paged_attention)
+            import jax as _jax
+            interpret = _jax.default_backend() != "tpu"
+
+            def fn(qa):
+                out = ragged_paged_attention(
+                    qa[0], new_kp, new_vp, tables, seq_slots, q_starts,
+                    q_lens, ctx_lens, interpret=interpret)
+                return out[None]         # [1, tokens, heads, d]
+            return apply(fn, q, op_name="ragged_paged_attention")
 
         # decode: one token for EVERY slot (fixed shape), per-slot ctx
         assert b == self.max_batch and s == 1

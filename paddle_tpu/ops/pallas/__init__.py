@@ -7,3 +7,6 @@ from .flash_attention import (  # noqa: F401
 )
 from .ring_attention import ring_flash_attention  # noqa: F401
 from .quant_matmul import int8_matmul, quantize_weight  # noqa: F401
+from .ragged_paged_attention import (  # noqa: F401
+    ragged_paged_attention, ragged_paged_attention_reference,
+)

@@ -1,0 +1,264 @@
+"""Ragged paged attention — ONE kernel for mixed prefill + decode over the
+shared paged KV pool (reference: "Ragged Paged Attention", arxiv
+2604.15464; ROADMAP item 1 after PR 4's two-program serving tick).
+
+The serving scheduler packs a tick's work into ONE flat token batch:
+every decoding slot contributes its single current token, every
+mid-prefill slot contributes a span of prompt tokens, and the whole
+batch is padded to a bounded bucket size. Each sequence is described by
+``(slot, q_start, q_len, context_len)``:
+
+* ``slot``         — row of ``block_tables`` (the sequence's page map);
+* ``q_start``      — offset of the sequence's first token in the flat
+                     ``q`` batch (``q_starts`` must be non-decreasing);
+* ``q_len``        — number of NEW tokens this step (1 for decode);
+* ``context_len``  — total context INCLUDING the new tokens, so query
+                     ``j`` of the span attends positions
+                     ``[0, context_len - q_len + j]`` — causal masking
+                     inside the ragged span falls out of the same
+                     per-token context bound the decode kernel uses.
+
+Tokens outside every span (bucket padding) attend one garbage key
+(page 0 slot 0, the pool's scratch page) and their output is discarded
+by the caller — identical to the decode kernel's inactive-slot story.
+
+Three tiers, mirroring ``ops/pallas/paged_attention.py``:
+
+* on real TPU the in-repo kernel is the default once its canary has
+  been proven in a disposable subprocess (``utils.guarded_compile``);
+* ``PADDLE_TPU_RAGGED_IMPL=xla`` (or an unproven kernel) delegates to a
+  plain-XLA gather+softmax fallback — zero Mosaic, wedge-free;
+* CPU tests / ``interpret=True`` run the in-repo kernel in interpret
+  mode: grid ``(tokens, kv_head, pages)``, block-table-steered dynamic
+  BlockSpec index maps (scalar prefetch in SMEM), online-softmax
+  scratch accumulation — the decode kernel's streaming recurrence with
+  per-TOKEN (not per-row) context bounds and table rows.
+
+Unused block-table entries MUST be 0 (a valid page): their scores are
+masked by the per-token context bound but the DMA address must be in
+range.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .paged_attention import _CompilerParams, NEG_INF
+
+
+def _token_descriptors(num_tokens, seq_slots, q_starts, q_lens,
+                       context_lens):
+    """Expand per-sequence ``(slot, q_start, q_len, context_len)``
+    descriptors into the per-token arrays the kernel grid consumes:
+    ``tok_slot[t]`` (block-table row) and ``tok_ctx[t]`` (key positions
+    visible to token ``t``). Padding tokens — outside every span — get
+    ``(slot 0, ctx 1)``: one finite, discarded garbage score instead of
+    an all-masked NaN softmax. Pure jnp, so it traces under jit."""
+    seq_slots = jnp.asarray(seq_slots, jnp.int32)
+    q_starts = jnp.asarray(q_starts, jnp.int32)
+    q_lens = jnp.asarray(q_lens, jnp.int32)
+    context_lens = jnp.asarray(context_lens, jnp.int32)
+    tok = jnp.arange(num_tokens, dtype=jnp.int32)
+    nseq = q_starts.shape[0]
+    seq_of = jnp.clip(
+        jnp.searchsorted(q_starts, tok, side="right").astype(jnp.int32) - 1,
+        0, nseq - 1)
+    off = tok - q_starts[seq_of]
+    valid = (off >= 0) & (off < q_lens[seq_of])
+    tok_slot = jnp.where(valid, seq_slots[seq_of], 0)
+    tok_ctx = jnp.where(
+        valid, context_lens[seq_of] - q_lens[seq_of] + off + 1, 1)
+    return tok_slot, tok_ctx
+
+
+def _ragged_kernel(slots_ref, ctx_ref, tables_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_ref, l_ref, acc_ref, *, sm_scale, page_size,
+                   pages_per_seq, group):
+    t = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[t]
+    q = q_ref[0, 0].astype(jnp.float32)            # [group, d]
+    k = k_ref[0, 0].astype(jnp.float32)            # [page_size, d]
+    v = v_ref[0, 0].astype(jnp.float32)
+    # s[g, ps] — one plain 2-D MXU dot per (token, head, page)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < ctx, s, NEG_INF)
+
+    m_prev = m_ref[...][:, :1]                     # [g, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    w = jnp.exp(s - m_new)                         # masked -> 0
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_ref[...][:, :1] * corr + jnp.sum(w, -1, keepdims=True)
+    pv = jax.lax.dot_general(                      # [g, d]
+        w, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * corr + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                   tok_slot, tok_ctx, *, sm_scale,
+                                   interpret):
+    tokens, heads, d = q.shape
+    kv_heads, _, page_size, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    group = heads // kv_heads
+    qg = q.reshape(tokens, kv_heads, group, d)
+
+    kernel = functools.partial(
+        _ragged_kernel, sm_scale=sm_scale, page_size=page_size,
+        pages_per_seq=pages_per_seq, group=group)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(tokens, kv_heads, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d),
+                         lambda t, h, p, slot, ctx, tbl: (t, h, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda t, h, p, slot, ctx, tbl:
+                         (h, tbl[slot[t], p], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d),
+                         lambda t, h, p, slot, ctx, tbl:
+                         (h, tbl[slot[t], p], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda t, h, p, slot, ctx, tbl: (t, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tokens, kv_heads, group, d),
+                                       q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(tok_slot, jnp.int32), jnp.asarray(tok_ctx, jnp.int32),
+      jnp.asarray(block_tables, jnp.int32), qg, k_pages, v_pages)
+    return out.reshape(tokens, heads, d)
+
+
+def _ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,
+                                tok_slot, tok_ctx, *, sm_scale):
+    """Vectorized jittable XLA tier: gather each token's sequence pages
+    as dense KV, then masked softmax-attention. O(tokens * S_max) HBM —
+    trades the kernel's memory win for wedge-free compiles."""
+    kv_heads, _, page_size, d = k_pages.shape
+    tokens, heads, _ = q.shape
+    group = heads // kv_heads
+    tbl = jnp.asarray(block_tables, jnp.int32)[jnp.asarray(tok_slot,
+                                                           jnp.int32)]
+    # [kv, tokens, pages, slot, d] -> [tokens, kv, S, d]
+    ks = jnp.moveaxis(k_pages[:, tbl], 1, 0).reshape(tokens, kv_heads, -1, d)
+    vs = jnp.moveaxis(v_pages[:, tbl], 1, 0).reshape(tokens, kv_heads, -1, d)
+    qb = (q * sm_scale).reshape(tokens, kv_heads, group, d)
+    s = jnp.einsum("tkgd,tksd->tkgs", qb.astype(jnp.float32),
+                   ks.astype(jnp.float32))
+    valid = (jnp.arange(ks.shape[2])[None, :]
+             < jnp.asarray(tok_ctx, jnp.int32)[:, None])
+    s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("tkgs,tksd->tkgd", w, vs.astype(jnp.float32))
+    return o.reshape(tokens, heads, d).astype(q.dtype)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables, seq_slots,
+                           q_starts, q_lens, context_lens, *,
+                           sm_scale=None, interpret=False):
+    """Mixed prefill+decode attention over a shared paged KV cache.
+
+    q               [tokens, heads, head_dim] — the flat packed batch
+    k_pages/v_pages [kv_heads, num_pages, page_size, head_dim]
+    block_tables    [slots, pages_per_seq] int32 (unused entries = 0)
+    seq_slots       [nseq] int32 — block-table row per sequence
+    q_starts        [nseq] int32 — NON-DECREASING span offsets into q
+    q_lens          [nseq] int32 — span length (1 = decode)
+    context_lens    [nseq] int32 — total context incl. this span
+    -> [tokens, heads, head_dim]; rows outside every span are garbage.
+    """
+    tokens, heads, d = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    tok_slot, tok_ctx = _token_descriptors(tokens, seq_slots, q_starts,
+                                           q_lens, context_lens)
+    if not interpret and jax.default_backend() == "tpu":
+        # Impl choice on real TPU: same wedge-proof ladder as
+        # paged_attention — the in-repo kernel only after its canary is
+        # proven in a disposable subprocess; otherwise zero-Mosaic XLA.
+        import os
+        impl = os.environ.get("PADDLE_TPU_RAGGED_IMPL", "auto").lower()
+        if impl != "xla":
+            from ...utils.guarded_compile import kernel_allowed
+            if impl == "inrepo" or kernel_allowed(
+                    "ragged_paged_attention", "ragged paged attention kernel",
+                    fallback="the XLA gather-attention tier"):
+                return _ragged_paged_attention_pallas(
+                    q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
+                    sm_scale=sm_scale, interpret=False)
+        return _ragged_paged_attention_xla(
+            q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
+            sm_scale=sm_scale)
+    return _ragged_paged_attention_pallas(
+        q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
+        sm_scale=sm_scale, interpret=interpret)
+
+
+def ragged_paged_attention_reference(q, k_pages, v_pages, block_tables,
+                                     seq_slots, q_starts, q_lens,
+                                     context_lens):
+    """Dense numpy-style oracle: per sequence, gather its context from
+    the pages and run plain causal softmax attention for its span. Rows
+    outside every span are zero."""
+    import numpy as np
+
+    tokens, heads, d = q.shape
+    kv_heads, _, page_size, _ = k_pages.shape
+    group = heads // kv_heads
+    out = np.zeros((tokens, heads, d), np.float32)
+    tbl = np.asarray(block_tables)
+    for i in range(len(np.asarray(seq_slots))):
+        slot = int(np.asarray(seq_slots)[i])
+        qs = int(np.asarray(q_starts)[i])
+        ql = int(np.asarray(q_lens)[i])
+        ctx = int(np.asarray(context_lens)[i])
+        n_pages = -(-ctx // page_size)
+        ks = jnp.concatenate([k_pages[:, int(tbl[slot, p])]
+                              for p in range(n_pages)], axis=1)[:, :ctx]
+        vs = jnp.concatenate([v_pages[:, int(tbl[slot, p])]
+                              for p in range(n_pages)], axis=1)[:, :ctx]
+        for j in range(ql):
+            vis = ctx - ql + j + 1                 # causal inside the span
+            qb = q[qs + j].reshape(kv_heads, group, d).astype(jnp.float32)
+            s = jnp.einsum("kgd,ksd->kgs", qb,
+                           ks[:, :vis].astype(jnp.float32)) / math.sqrt(d)
+            w = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("kgs,ksd->kgd", w,
+                           vs[:, :vis].astype(jnp.float32))
+            out[qs + j] = np.asarray(o.reshape(heads, d))
+    return jnp.asarray(out).astype(q.dtype)

@@ -128,6 +128,34 @@ err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
 assert err < 5e-2, ("numeric mismatch", err)
 print("PROOF_OK")
 """,
+    "ragged_paged_attention": _REQUIRE_TPU + """
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.ragged_paged_attention import (
+    _ragged_paged_attention_pallas, _token_descriptors,
+    ragged_paged_attention_reference)
+rs = np.random.RandomState(0)
+kv_heads, group, d, page, npages, pps = 2, 4, 128, 16, 12, 4
+kp = jnp.asarray(rs.randn(kv_heads, npages, page, d), jnp.bfloat16)
+vp = jnp.asarray(rs.randn(kv_heads, npages, page, d), jnp.bfloat16)
+tbl = jnp.asarray(rs.randint(0, npages, (3, pps)), jnp.int32)
+# mixed spans: decode, chunked-prefill continuation, fresh prefill
+slots = jnp.asarray([0, 1, 2], jnp.int32)
+q_starts = jnp.asarray([0, 1, 10], jnp.int32)
+q_lens = jnp.asarray([1, 9, 6], jnp.int32)
+ctx = jnp.asarray([33, 25, 6], jnp.int32)
+q = jnp.asarray(rs.randn(16, kv_heads * group, d), jnp.bfloat16)
+slot_t, ctx_t = _token_descriptors(16, slots, q_starts, q_lens, ctx)
+out = _ragged_paged_attention_pallas(q, kp, vp, tbl, slot_t, ctx_t,
+                                     sm_scale=d ** -0.5, interpret=False)
+ref = ragged_paged_attention_reference(q, kp, vp, tbl, slots, q_starts,
+                                       q_lens, ctx)
+for s, qs, ql in ((0, 0, 1), (1, 1, 9), (2, 10, 6)):
+    err = float(jnp.max(jnp.abs(
+        out[qs:qs + ql].astype(jnp.float32)
+        - ref[qs:qs + ql].astype(jnp.float32))))
+    assert err < 5e-2, ("numeric mismatch", s, err)
+print("PROOF_OK")
+""",
     "quant_matmul": _REQUIRE_TPU + """
 import numpy as np, jax, jax.numpy as jnp
 from paddle_tpu.ops.pallas.quant_matmul import int8_matmul, quantize_weight
@@ -195,7 +223,8 @@ def bench_kernels(mode: str):
         "resnet": [],
         "llama": [_fa_kernel_id()],
         "llama_decode": [_fa_kernel_id(), "paged_attention"],
-        "serving": [_fa_kernel_id(), "paged_attention"],
+        "serving": [_fa_kernel_id(), "paged_attention",
+                    "ragged_paged_attention"],
         "data": [],
     }.get(mode, [])
 

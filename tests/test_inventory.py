@@ -27,6 +27,15 @@ def test_env_knobs_documented():
     assert not missing, f"undocumented PADDLE_* env knobs: {missing}"
 
 
+def test_serving_program_budget():
+    """Compiled-program guard: a mixed prefill+decode load stays inside
+    the ragged scheduler's declared token-bucket family (no per-request
+    shapes / unbounded recompiles) and exercises both token kinds."""
+    from check_inventory import check_serving_programs
+    violations = check_serving_programs(verbose=False)
+    assert not violations, violations
+
+
 def test_paddle_flops():
     import numpy as np
     import paddle_tpu as paddle

@@ -309,7 +309,13 @@ def test_prefix_and_chunk_telemetry(model):
     snap = metrics()
     assert snap["paddle_serving_prefix_hits"]["series"][""] >= 2
     assert snap["paddle_serving_prefix_cached_tokens"]["series"][""] >= 32
-    util = snap["paddle_serving_chunk_utilization"]["series"][""]
-    assert util["count"] >= eng.prefill_chunks > 0
+    # the ragged scheduler observes batch-level budget utilization; the
+    # legacy path observes per-chunk utilization
+    if eng.enable_ragged:
+        util = snap["paddle_serving_token_budget_utilization"]["series"][""]
+        assert util["count"] >= eng.ragged_steps > 0
+    else:
+        util = snap["paddle_serving_chunk_utilization"]["series"][""]
+        assert util["count"] >= eng.prefill_chunks > 0
     assert "paddle_serving_page_pool_occupancy" in snap
     assert "paddle_serving_prefix_misses" in snap

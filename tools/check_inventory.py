@@ -277,6 +277,16 @@ INVENTORY = [
     ("Async/sharded checkpoint manager",
      "paddle_tpu.distributed.fleet.elastic.supervisor",
      ["CheckpointManager", "ElasticTrainLoop", "ElasticWorld"]),
+    # -- ragged paged attention + token-budget scheduler (ISSUE 7) -----------
+    ("Ragged paged attention (mixed prefill+decode kernel)",
+     "paddle_tpu.ops.pallas.ragged_paged_attention",
+     ["ragged_paged_attention", "ragged_paged_attention_reference"]),
+    ("Token-budget continuous batching",
+     "paddle_tpu.inference.serving",
+     ["ContinuousServingEngine", "DEFAULT_SERVING_TOKEN_BUDGET"]),
+    ("Ragged cache step (slot-paged pool)",
+     "paddle_tpu.models.generation",
+     ["SlotPagedKVCache"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -347,6 +357,60 @@ def check_env_docs(verbose=True):
     return missing
 
 
+def check_serving_programs(verbose=True):
+    """Compiled-program-count guard for the serving tier: drive a short
+    MIXED prefill+decode load through the ragged scheduler and fail if
+    any forward ran a shape outside the engine's declared token-bucket
+    family — per-request shapes mean unbounded recompiles in production.
+    Also proves both token kinds actually flowed through the single
+    ragged program family. Returns a list of violation strings."""
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ContinuousServingEngine
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+    rng = np.random.RandomState(0)
+    # deliberately awkward prompt lengths: none is a bucket size
+    prompts = [rng.randint(0, 128, (1, n)).astype(np.int64)
+               for n in (13, 3, 21)]
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=48,
+                                  token_budget=16, prefill_chunk_tokens=16)
+    with eng:
+        threads = [threading.Thread(
+            target=lambda p=p: eng.generate(p, max_new_tokens=3,
+                                            timeout=300))
+            for p in prompts]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    declared = eng.declared_token_buckets()
+    violations = []
+    stray = eng.ragged_buckets_used - declared
+    if stray:
+        violations.append(
+            f"serving ran shapes outside the declared bucket set: "
+            f"{sorted(stray)} (declared {sorted(declared)})")
+    if not eng.ragged_steps:
+        violations.append("mixed load never reached the ragged scheduler")
+    if not (eng.ragged_prefill_tokens and eng.ragged_decode_tokens):
+        violations.append(
+            f"ragged program family missed a token kind: prefill="
+            f"{eng.ragged_prefill_tokens} decode={eng.ragged_decode_tokens}")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"serving programs: {len(eng.ragged_buckets_used)} bucket(s) "
+              f"{sorted(eng.ragged_buckets_used)} within declared "
+              f"{sorted(declared)}; prefill={eng.ragged_prefill_tokens} "
+              f"decode={eng.ragged_decode_tokens} tokens")
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -372,5 +436,6 @@ def check(verbose=True):
 if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
-    sys.exit(1 if (check() or check_strategy_docs() or check_env_docs())
+    sys.exit(1 if (check() or check_strategy_docs() or check_env_docs()
+                   or check_serving_programs())
              else 0)
