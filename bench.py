@@ -963,6 +963,106 @@ def bench_serving():
     }
 
 
+def bench_fleet():
+    """Fleet-router bench (``BENCH_MODEL=fleet``): shared-system-prompt
+    mixed-tenant workload over 2 engine replicas, prefix-affinity
+    routing vs round-robin — the PR-4 ``serving_prefix_ttft_speedup``
+    methodology applied at the orchestration layer (the Gemma-on-TPU
+    serving study, arxiv 2605.25645: replica routing + cache locality
+    decide TPU serving economics)."""
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.elastic.tcp_kv import MemKVStore
+    from paddle_tpu.inference import ServingRouter
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    n_req = int(os.environ.get("BENCH_REQUESTS", "8"))
+    sys_len = int(os.environ.get("BENCH_SYS_PROMPT", "128"))
+    tail = int(os.environ.get("BENCH_TAIL", "8"))
+    new = int(os.environ.get("BENCH_NEW_TOKENS", "8"))
+
+    paddle.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=256,
+                      intermediate_size=704, num_hidden_layers=4,
+                      num_attention_heads=8, num_key_value_heads=4,
+                      max_position_embeddings=max(2048, sys_len + tail + new))
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.default_rng(0)
+    sys_prompt = rng.integers(0, cfg.vocab_size, sys_len)
+    prompts = [np.concatenate([sys_prompt,
+                               rng.integers(0, cfg.vocab_size, tail)])
+               .astype(np.int64)[None] for _ in range(n_req)]
+
+    def run(policy):
+        router = ServingRouter(
+            model, num_replicas=2, policy=policy, store=MemKVStore(),
+            heartbeat_ttl=600.0,
+            engine_kwargs=dict(max_batch_size=4,
+                               max_len=sys_len + tail + new + 16))
+        with router:
+            # request 0 warms compiled programs on ONE replica and (under
+            # affinity) pins the shared chain there; round-robin then
+            # pays the prefill again on the other replica
+            router.generate(prompts[0], max_new_tokens=new,
+                            tenant="tenant0", timeout=1800)
+            ttfts = []
+            for i, p in enumerate(prompts[1:], start=1):
+                t0 = time.perf_counter()
+                router.generate(p, max_new_tokens=1,
+                                tenant=f"tenant{i % 3}", timeout=1800)
+                ttfts.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            threads = [threading.Thread(
+                target=lambda p=p, i=i: router.generate(
+                    p, max_new_tokens=new, tenant=f"tenant{i % 3}",
+                    timeout=1800))
+                for i, p in enumerate(prompts[1:], start=1)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            cached = sum(r.engine._cache.cached_tokens_total
+                         for r in router.replicas)
+            stats = router.stats()
+        return {
+            "ttft_ms": round(float(np.mean(ttfts)) * 1e3, 2),
+            "tokens_per_sec": round((n_req - 1) * new / dt, 2),
+            "cached_tokens": int(cached),
+            "affinity_hits": stats["affinity_hits"],
+            "affinity_matchable": stats["affinity_matchable"],
+        }
+
+    rr = run("round_robin")
+    aff = run("affinity")
+    speedup = round(rr["ttft_ms"] / max(aff["ttft_ms"], 1e-6), 2)
+    for name, val in (
+            ("fleet_affinity_ttft_speedup", speedup),
+            ("fleet_affinity_cached_tokens", aff["cached_tokens"]),
+            ("fleet_rr_cached_tokens", rr["cached_tokens"])):
+        print(json.dumps({"aux_metric": name, "value": val}),
+              file=sys.stderr)
+    return {
+        "metric": "fleet_affinity_ttft_speedup",
+        "value": speedup,
+        "unit": "x (mean TTFT, round-robin / affinity, 2 replicas, "
+                "shared sys prompt)",
+        "vs_baseline": None,
+        "ttft_affinity_ms": aff["ttft_ms"],
+        "ttft_round_robin_ms": rr["ttft_ms"],
+        "tokens_per_sec_affinity": aff["tokens_per_sec"],
+        "tokens_per_sec_round_robin": rr["tokens_per_sec"],
+        "cached_tokens_affinity": aff["cached_tokens"],
+        "cached_tokens_round_robin": rr["cached_tokens"],
+        "affinity_hit_rate": round(
+            aff["affinity_hits"] / max(aff["affinity_matchable"], 1), 3),
+        "config": {"requests": n_req, "sys_prompt": sys_len, "tail": tail,
+                   "new_tokens": new, "replicas": 2},
+    }
+
+
 # --------------------------------------------------------------------------
 # Orchestration: never hang, never exit without a JSON line.
 # --------------------------------------------------------------------------
@@ -1011,6 +1111,7 @@ def _child_main():
     out = (bench_llama() if mode == "llama"
            else bench_llama_decode() if mode == "llama_decode"
            else bench_serving() if mode == "serving"
+           else bench_fleet() if mode == "fleet"
            else bench_data() if mode == "data"
            else bench_dispatch() if mode == "dispatch"
            else bench_bert() if mode == "bert"
@@ -1169,6 +1270,7 @@ def main():
                    else "llama_paged_decode_tokens_per_sec"
                    if mode == "llama_decode"
                    else "serving_prefix_ttft_speedup" if mode == "serving"
+                   else "fleet_affinity_ttft_speedup" if mode == "fleet"
                    else "dataloader_hbm_samples_per_sec" if mode == "data"
                    else "eager_dispatch_overhead_vs_jax"
                    if mode == "dispatch"
@@ -1178,7 +1280,7 @@ def main():
         "value": None,
         "unit": ("tokens/sec" if mode in ("llama", "llama_decode")
                  else "samples/sec" if mode == "data"
-                 else "x" if mode in ("dispatch", "serving")
+                 else "x" if mode in ("dispatch", "serving", "fleet")
                  else "ms/step" if mode == "bert"
                  else "bytes" if mode == "comm"
                  else "images/sec"),

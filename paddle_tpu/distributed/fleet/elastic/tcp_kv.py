@@ -42,6 +42,23 @@ class MemKVStore:
         with self._lock:
             self._d.pop(key, None)
 
+    def incr(self, key, delta=1):
+        """Atomic fleet-wide counter: add ``delta`` and return the new
+        value. Counters share the key space with put/get (the value is a
+        plain int, readable by ``get``); the whole read-modify-write runs
+        under the store lock so concurrent increments never lose."""
+        with self._lock:
+            raw = self._d.get(key)
+            cur = 0
+            if raw is not None:
+                try:
+                    cur = int(json.loads(raw)["value"])
+                except (ValueError, TypeError):
+                    cur = 0
+            cur += int(delta)
+            self._d[key] = json.dumps({"value": cur, "ts": time.time()})
+            return cur
+
     def keys(self, prefix=""):
         with self._lock:
             return [k for k in self._d if k.startswith(prefix)]
@@ -101,8 +118,21 @@ class TcpKVStore:
             return None
         try:
             return json.loads(raw.decode())["value"]
-        except ValueError:
+        except (ValueError, UnicodeDecodeError, TypeError):
+            # counter keys (see incr) hold the native ADD op's raw
+            # little-endian int64, not the JSON envelope
+            if len(raw) == 8:
+                return int.from_bytes(raw, "little", signed=True)
             return None
+
+    def incr(self, key, delta=1):
+        """Atomic fleet-wide counter via the native TCPStore ADD op —
+        the server applies the add under its own lock, so increments
+        from any number of clients/hosts never lose. NB: the stored
+        representation is a raw int64 (``get`` reads it back as an int,
+        ``age`` has no timestamp for it); don't mix ``put`` and ``incr``
+        on the same key."""
+        return int(self._store.add(key, int(delta)))
 
     def delete(self, key):
         self._store.delete_key(key)
@@ -114,7 +144,7 @@ class TcpKVStore:
         try:
             raw = self._store.get(key, wait=False)
             return time.time() - json.loads(raw.decode())["ts"]
-        except (KeyError, ValueError):
+        except (KeyError, ValueError, UnicodeDecodeError, TypeError):
             return None
 
     def close(self):

@@ -222,3 +222,5 @@ class PrecisionType:
     Int8 = 2
 
 from .serving import ServingEngine, ContinuousServingEngine  # noqa: E402,F401
+from .fleet import (ServingRouter, Rejected,                 # noqa: E402,F401
+                    TenantQuotaManager, ROUTER_POLICIES)

@@ -1,0 +1,10 @@
+"""Serving fleet: a router front end over N continuous-batching engine
+replicas — prefix-cache-affinity routing, prefill/decode disaggregation,
+fleet-wide per-tenant admission quotas, and replica health/drain/rejoin
+(ROADMAP item 2; see docs/SERVING.md "Serving fleet")."""
+from .quota import Rejected, TenantQuotaManager                  # noqa: F401
+from .router import (DEFAULT_FLEET_AFFINITY, ROUTER_POLICIES,    # noqa: F401
+                     Replica, ServingRouter)
+
+__all__ = ["ServingRouter", "Replica", "Rejected", "TenantQuotaManager",
+           "ROUTER_POLICIES", "DEFAULT_FLEET_AFFINITY"]

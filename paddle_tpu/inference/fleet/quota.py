@@ -1,0 +1,95 @@
+"""Per-tenant admission control for the serving fleet.
+
+Quotas are token buckets accounted FLEET-WIDE: the consumed-token counter
+for each tenant lives in the shared elastic KV store (``MemKVStore`` on
+the thread-rank simulator tier, ``TcpKVStore`` across processes/hosts)
+and is advanced with the store's atomic ``incr`` — N routers admitting
+the same tenant concurrently can never double-spend a budget. A request
+that exceeds its tenant's budget is refused up front with a structured
+:class:`Rejected` (reason ``tenant_quota``) before any model work — the
+caller learns immediately instead of burning its timeout in a queue.
+"""
+from __future__ import annotations
+
+import time
+
+
+class Rejected(RuntimeError):
+    """Structured fleet admission rejection — NOT a timeout. ``reason``
+    is one of ``tenant_quota`` (the tenant's fleet-wide token budget is
+    spent), ``queue_full`` (every live replica is over the router's
+    queue-token backpressure bound), or ``no_replicas`` (no healthy
+    replica can take the request)."""
+
+    def __init__(self, reason, detail="", tenant=None):
+        self.reason = str(reason)
+        self.tenant = tenant
+        self.detail = detail
+        msg = f"request rejected ({self.reason})"
+        if tenant is not None:
+            msg += f" tenant={tenant}"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class TenantQuotaManager:
+    """Fleet-wide token-bucket quotas per tenant id.
+
+    A tenant's bucket holds ``capacity`` tokens and refills at
+    ``refill_per_s`` tokens/second (``refill_per_s=0`` makes it a hard
+    budget — the deterministic configuration tests use). The admitted
+    cost of a request is its token footprint (uncached prompt estimate +
+    decode budget), charged via ``store.incr`` so the counter is one
+    fleet-wide truth; a rejected request's charge is rolled back with a
+    negative increment.
+
+    ``capacity <= 0`` means the tenant is unlimited. Per-tenant
+    ``overrides`` ({tenant: (capacity, refill_per_s)}) win over the
+    defaults.
+    """
+
+    def __init__(self, store, capacity=0, refill_per_s=0.0,
+                 namespace="fleet", overrides=None):
+        self.store = store
+        self.capacity = int(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self.ns = namespace
+        self.overrides = dict(overrides or {})
+
+    def _limits(self, tenant):
+        cap, rate = self.overrides.get(
+            tenant, (self.capacity, self.refill_per_s))
+        return int(cap), float(rate)
+
+    def _key(self, tenant, leaf):
+        return f"{self.ns}/quota/{tenant}/{leaf}"
+
+    def admit(self, tenant, cost_tokens):
+        """Charge ``cost_tokens`` to ``tenant``'s fleet-wide bucket.
+        Returns None on admission; raises :class:`Rejected` (reason
+        ``tenant_quota``) when the bucket cannot cover the cost."""
+        cap, rate = self._limits(tenant)
+        if cap <= 0:
+            return
+        cost = max(int(cost_tokens), 1)
+        t0_key = self._key(tenant, "t0")
+        t0 = self.store.get(t0_key)
+        if t0 is None:
+            # first sighting of the tenant anywhere in the fleet starts
+            # its refill clock; near-simultaneous writers land within
+            # clock jitter of each other, which the bucket tolerates
+            self.store.put(t0_key, time.time())
+            t0 = self.store.get(t0_key) or time.time()
+        allowance = cap + rate * max(time.time() - float(t0), 0.0)
+        used = self.store.incr(self._key(tenant, "used"), cost)
+        if used > allowance:
+            self.store.incr(self._key(tenant, "used"), -cost)  # roll back
+            raise Rejected(
+                "tenant_quota", tenant=tenant,
+                detail=f"cost {cost} tokens over budget "
+                       f"(used {used - cost}/{int(allowance)})")
+
+    def usage(self, tenant):
+        """Current consumed-token counter for ``tenant`` (0 if unseen)."""
+        return int(self.store.get(self._key(tenant, "used")) or 0)

@@ -1,0 +1,678 @@
+"""Serving fleet router: N continuous-batching engine replicas behind one
+front end (ROADMAP item 2; the Gemma-on-TPU serving comparison, arxiv
+2605.25645, argues TPU serving economics are won at exactly this
+orchestration layer — replica routing, cache locality, KV transfer).
+
+Four pillars:
+
+* **Prefix-cache-affinity routing** — every request's prompt is hashed
+  into its ``block_hash_chain`` (PR 4); the router keeps a per-replica
+  hash-frontier map and scores replicas by ``affinity * matched_tokens -
+  (1 - affinity) * load_tokens`` (``PADDLE_FLEET_AFFINITY``), so requests
+  sharing a system prompt land on the replica already holding those KV
+  pages and everything else falls back to least-loaded (live token
+  occupancy accounted router-side from in-flight footprints, cross-checked
+  against the engine's flight-recorder state provider).
+* **Prefill/decode disaggregation** (``PADDLE_FLEET_DISAGG=1``) —
+  dedicated prefill replicas run the chunked/ragged prefill, then the
+  finished KV pages travel to a decode replica via
+  ``SlotPagedKVCache.export_pages``/``import_pages`` (re-registered under
+  the receiver's prefix index, so greedy decode is bit-identical to
+  colocated serving).
+* **Per-tenant admission quotas** — fleet-wide token buckets over the
+  elastic KV store's atomic ``incr`` (:mod:`.quota`); over-budget and
+  queue-full requests fail fast with a structured ``Rejected(reason)``.
+* **Replica health & drain** — replicas heartbeat engine state through
+  the flight-recorder KV publish path; a missed-TTL replica is marked
+  dead and hard-aborted, its queued and in-flight requests requeue to
+  survivors (decode restarts from the cached prefix; tokens are delivered
+  to the caller exactly once, on the attempt that completes), and a
+  drained replica can rejoin.
+
+Thread-per-replica on the simulator tier; on device tiers each replica is
+its own process and the same router logic coordinates over ``TcpKVStore``.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from ...framework.core import Tensor
+from ...models.generation import block_hash_chain
+from ..serving import ContinuousServingEngine, _engine_state
+from .quota import Rejected, TenantQuotaManager
+
+#: every routing-decision label the router can emit (the
+#: ``paddle_fleet_routed_total{policy=}`` values); tools/check_inventory.py
+#: requires each to be exercised by a test
+ROUTER_POLICIES = ("affinity", "balance", "round_robin", "disagg")
+
+#: default affinity-vs-balance weight (PADDLE_FLEET_AFFINITY): 1.0 always
+#: follows the longest matching hash chain, 0.0 is pure least-loaded
+DEFAULT_FLEET_AFFINITY = 0.9
+
+#: per-replica frontier map cap (digests); oldest entries age out
+_FRONTIER_CAP = 8192
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        from ...profiler.telemetry import get_registry
+        r = get_registry()
+        _TELEMETRY = {
+            "routed": r.counter(
+                "paddle_fleet_routed_total",
+                "requests routed, by deciding policy",
+                labels=("policy",)),
+            "requeues": r.counter(
+                "paddle_fleet_requeues_total",
+                "requests requeued to a surviving replica",
+                labels=("reason",)),
+            "rejected": r.counter(
+                "paddle_fleet_rejected_total",
+                "requests refused at admission (structured Rejected)",
+                labels=("tenant", "reason")),
+            "hit_rate": r.gauge(
+                "paddle_fleet_affinity_hit_rate",
+                "fraction of prefix-matchable requests routed to the "
+                "replica holding the longest chain"),
+            "qdepth": r.gauge(
+                "paddle_fleet_replica_queue_depth",
+                "requests waiting inside each replica's engine queue",
+                labels=("replica",)),
+            "alive": r.gauge(
+                "paddle_fleet_replicas_alive",
+                "replicas currently routable"),
+            "handoff": r.counter(
+                "paddle_fleet_handoff_pages_total",
+                "KV pages moved prefill->decode (disaggregation)"),
+        }
+    return _TELEMETRY
+
+
+class _ReplicaDied(Exception):
+    """Internal: the attempt's replica died under it — requeue."""
+
+    def __init__(self, replica, cause):
+        self.replica = replica
+        self.cause = cause
+        super().__init__(f"replica {replica.id} died: {cause}")
+
+
+class _Ticket:
+    """One client request inside the router. Tokens are delivered to the
+    caller exactly once — only the attempt that matches ``attempt`` at
+    completion may set the result, so a requeued request's superseded
+    attempt (which restarts decode from the cached prefix on a survivor)
+    can never double-deliver."""
+
+    _ids = itertools.count()
+
+    def __init__(self, ids, max_new_tokens, tenant, chain, timeout, kwargs):
+        self.id = next(self._ids)
+        self.ids = ids                      # np [1, s]
+        self.max_new_tokens = int(max_new_tokens)
+        self.tenant = tenant
+        self.chain = chain
+        self.kwargs = kwargs
+        self.deadline = (None if timeout is None
+                         else time.monotonic() + float(timeout))
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+        self.attempt = 0
+        self.replica = None
+        self.cancelled = False
+
+    def remaining(self):
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+
+class Replica:
+    """Router-side handle for one engine replica: its role, liveness,
+    hash-frontier map, and in-flight token footprints."""
+
+    def __init__(self, rid, engine, role="mixed"):
+        self.id = str(rid)
+        self.engine = engine
+        self.role = role                # mixed | prefill | decode
+        self.alive = False
+        self.draining = False
+        self.heartbeating = True
+        self.frontier: OrderedDict = OrderedDict()   # digest -> None (LRU)
+        self.inflight: dict = {}        # ticket id -> token footprint
+
+    @property
+    def load_tokens(self):
+        """Live token-budget occupancy: uncached-prompt + decode-budget
+        tokens of everything routed here and not yet finished."""
+        return sum(self.inflight.values())
+
+    @property
+    def queue_depth(self):
+        return self.engine._q.qsize()
+
+    def matched_tokens(self, chain):
+        """Tokens covered by the LEADING run of ``chain`` digests this
+        replica is believed to hold (the affinity score's cache term)."""
+        n = 0
+        for d in chain:
+            if d not in self.frontier:
+                break
+            n += 1
+        return n * self.engine.page_size
+
+    def note_chain(self, chain):
+        for d in chain:
+            self.frontier[d] = None
+            self.frontier.move_to_end(d)
+        while len(self.frontier) > _FRONTIER_CAP:
+            self.frontier.popitem(last=False)
+
+    def kill(self):
+        """Simulate replica process death: stop heartbeating (the router
+        health loop will miss the TTL, mark it dead, and requeue its
+        work). The engine object itself is aborted by the router."""
+        self.heartbeating = False
+
+
+class ServingRouter:
+    """Fleet front end over N :class:`ContinuousServingEngine` replicas.
+
+    router = ServingRouter(model, num_replicas=3, store=MemKVStore())
+    router.start()
+    out = router.generate(prompt_ids, max_new_tokens=64, tenant="acme")
+    router.stop()
+
+    ``generate`` blocks like the engine API and returns the same greedy
+    output a single engine would (bit-identical — routing, handoff and
+    requeue never change tokens). Admission failures raise the structured
+    :class:`Rejected` immediately instead of timing out.
+    """
+
+    def __init__(self, model=None, num_replicas=2, engines=None,
+                 engine_kwargs=None, store=None, policy="affinity",
+                 affinity=None, disagg=None, prefill_replicas=1,
+                 quota=None, tenant_quotas=None, max_queue_tokens=None,
+                 heartbeat_interval=0.5, heartbeat_ttl=None,
+                 health_interval=None, namespace="fleet"):
+        if engines is None:
+            if model is None:
+                raise ValueError("ServingRouter needs a model or engines=")
+            kw = dict(engine_kwargs or {})
+            engines = [ContinuousServingEngine(model, **kw)
+                       for _ in range(int(num_replicas))]
+        if policy not in ("affinity", "balance", "round_robin"):
+            raise ValueError(f"unknown router policy {policy!r} "
+                             f"(one of affinity|balance|round_robin; "
+                             f"disagg is the PADDLE_FLEET_DISAGG mode)")
+        self.policy = policy
+        if affinity is None:
+            affinity = float(os.environ.get("PADDLE_FLEET_AFFINITY",
+                                            str(DEFAULT_FLEET_AFFINITY)))
+        self.affinity = min(max(float(affinity), 0.0), 1.0)
+        if disagg is None:
+            disagg = os.environ.get("PADDLE_FLEET_DISAGG", "0") == "1"
+        self.disagg = bool(disagg)
+        if max_queue_tokens is None:
+            max_queue_tokens = int(os.environ.get(
+                "PADDLE_FLEET_MAX_QUEUE_TOKENS", "0"))
+        self.max_queue_tokens = int(max_queue_tokens)
+        if store is None:
+            from ...distributed.fleet.elastic.tcp_kv import MemKVStore
+            store = MemKVStore()
+        self.store = store
+        self.ns = namespace
+        roles = ["mixed"] * len(engines)
+        if self.disagg:
+            if len(engines) < 2:
+                raise ValueError("disaggregation needs >= 2 replicas")
+            n_pre = min(max(int(prefill_replicas), 1), len(engines) - 1)
+            roles = (["prefill"] * n_pre
+                     + ["decode"] * (len(engines) - n_pre))
+        self.replicas = [Replica(f"r{i}", eng, role)
+                         for i, (eng, role) in enumerate(zip(engines,
+                                                             roles))]
+        self.page_size = int(self.replicas[0].engine.page_size)
+        if quota is None:
+            default_cap = int(os.environ.get("PADDLE_FLEET_TENANT_TOKENS",
+                                             "0"))
+            if tenant_quotas or default_cap > 0:
+                quota = TenantQuotaManager(
+                    store, capacity=default_cap, namespace=namespace,
+                    overrides=tenant_quotas)
+        self.quota = quota
+        self.heartbeat_interval = float(heartbeat_interval)
+        # generous default: on the interpret-mode simulator tier the GIL
+        # can starve heartbeat threads for whole forwards, and a spurious
+        # fleet-wide death is far worse than slow detection
+        self.heartbeat_ttl = float(
+            heartbeat_ttl if heartbeat_ttl is not None
+            else os.environ.get("PADDLE_FLEET_HEARTBEAT_TTL_S",
+                                str(10.0 * self.heartbeat_interval)))
+        self.health_interval = float(
+            health_interval if health_interval is not None
+            else max(self.heartbeat_interval / 2.0, 0.02))
+        self._lock = threading.RLock()
+        self._stop_evt = threading.Event()
+        self._threads: list = []
+        self._started = False
+        self._rr_next = 0
+        self._flight_key = None
+        self._models_training: list = []
+        # counters mirrored by the state provider (tests read these too)
+        self.routed_total = 0
+        self.requeues_total = 0
+        self.rejected_total = 0
+        self.affinity_matchable = 0
+        self.affinity_hits = 0
+        self.handoff_pages = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def _hb_key(self, replica):
+        return f"{self.ns}/replica/{replica.id}"
+
+    def start(self):
+        if self._started:
+            return self
+        # the router owns eval-mode for the shared model(s): a dying
+        # replica's teardown must never flip training mode back on while
+        # survivors are still serving
+        seen = {}
+        for r in self.replicas:
+            m = r.engine.model
+            if id(m) not in seen:
+                seen[id(m)] = (m, m.training)
+                m.eval()
+        self._models_training = list(seen.values())
+        self._stop_evt.clear()
+        for r in self.replicas:
+            r.engine.start()
+            r.alive = True
+            r.heartbeating = True
+            self._publish_heartbeat(r)     # liveness visible before the
+            #                                health loop takes its first look
+        from ...profiler import flight_recorder as _flight
+        self._flight_key = f"serving_fleet_{id(self):x}"
+        _flight.register_state_provider(self._flight_key, self._state)
+        self._started = True
+        for r in self.replicas:
+            t = threading.Thread(target=self._heartbeat_loop, args=(r,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        t = threading.Thread(target=self._health_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self):
+        if not self._started:
+            return
+        self._started = False
+        self._stop_evt.set()
+        for t in self._threads:
+            t.join(timeout=10)
+        self._threads = []
+        for r in self.replicas:
+            if r.alive:
+                r.engine.stop()
+            r.alive = False
+        if self._flight_key is not None:
+            from ...profiler import flight_recorder as _flight
+            _flight.unregister_state_provider(self._flight_key)
+            self._flight_key = None
+        for m, was_training in self._models_training:
+            if was_training:
+                m.train()
+        self._models_training = []
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- heartbeat / health -------------------------------------------------
+    def _publish_heartbeat(self, replica):
+        from ...profiler import flight_recorder as _flight
+        state = _engine_state(replica.engine)
+        state.update(replica=replica.id, role=replica.role,
+                     draining=replica.draining,
+                     load_tokens=replica.load_tokens,
+                     inflight=len(replica.inflight))
+        _flight.publish_component_state(self.store, self._hb_key(replica),
+                                        state)
+
+    def _heartbeat_loop(self, replica):
+        tele = _telemetry()
+        while not self._stop_evt.wait(self.heartbeat_interval):
+            if replica.heartbeating and replica.alive:
+                try:
+                    self._publish_heartbeat(replica)
+                except Exception:      # a flaky store must not kill the hb
+                    pass
+            tele["qdepth"].set(replica.queue_depth, replica=replica.id)
+
+    def _health_loop(self):
+        tele = _telemetry()
+        while not self._stop_evt.wait(self.health_interval):
+            for r in self.replicas:
+                if not r.alive or r.draining:
+                    continue
+                age = self.store.age(self._hb_key(r))
+                if age is None or age > self.heartbeat_ttl:
+                    self._on_replica_dead(r, reason="heartbeat_ttl")
+            tele["alive"].set(sum(r.alive for r in self.replicas))
+
+    def _on_replica_dead(self, replica, reason):
+        with self._lock:
+            if not replica.alive:
+                return
+            replica.alive = False
+            replica.heartbeating = False
+            # engine restart rebuilds the KV cache from scratch: the
+            # router's belief about what it holds dies with it
+            replica.frontier.clear()
+        from ...profiler import flight_recorder as _flight
+        _flight.record_event("fleet_replica_dead", replica=replica.id,
+                             reason=reason)
+        # hard abort (no drain): blocked dispatch threads get their
+        # requests failed NOW and requeue to survivors; run off-thread so
+        # the health loop never stalls on the engine join
+        threading.Thread(target=replica.engine.abort, daemon=True).start()
+
+    # -- ops hooks ----------------------------------------------------------
+    def kill_replica(self, rid, hard=True):
+        """Chaos hook. ``hard`` models a dead process: the engine aborts
+        now and blocked dispatches requeue immediately via the fast
+        attempt-failure path. ``hard=False`` only silences the heartbeat,
+        leaving detection entirely to the health loop's missed-TTL sweep
+        (the zombie-replica scenario)."""
+        r = self._replica(rid)
+        r.kill()
+        if hard:
+            self._on_replica_dead(r, reason="killed")
+
+    def drain(self, rid, timeout=60.0):
+        """Graceful removal: stop routing to the replica, wait for its
+        in-flight work, stop the engine. The replica can ``rejoin``."""
+        r = self._replica(rid)
+        with self._lock:
+            r.draining = True
+        deadline = time.monotonic() + timeout
+        while r.inflight and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if r.inflight:
+            raise TimeoutError(f"replica {rid} still has "
+                               f"{len(r.inflight)} in-flight requests")
+        r.engine.stop()
+        with self._lock:
+            r.alive = False
+            r.frontier.clear()
+        return r
+
+    def rejoin(self, rid):
+        """Bring a drained (or dead-and-recovered) replica back into the
+        routable set with a fresh engine lifecycle."""
+        r = self._replica(rid)
+        if r.alive:
+            return r
+        r.engine.start()
+        with self._lock:
+            r.alive = True
+            r.draining = False
+            r.heartbeating = True
+        self._publish_heartbeat(r)
+        return r
+
+    def _replica(self, rid):
+        for r in self.replicas:
+            if r.id == str(rid):
+                return r
+        raise KeyError(f"no replica {rid!r}")
+
+    # -- client API ---------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens=32, tenant="default",
+                 timeout=None, chain=None, **kwargs):
+        """Route one request through the fleet and block for its output
+        (a ``Tensor``, prompt included — the engine contract). Raises
+        :class:`Rejected` on admission failure, ``TimeoutError`` when
+        ``timeout`` elapses."""
+        if not self._started:
+            raise RuntimeError("ServingRouter not started (call start())")
+        ids = (input_ids.numpy() if isinstance(input_ids, Tensor)
+               else np.asarray(input_ids))
+        if ids.ndim == 1:
+            ids = ids[None]
+        if ids.shape[0] != 1:
+            raise ValueError("the fleet router takes one sequence per "
+                             "request (batch client-side fan-out belongs "
+                             "above the router)")
+        if chain is None:
+            chain = block_hash_chain(ids[0], self.page_size)
+        cost = ids.shape[1] + int(max_new_tokens)
+        tele = _telemetry()
+        try:
+            if self.quota is not None:
+                self.quota.admit(tenant, cost)
+            self._check_backpressure(tenant)
+        except Rejected as e:
+            with self._lock:
+                self.rejected_total += 1
+            tele["rejected"].inc(tenant=str(tenant), reason=e.reason)
+            raise
+        ticket = _Ticket(ids, max_new_tokens, tenant, chain, timeout,
+                         kwargs)
+        worker = threading.Thread(target=self._dispatch, args=(ticket,),
+                                  daemon=True)
+        worker.start()
+        if not ticket.done.wait(timeout):
+            with self._lock:
+                ticket.cancelled = True
+            raise TimeoutError("fleet generate timed out")
+        if ticket.error is not None:
+            raise ticket.error
+        return Tensor(ticket.result)
+
+    def _check_backpressure(self, tenant):
+        if self.max_queue_tokens <= 0:
+            return
+        with self._lock:
+            elig = [r for r in self.replicas
+                    if r.alive and not r.draining and r.role != "prefill"]
+            if elig and min(r.load_tokens for r in elig) \
+                    >= self.max_queue_tokens:
+                raise Rejected(
+                    "queue_full", tenant=tenant,
+                    detail=f"every replica over "
+                           f"{self.max_queue_tokens} queued tokens")
+
+    # -- dispatch -----------------------------------------------------------
+    def _dispatch(self, ticket):
+        tele = _telemetry()
+        while not ticket.done.is_set():
+            if ticket.cancelled:
+                return
+            rem = ticket.remaining()
+            if rem is not None and rem <= 0:
+                ticket.error = TimeoutError("fleet generate timed out")
+                ticket.done.set()
+                return
+            try:
+                out = (self._run_disagg(ticket) if self.disagg
+                       else self._run_colocated(ticket))
+            except _ReplicaDied as e:
+                # fast-path detection: the attempt's replica is gone even
+                # if the TTL sweep hasn't fired yet
+                self._on_replica_dead(e.replica, reason="attempt_failed")
+                with self._lock:
+                    self.requeues_total += 1
+                tele["requeues"].inc(reason="replica_dead")
+                continue                      # re-route to a survivor
+            except Exception as e:            # noqa: BLE001 — to caller
+                ticket.error = e
+                ticket.done.set()
+                return
+            with self._lock:
+                if ticket.cancelled:
+                    return                    # at-most-once: discard
+                ticket.result = out
+            ticket.done.set()
+            return
+
+    def _run_attempt(self, ticket, replica, max_new_tokens):
+        """One engine call, with the replica's in-flight footprint held
+        for its duration and death translated to ``_ReplicaDied``."""
+        try:
+            out = replica.engine.generate(
+                ticket.ids, max_new_tokens=max_new_tokens,
+                timeout=ticket.remaining(), **ticket.kwargs)
+            return np.asarray(out.numpy())
+        except TimeoutError:
+            raise
+        except Exception as e:
+            if not replica.alive or replica.engine._aborted:
+                raise _ReplicaDied(replica, e) from e
+            raise
+        finally:
+            with self._lock:
+                replica.inflight.pop(ticket.id, None)
+
+    def _run_colocated(self, ticket):
+        with self._lock:
+            replica = self._route_locked(ticket, roles=("mixed",))
+        return self._run_attempt(ticket, replica, ticket.max_new_tokens)
+
+    def _run_disagg(self, ticket):
+        tele = _telemetry()
+        # phase 1 — prefill replica fills + commits the prompt's blocks
+        # (max_new_tokens=1 is pure prefill in the ragged scheduler: the
+        # single token samples from the final prefill chunk's logits, so
+        # the replica never runs a decode step)
+        with self._lock:
+            pre = self._route_locked(ticket, roles=("prefill",),
+                                     label="disagg")
+        blob = None
+        try:
+            self._run_attempt(ticket, pre, max_new_tokens=1)
+            chain = ticket.chain
+            blob = pre.engine.run_on_loop(
+                lambda eng: eng._cache.export_pages(chain))
+        except _ReplicaDied:
+            # degraded mode: the decode replica simply prefills the whole
+            # prompt itself — correctness never depends on the handoff
+            self._on_replica_dead(pre, reason="attempt_failed")
+            with self._lock:
+                self.requeues_total += 1
+            tele["requeues"].inc(reason="replica_dead")
+        except Exception:
+            blob = None                      # handoff is best-effort
+        # phase 2 — decode replica imports the pages under its prefix
+        # index and serves the full request (admission maps the leading
+        # blocks onto the imported pages: no re-prefill of the prefix)
+        with self._lock:
+            dec = self._route_locked(ticket, roles=("decode",),
+                                     label="disagg")
+        if blob:
+            try:
+                n = dec.engine.run_on_loop(
+                    lambda eng: eng._cache.import_pages(blob))
+                if n:
+                    with self._lock:
+                        self.handoff_pages += n
+                    tele["handoff"].inc(n)
+            except Exception:
+                pass                         # full prefill fallback
+        return self._run_attempt(ticket, dec, ticket.max_new_tokens)
+
+    # -- routing ------------------------------------------------------------
+    def _route_locked(self, ticket, roles, label=None):
+        """Pick a replica for the ticket's next attempt (caller holds the
+        lock): longest-matching hash chain weighted against live token
+        occupancy, or round-robin / pure balance per policy."""
+        tele = _telemetry()
+        elig = [r for r in self.replicas
+                if r.alive and not r.draining and r.role in roles]
+        if not elig and roles == ("prefill",):
+            # all dedicated prefill replicas gone: decode replicas absorb
+            # the prefill role rather than refusing traffic
+            elig = [r for r in self.replicas
+                    if r.alive and not r.draining and r.role == "decode"]
+        if not elig:
+            raise Rejected("no_replicas", tenant=ticket.tenant,
+                           detail="no healthy replica for role "
+                                  f"{'/'.join(roles)}")
+        matched = {r.id: r.matched_tokens(ticket.chain) for r in elig}
+        if self.policy == "round_robin":
+            best = elig[self._rr_next % len(elig)]
+            self._rr_next += 1
+            decided = "round_robin"
+        else:
+            aff = 0.0 if self.policy == "balance" else self.affinity
+            best = max(
+                elig,
+                key=lambda r: (aff * matched[r.id]
+                               - (1.0 - aff) * r.load_tokens,
+                               -r.load_tokens, r.id))
+            decided = ("affinity" if aff > 0 and matched[best.id] > 0
+                       else "balance")
+            top = max(matched.values())
+            if top > 0:
+                self.affinity_matchable += 1
+                if matched[best.id] == top:
+                    self.affinity_hits += 1
+                tele["hit_rate"].set(
+                    self.affinity_hits / self.affinity_matchable)
+        if label is not None:
+            decided = label
+        # optimistic frontier: the request will fill+commit these blocks
+        # on that replica; footprint counts only the tokens it will
+        # actually compute there
+        best.note_chain(ticket.chain)
+        footprint = (max(ticket.ids.shape[1] - matched[best.id], 1)
+                     + ticket.max_new_tokens)
+        best.inflight[ticket.id] = footprint
+        ticket.replica = best
+        ticket.attempt += 1
+        self.routed_total += 1
+        tele["routed"].inc(policy=decided)
+        tele["qdepth"].set(best.queue_depth, replica=best.id)
+        return best
+
+    # -- observability ------------------------------------------------------
+    def _state(self):
+        """Fleet state provider payload (flight-recorder dumps)."""
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "affinity": self.affinity,
+                "disagg": self.disagg,
+                "routed_total": self.routed_total,
+                "requeues_total": self.requeues_total,
+                "rejected_total": self.rejected_total,
+                "affinity_hits": self.affinity_hits,
+                "affinity_matchable": self.affinity_matchable,
+                "handoff_pages": self.handoff_pages,
+                "replicas": {
+                    r.id: {"alive": r.alive, "draining": r.draining,
+                           "role": r.role, "inflight": len(r.inflight),
+                           "load_tokens": r.load_tokens,
+                           "queue_depth": r.queue_depth,
+                           "frontier_blocks": len(r.frontier)}
+                    for r in self.replicas},
+            }
+
+    def stats(self):
+        """Router decision counters (tests / dashboards)."""
+        return self._state()

@@ -154,6 +154,33 @@ def _engine_state(engine) -> dict:
     return state
 
 
+class _Control:
+    """A callable posted into the engine queue and executed by the serve
+    loop at a tick boundary — the safe point to touch scheduler-owned
+    state (the KV cache, slot tables) from another thread. The fleet
+    router's disaggregation handoff (export/import of KV pages) rides on
+    this."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+
+    def run(self, engine):
+        try:
+            self.result = self.fn(engine)
+        except Exception as e:        # noqa: BLE001 — fanned to the caller
+            self.error = e
+        finally:
+            self.done.set()
+
+    def fail(self, exc):
+        if not self.done.is_set():
+            self.error = exc
+            self.done.set()
+
+
 class _Request:
     def __init__(self, ids, max_new_tokens, kwargs):
         self.ids = np.asarray(ids)
@@ -193,9 +220,25 @@ class ServingEngine:
         self._q: queue.Queue = queue.Queue()
         self._thread = None
         self._running = False
+        self._aborted = False
         self.batches_run = 0          # observability/testing
 
     # -- client API ----------------------------------------------------------
+    def run_on_loop(self, fn, timeout=30.0):
+        """Run ``fn(engine)`` on the serve-loop thread at the next tick
+        boundary and return its result (raising its exception). The only
+        safe way to inspect or mutate scheduler-owned state (e.g. the
+        slot-paged KV cache) while the engine is serving."""
+        if not self._running:
+            raise RuntimeError("ServingEngine not started (call start())")
+        ctl = _Control(fn)
+        self._q.put(ctl)
+        if not ctl.done.wait(timeout):
+            raise TimeoutError("run_on_loop control not serviced")
+        if ctl.error is not None:
+            raise ctl.error
+        return ctl.result
+
     def generate(self, input_ids, max_new_tokens=32, timeout=None, **kwargs):
         if not self._running:
             raise RuntimeError("ServingEngine not started (call start())")
@@ -247,6 +290,7 @@ class ServingEngine:
         except queue.Empty:
             pass
         self._running = True
+        self._aborted = False
         import weakref
         from ..profiler import flight_recorder as _flight
         self._flight_key = f"serving_{self._ENGINE}_{id(self):x}"
@@ -263,15 +307,26 @@ class ServingEngine:
         if not self._running and self._thread is None:
             return
         self._running = False
+        self._q.put(self._STOP)
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # unregister AFTER the drain: a watchdog dump taken while the
+        # engine winds down must still see its state, and repeated
+        # start/stop (the fleet router's drain/rejoin cycle) must never
+        # accumulate stale providers in dumps
         key = getattr(self, "_flight_key", None)
         if key is not None:
             from ..profiler import flight_recorder as _flight
             _flight.unregister_state_provider(key)
             self._flight_key = None
-        self._q.put(self._STOP)
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-            self._thread = None
+
+    def abort(self):
+        """Hard stop: fail every queued AND in-flight request instead of
+        draining decodes to completion — the fleet tier's simulated
+        replica death (a real process kill has no drain either)."""
+        self._aborted = True
+        self.stop()
 
     # -- scheduler -----------------------------------------------------------
     def _collect(self):
@@ -279,6 +334,9 @@ class ServingEngine:
         window. Groups by (prompt_len, max_new_tokens, kwargs) — equal
         shapes keep the decode batch fixed-shape."""
         first = self._q.get()
+        while isinstance(first, _Control):
+            first.run(self)
+            first = self._q.get()
         if first is self._STOP or first is None:
             return None
         group = [first]
@@ -295,6 +353,9 @@ class ServingEngine:
                     nxt = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
+                if isinstance(nxt, _Control):
+                    nxt.run(self)
+                    continue
                 if nxt is self._STOP or nxt is None:
                     self._q.put(self._STOP)  # re-post the stop token
                     break
@@ -322,6 +383,8 @@ class ServingEngine:
                     if isinstance(item, _Request):
                         item.error = RuntimeError("ServingEngine stopped")
                         item.done.set()
+                    elif isinstance(item, _Control):
+                        item.fail(RuntimeError("ServingEngine stopped"))
             except queue.Empty:
                 pass
 
@@ -480,6 +543,7 @@ class ContinuousServingEngine:
         self._q: queue.Queue = queue.Queue()
         self._thread = None
         self._running = False
+        self._aborted = False
         self._cache = None
         # observability (and the "beats static batching" proof in tests)
         self.decode_steps = 0
@@ -536,6 +600,8 @@ class ContinuousServingEngine:
                                       timeout=timeout, **kwargs)
 
     start = ServingEngine.start
+    run_on_loop = ServingEngine.run_on_loop
+    abort = ServingEngine.abort
     stop = ServingEngine.stop
     _loop = ServingEngine._loop
     __enter__ = ServingEngine.__enter__
@@ -688,6 +754,9 @@ class ContinuousServingEngine:
                 """False = stop token; otherwise split into rows."""
                 if item is self._STOP or item is None:
                     return False
+                if isinstance(item, _Control):
+                    item.run(self)       # tick boundary: scheduler-safe
+                    return True
                 item._rows = [_Row(item, row) for row in item.ids]
                 pending.extend(item._rows)
                 return True
@@ -700,6 +769,16 @@ class ContinuousServingEngine:
                 free.append(i)
 
             while True:
+                if self._aborted:
+                    # replica death (fleet abort()): no drain — every
+                    # queued and in-flight request fails NOW so callers
+                    # can requeue to a surviving replica
+                    err = RuntimeError("ServingEngine aborted")
+                    for row in list(pending) + [r for r in active
+                                                if r is not None]:
+                        row.req.error = err
+                        row.req.done.set()
+                    break
                 draining = not self._running
                 if draining and all(r is None for r in active):
                     break
@@ -877,6 +956,9 @@ class ContinuousServingEngine:
                 """False = stop token; otherwise split into rows."""
                 if item is self._STOP or item is None:
                     return False
+                if isinstance(item, _Control):
+                    item.run(self)       # tick boundary: scheduler-safe
+                    return True
                 item._rows = [_Row(item, row) for row in item.ids]
                 pending.extend(item._rows)
                 return True
@@ -889,6 +971,16 @@ class ContinuousServingEngine:
                 free.append(i)
 
             while True:
+                if self._aborted:
+                    # replica death (fleet abort()): no drain — every
+                    # queued and in-flight request fails NOW so callers
+                    # can requeue to a surviving replica
+                    err = RuntimeError("ServingEngine aborted")
+                    for row in list(pending) + [r for r in active
+                                                if r is not None]:
+                        row.req.error = err
+                        row.req.done.set()
+                    break
                 draining = not self._running
                 if draining and all(r is None for r in active):
                     break

@@ -273,6 +273,13 @@ class SlotPagedKVCache:
         self.prefix_misses = 0        # full blocks that had to prefill
         self.cached_tokens_total = 0
         self.cow_copies = 0
+        # disagg handoff: pages imported before this pool ran its first
+        # forward have no per-layer arrays to land in yet — their K/V is
+        # staged here and applied as each layer's pool materializes (pool
+        # creation order == layer forward order == export order)
+        self._import_backlog: list = []     # (page, [(k_blk, v_blk)/layer])
+        self.pages_imported = 0
+        self.pages_exported = 0
 
     # -- page allocator ------------------------------------------------------
     def _alloc_page(self):
@@ -456,6 +463,71 @@ class SlotPagedKVCache:
         self.lens[slot] = 0
         self._chain[slot] = None
 
+    # -- prefill/decode disaggregation handoff -------------------------------
+    def export_pages(self, digests):
+        """Serialize the prefix-index pages backing the LEADING run of
+        ``digests`` (a ``block_hash_chain``) — the prefill→decode
+        disaggregation payload. Returns ``None`` when the first digest
+        is not registered, else a dict with the digests actually
+        exported and one host-side ``[kv, blocks, page_size, d]`` K/V
+        array pair per attention layer (layer order == pool creation
+        order == forward order, the cross-replica identity). On device
+        tiers the ``np.asarray`` copies ARE the wire transfer."""
+        pages, out_digests = [], []
+        for d in digests:
+            page = self._index.get(d)
+            if page is None:
+                break
+            self._index.move_to_end(d)              # LRU touch
+            pages.append(int(page))
+            out_digests.append(bytes(d))
+        if not out_digests or not self._pools:
+            return None
+        idx = jnp.asarray(pages)
+        layers = [(np.asarray(kp[:, idx]), np.asarray(vp[:, idx]))
+                  for kp, vp in self._pools.values()]
+        self.pages_exported += len(pages)
+        return {"page_size": self.page_size, "digests": out_digests,
+                "layers": layers}
+
+    def import_pages(self, blob):
+        """Receiver side of the disagg handoff: allocate pages for the
+        exported blocks, write their K/V into this pool, and register
+        the digests in the prefix index (holding the index's own ref,
+        exactly like :meth:`commit_prefix`) so the next ``assign`` of a
+        prompt sharing the chain maps straight onto them. Digests
+        already registered are skipped — first writer wins. Returns the
+        number of pages imported."""
+        if not blob or not self.enable_prefix_cache:
+            return 0
+        if int(blob["page_size"]) != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: exporter {blob['page_size']} vs "
+                f"importer {self.page_size}")
+        imported = 0
+        for j, digest in enumerate(blob["digests"]):
+            if digest in self._index:
+                continue
+            page = self._alloc_page()        # ref=1: the index's own ref
+            per_layer = [(k[:, j], v[:, j]) for k, v in blob["layers"]]
+            if self._pools:
+                if len(per_layer) != len(self._pools):
+                    raise ValueError(
+                        f"layer count mismatch: exporter "
+                        f"{len(per_layer)} vs importer {len(self._pools)}")
+                for li, key in enumerate(list(self._pools)):
+                    kp, vp = self._pools[key]
+                    kb, vb = per_layer[li]
+                    self._pools[key] = (kp.at[:, page].set(kb),
+                                        vp.at[:, page].set(vb))
+            else:
+                self._import_backlog.append((page, per_layer))
+            self._index[digest] = page
+            self._page_digest[page] = digest
+            imported += 1
+        self.pages_imported += imported
+        return imported
+
     @property
     def pos(self):
         # models read cache.pos for default position ids; the engine
@@ -477,9 +549,19 @@ class SlotPagedKVCache:
     def _pool(self, layer, kv_heads, d, dtype):
         key = id(layer)
         if key not in self._pools:
+            li = len(self._pools)       # this layer's forward-order index
             shape = (kv_heads, self.num_pages, self.page_size, d)
-            self._pools[key] = (jnp.zeros(shape, dtype),
-                                jnp.zeros(shape, dtype))
+            kp = jnp.zeros(shape, dtype)
+            vp = jnp.zeros(shape, dtype)
+            # land any pre-forward disagg imports (import_pages before the
+            # first request) for this layer; entries whose page has since
+            # been evicted from the index are dead — skip them
+            for page, per_layer in self._import_backlog:
+                if li < len(per_layer) and page in self._page_digest:
+                    kb, vb = per_layer[li]
+                    kp = kp.at[:, page].set(kb)
+                    vp = vp.at[:, page].set(vb)
+            self._pools[key] = (kp, vp)
         return self._pools[key]
 
     # -- attention ----------------------------------------------------------

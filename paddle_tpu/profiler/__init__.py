@@ -28,7 +28,8 @@ from .telemetry import (  # noqa: F401  (re-exported facade)
 from . import flight_recorder  # noqa: F401
 from .flight_recorder import (  # noqa: F401  (re-exported facade)
     FlightRecorder, Watchdog, get_flight_recorder, gather_metrics,
-    publish_snapshot, merge_chrome_traces, merge_rank_snapshots,
+    publish_snapshot, publish_component_state, gather_component_states,
+    merge_chrome_traces, merge_rank_snapshots,
     desync_report, straggler_report,
 )
 
@@ -39,8 +40,9 @@ __all__ = [
     "MetricRegistry", "SpanTracer", "get_registry", "get_tracer",
     "metrics", "metrics_text", "enable_op_telemetry", "disable_op_telemetry",
     "FlightRecorder", "Watchdog", "get_flight_recorder", "gather_metrics",
-    "publish_snapshot", "merge_chrome_traces", "merge_rank_snapshots",
-    "desync_report", "straggler_report",
+    "publish_snapshot", "publish_component_state",
+    "gather_component_states", "merge_chrome_traces",
+    "merge_rank_snapshots", "desync_report", "straggler_report",
 ]
 
 

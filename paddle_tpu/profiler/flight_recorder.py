@@ -549,6 +549,28 @@ def publish_snapshot(store, rank=None) -> dict:
     return snap
 
 
+def publish_component_state(store, name, state) -> dict:
+    """Deposit one named component's state dict into an elastic KV store
+    — the serving fleet's replica-heartbeat path (same transport as
+    :func:`publish_snapshot`; the store's own value timestamp makes TTL
+    liveness checks via ``store.age`` work unchanged)."""
+    payload = {"component": name, "state": state}
+    if _ENABLED:
+        record_event("component_state", component=name)
+    store.put(name, payload)
+    return payload
+
+
+def gather_component_states(store, prefix) -> dict:
+    """{key: state} for every component published under ``prefix``."""
+    out = {}
+    for key in store.keys(prefix):
+        v = store.get(key)
+        if isinstance(v, dict) and "component" in v:
+            out[key] = v.get("state")
+    return out
+
+
 def gather_snapshots(store) -> dict:
     """{rank: snapshot} for every rank that published."""
     out = {}

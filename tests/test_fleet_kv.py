@@ -1,0 +1,235 @@
+"""Fleet substrate (ISSUE 8 satellites): atomic KV counters on both
+store tiers, the SlotPagedKVCache page export/import handoff, tenant
+token buckets, and the engine start/stop state-provider lifecycle."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic.tcp_kv import (MemKVStore,
+                                                         TcpKVStore)
+from paddle_tpu.inference.fleet import Rejected, TenantQuotaManager
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+
+# ---------------------------------------------------------------------------
+# atomic incr — MemKVStore (thread tier) and TcpKVStore (native TCPStore)
+# ---------------------------------------------------------------------------
+
+def test_mem_kv_incr_concurrent():
+    store = MemKVStore()
+
+    def bump():
+        for _ in range(250):
+            store.incr("fleet/quota/t/used", 2)
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert store.get("fleet/quota/t/used") == 8 * 250 * 2
+    assert store.incr("fleet/quota/t/used", -1000) == 3000
+    # counters live in the same key space as put/get
+    assert store.get("fleet/quota/t/used") == 3000
+
+
+def test_tcp_kv_incr_concurrent():
+    from paddle_tpu.distributed import native
+    if not native.available():
+        pytest.skip("native TCPStore unavailable")
+    master = TcpKVStore("tcp://127.0.0.1:0")
+    port = master._store.port
+    try:
+        results = []
+
+        def bump():
+            # one client per thread — the realistic fleet shape (each
+            # router/replica process owns its own connection)
+            client = TcpKVStore(f"tcp://127.0.0.1:{port}")
+            try:
+                for _ in range(100):
+                    results.append(client.incr("ctr", 1))
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=bump) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert master.incr("ctr", 0) == 400
+        # every increment observed a distinct value (no lost updates)
+        assert len(set(results)) == 400
+        # get() reads the native ADD representation back as an int
+        assert master.get("ctr") == 400
+    finally:
+        master.close()
+
+
+# ---------------------------------------------------------------------------
+# page export/import (disagg handoff payload)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(num_hidden_layers=2,
+                                       max_position_embeddings=256))
+
+
+def _filled_cache(model, prompt):
+    """Run a 1-token generate so the engine fills + commits the prompt's
+    full blocks, then hand back the engine (still running)."""
+    from paddle_tpu.inference import ContinuousServingEngine
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=96,
+                                  page_size=16)
+    eng.start()
+    eng.generate(prompt, max_new_tokens=1, timeout=600)
+    return eng
+
+
+def test_export_import_roundtrip(model):
+    from paddle_tpu.models.generation import block_hash_chain
+    prompt = np.random.RandomState(0).randint(0, 128, (1, 40)) \
+        .astype(np.int64)
+    chain = block_hash_chain(prompt[0], 16)
+    src = _filled_cache(model, prompt)
+    try:
+        blob = src.run_on_loop(lambda e: e._cache.export_pages(chain))
+        assert blob is not None
+        assert len(blob["digests"]) == 2            # 40 tokens, 2 full blocks
+        assert len(blob["layers"]) == 2             # one K/V pair per layer
+        k0, v0 = blob["layers"][0]
+        assert k0.shape[1] == 2 and k0.shape[2] == 16
+        # source pages survive the export byte-for-byte
+        src_k = src.run_on_loop(
+            lambda e: np.asarray(next(iter(e._cache._pools.values()))[0]
+                                 [:, e._cache._index[blob["digests"][0]]]))
+        np.testing.assert_array_equal(src_k, k0[:, 0])
+    finally:
+        src.stop()
+
+    # import into a COLD cache (no forward run yet): pages land via the
+    # pool-creation backlog, and a prompt sharing the prefix maps onto
+    # them with zero prefill work
+    from paddle_tpu.models.generation import SlotPagedKVCache
+    dst = SlotPagedKVCache(2, page_size=16, max_len=96)
+    assert dst.import_pages(blob) == 2
+    assert dst.pages_imported == 2
+    cached, hits, misses = dst.assign(0, prompt[0])
+    assert (cached, hits) == (32, 2)
+    # re-import is first-writer-wins: nothing double-registers
+    assert dst.import_pages(blob) == 0
+
+
+def test_import_rejects_mismatched_geometry(model):
+    from paddle_tpu.models.generation import SlotPagedKVCache, \
+        block_hash_chain
+    prompt = np.random.RandomState(1).randint(0, 128, (1, 36)) \
+        .astype(np.int64)
+    src = _filled_cache(model, prompt)
+    try:
+        chain = block_hash_chain(prompt[0], 16)
+        blob = src.run_on_loop(lambda e: e._cache.export_pages(chain))
+    finally:
+        src.stop()
+    dst = SlotPagedKVCache(2, page_size=8, max_len=96)
+    with pytest.raises(ValueError):
+        dst.import_pages(blob)
+    # cache-off receivers refuse politely (nothing to register into)
+    dst2 = SlotPagedKVCache(2, page_size=16, max_len=96,
+                            enable_prefix_cache=False)
+    assert dst2.import_pages(blob) == 0
+
+
+# ---------------------------------------------------------------------------
+# tenant token buckets
+# ---------------------------------------------------------------------------
+
+def test_quota_manager_bucket_and_refill():
+    store = MemKVStore()
+    q = TenantQuotaManager(store, capacity=100, refill_per_s=0.0,
+                           overrides={"vip": (0, 0.0),
+                                      "tiny": (10, 1000.0)})
+    q.admit("a", 60)
+    q.admit("a", 40)
+    with pytest.raises(Rejected) as exc:
+        q.admit("a", 1)
+    assert exc.value.reason == "tenant_quota"
+    assert q.usage("a") == 100            # rejected charge rolled back
+    q.admit("vip", 10 ** 9)               # capacity<=0 => unlimited
+    # a refilling bucket recovers: 10-token capacity + 1000 tok/s
+    q.admit("tiny", 10)
+    import time
+    time.sleep(0.05)
+    q.admit("tiny", 10)
+
+    # two managers over one store share the fleet-wide counter
+    q2 = TenantQuotaManager(store, capacity=100)
+    with pytest.raises(Rejected):
+        q2.admit("a", 1)
+
+
+# ---------------------------------------------------------------------------
+# engine lifecycle: state provider must not leak across start/stop
+# ---------------------------------------------------------------------------
+
+def test_engine_stop_unregisters_state_provider(model):
+    """Repeated start/stop — exactly the router's drain/rejoin cycle —
+    must never accumulate stale providers in watchdog dumps, and the
+    provider must stay live for the engine's whole serving window."""
+    from paddle_tpu.inference import ContinuousServingEngine, ServingEngine
+    from paddle_tpu.profiler import flight_recorder as flight
+
+    def serving_keys():
+        return [k for k in flight._STATE_PROVIDERS
+                if k.startswith("serving_")]
+
+    base = len(serving_keys())
+    prompt = np.random.RandomState(2).randint(0, 128, (1, 12)) \
+        .astype(np.int64)
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=48)
+    for _ in range(3):
+        eng.start()
+        assert len(serving_keys()) == base + 1
+        eng.generate(prompt, max_new_tokens=2, timeout=600)
+        state = flight._STATE_PROVIDERS[eng._flight_key]()
+        assert state["engine"] == "continuous"
+        eng.stop()
+        assert len(serving_keys()) == base, serving_keys()
+    # the static engine shares the same contract (incl. abort teardown)
+    se = ServingEngine(model, max_batch_size=2)
+    se.start()
+    assert len(serving_keys()) == base + 1
+    se.abort()
+    assert len(serving_keys()) == base
+
+
+def test_engine_abort_fails_inflight_fast(model):
+    """abort() is replica death: queued AND in-flight requests error out
+    instead of draining to completion."""
+    from paddle_tpu.inference import ContinuousServingEngine
+    import time
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=96)
+    prompt = np.random.RandomState(3).randint(0, 128, (1, 16)) \
+        .astype(np.int64)
+    errors = []
+
+    def call():
+        try:
+            eng.generate(prompt, max_new_tokens=64, timeout=600)
+        except RuntimeError as e:
+            errors.append(e)
+
+    with eng:
+        t = threading.Thread(target=call)
+        t.start()
+        deadline = time.monotonic() + 5
+        while eng.decode_steps + eng.prefill_chunks == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        eng.abort()
+        t.join(timeout=30)
+    assert errors and "abort" in str(errors[0]).lower()

@@ -27,6 +27,14 @@ def test_env_knobs_documented():
     assert not missing, f"undocumented PADDLE_* env knobs: {missing}"
 
 
+def test_fleet_knobs_covered():
+    """Every PADDLE_FLEET_* knob is documented in docs/SERVING.md and
+    every router policy string is exercised by a test (and documented)."""
+    from check_inventory import check_fleet_knobs
+    violations = check_fleet_knobs(verbose=False)
+    assert not violations, violations
+
+
 def test_serving_program_budget():
     """Compiled-program guard: a mixed prefill+decode load stays inside
     the ragged scheduler's declared token-bucket family (no per-request

@@ -287,6 +287,17 @@ INVENTORY = [
     ("Ragged cache step (slot-paged pool)",
      "paddle_tpu.models.generation",
      ["SlotPagedKVCache"]),
+    # -- serving fleet (ISSUE 8) ---------------------------------------------
+    ("Serving fleet router (affinity/disagg/quotas/health)",
+     "paddle_tpu.inference.fleet",
+     ["ServingRouter", "Replica", "Rejected", "TenantQuotaManager",
+      "ROUTER_POLICIES", "DEFAULT_FLEET_AFFINITY"]),
+    ("Fleet KV atomic counters + component-state publish",
+     "paddle_tpu.distributed.fleet.elastic.tcp_kv",
+     ["MemKVStore", "TcpKVStore"]),
+    ("Fleet heartbeat publish path (flight recorder)",
+     "paddle_tpu.profiler.flight_recorder",
+     ["publish_component_state", "gather_component_states"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -411,6 +422,53 @@ def check_serving_programs(verbose=True):
     return violations
 
 
+def check_fleet_knobs(verbose=True):
+    """Serving-fleet inventory guard: every ``PADDLE_FLEET_*`` env knob
+    referenced in ``paddle_tpu/`` must be documented in docs/SERVING.md's
+    fleet knob table, and every router policy string
+    (``inference.fleet.ROUTER_POLICIES``) must appear in at least one
+    test — a routing mode no test exercises is a routing mode that
+    silently rots. Returns a list of violation strings."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    pat = re.compile(r"PADDLE_FLEET_[A-Z0-9_]*[A-Z0-9]")
+    knobs = set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    knobs.update(pat.findall(f.read()))
+    with open(os.path.join(root, "docs", "SERVING.md"),
+              errors="replace") as f:
+        serving_doc = f.read()
+    violations = [f"fleet knob {k} missing from docs/SERVING.md"
+                  for k in sorted(knobs) if k not in serving_doc]
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name), errors="replace") as f:
+                tests_text += f.read()
+    from paddle_tpu.inference.fleet import ROUTER_POLICIES
+    for policy in ROUTER_POLICIES:
+        if f'"{policy}"' not in tests_text:
+            violations.append(
+                f"router policy {policy!r} not exercised by any test")
+        if policy not in serving_doc:
+            violations.append(
+                f"router policy {policy!r} missing from docs/SERVING.md")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"fleet knobs: {len(knobs)} found, "
+              f"{len(ROUTER_POLICIES)} policies checked")
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -437,5 +495,5 @@ if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
     sys.exit(1 if (check() or check_strategy_docs() or check_env_docs()
-                   or check_serving_programs())
+                   or check_fleet_knobs() or check_serving_programs())
              else 0)
