@@ -842,7 +842,10 @@ def bench_serving():
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.inference import ContinuousServingEngine
+    from paddle_tpu.profiler import request_trace as rt
 
+    # fresh sliding window: the SLO percentiles below cover THIS run
+    rt.reset_slo_monitor()
     n_req = int(os.environ.get("BENCH_REQUESTS", "8"))
     sys_len = int(os.environ.get("BENCH_SYS_PROMPT", "128"))
     tail = int(os.environ.get("BENCH_TAIL", "8"))
@@ -934,13 +937,24 @@ def bench_serving():
     mixed_legacy = run_mixed(False)
     ragged_ratio = round(mixed_ragged["tokens_per_sec"]
                          / max(mixed_legacy["tokens_per_sec"], 1e-9), 2)
+    # latency percentiles + goodput from the request-trace SLO monitor
+    # (every engine generate above fed it) — the bench trajectory's
+    # first latency-percentile entries
+    slo = rt.slo_report()
     for name, val in (
             ("serving_ragged_tokens_per_s_ratio", ragged_ratio),
             ("serving_ragged_waste_ratio", mixed_ragged["waste_ratio"]),
-            ("serving_legacy_waste_ratio", mixed_legacy["waste_ratio"])):
+            ("serving_legacy_waste_ratio", mixed_legacy["waste_ratio"]),
+            ("serving_p95_ttft_ms", round(slo["ttft"]["p95_s"] * 1e3, 2)),
+            ("serving_p95_tpot_ms", round(slo["tpot"]["p95_s"] * 1e3, 2)),
+            ("serving_goodput_ratio", round(slo["goodput_ratio"], 3))):
         print(json.dumps({"aux_metric": name, "value": val}),
               file=sys.stderr)
     return {
+        "p95_ttft_ms": round(slo["ttft"]["p95_s"] * 1e3, 2),
+        "p95_tpot_ms": round(slo["tpot"]["p95_s"] * 1e3, 2),
+        "p95_queue_wait_ms": round(slo["queue_wait"]["p95_s"] * 1e3, 2),
+        "goodput_ratio": round(slo["goodput_ratio"], 3),
         "metric": "serving_prefix_ttft_speedup",
         "value": round(off["ttft_ms"] / max(on["ttft_ms"], 1e-6), 2),
         "unit": "x (mean TTFT, prefix cache off / on, shared sys prompt)",
@@ -977,7 +991,10 @@ def bench_fleet():
     from paddle_tpu.distributed.fleet.elastic.tcp_kv import MemKVStore
     from paddle_tpu.inference import ServingRouter
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.profiler import request_trace as rt
 
+    # fresh sliding window: the SLO percentiles below cover THIS run
+    rt.reset_slo_monitor()
     n_req = int(os.environ.get("BENCH_REQUESTS", "8"))
     sys_len = int(os.environ.get("BENCH_SYS_PROMPT", "128"))
     tail = int(os.environ.get("BENCH_TAIL", "8"))
@@ -1038,13 +1055,24 @@ def bench_fleet():
     rr = run("round_robin")
     aff = run("affinity")
     speedup = round(rr["ttft_ms"] / max(aff["ttft_ms"], 1e-6), 2)
+    # fleet-level SLO percentiles + goodput: every routed request above
+    # fed the request-trace SLO monitor (TTFT measured at the ROUTER,
+    # queue wait and per-token gaps from the engine spans)
+    slo = rt.slo_report()
     for name, val in (
             ("fleet_affinity_ttft_speedup", speedup),
             ("fleet_affinity_cached_tokens", aff["cached_tokens"]),
-            ("fleet_rr_cached_tokens", rr["cached_tokens"])):
+            ("fleet_rr_cached_tokens", rr["cached_tokens"]),
+            ("fleet_p95_ttft_ms", round(slo["ttft"]["p95_s"] * 1e3, 2)),
+            ("fleet_p95_tpot_ms", round(slo["tpot"]["p95_s"] * 1e3, 2)),
+            ("fleet_goodput_ratio", round(slo["goodput_ratio"], 3))):
         print(json.dumps({"aux_metric": name, "value": val}),
               file=sys.stderr)
     return {
+        "p95_ttft_ms": round(slo["ttft"]["p95_s"] * 1e3, 2),
+        "p95_tpot_ms": round(slo["tpot"]["p95_s"] * 1e3, 2),
+        "p95_queue_wait_ms": round(slo["queue_wait"]["p95_s"] * 1e3, 2),
+        "goodput_ratio": round(slo["goodput_ratio"], 3),
         "metric": "fleet_affinity_ttft_speedup",
         "value": speedup,
         "unit": "x (mean TTFT, round-robin / affinity, 2 replicas, "

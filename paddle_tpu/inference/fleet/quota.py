@@ -67,11 +67,13 @@ class TenantQuotaManager:
 
     def admit(self, tenant, cost_tokens):
         """Charge ``cost_tokens`` to ``tenant``'s fleet-wide bucket.
-        Returns None on admission; raises :class:`Rejected` (reason
-        ``tenant_quota``) when the bucket cannot cover the cost."""
+        Returns the tenant's post-charge consumed-token counter (None
+        for an unlimited tenant — the router's admission trace span
+        records it); raises :class:`Rejected` (reason ``tenant_quota``)
+        when the bucket cannot cover the cost."""
         cap, rate = self._limits(tenant)
         if cap <= 0:
-            return
+            return None
         cost = max(int(cost_tokens), 1)
         t0_key = self._key(tenant, "t0")
         t0 = self.store.get(t0_key)
@@ -89,6 +91,7 @@ class TenantQuotaManager:
                 "tenant_quota", tenant=tenant,
                 detail=f"cost {cost} tokens over budget "
                        f"(used {used - cost}/{int(allowance)})")
+        return int(used)
 
     def usage(self, tenant):
         """Current consumed-token counter for ``tenant`` (0 if unseen)."""

@@ -44,6 +44,7 @@ import numpy as np
 
 from ...framework.core import Tensor
 from ...models.generation import block_hash_chain
+from ...profiler import request_trace as _rt
 from ..serving import ContinuousServingEngine, _engine_state
 from .quota import Rejected, TenantQuotaManager
 
@@ -116,12 +117,14 @@ class _Ticket:
 
     _ids = itertools.count()
 
-    def __init__(self, ids, max_new_tokens, tenant, chain, timeout, kwargs):
+    def __init__(self, ids, max_new_tokens, tenant, chain, timeout, kwargs,
+                 trace=None):
         self.id = next(self._ids)
         self.ids = ids                      # np [1, s]
         self.max_new_tokens = int(max_new_tokens)
         self.tenant = tenant
         self.chain = chain
+        self.trace = trace                  # request-trace ctx (or None)
         self.kwargs = kwargs
         self.deadline = (None if timeout is None
                          else time.monotonic() + float(timeout))
@@ -463,26 +466,57 @@ class ServingRouter:
             chain = block_hash_chain(ids[0], self.page_size)
         cost = ids.shape[1] + int(max_new_tokens)
         tele = _telemetry()
+        # the trace is minted BEFORE admission: rejections must trace too
+        ctx = _rt.start_request(tenant=str(tenant), source="router",
+                                prompt_tokens=int(ids.shape[1]),
+                                max_new_tokens=int(max_new_tokens))
         try:
-            if self.quota is not None:
-                self.quota.admit(tenant, cost)
-            self._check_backpressure(tenant)
+            with _rt.span(ctx, "admission", tenant=str(tenant),
+                          cost=cost) as adm:
+                if self.quota is not None:
+                    used = self.quota.admit(tenant, cost)
+                    if used is not None and adm is not None:
+                        adm.tags["quota_used"] = used
+                self._check_backpressure(tenant)
         except Rejected as e:
             with self._lock:
                 self.rejected_total += 1
             tele["rejected"].inc(tenant=str(tenant), reason=e.reason)
+            _rt.add_event(ctx, "rejected", reason=e.reason)
+            _rt.finish_request(ctx, status="rejected", reason=e.reason)
             raise
         ticket = _Ticket(ids, max_new_tokens, tenant, chain, timeout,
-                         kwargs)
+                         kwargs, trace=ctx)
         worker = threading.Thread(target=self._dispatch, args=(ticket,),
                                   daemon=True)
         worker.start()
         if not ticket.done.wait(timeout):
             with self._lock:
                 ticket.cancelled = True
+            # a timed-out request must not vanish from observability:
+            # it traces as terminal AND counts next to the admission
+            # rejections (reason label keeps the paths apart)
+            tele["rejected"].inc(tenant=str(tenant), reason="timeout")
+            _rt.add_event(ctx, "timeout")
+            _rt.finish_request(ctx, status="timeout")
             raise TimeoutError("fleet generate timed out")
         if ticket.error is not None:
+            if isinstance(ticket.error, Rejected):
+                # dispatch-side rejection (no healthy replica): same
+                # accounting as the admission-time path
+                with self._lock:
+                    self.rejected_total += 1
+                tele["rejected"].inc(tenant=str(tenant),
+                                     reason=ticket.error.reason)
+                _rt.add_event(ctx, "rejected", reason=ticket.error.reason)
+                _rt.finish_request(ctx, status="rejected",
+                                   reason=ticket.error.reason)
+            else:
+                _rt.finish_request(ctx, status="error",
+                                   error=type(ticket.error).__name__)
             raise ticket.error
+        _rt.add_event(ctx, "delivered", attempt=ticket.attempt)
+        _rt.finish_request(ctx, status="ok")
         return Tensor(ticket.result)
 
     def _check_backpressure(self, tenant):
@@ -519,6 +553,9 @@ class ServingRouter:
                 with self._lock:
                     self.requeues_total += 1
                 tele["requeues"].inc(reason="replica_dead")
+                _rt.add_event(ticket.trace, "requeue",
+                              reason="replica_dead", replica=e.replica.id,
+                              attempt=ticket.attempt)
                 continue                      # re-route to a survivor
             except Exception as e:            # noqa: BLE001 — to caller
                 ticket.error = e
@@ -537,7 +574,8 @@ class ServingRouter:
         try:
             out = replica.engine.generate(
                 ticket.ids, max_new_tokens=max_new_tokens,
-                timeout=ticket.remaining(), **ticket.kwargs)
+                timeout=ticket.remaining(), trace=ticket.trace,
+                **ticket.kwargs)
             return np.asarray(out.numpy())
         except TimeoutError:
             raise
@@ -567,8 +605,10 @@ class ServingRouter:
         try:
             self._run_attempt(ticket, pre, max_new_tokens=1)
             chain = ticket.chain
-            blob = pre.engine.run_on_loop(
-                lambda eng: eng._cache.export_pages(chain))
+            with _rt.span(ticket.trace, "handoff_export",
+                          replica=pre.id):
+                blob = pre.engine.run_on_loop(
+                    lambda eng: eng._cache.export_pages(chain))
         except _ReplicaDied:
             # degraded mode: the decode replica simply prefills the whole
             # prompt itself — correctness never depends on the handoff
@@ -576,6 +616,8 @@ class ServingRouter:
             with self._lock:
                 self.requeues_total += 1
             tele["requeues"].inc(reason="replica_dead")
+            _rt.add_event(ticket.trace, "requeue", reason="replica_dead",
+                          replica=pre.id, attempt=ticket.attempt)
         except Exception:
             blob = None                      # handoff is best-effort
         # phase 2 — decode replica imports the pages under its prefix
@@ -586,14 +628,21 @@ class ServingRouter:
                                      label="disagg")
         if blob:
             try:
-                n = dec.engine.run_on_loop(
-                    lambda eng: eng._cache.import_pages(blob))
+                with _rt.span(ticket.trace, "handoff_import",
+                              replica=dec.id, source_replica=pre.id):
+                    n = dec.engine.run_on_loop(
+                        lambda eng: eng._cache.import_pages(blob))
                 if n:
                     with self._lock:
                         self.handoff_pages += n
                     tele["handoff"].inc(n)
+                _rt.add_event(ticket.trace, "handoff", pages=int(n or 0),
+                              replica=dec.id, source_replica=pre.id)
             except Exception:
                 pass                         # full prefill fallback
+        else:
+            _rt.add_event(ticket.trace, "handoff_skipped",
+                          replica=dec.id)
         return self._run_attempt(ticket, dec, ticket.max_new_tokens)
 
     # -- routing ------------------------------------------------------------
@@ -648,6 +697,15 @@ class ServingRouter:
         self.routed_total += 1
         tele["routed"].inc(policy=decided)
         tele["qdepth"].set(best.queue_depth, replica=best.id)
+        if ticket.trace is not None:
+            # stamp every later engine-side span with where (and which
+            # try) this attempt runs, then record the decision itself
+            ticket.trace.set_tags(replica=best.id, attempt=ticket.attempt)
+            _rt.add_event(ticket.trace, "route", policy=decided,
+                          role=best.role,
+                          matched_tokens=int(matched[best.id]),
+                          load_tokens=int(best.load_tokens),
+                          affinity=self.affinity)
         return best
 
     # -- observability ------------------------------------------------------

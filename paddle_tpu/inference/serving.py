@@ -20,6 +20,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
+from ..profiler import request_trace as _rt
 
 #: default token budget of one chunked-prefill step (overridable per
 #: engine via ``prefill_chunk_tokens=`` or PADDLE_SERVING_CHUNK_TOKENS)
@@ -138,6 +139,28 @@ def _engine_state(engine) -> dict:
     buckets = getattr(engine, "ragged_buckets_used", None)
     if buckets:
         state["ragged_buckets_used"] = sorted(buckets)
+    # per-request ages, oldest first: a watchdog dump must NAME the stuck
+    # request (trace id + scheduler state), not just the stalled rank
+    reqs = list(getattr(engine, "_inflight_reqs", {}).values())
+    if reqs:
+        now = time.perf_counter()
+        ages = []
+        for r in reqs:
+            rows = getattr(r, "_rows", None)
+            ages.append({
+                "age_s": round(now - r.t_submit, 3),
+                "state": (",".join(sorted({row.state for row in rows}))
+                          if rows else "queued"),
+                "trace": (r.trace.trace_id if r.trace is not None
+                          else None),
+                "cancelled": r.cancelled,
+            })
+        ages.sort(key=lambda a: -a["age_s"])
+        state["oldest_request_age_s"] = ages[0]["age_s"]
+        state["oldest_request_trace"] = ages[0]["trace"]
+        state["request_ages"] = ages[:8]
+    else:
+        state["oldest_request_age_s"] = 0.0
     if getattr(engine, "enable_ragged", None) is not None:
         state["ragged"] = engine.enable_ragged
     cache = getattr(engine, "_cache", None)
@@ -182,12 +205,13 @@ class _Control:
 
 
 class _Request:
-    def __init__(self, ids, max_new_tokens, kwargs):
+    def __init__(self, ids, max_new_tokens, kwargs, trace=None):
         self.ids = np.asarray(ids)
         if self.ids.ndim == 1:
             self.ids = self.ids[None]
         self.max_new_tokens = max_new_tokens
         self.kwargs = kwargs
+        self.trace = trace             # request-trace context (or None)
         self.done = threading.Event()
         self.result = None
         self.error = None
@@ -221,6 +245,7 @@ class ServingEngine:
         self._thread = None
         self._running = False
         self._aborted = False
+        self._inflight_reqs: dict = {}   # id(req) -> req (age tracking)
         self.batches_run = 0          # observability/testing
 
     # -- client API ----------------------------------------------------------
@@ -239,41 +264,65 @@ class ServingEngine:
             raise ctl.error
         return ctl.result
 
-    def generate(self, input_ids, max_new_tokens=32, timeout=None, **kwargs):
+    def generate(self, input_ids, max_new_tokens=32, timeout=None,
+                 trace=None, **kwargs):
         if not self._running:
             raise RuntimeError("ServingEngine not started (call start())")
         ids = input_ids.numpy() if isinstance(input_ids, Tensor) \
             else np.asarray(input_ids)
-        req = _Request(ids, max_new_tokens, kwargs)
+        # mint a request trace at direct engine admission (fleet-less
+        # use); the router passes its own ctx through ``trace=`` and
+        # stays the owner (it finishes the trace at delivery)
+        trace_owned = False
+        if trace is None and _rt.is_enabled():
+            trace = _rt.start_request(
+                source=self._ENGINE, prompt_tokens=int(ids.shape[-1]),
+                max_new_tokens=int(max_new_tokens))
+            trace_owned = True
+        req = _Request(ids, max_new_tokens, kwargs, trace=trace)
         tele = _telemetry()
         tele["requests"].inc(engine=self._ENGINE)
+        self._inflight_reqs[id(req)] = req
         self._q.put(req)
         tele["qdepth"].set(self._q.qsize())
         deadline = None if timeout is None else time.monotonic() + timeout
-        while not req.done.is_set():
-            remaining = (None if deadline is None
-                         else deadline - time.monotonic())
-            if remaining is not None and remaining <= 0:
-                # the scheduler must not keep decoding for a client that
-                # gave up: pending rows are skipped at admission, active
-                # slots/pages freed at the next step boundary
-                req.cancelled = True
-                raise TimeoutError("generate timed out")
-            th = self._thread
-            worker_alive = th is not None and th.is_alive()
-            if not self._running and not worker_alive:
-                # raced with stop() AND the worker (whose exit path fails
-                # every still-queued request) is gone: our request provably
-                # missed the drain — fail it here rather than hang
-                if not req.done.is_set():
-                    req.error = RuntimeError("ServingEngine stopped")
-                    req.done.set()
-                break
-            req.done.wait(0.5 if remaining is None
-                          else min(0.5, remaining))
-        if req.error is not None:
-            raise req.error
-        return Tensor(req.result)
+        try:
+            while not req.done.is_set():
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    # the scheduler must not keep decoding for a client
+                    # that gave up: pending rows are skipped at admission,
+                    # active slots/pages freed at the next step boundary
+                    req.cancelled = True
+                    _rt.add_event(trace, "timeout", engine=self._ENGINE)
+                    if trace_owned:
+                        _rt.finish_request(trace, status="timeout")
+                    raise TimeoutError("generate timed out")
+                th = self._thread
+                worker_alive = th is not None and th.is_alive()
+                if not self._running and not worker_alive:
+                    # raced with stop() AND the worker (whose exit path
+                    # fails every still-queued request) is gone: our
+                    # request provably missed the drain — fail it here
+                    # rather than hang
+                    if not req.done.is_set():
+                        req.error = RuntimeError("ServingEngine stopped")
+                        req.done.set()
+                    break
+                req.done.wait(0.5 if remaining is None
+                              else min(0.5, remaining))
+            if req.error is not None:
+                _rt.add_event(trace, "engine_error",
+                              error=type(req.error).__name__)
+                if trace_owned:
+                    _rt.finish_request(trace, status="error")
+                raise req.error
+            if trace_owned:
+                _rt.finish_request(trace, status="ok")
+            return Tensor(req.result)
+        finally:
+            self._inflight_reqs.pop(id(req), None)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):
@@ -402,6 +451,8 @@ class ServingEngine:
             for r in group:
                 tele["queue_wait"].observe(t_admit - r.t_submit,
                                            engine=self._ENGINE)
+                _rt.add_span(r.trace, "queue_wait", t0=r.t_submit,
+                             dur=t_admit - r.t_submit, engine=self._ENGINE)
             try:
                 batch = np.concatenate([r.ids for r in group], axis=0)
                 kwargs = dict(group[0].kwargs)
@@ -421,6 +472,12 @@ class ServingEngine:
                     r.t_first = t_done
                     tele["ttft"].observe(t_done - r.t_submit,
                                          engine=self._ENGINE)
+                    # the window batcher emits the whole completion at
+                    # once: one batch span + one token mark per request
+                    _rt.add_span(r.trace, "batch_generate", t0=t_admit,
+                                 dur=t_done - t_admit,
+                                 batch=len(group), engine=self._ENGINE)
+                    _rt.note_token(r.trace, t_done)
                 tele["tokens"].inc(
                     (arr.shape[1] - prompt_len) * arr.shape[0],
                     engine=self._ENGINE)
@@ -544,6 +601,7 @@ class ContinuousServingEngine:
         self._thread = None
         self._running = False
         self._aborted = False
+        self._inflight_reqs = {}       # id(req) -> req (age tracking)
         self._cache = None
         # observability (and the "beats static batching" proof in tests)
         self.decode_steps = 0
@@ -580,7 +638,7 @@ class ContinuousServingEngine:
         return out
 
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
-                 timeout=None, **kwargs):
+                 timeout=None, trace=None, **kwargs):
         ids = input_ids.numpy() if isinstance(input_ids, Tensor) \
             else np.asarray(input_ids)
         if ids.ndim == 1:
@@ -597,7 +655,8 @@ class ContinuousServingEngine:
                 f"> engine max_len {self.max_len}")
         return ServingEngine.generate(self, ids,
                                       max_new_tokens=max_new_tokens,
-                                      timeout=timeout, **kwargs)
+                                      timeout=timeout, trace=trace,
+                                      **kwargs)
 
     start = ServingEngine.start
     run_on_loop = ServingEngine.run_on_loop
@@ -621,14 +680,21 @@ class ContinuousServingEngine:
                 self.cancelled_rows += 1
                 continue
             slot = free.popleft()
-            tele["queue_wait"].observe(
-                time.perf_counter() - row.req.t_submit, engine=self._ENGINE)
+            now = time.perf_counter()
+            tele["queue_wait"].observe(now - row.req.t_submit,
+                                       engine=self._ENGINE)
+            _rt.add_span(row.req.trace, "queue_wait",
+                         t0=row.req.t_submit, dur=now - row.req.t_submit,
+                         engine=self._ENGINE)
             if row.prompt.shape[0] < 1:
                 raise ValueError("cannot serve an empty prompt")
             cached, hits, misses = cache.assign(slot, row.prompt)
             tele["prefix_hits"].inc(hits)
             tele["prefix_misses"].inc(misses)
             tele["prefix_cached"].inc(cached)
+            _rt.add_event(row.req.trace, "admit", slot=slot,
+                          cached_tokens=int(cached), prefix_hits=int(hits),
+                          prefix_misses=int(misses), engine=self._ENGINE)
             row.state = "prefill"
             active[slot] = row
             prefill_q.append(slot)
@@ -658,6 +724,7 @@ class ContinuousServingEngine:
         pos = np.minimum(np.arange(start, start + padded, dtype=np.int32),
                          start + n_valid - 1)
         cache.begin_prefill(slot, n_valid)
+        t_chunk = time.perf_counter()
         logits = self.model.forward(Tensor(chunk[None]), cache=cache,
                                     position_ids=pos)
         self.prefill_chunks += 1
@@ -666,6 +733,9 @@ class ContinuousServingEngine:
         tele["chunk_util"].observe(n_valid / max(padded, 1))
         done = start + n_valid >= row.prompt.shape[0]
         self.events.append(("chunk", slot, n_valid, done))
+        _rt.add_span(row.req.trace, "prefill_chunk", t0=t_chunk,
+                     dur=time.perf_counter() - t_chunk, slot=slot,
+                     tokens=n_valid, start=start, last=done)
         if not done:
             return
         prefill_q.popleft()
@@ -683,6 +753,7 @@ class ContinuousServingEngine:
         row.generated.append(token)
         tele = _telemetry()
         tele["tokens"].inc(engine=self._ENGINE)
+        _rt.note_token(row.req.trace)
         if row.req.t_first is None:
             row.req.t_first = time.perf_counter()
             tele["ttft"].observe(row.req.t_first - row.req.t_submit,
@@ -776,6 +847,8 @@ class ContinuousServingEngine:
                     err = RuntimeError("ServingEngine aborted")
                     for row in list(pending) + [r for r in active
                                                 if r is not None]:
+                        _rt.add_event(row.req.trace, "engine_aborted",
+                                      engine=self._ENGINE)
                         row.req.error = err
                         row.req.done.set()
                     break
@@ -814,6 +887,8 @@ class ContinuousServingEngine:
                     if r is not None and r.req.cancelled:
                         r.done = True
                         self.cancelled_rows += 1
+                        _rt.add_event(r.req.trace, "cancelled", slot=i,
+                                      engine=self._ENGINE)
                         drop_slot(i)
                 tele = _telemetry()
                 try:
@@ -884,6 +959,21 @@ class ContinuousServingEngine:
                         tele["ragged_tokens"].inc(n_decode, kind="decode")
                     if n_prefill:
                         tele["ragged_tokens"].inc(n_prefill, kind="prefill")
+                    # request-trace: the packed tick lands as one span on
+                    # every participating request (its kind/tokens in the
+                    # tags — prefill chunks and decode ticks both)
+                    for slot, qs, start, n, kind in spans:
+                        row = active[slot]
+                        if row is None:
+                            continue
+                        name = ("prefill_chunk" if kind == "prefill"
+                                else "decode")
+                        _rt.add_span(
+                            row.req.trace, name, t0=t_step, dur=step_dt,
+                            slot=slot, tokens=n, start=start,
+                            tick=self.ragged_steps,
+                            last=(kind == "prefill" and
+                                  start + n >= row.prompt.shape[0]))
 
                     def sample(idx, kw):
                         if kw.get("do_sample", False):
@@ -978,6 +1068,8 @@ class ContinuousServingEngine:
                     err = RuntimeError("ServingEngine aborted")
                     for row in list(pending) + [r for r in active
                                                 if r is not None]:
+                        _rt.add_event(row.req.trace, "engine_aborted",
+                                      engine=self._ENGINE)
                         row.req.error = err
                         row.req.done.set()
                     break
@@ -1018,6 +1110,8 @@ class ContinuousServingEngine:
                     if r is not None and r.req.cancelled:
                         r.done = True
                         self.cancelled_rows += 1
+                        _rt.add_event(r.req.trace, "cancelled", slot=i,
+                                      engine=self._ENGINE)
                         drop_slot(i)
                 tele = _telemetry()
                 try:
@@ -1066,6 +1160,9 @@ class ContinuousServingEngine:
                     for i, r in enumerate(list(active)):
                         if r is None or r.state != "decode":
                             continue
+                        _rt.add_span(r.req.trace, "decode", t0=t_step,
+                                     dur=step_dt, slot=i, tokens=1,
+                                     tick=self.decode_steps)
                         kw = r.req.kwargs
                         if kw.get("do_sample", False):
                             tok = int(np.asarray(_sample_logits(

@@ -32,6 +32,13 @@ from .flight_recorder import (  # noqa: F401  (re-exported facade)
     merge_chrome_traces, merge_rank_snapshots,
     desync_report, straggler_report,
 )
+from . import request_trace  # noqa: F401
+from .request_trace import (  # noqa: F401  (re-exported facade)
+    TraceContext, RequestTraceStore, SLOMonitor, start_request,
+    finish_request, request_timeline, recent_timelines,
+    timeline_to_chrome, get_slo_monitor, reset_slo_monitor, slo_report,
+    cost_table, get_trace_store,
+)
 
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
@@ -43,6 +50,10 @@ __all__ = [
     "publish_snapshot", "publish_component_state",
     "gather_component_states", "merge_chrome_traces",
     "merge_rank_snapshots", "desync_report", "straggler_report",
+    "TraceContext", "RequestTraceStore", "SLOMonitor", "start_request",
+    "finish_request", "request_timeline", "recent_timelines",
+    "timeline_to_chrome", "get_slo_monitor", "reset_slo_monitor",
+    "slo_report", "cost_table", "get_trace_store",
 ]
 
 

@@ -626,13 +626,24 @@ def gather_metrics(store=None) -> dict:
 
 
 def merge_chrome_traces(traces_by_rank: dict) -> dict:
-    """Union per-rank chrome traces into one: every event's ``pid``
-    becomes its rank (plus a ``process_name`` metadata event per rank),
-    so Perfetto shows one process lane per rank.
+    """Union per-rank (or per-replica) chrome traces into one: every
+    event's ``pid`` becomes its lane key (plus a ``process_name``
+    metadata event per lane), so Perfetto shows one process lane per
+    rank/replica.
+
+    Request flows: any merged event carrying ``args.trace_id`` (the
+    per-request spans ``request_trace.timeline_to_chrome`` emits) is
+    linked to the other events of the same trace_id with chrome flow
+    events (``ph`` s/t/f, ``id`` = trace_id) — a disaggregated request
+    renders as ONE arrow-connected flow from its prefill lane through
+    the handoff to its decode lane.
 
     ``traces_by_rank``: {rank: trace dict | traceEvents list | path}."""
     events = []
-    for rank in sorted(traces_by_rank):
+    # ints (ranks) sort numerically, strings (replica lanes) after
+    for rank in sorted(traces_by_rank,
+                       key=lambda r: ((0, r, "") if isinstance(r, int)
+                                      else (1, 0, str(r)))):
         t = traces_by_rank[rank]
         if isinstance(t, (str, os.PathLike)):
             with open(t) as f:
@@ -644,7 +655,26 @@ def merge_chrome_traces(traces_by_rank: dict) -> dict:
             e = dict(e)
             e["pid"] = rank
             events.append(e)
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    flows: dict = {}
+    for e in events:
+        tid_ = (e.get("args") or {}).get("trace_id")
+        if tid_ is not None and e.get("ph", "X") == "X":
+            flows.setdefault(str(tid_), []).append(e)
+    flow_events = []
+    for trace_id, evs in sorted(flows.items()):
+        if len(evs) < 2:
+            continue
+        evs.sort(key=lambda e: e.get("ts", 0))
+        last = len(evs) - 1
+        for i, e in enumerate(evs):
+            ph = "s" if i == 0 else ("f" if i == last else "t")
+            fe = {"name": f"request {trace_id}", "cat": "request",
+                  "ph": ph, "id": trace_id, "pid": e["pid"],
+                  "tid": e.get("tid", 0), "ts": e.get("ts", 0)}
+            if ph == "f":
+                fe["bp"] = "e"
+            flow_events.append(fe)
+    return {"traceEvents": events + flow_events, "displayTimeUnit": "ms"}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,706 @@
+"""Per-request distributed tracing + SLO monitoring (ISSUE 9).
+
+A request that crosses router admission -> quota -> prefill replica ->
+KV-page handoff -> decode replica -> delivery used to leave only
+per-subsystem histograms behind; no single artifact showed ONE request's
+journey. Production disaggregated serving (PAPERS.md: "Ragged Paged
+Attention", arxiv 2604.15464; the Gemma-on-TPU serving study, arxiv
+2605.25645) lives on per-request TTFT/TPOT attribution and SLO
+percentiles — this module supplies both, plus the telemetry-fed cost
+table ROADMAP item 4's planner wants:
+
+* :class:`TraceContext` — a ``trace_id`` (+ optional parent) minted at
+  ``ServingRouter.generate()`` (or at direct engine admission for
+  fleet-less use) and threaded through ticket -> dispatch -> replica
+  ``generate`` -> engine request rows. Every lifecycle edge lands as a
+  rank/replica-stamped span in the process-global
+  :class:`RequestTraceStore`: quota decision (rejections trace too),
+  route choice with affinity score, queue wait, each prefill chunk, the
+  disaggregation ``export_pages``/``import_pages`` handoff, every decode
+  tick the request participates in, cancellation/timeout, and requeue
+  attempts (attempt generation in the span tags).
+* :func:`request_timeline` — the per-request record: queue wait, TTFT,
+  per-token latencies, cached tokens, replica hops, requeue count.
+  Recent timelines ride into watchdog debug files through a flight-
+  recorder state provider, and :func:`timeline_to_chrome` renders one
+  request as per-replica chrome lanes that
+  ``flight_recorder.merge_chrome_traces`` joins into a single flow.
+* :class:`SLOMonitor` — sliding-window p50/p95/p99 over TTFT / TPOT /
+  queue wait plus goodput counters (``paddle_slo_goodput_total{slo}`` /
+  ``paddle_slo_violations_total{slo}``; targets from
+  ``PADDLE_SLO_TTFT_MS`` / ``PADDLE_SLO_TPOT_MS``), exposed as gauges
+  and :func:`slo_report`.
+* :func:`cost_table` — planner-facing JSON: measured per-collective
+  bytes/s (CommStats + flight-recorder seq records), per-program step
+  times (every ``*_seconds`` histogram), the SLO report and the
+  simulator wire model in one table.
+
+Everything is stdlib-only. ``PADDLE_REQUEST_TRACE=0`` disables the whole
+layer (``start_request`` returns ``None`` and every other call is a
+None-check away from free); ``PADDLE_REQUEST_TRACE_CAPACITY`` bounds the
+store (oldest finished records evict first).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+__all__ = [
+    "TraceContext", "RequestTraceStore", "SLOMonitor", "TRACE_SCHEMA",
+    "get_trace_store", "is_enabled", "enable", "disable",
+    "start_request", "add_span", "add_event", "span", "note_token",
+    "finish_request", "request_timeline", "recent_timelines",
+    "timeline_to_chrome", "get_slo_monitor", "reset_slo_monitor",
+    "slo_report", "cost_table",
+]
+
+TRACE_SCHEMA = "paddle_request_trace/1"
+COST_TABLE_SCHEMA = "paddle_cost_table/1"
+
+DEFAULT_TRACE_CAPACITY = 1024
+DEFAULT_SLO_WINDOW = 1024
+#: spans kept per trace (a long decode emits one span per tick; beyond
+#: the cap spans are counted, not stored)
+MAX_SPANS_PER_TRACE = 2048
+MAX_TOKENS_PER_TRACE = 8192
+
+#: terminal request states (one per trace; first finish wins)
+TERMINAL_STATUSES = ("ok", "rejected", "timeout", "cancelled", "error")
+
+
+def _env_truthy(v) -> bool:
+    return v not in (None, "", "0", "false", "False", "no")
+
+
+_ENABLED = _env_truthy(os.environ.get("PADDLE_REQUEST_TRACE", "1"))
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def enable():
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def _rank() -> int:
+    """Issuing rank (thread-simulator aware) — same rule as the flight
+    recorder, so trace spans and collective events agree."""
+    try:
+        from .flight_recorder import _rank as fr_rank
+        return fr_rank()
+    except Exception:
+        return 0
+
+
+class TraceContext:
+    """One request's trace handle: the ``trace_id`` every span keys on,
+    plus mutable default tags (``replica``/``attempt``) the router
+    refreshes before each dispatch attempt so engine-side spans are
+    stamped with where (and which try) they ran."""
+
+    __slots__ = ("trace_id", "parent", "t0", "wall0", "source", "tags")
+
+    _ids = itertools.count(1)
+
+    def __init__(self, trace_id=None, parent=None, source="engine"):
+        self.trace_id = (trace_id if trace_id is not None
+                         else f"req-{os.getpid():x}-{next(self._ids):06x}")
+        self.parent = parent
+        self.t0 = time.perf_counter()
+        self.wall0 = time.time()
+        self.source = source
+        self.tags: dict = {}
+
+    def set_tags(self, **tags):
+        """Merge default tags stamped onto every later span (the router
+        sets ``replica=``/``attempt=`` before each dispatch attempt)."""
+        self.tags.update(tags)
+        return self
+
+    def __repr__(self):
+        return f"<TraceContext {self.trace_id} source={self.source}>"
+
+
+class RequestTraceStore:
+    """Process-global bounded store of per-request trace records.
+
+    A record is one JSON-ready dict per trace_id: identity + timing
+    fields, the ordered span list, and per-token timestamps. Records are
+    mutated under one lock (router thread, dispatch threads and the
+    engine serve loop all append concurrently) and evicted oldest-
+    finished-first when the store exceeds its capacity.
+    """
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(
+                    "PADDLE_REQUEST_TRACE_CAPACITY",
+                    str(DEFAULT_TRACE_CAPACITY)))
+            except ValueError:
+                capacity = DEFAULT_TRACE_CAPACITY
+        self.capacity = max(int(capacity), 8)
+        self._lock = threading.RLock()
+        self._records: OrderedDict = OrderedDict()   # trace_id -> record
+        self._metrics = None
+        self._provider_registered = False
+
+    # -- metrics ------------------------------------------------------------
+    def _tele(self):
+        if self._metrics is None:
+            from .telemetry import get_registry
+            r = get_registry()
+            self._metrics = {
+                "traces": r.counter(
+                    "paddle_request_traces_total",
+                    "request traces finished, by terminal status",
+                    labels=("status",)),
+                "active": r.gauge(
+                    "paddle_request_active_traces",
+                    "request traces currently open in the store"),
+                "dropped": r.counter(
+                    "paddle_request_spans_dropped_total",
+                    "spans past the per-trace cap (counted, not stored)"),
+            }
+        return self._metrics
+
+    def _register_provider(self):
+        """Recent timelines ride into every watchdog/flight dump. Only
+        the process-global store registers — an ad-hoc store (tests)
+        must not hijack the dump provider."""
+        if self._provider_registered or _STORE is not self:
+            return
+        self._provider_registered = True
+        from . import flight_recorder
+        flight_recorder.register_state_provider(
+            "request_traces", lambda: {
+                "recent": self.recent(8),
+                "open": sum(1 for r in self._records.values()
+                            if r["status"] == "open"),
+            })
+
+    # -- record lifecycle ---------------------------------------------------
+    def start(self, tenant="default", source="engine", prompt_tokens=0,
+              max_new_tokens=0, parent=None, trace_id=None) -> TraceContext:
+        ctx = TraceContext(trace_id=trace_id, parent=parent, source=source)
+        rec = {
+            "schema": TRACE_SCHEMA,
+            "trace_id": ctx.trace_id,
+            "parent": parent,
+            "source": source,
+            "tenant": str(tenant),
+            "prompt_tokens": int(prompt_tokens),
+            "max_new_tokens": int(max_new_tokens),
+            "t_start": ctx.t0,
+            "wall_start": ctx.wall0,
+            "status": "open",
+            "spans": [],
+            "tokens": [],
+            "spans_dropped": 0,
+        }
+        with self._lock:
+            self._records[ctx.trace_id] = rec
+            while len(self._records) > self.capacity:
+                victim = next(
+                    (k for k, r in self._records.items()
+                     if r["status"] != "open"),
+                    next(iter(self._records)))
+                self._records.pop(victim, None)
+            n_open = sum(1 for r in self._records.values()
+                         if r["status"] == "open")
+        self._tele()["active"].set(n_open)
+        self._register_provider()
+        return ctx
+
+    def add_span(self, ctx, name, t0=None, dur=0.0, **tags):
+        if ctx is None or not _ENABLED:
+            return None
+        now = time.perf_counter()
+        sp = {"name": str(name),
+              "t0": float(t0) if t0 is not None else now,
+              "dur": max(float(dur), 0.0),
+              "wall": time.time(),
+              "rank": _rank()}
+        merged = dict(ctx.tags)
+        merged.update(tags)
+        for key in ("replica", "attempt"):
+            if key in merged:
+                sp[key] = merged.pop(key)
+        if merged:
+            sp["tags"] = merged
+        with self._lock:
+            rec = self._records.get(ctx.trace_id)
+            if rec is None:
+                return None
+            if len(rec["spans"]) >= MAX_SPANS_PER_TRACE:
+                rec["spans_dropped"] += 1
+                self._tele()["dropped"].inc()
+                return None
+            rec["spans"].append(sp)
+        return sp
+
+    def note_token(self, ctx, t=None):
+        """Record one generated-token timestamp (feeds TTFT / per-token
+        latency without a full span per token delivery)."""
+        if ctx is None or not _ENABLED:
+            return
+        t = time.perf_counter() if t is None else float(t)
+        with self._lock:
+            rec = self._records.get(ctx.trace_id)
+            if rec is not None and len(rec["tokens"]) < MAX_TOKENS_PER_TRACE:
+                rec["tokens"].append(t)
+
+    def finish(self, ctx, status="ok", **tags):
+        """Seal the trace: compute the timeline summary, feed the SLO
+        monitor (completed requests only) and bump the status counter.
+        Idempotent — the first terminal status wins (a requeued
+        attempt's late failure can never overwrite a delivery)."""
+        if ctx is None or not _ENABLED:
+            return None
+        if status not in TERMINAL_STATUSES:
+            raise ValueError(f"unknown terminal status {status!r}")
+        self.add_span(ctx, "done", status=status, **tags)
+        with self._lock:
+            rec = self._records.get(ctx.trace_id)
+            if rec is None or rec["status"] != "open":
+                return rec
+            rec["status"] = status
+            rec["t_end"] = time.perf_counter()
+            self._summarize_locked(rec)
+            n_open = sum(1 for r in self._records.values()
+                         if r["status"] == "open")
+        tele = self._tele()
+        tele["traces"].inc(status=status)
+        tele["active"].set(n_open)
+        if status == "ok":
+            s = rec["summary"]
+            get_slo_monitor().observe(ttft_s=s.get("ttft_s"),
+                                      tpot_s=s.get("tpot_s"),
+                                      queue_wait_s=s.get("queue_wait_s"))
+        return rec
+
+    def _summarize_locked(self, rec):
+        t_start = rec["t_start"]
+        tokens = rec["tokens"]
+        spans = rec["spans"]
+        ttft = tokens[0] - t_start if tokens else None
+        gaps = [b - a for a, b in zip(tokens, tokens[1:])]
+        tpot = sum(gaps) / len(gaps) if gaps else None
+        qw = next((s["dur"] for s in spans if s["name"] == "queue_wait"),
+                  None)
+        hops, seen = [], set()
+        for s in spans:
+            r = s.get("replica")
+            if r is not None and r not in seen:
+                seen.add(r)
+                hops.append(r)
+        cached = max((int((s.get("tags") or {}).get("cached_tokens", 0))
+                      for s in spans if s["name"] == "admit"), default=0)
+        rec["summary"] = {
+            "queue_wait_s": qw,
+            "ttft_s": ttft,
+            "tpot_s": tpot,
+            "token_latencies_s": gaps[:256],
+            "tokens_generated": len(tokens),
+            "cached_tokens": cached,
+            "replica_hops": hops,
+            "requeues": sum(1 for s in spans if s["name"] == "requeue"),
+            "attempts": max((s.get("attempt", 0) for s in spans), default=0),
+            "duration_s": rec.get("t_end", t_start) - t_start,
+        }
+
+    # -- read side ----------------------------------------------------------
+    def timeline(self, trace_id) -> dict:
+        """The per-request timeline record (spans + computed summary).
+        Open traces are summarized on the fly."""
+        with self._lock:
+            rec = self._records.get(str(trace_id))
+            if rec is None:
+                raise KeyError(f"no trace {trace_id!r} in the store")
+            rec = json.loads(json.dumps(rec))   # deep, JSON-clean copy
+        if "summary" not in rec:
+            self._summarize_locked(rec)
+        return rec
+
+    def recent(self, n=16) -> list:
+        """Newest-first compact timelines (watchdog dumps / debugging):
+        summary + identity, spans trimmed to the last 32."""
+        with self._lock:
+            recs = list(self._records.values())[-int(n):]
+        out = []
+        for rec in reversed(recs):
+            rec = json.loads(json.dumps(rec))
+            if "summary" not in rec:
+                self._summarize_locked(rec)
+            rec["spans"] = rec["spans"][-32:]
+            rec.pop("tokens", None)
+            out.append(rec)
+        return out
+
+    def trace_ids(self) -> list:
+        with self._lock:
+            return list(self._records)
+
+    def clear(self):
+        with self._lock:
+            self._records.clear()
+
+
+_STORE: "RequestTraceStore | None" = None
+_STORE_LOCK = threading.Lock()
+
+
+def get_trace_store() -> RequestTraceStore:
+    global _STORE
+    if _STORE is None:
+        with _STORE_LOCK:
+            if _STORE is None:
+                _STORE = RequestTraceStore()
+    return _STORE
+
+
+# ---------------------------------------------------------------------------
+# module facade (every call is a None/bool check when tracing is off)
+# ---------------------------------------------------------------------------
+
+
+def start_request(tenant="default", source="engine", prompt_tokens=0,
+                  max_new_tokens=0, parent=None, trace_id=None):
+    """Mint a :class:`TraceContext` (or None when tracing is disabled)."""
+    if not _ENABLED:
+        return None
+    return get_trace_store().start(
+        tenant=tenant, source=source, prompt_tokens=prompt_tokens,
+        max_new_tokens=max_new_tokens, parent=parent, trace_id=trace_id)
+
+
+def add_span(ctx, name, t0=None, dur=0.0, **tags):
+    """Record one completed span on ``ctx`` (no-op for ``ctx=None``)."""
+    if ctx is None or not _ENABLED:
+        return None
+    return get_trace_store().add_span(ctx, name, t0=t0, dur=dur, **tags)
+
+
+def add_event(ctx, name, **tags):
+    """Zero-duration span (a lifecycle edge: route, requeue, reject)."""
+    return add_span(ctx, name, **tags)
+
+
+class span:
+    """Context-manager span: ``with span(ctx, "handoff_export"): ...``.
+    Records on normal AND exceptional exit (an aborted handoff still
+    shows how long it ran)."""
+
+    def __init__(self, ctx, name, **tags):
+        self.ctx = ctx
+        self.name = name
+        self.tags = tags
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.tags.setdefault("error", exc_type.__name__)
+        add_span(self.ctx, self.name, t0=self._t0,
+                 dur=time.perf_counter() - self._t0, **self.tags)
+        return False
+
+
+def note_token(ctx, t=None):
+    if ctx is None or not _ENABLED:
+        return
+    get_trace_store().note_token(ctx, t)
+
+
+def finish_request(ctx, status="ok", **tags):
+    if ctx is None or not _ENABLED:
+        return None
+    return get_trace_store().finish(ctx, status=status, **tags)
+
+
+def request_timeline(trace_id) -> dict:
+    """``paddle.profiler.request_timeline(trace_id)`` — the per-request
+    timeline record (spans, per-token latencies, summary)."""
+    return get_trace_store().timeline(trace_id)
+
+
+def recent_timelines(n=16) -> list:
+    return get_trace_store().recent(n)
+
+
+# ---------------------------------------------------------------------------
+# chrome rendering: one request as per-replica lanes
+# ---------------------------------------------------------------------------
+
+
+def timeline_to_chrome(timeline_or_id) -> dict:
+    """Render one request's timeline as ``{lane: chrome trace}`` — one
+    lane per replica (spans with no replica stamp land on the minting
+    source's lane). Feed the result to
+    ``flight_recorder.merge_chrome_traces`` to get a single trace where
+    the request renders as one flow across lanes (every event carries
+    ``args.trace_id``; the merger links same-trace events with chrome
+    flow events)."""
+    rec = (timeline_or_id if isinstance(timeline_or_id, dict)
+           else request_timeline(timeline_or_id))
+    lanes: dict = {}
+    t_origin = rec.get("t_start", 0.0)
+    for sp in rec.get("spans", []):
+        lane = str(sp.get("replica", rec.get("source", "engine")))
+        args = {"trace_id": rec["trace_id"], "rank": sp.get("rank")}
+        if sp.get("attempt") is not None:
+            args["attempt"] = sp["attempt"]
+        args.update(sp.get("tags") or {})
+        lanes.setdefault(lane, []).append({
+            "name": sp["name"], "ph": "X", "tid": 0,
+            "ts": round((sp["t0"] - t_origin) * 1e6, 3),
+            "dur": max(round(sp["dur"] * 1e6, 3), 0.001),
+            "args": args,
+        })
+    return {lane: {"traceEvents": evs} for lane, evs in lanes.items()}
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor
+# ---------------------------------------------------------------------------
+
+
+def _exact_percentile(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round((p / 100.0) * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class SLOMonitor:
+    """Sliding-window SLO accounting over completed requests.
+
+    Keeps the last ``window`` raw observations of TTFT, TPOT and queue
+    wait (count-based window, ``PADDLE_SLO_WINDOW``) and computes EXACT
+    p50/p95/p99 over the window — percentiles match the raw per-request
+    timelines by construction, no histogram-bucket quantization.
+    Targets come from ``PADDLE_SLO_TTFT_MS`` / ``PADDLE_SLO_TPOT_MS``
+    (0 = no target, everything is goodput); each observed request bumps
+    ``paddle_slo_goodput_total{slo}`` or
+    ``paddle_slo_violations_total{slo}`` per targeted SLO plus the
+    ``slo="request"`` rollup (a request is goodput only when EVERY
+    targeted SLO held). Current window percentiles ride as
+    ``paddle_slo_latency_seconds{metric,quantile}`` gauges.
+    """
+
+    METRICS = ("ttft", "tpot", "queue_wait")
+    QUANTILES = (50, 95, 99)
+
+    def __init__(self, window=None, ttft_ms=None, tpot_ms=None):
+        if window is None:
+            try:
+                window = int(os.environ.get("PADDLE_SLO_WINDOW",
+                                            str(DEFAULT_SLO_WINDOW)))
+            except ValueError:
+                window = DEFAULT_SLO_WINDOW
+        if ttft_ms is None:
+            ttft_ms = float(os.environ.get("PADDLE_SLO_TTFT_MS", "0"))
+        if tpot_ms is None:
+            tpot_ms = float(os.environ.get("PADDLE_SLO_TPOT_MS", "0"))
+        self.window = max(int(window), 1)
+        self.targets_s = {"ttft": ttft_ms / 1e3, "tpot": tpot_ms / 1e3}
+        self._lock = threading.Lock()
+        self._win = {m: deque(maxlen=self.window) for m in self.METRICS}
+        self._goodput = {"ttft": 0, "tpot": 0, "request": 0}
+        self._violations = {"ttft": 0, "tpot": 0, "request": 0}
+        self._tele_fams = None
+
+    def _tele(self):
+        if self._tele_fams is None:
+            from .telemetry import get_registry
+            r = get_registry()
+            self._tele_fams = {
+                "latency": r.gauge(
+                    "paddle_slo_latency_seconds",
+                    "sliding-window latency percentile (exact over the "
+                    "last PADDLE_SLO_WINDOW requests)",
+                    labels=("metric", "quantile")),
+                "goodput": r.counter(
+                    "paddle_slo_goodput_total",
+                    "requests inside their SLO target (slo=request "
+                    "rolls up every targeted SLO)", labels=("slo",)),
+                "violations": r.counter(
+                    "paddle_slo_violations_total",
+                    "requests over their SLO target", labels=("slo",)),
+            }
+        return self._tele_fams
+
+    def observe(self, ttft_s=None, tpot_s=None, queue_wait_s=None):
+        """One completed request's latencies (None = not applicable,
+        e.g. a single-token request has no TPOT)."""
+        tele = self._tele()
+        vals = {"ttft": ttft_s, "tpot": tpot_s, "queue_wait": queue_wait_s}
+        with self._lock:
+            for m, v in vals.items():
+                if v is not None:
+                    self._win[m].append(float(v))
+            ok_all = True
+            for slo in ("ttft", "tpot"):
+                v, target = vals[slo], self.targets_s[slo]
+                if v is None:
+                    continue
+                good = target <= 0 or v <= target
+                key = "_goodput" if good else "_violations"
+                getattr(self, key)[slo] += 1
+                if not good:
+                    ok_all = False
+                tele["goodput" if good else "violations"].inc(slo=slo)
+            key = "_goodput" if ok_all else "_violations"
+            getattr(self, key)["request"] += 1
+            tele["goodput" if ok_all else "violations"].inc(slo="request")
+            pct = {m: sorted(self._win[m]) for m in self.METRICS}
+        for m, sv in pct.items():
+            for q in self.QUANTILES:
+                tele["latency"].set(_exact_percentile(sv, q),
+                                    metric=m, quantile=f"p{q}")
+
+    def percentile(self, metric, p):
+        with self._lock:
+            return _exact_percentile(sorted(self._win[metric]), p)
+
+    def report(self) -> dict:
+        with self._lock:
+            win = {m: sorted(self._win[m]) for m in self.METRICS}
+            goodput = dict(self._goodput)
+            violations = dict(self._violations)
+        out = {
+            "window": self.window,
+            "targets_ms": {m: self.targets_s[m] * 1e3
+                           for m in ("ttft", "tpot")},
+            "goodput": goodput,
+            "violations": violations,
+        }
+        total = goodput["request"] + violations["request"]
+        out["goodput_ratio"] = goodput["request"] / total if total else 1.0
+        for m, sv in win.items():
+            out[m] = {
+                "count": len(sv),
+                **{f"p{q}_s": _exact_percentile(sv, q)
+                   for q in self.QUANTILES},
+                "max_s": sv[-1] if sv else 0.0,
+            }
+        return out
+
+    def reset(self):
+        with self._lock:
+            for d in self._win.values():
+                d.clear()
+            for d in (self._goodput, self._violations):
+                for k in d:
+                    d[k] = 0
+
+
+_SLO: "SLOMonitor | None" = None
+_SLO_LOCK = threading.Lock()
+
+
+def get_slo_monitor() -> SLOMonitor:
+    global _SLO
+    if _SLO is None:
+        with _SLO_LOCK:
+            if _SLO is None:
+                _SLO = SLOMonitor()
+    return _SLO
+
+
+def reset_slo_monitor() -> SLOMonitor:
+    """Rebuild the global monitor from the current env (fresh window AND
+    fresh targets — tests and bench runs)."""
+    global _SLO
+    with _SLO_LOCK:
+        _SLO = SLOMonitor()
+    return _SLO
+
+
+def slo_report() -> dict:
+    """``paddle.profiler.slo_report()`` — the sliding-window SLO view."""
+    return get_slo_monitor().report()
+
+
+# ---------------------------------------------------------------------------
+# planner-facing cost table (ROADMAP 4's input)
+# ---------------------------------------------------------------------------
+
+
+def cost_table(path=None) -> dict:
+    """Fold measured telemetry into one JSON cost table: per-collective
+    wire throughput (CommStats totals + flight-recorder seq records with
+    entry/exit timestamps), per-program step times (every ``*_seconds``
+    histogram family with observations), the current SLO report and the
+    simulator wire model. This is the measured side ROADMAP item 4's
+    parallelism planner searches against. ``path=`` also writes it."""
+    from .telemetry import get_registry
+
+    table: dict = {"schema": COST_TABLE_SCHEMA, "unix_time": time.time()}
+    try:
+        from ..distributed.comm import get_comm_stats
+        table["comm"] = get_comm_stats().as_dict()
+    except Exception:
+        table["comm"] = {}
+    # per-collective measured throughput from the flight recorder's seq
+    # records (entry/exit wall clock per collective)
+    collectives: dict = {}
+    try:
+        from .flight_recorder import get_flight_recorder
+        for ev in get_flight_recorder().events(kind="collective"):
+            if ev.get("t_exit") is None:
+                continue
+            op = str(ev.get("op"))
+            dur = max(float(ev["t_exit"]) - float(ev["t_enter"]), 0.0)
+            d = collectives.setdefault(
+                op, {"calls": 0, "bytes": 0, "seconds": 0.0})
+            d["calls"] += 1
+            d["bytes"] += int(ev.get("bytes", 0))
+            d["seconds"] += dur
+    except Exception:
+        pass
+    for op, d in collectives.items():
+        d["mean_s"] = d["seconds"] / max(d["calls"], 1)
+        d["bytes_per_s"] = d["bytes"] / d["seconds"] if d["seconds"] else 0.0
+    table["collectives"] = collectives
+    # per-program step times: every latency histogram that observed
+    programs: dict = {}
+    for name, fam in get_registry().collect().items():
+        if fam.get("type") != "histogram" or not name.endswith("_seconds"):
+            continue
+        for key, s in fam.get("series", {}).items():
+            if not s.get("count"):
+                continue
+            label = f"{name}{{{key}}}" if key else name
+            programs[label] = {
+                "count": s["count"],
+                "mean_s": s["sum"] / s["count"],
+                "p50_s": s["p50"], "p95_s": s["p95"],
+            }
+    table["programs"] = programs
+    table["slo"] = slo_report()
+    table["wire_model"] = {
+        "sim_lat_us": float(os.environ.get("PADDLE_SIM_WIRE_LAT_US", "0")),
+        "sim_gbps": float(os.environ.get("PADDLE_SIM_WIRE_GBPS", "0")),
+    }
+    if path:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(table, f)
+    return table

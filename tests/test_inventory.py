@@ -35,6 +35,15 @@ def test_fleet_knobs_covered():
     assert not violations, violations
 
 
+def test_observability_catalog():
+    """Every paddle_request_*/paddle_slo_* metric and PADDLE_SLO_*/
+    PADDLE_REQUEST_TRACE* knob referenced in paddle_tpu/ is cataloged in
+    docs/OBSERVABILITY.md."""
+    from check_inventory import check_observability_catalog
+    violations = check_observability_catalog(verbose=False)
+    assert not violations, violations
+
+
 def test_serving_program_budget():
     """Compiled-program guard: a mixed prefill+decode load stays inside
     the ragged scheduler's declared token-bucket family (no per-request

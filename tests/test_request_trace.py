@@ -1,0 +1,539 @@
+"""Per-request distributed tracing + SLO monitor (ISSUE 9): trace-store
+unit tier, SLO percentile/goodput accounting, engine + fleet span
+wiring, disagg trace continuity, kill-mid-decode requeue attempts under
+one trace_id, chrome flow rendering, the trace_merge --request CLI, and
+bit-parity with tracing disabled."""
+import importlib.util
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic.tcp_kv import MemKVStore
+from paddle_tpu.inference import Rejected, ServingRouter
+from paddle_tpu.inference import ContinuousServingEngine
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.profiler import flight_recorder
+from paddle_tpu.profiler import request_trace as rt
+from paddle_tpu.profiler.request_trace import _exact_percentile
+
+ENGINE_KW = dict(max_batch_size=4, max_len=160, page_size=16,
+                 prefill_chunk_tokens=32)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(num_hidden_layers=1,
+                                       max_position_embeddings=256))
+
+
+@pytest.fixture(autouse=True)
+def fresh_trace_state():
+    rt.enable()
+    rt.get_trace_store().clear()
+    rt.reset_slo_monitor()
+    yield
+    rt.enable()
+
+
+def _oracle(model, p, n):
+    return np.asarray(model.generate(paddle.to_tensor(p),
+                                     max_new_tokens=n)._data)
+
+
+def _mixed_workload(n_req=8, sys_len=48, tail=8, seed=0):
+    rng = np.random.RandomState(seed)
+    sys_prompt = rng.randint(0, 128, sys_len)
+    prompts = [np.concatenate([sys_prompt, rng.randint(0, 128, tail)])
+               .astype(np.int64)[None] for _ in range(n_req)]
+    return prompts
+
+
+def _records_by_tenant():
+    store = rt.get_trace_store()
+    out = {}
+    for tid in store.trace_ids():
+        rec = store.timeline(tid)
+        out.setdefault(rec["tenant"], []).append(rec)
+    return out
+
+
+def _first_t0(rec, *names):
+    for s in rec["spans"]:
+        if s["name"] in names:
+            return s["t0"]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# unit tier: store, SLO monitor, cost table
+# ---------------------------------------------------------------------------
+
+def test_store_lifecycle_and_timeline():
+    ctx = rt.start_request(tenant="acme", source="router",
+                           prompt_tokens=40, max_new_tokens=4)
+    assert ctx is not None and ctx.trace_id
+    rt.add_span(ctx, "queue_wait", t0=ctx.t0, dur=0.25)
+    ctx.set_tags(replica="r0", attempt=1)
+    rt.add_event(ctx, "admit", cached_tokens=32)
+    base = time.perf_counter()
+    for i in range(4):
+        rt.note_token(ctx, base + 0.1 * (i + 1))
+    ctx.set_tags(replica="r1", attempt=2)
+    rt.add_event(ctx, "requeue", reason="replica_dead")
+    rec = rt.finish_request(ctx, status="ok")
+    assert rec["status"] == "ok"
+    s = rec["summary"]
+    assert s["queue_wait_s"] == pytest.approx(0.25)
+    assert s["tokens_generated"] == 4
+    assert s["tpot_s"] == pytest.approx(0.1, abs=1e-6)
+    assert s["cached_tokens"] == 32
+    assert s["replica_hops"] == ["r0", "r1"]
+    assert s["requeues"] == 1 and s["attempts"] == 2
+    # the facade returns the same record; double-finish never overwrites
+    tl = rt.request_timeline(ctx.trace_id)
+    assert tl["status"] == "ok"
+    rt.finish_request(ctx, status="error")
+    assert rt.request_timeline(ctx.trace_id)["status"] == "ok"
+    # spans are rank-stamped and ordered
+    assert all("rank" in sp for sp in tl["spans"])
+    names = [sp["name"] for sp in tl["spans"]]
+    assert names[0] == "queue_wait" and names[-1] == "done"
+
+
+def test_store_eviction_prefers_finished():
+    store = rt.RequestTraceStore(capacity=8)
+    open_ctx = store.start(tenant="keep")
+    done_ids = []
+    for i in range(10):
+        c = store.start(tenant=f"t{i}")
+        store.finish(c, status="ok")
+        done_ids.append(c.trace_id)
+    ids = store.trace_ids()
+    assert len(ids) <= 8
+    assert open_ctx.trace_id in ids     # open records evict last
+
+
+def test_disabled_layer_is_inert():
+    rt.disable()
+    assert rt.start_request(tenant="x") is None
+    assert rt.add_span(None, "y") is None
+    rt.note_token(None)
+    assert rt.finish_request(None) is None
+    assert rt.get_trace_store().trace_ids() == []
+
+
+def test_slo_monitor_exact_percentiles_and_goodput():
+    mon = rt.SLOMonitor(window=100, ttft_ms=50.0, tpot_ms=10.0)
+    ttfts = [0.01 * (i + 1) for i in range(10)]       # 10ms .. 100ms
+    for v in ttfts:
+        mon.observe(ttft_s=v, tpot_s=0.005, queue_wait_s=0.001)
+    rep = mon.report()
+    sv = sorted(ttfts)
+    for q in (50, 95, 99):
+        assert rep["ttft"][f"p{q}_s"] == _exact_percentile(sv, q)
+    # 5 of 10 TTFTs exceed the 50ms target; every TPOT is inside 10ms
+    assert rep["violations"]["ttft"] == 5
+    assert rep["goodput"]["ttft"] == 5
+    assert rep["goodput"]["tpot"] == 10
+    assert rep["violations"]["request"] == 5
+    assert rep["goodput_ratio"] == pytest.approx(0.5)
+    mon.reset()
+    assert mon.report()["ttft"]["count"] == 0
+
+
+def test_slo_env_targets_and_gauges(monkeypatch):
+    monkeypatch.setenv("PADDLE_SLO_TTFT_MS", "20")
+    monkeypatch.setenv("PADDLE_SLO_WINDOW", "4")
+    mon = rt.reset_slo_monitor()
+    assert mon.targets_s["ttft"] == pytest.approx(0.02)
+    assert mon.window == 4
+    for v in (0.01, 0.03):
+        mon.observe(ttft_s=v)
+    from paddle_tpu.profiler.telemetry import get_registry
+    g = get_registry().get("paddle_slo_latency_seconds")
+    assert g.value(metric="ttft", quantile="p95") == pytest.approx(0.03)
+    snap = get_registry().collect()
+    assert "paddle_slo_goodput_total" in snap
+    assert "paddle_slo_violations_total" in snap
+
+
+def test_cost_table_folds_collectives_programs_slo():
+    flight_recorder.enable()
+    try:
+        ev = flight_recorder.collective_begin("all_reduce", 1 << 20,
+                                              [0, 1])
+        time.sleep(0.01)
+        flight_recorder.collective_end(ev)
+        from paddle_tpu.profiler.telemetry import get_registry
+        h = get_registry().histogram("paddle_test_cost_seconds",
+                                     "cost-table probe")
+        h.observe(0.125)
+        rt.get_slo_monitor().observe(ttft_s=0.2, tpot_s=0.01)
+        table = rt.cost_table()
+    finally:
+        flight_recorder.disable()
+    assert table["schema"] == "paddle_cost_table/1"
+    ar = table["collectives"]["all_reduce"]
+    assert ar["calls"] >= 1 and ar["bytes"] >= 1 << 20
+    assert ar["bytes_per_s"] > 0
+    probe = table["programs"]["paddle_test_cost_seconds"]
+    assert probe["count"] >= 1 and probe["mean_s"] > 0
+    assert table["slo"]["ttft"]["count"] >= 1
+    assert "sim_gbps" in table["wire_model"]
+    assert "comm" in table
+
+
+# ---------------------------------------------------------------------------
+# engine tier: spans through the continuous scheduler
+# ---------------------------------------------------------------------------
+
+def test_engine_trace_spans_and_timeline(model):
+    p = _mixed_workload(n_req=1)[0]
+    eng = ContinuousServingEngine(model, **ENGINE_KW)
+    with eng:
+        eng.generate(p, max_new_tokens=4, timeout=600)
+    ids = rt.get_trace_store().trace_ids()
+    assert len(ids) == 1
+    tl = rt.request_timeline(ids[0])
+    assert tl["status"] == "ok" and tl["source"] == "continuous"
+    names = [s["name"] for s in tl["spans"]]
+    for need in ("queue_wait", "admit", "prefill_chunk", "decode", "done"):
+        assert need in names, names
+    # lifecycle edges in monotonic order
+    t_q = _first_t0(tl, "queue_wait")
+    t_p = _first_t0(tl, "prefill_chunk")
+    t_d = _first_t0(tl, "decode")
+    t_done = _first_t0(tl, "done")
+    assert t_q <= t_p <= t_d <= t_done
+    assert tl["summary"]["tokens_generated"] == 4
+    assert tl["summary"]["ttft_s"] > 0
+    # the completed request fed the SLO window
+    assert rt.slo_report()["ttft"]["count"] == 1
+
+
+def test_engine_state_names_oldest_request(model):
+    from paddle_tpu.inference.serving import _engine_state
+    eng = ContinuousServingEngine(model, **ENGINE_KW)
+    p = _mixed_workload(n_req=1)[0]
+    hold = threading.Event()
+    with eng:
+        blocker = threading.Thread(
+            target=lambda: eng.run_on_loop(lambda e: hold.wait(15),
+                                           timeout=30), daemon=True)
+        blocker.start()
+        time.sleep(0.05)        # the control is on the loop: ticks frozen
+        t = threading.Thread(
+            target=lambda: eng.generate(p, max_new_tokens=2, timeout=600))
+        t.start()
+        deadline = time.monotonic() + 5
+        state = {}
+        while time.monotonic() < deadline:
+            state = _engine_state(eng)
+            if state.get("oldest_request_age_s", 0) > 0:
+                break
+            time.sleep(0.01)
+        assert state.get("oldest_request_age_s", 0) > 0, state
+        assert state["oldest_request_trace"], state
+        assert state["request_ages"][0]["state"] == "queued"
+        hold.set()
+        t.join()
+    # after completion the engine reports no stuck request
+    assert _engine_state(eng)["oldest_request_age_s"] == 0.0
+
+
+def test_trace_disabled_bit_parity(model):
+    p = _mixed_workload(n_req=1, seed=3)[0]
+    want = _oracle(model, p, 3)
+    eng = ContinuousServingEngine(model, **ENGINE_KW)
+    with eng:
+        traced = np.asarray(eng.generate(p, max_new_tokens=3,
+                                         timeout=600).numpy())
+    rt.disable()
+    n_before = len(rt.get_trace_store().trace_ids())
+    eng2 = ContinuousServingEngine(model, **ENGINE_KW)
+    with eng2:
+        untraced = np.asarray(eng2.generate(p, max_new_tokens=3,
+                                            timeout=600).numpy())
+    rt.enable()
+    np.testing.assert_array_equal(traced, want)
+    np.testing.assert_array_equal(untraced, want)     # bit-identical
+    assert len(rt.get_trace_store().trace_ids()) == n_before
+
+
+def test_watchdog_dump_carries_request_timelines(model, tmp_path):
+    p = _mixed_workload(n_req=1, seed=5)[0]
+    eng = ContinuousServingEngine(model, **ENGINE_KW)
+    with eng:
+        eng.generate(p, max_new_tokens=2, timeout=600)
+    out = flight_recorder.get_flight_recorder().dump(
+        reason="test", directory=str(tmp_path))
+    path = next(iter(out["ranks"].values()))
+    with open(path) as f:
+        dump = json.load(f)
+    traces = dump["state"]["request_traces"]
+    assert traces["recent"], traces
+    assert traces["recent"][0]["trace_id"].startswith("req-")
+    assert "summary" in traces["recent"][0]
+
+
+# ---------------------------------------------------------------------------
+# fleet acceptance: 2-replica disagg, >=8 mixed-tenant requests, one
+# rejected + one requeued after a hard kill — one trace each, ordered
+# spans, chrome flow, SLO p95 == raw timelines, parity
+# ---------------------------------------------------------------------------
+
+def test_fleet_acceptance_disagg_request_tracing(model):
+    n_req = 8
+    prompts = _mixed_workload(n_req=n_req)
+    want = [_oracle(model, p, 3) for p in prompts]
+    router = ServingRouter(
+        model, num_replicas=2, disagg=True, engine_kwargs=ENGINE_KW,
+        store=MemKVStore(), heartbeat_ttl=60.0,
+        tenant_quotas={"blocked": (8, 0.0)})   # below any request cost
+    results = [None] * n_req
+    errors = [None] * n_req
+
+    def call(i):
+        try:
+            results[i] = np.asarray(router.generate(
+                prompts[i], max_new_tokens=3, tenant=f"t{i}",
+                timeout=600).numpy())
+        except Exception as e:          # noqa: BLE001 — asserted below
+            errors[i] = e
+
+    with router:
+        # (1) a rejected request must trace too
+        with pytest.raises(Rejected):
+            router.generate(prompts[0], max_new_tokens=3,
+                            tenant="blocked", timeout=600)
+        # (2) warm request: full prefill->handoff->decode flow, no chaos
+        call(0)
+        # (3) concurrent batch with the prefill replica hard-killed while
+        # one request is provably in flight on it (loop frozen by a
+        # control, so the kill cannot race past the attempt)
+        pre = router._replica("r0")
+        assert pre.role == "prefill"
+        hold = threading.Event()
+        blocker = threading.Thread(
+            target=lambda: pre.engine.run_on_loop(
+                lambda e: hold.wait(20), timeout=60), daemon=True)
+        blocker.start()
+        time.sleep(0.05)
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(1, n_req)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while not pre.inflight and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert pre.inflight, "no in-flight work on the prefill replica"
+        router.kill_replica("r0")
+        hold.set()
+        for t in threads:
+            t.join()
+        stats = router.stats()
+    assert not [e for e in errors if e], errors
+    for g, w in zip(results, want):                      # bit-parity
+        np.testing.assert_array_equal(g, w)
+    assert stats["requeues_total"] >= 1, stats
+
+    recs = _records_by_tenant()
+    # every request (served, rejected, requeued) has exactly ONE trace
+    assert len(recs["blocked"]) == 1
+    rejected = recs["blocked"][0]
+    assert rejected["status"] == "rejected"
+    names = [s["name"] for s in rejected["spans"]]
+    assert "admission" in names and "rejected" in names
+    tags = next(s for s in rejected["spans"]
+                if s["name"] == "rejected")["tags"]
+    assert tags["reason"] == "tenant_quota"
+
+    requeued = 0
+    for i in range(n_req):
+        assert len(recs[f"t{i}"]) == 1, f"t{i} traced more than once"
+        rec = recs[f"t{i}"][0]
+        assert rec["status"] == "ok"
+        names = [s["name"] for s in rec["spans"]]
+        for need in ("admission", "route", "prefill_chunk", "decode",
+                     "done"):
+            assert need in names, (i, names)
+        assert any(n.startswith("handoff") for n in names), (i, names)
+        # monotonic lifecycle edges
+        t_adm = _first_t0(rec, "admission")
+        t_route = _first_t0(rec, "route")
+        t_pre = _first_t0(rec, "prefill_chunk")
+        t_dec = _first_t0(rec, "decode")
+        t_done = _first_t0(rec, "done")
+        t_hand = _first_t0(rec, "handoff_export", "handoff_import",
+                           "handoff", "handoff_skipped")
+        assert t_adm <= t_route <= t_pre <= t_dec <= t_done, i
+        assert t_adm <= t_hand <= t_done, i
+        if any(s["name"] == "requeue" for s in rec["spans"]):
+            requeued += 1
+            attempts = {s.get("attempt") for s in rec["spans"]}
+            assert {1, 2} <= attempts, attempts
+    assert requeued >= 1
+
+    # the warm request's flow: prefill on r0, handoff, decode on r1,
+    # rendered by merge_chrome_traces as ONE flow keyed by trace_id
+    warm = recs["t0"][0]
+    assert warm["summary"]["replica_hops"] == ["r0", "r1"]
+    t_hand = _first_t0(warm, "handoff_export")
+    assert (_first_t0(warm, "prefill_chunk") <= t_hand
+            <= _first_t0(warm, "decode"))
+    lanes = rt.timeline_to_chrome(warm)
+    assert {"router", "r0", "r1"} <= set(lanes)
+    merged = flight_recorder.merge_chrome_traces(lanes)
+    flow = [e for e in merged["traceEvents"]
+            if e.get("cat") == "request" and e["id"] == warm["trace_id"]]
+    assert [e["ph"] for e in flow].count("s") == 1
+    assert [e["ph"] for e in flow].count("f") == 1
+    assert len({e["pid"] for e in flow}) >= 2      # spans >1 lane
+
+    # SLO p95 TTFT == raw per-request timelines (exact, same formula)
+    raw = sorted(r[0]["summary"]["ttft_s"]
+                 for r in recs.values() if r[0]["status"] == "ok")
+    rep = rt.slo_report()
+    assert rep["ttft"]["count"] == len(raw)
+    assert rep["ttft"]["p95_s"] == pytest.approx(
+        _exact_percentile(raw, 95), rel=1e-9)
+    # timed-out/rejected requests count in the rejected metric
+    from paddle_tpu.profiler.telemetry import get_registry
+    c = get_registry().get("paddle_fleet_rejected_total")
+    assert c.value(tenant="blocked", reason="tenant_quota") >= 1
+
+
+def test_fleet_kill_mid_decode_attempt_spans(model):
+    """Colocated 2-replica fleet, replica hard-killed mid-decode: the
+    request requeues to the survivor and its trace shows attempt-1 AND
+    attempt-2 spans under the same trace_id, output still bit-identical."""
+    prompts = _mixed_workload(n_req=4, sys_len=32, seed=2)
+    want = [_oracle(model, p, 12) for p in prompts]
+    router = ServingRouter(model, num_replicas=2, policy="balance",
+                           engine_kwargs=ENGINE_KW, store=MemKVStore(),
+                           heartbeat_ttl=60.0)
+    results = [None] * 4
+    errors = [None] * 4
+
+    def call(i):
+        try:
+            results[i] = np.asarray(router.generate(
+                prompts[i], max_new_tokens=12, tenant=f"t{i}",
+                timeout=600).numpy())
+        except Exception as e:          # noqa: BLE001 — asserted below
+            errors[i] = e
+
+    with router:
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        victim = None
+        while time.monotonic() < deadline:
+            busy = [r for r in router.replicas if r.inflight]
+            if busy:
+                victim = max(busy, key=lambda r: len(r.inflight))
+                break
+            time.sleep(0.01)
+        assert victim is not None, "no in-flight work to kill under"
+        router.kill_replica(victim.id)
+        for t in threads:
+            t.join()
+        stats = router.stats()
+    assert not [e for e in errors if e], errors
+    for g, w in zip(results, want):
+        np.testing.assert_array_equal(g, w)
+    assert stats["requeues_total"] >= 1, stats
+    requeued = [rec for recs in _records_by_tenant().values()
+                for rec in recs
+                if any(s["name"] == "requeue" for s in rec["spans"])]
+    assert requeued, "no trace recorded the requeue"
+    for rec in requeued:
+        assert rec["status"] == "ok"
+        attempts = {s.get("attempt") for s in rec["spans"]} - {None}
+        assert {1, 2} <= attempts, attempts
+        assert rec["summary"]["requeues"] >= 1
+        assert len(rec["summary"]["replica_hops"]) >= 1
+        # both attempts share the one trace_id by construction: every
+        # span above came from the same record
+        assert rec["summary"]["attempts"] >= 2
+
+
+def test_fleet_timeout_traces_and_counts(model):
+    """A timed-out fleet request ends its trace (status=timeout) and
+    lands in paddle_fleet_rejected_total{reason="timeout"}."""
+    p = _mixed_workload(n_req=1, seed=9)[0]
+    router = ServingRouter(model, num_replicas=2, engine_kwargs=ENGINE_KW,
+                           store=MemKVStore(), heartbeat_ttl=60.0)
+    with router:
+        hold = threading.Event()
+        for r in router.replicas:       # freeze every engine loop
+            threading.Thread(
+                target=lambda r=r: r.engine.run_on_loop(
+                    lambda e: hold.wait(10), timeout=30),
+                daemon=True).start()
+        time.sleep(0.05)
+        with pytest.raises(TimeoutError):
+            router.generate(p, max_new_tokens=2, tenant="slow",
+                            timeout=0.3)
+        hold.set()
+    recs = _records_by_tenant()["slow"]
+    assert len(recs) == 1
+    assert recs[0]["status"] == "timeout"
+    assert any(s["name"] == "timeout" for s in recs[0]["spans"])
+    from paddle_tpu.profiler.telemetry import get_registry
+    c = get_registry().get("paddle_fleet_rejected_total")
+    assert c.value(tenant="slow", reason="timeout") >= 1
+
+
+# ---------------------------------------------------------------------------
+# CLI: trace_merge --request
+# ---------------------------------------------------------------------------
+
+def _load_trace_merge():
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "trace_merge.py")
+    spec = importlib.util.spec_from_file_location("_trace_merge_test",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_merge_request_filter(model, tmp_path):
+    p = _mixed_workload(n_req=2, seed=7)
+    eng = ContinuousServingEngine(model, **ENGINE_KW)
+    with eng:
+        eng.generate(p[0], max_new_tokens=2, timeout=600)
+        eng.generate(p[1], max_new_tokens=2, timeout=600)
+    ids = rt.get_trace_store().trace_ids()
+    assert len(ids) == 2
+    for i, tid in enumerate(ids):
+        with open(tmp_path / f"timeline{i}.json", "w") as f:
+            json.dump(rt.request_timeline(tid), f)
+    tm = _load_trace_merge()
+    out = tmp_path / "one.json"
+    rc = tm.main(["--trace", str(out), "--request", ids[0],
+                  str(tmp_path / "timeline0.json"),
+                  str(tmp_path / "timeline1.json")])
+    assert rc == 0
+    with open(out) as f:
+        merged = json.load(f)
+    got_ids = {(e.get("args") or {}).get("trace_id")
+               for e in merged["traceEvents"]}
+    assert got_ids <= {None, ids[0]}, got_ids
+    assert any((e.get("args") or {}).get("trace_id") == ids[0]
+               for e in merged["traceEvents"])
+    # an unknown trace id is a clean non-zero exit
+    rc = tm.main(["--trace", str(tmp_path / "none.json"),
+                  "--request", "req-nope",
+                  str(tmp_path / "timeline0.json")])
+    assert rc == 2

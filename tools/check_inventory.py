@@ -298,6 +298,19 @@ INVENTORY = [
     ("Fleet heartbeat publish path (flight recorder)",
      "paddle_tpu.profiler.flight_recorder",
      ["publish_component_state", "gather_component_states"]),
+    # -- per-request tracing + SLO monitor (ISSUE 9) -------------------------
+    ("Per-request trace store + SLO monitor",
+     "paddle_tpu.profiler.request_trace",
+     ["TraceContext", "RequestTraceStore", "SLOMonitor", "start_request",
+      "add_span", "add_event", "note_token", "finish_request",
+      "request_timeline", "recent_timelines", "timeline_to_chrome",
+      "get_slo_monitor", "reset_slo_monitor", "slo_report", "cost_table"]),
+    ("Request-trace facade via profiler", "paddle_tpu.profiler",
+     ["request_timeline", "slo_report", "cost_table", "get_slo_monitor",
+      "timeline_to_chrome", "get_trace_store"]),
+    ("Request-flow chrome merge (flow events)",
+     "paddle_tpu.profiler.flight_recorder",
+     ["merge_chrome_traces"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -469,6 +482,47 @@ def check_fleet_knobs(verbose=True):
     return violations
 
 
+def check_observability_catalog(verbose=True):
+    """Request-trace/SLO inventory guard: every ``paddle_request_*`` /
+    ``paddle_slo_*`` metric name and every ``PADDLE_SLO_*`` /
+    ``PADDLE_REQUEST_TRACE*`` env knob referenced in ``paddle_tpu/``
+    must be cataloged in docs/OBSERVABILITY.md — the request-tracing
+    layer exists so operators can SEE; an uncataloged signal defeats it.
+    Returns a list of violation strings."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    metric_pat = re.compile(r"paddle_(?:request|slo)_[a-z0-9_]*[a-z0-9]")
+    knob_pat = re.compile(
+        r"PADDLE_(?:SLO|REQUEST_TRACE)[A-Z0-9_]*")
+    metrics, knobs = set(), set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    text = f.read()
+                metrics.update(metric_pat.findall(text))
+                knobs.update(knob_pat.findall(text))
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              errors="replace") as f:
+        doc = f.read()
+    violations = [f"request/SLO metric {m} missing from "
+                  f"docs/OBSERVABILITY.md"
+                  for m in sorted(metrics) if m not in doc]
+    violations += [f"request-trace knob {k} missing from "
+                   f"docs/OBSERVABILITY.md"
+                   for k in sorted(knobs) if k not in doc]
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"observability catalog: {len(metrics)} request/SLO "
+              f"metrics, {len(knobs)} knobs checked")
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -495,5 +549,6 @@ if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
     sys.exit(1 if (check() or check_strategy_docs() or check_env_docs()
-                   or check_fleet_knobs() or check_serving_programs())
+                   or check_fleet_knobs() or check_observability_catalog()
+                   or check_serving_programs())
              else 0)

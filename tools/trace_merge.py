@@ -13,8 +13,15 @@ Two input shapes, auto-detected per file:
 The rank of a file comes from its payload (dumps carry ``rank``) or
 from a ``rank<N>`` substring in the filename, else its position.
 
+``--request <trace_id>`` filters the merged chrome trace down to ONE
+request's flow (every event whose ``args.trace_id`` matches, plus its
+flow arrows and the process-name metadata of the lanes it touched) — the
+single-request view of a disaggregated prefill->handoff->decode journey.
+
 Usage:
     python tools/trace_merge.py --trace merged.json rank*.trace.json
+    python tools/trace_merge.py --trace one.json --request req-1a2b-000003 \
+        replica*.trace.json
     python tools/trace_merge.py --report report.json flight_rank*.json
     python tools/trace_merge.py --report r.json --trace t.json <mixed...>
 """
@@ -52,6 +59,28 @@ def _fr():
     return _FR
 
 
+_RT = None
+
+
+def _rt():
+    """The request_trace module, loaded stdlib-only from its file (its
+    package-relative imports are all lazy) — same rule as :func:`_fr`."""
+    global _RT
+    if _RT is None:
+        mod = sys.modules.get("paddle_tpu.profiler.request_trace")
+        if mod is not None:              # already imported (tests)
+            _RT = mod
+        else:
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "paddle_tpu", "profiler",
+                                "request_trace.py")
+            spec = importlib.util.spec_from_file_location(
+                "_request_trace_cli", path)
+            _RT = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(_RT)
+    return _RT
+
+
 def _rank_of(path, payload, fallback):
     if isinstance(payload, dict) and isinstance(payload.get("rank"), int):
         return payload["rank"]
@@ -60,7 +89,13 @@ def _rank_of(path, payload, fallback):
 
 
 def load_inputs(paths):
-    """Split the input files into ({rank: trace}, {rank: dump})."""
+    """Split the input files into ({rank: trace}, {rank: dump}).
+
+    Three payload shapes are auto-detected: chrome traces, flight dumps,
+    and per-request timeline records (schema ``paddle_request_trace/1``,
+    as returned by ``paddle.profiler.request_timeline``) — the latter
+    render into per-replica chrome lanes, several timelines sharing a
+    replica merge onto one lane."""
     traces, dumps = {}, {}
     idx = 0
     for pattern in paths:
@@ -72,11 +107,18 @@ def load_inputs(paths):
             idx += 1
             if isinstance(payload, dict) and "traceEvents" in payload:
                 traces[rank] = payload
+            elif isinstance(payload, dict) and str(
+                    payload.get("schema", "")).startswith(
+                    "paddle_request_trace"):
+                for lane, t in _rt().timeline_to_chrome(payload).items():
+                    dst = traces.setdefault(lane, {"traceEvents": []})
+                    dst["traceEvents"].extend(t["traceEvents"])
             elif isinstance(payload, dict) and "events" in payload:
                 dumps[rank] = payload
             else:
                 print(f"trace_merge: skipping {path} (neither a chrome "
-                      "trace nor a flight dump)", file=sys.stderr)
+                      "trace, a request timeline, nor a flight dump)",
+                      file=sys.stderr)
     return traces, dumps
 
 
@@ -96,6 +138,22 @@ def build_report(dumps: dict) -> dict:
     }
 
 
+def filter_request(merged: dict, trace_id: str) -> dict:
+    """One request's flow out of a merged chrome trace: its spans
+    (``args.trace_id`` match), its flow arrows (``id`` match) and the
+    process-name metadata of the lanes it touched."""
+    keep, pids = [], set()
+    for e in merged.get("traceEvents", []):
+        if (e.get("args") or {}).get("trace_id") == trace_id \
+                or (e.get("cat") == "request" and e.get("id") == trace_id):
+            keep.append(e)
+            pids.add(e.get("pid"))
+    meta = [e for e in merged.get("traceEvents", [])
+            if e.get("ph") == "M" and e.get("pid") in pids]
+    return {"traceEvents": meta + keep,
+            "displayTimeUnit": merged.get("displayTimeUnit", "ms")}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="merge per-rank flight dumps / traces")
@@ -103,9 +161,13 @@ def main(argv=None) -> int:
                     help="per-rank json files (globs ok)")
     ap.add_argument("--trace", help="write merged chrome trace here")
     ap.add_argument("--report", help="write cross-rank report here")
+    ap.add_argument("--request", metavar="TRACE_ID",
+                    help="filter --trace output to one request's flow")
     args = ap.parse_args(argv)
     if not args.trace and not args.report:
         ap.error("need --trace and/or --report")
+    if args.request and not args.trace:
+        ap.error("--request needs --trace (it filters the merged trace)")
 
     traces, dumps = load_inputs(args.inputs)
     fr = _fr()
@@ -116,6 +178,13 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         merged = fr.merge_chrome_traces(traces)
+        if args.request:
+            merged = filter_request(merged, args.request)
+            if not any((e.get("args") or {}).get("trace_id")
+                       == args.request for e in merged["traceEvents"]):
+                print(f"trace_merge: no events carry trace_id "
+                      f"{args.request!r}", file=sys.stderr)
+                return 2
         with open(args.trace, "w") as f:
             json.dump(merged, f)
         print(f"trace_merge: {len(traces)} rank trace(s) -> {args.trace} "
