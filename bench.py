@@ -931,23 +931,116 @@ def bench_serving():
                 "waste_ratio": round(waste, 3),
                 "buckets": sorted(eng.ragged_buckets_used)}
 
+    def run_spec(spec_on):
+        """Speculative-decode on-vs-off variant: short prompts + long
+        decodes so TPOT dominates, tier-2 self-draft drafter (acceptance
+        ~1.0) as the upper bound. Interpret-tier wall clock understates
+        the win (a verify span costs k+1 attention grid steps there, and
+        the draft forwards are full model runs), so the target-forwards-
+        per-token ratio is emitted alongside as the device-tier proxy —
+        the same convention as the ragged tokens/s ratio."""
+        sp_rng = np.random.default_rng(2)
+        sp_new = max(new, 8)
+        sp = [sp_rng.integers(0, cfg.vocab_size, 24).astype(np.int64)[None]
+              for _ in range(4)]
+        eng = ContinuousServingEngine(
+            model, max_batch_size=4, max_len=24 + sp_new + 16,
+            enable_prefix_cache=False, token_budget=64,
+            spec_decode=spec_on, spec_k=4,
+            draft_model=model if spec_on else None)
+        with eng:
+            eng.generate(sp[0], max_new_tokens=2, timeout=1800)  # warmup
+            t0 = time.perf_counter()
+            threads = [threading.Thread(
+                target=lambda p=p: eng.generate(p, max_new_tokens=sp_new,
+                                                timeout=1800))
+                for p in sp]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+        tokens = len(sp) * sp_new
+        return {
+            "tokens_per_sec": tokens / dt,
+            "decode_ticks": eng.decode_steps,
+            "tokens": tokens,
+            "forwards_per_token": eng.decode_steps / max(tokens, 1),
+            "acceptance": (eng.spec_accepted_tokens
+                           / max(eng.spec_drafted_tokens, 1)),
+            "drafted": eng.spec_drafted_tokens,
+        }
+
+    def kv_capacity_probe():
+        """``BENCH_KV_DTYPE=int8`` capacity probe: max concurrent
+        full-length sessions a fixed pool byte budget holds, int8 vs
+        native pages (analytic from the page codec's byte layout,
+        cross-checked against a live int8 engine's measured
+        ``page_nbytes``)."""
+        from paddle_tpu.models.generation import kv_page_nbytes
+        pool_mb = float(os.environ.get("BENCH_KV_POOL_MB", "64"))
+        budget = int(pool_mb * 2 ** 20)
+        page = 16
+        seq_len = sys_len + tail + new + 16
+        pages_per_seq = -(-seq_len // page)
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        kv_heads = cfg.num_key_value_heads
+        native_pb = kv_page_nbytes(kv_heads, head_dim, page, "native",
+                                   "float32", cfg.num_hidden_layers)
+        int8_pb = kv_page_nbytes(kv_heads, head_dim, page, "int8",
+                                 "float32", cfg.num_hidden_layers)
+        native_sessions = budget // (pages_per_seq * native_pb)
+        int8_sessions = budget // (pages_per_seq * int8_pb)
+        # prove the int8 pool serves real traffic + measured page bytes
+        eng = ContinuousServingEngine(model, max_batch_size=2,
+                                      max_len=seq_len, kv_dtype="int8")
+        with eng:
+            eng.generate(prompts[0], max_new_tokens=new, timeout=1800)
+            measured_pb = eng._cache.page_nbytes
+        return {
+            "pool_mb": pool_mb,
+            "native_sessions": int(native_sessions),
+            "int8_sessions": int(int8_sessions),
+            "capacity_ratio": round(int8_sessions
+                                    / max(native_sessions, 1), 2),
+            "int8_page_nbytes": int(int8_pb),
+            "int8_page_nbytes_measured": int(measured_pb),
+        }
+
     off = run(False)
     on = run(True)
     mixed_ragged = run_mixed(True)
     mixed_legacy = run_mixed(False)
+    spec_on = run_spec(True)
+    spec_off = run_spec(False)
+    spec_speedup = round(spec_on["tokens_per_sec"]
+                         / max(spec_off["tokens_per_sec"], 1e-9), 2)
+    kv_probe = (kv_capacity_probe()
+                if os.environ.get("BENCH_KV_DTYPE", "").lower() == "int8"
+                else None)
     ragged_ratio = round(mixed_ragged["tokens_per_sec"]
                          / max(mixed_legacy["tokens_per_sec"], 1e-9), 2)
     # latency percentiles + goodput from the request-trace SLO monitor
     # (every engine generate above fed it) — the bench trajectory's
     # first latency-percentile entries
     slo = rt.slo_report()
-    for name, val in (
-            ("serving_ragged_tokens_per_s_ratio", ragged_ratio),
-            ("serving_ragged_waste_ratio", mixed_ragged["waste_ratio"]),
-            ("serving_legacy_waste_ratio", mixed_legacy["waste_ratio"]),
-            ("serving_p95_ttft_ms", round(slo["ttft"]["p95_s"] * 1e3, 2)),
-            ("serving_p95_tpot_ms", round(slo["tpot"]["p95_s"] * 1e3, 2)),
-            ("serving_goodput_ratio", round(slo["goodput_ratio"], 3))):
+    aux = [
+        ("serving_ragged_tokens_per_s_ratio", ragged_ratio),
+        ("serving_ragged_waste_ratio", mixed_ragged["waste_ratio"]),
+        ("serving_legacy_waste_ratio", mixed_legacy["waste_ratio"]),
+        ("serving_p95_ttft_ms", round(slo["ttft"]["p95_s"] * 1e3, 2)),
+        ("serving_p95_tpot_ms", round(slo["tpot"]["p95_s"] * 1e3, 2)),
+        ("serving_goodput_ratio", round(slo["goodput_ratio"], 3)),
+        ("serving_spec_tpot_speedup", spec_speedup),
+        ("serving_spec_acceptance_rate",
+         round(spec_on["acceptance"], 3)),
+        ("serving_spec_forwards_per_token",
+         round(spec_on["forwards_per_token"], 3)),
+    ]
+    if kv_probe is not None:
+        aux.append(("serving_kv_capacity_ratio",
+                    kv_probe["capacity_ratio"]))
+    for name, val in aux:
         print(json.dumps({"aux_metric": name, "value": val}),
               file=sys.stderr)
     return {
@@ -972,6 +1065,14 @@ def bench_serving():
         "ragged_waste_ratio": mixed_ragged["waste_ratio"],
         "legacy_waste_ratio": mixed_legacy["waste_ratio"],
         "ragged_buckets": mixed_ragged["buckets"],
+        # speculative decode on-vs-off (self-draft upper bound)
+        "serving_spec_tpot_speedup": spec_speedup,
+        "spec_acceptance_rate": round(spec_on["acceptance"], 3),
+        "spec_drafted_tokens": spec_on["drafted"],
+        "spec_forwards_per_token": round(spec_on["forwards_per_token"], 3),
+        "nospec_forwards_per_token": round(spec_off["forwards_per_token"],
+                                           3),
+        "kv_capacity_probe": kv_probe,
         "config": {"requests": n_req, "sys_prompt": sys_len, "tail": tail,
                    "new_tokens": new, "chunk_tokens": chunk},
     }

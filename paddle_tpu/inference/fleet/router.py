@@ -511,6 +511,15 @@ class ServingRouter:
                 _rt.add_event(ctx, "rejected", reason=ticket.error.reason)
                 _rt.finish_request(ctx, status="rejected",
                                    reason=ticket.error.reason)
+            elif isinstance(ticket.error, TimeoutError):
+                # the ENGINE-side deadline can fire a breath before the
+                # router's own wait expires — same terminal outcome,
+                # same accounting as the wait-expired path above (the
+                # trace must say "timeout" regardless of which side of
+                # the race noticed first)
+                tele["rejected"].inc(tenant=str(tenant), reason="timeout")
+                _rt.add_event(ctx, "timeout")
+                _rt.finish_request(ctx, status="timeout")
             else:
                 _rt.finish_request(ctx, status="error",
                                    error=type(ticket.error).__name__)

@@ -119,6 +119,21 @@ def _telemetry():
                 "paddle_serving_ragged_tokens_total",
                 "tokens executed through the ragged program family",
                 labels=("kind",)),
+            "pool_bytes": r.gauge(
+                "paddle_serving_page_pool_bytes",
+                "dtype-aware KV page-pool bytes (kind=used: pages "
+                "backing live or prefix-cached context; kind=capacity: "
+                "the whole allocatable pool)", labels=("kind",)),
+            "spec_tokens": r.counter(
+                "paddle_spec_tokens_total",
+                "speculative-decode tokens by fate "
+                "(kind=drafted: proposed by the drafter; kind=accepted: "
+                "verified equal to the target model's token)",
+                labels=("kind",)),
+            "spec_accept": r.histogram(
+                "paddle_spec_acceptance_ratio",
+                "accepted/drafted fraction of each verified span",
+                buckets=DEFAULT_RATIO_BUCKETS),
         }
     return _TELEMETRY
 
@@ -132,7 +147,8 @@ def _engine_state(engine) -> dict:
                  "prefill_chunks", "cancelled_rows", "ragged_steps",
                  "token_budget", "ragged_prefill_tokens",
                  "ragged_decode_tokens", "padded_tokens_total",
-                 "useful_tokens_total"):
+                 "useful_tokens_total", "spec_drafted_tokens",
+                 "spec_accepted_tokens", "spec_rounds", "spec_k"):
         v = getattr(engine, attr, None)
         if v is not None:
             state[attr] = v
@@ -163,8 +179,13 @@ def _engine_state(engine) -> dict:
         state["oldest_request_age_s"] = 0.0
     if getattr(engine, "enable_ragged", None) is not None:
         state["ragged"] = engine.enable_ragged
+    if getattr(engine, "enable_spec", None) is not None:
+        state["spec_decode"] = engine.enable_spec
     cache = getattr(engine, "_cache", None)
     if cache is not None:
+        # bytes, not just page counts: the int8-KV capacity win must be
+        # visible in a hang dump without arithmetic
+        page_nb = cache.page_nbytes
         state["prefix_cache"] = {
             "enabled": cache.enable_prefix_cache,
             "hits": cache.prefix_hits,
@@ -173,6 +194,12 @@ def _engine_state(engine) -> dict:
             "cow_copies": cache.cow_copies,
             "free_pages": cache.free_page_count,
             "used_pages": cache.used_page_count,
+            "kv_dtype": cache.kv_dtype,
+            "page_nbytes": page_nb,
+            "pool_bytes_used": cache.used_page_count * page_nb,
+            "pool_bytes_capacity": (cache.num_pages - 1) * page_nb,
+            "rollbacks": cache.rollbacks,
+            "tokens_rolled_back": cache.tokens_rolled_back,
         }
     return state
 
@@ -513,12 +540,14 @@ class ServingEngine:
 class _Row:
     """One sequence of a request inside the continuous scheduler."""
 
-    def __init__(self, req, ids):
+    def __init__(self, req, ids, row_idx=0):
         self.req = req
+        self.row_idx = int(row_idx)          # row within the request
         self.prompt = np.asarray(ids)        # [s]
         self.generated: list = []
         self.done = False
         self.state = "queued"                # queued -> prefill -> decode
+        self._key_base = None                # seeded-sampling PRNG base
 
 
 class ContinuousServingEngine:
@@ -568,7 +597,9 @@ class ContinuousServingEngine:
     def __init__(self, model, max_batch_size=8, page_size=16, max_len=2048,
                  pad_token_id=0, prefill_chunk_tokens=None,
                  enable_prefix_cache=None, num_pages=None,
-                 token_budget=None, enable_ragged=None):
+                 token_budget=None, enable_ragged=None, kv_dtype=None,
+                 spec_decode=None, spec_k=None, drafter=None,
+                 draft_model=None):
         self.model = model
         self.max_batch = int(max_batch_size)
         self.page_size = int(page_size)
@@ -597,6 +628,35 @@ class ContinuousServingEngine:
         # lifetime
         self.token_budget = max(int(token_budget), self.max_batch, 1)
         self.num_pages = num_pages
+        self.kv_dtype = kv_dtype       # None => cache reads PADDLE_KV_DTYPE
+        # speculative decoding (PADDLE_SPEC_DECODE=1): a drafter proposes
+        # up to spec_k tokens per live decode slot each tick; the ragged
+        # forward verifies them as one q_len=k+1 span and the scheduler
+        # keeps the longest matching prefix (greedy acceptance => output
+        # bit-identical to plain greedy). Requires the ragged scheduler —
+        # the legacy fixed-shape decode step has no multi-token span.
+        if spec_decode is None:
+            spec_decode = os.environ.get("PADDLE_SPEC_DECODE", "0") == "1"
+        self.enable_spec = bool(spec_decode)
+        if spec_k is None:
+            from .speculative import DEFAULT_SPEC_K
+            spec_k = int(os.environ.get("PADDLE_SPEC_K",
+                                        str(DEFAULT_SPEC_K)))
+        self.spec_k = max(int(spec_k), 1)
+        if self.enable_spec and not self.enable_ragged:
+            raise ValueError(
+                "speculative decoding needs the ragged scheduler "
+                "(enable_ragged=True / PADDLE_SERVING_RAGGED=1): "
+                "verification is a q_len=k+1 ragged span")
+        self._drafter = None
+        if self.enable_spec:
+            if drafter is None:
+                from .speculative import make_drafter
+                drafter = make_drafter(draft_model=draft_model)
+            self._drafter = drafter
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_rounds = 0           # verify spans with >= 1 draft
         self._q: queue.Queue = queue.Queue()
         self._thread = None
         self._running = False
@@ -744,7 +804,8 @@ class ContinuousServingEngine:
         nxt = int(np.asarray(_sample_logits(
             logits._data[:, n_valid - 1].astype(jnp.float32),
             kw.get("do_sample", False), kw.get("top_k", 0),
-            kw.get("top_p", 1.0), kw.get("temperature", 1.0)))[0])
+            kw.get("top_p", 1.0), kw.get("temperature", 1.0),
+            key=self._row_key(row, len(row.generated))))[0])
         row.state = "decode"
         self._push_token(cache, free, active, slot, nxt)
 
@@ -795,9 +856,27 @@ class ContinuousServingEngine:
         cache = SlotPagedKVCache(self.max_batch, page_size=self.page_size,
                                  max_len=self.max_len,
                                  num_pages=self.num_pages,
-                                 enable_prefix_cache=self.enable_prefix_cache)
+                                 enable_prefix_cache=self.enable_prefix_cache,
+                                 kv_dtype=self.kv_dtype)
         self._cache = cache           # flight-recorder / test introspection
         return cache
+
+    @staticmethod
+    def _row_key(row, token_idx):
+        """Per-token PRNG key for seeded sampling: a request carrying
+        ``seed=`` draws token ``i`` of row ``r`` with
+        ``fold_in(fold_in(key(seed), r), i)`` — a pure function of the
+        request, so sampled decode replays identically across runs,
+        schedulers, and speculative verification. Returns None (global
+        stateful generator) without a seed."""
+        seed = row.req.kwargs.get("seed")
+        if seed is None:
+            return None
+        import jax
+        if row._key_base is None:
+            row._key_base = jax.random.fold_in(
+                jax.random.key(int(seed)), row.row_idx)
+        return jax.random.fold_in(row._key_base, int(token_idx))
 
     def _serve_impl(self):
         if self.enable_ragged:
@@ -828,7 +907,8 @@ class ContinuousServingEngine:
                 if isinstance(item, _Control):
                     item.run(self)       # tick boundary: scheduler-safe
                     return True
-                item._rows = [_Row(item, row) for row in item.ids]
+                item._rows = [_Row(item, row, i)
+                              for i, row in enumerate(item.ids)]
                 pending.extend(item._rows)
                 return True
 
@@ -894,16 +974,44 @@ class ContinuousServingEngine:
                 try:
                     if self._running:
                         self._admit(cache, free, active, pending, prefill_q)
-                    # ---- pack the tick: decode tokens first, then as
-                    # many prefill tokens as the budget admits ----------
+                    # ---- pack the tick: decode tokens first (each
+                    # optionally extended into a speculative verify span
+                    # of 1 current + up to spec_k drafted tokens), then
+                    # as many prefill tokens as the budget admits ------
                     decode_slots = [i for i, r in enumerate(active)
                                     if r is not None and r.state == "decode"]
                     spans = []        # (slot, q_start, start, n, kind)
+                    tick_drafts = {}  # slot -> drafted tokens this tick
                     off = 0
-                    for i in decode_slots:
-                        spans.append((i, off, int(cache.lens[i]), 1,
-                                      "decode"))
-                        off += 1
+                    drafter = self._drafter
+                    for di, i in enumerate(decode_slots):
+                        row = active[i]
+                        start = int(cache.lens[i])
+                        n = 1
+                        if drafter is not None:
+                            # drafts ride only on leftover budget: every
+                            # remaining decode slot keeps its 1 token
+                            # (decode liveness stays unconditional), and
+                            # a draft never runs past max_len or past
+                            # the row's remaining new-token budget
+                            room = min(
+                                self.token_budget - off - 1
+                                - (len(decode_slots) - di - 1),
+                                self.spec_k,
+                                self.max_len - start - 1,
+                                row.req.max_new_tokens
+                                - len(row.generated) - 1)
+                            draft = (drafter.propose(
+                                np.concatenate(
+                                    [row.prompt,
+                                     np.asarray(row.generated,
+                                                row.prompt.dtype)]),
+                                room) if room > 0 else [])
+                            if draft:
+                                tick_drafts[i] = [int(t) for t in draft]
+                                n = 1 + len(tick_drafts[i])
+                        spans.append((i, off, start, n, "decode"))
+                        off += n
                     remaining = self.token_budget - off
                     for slot in list(prefill_q):
                         if remaining <= 0:
@@ -922,6 +1030,11 @@ class ContinuousServingEngine:
                     tele["free_pages"].set(cache.free_page_count)
                     tele["pool_occupancy"].set(
                         cache.used_page_count / max(cache.num_pages - 1, 1))
+                    page_nb = cache.page_nbytes     # dtype-aware bytes
+                    tele["pool_bytes"].set(cache.used_page_count * page_nb,
+                                           kind="used")
+                    tele["pool_bytes"].set((cache.num_pages - 1) * page_nb,
+                                           kind="capacity")
                     if not spans:
                         continue
                     total = off
@@ -933,7 +1046,10 @@ class ContinuousServingEngine:
                         if kind == "decode":
                             flat[qs] = (row.generated[-1] if row.generated
                                         else row.prompt[-1])
-                            pos[qs] = start
+                            draft = tick_drafts.get(slot)
+                            if draft:
+                                flat[qs + 1:qs + n] = draft
+                            pos[qs:qs + n] = np.arange(start, start + n)
                         else:
                             flat[qs:qs + n] = row.prompt[start:start + n]
                             pos[qs:qs + n] = np.arange(start, start + n)
@@ -951,7 +1067,8 @@ class ContinuousServingEngine:
                     self.padded_tokens_total += padded
                     self.useful_tokens_total += total
                     tele["budget_util"].observe(total / max(padded, 1))
-                    n_decode = len(decode_slots)
+                    n_decode = sum(n for _, _, _, n, kind in spans
+                                   if kind == "decode")
                     n_prefill = total - n_decode
                     self.ragged_decode_tokens += n_decode
                     self.ragged_prefill_tokens += n_prefill
@@ -975,12 +1092,20 @@ class ContinuousServingEngine:
                             last=(kind == "prefill" and
                                   start + n >= row.prompt.shape[0]))
 
-                    def sample(idx, kw):
+                    def sample(idx, row, offset=0):
+                        """Target token for flat position ``idx``;
+                        ``offset`` is the token's index past the row's
+                        already-generated count (speculative verify
+                        positions), keeping seeded-sampling keys a pure
+                        function of the final token index."""
+                        kw = row.req.kwargs
                         if kw.get("do_sample", False):
+                            key = self._row_key(
+                                row, len(row.generated) + offset)
                             return int(np.asarray(_sample_logits(
                                 lg[idx:idx + 1], True, kw.get("top_k", 0),
                                 kw.get("top_p", 1.0),
-                                kw.get("temperature", 1.0)))[0])
+                                kw.get("temperature", 1.0), key=key))[0])
                         return int(greedy[idx])
 
                     # prefill spans: advance, register finished prompts,
@@ -998,23 +1123,52 @@ class ContinuousServingEngine:
                         cache.commit_prefix(slot)
                         row.state = "decode"
                         self._push_token(cache, free, active, slot,
-                                         sample(qs + n - 1, row.req.kwargs))
-                    # decode tokens: one per live slot, sampled from the
-                    # same packed forward
+                                         sample(qs + n - 1, row))
+                    # decode spans: verify drafted tokens against the
+                    # target model's own choices — the target token at
+                    # span offset j is valid iff every draft before it
+                    # matched, so the longest matching prefix (plus the
+                    # free token after it) is emitted and the rejected
+                    # tail's K/V rolls back out of the context
                     if decode_slots:
                         self.decode_steps += 1
-                        self.events.append(("decode", n_decode))
+                        self.events.append(("decode", len(decode_slots)))
                         tele["decode_step"].observe(step_dt)
-                        for _ in range(n_decode):
-                            tele["token"].observe(step_dt / n_decode)
+                        emitted = 0
                         for slot, qs, start, n, kind in spans:
                             if kind != "decode":
                                 continue
                             row = active[slot]
                             if row is None or row.done:
                                 continue
-                            self._push_token(cache, free, active, slot,
-                                             sample(qs, row.req.kwargs))
+                            draft = tick_drafts.get(slot, ())
+                            kd = len(draft)
+                            targets = [sample(qs + j, row, offset=j)
+                                       for j in range(kd + 1)]
+                            m = 0
+                            while m < kd and draft[m] == targets[m]:
+                                m += 1
+                            if kd:
+                                self.spec_rounds += 1
+                                self.spec_drafted_tokens += kd
+                                self.spec_accepted_tokens += m
+                                tele["spec_tokens"].inc(kd, kind="drafted")
+                                if m:
+                                    tele["spec_tokens"].inc(
+                                        m, kind="accepted")
+                                tele["spec_accept"].observe(m / kd)
+                                if kd > m:
+                                    cache.rollback(slot, kd - m)
+                            for t in targets[:m + 1]:
+                                self._push_token(cache, free, active,
+                                                 slot, t)
+                                emitted += 1
+                                if active[slot] is None \
+                                        or active[slot].done:
+                                    break
+                        for _ in range(emitted):
+                            tele["token"].observe(
+                                step_dt / max(emitted, 1))
                 except Exception as e:      # fail everything in flight
                     reqs = {r.req for r in pending}
                     reqs |= {r.req for r in active if r is not None}
@@ -1049,7 +1203,8 @@ class ContinuousServingEngine:
                 if isinstance(item, _Control):
                     item.run(self)       # tick boundary: scheduler-safe
                     return True
-                item._rows = [_Row(item, row) for row in item.ids]
+                item._rows = [_Row(item, row, i)
+                              for i, row in enumerate(item.ids)]
                 pending.extend(item._rows)
                 return True
 
@@ -1129,6 +1284,11 @@ class ContinuousServingEngine:
                     tele["free_pages"].set(cache.free_page_count)
                     tele["pool_occupancy"].set(
                         cache.used_page_count / max(cache.num_pages - 1, 1))
+                    page_nb = cache.page_nbytes     # dtype-aware bytes
+                    tele["pool_bytes"].set(cache.used_page_count * page_nb,
+                                           kind="used")
+                    tele["pool_bytes"].set((cache.num_pages - 1) * page_nb,
+                                           kind="capacity")
                     if not mask.any():
                         continue
                     t_step = time.perf_counter()
@@ -1168,7 +1328,8 @@ class ContinuousServingEngine:
                             tok = int(np.asarray(_sample_logits(
                                 lg[i:i + 1], True, kw.get("top_k", 0),
                                 kw.get("top_p", 1.0),
-                                kw.get("temperature", 1.0)))[0])
+                                kw.get("temperature", 1.0),
+                                key=self._row_key(r, len(r.generated))))[0])
                         else:
                             tok = int(greedy[i])
                         self._push_token(cache, free, active, i, tok)

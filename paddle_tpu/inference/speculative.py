@@ -1,0 +1,138 @@
+"""Speculative-decode drafters for the continuous serving engine
+(reference direction: PaddleNLP's speculative decoding tier around the
+``fused_multi_transformer`` serving block; decode-bandwidth argument per
+"Ragged Paged Attention", arxiv 2604.15464 — one target-model forward
+per generated token is the decode-latency floor this module breaks).
+
+A drafter proposes up to ``k`` next tokens for a sequence from its token
+history alone; the engine verifies the proposal in ONE ragged forward (a
+``q_len = k+1`` span over the paged cache — exactly what the ragged
+kernel already computes for a chunked-prefill span) and keeps the
+longest matching prefix. Greedy acceptance makes the output
+**bit-identical** to plain greedy decode regardless of drafter quality:
+a bad drafter only costs speed, never correctness.
+
+Two tiers:
+
+* :class:`NGramDrafter` (default, ``PADDLE_SPEC_DRAFTER=ngram``) —
+  model-free prompt-lookup: the most recent earlier occurrence of the
+  history's trailing n-gram supplies the continuation. Zero extra
+  weights, zero forwards; shines on extraction/summarization traffic
+  where outputs quote the prompt.
+* :class:`DraftModelDrafter` (``PADDLE_SPEC_DRAFTER=model``) — a small
+  causal LM sharing the tokenizer (e.g. a shallower config from
+  ``models/``) decodes ``k`` tokens greedily as the proposal. Passing
+  the target model itself is "self-speculation": acceptance is ~1.0 and
+  the verify path is exercised end to end (the test/bench harness tier).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["NGramDrafter", "DraftModelDrafter", "make_drafter",
+           "DEFAULT_SPEC_K", "DEFAULT_SPEC_NGRAM"]
+
+#: default drafted tokens per sequence per tick (PADDLE_SPEC_K)
+DEFAULT_SPEC_K = 4
+
+#: default longest trailing n-gram the lookup drafter matches
+#: (PADDLE_SPEC_NGRAM); it backs off to shorter n-grams before giving up
+DEFAULT_SPEC_NGRAM = 3
+
+
+class NGramDrafter:
+    """Model-free prompt-lookup drafter: propose the continuation of the
+    most recent earlier occurrence of the history's trailing n-gram,
+    backing off from ``max_ngram`` down to 1. Returns an empty proposal
+    when nothing matches — the engine then runs a plain 1-token decode
+    for that sequence."""
+
+    def __init__(self, max_ngram=None):
+        if max_ngram is None:
+            max_ngram = int(os.environ.get("PADDLE_SPEC_NGRAM",
+                                           str(DEFAULT_SPEC_NGRAM)))
+        self.max_ngram = max(int(max_ngram), 1)
+
+    def propose(self, history, k):
+        h = np.asarray(history).reshape(-1)
+        n_hist = h.shape[0]
+        k = int(k)
+        if k <= 0 or n_hist < 2:
+            return []
+        for n in range(min(self.max_ngram, n_hist - 1), 0, -1):
+            pat = h[n_hist - n:]
+            # candidate match ends (exclusive) in [n, n_hist-1]: the
+            # trailing occurrence itself is excluded, most recent first
+            windows = np.lib.stride_tricks.sliding_window_view(
+                h[:n_hist - 1], n)
+            hits = np.nonzero((windows == pat).all(axis=1))[0]
+            if hits.size == 0:
+                continue
+            start = int(hits[-1]) + n          # continuation start
+            out = h[start:start + k]
+            if out.size:
+                return [int(t) for t in out]
+        return []
+
+
+class DraftModelDrafter:
+    """Tier-2 drafter: a small causal LM (same tokenizer as the target)
+    greedily decodes ``k`` tokens as the proposal. The draft forward
+    runs on the trailing ``window`` tokens of the history — a drafter
+    needs recency, not the full context, and the window bounds its
+    cost. Proposals are suggestions only: the target model's verify
+    forward decides every emitted token."""
+
+    def __init__(self, model, window=64):
+        if model is None:
+            raise ValueError("DraftModelDrafter needs a draft model "
+                             "(PADDLE_SPEC_DRAFTER=model requires the "
+                             "engine's draft_model= kwarg)")
+        self.model = model
+        self.window = max(int(window), 1)
+
+    def propose(self, history, k):
+        import jax.numpy as jnp
+        from ..framework.core import Tensor
+        from ..autograd.tape import no_grad
+
+        h = np.asarray(history).reshape(-1)
+        k = int(k)
+        if k <= 0 or h.size == 0:
+            return []
+        ids = h[-self.window:].astype(np.int64)
+        out = []
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            with no_grad():
+                for _ in range(k):
+                    logits = self.model.forward(Tensor(ids[None]))
+                    nxt = int(np.asarray(
+                        jnp.argmax(logits._data[0, -1])))
+                    out.append(nxt)
+                    ids = np.concatenate([ids, [nxt]])[-self.window:]
+        finally:
+            if was_training:
+                self.model.train()
+        return out
+
+
+def make_drafter(kind=None, draft_model=None, max_ngram=None, window=64):
+    """Drafter factory for the serving engine. ``kind`` defaults to
+    ``PADDLE_SPEC_DRAFTER`` (``ngram`` | ``model``); ``model`` requires
+    ``draft_model``. A drafter object passed straight through the
+    engine's ``drafter=`` kwarg bypasses this factory entirely."""
+    if kind is None:
+        kind = os.environ.get(
+            "PADDLE_SPEC_DRAFTER",
+            "model" if draft_model is not None else "ngram")
+    kind = str(kind).lower()
+    if kind == "ngram":
+        return NGramDrafter(max_ngram=max_ngram)
+    if kind == "model":
+        return DraftModelDrafter(draft_model, window=window)
+    raise ValueError(f"unknown drafter kind {kind!r} "
+                     f"(expected 'ngram' or 'model')")

@@ -9,6 +9,8 @@ decode kernel — follow-up on the inference milestone.
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -17,7 +19,46 @@ from ..autograd.tape import no_grad
 from ..framework import random as prandom
 
 __all__ = ["KVCache", "PagedKVCache", "SlotPagedKVCache", "GenerationMixin",
-           "block_hash_chain"]
+           "block_hash_chain", "quantize_kv_rows", "dequantize_kv_rows",
+           "kv_page_nbytes"]
+
+#: kv_dtype values SlotPagedKVCache understands (PADDLE_KV_DTYPE)
+KV_DTYPES = ("auto", "int8", "native")
+
+
+def quantize_kv_rows(x):
+    """Symmetric int8 row codec for KV pages: abs-max over the head_dim
+    axis, one fp32 scale per ``[..., d]`` row — the ``quant_matmul``
+    per-output-channel discipline applied at (kv_head, page, slot)
+    granularity. ``x [..., d]`` -> ``(int8 [..., d], f32 scales [...])``;
+    round half-to-even matches the comm-layer wire codec."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.rint(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv_rows(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv_rows` (error bound per element:
+    ``scale / 2 = max|row| / 254``)."""
+    return (jnp.asarray(q).astype(jnp.float32)
+            * jnp.asarray(scale)[..., None]).astype(dtype)
+
+
+def kv_page_nbytes(kv_heads, head_dim, page_size=16, kv_dtype="native",
+                   native_dtype="float32", num_layers=1):
+    """HBM bytes ONE page pins across K+V (plus int8 row scales) for
+    ``num_layers`` attention layers — the int8-KV capacity math:
+    ``sessions_per_pool = pool_bytes // (pages_per_seq * this)``. int8
+    vs fp32 is ``4d/(d+4)`` (~3.8x at d=64), vs bf16 ``2d/(d+4)``
+    (~1.94x at d=128)."""
+    elems = int(kv_heads) * int(page_size) * int(head_dim)
+    if str(kv_dtype) == "int8":
+        per = elems + int(kv_heads) * int(page_size) * 4   # + f32 scales
+    else:
+        per = elems * np.dtype(native_dtype).itemsize
+    return 2 * per * int(num_layers)                       # K and V
 
 
 def block_hash_chain(tokens, page_size, parent=b""):
@@ -241,12 +282,25 @@ class SlotPagedKVCache:
     """
 
     def __init__(self, max_batch, page_size=16, max_len=2048,
-                 num_pages=None, enable_prefix_cache=True):
+                 num_pages=None, enable_prefix_cache=True, kv_dtype=None):
         self.max_batch = int(max_batch)
         self.page_size = int(page_size)
         self.max_len = int(max_len)
         self.pages_per_seq = -(-self.max_len // self.page_size)
         self.enable_prefix_cache = bool(enable_prefix_cache)
+        # int8 KV pages (PADDLE_KV_DTYPE=auto|int8|native): pages store
+        # int8 values + one fp32 scale per (kv_head, page, slot) row,
+        # halving page bytes vs bf16 (quartering vs fp32) so the same
+        # HBM holds ~2x the concurrent sessions; "auto" resolves to
+        # native today (int8 is an explicit capacity opt-in)
+        if kv_dtype is None:
+            kv_dtype = os.environ.get("PADDLE_KV_DTYPE", "auto")
+        kv_dtype = str(kv_dtype).lower()
+        if kv_dtype not in KV_DTYPES:
+            raise ValueError(f"kv_dtype {kv_dtype!r} not in {KV_DTYPES}")
+        self.kv_dtype = "native" if kv_dtype == "auto" else kv_dtype
+        self.kv_quant = self.kv_dtype == "int8"
+        self._scales = {}       # id(layer) -> (k_scales, v_scales) if int8
         # +1: page 0 is the never-allocated scratch page, so capacity for
         # max_batch full-length sequences survives even with zero sharing
         self.num_pages = (int(num_pages) if num_pages is not None
@@ -277,9 +331,12 @@ class SlotPagedKVCache:
         # forward have no per-layer arrays to land in yet — their K/V is
         # staged here and applied as each layer's pool materializes (pool
         # creation order == layer forward order == export order)
-        self._import_backlog: list = []     # (page, [(k_blk, v_blk)/layer])
+        self._import_backlog: list = []     # (page, kv/layer, scales/layer)
         self.pages_imported = 0
         self.pages_exported = 0
+        # speculative-decode rejection accounting (rollback())
+        self.rollbacks = 0
+        self.tokens_rolled_back = 0
 
     # -- page allocator ------------------------------------------------------
     def _alloc_page(self):
@@ -339,6 +396,9 @@ class SlotPagedKVCache:
         for key, (kp, vp) in self._pools.items():
             self._pools[key] = (kp.at[:, new].set(kp[:, page]),
                                 vp.at[:, new].set(vp[:, page]))
+        for key, (ks, vs) in self._scales.items():
+            self._scales[key] = (ks.at[:, new].set(ks[:, page]),
+                                 vs.at[:, new].set(vs[:, page]))
         self._decref(page)
         self._tables[slot, blk] = new
         self.cow_copies += 1
@@ -350,6 +410,48 @@ class SlotPagedKVCache:
     @property
     def used_page_count(self):
         return self.num_pages - 1 - len(self._free)
+
+    @property
+    def page_nbytes(self):
+        """dtype-aware HBM bytes one page pins across every layer's K+V
+        pools (and int8 scale arrays) — 0 until the first forward
+        materializes the pools."""
+        total = 0
+        for kp, vp in self._pools.values():
+            total += kp.nbytes + vp.nbytes
+        for ks, vs in self._scales.values():
+            total += ks.nbytes + vs.nbytes
+        return total // self.num_pages if total else 0
+
+    def rollback(self, slot, n):
+        """Truncate the last ``n`` context tokens of ``slot`` — the
+        speculative-decode rejection path: a verify span wrote K/V for
+        ``k`` drafted tokens, the target model accepted only ``m``, and
+        positions past the accepted prefix must leave the context.
+        Pages wholly past the truncation point are unmapped from the
+        slot's table (refcount--): a page another slot still shares, or
+        one the prefix index registered, keeps its other references and
+        survives untouched; a private page returns to the free list.
+        The kept partial block may hold stale K/V past the new length —
+        masked by every reader's context bound and overwritten by the
+        next write (which re-runs copy-on-write protection)."""
+        slot = int(slot)
+        n = int(n)
+        if n <= 0:
+            return 0
+        if n > int(self.lens[slot]):
+            raise ValueError(f"rollback {n} > slot context "
+                             f"{int(self.lens[slot])}")
+        new_len = int(self.lens[slot]) - n
+        keep = -(-new_len // self.page_size)
+        for blk in range(keep, int(self._n_blocks[slot])):
+            self._decref(int(self._tables[slot, blk]))
+            self._tables[slot, blk] = 0
+        self._n_blocks[slot] = keep
+        self.lens[slot] = new_len
+        self.rollbacks += 1
+        self.tokens_rolled_back += n
+        return n
 
     # -- engine-facing lifecycle -------------------------------------------
     def assign(self, slot, prompt):
@@ -486,9 +588,16 @@ class SlotPagedKVCache:
         idx = jnp.asarray(pages)
         layers = [(np.asarray(kp[:, idx]), np.asarray(vp[:, idx]))
                   for kp, vp in self._pools.values()]
+        # int8 pools ship their quantized ints AS-IS plus the per-row
+        # scales — the handoff blob shrinks with the pages and the
+        # receiver re-registers bit-exactly (no requantization step)
+        scales = [(np.asarray(ks[:, idx]), np.asarray(vs[:, idx]))
+                  for ks, vs in self._scales.values()] if self.kv_quant \
+            else None
         self.pages_exported += len(pages)
         return {"page_size": self.page_size, "digests": out_digests,
-                "layers": layers}
+                "layers": layers, "kv_dtype": self.kv_dtype,
+                "native_dtype": str(layers[0][0].dtype), "scales": scales}
 
     def import_pages(self, blob):
         """Receiver side of the disagg handoff: allocate pages for the
@@ -504,12 +613,29 @@ class SlotPagedKVCache:
             raise ValueError(
                 f"page_size mismatch: exporter {blob['page_size']} vs "
                 f"importer {self.page_size}")
+        blob_kv = blob.get("kv_dtype", "native")
+        if blob_kv != self.kv_dtype:
+            # an int8 blob landed in a native pool (or vice versa) would
+            # silently de/re-quantize — reject instead; the disagg
+            # handoff is best-effort and falls back to full prefill
+            raise ValueError(f"kv_dtype mismatch: exporter {blob_kv} vs "
+                             f"importer {self.kv_dtype}")
+        if self._pools:
+            pool_dtype = str(next(iter(self._pools.values()))[0].dtype)
+            blob_native = blob.get("native_dtype", pool_dtype)
+            if blob_native != pool_dtype:
+                raise ValueError(
+                    f"pool dtype mismatch: exporter {blob_native} vs "
+                    f"importer {pool_dtype}")
+        blob_scales = blob.get("scales")
         imported = 0
         for j, digest in enumerate(blob["digests"]):
             if digest in self._index:
                 continue
             page = self._alloc_page()        # ref=1: the index's own ref
             per_layer = [(k[:, j], v[:, j]) for k, v in blob["layers"]]
+            per_scales = ([(ks[:, j], vs[:, j]) for ks, vs in blob_scales]
+                          if blob_scales is not None else None)
             if self._pools:
                 if len(per_layer) != len(self._pools):
                     raise ValueError(
@@ -520,8 +646,13 @@ class SlotPagedKVCache:
                     kb, vb = per_layer[li]
                     self._pools[key] = (kp.at[:, page].set(kb),
                                         vp.at[:, page].set(vb))
+                    if per_scales is not None:
+                        ks, vs = self._scales[key]
+                        ksb, vsb = per_scales[li]
+                        self._scales[key] = (ks.at[:, page].set(ksb),
+                                             vs.at[:, page].set(vsb))
             else:
-                self._import_backlog.append((page, per_layer))
+                self._import_backlog.append((page, per_layer, per_scales))
             self._index[digest] = page
             self._page_digest[page] = digest
             imported += 1
@@ -551,18 +682,59 @@ class SlotPagedKVCache:
         if key not in self._pools:
             li = len(self._pools)       # this layer's forward-order index
             shape = (kv_heads, self.num_pages, self.page_size, d)
-            kp = jnp.zeros(shape, dtype)
-            vp = jnp.zeros(shape, dtype)
+            pool_dtype = jnp.int8 if self.kv_quant else dtype
+            kp = jnp.zeros(shape, pool_dtype)
+            vp = jnp.zeros(shape, pool_dtype)
+            if self.kv_quant:
+                # scale 1.0 everywhere: the scratch page (and any
+                # never-written slot) dequantizes to finite garbage that
+                # context bounds mask, never NaN/inf
+                sshape = (kv_heads, self.num_pages, self.page_size)
+                ks = jnp.ones(sshape, jnp.float32)
+                vs = jnp.ones(sshape, jnp.float32)
             # land any pre-forward disagg imports (import_pages before the
             # first request) for this layer; entries whose page has since
             # been evicted from the index are dead — skip them
-            for page, per_layer in self._import_backlog:
+            for page, per_layer, per_scales in self._import_backlog:
                 if li < len(per_layer) and page in self._page_digest:
                     kb, vb = per_layer[li]
                     kp = kp.at[:, page].set(kb)
                     vp = vp.at[:, page].set(vb)
+                    if self.kv_quant and per_scales is not None:
+                        ksb, vsb = per_scales[li]
+                        ks = ks.at[:, page].set(ksb)
+                        vs = vs.at[:, page].set(vsb)
             self._pools[key] = (kp, vp)
+            if self.kv_quant:
+                self._scales[key] = (ks, vs)
         return self._pools[key]
+
+    def _scatter(self, layer, k_pages, v_pages, kt, vt, page_ids, slot_ids):
+        """Write this forward's K/V rows into the pages — quantizing on
+        scatter when the pool is int8 (each ``[..., d]`` row gets its
+        own fp32 scale, stored beside the pool) — and return the updated
+        pools. The leading shape of ``kt``/``vt`` past the kv axis must
+        match ``page_ids``/``slot_ids``."""
+        key = id(layer)
+        if self.kv_quant:
+            kq, ks_new = quantize_kv_rows(kt)
+            vq, vs_new = quantize_kv_rows(vt)
+            ks, vs = self._scales[key]
+            self._scales[key] = (
+                ks.at[:, page_ids, slot_ids].set(ks_new),
+                vs.at[:, page_ids, slot_ids].set(vs_new))
+            kt, vt = kq, vq
+        new_kp = k_pages.at[:, page_ids, slot_ids].set(kt)
+        new_vp = v_pages.at[:, page_ids, slot_ids].set(vt)
+        self._pools[key] = (new_kp, new_vp)
+        return new_kp, new_vp
+
+    def _layer_scales(self, layer):
+        """(k_scales, v_scales) for the paged kernels' dequant-gather
+        tiers, or (None, None) on native pools."""
+        if not self.kv_quant:
+            return None, None
+        return self._scales[id(layer)]
 
     # -- attention ----------------------------------------------------------
     def attend(self, layer, q, k, v, training=False, dropout_p=0.0):
@@ -607,22 +779,28 @@ class SlotPagedKVCache:
             page_ids, slot_ids = self._idx
             kt = jnp.moveaxis(ka[0], 1, 0)          # [kv, s, d]
             vt = jnp.moveaxis(va[0], 1, 0)
-            new_kp = k_pages.at[:, page_ids, slot_ids].set(kt)
-            new_vp = v_pages.at[:, page_ids, slot_ids].set(vt)
-            self._pools[id(layer)] = (new_kp, new_vp)
-            if start > 0:
+            new_kp, new_vp = self._scatter(layer, k_pages, v_pages, kt, vt,
+                                           page_ids, slot_ids)
+            if start > 0 or self.kv_quant:
                 # chunked / prefix-cached prefill: read the whole prefix
                 # back from the pages; sdpa's bottom-right causal
                 # alignment handles sq != sk. Table entries past the
                 # allocated blocks are the scratch page — those keys sit
                 # at pad positions and are never attended by valid
-                # queries.
+                # queries. int8 pools ALWAYS read back (dequantized) so
+                # every attention consistently sees the quantized KV the
+                # later decode steps will see.
                 n_pages = min(-(-(start + s) // self.page_size),
                               self.pages_per_seq)
                 tb = jnp.asarray(self._tables[slot, :n_pages])
-                kf_flat = jnp.moveaxis(new_kp[:, tb], 0, 2).reshape(
+                kp_g, vp_g = new_kp[:, tb], new_vp[:, tb]
+                if self.kv_quant:
+                    ks, vs = self._scales[id(layer)]
+                    kp_g = dequantize_kv_rows(kp_g, ks[:, tb], ka.dtype)
+                    vp_g = dequantize_kv_rows(vp_g, vs[:, tb], va.dtype)
+                kf_flat = jnp.moveaxis(kp_g, 0, 2).reshape(
                     n_pages * self.page_size, kv_heads, d)
-                vf_flat = jnp.moveaxis(new_vp[:, tb], 0, 2).reshape(
+                vf_flat = jnp.moveaxis(vp_g, 0, 2).reshape(
                     n_pages * self.page_size, kv_heads, d)
                 if n_pages * self.page_size < start + s:
                     # bucket-padded chunk ran past the table: keep sdpa's
@@ -671,9 +849,9 @@ class SlotPagedKVCache:
              ctx_lens) = self._idx
             kt = jnp.moveaxis(ka[0], 1, 0)          # [kv, s, d]
             vt = jnp.moveaxis(va[0], 1, 0)
-            new_kp = k_pages.at[:, page_ids, slot_ids].set(kt)
-            new_vp = v_pages.at[:, page_ids, slot_ids].set(vt)
-            self._pools[id(layer)] = (new_kp, new_vp)
+            new_kp, new_vp = self._scatter(layer, k_pages, v_pages, kt, vt,
+                                           page_ids, slot_ids)
+            ksc, vsc = self._layer_scales(layer)
 
             from ..ops.pallas.ragged_paged_attention import (
                 ragged_paged_attention)
@@ -683,7 +861,8 @@ class SlotPagedKVCache:
             def fn(qa):
                 out = ragged_paged_attention(
                     qa[0], new_kp, new_vp, tables, seq_slots, q_starts,
-                    q_lens, ctx_lens, interpret=interpret)
+                    q_lens, ctx_lens, k_scales=ksc, v_scales=vsc,
+                    interpret=interpret)
                 return out[None]         # [1, tokens, heads, d]
             return apply(fn, q, op_name="ragged_paged_attention")
 
@@ -707,9 +886,9 @@ class SlotPagedKVCache:
         page_ids, slot_ids, tables, ctx = self._idx
         kt = jnp.moveaxis(ka, 2, 0)                 # [kv, b, 1, d]
         vt = jnp.moveaxis(va, 2, 0)
-        new_kp = k_pages.at[:, page_ids, slot_ids].set(kt)
-        new_vp = v_pages.at[:, page_ids, slot_ids].set(vt)
-        self._pools[id(layer)] = (new_kp, new_vp)
+        new_kp, new_vp = self._scatter(layer, k_pages, v_pages, kt, vt,
+                                       page_ids, slot_ids)
+        ksc, vsc = self._layer_scales(layer)
 
         from ..ops.pallas.paged_attention import paged_attention
         import jax as _jax
@@ -717,13 +896,21 @@ class SlotPagedKVCache:
 
         def fn(qa):
             out = paged_attention(qa[:, 0], new_kp, new_vp, tables, ctx,
+                                  k_scales=ksc, v_scales=vsc,
                                   interpret=interpret)
             return out[:, None]
         return apply(fn, q, op_name="paged_attention")
 
 
-def _sample_logits(logits, do_sample, top_k, top_p, temperature):
-    """logits [b, V] (jnp) -> token ids [b] (jnp)."""
+def _sample_logits(logits, do_sample, top_k, top_p, temperature, key=None):
+    """logits [b, V] (jnp) -> token ids [b] (jnp).
+
+    ``key`` is an explicit jax PRNG key for the categorical draw; with
+    it the sample is a pure function of (logits, key) — the serving
+    engine derives one key per (request seed, row, token index) so
+    sampled decode is reproducible and speculative verification of
+    sampled tokens is deterministic. ``None`` falls back to the global
+    stateful generator (legacy call-order-dependent behavior)."""
     if not do_sample:
         return jnp.argmax(logits, axis=-1)
     logits = logits / max(temperature, 1e-6)
@@ -740,7 +927,8 @@ def _sample_logits(logits, do_sample, top_k, top_p, temperature):
         kth = jnp.take_along_axis(sorted_l, cutoff_idx[:, None], axis=-1)
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     import jax
-    key = prandom.next_key()
+    if key is None:
+        key = prandom.next_key()
     return jax.random.categorical(key, logits, axis=-1)
 
 
@@ -753,12 +941,15 @@ class GenerationMixin:
     @no_grad()
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
                  do_sample=False, top_k=0, top_p=1.0, temperature=1.0,
-                 eos_token_id=None, num_beams=1, length_penalty=1.0, **kw):
+                 eos_token_id=None, num_beams=1, length_penalty=1.0,
+                 seed=None, **kw):
         """Returns generated ids [b, prompt + new] (prompt included,
         reference decode contract). ``num_beams > 1`` runs beam search
         (reference ``decode_strategy='beam_search'``) — greedy expansion
         over the top-``num_beams`` hypotheses with KV-cache reordering;
-        requires ``do_sample=False``."""
+        requires ``do_sample=False``. ``seed`` makes sampled decode
+        reproducible: step ``i`` draws with ``fold_in(key(seed), i)``
+        instead of the global stateful generator."""
         input_ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(np.asarray(input_ids, np.int64))
         if max_length is not None:
@@ -785,13 +976,22 @@ class GenerationMixin:
             cur = ids
             all_ids = ids._data
             finished = jnp.zeros((ids.shape[0],), bool)
+            base_key = None
+            if seed is not None:
+                import jax
+                base_key = jax.random.key(int(seed))
             for step in range(max_new_tokens):
                 logits = self.forward(cur, cache=cache) \
                     if cache is not None else self.forward(
                         Tensor(all_ids))
                 lg = logits._data[:, -1].astype(jnp.float32)
+                step_key = None
+                if base_key is not None:
+                    import jax
+                    step_key = jax.random.fold_in(base_key, step)
                 nxt = _sample_logits(lg, do_sample, top_k, top_p,
-                                     temperature).astype(all_ids.dtype)
+                                     temperature,
+                                     key=step_key).astype(all_ids.dtype)
                 if eos_token_id is not None:
                     nxt = jnp.where(finished,
                                     jnp.asarray(eos_token_id, nxt.dtype),

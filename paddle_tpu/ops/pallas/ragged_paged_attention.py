@@ -118,6 +118,99 @@ def _ragged_kernel(slots_ref, ctx_ref, tables_ref, q_ref, k_ref, v_ref,
         o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
+def _ragged_kernel_quant(slots_ref, ctx_ref, tables_ref, q_ref, k_ref,
+                         v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref,
+                         acc_ref, *, sm_scale, page_size, pages_per_seq,
+                         group):
+    """int8-KV variant of :func:`_ragged_kernel`: page blocks arrive as
+    int8 rows plus one fp32 scale per (page, slot) row, dequantized in
+    VMEM right before the MXU dots — fp32 pages never exist in HBM."""
+    t = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[t]
+    q = q_ref[0, 0].astype(jnp.float32)            # [group, d]
+    k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0][:, None]
+    v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0][:, None]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    pos = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < ctx, s, NEG_INF)
+
+    m_prev = m_ref[...][:, :1]                     # [g, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    w = jnp.exp(s - m_new)                         # masked -> 0
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_ref[...][:, :1] * corr + jnp.sum(w, -1, keepdims=True)
+    pv = jax.lax.dot_general(                      # [g, d]
+        w, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * corr + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == pages_per_seq - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _ragged_paged_attention_pallas_quant(q, k_pages, v_pages, k_scales,
+                                         v_scales, block_tables, tok_slot,
+                                         tok_ctx, *, sm_scale, interpret):
+    tokens, heads, d = q.shape
+    kv_heads, _, page_size, _ = k_pages.shape
+    pages_per_seq = block_tables.shape[1]
+    group = heads // kv_heads
+    qg = q.reshape(tokens, kv_heads, group, d)
+
+    kernel = functools.partial(
+        _ragged_kernel_quant, sm_scale=sm_scale, page_size=page_size,
+        pages_per_seq=pages_per_seq, group=group)
+    page_spec = pl.BlockSpec((1, 1, page_size, d),
+                             lambda t, h, p, slot, ctx, tbl:
+                             (h, tbl[slot[t], p], 0, 0))
+    scale_spec = pl.BlockSpec((1, 1, page_size),
+                              lambda t, h, p, slot, ctx, tbl:
+                              (h, tbl[slot[t], p], 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(tokens, kv_heads, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d),
+                         lambda t, h, p, slot, ctx, tbl: (t, h, 0, 0)),
+            page_spec, page_spec, scale_spec, scale_spec,
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d),
+                               lambda t, h, p, slot, ctx, tbl: (t, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((tokens, kv_heads, group, d),
+                                       q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(tok_slot, jnp.int32), jnp.asarray(tok_ctx, jnp.int32),
+      jnp.asarray(block_tables, jnp.int32), qg, k_pages, v_pages,
+      jnp.asarray(k_scales, jnp.float32), jnp.asarray(v_scales, jnp.float32))
+    return out.reshape(tokens, heads, d)
+
+
 def _ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
                                    tok_slot, tok_ctx, *, sm_scale,
                                    interpret):
@@ -165,18 +258,24 @@ def _ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
 
 
 def _ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,
-                                tok_slot, tok_ctx, *, sm_scale):
+                                tok_slot, tok_ctx, *, sm_scale,
+                                k_scales=None, v_scales=None):
     """Vectorized jittable XLA tier: gather each token's sequence pages
-    as dense KV, then masked softmax-attention. O(tokens * S_max) HBM —
-    trades the kernel's memory win for wedge-free compiles."""
+    as dense KV (dequantized when int8 row scales are given), then
+    masked softmax-attention. O(tokens * S_max) HBM — trades the
+    kernel's memory win for wedge-free compiles."""
     kv_heads, _, page_size, d = k_pages.shape
     tokens, heads, _ = q.shape
     group = heads // kv_heads
     tbl = jnp.asarray(block_tables, jnp.int32)[jnp.asarray(tok_slot,
                                                            jnp.int32)]
+    kg, vg = k_pages[:, tbl], v_pages[:, tbl]
+    if k_scales is not None:
+        kg = kg.astype(jnp.float32) * k_scales[:, tbl][..., None]
+        vg = vg.astype(jnp.float32) * v_scales[:, tbl][..., None]
     # [kv, tokens, pages, slot, d] -> [tokens, kv, S, d]
-    ks = jnp.moveaxis(k_pages[:, tbl], 1, 0).reshape(tokens, kv_heads, -1, d)
-    vs = jnp.moveaxis(v_pages[:, tbl], 1, 0).reshape(tokens, kv_heads, -1, d)
+    ks = jnp.moveaxis(kg, 1, 0).reshape(tokens, kv_heads, -1, d)
+    vs = jnp.moveaxis(vg, 1, 0).reshape(tokens, kv_heads, -1, d)
     qb = (q * sm_scale).reshape(tokens, kv_heads, group, d)
     s = jnp.einsum("tkgd,tksd->tkgs", qb.astype(jnp.float32),
                    ks.astype(jnp.float32))
@@ -190,7 +289,8 @@ def _ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables, seq_slots,
                            q_starts, q_lens, context_lens, *,
-                           sm_scale=None, interpret=False):
+                           sm_scale=None, k_scales=None, v_scales=None,
+                           interpret=False):
     """Mixed prefill+decode attention over a shared paged KV cache.
 
     q               [tokens, heads, head_dim] — the flat packed batch
@@ -198,8 +298,12 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, seq_slots,
     block_tables    [slots, pages_per_seq] int32 (unused entries = 0)
     seq_slots       [nseq] int32 — block-table row per sequence
     q_starts        [nseq] int32 — NON-DECREASING span offsets into q
-    q_lens          [nseq] int32 — span length (1 = decode)
+    q_lens          [nseq] int32 — span length (1 = decode; a
+                    speculative verify span is the current token plus k
+                    drafted tokens, q_len = k+1)
     context_lens    [nseq] int32 — total context incl. this span
+    k_scales/v_scales [kv_heads, num_pages, page_size] f32 — per-row
+                    dequant scales for int8 pages (None = native pages)
     -> [tokens, heads, head_dim]; rows outside every span are garbage.
     """
     tokens, heads, d = q.shape
@@ -207,6 +311,29 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, seq_slots,
         sm_scale = 1.0 / math.sqrt(d)
     tok_slot, tok_ctx = _token_descriptors(tokens, seq_slots, q_starts,
                                            q_lens, context_lens)
+    if k_scales is not None:
+        # int8 KV pages: same wedge-proof ladder, own canary — the quant
+        # kernel's Mosaic lowering (int8 loads + row-scale multiplies)
+        # is distinct from the native kernel's proven one.
+        if not interpret and jax.default_backend() == "tpu":
+            import os
+            impl = os.environ.get("PADDLE_TPU_RAGGED_IMPL", "auto").lower()
+            if impl != "xla":
+                from ...utils.guarded_compile import kernel_allowed
+                if impl == "inrepo" or kernel_allowed(
+                        "ragged_paged_attention_int8",
+                        "int8-KV ragged paged attention kernel",
+                        fallback="the XLA dequant-gather tier"):
+                    return _ragged_paged_attention_pallas_quant(
+                        q, k_pages, v_pages, k_scales, v_scales,
+                        block_tables, tok_slot, tok_ctx,
+                        sm_scale=sm_scale, interpret=False)
+            return _ragged_paged_attention_xla(
+                q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
+                sm_scale=sm_scale, k_scales=k_scales, v_scales=v_scales)
+        return _ragged_paged_attention_pallas_quant(
+            q, k_pages, v_pages, k_scales, v_scales, block_tables,
+            tok_slot, tok_ctx, sm_scale=sm_scale, interpret=interpret)
     if not interpret and jax.default_backend() == "tpu":
         # Impl choice on real TPU: same wedge-proof ladder as
         # paged_attention — the in-repo kernel only after its canary is

@@ -156,6 +156,61 @@ for s, qs, ql in ((0, 0, 1), (1, 1, 9), (2, 10, 6)):
     assert err < 5e-2, ("numeric mismatch", s, err)
 print("PROOF_OK")
 """,
+    "paged_attention_int8": _REQUIRE_TPU + """
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.paged_attention import (
+    _paged_attention_pallas_quant, paged_attention_reference)
+from paddle_tpu.models.generation import quantize_kv_rows, \
+    dequantize_kv_rows
+rs = np.random.RandomState(0)
+batch, kv_heads, group, d, page, npages = 4, 2, 4, 128, 16, 8
+q = jnp.asarray(rs.randn(batch, kv_heads * group, d), jnp.float32)
+kq, ks = quantize_kv_rows(rs.randn(kv_heads, npages, page, d))
+vq, vs = quantize_kv_rows(rs.randn(kv_heads, npages, page, d))
+tbl = jnp.asarray(rs.randint(0, npages, (batch, 4)), jnp.int32)
+lens = jnp.asarray([64, 33, 17, 50], jnp.int32)
+out = _paged_attention_pallas_quant(q, kq, vq, ks, vs, tbl, lens,
+                                    sm_scale=d ** -0.5, interpret=False)
+ref = paged_attention_reference(q, dequantize_kv_rows(kq, ks),
+                                dequantize_kv_rows(vq, vs), tbl, lens)
+err = float(jnp.max(jnp.abs(out.astype(jnp.float32) -
+                            ref.astype(jnp.float32))))
+assert err < 5e-2, ("numeric mismatch", err)
+print("PROOF_OK")
+""",
+    "ragged_paged_attention_int8": _REQUIRE_TPU + """
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.ragged_paged_attention import (
+    _ragged_paged_attention_pallas_quant, _token_descriptors,
+    ragged_paged_attention_reference)
+from paddle_tpu.models.generation import quantize_kv_rows, \
+    dequantize_kv_rows
+rs = np.random.RandomState(0)
+kv_heads, group, d, page, npages, pps = 2, 4, 128, 16, 12, 4
+kq, ks = quantize_kv_rows(rs.randn(kv_heads, npages, page, d))
+vq, vs = quantize_kv_rows(rs.randn(kv_heads, npages, page, d))
+tbl = jnp.asarray(rs.randint(0, npages, (3, pps)), jnp.int32)
+# mixed spans incl. a q_len=5 speculative verify span
+slots = jnp.asarray([0, 1, 2], jnp.int32)
+q_starts = jnp.asarray([0, 1, 6], jnp.int32)
+q_lens = jnp.asarray([1, 5, 9], jnp.int32)
+ctx = jnp.asarray([33, 25, 9], jnp.int32)
+q = jnp.asarray(rs.randn(16, kv_heads * group, d), jnp.float32)
+slot_t, ctx_t = _token_descriptors(16, slots, q_starts, q_lens, ctx)
+out = _ragged_paged_attention_pallas_quant(q, kq, vq, ks, vs, tbl,
+                                           slot_t, ctx_t,
+                                           sm_scale=d ** -0.5,
+                                           interpret=False)
+ref = ragged_paged_attention_reference(
+    q, dequantize_kv_rows(kq, ks), dequantize_kv_rows(vq, vs), tbl,
+    slots, q_starts, q_lens, ctx)
+for s, qs, ql in ((0, 0, 1), (1, 1, 5), (2, 6, 9)):
+    err = float(jnp.max(jnp.abs(
+        out[qs:qs + ql].astype(jnp.float32)
+        - ref[qs:qs + ql].astype(jnp.float32))))
+    assert err < 5e-2, ("numeric mismatch", s, err)
+print("PROOF_OK")
+""",
     "quant_matmul": _REQUIRE_TPU + """
 import numpy as np, jax, jax.numpy as jnp
 from paddle_tpu.ops.pallas.quant_matmul import int8_matmul, quantize_weight
@@ -219,12 +274,14 @@ def _fa_kernel_id() -> str:
 
 def bench_kernels(mode: str):
     """Kernel ids a bench mode must prove before spawning its child."""
+    serving = [_fa_kernel_id(), "paged_attention", "ragged_paged_attention"]
+    if os.environ.get("BENCH_KV_DTYPE", "").lower() == "int8":
+        serving += ["paged_attention_int8", "ragged_paged_attention_int8"]
     return {
         "resnet": [],
         "llama": [_fa_kernel_id()],
         "llama_decode": [_fa_kernel_id(), "paged_attention"],
-        "serving": [_fa_kernel_id(), "paged_attention",
-                    "ragged_paged_attention"],
+        "serving": serving,
         "data": [],
     }.get(mode, [])
 

@@ -47,7 +47,9 @@ def test_observability_catalog():
 def test_serving_program_budget():
     """Compiled-program guard: a mixed prefill+decode load stays inside
     the ragged scheduler's declared token-bucket family (no per-request
-    shapes / unbounded recompiles) and exercises both token kinds."""
+    shapes / unbounded recompiles) and exercises both token kinds; the
+    speculative pass proves verify spans (q_len = 1+k) stay inside the
+    SAME family — spec decode must not explode the program set."""
     from check_inventory import check_serving_programs
     violations = check_serving_programs(verbose=False)
     assert not violations, violations

@@ -1,0 +1,365 @@
+"""Speculative decoding (ISSUE 10): drafter tiers, the ragged verify
+path, SlotPagedKVCache.rollback lifecycle, seeded per-request sampling,
+and the acceptance bar — greedy speculative outputs bit-identical to
+plain greedy on a mixed workload (shared prefixes, staggered arrivals, a
+cancellation, a fleet disagg handoff) with measured acceptance > 0 and
+fewer target-model forwards than tokens generated."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic.tcp_kv import MemKVStore
+from paddle_tpu.inference import (ContinuousServingEngine, ServingRouter,
+                                  NGramDrafter, DraftModelDrafter,
+                                  make_drafter)
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.models.generation import SlotPagedKVCache
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(num_hidden_layers=2,
+                                       max_position_embeddings=256))
+
+
+def _oracle(model, p, n):
+    return np.asarray(model.generate(paddle.to_tensor(p),
+                                     max_new_tokens=n)._data)
+
+
+class _WrongDrafter:
+    """Adversarial drafter: always proposes tokens the target model will
+    reject (token+1 mod vocab of whatever greedy would say is wrong by
+    construction only probabilistically — so propose a constant garbage
+    run instead; greedy acceptance must reject and roll back, and the
+    output must not change)."""
+
+    def propose(self, history, k):
+        return [int(history[-1]) for _ in range(int(k))] if k > 0 else []
+
+
+# ---------------------------------------------------------------------------
+# drafter unit tier
+# ---------------------------------------------------------------------------
+
+def test_ngram_drafter_prompt_lookup():
+    d = NGramDrafter(max_ngram=3)
+    #          0  1  2  3  4  5  6  7  8
+    hist = [5, 6, 7, 9, 1, 5, 6, 7]      # trailing [5,6,7] recurs at 0..2
+    assert d.propose(hist, 3) == [9, 1, 5]
+    assert d.propose(hist, 1) == [9]
+    # no earlier occurrence of any trailing n-gram -> empty proposal
+    assert d.propose([1, 2, 3, 4], 3) == []
+    assert d.propose([7], 3) == []
+    assert d.propose(hist, 0) == []
+
+
+def test_ngram_drafter_backoff_and_recency():
+    d = NGramDrafter(max_ngram=3)
+    # trailing 3-gram unique, but trailing 1-gram [2] recurs twice: the
+    # MOST RECENT earlier occurrence (index 4) supplies the continuation
+    hist = [2, 9, 8, 7, 2, 3, 1, 2]
+    assert d.propose(hist, 2) == [3, 1]
+
+
+def test_draft_model_drafter_matches_target_greedy(model):
+    rng = np.random.RandomState(0)
+    p = rng.randint(0, 128, 12).astype(np.int64)
+    d = DraftModelDrafter(model, window=64)
+    drafts = d.propose(p, 3)
+    want = _oracle(model, p[None], 3)[0, -3:]
+    np.testing.assert_array_equal(np.asarray(drafts), want)
+
+
+def test_make_drafter_factory(model, monkeypatch):
+    assert isinstance(make_drafter(), NGramDrafter)
+    assert isinstance(make_drafter(draft_model=model), DraftModelDrafter)
+    monkeypatch.setenv("PADDLE_SPEC_NGRAM", "5")
+    assert make_drafter("ngram").max_ngram == 5
+    with pytest.raises(ValueError):
+        make_drafter("model")                # no draft model given
+    with pytest.raises(ValueError):
+        make_drafter("warp")
+
+
+# ---------------------------------------------------------------------------
+# rollback lifecycle: refcounts, COW-shared pages, registered pages
+# ---------------------------------------------------------------------------
+
+def test_rollback_frees_private_pages():
+    c = SlotPagedKVCache(2, page_size=4, max_len=32)
+    c._ensure_blocks(0, 10)                  # 3 blocks
+    c.lens[0] = 10
+    free0 = c.free_page_count
+    assert c.rollback(0, 5) == 5             # keep 5 tokens -> 2 blocks
+    assert int(c.lens[0]) == 5
+    assert int(c._n_blocks[0]) == 2
+    assert c.free_page_count == free0 + 1    # block 2 went back
+    assert c._tables[0, 2] == 0
+    assert c.rollbacks == 1 and c.tokens_rolled_back == 5
+    # zero/negative is a no-op; beyond the context raises
+    assert c.rollback(0, 0) == 0
+    with pytest.raises(ValueError):
+        c.rollback(0, 6)
+
+
+def test_rollback_keeps_cow_shared_page():
+    c = SlotPagedKVCache(2, page_size=4, max_len=32)
+    c._ensure_blocks(0, 8)                   # slot 0 owns 2 pages
+    c.lens[0] = 8
+    shared = int(c._tables[0, 1])
+    c._tables[1, 0] = shared                 # slot 1 aliases block 1
+    c._ref[shared] += 1
+    c._n_blocks[1] = 1
+    c.lens[1] = 4
+    c.rollback(0, 5)                         # truncates past the share
+    assert c._ref[shared] == 1               # slot 1's ref survives
+    assert int(c._tables[1, 0]) == shared
+    assert shared not in c._free
+
+
+def test_rollback_keeps_prefix_registered_page():
+    c = SlotPagedKVCache(2, page_size=4, max_len=32)
+    c._ensure_blocks(0, 8)
+    c.lens[0] = 8
+    page = int(c._tables[0, 1])
+    digest = b"\x01" * 20
+    c._index[digest] = page                  # register block 1
+    c._page_digest[page] = digest
+    c._ref[page] += 1                        # the index's own ref
+    free0 = c.free_page_count
+    c.rollback(0, 8)                         # truncate the whole slot
+    # the registered page stays alive under the index's ref...
+    assert c._ref[page] == 1
+    assert c._index[digest] == page
+    assert c.free_page_count == free0 + 1    # only block 0 was freed
+    # ...and remains evictable through the normal LRU path
+    assert c._evict_lru()
+    assert page in c._free
+
+
+# ---------------------------------------------------------------------------
+# engine: spec requires ragged; env knobs
+# ---------------------------------------------------------------------------
+
+def test_spec_requires_ragged_scheduler(model):
+    with pytest.raises(ValueError):
+        ContinuousServingEngine(model, spec_decode=True,
+                                enable_ragged=False)
+
+
+def test_spec_env_knobs(model, monkeypatch):
+    assert ContinuousServingEngine(model).enable_spec is False
+    monkeypatch.setenv("PADDLE_SPEC_DECODE", "1")
+    monkeypatch.setenv("PADDLE_SPEC_K", "2")
+    eng = ContinuousServingEngine(model)
+    assert eng.enable_spec is True and eng.spec_k == 2
+    assert isinstance(eng._drafter, NGramDrafter)
+    monkeypatch.setenv("PADDLE_SPEC_DRAFTER", "model")
+    with pytest.raises(ValueError):          # model tier needs a model
+        ContinuousServingEngine(model)
+    eng = ContinuousServingEngine(model, draft_model=model)
+    assert isinstance(eng._drafter, DraftModelDrafter)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: mixed workload bit-parity + fewer forwards than tokens
+# ---------------------------------------------------------------------------
+
+def _run_workload(model, prompts, new, **engine_kw):
+    eng = ContinuousServingEngine(
+        model, max_batch_size=4, max_len=96, page_size=16,
+        prefill_chunk_tokens=24, token_budget=32, **engine_kw)
+    results = [None] * len(prompts)
+    with eng:
+        results[0] = np.asarray(eng.generate(
+            prompts[0], max_new_tokens=new, timeout=300).numpy())
+
+        def call(i):
+            time.sleep(0.01 * i)             # staggered arrivals
+            results[i] = np.asarray(eng.generate(
+                prompts[i], max_new_tokens=new, timeout=300).numpy())
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(1, len(prompts))]
+        for t in threads:
+            t.start()
+        # one request that gives up while the engine is busy
+        with pytest.raises(TimeoutError):
+            eng.generate(prompts[0], max_new_tokens=30, timeout=0.001)
+        for t in threads:
+            t.join()
+        deadline = time.time() + 60
+        while eng.cancelled_rows < 1 and time.time() < deadline:
+            time.sleep(0.01)
+    assert eng.cancelled_rows >= 1
+    return results, eng
+
+
+def test_spec_mixed_workload_bit_identical_and_fewer_forwards(model):
+    """The PR's acceptance bar: 8 requests with shared prefixes,
+    staggered arrivals and a timeout cancellation — greedy outputs with
+    speculative decoding ON (self-draft tier-2 drafter, acceptance ~1)
+    bit-identical to PADDLE_SPEC_DECODE=0 plain greedy, with measured
+    acceptance > 0 and fewer target-model forwards than tokens
+    generated, asserted via the engine/telemetry counters."""
+    from paddle_tpu.profiler import metrics
+
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, 128, 48)
+    specs = [3, 9, 5, 14, 7, 4, 11, 6]
+    prompts = [np.concatenate([shared, rng.randint(0, 128, t)])
+               .astype(np.int64)[None] for t in specs]
+    new = 8
+
+    got_off, eng_off = _run_workload(model, prompts, new)
+    got_on, eng_on = _run_workload(model, prompts, new, spec_decode=True,
+                                   spec_k=3, draft_model=model)
+    for a, b in zip(got_on, got_off):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(got_on[0], _oracle(model, prompts[0],
+                                                     new))
+    # acceptance rate > 0, and the accepted drafts shrank the number of
+    # target forwards below one-per-token
+    tokens = len(prompts) * new
+    assert eng_on.spec_drafted_tokens > 0
+    assert eng_on.spec_accepted_tokens > 0
+    rate = eng_on.spec_accepted_tokens / eng_on.spec_drafted_tokens
+    assert rate > 0.9                        # self-draft: near-total
+    assert eng_on.ragged_steps < eng_off.ragged_steps
+    assert eng_on.decode_steps < tokens      # forwards < tokens generated
+    assert eng_on.decode_steps < eng_off.decode_steps
+    # telemetry counters carry the same story
+    snap = metrics()["paddle_spec_tokens_total"]["series"]
+    assert snap.get("drafted", 0) >= eng_on.spec_drafted_tokens
+    assert snap.get("accepted", 0) >= eng_on.spec_accepted_tokens
+    # prefix cache still worked under spec decode
+    assert eng_on._cache.prefix_hits > 0
+
+
+def test_spec_rejections_roll_back_and_stay_correct(model):
+    """A drafter that is always wrong costs speed, never text: every
+    draft is rejected, every rejection rolls back, outputs match."""
+    rng = np.random.RandomState(1)
+    p = rng.randint(0, 128, (1, 20)).astype(np.int64)
+    want = _oracle(model, p, 6)
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=64,
+                                  token_budget=16, spec_decode=True,
+                                  spec_k=3, drafter=_WrongDrafter())
+    with eng:
+        got = np.asarray(eng.generate(p, max_new_tokens=6,
+                                      timeout=300).numpy())
+    np.testing.assert_array_equal(got, want)
+    assert eng.spec_drafted_tokens > 0
+    assert eng._cache.rollbacks > 0
+    assert eng._cache.tokens_rolled_back >= eng.spec_drafted_tokens \
+        - eng.spec_accepted_tokens
+
+
+def test_spec_ngram_drafter_bit_identical(model):
+    """The model-free tier: whatever the n-gram drafter proposes (hit or
+    miss), greedy output is bit-identical to spec-off. The prompt is a
+    permutation of the whole vocab, so EVERY generated token has a
+    1-gram match and the drafter provably fires."""
+    rng = np.random.RandomState(2)
+    p = rng.permutation(128).astype(np.int64)[None]
+    want = _oracle(model, p, 6)
+    eng = ContinuousServingEngine(model, max_batch_size=2, max_len=160,
+                                  token_budget=32, spec_decode=True,
+                                  spec_k=4)
+    assert isinstance(eng._drafter, NGramDrafter)
+    with eng:
+        got = np.asarray(eng.generate(p, max_new_tokens=6,
+                                      timeout=300).numpy())
+    np.testing.assert_array_equal(got, want)
+    assert eng.spec_drafted_tokens > 0       # full-vocab prompt: 1-gram hit
+
+
+# ---------------------------------------------------------------------------
+# seeded per-request sampling (satellite): reproducible + spec-exact
+# ---------------------------------------------------------------------------
+
+def test_seeded_sampling_reproducible(model):
+    rng = np.random.RandomState(3)
+    p = rng.randint(0, 128, (1, 16)).astype(np.int64)
+
+    def run(seed, **kw):
+        eng = ContinuousServingEngine(model, max_batch_size=2, max_len=64,
+                                      token_budget=16, **kw)
+        with eng:
+            return np.asarray(eng.generate(
+                p, max_new_tokens=8, do_sample=True, temperature=1.3,
+                seed=seed, timeout=300).numpy())
+
+    a, b = run(7), run(7)
+    np.testing.assert_array_equal(a, b)      # same seed -> same text
+    assert not np.array_equal(a, run(8))     # different seed diverges
+    # legacy scheduler derives the identical per-token keys
+    np.testing.assert_array_equal(a, run(7, enable_ragged=False))
+
+
+def test_seeded_sampling_spec_verification_exact(model):
+    """Sampled speculative decode with a seed is exact: the per-token
+    key depends only on the token INDEX, so verification reproduces the
+    very draw plain sampled decode would have made."""
+    rng = np.random.RandomState(4)
+    p = rng.randint(0, 128, (1, 16)).astype(np.int64)
+
+    def run(**kw):
+        eng = ContinuousServingEngine(model, max_batch_size=2, max_len=64,
+                                      token_budget=16, **kw)
+        with eng:
+            out = np.asarray(eng.generate(
+                p, max_new_tokens=8, do_sample=True, temperature=1.3,
+                seed=11, timeout=300).numpy())
+        return out, eng
+
+    off, _ = run()
+    on, eng = run(spec_decode=True, spec_k=3, draft_model=model)
+    np.testing.assert_array_equal(on, off)
+    assert eng.spec_drafted_tokens > 0
+
+
+def test_generation_mixin_seed(model):
+    rng = np.random.RandomState(5)
+    p = paddle.to_tensor(rng.randint(0, 128, (2, 10)).astype(np.int64))
+    a = np.asarray(model.generate(p, max_new_tokens=6, do_sample=True,
+                                  seed=3)._data)
+    b = np.asarray(model.generate(p, max_new_tokens=6, do_sample=True,
+                                  seed=3)._data)
+    np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# fleet composition: disagg handoff with spec decode on
+# ---------------------------------------------------------------------------
+
+def test_spec_fleet_disagg_handoff_parity(model):
+    """Speculative decoding composes with the disaggregated fleet: the
+    prefill replica never decodes (max_new=1 leaves no draft room), the
+    decode replica speculates over imported pages, and outputs stay
+    bit-identical to the plain single-engine oracle."""
+    rng = np.random.RandomState(6)
+    shared = rng.randint(0, 128, 32)
+    prompts = [np.concatenate([shared, rng.randint(0, 128, t)])
+               .astype(np.int64)[None] for t in (4, 7, 5)]
+    want = [_oracle(model, p, 4) for p in prompts]
+    router = ServingRouter(
+        model, num_replicas=2, disagg=True, store=MemKVStore(),
+        heartbeat_ttl=600.0,
+        engine_kwargs=dict(max_batch_size=2, max_len=96,
+                           spec_decode=True, spec_k=3,
+                           draft_model=model))
+    with router:
+        results = [np.asarray(router.generate(
+            p, max_new_tokens=4, timeout=600).numpy()) for p in prompts]
+        pre, dec = router.replicas
+        assert pre.engine.decode_steps == 0
+        assert dec.engine._cache.pages_imported > 0
+        assert dec.engine.spec_accepted_tokens > 0
+    for g, w in zip(results, want):
+        np.testing.assert_array_equal(g, w)

@@ -311,6 +311,18 @@ INVENTORY = [
     ("Request-flow chrome merge (flow events)",
      "paddle_tpu.profiler.flight_recorder",
      ["merge_chrome_traces"]),
+    # -- speculative decoding + int8 KV pages (ISSUE 10) ---------------------
+    ("Speculative decoding (drafter tiers + verify path)",
+     "paddle_tpu.inference.speculative",
+     ["NGramDrafter", "DraftModelDrafter", "make_drafter",
+      "DEFAULT_SPEC_K"]),
+    ("Slot-paged KV rollback + int8 page codec",
+     "paddle_tpu.models.generation",
+     ["SlotPagedKVCache", "quantize_kv_rows", "dequantize_kv_rows",
+      "kv_page_nbytes"]),
+    ("Quantized paged-attention gather tiers",
+     "paddle_tpu.ops.pallas.ragged_paged_attention",
+     ["ragged_paged_attention"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -387,7 +399,10 @@ def check_serving_programs(verbose=True):
     any forward ran a shape outside the engine's declared token-bucket
     family — per-request shapes mean unbounded recompiles in production.
     Also proves both token kinds actually flowed through the single
-    ragged program family. Returns a list of violation strings."""
+    ragged program family, and (second pass) that speculative-decode
+    verify spans (q_len = 1 + k drafted tokens) stay inside the SAME
+    declared family — spec decode must not explode the compiled-program
+    set. Returns a list of violation strings."""
     import threading
 
     import numpy as np
@@ -401,17 +416,21 @@ def check_serving_programs(verbose=True):
     # deliberately awkward prompt lengths: none is a bucket size
     prompts = [rng.randint(0, 128, (1, n)).astype(np.int64)
                for n in (13, 3, 21)]
+
+    def drive(eng, reqs, new_tokens=3):
+        with eng:
+            threads = [threading.Thread(
+                target=lambda p=p: eng.generate(p, max_new_tokens=new_tokens,
+                                                timeout=300))
+                for p in reqs]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
     eng = ContinuousServingEngine(model, max_batch_size=2, max_len=48,
                                   token_budget=16, prefill_chunk_tokens=16)
-    with eng:
-        threads = [threading.Thread(
-            target=lambda p=p: eng.generate(p, max_new_tokens=3,
-                                            timeout=300))
-            for p in prompts]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+    drive(eng, prompts)
     declared = eng.declared_token_buckets()
     violations = []
     stray = eng.ragged_buckets_used - declared
@@ -425,13 +444,31 @@ def check_serving_programs(verbose=True):
         violations.append(
             f"ragged program family missed a token kind: prefill="
             f"{eng.ragged_prefill_tokens} decode={eng.ragged_decode_tokens}")
+    # speculative pass: self-draft (acceptance ~1) maximizes verify-span
+    # lengths, the worst case for bucket growth
+    spec = ContinuousServingEngine(model, max_batch_size=2, max_len=48,
+                                   token_budget=16, prefill_chunk_tokens=16,
+                                   spec_decode=True, spec_k=3,
+                                   draft_model=model)
+    drive(spec, prompts[:2], new_tokens=6)
+    spec_stray = spec.ragged_buckets_used - spec.declared_token_buckets()
+    if spec_stray:
+        violations.append(
+            f"speculative verify spans ran shapes outside the declared "
+            f"bucket set: {sorted(spec_stray)} "
+            f"(declared {sorted(spec.declared_token_buckets())})")
+    if not spec.spec_drafted_tokens:
+        violations.append("speculative pass drafted no tokens")
     if verbose:
         for v in violations:
             print(f"FAIL {v}")
         print(f"serving programs: {len(eng.ragged_buckets_used)} bucket(s) "
               f"{sorted(eng.ragged_buckets_used)} within declared "
               f"{sorted(declared)}; prefill={eng.ragged_prefill_tokens} "
-              f"decode={eng.ragged_decode_tokens} tokens")
+              f"decode={eng.ragged_decode_tokens} tokens; spec buckets "
+              f"{sorted(spec.ragged_buckets_used)} drafted="
+              f"{spec.spec_drafted_tokens} accepted="
+              f"{spec.spec_accepted_tokens}")
     return violations
 
 
@@ -483,16 +520,20 @@ def check_fleet_knobs(verbose=True):
 
 
 def check_observability_catalog(verbose=True):
-    """Request-trace/SLO inventory guard: every ``paddle_request_*`` /
-    ``paddle_slo_*`` metric name and every ``PADDLE_SLO_*`` /
-    ``PADDLE_REQUEST_TRACE*`` env knob referenced in ``paddle_tpu/``
-    must be cataloged in docs/OBSERVABILITY.md — the request-tracing
-    layer exists so operators can SEE; an uncataloged signal defeats it.
-    Returns a list of violation strings."""
+    """Request-trace/SLO/spec-decode inventory guard: every
+    ``paddle_request_*`` / ``paddle_slo_*`` / ``paddle_spec_*`` metric
+    name and every ``PADDLE_SLO_*`` / ``PADDLE_REQUEST_TRACE*`` /
+    ``PADDLE_SPEC_*`` / ``PADDLE_KV_*`` env knob referenced in
+    ``paddle_tpu/`` must be cataloged in docs/OBSERVABILITY.md (knobs
+    may live in any docs/*.md via check_env_docs, but the metric names
+    must be in the catalog) — these layers exist so operators can SEE;
+    an uncataloged signal defeats it. Returns a list of violation
+    strings."""
     import re
 
     root = os.path.join(os.path.dirname(__file__), "..")
-    metric_pat = re.compile(r"paddle_(?:request|slo)_[a-z0-9_]*[a-z0-9]")
+    metric_pat = re.compile(
+        r"paddle_(?:request|slo|spec)_[a-z0-9_]*[a-z0-9]")
     knob_pat = re.compile(
         r"PADDLE_(?:SLO|REQUEST_TRACE)[A-Z0-9_]*")
     metrics, knobs = set(), set()
