@@ -1160,13 +1160,18 @@ def bench_fleet():
     # fed the request-trace SLO monitor (TTFT measured at the ROUTER,
     # queue wait and per-token gaps from the engine spans)
     slo = rt.slo_report()
+    replay_rep = _bench_fleet_replay(model, sys_len, tail, new)
     for name, val in (
             ("fleet_affinity_ttft_speedup", speedup),
             ("fleet_affinity_cached_tokens", aff["cached_tokens"]),
             ("fleet_rr_cached_tokens", rr["cached_tokens"]),
             ("fleet_p95_ttft_ms", round(slo["ttft"]["p95_s"] * 1e3, 2)),
             ("fleet_p95_tpot_ms", round(slo["tpot"]["p95_s"] * 1e3, 2)),
-            ("fleet_goodput_ratio", round(slo["goodput_ratio"], 3))):
+            ("fleet_goodput_ratio", round(slo["goodput_ratio"], 3)),
+            ("fleet_goodput_under_burst",
+             replay_rep.get("goodput_under_burst")),
+            ("fleet_time_to_recover_s",
+             replay_rep.get("time_to_recover_s"))):
         print(json.dumps({"aux_metric": name, "value": val}),
               file=sys.stderr)
     return {
@@ -1187,9 +1192,68 @@ def bench_fleet():
         "cached_tokens_round_robin": rr["cached_tokens"],
         "affinity_hit_rate": round(
             aff["affinity_hits"] / max(aff["affinity_matchable"], 1), 3),
+        "replay": replay_rep,
         "config": {"requests": n_req, "sys_prompt": sys_len, "tail": tail,
                    "new_tokens": new, "replicas": 2},
     }
+
+
+def _bench_fleet_replay(model, sys_len, tail, new):
+    """Seeded bursty replay against a fresh 2-replica fleet: the
+    goodput-under-burst / time-to-recover measurement rig (ISSUE 11;
+    ROADMAP 4's controller gets judged by exactly these numbers). SLO
+    TTFT target is adaptive — 2x a measured warm-path request — so the
+    burst (not host speed) decides the violation story."""
+    import numpy as np
+    from paddle_tpu.distributed.fleet.elastic.tcp_kv import MemKVStore
+    from paddle_tpu.inference import ServingRouter
+    from paddle_tpu.inference.fleet import replay as rp
+    from paddle_tpu.profiler import alerts, request_trace as rt
+    from paddle_tpu.profiler import timeseries
+
+    seed = int(os.environ.get("BENCH_REPLAY_SEED", "11"))
+    duration = float(os.environ.get("BENCH_REPLAY_DURATION_S", "6"))
+    trace = rp.make_trace(
+        preset="bursty", seed=seed, duration_s=duration, rate_rps=0.7,
+        burst_factor=float(os.environ.get("BENCH_REPLAY_BURST", "10")),
+        burst_start_frac=0.35, burst_dur_frac=0.2,
+        prompt_len=(8, min(sys_len, 24)), new_tokens=(2, max(new // 2, 2)))
+    router = ServingRouter(
+        model, num_replicas=2, store=MemKVStore(), heartbeat_ttl=600.0,
+        engine_kwargs=dict(max_batch_size=2,
+                           max_len=sys_len + tail + new + 16))
+    hist = timeseries.MetricsHistory(capacity=4096)
+    engine = alerts.AlertEngine(history=hist)
+    engine.add_rule(alerts.BurnRateRule(
+        budget=0.2, fast_window_s=1.5, slow_window_s=4.5, factor=1.0))
+    engine.attach(hist)
+    old_ttft = os.environ.get("PADDLE_SLO_TTFT_MS")
+    try:
+        with router:
+            warm = np.arange(16, dtype=np.int64)[None]
+            router.generate(warm, max_new_tokens=2, timeout=1800)
+            t0 = time.perf_counter()
+            router.generate(warm + 16, max_new_tokens=2, timeout=1800)
+            warm_s = time.perf_counter() - t0
+            os.environ["PADDLE_SLO_TTFT_MS"] = str(
+                round(max(2.0 * warm_s, 0.2) * 1e3, 1))
+            rt.reset_slo_monitor()
+            harness = rp.ReplayHarness(
+                router, trace, vocab_size=256, history=hist,
+                alert_engine=engine, tick_interval_s=0.25,
+                recover_window_s=1.5, budget=0.2, factor=1.0)
+            rep = harness.run().as_dict()
+    finally:
+        if old_ttft is None:
+            os.environ.pop("PADDLE_SLO_TTFT_MS", None)
+        else:
+            os.environ["PADDLE_SLO_TTFT_MS"] = old_ttft
+        rt.reset_slo_monitor()
+    keep = ("preset", "seed", "schedule_digest", "requests", "ok",
+            "statuses", "goodput_under_burst", "p99_ttft_under_burst_s",
+            "p99_latency_s", "time_to_recover_s", "burst_requests",
+            "burst_ok", "alerts")
+    return {k: rep.get(k) for k in keep if k in rep}
 
 
 # --------------------------------------------------------------------------

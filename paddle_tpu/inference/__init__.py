@@ -225,4 +225,5 @@ from .serving import ServingEngine, ContinuousServingEngine  # noqa: E402,F401
 from .speculative import (NGramDrafter, DraftModelDrafter,   # noqa: E402,F401
                           make_drafter)
 from .fleet import (ServingRouter, Rejected,                 # noqa: E402,F401
-                    TenantQuotaManager, ROUTER_POLICIES)
+                    TenantQuotaManager, ROUTER_POLICIES,
+                    ReplayHarness, ReplayTrace, make_trace)

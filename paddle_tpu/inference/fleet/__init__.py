@@ -5,6 +5,12 @@ fleet-wide per-tenant admission quotas, and replica health/drain/rejoin
 from .quota import Rejected, TenantQuotaManager                  # noqa: F401
 from .router import (DEFAULT_FLEET_AFFINITY, ROUTER_POLICIES,    # noqa: F401
                      Replica, ServingRouter)
+from .replay import (REPLAY_PRESETS, ReplayHarness, ReplayReport,  # noqa: F401
+                     ReplayRequest, ReplayTrace, load_trace,
+                     make_trace, time_to_recover)
 
 __all__ = ["ServingRouter", "Replica", "Rejected", "TenantQuotaManager",
-           "ROUTER_POLICIES", "DEFAULT_FLEET_AFFINITY"]
+           "ROUTER_POLICIES", "DEFAULT_FLEET_AFFINITY",
+           "ReplayHarness", "ReplayReport", "ReplayRequest",
+           "ReplayTrace", "REPLAY_PRESETS", "load_trace", "make_trace",
+           "time_to_recover"]

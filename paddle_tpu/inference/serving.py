@@ -87,6 +87,11 @@ def _telemetry():
                                 "tokens generated", labels=("engine",)),
             "qdepth": r.gauge("paddle_serving_queue_depth",
                               "requests waiting in the engine queue"),
+            "active_reqs": r.gauge(
+                "paddle_serving_active_requests",
+                "generate() calls currently in flight (queued or "
+                "decoding) — the live-load series the metric history "
+                "samples", labels=("engine",)),
             "active": r.gauge("paddle_serving_active_slots",
                               "continuous-scheduler slots decoding"),
             "free_slots": r.gauge("paddle_serving_free_slots",
@@ -310,6 +315,8 @@ class ServingEngine:
         tele = _telemetry()
         tele["requests"].inc(engine=self._ENGINE)
         self._inflight_reqs[id(req)] = req
+        tele["active_reqs"].set(len(self._inflight_reqs),
+                                engine=self._ENGINE)
         self._q.put(req)
         tele["qdepth"].set(self._q.qsize())
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -350,6 +357,8 @@ class ServingEngine:
             return Tensor(req.result)
         finally:
             self._inflight_reqs.pop(id(req), None)
+            tele["active_reqs"].set(len(self._inflight_reqs),
+                                    engine=self._ENGINE)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self):

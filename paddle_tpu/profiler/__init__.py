@@ -39,6 +39,15 @@ from .request_trace import (  # noqa: F401  (re-exported facade)
     timeline_to_chrome, get_slo_monitor, reset_slo_monitor, slo_report,
     cost_table, get_trace_store,
 )
+from . import timeseries  # noqa: F401
+from .timeseries import (  # noqa: F401  (re-exported facade)
+    MetricsHistory, get_history, history, history_tick,
+)
+from . import alerts  # noqa: F401
+from .alerts import (  # noqa: F401  (re-exported facade)
+    AlertEngine, AlertRule, ThresholdRule, BurnRateRule,
+    get_alert_engine, active_alerts,
+)
 
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
@@ -54,6 +63,9 @@ __all__ = [
     "finish_request", "request_timeline", "recent_timelines",
     "timeline_to_chrome", "get_slo_monitor", "reset_slo_monitor",
     "slo_report", "cost_table", "get_trace_store",
+    "MetricsHistory", "get_history", "history", "history_tick",
+    "AlertEngine", "AlertRule", "ThresholdRule", "BurnRateRule",
+    "get_alert_engine", "active_alerts",
 ]
 
 

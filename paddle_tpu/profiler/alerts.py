@@ -1,0 +1,431 @@
+"""Alert rules over the metric history: static thresholds + multi-window
+SLO burn-rate alerting (ISSUE 11 — the "should a pager fire" half of the
+fleet load observatory).
+
+Rules evaluate against :class:`~.timeseries.MetricsHistory` (never the
+instantaneous registry — an alert is a statement about a *window*, not a
+moment):
+
+* :class:`ThresholdRule` — fire when a series' latest sample (held for
+  ``for_s`` seconds, optional) sits above/below a bound. The classic
+  "replicas_alive < 2" page.
+* :class:`BurnRateRule` — Prometheus-style multi-window SLO burn rate
+  over the :class:`~.request_trace.SLOMonitor` counters
+  (``paddle_slo_violations_total`` / ``paddle_slo_goodput_total``):
+  ``burn = (violations / total) / budget`` computed over a **fast**
+  window (1x base — catches the burst quickly) AND a **slow** window
+  (N x base — keeps one noisy request from paging); the rule fires only
+  when both exceed ``factor``. Fast-window-only also *clears* quickly
+  once the burst drains, which is what makes time-to-recover
+  measurable.
+
+Firing / clearing transitions land in three places at once: a
+flight-recorder event (``kind="alert"``), the
+``paddle_alerts_total{rule,severity}`` counter +
+``paddle_alert_active{rule}`` gauge, and the ``alerts`` state provider
+captured into every watchdog dump.
+
+Rules register programmatically (:meth:`AlertEngine.add_rule`) or via
+the ``PADDLE_ALERT_RULES`` env grammar — ``;``-separated
+``kind:key=value,...`` directives, same shape as ``PADDLE_FAULT_PLAN``::
+
+    PADDLE_ALERT_RULES="threshold:metric=paddle_fleet_replicas_alive,below=2,severity=page"
+    PADDLE_ALERT_RULES="burn_rate:slo=request,budget=0.1,fast=30,slow=120,factor=1.0"
+
+The global engine hooks itself onto the history's tick observers, so
+rules evaluate on the exact sample timeline — deterministic under
+``tick(now=)`` in tests. Everything here is stdlib-only.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = [
+    "AlertRule", "ThresholdRule", "BurnRateRule", "AlertEngine",
+    "parse_rules", "get_alert_engine", "reset_alert_engine",
+    "active_alerts", "DEFAULT_SLO_BUDGET",
+]
+
+#: default SLO error budget (fraction of requests allowed to violate)
+DEFAULT_SLO_BUDGET = 0.05
+
+_SEVERITIES = ("info", "warn", "page")
+
+
+class AlertRule:
+    """Base rule: a named predicate over the history. Subclasses
+    implement :meth:`value` (the measured quantity) and
+    :meth:`breached` (is the condition met at ``now``)."""
+
+    kind = "rule"
+
+    def __init__(self, name, severity="warn"):
+        self.name = str(name)
+        if severity not in _SEVERITIES:
+            raise ValueError(f"unknown severity {severity!r} "
+                             f"(one of {'/'.join(_SEVERITIES)})")
+        self.severity = severity
+
+    def value(self, history, now):          # pragma: no cover - interface
+        raise NotImplementedError
+
+    def breached(self, history, now) -> bool:   # pragma: no cover
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {"name": self.name, "kind": self.kind,
+                "severity": self.severity}
+
+
+class ThresholdRule(AlertRule):
+    """Fire when the latest sample of ``metric{labels}`` is ``above``
+    (strictly greater) or ``below`` (strictly less) the bound, and has
+    been for at least ``for_s`` seconds (every sample in the trailing
+    ``for_s`` window must breach — one blip does not page)."""
+
+    kind = "threshold"
+
+    def __init__(self, name=None, metric=None, labels="", above=None,
+                 below=None, for_s=0.0, severity="warn"):
+        if metric is None:
+            raise ValueError("ThresholdRule needs metric=")
+        if (above is None) == (below is None):
+            raise ValueError("ThresholdRule needs exactly one of "
+                             "above= / below=")
+        super().__init__(name or f"threshold_{metric}", severity=severity)
+        self.metric = str(metric)
+        self.labels = labels
+        self.above = None if above is None else float(above)
+        self.below = None if below is None else float(below)
+        self.for_s = float(for_s)
+
+    def _breach(self, v) -> bool:
+        if self.above is not None:
+            return v > self.above
+        return v < self.below
+
+    def value(self, history, now):
+        p = history.latest(self.metric, self.labels)
+        return p[1] if p else None
+
+    def breached(self, history, now) -> bool:
+        pts = history.points(self.metric, self.labels)
+        if not pts:
+            return False
+        if self.for_s <= 0:
+            return self._breach(pts[-1][1])
+        lo = now - self.for_s
+        window = [(t, v) for t, v in pts if t >= lo]
+        if not window or window[0][0] > lo + 1e-9:
+            # the condition must be OBSERVED across the whole hold
+            # window; too-young series (or a gap) cannot page yet
+            return False
+        return all(self._breach(v) for _, v in window)
+
+    def describe(self) -> dict:
+        d = super().describe()
+        d.update(metric=self.metric, labels=str(self.labels),
+                 above=self.above, below=self.below, for_s=self.for_s)
+        return d
+
+
+class BurnRateRule(AlertRule):
+    """Multi-window SLO burn rate (Prometheus SRE-workbook style).
+
+    ``burn(window) = (bad / (bad + good)) / budget`` where bad/good are
+    reset-aware counter increases of ``bad_metric{slo}`` /
+    ``good_metric{slo}`` over the window. Fires when **both** the fast
+    window (``fast_window_s``) and the slow window (``slow_window_s``,
+    conventionally N x fast) burn at >= ``factor``; windows with no
+    traffic burn 0. ``factor=1`` means "violations are eating budget
+    exactly at the rate that exhausts it"."""
+
+    kind = "burn_rate"
+
+    def __init__(self, name=None, slo="request", budget=None,
+                 fast_window_s=60.0, slow_window_s=300.0, factor=1.0,
+                 severity="page",
+                 good_metric="paddle_slo_goodput_total",
+                 bad_metric="paddle_slo_violations_total"):
+        super().__init__(name or f"slo_burn_{slo}", severity=severity)
+        self.slo = str(slo)
+        if budget is None:
+            budget = DEFAULT_SLO_BUDGET
+        self.budget = float(budget)
+        if not (0.0 < self.budget <= 1.0):
+            raise ValueError(f"budget must be in (0, 1], got {budget}")
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        if self.slow_window_s < self.fast_window_s:
+            raise ValueError("slow_window_s must be >= fast_window_s")
+        self.factor = float(factor)
+        self.good_metric = good_metric
+        self.bad_metric = bad_metric
+
+    def burn(self, history, window_s, now) -> float:
+        bad = history.increase(self.bad_metric, self.slo,
+                               window_s=window_s, now=now)
+        good = history.increase(self.good_metric, self.slo,
+                                window_s=window_s, now=now)
+        total = bad + good
+        if total <= 0:
+            return 0.0
+        return (bad / total) / self.budget
+
+    def value(self, history, now):
+        return self.burn(history, self.fast_window_s, now)
+
+    def breached(self, history, now) -> bool:
+        return (self.burn(history, self.fast_window_s, now) >= self.factor
+                and self.burn(history, self.slow_window_s, now)
+                >= self.factor)
+
+    def describe(self) -> dict:
+        d = super().describe()
+        d.update(slo=self.slo, budget=self.budget,
+                 fast_window_s=self.fast_window_s,
+                 slow_window_s=self.slow_window_s, factor=self.factor)
+        return d
+
+
+# ---------------------------------------------------------------------------
+# env grammar (PADDLE_ALERT_RULES — same directive shape as the
+# PADDLE_FAULT_PLAN grammar from PR 6)
+# ---------------------------------------------------------------------------
+
+_RULE_KINDS = {"threshold": ThresholdRule, "burn_rate": BurnRateRule}
+
+#: grammar key -> constructor kwarg (+ coercion)
+_KEY_MAP = {
+    "threshold": {"metric": str, "labels": str, "above": float,
+                  "below": float, "for": ("for_s", float),
+                  "name": str, "severity": str},
+    "burn_rate": {"slo": str, "budget": float, "fast": ("fast_window_s",
+                                                        float),
+                  "slow": ("slow_window_s", float), "factor": float,
+                  "name": str, "severity": str},
+}
+
+
+def parse_rules(spec: str) -> list:
+    """Parse the ``PADDLE_ALERT_RULES`` grammar into rule objects."""
+    rules = []
+    for directive in str(spec).split(";"):
+        directive = directive.strip()
+        if not directive:
+            continue
+        kind, _, rest = directive.partition(":")
+        kind = kind.strip()
+        cls = _RULE_KINDS.get(kind)
+        if cls is None:
+            raise ValueError(
+                f"unknown alert rule kind {kind!r} in {directive!r} "
+                f"(one of {'/'.join(sorted(_RULE_KINDS))})")
+        kwargs = {}
+        for pair in filter(None, (p.strip() for p in rest.split(","))):
+            k, _, v = pair.partition("=")
+            k = k.strip()
+            mapping = _KEY_MAP[kind].get(k)
+            if mapping is None:
+                raise ValueError(f"unknown key {k!r} for alert rule "
+                                 f"{kind!r} (in {directive!r})")
+            if isinstance(mapping, tuple):
+                dest, coerce = mapping
+            else:
+                dest, coerce = k, mapping
+            kwargs[dest] = coerce(v.strip())
+        rules.append(cls(**kwargs))
+    return rules
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+class AlertEngine:
+    """Holds the rules, tracks firing state, and emits the transitions.
+
+    ``evaluate(now=)`` runs every rule; an inactive rule whose condition
+    breaches becomes *active* (counter tick + gauge 1 + flight event),
+    an active rule whose condition clears becomes *inactive* (gauge 0 +
+    flight event). The engine hooks itself onto a history's tick
+    observers (:meth:`attach`) so evaluation rides the sample timeline.
+    """
+
+    def __init__(self, history=None, rules=None):
+        self._history = history
+        self._lock = threading.RLock()
+        self.rules: dict = {}             # name -> rule
+        self.active: dict = {}            # name -> {since, severity, value}
+        self.transitions: list = []       # bounded recent fire/clear log
+        self._tele = None
+        self._attached = None
+        for r in rules or ():
+            self.add_rule(r)
+
+    def _telemetry(self):
+        if self._tele is None:
+            from .telemetry import get_registry
+            r = get_registry()
+            self._tele = {
+                "fired": r.counter(
+                    "paddle_alerts_total",
+                    "alert rule firings (active transitions)",
+                    labels=("rule", "severity")),
+                "active": r.gauge(
+                    "paddle_alert_active",
+                    "1 while the rule's condition holds, else 0",
+                    labels=("rule",)),
+            }
+        return self._tele
+
+    def history(self):
+        if self._history is None:
+            from .timeseries import get_history
+            self._history = get_history()
+        return self._history
+
+    # -- rule management -----------------------------------------------------
+    def add_rule(self, rule) -> AlertRule:
+        with self._lock:
+            self.rules[rule.name] = rule
+        # the gauge exists (at 0) from registration, not first firing —
+        # dashboards can tell "healthy" from "never evaluated"
+        self._telemetry()["active"].set(0, rule=rule.name)
+        return rule
+
+    def add_rules(self, spec_or_rules) -> list:
+        rules = (parse_rules(spec_or_rules)
+                 if isinstance(spec_or_rules, str) else list(spec_or_rules))
+        return [self.add_rule(r) for r in rules]
+
+    def remove_rule(self, name):
+        with self._lock:
+            self.rules.pop(str(name), None)
+            self.active.pop(str(name), None)
+
+    # -- evaluation ----------------------------------------------------------
+    def evaluate(self, now=None) -> list:
+        """Evaluate every rule at ``now``; returns the transitions made
+        (``[{rule, action, value, t}, ...]``)."""
+        h = self.history()
+        now = h.now() if now is None else float(now)
+        tele = self._telemetry()
+        out = []
+        with self._lock:
+            rules = list(self.rules.values())
+        for rule in rules:
+            try:
+                breached = rule.breached(h, now)
+                val = rule.value(h, now)
+            except Exception:      # a broken rule must not kill the tick
+                continue
+            with self._lock:
+                was = rule.name in self.active
+                if breached and not was:
+                    self.active[rule.name] = {
+                        "since": now, "severity": rule.severity,
+                        "value": val, "rule": rule.describe()}
+                    tr = {"rule": rule.name, "action": "fired",
+                          "severity": rule.severity, "value": val,
+                          "t": now, "wall": time.time()}
+                elif not breached and was:
+                    ent = self.active.pop(rule.name)
+                    tr = {"rule": rule.name, "action": "cleared",
+                          "severity": rule.severity, "value": val,
+                          "t": now, "wall": time.time(),
+                          "active_s": now - ent["since"]}
+                else:
+                    if was:
+                        self.active[rule.name]["value"] = val
+                    continue
+                self.transitions.append(tr)
+                del self.transitions[:-64]
+            out.append(tr)
+            if tr["action"] == "fired":
+                tele["fired"].inc(rule=rule.name, severity=rule.severity)
+                tele["active"].set(1, rule=rule.name)
+            else:
+                tele["active"].set(0, rule=rule.name)
+            from . import flight_recorder
+            flight_recorder.record_event(
+                "alert", rule=rule.name, action=tr["action"],
+                severity=rule.severity,
+                value=None if val is None else float(val))
+        return out
+
+    def _on_tick(self, history, now):
+        self.evaluate(now=now)
+
+    def attach(self, history=None):
+        """Evaluate on every history tick (idempotent)."""
+        h = history if history is not None else self.history()
+        self._history = h
+        if self._attached is not h:
+            h.add_tick_observer(self._on_tick)
+            self._attached = h
+        return self
+
+    def detach(self):
+        if self._attached is not None:
+            self._attached.remove_tick_observer(self._on_tick)
+            self._attached = None
+
+    # -- observability -------------------------------------------------------
+    def state(self) -> dict:
+        """The ``alerts`` state-provider payload (watchdog dumps and
+        the fleet console)."""
+        with self._lock:
+            return {
+                "rules": [r.describe() for r in self.rules.values()],
+                "active": {n: dict(e) for n, e in self.active.items()},
+                "recent_transitions": list(self.transitions[-16:]),
+            }
+
+
+_ENGINE: "AlertEngine | None" = None
+_ENGINE_LOCK = threading.Lock()
+
+
+def get_alert_engine() -> AlertEngine:
+    """The process-global engine: attached to the global history,
+    seeded from ``PADDLE_ALERT_RULES`` (if set), and registered as the
+    ``alerts`` state provider so active alerts ride into every
+    watchdog dump."""
+    global _ENGINE
+    if _ENGINE is None:
+        with _ENGINE_LOCK:
+            if _ENGINE is None:
+                eng = AlertEngine()
+                spec = os.environ.get("PADDLE_ALERT_RULES")
+                if spec:
+                    eng.add_rules(spec)
+                eng.attach()
+                from . import flight_recorder
+                flight_recorder.register_state_provider(
+                    "alerts", eng.state)
+                _ENGINE = eng
+    return _ENGINE
+
+
+def reset_alert_engine() -> None:
+    """Drop the global engine (tests / between jobs)."""
+    global _ENGINE
+    with _ENGINE_LOCK:
+        if _ENGINE is not None:
+            _ENGINE.detach()
+            from . import flight_recorder
+            flight_recorder.unregister_state_provider("alerts")
+            _ENGINE = None
+
+
+def active_alerts() -> dict:
+    """``paddle.profiler.active_alerts()`` — {rule: entry} currently
+    firing (empty when no engine was ever built)."""
+    if _ENGINE is None:
+        return {}
+    with _ENGINE._lock:
+        return {n: dict(e) for n, e in _ENGINE.active.items()}

@@ -71,7 +71,9 @@ class _Family:
 
     def __init__(self, name, help, labels, lock):
         self.name = name
-        self.help = help
+        # real Prometheus scrapers warn on empty HELP text — an
+        # undescribed family self-documents with its own name
+        self.help = help or name
         self.label_names = tuple(labels)
         self._lock = lock
         self._children = {}
@@ -342,15 +344,33 @@ class MetricRegistry:
         Multi-process safe: the whole line goes down in a single
         ``os.write`` on an ``O_APPEND`` fd, so concurrent ranks
         appending to one file (bench_telemetry.jsonl) can interleave
-        only whole lines, never partial ones."""
+        only whole lines, never partial ones.
+
+        Size-capped: when the file would grow past
+        ``PADDLE_TELEMETRY_JSONL_MAX_MB`` (default 16, ``0`` disables),
+        it first rotates to ``<path>.1`` (atomic ``os.replace``,
+        clobbering the previous rotation) — an append-forever snapshot
+        file must not eat the disk across bench runs."""
         rec = {"unix_time": time.time(), "metrics": self.collect()}
         if extra:
             rec.update(extra)
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
+        line = (json.dumps(rec) + "\n").encode()
+        try:
+            max_mb = float(os.environ.get("PADDLE_TELEMETRY_JSONL_MAX_MB",
+                                          "16"))
+        except ValueError:
+            max_mb = 16.0
+        if max_mb > 0:
+            try:
+                if os.path.getsize(path) + len(line) > max_mb * (1 << 20):
+                    os.replace(path, f"{path}.1")
+            except OSError:
+                pass               # no file yet / raced: append fresh
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
         try:
-            os.write(fd, (json.dumps(rec) + "\n").encode())
+            os.write(fd, line)
         finally:
             os.close(fd)
         return rec

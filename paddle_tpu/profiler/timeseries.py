@@ -1,0 +1,466 @@
+"""Metric time-series: bounded history of the process-global registry
+(ISSUE 11 — the sensing half of ROADMAP 4's autoscaling control plane).
+
+The :class:`~.telemetry.MetricRegistry` is a point-in-time snapshot: it
+can say *what the gauges read now*, never *how they moved through the
+burst*. :class:`MetricsHistory` closes that gap — it samples the
+registry on a background interval (``PADDLE_HISTORY_INTERVAL_S``) or on
+an explicit, deterministic :meth:`~MetricsHistory.tick` (``tick(now=)``
+in tests and replay harnesses), keeping a bounded ring of
+``(timestamp, value)`` points per labeled series:
+
+* counters / gauges sample their value; histograms expand to three
+  derived series (``:count``, ``:sum``, ``:p95``) so both rate-style and
+  latency-style questions have a timeline;
+* :meth:`~MetricsHistory.rate` computes counter increase-per-second over
+  a window with Prometheus-style **reset detection** (a process restart
+  mid-history yields the post-restart increase, never a huge negative
+  rate);
+* :meth:`~MetricsHistory.window` gives min / mean / max / exact-p95 over
+  the points inside a time window — the primitive the alert rules
+  (:mod:`.alerts`) and the replay report
+  (``inference/fleet/replay.py``) are built on;
+* :meth:`~MetricsHistory.export_jsonl` writes a self-describing JSONL
+  file ``tools/fleet_console.py`` renders without importing jax, and
+  :meth:`~MetricsHistory.to_chrome` emits chrome **counter tracks**
+  (``ph:"C"``) that ``flight_recorder.merge_chrome_traces`` folds into
+  the per-rank trace view as one more lane.
+
+Same zero-overhead discipline as the flight recorder: the module gate
+(:func:`is_enabled`) is a plain bool, and the wired call site
+(:func:`history_tick`) returns immediately when it is off.
+``PADDLE_HISTORY=1`` enables at import (and starts the background
+sampler unless ``PADDLE_HISTORY_INTERVAL_S=0``);
+``PADDLE_HISTORY_CAPACITY`` bounds the ring (points per series,
+default 512). Everything here is stdlib-only.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "MetricsHistory", "get_history", "history", "history_tick",
+    "enable", "disable", "is_enabled", "reset",
+    "HISTORY_SCHEMA", "DEFAULT_HISTORY_CAPACITY",
+    "DEFAULT_HISTORY_INTERVAL_S",
+]
+
+HISTORY_SCHEMA = "paddle_history/1"
+DEFAULT_HISTORY_CAPACITY = 512
+DEFAULT_HISTORY_INTERVAL_S = 1.0
+
+_ENABLED = False
+_HISTORY: "MetricsHistory | None" = None
+_MODULE_LOCK = threading.Lock()
+
+
+def _env_truthy(v) -> bool:
+    return v not in (None, "", "0", "false", "False", "no")
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+class _Series:
+    """One labeled series: a bounded ring of (t, value) points."""
+
+    __slots__ = ("name", "key", "kind", "label_names", "points", "dropped")
+
+    def __init__(self, name, key, kind, label_names, capacity):
+        self.name = name
+        self.key = key                    # the collect() label-value key
+        self.kind = kind                  # counter | gauge | derived
+        self.label_names = list(label_names)
+        self.points: deque = deque(maxlen=capacity)
+        self.dropped = 0                  # ring evictions (capacity hits)
+
+    def append(self, t, v):
+        if len(self.points) == self.points.maxlen:
+            self.dropped += 1
+        self.points.append((t, float(v)))
+
+    @property
+    def display(self):
+        return f"{self.name}{{{self.key}}}" if self.key else self.name
+
+
+class MetricsHistory:
+    """Sampler + query surface over the process-global metric registry.
+
+    h = MetricsHistory()
+    h.tick()                       # one deterministic snapshot
+    h.start()                      # or: background sampling
+    h.rate("paddle_slo_violations_total", labels="request", window_s=30)
+    h.window("paddle_fleet_replica_queue_depth", labels="r0", window_s=10)
+    """
+
+    def __init__(self, capacity=None, interval_s=None, registry=None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("PADDLE_HISTORY_CAPACITY",
+                                              str(DEFAULT_HISTORY_CAPACITY)))
+            except ValueError:
+                capacity = DEFAULT_HISTORY_CAPACITY
+        self.capacity = max(int(capacity), 8)
+        self.interval_s = (interval_s if interval_s is not None
+                           else _env_float("PADDLE_HISTORY_INTERVAL_S",
+                                           DEFAULT_HISTORY_INTERVAL_S))
+        self._registry = registry
+        self._lock = threading.RLock()
+        self._series: dict = {}           # (name, key) -> _Series
+        self._ticks = 0
+        self._last_tick_t = None
+        self._wall_offset = time.time() - time.monotonic()
+        self._observers: list = []        # fn(history, now) after each tick
+        self._stop = threading.Event()
+        self._thread = None
+        self._tele = None
+
+    # -- internals -----------------------------------------------------------
+    def _reg(self):
+        if self._registry is None:
+            from .telemetry import get_registry
+            self._registry = get_registry()
+        return self._registry
+
+    def _telemetry(self):
+        if self._tele is None:
+            r = self._reg()
+            self._tele = {
+                "samples": r.counter(
+                    "paddle_history_samples_total",
+                    "history sampler ticks taken"),
+                "series": r.gauge(
+                    "paddle_history_series",
+                    "distinct labeled series tracked in the history"),
+                "evicted": r.counter(
+                    "paddle_history_points_evicted_total",
+                    "points aged out of full series rings"),
+            }
+        return self._tele
+
+    @staticmethod
+    def now() -> float:
+        """The history clock (monotonic). Replay harnesses and alert
+        rules share it so window math lines up exactly."""
+        return time.monotonic()
+
+    # -- sampling ------------------------------------------------------------
+    def tick(self, now=None) -> int:
+        """Take one snapshot of the registry; every series gains one
+        point stamped ``now`` (the history clock unless given — tests
+        and replay harnesses pass explicit times for determinism).
+        Returns the number of series updated."""
+        now = self.now() if now is None else float(now)
+        snap = self._reg().collect()
+        updated = 0
+        evicted_before = 0
+        with self._lock:
+            for s in self._series.values():
+                evicted_before += s.dropped
+            for name, fam in snap.items():
+                kind = fam.get("type", "untyped")
+                label_names = fam.get("label_names", [])
+                for key, val in fam.get("series", {}).items():
+                    if kind == "histogram":
+                        for suffix, v in (
+                                (":count", val.get("count", 0)),
+                                (":sum", val.get("sum", 0.0)),
+                                (":p95", val.get("p95", 0.0))):
+                            self._append_locked(
+                                name + suffix, key,
+                                "counter" if suffix != ":p95" else "derived",
+                                label_names, now, v)
+                            updated += 1
+                    else:
+                        self._append_locked(name, key, kind, label_names,
+                                            now, val)
+                        updated += 1
+            self._ticks += 1
+            self._last_tick_t = now
+            n_series = len(self._series)
+            evicted_after = sum(s.dropped for s in self._series.values())
+        tele = self._telemetry()
+        tele["samples"].inc()
+        tele["series"].set(n_series)
+        if evicted_after > evicted_before:
+            tele["evicted"].inc(evicted_after - evicted_before)
+        for fn in list(self._observers):
+            try:
+                fn(self, now)
+            except Exception:      # an observer must never kill the sampler
+                pass
+        return updated
+
+    def _append_locked(self, name, key, kind, label_names, now, v):
+        sk = (name, key)
+        s = self._series.get(sk)
+        if s is None:
+            s = self._series[sk] = _Series(name, key, kind, label_names,
+                                           self.capacity)
+        s.append(now, v)
+
+    def add_tick_observer(self, fn):
+        """``fn(history, now)`` runs after every tick — the alert engine
+        hooks here so rules evaluate on the exact tick timeline."""
+        if fn not in self._observers:
+            self._observers.append(fn)
+
+    def remove_tick_observer(self, fn):
+        if fn in self._observers:
+            self._observers.remove(fn)
+
+    # -- background sampler --------------------------------------------------
+    def start(self, interval_s=None):
+        """Start the background sampling thread (no-op if running)."""
+        if interval_s is not None:
+            self.interval_s = float(interval_s)
+        if self.interval_s <= 0:
+            return self
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="paddle-history-sampler")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception:      # sampling must never crash the process
+                pass
+
+    # -- read side -----------------------------------------------------------
+    def series_names(self) -> list:
+        with self._lock:
+            return sorted(s.display for s in self._series.values())
+
+    def _find(self, name, labels=""):
+        key = (",".join(str(labels[n]) for n in labels)
+               if isinstance(labels, dict) else str(labels))
+        with self._lock:
+            s = self._series.get((name, key))
+            if s is None and isinstance(labels, dict):
+                # dict labels: match by value set against the label order
+                for (n, k), cand in self._series.items():
+                    if n == name and set(k.split(",")) == set(
+                            str(v) for v in labels.values()):
+                        s = cand
+                        break
+        return s
+
+    def points(self, name, labels="") -> list:
+        """The raw ``[(t, value), ...]`` ring for one series (oldest
+        first; empty when the series was never sampled)."""
+        s = self._find(name, labels)
+        if s is None:
+            return []
+        with self._lock:
+            return list(s.points)
+
+    def _window_points(self, name, labels, window_s, now):
+        pts = self.points(name, labels)
+        if not pts:
+            return []
+        if window_s is None:
+            return pts
+        now = pts[-1][0] if now is None else float(now)
+        lo = now - float(window_s)
+        return [(t, v) for t, v in pts if lo <= t <= now]
+
+    def window(self, name, labels="", window_s=None, now=None) -> dict:
+        """min / mean / max / exact-p95 over the points inside the
+        window (``window_s=None`` = the whole ring; ``now`` defaults to
+        the newest point)."""
+        pts = self._window_points(name, labels, window_s, now)
+        if not pts:
+            return {"count": 0, "min": 0.0, "mean": 0.0, "max": 0.0,
+                    "p95": 0.0, "t_first": None, "t_last": None}
+        vals = sorted(v for _, v in pts)
+        k95 = max(0, min(len(vals) - 1,
+                         int(round(0.95 * (len(vals) - 1)))))
+        return {
+            "count": len(pts),
+            "min": vals[0],
+            "mean": sum(vals) / len(vals),
+            "max": vals[-1],
+            "p95": vals[k95],
+            "t_first": pts[0][0],
+            "t_last": pts[-1][0],
+        }
+
+    def rate(self, name, labels="", window_s=None, now=None) -> float:
+        """Counter increase per second over the window, reset-aware: a
+        decrease between consecutive points means the counter restarted
+        (process restart mid-history), so the post-reset value counts
+        as increase-from-zero instead of poisoning the rate with a huge
+        negative delta (the Prometheus ``rate()`` convention)."""
+        pts = self._window_points(name, labels, window_s, now)
+        if len(pts) < 2:
+            return 0.0
+        increase = 0.0
+        for (_, a), (_, b) in zip(pts, pts[1:]):
+            increase += (b - a) if b >= a else b
+        dt = pts[-1][0] - pts[0][0]
+        return increase / dt if dt > 0 else 0.0
+
+    def increase(self, name, labels="", window_s=None, now=None) -> float:
+        """Reset-aware counter increase over the window (the rate's
+        numerator — burn-rate rules use this directly)."""
+        pts = self._window_points(name, labels, window_s, now)
+        if len(pts) < 2:
+            return 0.0
+        inc = 0.0
+        for (_, a), (_, b) in zip(pts, pts[1:]):
+            inc += (b - a) if b >= a else b
+        return inc
+
+    def latest(self, name, labels="") -> "tuple | None":
+        pts = self.points(name, labels)
+        return pts[-1] if pts else None
+
+    @property
+    def ticks(self):
+        return self._ticks
+
+    def clear(self):
+        with self._lock:
+            self._series.clear()
+            self._ticks = 0
+            self._last_tick_t = None
+
+    # -- exports -------------------------------------------------------------
+    def export_jsonl(self, path) -> int:
+        """Write the whole history as self-describing JSONL: one header
+        record (schema, tick count, wall-clock offset so consumers can
+        map monotonic t to wall time) then one record per series.
+        Write-temp-then-replace: a concurrent reader (the fleet console
+        tailing mid-replay) never sees a torn file. Returns the series
+        count."""
+        with self._lock:
+            series = [
+                {"name": s.name, "labels": s.key,
+                 "label_names": s.label_names, "kind": s.kind,
+                 "dropped": s.dropped,
+                 "points": [[round(t, 6), v] for t, v in s.points]}
+                for s in self._series.values()
+            ]
+            header = {"schema": HISTORY_SCHEMA, "ticks": self._ticks,
+                      "capacity": self.capacity,
+                      "wall_offset": self._wall_offset,
+                      "unix_time": time.time()}
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for rec in sorted(series, key=lambda r: (r["name"],
+                                                     r["labels"])):
+                f.write(json.dumps(rec) + "\n")
+        os.replace(tmp, path)
+        return len(series)
+
+    def to_chrome(self, pid=None, match=None) -> dict:
+        """Chrome **counter-track** events (``ph:"C"``): each series
+        renders as a value-over-time track Perfetto draws next to the
+        span lanes. Feed the result to
+        ``flight_recorder.merge_chrome_traces`` as one more lane to see
+        metric movement against the per-rank / per-request timeline.
+        ``match=`` filters series by substring of the display name."""
+        pid = os.getpid() if pid is None else pid
+        events = []
+        with self._lock:
+            series = list(self._series.values())
+        for s in sorted(series, key=lambda x: (x.name, x.key)):
+            disp = s.display
+            if match and match not in disp:
+                continue
+            for t, v in s.points:
+                events.append({"name": disp, "ph": "C", "pid": pid,
+                               "tid": 0, "ts": round(t * 1e6, 3),
+                               "args": {"value": v}})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# module facade (zero overhead disabled — same pattern as flight_recorder)
+# ---------------------------------------------------------------------------
+
+
+def get_history() -> MetricsHistory:
+    global _HISTORY
+    if _HISTORY is None:
+        with _MODULE_LOCK:
+            if _HISTORY is None:
+                _HISTORY = MetricsHistory()
+    return _HISTORY
+
+
+def history() -> MetricsHistory:
+    """``paddle.profiler.history()`` — the process-global history."""
+    return get_history()
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def enable(interval_s=None, sampler=True) -> MetricsHistory:
+    """Turn the history on (and start the background sampler unless
+    ``sampler=False`` — replay harnesses and tests drive ``tick()``
+    themselves for determinism)."""
+    global _ENABLED
+    h = get_history()
+    _ENABLED = True
+    if sampler:
+        h.start(interval_s=interval_s)
+    elif interval_s is not None:
+        h.interval_s = float(interval_s)
+    return h
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+    with _MODULE_LOCK:
+        if _HISTORY is not None:
+            _HISTORY.stop()
+
+
+def reset():
+    """Drop the global history (tests / between jobs). Keeps the
+    enabled flag."""
+    global _HISTORY
+    with _MODULE_LOCK:
+        if _HISTORY is not None:
+            _HISTORY.stop()
+        _HISTORY = None
+
+
+def history_tick(now=None):
+    """The wired call site: one sample IF the layer is enabled (plain
+    bool check when off — the disabled path costs nothing)."""
+    if not _ENABLED:
+        return None
+    return get_history().tick(now=now)
+
+
+if _env_truthy(os.environ.get("PADDLE_HISTORY")):   # pragma: no cover
+    enable(sampler=_env_float("PADDLE_HISTORY_INTERVAL_S",
+                              DEFAULT_HISTORY_INTERVAL_S) > 0)

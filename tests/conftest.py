@@ -20,6 +20,8 @@ jax.config.update("jax_platforms", "cpu")
 # backend default — bf16 passes on TPU MXU)
 jax.config.update("jax_default_matmul_precision", "highest")
 
+import functools  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -30,6 +32,66 @@ def _seed_all():
     paddle.seed(102)
     np.random.seed(102)
     yield
+
+
+# ---------------------------------------------------------------------------
+# SPMD pipeline-engine guard: recent jax CPU builds reject the PartitionId
+# instruction under SPMD partitioning ("UNIMPLEMENTED: PartitionId
+# instruction is not supported for SPMD partitioning..."), which the
+# shard_map-based pipeline engine needs. That is a backend limitation, not
+# a pipeline bug — probe it ONCE and skip (with the backend's own reason)
+# the tests that require it, so tier-1 signal stays clean without touching
+# pipeline code paths. On backends where the probe passes (real TPU, older
+# jax CPU), the tests run unchanged.
+# ---------------------------------------------------------------------------
+
+_SPMD_PIPELINE_PROBE = {"done": False, "ok": True, "reason": ""}
+
+
+def spmd_pipeline_supported():
+    """True when a minimal jitted `pipeline_forward` program compiles on
+    this backend. Cached for the process; any failure OTHER than the
+    known unsupported-instruction condition counts as supported so real
+    regressions still surface in the tests themselves."""
+    p = _SPMD_PIPELINE_PROBE
+    if not p["done"]:
+        p["done"] = True
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import mesh as mesh_mod
+        from paddle_tpu.distributed.engine import pipeline_forward
+
+        def _stage(params, x):
+            return x * params
+
+        try:
+            mesh_mod.init_mesh({"dp": 2, "pp": 4})
+            ws = jnp.ones((4, 1), jnp.float32)
+            micro = jnp.ones((4, 1, 1), jnp.float32)
+            jax.jit(lambda w, x: pipeline_forward(_stage, w, x))(
+                ws, micro)
+        except Exception as e:  # noqa: BLE001 — classified below
+            msg = str(e)
+            if "PartitionId" in msg or ("SPMD" in msg
+                                        and "UNIMPLEMENTED" in msg):
+                p["ok"] = False
+                p["reason"] = msg.splitlines()[0][:200]
+        finally:
+            mesh_mod.reset_mesh()
+    return p["ok"]
+
+
+def requires_spmd_pipeline(fn):
+    """Decorator for tests that run the SPMD pipeline engine: skip at
+    run time (probe evaluated lazily, once) when the backend cannot
+    partition it."""
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not spmd_pipeline_supported():
+            pytest.skip("SPMD pipeline engine unsupported on this "
+                        f"backend: {_SPMD_PIPELINE_PROBE['reason']}")
+        return fn(*args, **kwargs)
+    return wrapper
 
 
 # ---------------------------------------------------------------------------

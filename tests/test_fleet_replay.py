@@ -1,0 +1,354 @@
+"""Workload replay harness + fleet console (ISSUE 11).
+
+Unit tier: seeded trace generation is bit-reproducible across all
+presets, JSONL round-trips, time_to_recover is a pure function with the
+"sustained to end of observation" semantics, env knob defaults.
+
+Acceptance: a seeded 10x bursty replay against a 2-replica fleet fires
+the SLO burn-rate alert during the overload episode and clears it
+after; ReplayReport.time_to_recover_s agrees exactly with the first
+post-burst compliant window recomputed from ``profiler.history()``; a
+second trace from the same seed is bit-identical and the report is a
+pure recompute. The console renders the exported history without jax.
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic.tcp_kv import MemKVStore
+from paddle_tpu.inference import ServingRouter
+from paddle_tpu.inference.fleet import replay
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.profiler import alerts, request_trace as rt
+from paddle_tpu.profiler.telemetry import MetricRegistry
+from paddle_tpu.profiler.timeseries import MetricsHistory
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+# ---------------------------------------------------------------------------
+# trace generation
+# ---------------------------------------------------------------------------
+
+def test_trace_presets_deterministic(tmp_path):
+    assert replay.REPLAY_PRESETS == ("poisson", "bursty", "diurnal",
+                                     "adversarial")
+    for preset in replay.REPLAY_PRESETS:
+        a = replay.make_trace(preset=preset, seed=42, duration_s=5.0,
+                              rate_rps=1.5)
+        b = replay.make_trace(preset=preset, seed=42, duration_s=5.0,
+                              rate_rps=1.5)
+        assert a.digest() == b.digest(), preset
+        assert a.to_jsonl() == b.to_jsonl(), preset
+        c = replay.make_trace(preset=preset, seed=43, duration_s=5.0,
+                              rate_rps=1.5)
+        assert a.digest() != c.digest(), preset
+        assert len(a) > 0
+        assert all(0 <= r.t < 5.0 for r in a)
+        # arrival order is sorted; every request carries its own seed
+        ts = [r.t for r in a]
+        assert ts == sorted(ts)
+        # JSONL round-trip is identity on the canonical form
+        path = tmp_path / f"{preset}.jsonl"
+        a.to_jsonl(str(path))
+        back = replay.load_trace(str(path))
+        assert back.digest() == a.digest()
+        assert back.preset == preset and back.seed == 42
+    bursty = replay.make_trace(preset="bursty", seed=1, duration_s=10.0,
+                               rate_rps=1.0, burst_factor=10.0,
+                               burst_start_frac=0.4, burst_dur_frac=0.2)
+    b0, b1 = bursty.burst_window()
+    assert (b0, b1) == (pytest.approx(4.0), pytest.approx(6.0))
+    in_burst = sum(1 for r in bursty if b0 <= r.t < b1)
+    out_burst = len(bursty) - in_burst
+    assert in_burst > out_burst, "10x window must dominate arrivals"
+    adv = replay.make_trace(preset="adversarial", seed=1, duration_s=10.0,
+                            rate_rps=1.0, tenants=("hog", "fair"))
+    a0, a1 = adv.burst_window()
+    flood = [r for r in adv if r.t <= a1]
+    assert all(r.tenant == "hog" for r in flood)
+    assert all(r.prompt_len == 48 for r in flood)   # max length flood
+    assert replay.make_trace(preset="poisson", seed=0,
+                             duration_s=4.0).burst_window() is None
+    with pytest.raises(ValueError):
+        replay.make_trace(preset="wat", seed=0)
+    with pytest.raises(ValueError):
+        replay.load_trace('{"schema": "nope"}')
+
+
+def test_replay_env_knob_defaults(monkeypatch):
+    monkeypatch.setenv("PADDLE_REPLAY_PRESET", "bursty")
+    monkeypatch.setenv("PADDLE_REPLAY_SEED", "7")
+    tr = replay.make_trace(duration_s=4.0, rate_rps=1.0)
+    assert tr.preset == "bursty" and tr.seed == 7
+    assert tr.digest() == replay.make_trace(
+        preset="bursty", seed=7, duration_s=4.0, rate_rps=1.0).digest()
+    monkeypatch.setenv("PADDLE_REPLAY_TIME_SCALE", "0.5")
+    h = replay.ReplayHarness(router=None, trace=tr,
+                             history=MetricsHistory(
+                                 registry=MetricRegistry()))
+    assert h.time_scale == 0.5
+
+
+# ---------------------------------------------------------------------------
+# time_to_recover (pure over a hand-built history)
+# ---------------------------------------------------------------------------
+
+def _slo_history():
+    reg = MetricRegistry()
+    bad = reg.counter("paddle_slo_violations_total", labels=("slo",))
+    good = reg.counter("paddle_slo_goodput_total", labels=("slo",))
+    return MetricsHistory(capacity=256, registry=reg), good, bad
+
+
+def test_time_to_recover_semantics():
+    h, good, bad = _slo_history()
+    # violations t=5..8, a quiet gap 9..10, violations again 11..12,
+    # then clean goodput: the quiet gap must NOT count as recovery
+    for t in range(20):
+        if 5 <= t <= 8 or 11 <= t <= 12:
+            bad.inc(slo="request")
+        elif t >= 13 or t < 5:
+            good.inc(slo="request")
+        h.tick(now=float(t))
+    ttr = replay.time_to_recover(h, burst_end=6.0, window_s=2.0,
+                                 budget=0.25, factor=1.0)
+    assert ttr is not None
+    # with a 2 s trailing window the last violation (t=12) stops
+    # polluting at t=15 — recovery must be after the second wave
+    assert 6.0 + ttr >= 13.0
+    recompute = replay.time_to_recover(h, burst_end=6.0, window_s=2.0,
+                                       budget=0.25, factor=1.0)
+    assert recompute == ttr                   # pure function
+    # still burning at the end of observation: no recovery claimed
+    h2, good2, bad2 = _slo_history()
+    for t in range(10):
+        bad2.inc(slo="request")
+        h2.tick(now=float(t))
+    assert replay.time_to_recover(h2, burst_end=2.0, window_s=2.0,
+                                  budget=0.25, factor=1.0) is None
+    # empty history: None, not a crash
+    h3, _, _ = _slo_history()
+    assert replay.time_to_recover(h3, burst_end=0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# acceptance: 2-replica fleet, seeded burst, alert + recovery
+# ---------------------------------------------------------------------------
+
+def test_replay_acceptance_burst_alert_recovery(monkeypatch):
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.profiler import timeseries as ts
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny(num_hidden_layers=1,
+                                        max_position_embeddings=256))
+    trace = replay.make_trace(
+        preset="bursty", seed=11, duration_s=6.0, rate_rps=0.7,
+        burst_factor=10.0, burst_start_frac=0.35, burst_dur_frac=0.2,
+        prompt_len=(8, 24), new_tokens=(2, 4))
+    # bit-reproducible schedule: a second generation from the same seed
+    # is byte-identical
+    again = replay.make_trace(
+        preset="bursty", seed=11, duration_s=6.0, rate_rps=0.7,
+        burst_factor=10.0, burst_start_frac=0.35, burst_dur_frac=0.2,
+        prompt_len=(8, 24), new_tokens=(2, 4))
+    assert again.to_jsonl() == trace.to_jsonl()
+    assert again.digest() == trace.digest()
+
+    router = ServingRouter(
+        model, num_replicas=2, store=MemKVStore(), heartbeat_ttl=600.0,
+        engine_kwargs=dict(max_batch_size=2, max_len=96, page_size=16,
+                           prefill_chunk_tokens=32))
+    ts.reset()                      # fresh GLOBAL history for this run
+    hist = profiler.history()
+    engine = alerts.AlertEngine(history=hist)
+    rule = engine.add_rule(alerts.BurnRateRule(
+        name="slo_burn", budget=0.2, fast_window_s=1.5,
+        slow_window_s=4.5, factor=1.0, severity="page"))
+    engine.attach(hist)
+    try:
+        with router:
+            # warm the compiled programs, then pick an adaptive TTFT
+            # target: 2x a warm sequential request — the burst's
+            # queueing (not host speed) decides the violation story
+            warm = np.arange(16, dtype=np.int64)[None]
+            router.generate(warm, max_new_tokens=2, timeout=600)
+            t0 = time.perf_counter()
+            router.generate(warm + 16, max_new_tokens=2, timeout=600)
+            warm_s = time.perf_counter() - t0
+            monkeypatch.setenv("PADDLE_SLO_TTFT_MS",
+                               str(round(max(2.0 * warm_s, 0.2) * 1e3, 1)))
+            rt.reset_slo_monitor()
+            harness = replay.ReplayHarness(
+                router, trace, vocab_size=128, history=hist,
+                alert_engine=engine, tick_interval_s=0.25,
+                recover_window_s=1.5, budget=0.2, factor=1.0)
+            report = harness.run()
+    finally:
+        engine.detach()
+        rt.reset_slo_monitor()
+    d = report.as_dict()
+    assert d["requests"] == len(trace)
+    assert d["statuses"].get("ok", 0) == len(trace), d["statuses"]
+    b0, b1 = d["burst_t"]
+
+    # the burn-rate alert fired during the overload episode...
+    fired = [t for t in d["alerts"]["transitions"]
+             if t["action"] == "fired"]
+    cleared = [t for t in d["alerts"]["transitions"]
+               if t["action"] == "cleared"]
+    assert fired, "burst never fired the SLO burn-rate alert"
+    assert d["time_to_recover_s"] is not None, "fleet never recovered"
+    episode_end = b1 + d["time_to_recover_s"]
+    assert b0 - harness.tick_interval_s <= fired[0]["t"] <= episode_end
+    # ...and cleared after it: nothing active at the end, last
+    # transition is a clear, at/after the measured recovery point
+    assert d["alerts"]["active"] == []
+    assert cleared and cleared[-1]["t"] >= fired[-1]["t"]
+
+    # time_to_recover agrees EXACTLY with the first post-burst
+    # compliant window recomputed from profiler.history()
+    recomputed = replay.time_to_recover(
+        profiler.history(), b1, window_s=1.5, budget=0.2, factor=1.0)
+    assert recomputed == d["time_to_recover_s"]
+
+    # burst measurements exist and the report is a pure recompute
+    assert d["burst_requests"] >= 5
+    assert d["goodput_under_burst"] is not None
+    assert d["p99_ttft_under_burst_s"] > 0
+    # per-replica state rides in the report (fleet console food)
+    assert set(d["replicas"]) == {"r0", "r1"}
+    # the report is a pure recompute over (results, history) — replica
+    # liveness is the one live snapshot field, so compare without it
+    # (the router is stopped by now)
+    d2 = harness.report().as_dict()
+    d2.pop("replicas"), d.pop("replicas")
+    assert d2 == d
+    # the history observed the load moving: the serving gauge series
+    # has points and a nonzero peak
+    w = profiler.history().window("paddle_serving_active_requests",
+                                  "continuous")
+    assert w["count"] > 0 and w["max"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# fleet console
+# ---------------------------------------------------------------------------
+
+def _load_console():
+    path = os.path.join(REPO, "tools", "fleet_console.py")
+    spec = importlib.util.spec_from_file_location("fleet_console_test",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _console_fixtures(tmp_path):
+    """A history export, a flight dump with alerts + replicas, and a
+    replay report file."""
+    reg = MetricRegistry()
+    c = reg.counter("paddle_slo_violations_total", labels=("slo",))
+    g = reg.gauge("paddle_serving_active_requests", labels=("engine",))
+    h = MetricsHistory(capacity=64, registry=reg)
+    for t in range(12):
+        c.inc(slo="request")
+        g.set(t % 5, engine="continuous")
+        h.tick(now=float(t))
+    hist_path = tmp_path / "hist.jsonl"
+    h.export_jsonl(str(hist_path))
+    dump = {
+        "schema": "paddle_flight_recorder/1", "rank": 0, "events": [],
+        "state": {
+            "alerts": {
+                "active": {"slo_burn": {"severity": "page",
+                                        "value": 5.0, "since": 3.0}},
+                "recent_transitions": [
+                    {"rule": "slo_burn", "action": "fired", "t": 3.0,
+                     "severity": "page", "value": 5.0}],
+            },
+            "serving_fleet_x": {
+                "replicas": {
+                    "r0": {"alive": True, "draining": False,
+                           "role": "mixed", "inflight": 2,
+                           "load_tokens": 64, "queue_depth": 1},
+                    "r1": {"alive": False, "draining": False,
+                           "role": "mixed", "inflight": 0,
+                           "load_tokens": 0, "queue_depth": 0},
+                }},
+        },
+    }
+    dump_path = tmp_path / "flight_rank0.json"
+    dump_path.write_text(json.dumps(dump))
+    report_path = tmp_path / "report.json"
+    report_path.write_text(json.dumps({
+        "schema": "paddle_replay_report/1", "preset": "bursty",
+        "seed": 11, "requests": 14, "ok": 14,
+        "goodput_under_burst": 0.2, "time_to_recover_s": 1.5,
+        "schedule_digest": "abc"}))
+    return hist_path, dump_path, report_path
+
+
+def test_fleet_console_text_and_html(tmp_path, capsys):
+    hist_path, dump_path, report_path = _console_fixtures(tmp_path)
+    con = _load_console()
+    rc = con.main([str(hist_path), str(dump_path), str(report_path)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "paddle_slo_violations_total{request}" in out
+    assert "rate " in out                       # counter renders a rate
+    assert "ACTIVE  slo_burn" in out
+    assert "r0" in out and "role=mixed" in out
+    assert "time_to_recover_s: 1.5" in out
+    # sparkline characters actually present
+    assert any(ch in out for ch in con.BLOCKS)
+    # --match filters series
+    rc = con.main(["--match", "active_requests", str(hist_path)])
+    out = capsys.readouterr().out
+    assert "paddle_serving_active_requests" in out
+    assert "paddle_slo_violations_total" not in out
+    # --html writes a self-contained page
+    html_path = tmp_path / "console.html"
+    rc = con.main(["--html", str(html_path), str(hist_path),
+                   str(dump_path), str(report_path)])
+    assert rc == 0
+    html = html_path.read_text()
+    assert html.startswith("<!doctype html>")
+    assert "slo_burn" in html and "replicas" in html
+    # nothing usable -> exit 2
+    junk = tmp_path / "junk.json"
+    junk.write_text('{"hello": 1}')
+    assert con.main([str(junk)]) == 2
+    capsys.readouterr()
+
+
+def test_fleet_console_no_jax_import(tmp_path):
+    """Same discipline as trace_merge.py: the console must run with jax
+    (and numpy) poisoned out of the interpreter — it renders files
+    scp'd off the fleet, on machines with no accelerator stack."""
+    hist_path, dump_path, _ = _console_fixtures(tmp_path)
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "sys.modules['numpy'] = None\n"
+        "sys.argv = ['fleet_console.py', %r, %r]\n"
+        "import runpy\n"
+        "try:\n"
+        "    runpy.run_path(%r, run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    raise SystemExit(e.code or 0)\n"
+        % (str(hist_path), str(dump_path),
+           os.path.join(REPO, "tools", "fleet_console.py")))
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert "paddle_slo_violations_total" in proc.stdout
+    assert "ACTIVE  slo_burn" in proc.stdout

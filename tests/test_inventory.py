@@ -44,6 +44,16 @@ def test_observability_catalog():
     assert not violations, violations
 
 
+def test_alert_catalog():
+    """Every PADDLE_HISTORY_*/PADDLE_ALERT_*/PADDLE_REPLAY_*/
+    PADDLE_TELEMETRY_* knob and paddle_history_*/paddle_alert* metric
+    is cataloged in docs/OBSERVABILITY.md AND exercised by a test, and
+    every replay preset appears in a test."""
+    from check_inventory import check_alert_catalog
+    violations = check_alert_catalog(verbose=False)
+    assert not violations, violations
+
+
 def test_serving_program_budget():
     """Compiled-program guard: a mixed prefill+decode load stays inside
     the ragged scheduler's declared token-bucket family (no per-request

@@ -6,6 +6,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.distributed import mesh as mesh_mod
 from paddle_tpu.distributed.engine import pipeline_forward
+from conftest import requires_spmd_pipeline
 
 
 def _stage_fn(params, x):
@@ -32,6 +33,7 @@ def _sequential(params, micro):
     return jnp.stack(out)
 
 
+@requires_spmd_pipeline
 def test_pipeline_forward_matches_sequential():
     mesh = mesh_mod.init_mesh({"dp": 2, "pp": 4})
     try:
@@ -45,6 +47,7 @@ def test_pipeline_forward_matches_sequential():
         mesh_mod.reset_mesh()
 
 
+@requires_spmd_pipeline
 def test_pipeline_grad_matches_sequential():
     mesh = mesh_mod.init_mesh({"pp": 4, "mp": 2})
     try:
@@ -79,6 +82,7 @@ def test_pipeline_single_stage_fallback():
         mesh_mod.reset_mesh()
 
 
+@requires_spmd_pipeline
 def test_pipeline_interleaved_vpp_matches_sequential():
     """VPP: 8 chunks over 4 devices (v=2) == sequential 8-layer net."""
     mesh = mesh_mod.init_mesh({"dp": 2, "pp": 4})
@@ -102,6 +106,7 @@ def test_pipeline_interleaved_vpp_matches_sequential():
         mesh_mod.reset_mesh()
 
 
+@requires_spmd_pipeline
 def test_pipeline_trains_with_dp_and_pp():
     """Composition: pp pipeline inside a jitted train step with dp-sharded
     microbatches staying replicated across pp — loss decreases."""

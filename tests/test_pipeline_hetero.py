@@ -14,6 +14,7 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.distributed import mesh as mesh_mod
 from paddle_tpu.distributed.engine import pipeline_forward_hetero
+from conftest import requires_spmd_pipeline
 
 
 def _mk(rng, i, o, extra=False):
@@ -56,6 +57,7 @@ def _seq(fns, ps, x):
 
 
 @pytest.mark.parametrize("sched", ["fthenb", "1f1b", "zb"])
+@requires_spmd_pipeline
 def test_hetero_stage_widths_parity(sched):
     fns, params, micro, g = _setup()
     o_ref = _seq(fns, params, micro)
@@ -75,6 +77,7 @@ def test_hetero_stage_widths_parity(sched):
         mesh_mod.reset_mesh()
 
 
+@requires_spmd_pipeline
 def test_hetero_layer_stages_parity():
     """A Pipe-style model built from REAL Layers with per-stage widths:
     embedding-ish widening stage, two different-width MLP stages, and a
@@ -117,6 +120,7 @@ def test_hetero_layer_stages_parity():
         mesh_mod.reset_mesh()
 
 
+@requires_spmd_pipeline
 def test_hetero_dropout_keys():
     """Stochastic hetero stages reproduce the sequential run given the
     same base key (per-(micro, stage) key threading)."""

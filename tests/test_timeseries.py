@@ -1,0 +1,428 @@
+"""Metric time-series + alert rules (ISSUE 11): deterministic tick
+sampling, counter-reset-aware rates, ring eviction, burn-rate window
+edges, the PADDLE_ALERT_RULES grammar, alert telemetry/dump wiring, and
+the two telemetry satellites (HELP/TYPE exposition defaults, JSONL
+rotation)."""
+import json
+import os
+import threading
+
+import pytest
+
+from paddle_tpu.profiler import alerts, timeseries
+from paddle_tpu.profiler.telemetry import MetricRegistry
+from paddle_tpu.profiler.timeseries import MetricsHistory
+
+
+def _slo_registry():
+    """A private registry with SLO-shaped counters the tests drive by
+    hand (the global registry stays untouched)."""
+    reg = MetricRegistry()
+    bad = reg.counter("paddle_slo_violations_total", labels=("slo",))
+    good = reg.counter("paddle_slo_goodput_total", labels=("slo",))
+    return reg, good, bad
+
+
+# ---------------------------------------------------------------------------
+# history sampling + queries
+# ---------------------------------------------------------------------------
+
+def test_tick_window_and_latest():
+    reg = MetricRegistry()
+    g = reg.gauge("load")
+    h = MetricsHistory(capacity=64, registry=reg)
+    for t, v in enumerate([1.0, 3.0, 9.0, 5.0, 7.0]):
+        g.set(v)
+        h.tick(now=float(t))
+    assert h.ticks == 5
+    assert h.latest("load") == (4.0, 7.0)
+    w = h.window("load", window_s=2.0, now=4.0)   # t in {2,3,4}
+    assert w["count"] == 3
+    assert w["min"] == 5.0 and w["max"] == 9.0
+    assert w["mean"] == pytest.approx(7.0)
+    full = h.window("load")
+    assert full["count"] == 5 and full["p95"] == 9.0
+    # never-sampled series answer empty, not raise
+    assert h.points("nope") == []
+    assert h.window("nope")["count"] == 0
+    assert h.rate("nope") == 0.0
+
+
+def test_counter_rate_and_reset_detection():
+    """A process restart mid-history (counter drops) must yield the
+    post-restart increase, never a huge negative rate."""
+    reg = MetricRegistry()
+    c = reg.counter("reqs")
+    h = MetricsHistory(capacity=64, registry=reg)
+    for t, total in enumerate([2, 5, 9, 12]):
+        c._default_child().value = float(total)
+        h.tick(now=float(t))
+    assert h.rate("reqs") == pytest.approx(10.0 / 3.0)
+    # restart: counter falls back to 1 then climbs again
+    for t, total in enumerate([1, 4], start=4):
+        c._default_child().value = float(total)
+        h.tick(now=float(t))
+    # increase = 10 (pre) + 1 (reset restart credit) + 3 = 14 over 5s
+    r = h.rate("reqs")
+    assert r == pytest.approx(14.0 / 5.0)
+    assert r > 0
+    assert h.increase("reqs") == pytest.approx(14.0)
+
+
+def test_ring_eviction_under_capacity():
+    reg = MetricRegistry()
+    g = reg.gauge("x")
+    h = MetricsHistory(capacity=8, registry=reg)
+    for t in range(20):
+        g.set(float(t))
+        h.tick(now=float(t))
+    pts = h.points("x")
+    assert len(pts) == 8                       # bounded
+    assert pts[0] == (12.0, 12.0)              # oldest evicted first
+    assert pts[-1] == (19.0, 19.0)
+    # eviction is observable: the per-series drop count and the
+    # registry counter both moved
+    s = h._find("x")
+    assert s.dropped == 12
+    assert reg.counter("paddle_history_points_evicted_total") \
+        ._default_child().value >= 12
+    assert reg.counter("paddle_history_samples_total") \
+        ._default_child().value == 20
+    assert reg.gauge("paddle_history_series")._default_child().value >= 1
+
+
+def test_histogram_expands_to_derived_series():
+    reg = MetricRegistry()
+    hist = reg.histogram("lat_seconds")
+    h = MetricsHistory(capacity=16, registry=reg)
+    for v in (0.01, 0.02, 0.04):
+        hist.observe(v)
+    h.tick(now=1.0)
+    assert h.latest("lat_seconds:count")[1] == 3
+    assert h.latest("lat_seconds:sum")[1] == pytest.approx(0.07)
+    assert h.latest("lat_seconds:p95")[1] > 0
+    names = h.series_names()
+    assert "lat_seconds:count" in names and "lat_seconds:p95" in names
+
+
+def test_history_env_knobs(monkeypatch):
+    monkeypatch.setenv("PADDLE_HISTORY_CAPACITY", "33")
+    monkeypatch.setenv("PADDLE_HISTORY_INTERVAL_S", "0.125")
+    h = MetricsHistory(registry=MetricRegistry())
+    assert h.capacity == 33
+    assert h.interval_s == 0.125
+
+
+def test_history_disabled_is_inert():
+    """PADDLE_HISTORY off (the default): the wired call site is a bool
+    check — no tick, and the global instance is not even built."""
+    was_enabled = timeseries._ENABLED
+    was_hist = timeseries._HISTORY
+    try:
+        timeseries._ENABLED = False
+        timeseries._HISTORY = None
+        assert timeseries.history_tick() is None
+        assert timeseries._HISTORY is None        # untouched when off
+        timeseries._ENABLED = True
+        assert timeseries.history_tick(now=1.0) is not None
+        assert timeseries._HISTORY is not None
+    finally:
+        timeseries._HISTORY = was_hist
+        timeseries._ENABLED = was_enabled
+
+
+def test_disabled_history_adds_no_step_cost():
+    """Overhead guard (the disabled half of the ISSUE 11 acceptance):
+    a step loop with the history machinery present-but-disabled must
+    show no measurable added per-step cost — same disabled-path guard
+    pattern (and bench machinery) as the flight recorder's."""
+    import numpy as np
+
+    import bench
+
+    was_enabled = timeseries._ENABLED
+    was_hist = timeseries._HISTORY
+    timeseries._ENABLED = False
+    timeseries._HISTORY = None
+    try:
+        x = np.random.default_rng(0).normal(size=200_000).astype(
+            np.float32)
+
+        def step():
+            return float(np.tanh(x).sum())
+
+        def gated_step():
+            timeseries.history_tick()      # the wired disabled-path call
+            return step()
+
+        pct = min(
+            bench._telemetry_overhead_pct(step, lambda r: None, steps=30,
+                                          instrumented_step=gated_step)
+            for _ in range(3))
+        assert pct < 10.0, f"disabled history costs {pct}% per step"
+        assert timeseries._HISTORY is None   # truly sampled nothing
+    finally:
+        timeseries._HISTORY = was_hist
+        timeseries._ENABLED = was_enabled
+
+
+def test_background_sampler_start_stop():
+    reg = MetricRegistry()
+    reg.gauge("g").set(1.0)
+    h = MetricsHistory(capacity=32, interval_s=0.01, registry=reg)
+    h.start()
+    try:
+        evt = threading.Event()
+        h.add_tick_observer(lambda hh, now: evt.set())
+        assert evt.wait(5.0)
+    finally:
+        h.stop()
+    assert h.ticks >= 1
+    assert len(h.points("g")) >= 1
+
+
+def test_export_jsonl_and_chrome_counter_tracks(tmp_path):
+    reg = MetricRegistry()
+    c = reg.counter("paddle_foo_total")
+    h = MetricsHistory(capacity=16, registry=reg)
+    for t in range(3):
+        c.inc()
+        h.tick(now=float(t))
+    path = tmp_path / "hist.jsonl"
+    n = h.export_jsonl(str(path))
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines[0]["schema"] == timeseries.HISTORY_SCHEMA
+    assert lines[0]["ticks"] == 3
+    recs = {r["name"]: r for r in lines[1:]}
+    assert len(recs) == n
+    assert recs["paddle_foo_total"]["kind"] == "counter"
+    assert [p[1] for p in recs["paddle_foo_total"]["points"]] == [1, 2, 3]
+    # chrome counter tracks merge into the per-rank trace flow
+    trace = h.to_chrome(pid="history")
+    assert all(e["ph"] == "C" for e in trace["traceEvents"])
+    from paddle_tpu.profiler.flight_recorder import merge_chrome_traces
+    merged = merge_chrome_traces({0: {"traceEvents": []},
+                                  "history": trace})
+    counters = [e for e in merged["traceEvents"] if e.get("ph") == "C"]
+    assert len(counters) >= 3
+    assert all(e["pid"] == "history" for e in counters)
+    # filtered export
+    assert h.to_chrome(match="no_such")["traceEvents"] == []
+
+
+# ---------------------------------------------------------------------------
+# alert rules
+# ---------------------------------------------------------------------------
+
+def test_threshold_rule_above_below_and_hold():
+    reg = MetricRegistry()
+    g = reg.gauge("paddle_fleet_replicas_alive")
+    h = MetricsHistory(capacity=32, registry=reg)
+    rule = alerts.ThresholdRule(metric="paddle_fleet_replicas_alive",
+                                below=2, severity="page")
+    eng = alerts.AlertEngine(history=h, rules=[rule])
+    g.set(3)
+    h.tick(now=0.0)
+    assert eng.evaluate(now=0.0) == []
+    g.set(1)
+    h.tick(now=1.0)
+    tr = eng.evaluate(now=1.0)
+    assert tr and tr[0]["action"] == "fired"
+    assert rule.name in eng.active
+    g.set(2)
+    h.tick(now=2.0)
+    assert eng.evaluate(now=2.0)[0]["action"] == "cleared"
+    assert not eng.active
+    # for_s hold: a single breaching blip must NOT page
+    hold = alerts.ThresholdRule(name="held", metric="q", above=5.0,
+                                for_s=2.0)
+    q = reg.gauge("q")
+    eng2 = alerts.AlertEngine(history=h, rules=[hold])
+    for t, v in enumerate([1.0, 9.0, 1.0, 9.0, 9.0, 9.0, 9.0]):
+        q.set(v)
+        h.tick(now=10.0 + t)
+        eng2.evaluate(now=10.0 + t)
+    # breaches only from t=13 on; hold window (2 s) satisfied at t=15
+    fires = [t for t in eng2.transitions if t["action"] == "fired"]
+    assert len(fires) == 1 and fires[0]["t"] == 15.0
+
+
+def test_burn_rate_fast_slow_window_edges():
+    """The multi-window contract at its edges: a violation burst must
+    breach BOTH windows to fire, and the fast window alone clearing
+    un-fires it while the slow window still burns."""
+    reg, good, bad = _slo_registry()
+    h = MetricsHistory(capacity=256, registry=reg)
+    rule = alerts.BurnRateRule(budget=0.25, fast_window_s=3.0,
+                               slow_window_s=9.0, factor=1.0)
+    eng = alerts.AlertEngine(history=h, rules=[rule])
+    # 0..9: pure goodput — burn 0 everywhere
+    for t in range(10):
+        good.inc(slo="request")
+        h.tick(now=float(t))
+        eng.evaluate(now=float(t))
+    assert not eng.active
+    # t=10,11: violations start — fast window breaches immediately but
+    # the slow window is still diluted by 8 good requests -> no fire
+    for t in (10, 11):
+        bad.inc(slo="request")
+        h.tick(now=float(t))
+        eng.evaluate(now=float(t))
+    assert rule.burn(h, 3.0, 11.0) >= 1.0
+    assert rule.burn(h, 9.0, 11.0) < 1.0
+    assert not eng.active, "fast-only breach must not page"
+    # keep violating: slow window crosses too -> fires
+    t_fired = None
+    for t in range(12, 20):
+        bad.inc(slo="request")
+        h.tick(now=float(t))
+        if eng.evaluate(now=float(t)) and t_fired is None:
+            t_fired = t
+    assert rule.name in eng.active and t_fired is not None
+    # recovery: goodput resumes; the FAST window clears the alert even
+    # while the slow window still remembers the burst
+    t_cleared = None
+    for t in range(20, 30):
+        good.inc(slo="request")
+        h.tick(now=float(t))
+        trs = eng.evaluate(now=float(t))
+        if trs and trs[0]["action"] == "cleared":
+            t_cleared = t
+            break
+    assert t_cleared is not None
+    assert rule.burn(h, 9.0, float(t_cleared)) >= 1.0, \
+        "cleared on the fast window while the slow window still burned"
+    # no-traffic windows burn 0 (division guard)
+    assert rule.burn(h, 3.0, 1000.0) == 0.0
+
+
+def test_parse_rules_grammar_and_env(monkeypatch):
+    spec = ("threshold:metric=paddle_fleet_replicas_alive,below=2,"
+            "severity=page;"
+            "burn_rate:slo=request,budget=0.1,fast=30,slow=120,"
+            "factor=2,name=slo_burn")
+    rules = alerts.parse_rules(spec)
+    assert isinstance(rules[0], alerts.ThresholdRule)
+    assert rules[0].below == 2.0 and rules[0].severity == "page"
+    br = rules[1]
+    assert isinstance(br, alerts.BurnRateRule)
+    assert (br.name, br.budget, br.fast_window_s, br.slow_window_s,
+            br.factor) == ("slo_burn", 0.1, 30.0, 120.0, 2.0)
+    with pytest.raises(ValueError):
+        alerts.parse_rules("bogus:metric=x")
+    with pytest.raises(ValueError):
+        alerts.parse_rules("threshold:metric=x,wat=1")
+    with pytest.raises(ValueError):
+        alerts.ThresholdRule(metric="x")            # no bound
+    with pytest.raises(ValueError):
+        alerts.BurnRateRule(budget=0.0)             # empty budget
+    with pytest.raises(ValueError):
+        alerts.BurnRateRule(fast_window_s=60, slow_window_s=30)
+    # the PADDLE_ALERT_RULES env grammar seeds the global engine
+    monkeypatch.setenv("PADDLE_ALERT_RULES",
+                       "threshold:metric=qq,above=1")
+    alerts.reset_alert_engine()
+    try:
+        eng = alerts.get_alert_engine()
+        assert "threshold_qq" in eng.rules
+        assert alerts.active_alerts() == {}
+    finally:
+        alerts.reset_alert_engine()
+
+
+def test_alert_transitions_telemetry_events_and_dump(tmp_path):
+    """Firing lands in all three places: the paddle_alerts_total /
+    paddle_alert_active telemetry pair, a flight-recorder event, and
+    the alerts state provider inside a watchdog dump."""
+    from paddle_tpu.profiler import flight_recorder as fr
+    from paddle_tpu.profiler.telemetry import get_registry
+
+    reg, good, bad = _slo_registry()
+    h = MetricsHistory(capacity=64, registry=reg)
+    eng = alerts.AlertEngine(history=h)
+    rule = eng.add_rule(alerts.BurnRateRule(
+        name="slo_burn", budget=0.5, fast_window_s=2.0, slow_window_s=4.0,
+        factor=1.0, severity="page"))
+    fr.register_state_provider("alerts", eng.state)
+    was_enabled = fr.is_enabled()
+    fr.enable()
+    try:
+        eng.attach(h)                 # evaluates on each tick
+        for t in range(4):
+            bad.inc(slo="request")
+            h.tick(now=float(t))
+        assert "slo_burn" in eng.active
+        g = get_registry()
+        assert g.counter("paddle_alerts_total").value(
+            rule="slo_burn", severity="page") >= 1
+        assert g.gauge("paddle_alert_active").value(rule="slo_burn") == 1
+        evs = [e for e in fr.get_flight_recorder().events(kind="alert")
+               if e["rule"] == "slo_burn"]
+        assert evs and evs[-1]["action"] == "fired"
+        # watchdog dump carries the active alert
+        dump = fr.get_flight_recorder().dump(reason="test",
+                                             directory=str(tmp_path))
+        payload = json.load(open(next(iter(dump["ranks"].values()))))
+        assert "slo_burn" in payload["state"]["alerts"]["active"]
+        # clear
+        for t in range(4, 10):
+            good.inc(slo="request")
+            h.tick(now=float(t))
+        assert not eng.active
+        assert g.gauge("paddle_alert_active").value(rule="slo_burn") == 0
+        acts = [t["action"] for t in eng.transitions]
+        assert acts[-2:] == ["fired", "cleared"]
+    finally:
+        eng.detach()
+        fr.unregister_state_provider("alerts")
+        if not was_enabled:
+            fr.disable()
+
+
+# ---------------------------------------------------------------------------
+# telemetry satellites
+# ---------------------------------------------------------------------------
+
+def test_exposition_help_type_defaults():
+    """metrics_text() carries # HELP / # TYPE for every family, and an
+    un-helped family self-documents with its own name (real Prometheus
+    scrapers warn on empty HELP)."""
+    reg = MetricRegistry()
+    reg.counter("bare_total").inc()
+    reg.gauge("described", help="a described gauge").set(2)
+    reg.histogram("lat_seconds").observe(0.01)
+    text = reg.to_text()
+    assert "# HELP bare_total bare_total\n" in text
+    assert "# TYPE bare_total counter\n" in text
+    assert "# HELP described a described gauge\n" in text
+    assert "# TYPE described gauge\n" in text
+    assert "# TYPE lat_seconds histogram\n" in text
+    for line in text.splitlines():
+        if line.startswith("# HELP"):
+            assert len(line.split(" ", 3)) == 4 and line.split(" ", 3)[3]
+
+
+def test_export_jsonl_rotation(tmp_path, monkeypatch):
+    """bench_telemetry.jsonl must not grow forever: past
+    PADDLE_TELEMETRY_JSONL_MAX_MB the file rotates to <path>.1 and the
+    append stays a single O_APPEND write (whole lines only)."""
+    reg = MetricRegistry()
+    for i in range(40):
+        reg.counter(f"pad_{i:02d}_total", labels=("k",)).inc(k="v" * 40)
+    path = tmp_path / "t.jsonl"
+    monkeypatch.setenv("PADDLE_TELEMETRY_JSONL_MAX_MB", "0.002")  # ~2 KiB
+    for _ in range(6):
+        reg.export_jsonl(str(path))
+    rotated = tmp_path / "t.jsonl.1"
+    assert rotated.exists(), "cap exceeded without rotation"
+    assert path.stat().st_size <= 0.002 * (1 << 20) + 8192
+    # every line in both files parses whole
+    for p in (path, rotated):
+        for ln in p.read_text().splitlines():
+            assert json.loads(ln)["metrics"]
+    # rotation disabled: the file just grows
+    monkeypatch.setenv("PADDLE_TELEMETRY_JSONL_MAX_MB", "0")
+    before = path.stat().st_size
+    reg.export_jsonl(str(path))
+    assert path.stat().st_size > before
+    assert os.path.getsize(rotated) > 0

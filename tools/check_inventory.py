@@ -323,6 +323,19 @@ INVENTORY = [
     ("Quantized paged-attention gather tiers",
      "paddle_tpu.ops.pallas.ragged_paged_attention",
      ["ragged_paged_attention"]),
+    # -- fleet load observatory (ISSUE 11) -----------------------------------
+    ("Metric time-series history (sampler + queries)",
+     "paddle_tpu.profiler.timeseries",
+     ["MetricsHistory", "get_history", "history", "history_tick",
+      "HISTORY_SCHEMA"]),
+    ("Alert rules + SLO burn-rate engine",
+     "paddle_tpu.profiler.alerts",
+     ["AlertEngine", "AlertRule", "ThresholdRule", "BurnRateRule",
+      "parse_rules", "get_alert_engine", "active_alerts"]),
+    ("Workload replay harness (seeded load generator)",
+     "paddle_tpu.inference.fleet.replay",
+     ["ReplayHarness", "ReplayReport", "ReplayTrace", "ReplayRequest",
+      "make_trace", "load_trace", "time_to_recover", "REPLAY_PRESETS"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -564,6 +577,74 @@ def check_observability_catalog(verbose=True):
     return violations
 
 
+def check_alert_catalog(verbose=True):
+    """Fleet-observatory inventory guard: every ``PADDLE_HISTORY_*`` /
+    ``PADDLE_ALERT_*`` / ``PADDLE_REPLAY_*`` / ``PADDLE_TELEMETRY_*``
+    env knob and every ``paddle_history_*`` / ``paddle_alert*_*``
+    metric referenced in ``paddle_tpu/`` must be (a) cataloged in
+    docs/OBSERVABILITY.md and (b) exercised by at least one test —
+    an alerting signal nobody documents or tests is a pager that lies.
+    Every replay preset string must appear in a test too (same rule as
+    the router policies). Returns a list of violation strings."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    knob_pat = re.compile(
+        r"PADDLE_(?:HISTORY|ALERT|REPLAY|TELEMETRY)[A-Z0-9_]*")
+    metric_pat = re.compile(r"paddle_(?:history|alerts?)_[a-z0-9_]*[a-z0-9]")
+    knobs, metrics = set(), set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    text = f.read()
+                knobs.update(knob_pat.findall(text))
+                metrics.update(metric_pat.findall(text))
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              errors="replace") as f:
+        doc = f.read()
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name), errors="replace") as f:
+                tests_text += f.read()
+    violations = []
+    for k in sorted(knobs):
+        if k not in doc:
+            violations.append(
+                f"observatory knob {k} missing from docs/OBSERVABILITY.md")
+        if k not in tests_text:
+            violations.append(
+                f"observatory knob {k} not exercised by any test")
+    for m in sorted(metrics):
+        if m not in doc:
+            violations.append(
+                f"observatory metric {m} missing from "
+                f"docs/OBSERVABILITY.md")
+        if m not in tests_text:
+            violations.append(
+                f"observatory metric {m} not exercised by any test")
+    from paddle_tpu.inference.fleet import REPLAY_PRESETS
+    for preset in REPLAY_PRESETS:
+        if f'"{preset}"' not in tests_text:
+            violations.append(
+                f"replay preset {preset!r} not exercised by any test")
+        if preset not in doc:
+            violations.append(
+                f"replay preset {preset!r} missing from "
+                f"docs/OBSERVABILITY.md")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"alert catalog: {len(knobs)} knobs, {len(metrics)} "
+              f"metrics, {len(REPLAY_PRESETS)} presets checked")
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -591,5 +672,5 @@ if __name__ == "__main__":
     jax.config.update("jax_platforms", "cpu")
     sys.exit(1 if (check() or check_strategy_docs() or check_env_docs()
                    or check_fleet_knobs() or check_observability_catalog()
-                   or check_serving_programs())
+                   or check_alert_catalog() or check_serving_programs())
              else 0)

@@ -1,0 +1,327 @@
+"""Fleet load console: render metric history, active alerts and
+per-replica state as text sparklines (or a self-contained HTML page).
+
+Inputs, auto-detected per file (globs ok):
+
+* metric-history JSONL exports (``MetricsHistory.export_jsonl``, schema
+  ``paddle_history/1``) — one sparkline per series, with rate for
+  counters and min/mean/max for gauges;
+* flight-recorder dumps (``flight_rank*.json``) — the ``alerts`` state
+  provider (active rules + recent fire/clear transitions) and every
+  fleet/engine state provider's replica table;
+* replay reports (``ReplayReport.to_json``, schema
+  ``paddle_replay_report/1``) — the goodput-under-burst /
+  time-to-recover summary block.
+
+Usage:
+    python tools/fleet_console.py hist.jsonl
+    python tools/fleet_console.py --match paddle_slo hist.jsonl flight_rank0.json
+    python tools/fleet_console.py --html console.html hist.jsonl report.json
+
+Same import discipline as ``trace_merge.py``: stdlib-only, no jax — this
+must run on a laptop against files scp'd off the fleet.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import html as _html
+import json
+import os
+import sys
+
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width=48):
+    """Unicode sparkline of the last ``width`` values."""
+    vals = list(values)[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return BLOCKS[0] * len(vals)
+    return "".join(BLOCKS[min(int((v - lo) / span * (len(BLOCKS) - 1e-9)),
+                              len(BLOCKS) - 1)] for v in vals)
+
+
+def counter_rate(points):
+    """Reset-aware increase/second over the whole ring (the
+    ``MetricsHistory.rate`` convention, reimplemented stdlib-only)."""
+    if len(points) < 2:
+        return 0.0
+    inc = 0.0
+    for (_, a), (_, b) in zip(points, points[1:]):
+        inc += (b - a) if b >= a else b
+    dt = points[-1][0] - points[0][0]
+    return inc / dt if dt > 0 else 0.0
+
+
+def fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# input classification
+# ---------------------------------------------------------------------------
+
+
+def load_inputs(paths):
+    """Split inputs into (history series list, flight dumps, reports)."""
+    series, dumps, reports = [], [], []
+    for pattern in paths:
+        hits = sorted(glob.glob(pattern)) or [pattern]
+        for path in hits:
+            with open(path) as f:
+                first = f.readline()
+                rest = f.read()
+            try:
+                head = json.loads(first)
+            except ValueError:
+                print(f"fleet_console: skipping {path} (not JSON)",
+                      file=sys.stderr)
+                continue
+            schema = str(head.get("schema", "")) if isinstance(
+                head, dict) else ""
+            if schema.startswith("paddle_history"):
+                for ln in rest.splitlines():
+                    if ln.strip():
+                        series.append(json.loads(ln))
+            elif schema.startswith("paddle_replay_report"):
+                reports.append((path, head))
+            elif isinstance(head, dict) and ("events" in head
+                                             or "state" in head):
+                dumps.append((path, head))
+            else:
+                # a one-record file (report / dump written compact)
+                try:
+                    payload = json.loads(first + rest)
+                except ValueError:
+                    payload = head
+                if isinstance(payload, dict) and str(
+                        payload.get("schema", "")).startswith(
+                        "paddle_replay_report"):
+                    reports.append((path, payload))
+                elif isinstance(payload, dict) and ("events" in payload
+                                                    or "state" in payload):
+                    dumps.append((path, payload))
+                else:
+                    print(f"fleet_console: skipping {path} (neither "
+                          "history, flight dump, nor replay report)",
+                          file=sys.stderr)
+    return series, dumps, reports
+
+
+def series_rows(series, match=None, width=48):
+    rows = []
+    for s in sorted(series, key=lambda r: (r["name"], r.get("labels", ""))):
+        name = s["name"]
+        labels = s.get("labels", "")
+        disp = f"{name}{{{labels}}}" if labels else name
+        if match and match not in disp:
+            continue
+        pts = [(p[0], p[1]) for p in s.get("points", [])]
+        if not pts:
+            continue
+        vals = [v for _, v in pts]
+        row = {"series": disp, "kind": s.get("kind", ""),
+               "last": vals[-1], "min": min(vals), "max": max(vals),
+               "mean": sum(vals) / len(vals), "n": len(vals),
+               "spark": sparkline(vals, width=width)}
+        if s.get("kind") == "counter":
+            row["rate"] = counter_rate(pts)
+        rows.append(row)
+    return rows
+
+
+def alert_sections(dumps):
+    """Active alerts + transitions from every dump's ``alerts`` state
+    provider."""
+    active, transitions = {}, []
+    for path, d in dumps:
+        al = (d.get("state") or {}).get("alerts") or {}
+        for name, ent in (al.get("active") or {}).items():
+            active[name] = ent
+        transitions.extend(al.get("recent_transitions") or [])
+    transitions.sort(key=lambda t: t.get("t", 0))
+    return active, transitions[-16:]
+
+
+def replica_rows(dumps):
+    """Per-replica state from fleet/engine state providers."""
+    rows = []
+    for path, d in dumps:
+        for provider, payload in (d.get("state") or {}).items():
+            if not isinstance(payload, dict):
+                continue
+            reps = payload.get("replicas")
+            if isinstance(reps, dict):
+                for rid, st in sorted(reps.items()):
+                    if isinstance(st, dict):
+                        rows.append({"replica": rid, "provider": provider,
+                                     **st})
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+
+def render_text(rows, active, transitions, replicas, reports) -> str:
+    out = []
+    if rows:
+        w = max(len(r["series"]) for r in rows)
+        out.append("== metric history ==")
+        for r in rows:
+            stat = (f"rate {fmt(r.get('rate'))}/s"
+                    if "rate" in r else
+                    f"min {fmt(r['min'])} mean {fmt(r['mean'])} "
+                    f"max {fmt(r['max'])}")
+            out.append(f"{r['series']:<{w}}  {r['spark']}  "
+                       f"last {fmt(r['last'])}  {stat}  [{r['n']} pts]")
+    out.append("")
+    out.append("== alerts ==")
+    if active:
+        for name, ent in sorted(active.items()):
+            out.append(f"ACTIVE  {name}  severity={ent.get('severity')}  "
+                       f"value={fmt(ent.get('value'))}  "
+                       f"since t={fmt(ent.get('since'))}")
+    else:
+        out.append("(none active)")
+    for tr in transitions:
+        out.append(f"  {tr.get('action', '?'):<8} {tr.get('rule')}  "
+                   f"t={fmt(tr.get('t'))}  value={fmt(tr.get('value'))}")
+    if replicas:
+        out.append("")
+        out.append("== replicas ==")
+        for r in replicas:
+            out.append(
+                f"{r.get('replica'):<6} role={r.get('role', '?'):<8} "
+                f"alive={r.get('alive')} draining={r.get('draining')} "
+                f"inflight={r.get('inflight')} "
+                f"load_tokens={r.get('load_tokens')} "
+                f"queue_depth={r.get('queue_depth')}")
+    for path, rep in reports:
+        out.append("")
+        out.append(f"== replay report ({os.path.basename(path)}) ==")
+        for key in ("preset", "seed", "requests", "ok", "statuses",
+                    "goodput_under_burst", "p99_ttft_under_burst_s",
+                    "p99_latency_s", "time_to_recover_s",
+                    "schedule_digest"):
+            if key in rep:
+                out.append(f"  {key}: {fmt(rep[key]) if not isinstance(rep[key], dict) else json.dumps(rep[key])}")
+    return "\n".join(out) + "\n"
+
+
+def render_html(rows, active, transitions, replicas, reports) -> str:
+    def esc(x):
+        return _html.escape(str(x))
+
+    parts = ["<!doctype html><html><head><meta charset='utf-8'>",
+             "<title>fleet console</title><style>",
+             "body{font-family:monospace;background:#111;color:#ddd;"
+             "padding:1em}",
+             "table{border-collapse:collapse}",
+             "td,th{padding:2px 10px;text-align:left;"
+             "border-bottom:1px solid #333}",
+             ".spark{color:#6cf;font-size:14px}",
+             ".active{color:#f66;font-weight:bold}",
+             "h2{color:#9cf;margin-top:1.2em}",
+             "</style></head><body><h1>fleet console</h1>"]
+    if rows:
+        parts.append("<h2>metric history</h2><table><tr><th>series</th>"
+                     "<th>trend</th><th>last</th><th>stats</th>"
+                     "<th>pts</th></tr>")
+        for r in rows:
+            stat = (f"rate {fmt(r.get('rate'))}/s" if "rate" in r
+                    else f"min {fmt(r['min'])} mean {fmt(r['mean'])} "
+                         f"max {fmt(r['max'])}")
+            parts.append(
+                f"<tr><td>{esc(r['series'])}</td>"
+                f"<td class='spark'>{esc(r['spark'])}</td>"
+                f"<td>{fmt(r['last'])}</td><td>{esc(stat)}</td>"
+                f"<td>{r['n']}</td></tr>")
+        parts.append("</table>")
+    parts.append("<h2>alerts</h2>")
+    if active:
+        parts.append("<ul>")
+        for name, ent in sorted(active.items()):
+            parts.append(f"<li class='active'>ACTIVE {esc(name)} "
+                         f"severity={esc(ent.get('severity'))} "
+                         f"value={fmt(ent.get('value'))}</li>")
+        parts.append("</ul>")
+    else:
+        parts.append("<p>(none active)</p>")
+    if transitions:
+        parts.append("<ul>")
+        for tr in transitions:
+            parts.append(f"<li>{esc(tr.get('action'))} "
+                         f"{esc(tr.get('rule'))} t={fmt(tr.get('t'))}</li>")
+        parts.append("</ul>")
+    if replicas:
+        parts.append("<h2>replicas</h2><table><tr><th>replica</th>"
+                     "<th>role</th><th>alive</th><th>inflight</th>"
+                     "<th>load</th><th>queue</th></tr>")
+        for r in replicas:
+            parts.append(
+                f"<tr><td>{esc(r.get('replica'))}</td>"
+                f"<td>{esc(r.get('role'))}</td>"
+                f"<td>{esc(r.get('alive'))}</td>"
+                f"<td>{esc(r.get('inflight'))}</td>"
+                f"<td>{esc(r.get('load_tokens'))}</td>"
+                f"<td>{esc(r.get('queue_depth'))}</td></tr>")
+        parts.append("</table>")
+    for path, rep in reports:
+        parts.append(f"<h2>replay report ({esc(os.path.basename(path))})"
+                     "</h2><table>")
+        for key in ("preset", "seed", "requests", "ok",
+                    "goodput_under_burst", "p99_ttft_under_burst_s",
+                    "time_to_recover_s", "schedule_digest"):
+            if key in rep:
+                parts.append(f"<tr><td>{esc(key)}</td>"
+                             f"<td>{esc(fmt(rep[key]))}</td></tr>")
+        parts.append("</table>")
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render metric history / alerts / replica state")
+    ap.add_argument("inputs", nargs="+",
+                    help="history JSONL, flight dumps, replay reports "
+                         "(globs ok)")
+    ap.add_argument("--match", help="filter history series by substring")
+    ap.add_argument("--width", type=int, default=48,
+                    help="sparkline width (points)")
+    ap.add_argument("--html", metavar="PATH",
+                    help="write a self-contained HTML page instead of "
+                         "text on stdout")
+    args = ap.parse_args(argv)
+    series, dumps, reports = load_inputs(args.inputs)
+    if not series and not dumps and not reports:
+        print("fleet_console: no usable inputs", file=sys.stderr)
+        return 2
+    rows = series_rows(series, match=args.match, width=args.width)
+    active, transitions = alert_sections(dumps)
+    replicas = replica_rows(dumps)
+    if args.html:
+        text = render_html(rows, active, transitions, replicas, reports)
+        with open(args.html, "w") as f:
+            f.write(text)
+        print(f"fleet_console: {len(rows)} series, {len(active)} active "
+              f"alert(s) -> {args.html}")
+    else:
+        sys.stdout.write(render_text(rows, active, transitions, replicas,
+                                     reports))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
