@@ -113,14 +113,17 @@ def bench_resnet():
                  jnp.bfloat16 if amp else jnp.float32)
     y = jnp.zeros((batch,), jnp.int32)
 
+    def _loss_fn(ps, b_arrs, key, x, y):
+        cps = [a.astype(jnp.bfloat16) if amp and a.dtype == jnp.float32
+               else a for a in ps]
+        logits, new_b = fm(cps, b_arrs, key, x)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+        return loss, new_b
+
     def train_step(p_arrs, b_arrs, key, x, y):
         def loss_fn(ps):
-            cps = [a.astype(jnp.bfloat16) if amp and a.dtype == jnp.float32
-                   else a for a in ps]
-            logits, new_b = fm(cps, b_arrs, key, x)
-            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-            loss = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
-            return loss, new_b
+            return _loss_fn(ps, b_arrs, key, x, y)
 
         (loss, new_b), grads = jax.value_and_grad(loss_fn, has_aux=True)(p_arrs)
         new_p = [p - 0.05 * g.astype(p.dtype) for p, g in zip(p_arrs, grads)]
@@ -174,6 +177,26 @@ def bench_resnet():
         out["telemetry_overhead_pct"] = _telemetry_overhead_pct(
             _probe_step, lambda r: r.block_until_ready(),
             steps=min(steps, 10))
+        p_arrs, b_arrs = st
+    # -- training observatory (ISSUE 12): memory peak, phase split,
+    # numerics-sentinel cost — the first training-side memory/phase
+    # entries in the bench trajectory
+    out["train_peak_bytes"] = _train_peak_bytes()
+    if os.environ.get("BENCH_PHASES", "1") == "1":
+        def _fwd(ps):
+            return _loss_fn(ps, b_arrs, key, x, y)[0]
+
+        def _grads(ps):
+            return jax.value_and_grad(
+                lambda q: _loss_fn(q, b_arrs, key, x, y)[0])(ps)[1]
+
+        def _opt(ps, gs):
+            return [p - 0.05 * g.astype(p.dtype) for p, g in zip(ps, gs)]
+
+        out["train_phase_breakdown"] = _phase_breakdown_probe(
+            p_arrs, _fwd, _grads, _opt)
+    out["numerics_overhead_pct"] = _numerics_overhead_pct()
+    _emit_observatory_aux(out)
     return out
 
 
@@ -233,6 +256,120 @@ def _telemetry_overhead_pct(run_step, sync, steps=10, instrumented_step=None,
         if teardown is not None:
             teardown()
     return round((t_instr - t_plain) / max(t_plain, 1e-9) * 100, 3)
+
+
+def _train_peak_bytes():
+    """Peak device bytes of the training run so far (PJRT allocator
+    lifetime peak; 0 on backends without allocator stats)."""
+    try:
+        from paddle_tpu.device.memory import max_memory_allocated
+        return int(max_memory_allocated())
+    except Exception:
+        return 0
+
+
+def _phase_breakdown_probe(p_arrs, fwd_fn, grads_fn, opt_fn, steps=None):
+    """Split-timed step-phase decomposition of a jitted train step:
+    forward = t(loss-only program), backward = t(loss+grads) - forward,
+    optimizer = t(update-only program); comm_wait is 0 on one chip. The
+    measured durations are ALSO recorded through
+    ``profiler.step_phase`` so the ``paddle_step_phase_seconds``
+    histogram and ``cost_table()['phases']`` carry the same numbers the
+    record reports. Returns {phase: fraction} plus the per-phase
+    seconds under ``*_s`` keys."""
+    import jax
+
+    from paddle_tpu.profiler import step_phase
+
+    steps = steps or int(os.environ.get("BENCH_PHASE_STEPS", "2"))
+
+    def timed(fn, *args):
+        r = fn(*args)                       # compile/warm
+        jax.block_until_ready(r)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            r = fn(*args)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / steps, r
+
+    was = step_phase.is_enabled()
+    step_phase.enable()
+    try:
+        t_fwd, _ = timed(jax.jit(fwd_fn), p_arrs)
+        t_fwdbwd, grads = timed(jax.jit(grads_fn), p_arrs)
+        t_opt, _ = timed(jax.jit(opt_fn), p_arrs, grads)
+        t_bwd = max(t_fwdbwd - t_fwd, 0.0)
+        for ph, dt in (("forward", t_fwd), ("backward", t_bwd),
+                       ("optimizer", t_opt)):
+            step_phase.record_phase(ph, dt)
+        total = max(t_fwd + t_bwd + t_opt, 1e-12)
+        return {
+            "forward": round(t_fwd / total, 4),
+            "backward": round(t_bwd / total, 4),
+            "comm_wait": 0.0,
+            "optimizer": round(t_opt / total, 4),
+            "forward_s": round(t_fwd, 5),
+            "backward_s": round(t_bwd, 5),
+            "optimizer_s": round(t_opt, 5),
+        }
+    finally:
+        if not was:
+            step_phase.disable()
+
+
+def _numerics_overhead_pct():
+    """Per-step cost of the numerics sentinel (grad L2/abs-max/
+    nonfinite stats for every parameter, interval 1) vs sentinel-off,
+    measured on an eager 2-layer MLP train step — the sentinel
+    instruments the eager tape's grad-ready hooks, which a jitted
+    whole-step program never fires, so the eager loop IS the worst
+    case."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.profiler import tensor_stats
+
+    # sized so compute dominates the way a real model's does — the
+    # sentinel's per-param cost is fixed, so a toy step would report
+    # a uselessly inflated percentage
+    net = nn.Sequential(nn.Linear(256, 256), nn.Tanh(),
+                        nn.Linear(256, 64))
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters())
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(64, 256)).astype(np.float32))
+
+    def step():
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def setup():
+        tensor_stats.enable(interval=1, mode="warn")
+
+    def teardown():
+        tensor_stats.disable()
+        tensor_stats.reset()
+
+    return _telemetry_overhead_pct(step, lambda r: None, steps=10,
+                                   instrumented_step=step,
+                                   setup=setup, teardown=teardown)
+
+
+def _emit_observatory_aux(out):
+    """stderr aux lines for the training-observatory record fields."""
+    for name in ("train_peak_bytes", "numerics_overhead_pct"):
+        if name in out:
+            print(json.dumps({"aux_metric": name, "value": out[name]}),
+                  file=sys.stderr)
+    if "train_phase_breakdown" in out:
+        print(json.dumps({"aux_metric": "train_phase_breakdown",
+                          **{k: v for k, v in
+                             out["train_phase_breakdown"].items()
+                             if not k.endswith("_s")}}), file=sys.stderr)
 
 
 def bench_data():
@@ -357,12 +494,14 @@ def bench_llama():
     accum = max(int(os.environ.get("BENCH_ACCUM", "1")), 1)
     assert batch % accum == 0, "BENCH_ACCUM must divide BENCH_BATCH"
 
+    def _loss_fn(ps, mb_ids, mb_labels):
+        cps = [a.astype(jnp.bfloat16) if amp and a.dtype == jnp.float32
+               else a for a in ps]
+        (loss, _), _ = fm(cps, [], key, mb_ids, labels=mb_labels)
+        return loss
+
     def train_step(p_arrs, key, ids, labels):
-        def loss_fn(ps, mb_ids, mb_labels):
-            cps = [a.astype(jnp.bfloat16) if amp and a.dtype == jnp.float32
-                   else a for a in ps]
-            (loss, _), _ = fm(cps, [], key, mb_ids, labels=mb_labels)
-            return loss
+        loss_fn = _loss_fn
 
         if accum == 1:
             loss, grads = jax.value_and_grad(loss_fn)(p_arrs, ids, labels)
@@ -429,7 +568,7 @@ def bench_llama():
     print(json.dumps({"aux_metric": "mfu_" + chip,
                       "value": round(mfu * 100, 2), "unit": "%"}),
           file=sys.stderr)
-    return {
+    out = {
         "metric": "llama_1b_train_tokens_per_sec",
         "value": round(batch * seq * steps / dt, 2),
         "unit": "tokens/sec",
@@ -442,6 +581,24 @@ def bench_llama():
                                    else "fp32+amp" if amp else "fp32"),
                    **{k: v for k, v in dims.items()}},
     }
+    # -- training observatory (ISSUE 12): memory peak, phase split,
+    # numerics-sentinel cost
+    out["train_peak_bytes"] = _train_peak_bytes()
+    if os.environ.get("BENCH_PHASES", "1") == "1":
+        def _fwd(ps):
+            return _loss_fn(ps, ids, labels)
+
+        def _grads(ps):
+            return jax.value_and_grad(_loss_fn)(ps, ids, labels)[1]
+
+        def _opt(ps, gs):
+            return [p - 1e-4 * g.astype(p.dtype) for p, g in zip(ps, gs)]
+
+        out["train_phase_breakdown"] = _phase_breakdown_probe(
+            p_arrs, _fwd, _grads, _opt)
+    out["numerics_overhead_pct"] = _numerics_overhead_pct()
+    _emit_observatory_aux(out)
+    return out
 
 
 def bench_bert():

@@ -143,6 +143,24 @@ _profiler = None     # paddle_tpu.profiler.Profiler when recording
 # window being open; the empty-list check keeps the off path free.
 _op_observers: list = []
 
+# activation observers: fn(op_name, out) called with every dispatch's
+# OUTPUT while installed (profiler.tensor_stats activation abs-max).
+# Separate from _op_observers (which only see timing) and from the
+# single-slot _op_inspect (owned by auto_parallel completion); the
+# empty-list check keeps the off path to one truthiness test.
+_act_observers: list = []
+
+
+def register_activation_observer(fn):
+    if fn not in _act_observers:
+        _act_observers.append(fn)
+    return fn
+
+
+def unregister_activation_observer(fn):
+    if fn in _act_observers:
+        _act_observers.remove(fn)
+
 # callbacks fired once after a top-level backward() finishes (DataParallel
 # grad sync uses this — the analogue of the reference reducer's
 # post-backward allreduce flush, ``paddle/fluid/imperative/reducer.cc``).
@@ -217,6 +235,9 @@ def apply(fn, *args, op_name: str | None = None, **kwargs):
         out = _apply_inner(fn, name, args, kwargs)
     if _op_inspect[0] is not None:
         _op_inspect[0](name, out)
+    if _act_observers:
+        for _ob in _act_observers:
+            _ob(name, out)
     return out
 
 
@@ -348,6 +369,26 @@ def _accum(a, b):
     return b if a is None else a + b
 
 
+def poison_next_leaf_grad():
+    """Fault-injection hook (``distributed.fault`` ``nan:`` directives):
+    arm a one-shot NaN poison on THIS thread — the first leaf gradient
+    finalized by the next accumulate-mode backward gets a NaN written
+    into its first element, before grad hooks, ``.grad`` accumulation
+    and the grad-ready callbacks observe it (so the comm bucketer and
+    the numerics sentinel both see the poisoned value, exactly like a
+    real numerics blow-up). Thread-local: in the thread-rank simulator
+    only the targeted rank's backward is affected."""
+    _post_backward_tls.nan_poison = getattr(
+        _post_backward_tls, "nan_poison", 0) + 1
+
+
+def _poison_nan(g):
+    arr = jnp.asarray(g)
+    flat = arr.reshape(-1)
+    flat = flat.at[0].set(jnp.nan)
+    return flat.reshape(arr.shape)
+
+
 def _run_hooks(t: Tensor, g):
     if t._grad_hooks:
         for h in list(t._grad_hooks):
@@ -368,6 +409,10 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
     # unregister themselves mid-backward don't skew iteration
     ready_cbs = (list(getattr(_post_backward_tls, "ready_callbacks", ()))
                  if accumulate else [])
+    # armed fault-injection poison (poison_next_leaf_grad) — one getattr
+    # on the off path, consumed by the first finalized leaf grad below
+    nan_poison = (getattr(_post_backward_tls, "nan_poison", 0)
+                  if accumulate else 0)
     seed_leaves = []   # root tensors that got their grad in the seed loop
     # ---- seed
     seeds = []  # (node, out_idx, grad) or leaf accumulation
@@ -472,6 +517,11 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
                     for cb in ready_cbs:
                         cb(t)
                 continue
+            if nan_poison and prod is None and not t.stop_gradient:
+                g = _poison_nan(g)
+                nan_poison = 0
+                _post_backward_tls.nan_poison = max(
+                    getattr(_post_backward_tls, "nan_poison", 1) - 1, 0)
             g = _run_hooks(t, g)
             is_capture = capture is not None and id(t) in capture
             if is_capture:

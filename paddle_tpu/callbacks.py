@@ -282,11 +282,18 @@ class TelemetryCallback(Callback):
     While training runs, per-op dispatch telemetry is enabled on the
     autograd tape (``paddle_op_dispatch_total{op=...}``), so one fit()
     populates the tape, io, and train layers of the registry together.
+
+    ``track_phases=True`` (default) additionally enables the
+    step-phase layer (``profiler.step_phase``) for the duration of the
+    fit — forward/backward/comm-wait/optimizer spans land in
+    ``paddle_step_phase_seconds{phase}`` and every phase boundary
+    becomes a memory-timeline sample point (the memory timeline itself
+    stays gated on ``PADDLE_MEMORY`` / ``profiler.memory.enable()``).
     """
 
     def __init__(self, step_flops=None, tokens_per_batch=None,
                  samples_per_batch=None, chip=None, n_chips=1,
-                 track_memory=True, track_ops=True):
+                 track_memory=True, track_ops=True, track_phases=True):
         super().__init__()
         self.step_flops = step_flops
         self.tokens_per_batch = tokens_per_batch
@@ -295,10 +302,12 @@ class TelemetryCallback(Callback):
         self.n_chips = n_chips
         self.track_memory = track_memory
         self.track_ops = track_ops
+        self.track_phases = track_phases
         self._m = None
         self._monitor = None
         self._t_batch = None
         self._flight = None
+        self._phases_enabled_here = False
 
     def _metrics(self):
         if self._m is None:
@@ -328,6 +337,12 @@ class TelemetryCallback(Callback):
         if self.track_ops:
             from .profiler.telemetry import enable_op_telemetry
             enable_op_telemetry()
+        if self.track_phases:
+            from .profiler import step_phase
+            # enable only for this fit (mirror track_ops); remember
+            # whether WE turned it on so a knob-enabled layer survives
+            self._phases_enabled_here = not step_phase.is_enabled()
+            step_phase.enable()
         if self.step_flops:
             from .profiler.mfu import MFUMonitor, chip_kind
             chip = self.chip
@@ -343,9 +358,15 @@ class TelemetryCallback(Callback):
         if self.track_ops:
             from .profiler.telemetry import disable_op_telemetry
             disable_op_telemetry()
+        if self._phases_enabled_here:
+            from .profiler import step_phase
+            step_phase.disable()
+            self._phases_enabled_here = False
 
     def on_train_batch_begin(self, step, logs=None):
         self._t_batch = time.perf_counter()
+        from .profiler import step_phase
+        step_phase.step_begin(step)
 
     def on_train_batch_end(self, step, logs=None):
         if self._t_batch is None:
@@ -371,6 +392,8 @@ class TelemetryCallback(Callback):
                 m["mem"].set_max(max_memory_allocated())
             except Exception:
                 pass      # backend without allocator stats
+        from .profiler import step_phase
+        step_phase.step_end()
 
 
 class VisualDL(Callback):

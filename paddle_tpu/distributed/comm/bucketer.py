@@ -253,6 +253,8 @@ class GradientBucketer:
         """Exchange the wrapped parameters' gradients in place (the
         bucketed replacement for per-tensor ``all_reduce(p.grad)``)."""
         import jax.numpy as jnp
+        from ...profiler import step_phase as _step_phase
+        t0 = time.perf_counter()
         arrays = [p.grad._data if getattr(p, "grad", None) is not None
                   else None for p in self._params]
         red = self.sync_arrays(arrays, group=group, op=op,
@@ -260,6 +262,8 @@ class GradientBucketer:
         for p, r in zip(self._params, red):
             if r is not None:
                 p.grad._data = jnp.asarray(r, dtype=p.grad._data.dtype)
+        # barrier-path gradient exchange = un-overlapped comm time
+        _step_phase.record_phase("comm_wait", time.perf_counter() - t0)
         return self
 
     def sync_params(self, group=None, op=None):
@@ -552,7 +556,12 @@ class ReadyBucketScheduler:
             self.close()
             raise
         finally:
-            _overlap_telemetry()["wait"].observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _overlap_telemetry()["wait"].observe(dt)
+            # the step-boundary wait IS the comm time overlap failed to
+            # hide — the "comm_wait" slice of the step-phase breakdown
+            from ...profiler import step_phase as _step_phase
+            _step_phase.record_phase("comm_wait", dt)
             self._round += 1
             self._reset_round()
         return exchanged

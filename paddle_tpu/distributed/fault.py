@@ -1,9 +1,10 @@
 """Deterministic fault injection for elastic/chaos testing (ROADMAP 5).
 
 A :class:`FaultPlan` is a list of :class:`Fault` directives — kill rank R
-at step S, kill rank R before its N-th tracked collective, or delay rank
-R by T seconds — installed programmatically (:func:`install`) or via the
-``PADDLE_FAULT_PLAN`` env knob. Training loops call :func:`check_step`
+at step S, kill rank R before its N-th tracked collective, delay rank
+R by T seconds, or poison rank R's next gradient with NaN — installed
+programmatically (:func:`install`) or via the ``PADDLE_FAULT_PLAN`` env
+knob. Training loops call :func:`check_step`
 at every step boundary; the thread-rank simulator calls the collective
 hook at every rendezvous exchange entry (``simulator._FAULT_HOOK`` —
 installed only while a plan is active, so the no-plan path stays a
@@ -21,10 +22,21 @@ Env grammar (``;``-separated directives, ``kind:key=value,...``)::
 
     PADDLE_FAULT_PLAN="kill:rank=2,step=5"
     PADDLE_FAULT_PLAN="kill:rank=2,seq=12;delay:rank=1,step=3,seconds=0.5"
+    PADDLE_FAULT_PLAN="nan:rank=2,step=5"
+
+``nan`` faults (numerics chaos — the testable trigger for the
+``profiler.tensor_stats`` sentinel) arm the tape's one-shot
+:func:`~paddle_tpu.autograd.tape.poison_next_leaf_grad` on the firing
+rank's thread: the first leaf gradient its next backward finalizes gets
+a NaN before the grad bucket is dispatched, so the poison travels the
+same path (grad-ready hook → bucket collective) a real blow-up would.
+Step triggers are the natural fit (the poison lands on the rank's own
+training thread); seq triggers arm whichever thread entered the
+collective.
 
 Every fault fires at most once. Each firing is recorded as a
 flight-recorder event and counted in
-``paddle_elastic_events_total{kind="kill"|"delay"}``.
+``paddle_elastic_events_total{kind="kill"|"delay"|"nan"}``.
 """
 from __future__ import annotations
 
@@ -73,9 +85,9 @@ class Fault:
     __slots__ = ("kind", "rank", "step", "seq", "seconds", "fired")
 
     def __init__(self, kind, rank, step=None, seq=None, seconds=0.0):
-        if kind not in ("kill", "delay"):
+        if kind not in ("kill", "delay", "nan"):
             raise ValueError(f"unknown fault kind {kind!r} "
-                             "(expected 'kill' or 'delay')")
+                             "(expected 'kill', 'delay' or 'nan')")
         if (step is None) == (seq is None):
             raise ValueError("a fault needs exactly one trigger: "
                              "step=... or seq=...")
@@ -208,6 +220,13 @@ def _fire(fault: Fault, where: str):
     _flight.record_event("fault_injected", fault=repr(fault), where=where)
     if fault.kind == "delay":
         time.sleep(fault.seconds)
+        return
+    if fault.kind == "nan":
+        # arm the tape's one-shot poison on THIS thread: the next
+        # backward's first finalized leaf grad carries the NaN through
+        # the normal grad-ready → bucket path (sentinel-detectable)
+        from ..autograd import tape
+        tape.poison_next_leaf_grad()
         return
     # kill: mark dead FIRST so blocked survivors detect immediately,
     # then unwind this rank's thread

@@ -237,7 +237,12 @@ class Tensor:
     # -- autograd -----------------------------------------------------------
     def backward(self, grad_tensor=None, retain_graph=False):
         from ..autograd import tape
+        from ..profiler import step_phase as _step_phase
+        _t0 = _step_phase.clock()
         tape.run_backward([self], [grad_tensor], retain_graph=retain_graph)
+        if _t0 is not None:
+            import time as _time
+            _step_phase.record_phase("backward", _time.perf_counter() - _t0)
 
     def retain_grads(self):
         self._retain_grads = True

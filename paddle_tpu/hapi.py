@@ -11,6 +11,7 @@ from .framework.core import Tensor
 from .framework import io as fio
 from .io import DataLoader, Dataset
 from .metric import Metric
+from .profiler import step_phase as _step_phase
 
 
 def _pad_rows(x, target):
@@ -87,9 +88,11 @@ class Model:
 
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
-        out = self.network(*inputs) if isinstance(inputs, (list, tuple)) \
-            else self.network(inputs)
-        loss = self._loss(out, labels) if self._loss else out
+        with _step_phase.span("forward"):
+            out = self.network(*inputs) \
+                if isinstance(inputs, (list, tuple)) \
+                else self.network(inputs)
+            loss = self._loss(out, labels) if self._loss else out
         loss.backward()
         if update:
             self._optimizer.step()
@@ -142,10 +145,11 @@ class Model:
                     cbs.on_train_batch_begin(step, {})
                 x, y = self._unpack(batch)
                 x, true_n = self._maybe_pad_partial(x, pad_state)
-                out = self.network(x)
-                if true_n is not None:
-                    out = _slice_rows(out, true_n)
-                loss = self._loss(out, y) if self._loss else out
+                with _step_phase.span("forward"):
+                    out = self.network(x)
+                    if true_n is not None:
+                        out = _slice_rows(out, true_n)
+                    loss = self._loss(out, y) if self._loss else out
                 loss.backward()
                 if (step + 1) % accumulate_grad_batches == 0:
                     self._optimizer.step()

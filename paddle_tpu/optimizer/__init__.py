@@ -115,6 +115,19 @@ class Optimizer:
 
     @no_grad()
     def step(self):
+        # step-phase span ("optimizer" slice of the training-step
+        # breakdown); clock() is None when the layer is off
+        from ..profiler import step_phase as _step_phase
+        _t0 = _step_phase.clock()
+        try:
+            return self._step_impl()
+        finally:
+            if _t0 is not None:
+                import time as _time
+                _step_phase.record_phase("optimizer",
+                                         _time.perf_counter() - _t0)
+
+    def _step_impl(self):
         # accept plain Tensors with stop_gradient=False, like the
         # reference (Parameter.trainable; Tensor -> not stop_gradient)
         params_grads = [(p, p.grad) for p in self._parameter_list
@@ -417,6 +430,17 @@ class Lamb(Optimizer):
 
     @no_grad()
     def step(self):
+        from ..profiler import step_phase as _step_phase
+        _t0 = _step_phase.clock()
+        try:
+            self._lamb_step_impl()
+        finally:
+            if _t0 is not None:
+                import time as _time
+                _step_phase.record_phase("optimizer",
+                                         _time.perf_counter() - _t0)
+
+    def _lamb_step_impl(self):
         # resolve exclude_from_weight_decay_fn per parameter before updates
         if self._exclude_fn is not None:
             self._excluded_now = {id(p) for p in self._parameter_list

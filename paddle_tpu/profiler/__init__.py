@@ -48,6 +48,15 @@ from .alerts import (  # noqa: F401  (re-exported facade)
     AlertEngine, AlertRule, ThresholdRule, BurnRateRule,
     get_alert_engine, active_alerts,
 )
+from . import step_phase  # noqa: F401
+from . import memory  # noqa: F401
+from .memory import (  # noqa: F401  (re-exported facade)
+    MemoryTimeline, module_breakdown, register_model_breakdown,
+)
+from . import tensor_stats  # noqa: F401
+from .tensor_stats import (  # noqa: F401  (re-exported facade)
+    NumericsSentinel, NonFiniteGradError, get_sentinel,
+)
 
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
@@ -66,6 +75,9 @@ __all__ = [
     "MetricsHistory", "get_history", "history", "history_tick",
     "AlertEngine", "AlertRule", "ThresholdRule", "BurnRateRule",
     "get_alert_engine", "active_alerts",
+    "step_phase", "memory", "tensor_stats",
+    "MemoryTimeline", "module_breakdown", "register_model_breakdown",
+    "NumericsSentinel", "NonFiniteGradError", "get_sentinel",
 ]
 
 

@@ -1,0 +1,334 @@
+"""Step memory timeline + analytic per-module breakdown (ISSUE 12 —
+the memory half of the training observatory).
+
+Training memory today is one live-bytes high-water gauge. This module
+answers the two questions that number cannot: *when inside the step*
+does the peak happen, and *which module's state* is it made of.
+
+* :class:`MemoryTimeline` — live device bytes sampled at every
+  step-phase boundary (:mod:`.step_phase` forwards each
+  ``record_phase`` as a :func:`phase_sample`), kept in a bounded ring
+  of ``(t, step, phase, bytes)`` points with per-step peak attribution
+  (:meth:`~MemoryTimeline.peak_report`: the peak step, the phase the
+  peak landed in, per-phase maxima) and a chrome **counter track**
+  (:meth:`~MemoryTimeline.to_chrome`, ``ph:"C"``) that
+  ``flight_recorder.merge_chrome_traces`` folds into the per-rank trace
+  view next to the span lanes.
+* :func:`module_breakdown` — the analytic side: per-top-level-module
+  parameter / gradient / optimizer-slot / comm-bucket bytes, dtype-aware
+  (bf16 params cost half, int8 wire buckets a quarter — the same
+  byte-accounting discipline as ``kv_page_nbytes``). Registered via
+  :func:`register_model_breakdown`, it becomes the ``memory.modules``
+  section of ``profiler.cost_table()`` schema v2 — the per-stage memory
+  table ROADMAP item 1's pipeline-split search needs.
+
+Zero overhead disabled (flight-recorder-style module bool): the wired
+call sites (:func:`phase_sample`, :func:`step_begin`) are one bool
+check when off. ``PADDLE_MEMORY=1`` enables at import;
+``PADDLE_MEMORY_CAPACITY`` bounds the sample ring (default 2048).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "MemoryTimeline", "get_timeline", "enable", "disable", "is_enabled",
+    "reset", "phase_sample", "step_begin", "module_breakdown",
+    "register_model_breakdown", "last_breakdown",
+    "DEFAULT_MEMORY_CAPACITY",
+]
+
+DEFAULT_MEMORY_CAPACITY = 2048
+
+_ENABLED = False
+_TIMELINE: "MemoryTimeline | None" = None
+_MODULE_LOCK = threading.Lock()
+_LAST_BREAKDOWN: list = [None]
+
+
+def _live_bytes() -> int:
+    """Current device bytes in use (PJRT allocator); 0 on backends
+    without allocator stats (CPU jax) — explicit ``nbytes=`` samples
+    and the analytic breakdown carry the signal there."""
+    try:
+        from ..device.memory import memory_allocated
+        return int(memory_allocated())
+    except Exception:
+        return 0
+
+
+class MemoryTimeline:
+    """Bounded ring of phase-stamped live-byte samples with per-step
+    peak attribution. Thread-safe (dp sim ranks sample concurrently)."""
+
+    def __init__(self, capacity=None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("PADDLE_MEMORY_CAPACITY",
+                                              str(DEFAULT_MEMORY_CAPACITY)))
+            except ValueError:
+                capacity = DEFAULT_MEMORY_CAPACITY
+        self.capacity = max(int(capacity), 16)
+        self._lock = threading.Lock()
+        self._samples: deque = deque(maxlen=self.capacity)
+        self._step = 0
+        self._step_peak: dict = {}     # step -> (bytes, phase)
+        self._phase_max: dict = {}     # phase -> max bytes seen
+        self._tele = None
+
+    def _telemetry(self):
+        if self._tele is None:
+            from .telemetry import get_registry
+            r = get_registry()
+            self._tele = {
+                "live": r.gauge(
+                    "paddle_memory_live_bytes",
+                    "live device bytes at the last sampled phase "
+                    "boundary", labels=("phase",)),
+                "peak": r.gauge(
+                    "paddle_memory_step_peak_bytes",
+                    "peak sampled live bytes within the current step"),
+                "samples": r.counter(
+                    "paddle_memory_samples_total",
+                    "memory-timeline phase-boundary samples taken"),
+            }
+        return self._tele
+
+    # -- sampling ------------------------------------------------------------
+    def step_begin(self, step=None):
+        with self._lock:
+            self._step = self._step + 1 if step is None else int(step)
+
+    def sample(self, phase: str, nbytes=None) -> int:
+        """One phase-boundary sample. ``nbytes=`` overrides the device
+        reading (tests, or callers accounting host-side pools)."""
+        b = _live_bytes() if nbytes is None else int(nbytes)
+        now = time.monotonic()
+        with self._lock:
+            step = self._step
+            self._samples.append((now, step, str(phase), b))
+            cur = self._step_peak.get(step)
+            if cur is None or b > cur[0]:
+                self._step_peak[step] = (b, str(phase))
+                # bounded: keep the last capacity steps' attributions
+                if len(self._step_peak) > self.capacity:
+                    for k in sorted(self._step_peak)[:-self.capacity]:
+                        del self._step_peak[k]
+            if b > self._phase_max.get(str(phase), -1):
+                self._phase_max[str(phase)] = b
+            peak = self._step_peak[step][0]
+        tele = self._telemetry()
+        tele["live"].set(b, phase=str(phase))
+        tele["peak"].set(peak)
+        tele["samples"].inc()
+        return b
+
+    # -- read side -----------------------------------------------------------
+    def samples(self) -> list:
+        with self._lock:
+            return list(self._samples)
+
+    def peak_report(self) -> dict:
+        """Peak-step attribution: the global peak, the step and phase it
+        landed in, and per-phase maxima."""
+        with self._lock:
+            if not self._step_peak:
+                return {"peak_bytes": 0, "peak_step": None,
+                        "peak_phase": None, "per_phase_max": {},
+                        "samples": 0}
+            peak_step = max(self._step_peak,
+                            key=lambda s: self._step_peak[s][0])
+            peak_bytes, peak_phase = self._step_peak[peak_step]
+            return {
+                "peak_bytes": peak_bytes,
+                "peak_step": peak_step,
+                "peak_phase": peak_phase,
+                "per_phase_max": dict(self._phase_max),
+                "samples": len(self._samples),
+            }
+
+    def to_chrome(self, pid=None) -> dict:
+        """Chrome counter-track events (``ph:"C"``) — one
+        live-bytes-over-time lane ``merge_chrome_traces`` draws next to
+        the span lanes (same convention as
+        ``MetricsHistory.to_chrome``)."""
+        pid = os.getpid() if pid is None else pid
+        events = []
+        for t, step, phase, b in self.samples():
+            events.append({"name": "paddle_memory_live_bytes", "ph": "C",
+                           "pid": pid, "tid": 0,
+                           "ts": round(t * 1e6, 3),
+                           "args": {"value": b, "step": step,
+                                    "phase": phase}})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def clear(self):
+        with self._lock:
+            self._samples.clear()
+            self._step_peak.clear()
+            self._phase_max.clear()
+            self._step = 0
+
+
+# ---------------------------------------------------------------------------
+# analytic per-module breakdown (the cost_table memory side)
+# ---------------------------------------------------------------------------
+
+
+def _nbytes(arr) -> int:
+    import numpy as np
+    a = getattr(arr, "_data", arr)
+    try:
+        numel = 1
+        for d in a.shape:
+            numel *= int(d)
+        return numel * np.dtype(a.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def module_breakdown(model, optimizer=None, bucketer=None) -> dict:
+    """Analytic per-top-level-module byte accounting, dtype-aware:
+
+    * ``param_bytes`` — each parameter at its stored dtype;
+    * ``grad_bytes`` — the live ``p.grad`` when present, else the
+      parameter's own size for trainables (the steady-state bound);
+    * ``opt_bytes`` — the optimizer's slot arrays for the module's
+      parameters (moments, master weights, ... at their real dtypes);
+    * ``comm_bytes`` — the module's share of the gradient fusion
+      buckets (bucket dtype x per-item numel; block-alignment padding
+      reported separately as ``comm_padding_bytes`` in the totals).
+    """
+    modules: dict = {}
+    param_module: dict = {}
+
+    def bucket_of(name: str) -> str:
+        return name.split(".", 1)[0] if "." in name else name
+
+    named = list(model.named_parameters()) if hasattr(
+        model, "named_parameters") else [
+        (getattr(p, "name", f"param{i}"), p)
+        for i, p in enumerate(model.parameters())]
+    for name, p in named:
+        if p is None:
+            continue
+        m = bucket_of(name)
+        ent = modules.setdefault(m, {"param_bytes": 0, "grad_bytes": 0,
+                                     "opt_bytes": 0, "comm_bytes": 0,
+                                     "params": 0})
+        pb = _nbytes(p)
+        ent["param_bytes"] += pb
+        ent["params"] += 1
+        g = getattr(p, "grad", None)
+        if g is not None:
+            ent["grad_bytes"] += _nbytes(g)
+        elif getattr(p, "trainable", not p.stop_gradient):
+            ent["grad_bytes"] += pb
+        param_module[id(p)] = m
+        if optimizer is not None:
+            slots = getattr(optimizer, "_slots", {}).get(id(p))
+            if slots:
+                ent["opt_bytes"] += sum(_nbytes(a) for a in slots.values())
+    comm_padding = 0
+    if bucketer is not None:
+        import numpy as np
+        for b in bucketer.buckets:
+            itemsize = np.dtype(b.dtype).itemsize
+            used = 0
+            for (i, _off, numel, _shape) in b.items:
+                p = bucketer._params[i]
+                m = param_module.get(id(p))
+                if m is not None:
+                    modules[m]["comm_bytes"] += numel * itemsize
+                used += numel
+            comm_padding += (b.numel - used) * itemsize
+    for ent in modules.values():
+        ent["total_bytes"] = (ent["param_bytes"] + ent["grad_bytes"]
+                              + ent["opt_bytes"] + ent["comm_bytes"])
+    totals = {
+        k: sum(ent[k] for ent in modules.values())
+        for k in ("param_bytes", "grad_bytes", "opt_bytes", "comm_bytes",
+                  "total_bytes", "params")
+    }
+    totals["comm_padding_bytes"] = comm_padding
+    return {"modules": modules, "totals": totals}
+
+
+def register_model_breakdown(model, optimizer=None, bucketer=None) -> dict:
+    """Compute and register the breakdown as THE training job's memory
+    table — ``profiler.cost_table()`` folds the last registered one into
+    its ``memory`` section."""
+    bd = module_breakdown(model, optimizer=optimizer, bucketer=bucketer)
+    _LAST_BREAKDOWN[0] = bd
+    return bd
+
+
+def last_breakdown():
+    return _LAST_BREAKDOWN[0]
+
+
+# ---------------------------------------------------------------------------
+# module facade (zero overhead disabled — same pattern as flight_recorder)
+# ---------------------------------------------------------------------------
+
+
+def get_timeline() -> MemoryTimeline:
+    global _TIMELINE
+    if _TIMELINE is None:
+        with _MODULE_LOCK:
+            if _TIMELINE is None:
+                _TIMELINE = MemoryTimeline()
+    return _TIMELINE
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def enable(capacity=None) -> MemoryTimeline:
+    global _ENABLED, _TIMELINE
+    if capacity is not None:
+        with _MODULE_LOCK:
+            _TIMELINE = MemoryTimeline(capacity=capacity)
+    _ENABLED = True
+    return get_timeline()
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def reset():
+    """Drop the timeline and the registered breakdown (tests / between
+    jobs). Keeps the enabled flag."""
+    global _TIMELINE
+    with _MODULE_LOCK:
+        _TIMELINE = None
+    _LAST_BREAKDOWN[0] = None
+
+
+def phase_sample(phase: str, nbytes=None):
+    """The wired call site (every ``step_phase.record_phase`` boundary,
+    ``TelemetryCallback`` step ends): one sample IF enabled — a plain
+    bool check when off."""
+    if not _ENABLED:
+        return None
+    return get_timeline().sample(phase, nbytes=nbytes)
+
+
+def step_begin(step=None):
+    if not _ENABLED:
+        return
+    get_timeline().step_begin(step)
+
+
+def _env_truthy(v) -> bool:
+    return v not in (None, "", "0", "false", "False", "no")
+
+
+if _env_truthy(os.environ.get("PADDLE_MEMORY")):   # pragma: no cover
+    enable()

@@ -59,7 +59,7 @@ __all__ = [
 ]
 
 TRACE_SCHEMA = "paddle_request_trace/1"
-COST_TABLE_SCHEMA = "paddle_cost_table/1"
+COST_TABLE_SCHEMA = "paddle_cost_table/2"
 
 DEFAULT_TRACE_CAPACITY = 1024
 DEFAULT_SLO_WINDOW = 1024
@@ -647,8 +647,13 @@ def cost_table(path=None) -> dict:
     wire throughput (CommStats totals + flight-recorder seq records with
     entry/exit timestamps), per-program step times (every ``*_seconds``
     histogram family with observations), the current SLO report and the
-    simulator wire model. This is the measured side ROADMAP item 4's
-    parallelism planner searches against. ``path=`` also writes it."""
+    simulator wire model. Schema v2 adds the training observatory's
+    sections: ``phases`` (per-phase step seconds + fractions from
+    ``profiler.step_phase``) and ``memory`` (the registered per-module
+    param/grad/optimizer-state/comm byte breakdown plus the memory
+    timeline's peak attribution) — the per-stage compute/memory table
+    ROADMAP item 1's pipeline-split search consumes. ``path=`` also
+    writes it."""
     from .telemetry import get_registry
 
     table: dict = {"schema": COST_TABLE_SCHEMA, "unix_time": time.time()}
@@ -693,6 +698,25 @@ def cost_table(path=None) -> dict:
                 "p50_s": s["p50"], "p95_s": s["p95"],
             }
     table["programs"] = programs
+    # training observatory (schema v2): per-phase step seconds + the
+    # per-module memory table the parallelism planner splits against
+    try:
+        from . import step_phase as _step_phase
+        table["phases"] = _step_phase.breakdown()
+    except Exception:
+        table["phases"] = {}
+    try:
+        from . import memory as _memory
+        mem: dict = {}
+        bd = _memory.last_breakdown()
+        if bd:
+            mem["modules"] = bd["modules"]
+            mem["totals"] = bd["totals"]
+        if _memory.is_enabled():
+            mem["timeline"] = _memory.get_timeline().peak_report()
+        table["memory"] = mem
+    except Exception:
+        table["memory"] = {}
     table["slo"] = slo_report()
     table["wire_model"] = {
         "sim_lat_us": float(os.environ.get("PADDLE_SIM_WIRE_LAT_US", "0")),

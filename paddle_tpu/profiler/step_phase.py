@@ -1,0 +1,184 @@
+"""Step-phase spans: where a training step's wall time goes (ISSUE 12 —
+the per-phase half of the training observatory).
+
+One training step decomposes into four phases the planner's cost model
+(ROADMAP item 1) needs separately — forward, backward, comm-wait (the
+gradient exchange the overlap scheduler could not hide), optimizer —
+and today only the total is measured. This module is the shared clock:
+
+* wired call sites — ``hapi.Model.fit`` / ``Model.train_batch`` wrap
+  the net forward, ``Tensor.backward`` wraps ``tape.run_backward``,
+  ``ReadyBucketScheduler.finish`` / ``GradientBucketer.sync_grads``
+  report the gradient-exchange wait, and ``Optimizer.step`` wraps the
+  update — each a :func:`record_phase` call that is one bool check when
+  the layer is off;
+* every recorded span lands in the
+  ``paddle_step_phase_seconds{phase}`` histogram AND in the cumulative
+  per-phase totals :func:`breakdown` serves (phase fractions — the
+  ``train_phase_breakdown`` bench metric and the ``phases`` section of
+  ``profiler.cost_table()`` schema v2);
+* every phase boundary is also a memory-timeline sample point
+  (:func:`profiler.memory.phase_sample`) so the live-bytes timeline is
+  attributable to the phase that produced the peak.
+
+Zero overhead disabled (flight-recorder-style module bool):
+``PADDLE_STEP_PHASE=1`` enables at import; ``TelemetryCallback``
+enables it for the duration of a ``fit`` (``track_phases=True``, the
+default) the same way it enables op telemetry.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = [
+    "PHASES", "enable", "disable", "is_enabled", "reset", "clock",
+    "record_phase", "span", "step_begin", "step_end", "breakdown",
+    "steps_recorded",
+]
+
+#: the step decomposition (stable label set for the histogram)
+PHASES = ("forward", "backward", "comm_wait", "optimizer")
+
+_ENABLED = False
+_LOCK = threading.Lock()
+_TOTALS: dict = {}        # phase -> [seconds, count]
+_STEPS = [0]              # step_begin() calls observed
+_TELE = [None]
+
+
+def _telemetry():
+    if _TELE[0] is None:
+        from .telemetry import get_registry
+        _TELE[0] = get_registry().histogram(
+            "paddle_step_phase_seconds",
+            "wall seconds per training-step phase "
+            "(forward/backward/comm_wait/optimizer)",
+            labels=("phase",))
+    return _TELE[0]
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def enable():
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+
+
+def reset():
+    """Drop the cumulative totals (tests / between jobs). Keeps the
+    enabled flag; the histogram family persists like every registry
+    family."""
+    with _LOCK:
+        _TOTALS.clear()
+        _STEPS[0] = 0
+
+
+def clock():
+    """``time.perf_counter()`` when the layer is on, else ``None`` —
+    the cheap begin half of a hand-rolled span (wired call sites pair
+    it with :func:`record_phase`)."""
+    return time.perf_counter() if _ENABLED else None
+
+
+def record_phase(phase: str, seconds: float):
+    """One measured phase span. No-op (one bool check) when disabled."""
+    if not _ENABLED:
+        return
+    _telemetry().observe(seconds, phase=phase)
+    with _LOCK:
+        tot = _TOTALS.get(phase)
+        if tot is None:
+            tot = _TOTALS[phase] = [0.0, 0]
+        tot[0] += float(seconds)
+        tot[1] += 1
+    # a phase boundary is a memory-timeline sample point
+    from . import memory as _memory
+    _memory.phase_sample(phase)
+
+
+class _PhaseSpan:
+    __slots__ = ("phase", "_t0")
+
+    def __init__(self, phase):
+        self.phase = phase
+        self._t0 = None
+
+    def __enter__(self):
+        if _ENABLED:
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            record_phase(self.phase, time.perf_counter() - self._t0)
+            self._t0 = None
+        return False
+
+
+def span(phase: str) -> _PhaseSpan:
+    """Context manager measuring one phase span (inert when off)."""
+    return _PhaseSpan(phase)
+
+
+def step_begin(step: int | None = None):
+    """Step boundary (``TelemetryCallback.on_train_batch_begin``):
+    counts steps and forwards the boundary to the memory timeline."""
+    if not _ENABLED:
+        return
+    with _LOCK:
+        _STEPS[0] += 1
+    from . import memory as _memory
+    _memory.step_begin(step)
+
+
+def step_end():
+    """Step boundary (``TelemetryCallback.on_train_batch_end``): a
+    final memory sample so the timeline sees post-step live bytes."""
+    if not _ENABLED:
+        return
+    from . import memory as _memory
+    _memory.phase_sample("step")
+
+
+def steps_recorded() -> int:
+    return _STEPS[0]
+
+
+def breakdown() -> dict:
+    """Cumulative per-phase seconds/count/fraction — the
+    ``train_phase_breakdown`` shape and ``cost_table()['phases']``.
+    Fractions are of the summed phase time (phases can overlap the
+    step's untracked tail, so they are fractions of *attributed* time,
+    not of wall step time)."""
+    with _LOCK:
+        tot = {ph: (s, n) for ph, (s, n) in _TOTALS.items()}
+        steps = _STEPS[0]
+    total_s = sum(s for s, _ in tot.values())
+    out = {}
+    for ph in list(PHASES) + sorted(set(tot) - set(PHASES)):
+        if ph not in tot:
+            continue
+        s, n = tot[ph]
+        out[ph] = {
+            "seconds": s,
+            "count": n,
+            "fraction": (s / total_s) if total_s > 0 else 0.0,
+        }
+    return {"phases": out, "total_seconds": total_s, "steps": steps}
+
+
+def _env_truthy(v) -> bool:
+    return v not in (None, "", "0", "false", "False", "no")
+
+
+if _env_truthy(os.environ.get("PADDLE_STEP_PHASE")):   # pragma: no cover
+    enable()

@@ -1,0 +1,375 @@
+"""Per-layer numerics sentinel (ISSUE 12 — the numerics half of the
+training observatory).
+
+A NaN in one layer's gradient today surfaces steps later as a diverged
+loss with no attribution. The sentinel watches every parameter's
+gradient the moment it is FINAL — the tape's grad-ready hook
+(``autograd.tape.register_grad_ready_callback``, PR 5's overlap
+infrastructure) fires per leaf DURING backward — and keeps per-parameter
+L2 norm / abs-max / nonfinite counts, sampled every
+``PADDLE_NUMERICS_INTERVAL`` steps:
+
+* the **first nonfinite gradient** raises a structured
+  :class:`NonFiniteGradError` naming the exact parameter (or records
+  and continues under ``PADDLE_NUMERICS_MODE=warn``), ticks
+  ``paddle_numerics_nonfinite_total{param}``, records a
+  flight-recorder ``numerics`` event, and sets the
+  ``paddle_numerics_nonfinite_params`` gauge the built-in
+  :class:`~.alerts.ThresholdRule` (``numerics_nonfinite``) pages on —
+  so the watchdog dump's ``numerics`` state provider names the
+  misbehaving layer;
+* optional **activation abs-max** per op rides the tape's activation
+  observer hook (``PADDLE_NUMERICS_ACTIVATIONS=1``) — the int8
+  wire/KV codecs' clipping story (EQuARX blockwise discipline) needs
+  exactly this range telemetry;
+* the read path never perturbs training: stats are read-only over the
+  finalized gradient, so a ``warn``-mode run is bit-identical to a
+  sentinel-free run (tested), and the overlapped-backward dispatch
+  order is untouched.
+
+Zero overhead disabled (flight-recorder-style module bool): nothing is
+registered on the tape until :func:`enable`/:func:`attach`, so the off
+path costs literally nothing per dispatch. Tape callbacks are
+thread-local per simulated rank — in a dp sim each rank's worker calls
+:func:`attach` on its own thread (``enable()`` attaches the calling
+thread). ``PADDLE_NUMERICS=1`` enables+attaches at import.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = [
+    "NonFiniteGradError", "NumericsSentinel", "get_sentinel", "enable",
+    "disable", "attach", "detach", "is_enabled", "reset",
+    "DEFAULT_NUMERICS_INTERVAL",
+]
+
+DEFAULT_NUMERICS_INTERVAL = 1
+_MODES = ("raise", "warn")
+
+_ENABLED = False
+_SENTINEL: "NumericsSentinel | None" = None
+_MODULE_LOCK = threading.Lock()
+
+
+class NonFiniteGradError(RuntimeError):
+    """A parameter's finalized gradient contains NaN/Inf. Carries the
+    exact parameter (``param``), the issuing rank, the sentinel's step
+    count and the nonfinite element count."""
+
+    def __init__(self, param, rank, step, nonfinite, total):
+        self.param = str(param)
+        self.rank = rank
+        self.step = step
+        self.nonfinite = int(nonfinite)
+        self.total = int(total)
+        super().__init__(
+            f"nonfinite gradient in parameter '{self.param}' "
+            f"(rank {rank}, sentinel step {step}): {self.nonfinite}/"
+            f"{self.total} elements are NaN/Inf — dump the numerics "
+            f"state (watchdog 'numerics' provider) and see "
+            f"docs/RUNBOOK.md 'nonfinite gradients'")
+
+
+def _rank():
+    try:
+        from ..distributed import simulator
+        r = simulator.current_rank()
+        if r is not None:
+            return r
+    except Exception:
+        pass
+    return 0
+
+
+class NumericsSentinel:
+    """Per-parameter gradient statistics + nonfinite detection.
+
+    One process-global instance; per-rank *attachment* (tape callbacks
+    are thread-local). Stats are keyed ``(rank, param_name)``.
+    """
+
+    def __init__(self, interval=None, mode=None, activations=None):
+        if interval is None:
+            try:
+                interval = int(os.environ.get(
+                    "PADDLE_NUMERICS_INTERVAL",
+                    str(DEFAULT_NUMERICS_INTERVAL)))
+            except ValueError:
+                interval = DEFAULT_NUMERICS_INTERVAL
+        self.interval = max(int(interval), 1)
+        if mode is None:
+            mode = os.environ.get("PADDLE_NUMERICS_MODE", "raise")
+        if mode not in _MODES:
+            raise ValueError(f"unknown PADDLE_NUMERICS_MODE {mode!r} "
+                             f"(one of {'/'.join(_MODES)})")
+        self.mode = mode
+        if activations is None:
+            activations = os.environ.get(
+                "PADDLE_NUMERICS_ACTIVATIONS") not in (
+                None, "", "0", "false", "False", "no")
+        self.activations = bool(activations)
+        self._lock = threading.Lock()
+        self._stats: dict = {}        # (rank, param) -> stats dict
+        self._act: dict = {}          # (rank, op) -> abs-max high-water
+        self._steps: dict = {}        # rank -> completed backward count
+        self._offenders: list = []    # latched nonfinite records (warn)
+        self._tele = None
+
+    # -- telemetry -----------------------------------------------------------
+    def _telemetry(self):
+        if self._tele is None:
+            from .telemetry import get_registry
+            r = get_registry()
+            self._tele = {
+                "nonfinite": r.counter(
+                    "paddle_numerics_nonfinite_total",
+                    "nonfinite (NaN/Inf) gradient detections",
+                    labels=("param",)),
+                "bad_params": r.gauge(
+                    "paddle_numerics_nonfinite_params",
+                    "distinct parameters with a nonfinite gradient "
+                    "detected (the built-in alert rule's signal)"),
+                "samples": r.counter(
+                    "paddle_numerics_samples_total",
+                    "per-parameter gradient stat samples taken"),
+            }
+        return self._tele
+
+    # -- sampling gate -------------------------------------------------------
+    def _sampling(self, rank) -> bool:
+        return self._steps.get(rank, 0) % self.interval == 0
+
+    @staticmethod
+    def _param_name(t) -> str:
+        return getattr(t, "name", None) or f"param{id(t)}"
+
+    # -- tape hooks ----------------------------------------------------------
+    def _on_grad_ready(self, t):
+        g = getattr(t, "grad", None)
+        if g is None:
+            return
+        rank = _rank()
+        if not self._sampling(rank):
+            return
+        import numpy as np
+        a = np.asarray(g._data)
+        if not np.issubdtype(a.dtype, np.floating):
+            return
+        a64 = a.astype(np.float64, copy=False)
+        finite = np.isfinite(a64)
+        nonfinite = int(a64.size - int(finite.sum()))
+        absmax = float(np.max(np.abs(np.where(finite, a64, 0.0)))) \
+            if a64.size else 0.0
+        l2 = float(np.linalg.norm(np.where(finite, a64, 0.0).ravel()))
+        name = self._param_name(t)
+        step = self._steps.get(rank, 0)
+        with self._lock:
+            self._stats[(rank, name)] = {
+                "param": name, "rank": rank, "step": step,
+                "l2": l2, "absmax": absmax, "nonfinite": nonfinite,
+                "numel": int(a64.size), "t": time.time(),
+            }
+            bad = sum(1 for s in self._stats.values() if s["nonfinite"])
+        tele = self._telemetry()
+        tele["samples"].inc()
+        if nonfinite:
+            tele["nonfinite"].inc(param=name)
+            tele["bad_params"].set(bad)
+            from . import flight_recorder
+            flight_recorder.record_event(
+                "numerics", param=name, nonfinite=nonfinite,
+                numel=int(a64.size), step=step, mode=self.mode)
+            rec = {"param": name, "rank": rank, "step": step,
+                   "nonfinite": nonfinite}
+            with self._lock:
+                self._offenders.append(rec)
+                del self._offenders[:-32]
+            if self.mode == "raise":
+                raise NonFiniteGradError(name, rank, step, nonfinite,
+                                         a64.size)
+        else:
+            tele["bad_params"].set(bad)
+
+    def _on_post_backward(self):
+        rank = _rank()
+        self._steps[rank] = self._steps.get(rank, 0) + 1
+
+    def _on_activation(self, op_name, out):
+        rank = _rank()
+        if not self._sampling(rank):
+            return
+        import numpy as np
+        import jax
+        from ..framework.core import Tensor
+        hi = None
+        for leaf in jax.tree.leaves(
+                out, is_leaf=lambda x: isinstance(x, Tensor)):
+            a = getattr(leaf, "_data", leaf)
+            try:
+                if not np.issubdtype(np.asarray(a).dtype, np.floating):
+                    continue
+                m = float(np.max(np.abs(np.asarray(a, np.float64))))
+            except Exception:
+                continue
+            hi = m if hi is None else max(hi, m)
+        if hi is None:
+            return
+        key = (rank, str(op_name))
+        with self._lock:
+            if hi > self._act.get(key, -1.0):
+                self._act[key] = hi
+
+    # -- read side -----------------------------------------------------------
+    def report(self) -> dict:
+        """{(rank, param): stats} flattened for humans/tests."""
+        with self._lock:
+            return {f"{r}/{p}": dict(s)
+                    for (r, p), s in sorted(self._stats.items())}
+
+    def activation_report(self) -> dict:
+        with self._lock:
+            return {f"{r}/{op}": v
+                    for (r, op), v in sorted(self._act.items())}
+
+    def offenders(self) -> list:
+        with self._lock:
+            return [dict(o) for o in self._offenders]
+
+    def state(self) -> dict:
+        """The ``numerics`` state-provider payload (watchdog dumps)."""
+        with self._lock:
+            stats = sorted(self._stats.values(),
+                           key=lambda s: (-s["nonfinite"], -s["absmax"]))
+            return {
+                "mode": self.mode,
+                "interval": self.interval,
+                "steps": dict(self._steps),
+                "params": [dict(s) for s in stats[:64]],
+                "offenders": [dict(o) for o in self._offenders],
+                "activation_absmax": {
+                    f"{r}/{op}": v
+                    for (r, op), v in sorted(self._act.items())[:64]},
+            }
+
+    def clear(self):
+        with self._lock:
+            self._stats.clear()
+            self._act.clear()
+            self._steps.clear()
+            del self._offenders[:]
+
+
+# ---------------------------------------------------------------------------
+# module facade
+# ---------------------------------------------------------------------------
+
+_ATTACHED = threading.local()
+
+
+def get_sentinel() -> NumericsSentinel:
+    global _SENTINEL
+    if _SENTINEL is None:
+        with _MODULE_LOCK:
+            if _SENTINEL is None:
+                _SENTINEL = NumericsSentinel()
+    return _SENTINEL
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def attach() -> NumericsSentinel:
+    """Register the sentinel's tape callbacks on THIS thread (each
+    simulated rank attaches itself — tape hooks are thread-local).
+    Idempotent per thread."""
+    s = get_sentinel()
+    if getattr(_ATTACHED, "cbs", None) is not None:
+        return s
+    from ..autograd import tape
+    ready = tape.register_grad_ready_callback(s._on_grad_ready)
+    post = tape.register_post_backward_callback(s._on_post_backward)
+    _ATTACHED.cbs = (ready, post)
+    if s.activations:
+        tape.register_activation_observer(s._on_activation)
+        _ATTACHED.act = s._on_activation
+    return s
+
+
+def detach():
+    """Unregister this thread's callbacks."""
+    cbs = getattr(_ATTACHED, "cbs", None)
+    if cbs is None:
+        return
+    from ..autograd import tape
+    ready, post = cbs
+    tape.unregister_grad_ready_callback(ready)
+    tape.unregister_post_backward_callback(post)
+    _ATTACHED.cbs = None
+    act = getattr(_ATTACHED, "act", None)
+    if act is not None:
+        tape.unregister_activation_observer(act)
+        _ATTACHED.act = None
+
+
+def enable(interval=None, mode=None, activations=None) -> NumericsSentinel:
+    """Build/replace the global sentinel, attach the calling thread,
+    register the ``numerics`` watchdog state provider and the built-in
+    ``numerics_nonfinite`` alert rule."""
+    global _ENABLED, _SENTINEL
+    with _MODULE_LOCK:
+        if (_SENTINEL is None or interval is not None or mode is not None
+                or activations is not None):
+            _SENTINEL = NumericsSentinel(interval=interval, mode=mode,
+                                         activations=activations)
+    _ENABLED = True
+    s = attach()
+    from . import flight_recorder
+    flight_recorder.register_state_provider("numerics", s.state)
+    try:
+        from .alerts import ThresholdRule, get_alert_engine
+        eng = get_alert_engine()
+        if "numerics_nonfinite" not in eng.rules:
+            eng.add_rule(ThresholdRule(
+                name="numerics_nonfinite",
+                metric="paddle_numerics_nonfinite_params",
+                above=0, severity="page"))
+    except Exception:
+        pass           # alerting is optional; detection must still work
+    return s
+
+
+def disable():
+    """Detach this thread and drop the module gate + state provider.
+    Other threads' attachments detach lazily via their own
+    :func:`detach` (tests) or die with their rank threads."""
+    global _ENABLED
+    _ENABLED = False
+    detach()
+    from . import flight_recorder
+    flight_recorder.unregister_state_provider("numerics")
+
+
+def reset():
+    """Drop the sentinel and its stats (tests / between jobs)."""
+    global _SENTINEL
+    detach()
+    with _MODULE_LOCK:
+        _SENTINEL = None
+    try:
+        from .alerts import _ENGINE
+        if _ENGINE is not None:
+            _ENGINE.remove_rule("numerics_nonfinite")
+    except Exception:
+        pass
+
+
+def _env_truthy(v) -> bool:
+    return v not in (None, "", "0", "false", "False", "no")
+
+
+if _env_truthy(os.environ.get("PADDLE_NUMERICS")):   # pragma: no cover
+    enable()

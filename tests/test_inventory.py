@@ -54,6 +54,15 @@ def test_alert_catalog():
     assert not violations, violations
 
 
+def test_training_observability_catalog():
+    """Every PADDLE_NUMERICS_*/PADDLE_MEMORY_*/PADDLE_STEP_PHASE* knob
+    and paddle_numerics_*/paddle_memory_*/paddle_step_phase_* metric is
+    cataloged in docs/OBSERVABILITY.md AND exercised by a test."""
+    from check_inventory import check_training_observability
+    violations = check_training_observability(verbose=False)
+    assert not violations, violations
+
+
 def test_serving_program_budget():
     """Compiled-program guard: a mixed prefill+decode load stays inside
     the ragged scheduler's declared token-bucket family (no per-request

@@ -179,7 +179,9 @@ def test_cost_table_folds_collectives_programs_slo():
         table = rt.cost_table()
     finally:
         flight_recorder.disable()
-    assert table["schema"] == "paddle_cost_table/1"
+    # schema v2 (ISSUE 12): adds the training phases/memory sections
+    assert table["schema"] == "paddle_cost_table/2"
+    assert "phases" in table and "memory" in table
     ar = table["collectives"]["all_reduce"]
     assert ar["calls"] >= 1 and ar["bytes"] >= 1 << 20
     assert ar["bytes_per_s"] > 0

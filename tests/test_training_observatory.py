@@ -1,0 +1,680 @@
+"""Training observatory (ISSUE 12): per-layer numerics sentinel, step
+memory timeline + per-module breakdown, step-phase spans feeding
+cost_table v2, the ``nan:`` fault directive, and tools/bench_compare.py.
+
+Acceptance here: dp-4 sim with ``PADDLE_FAULT_PLAN="nan:rank=2,step=5"``
+— the sentinel detects the nonfinite grad within step 5, names the
+exact parameter in the raised error, the alert fires with a
+flight-recorder event, and the watchdog dump's ``numerics`` state
+provider carries the per-param stats; with numerics in ``warn`` mode
+and the fault plan off, the trajectory is bit-identical to sensing
+disabled.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu import nn
+from paddle_tpu.autograd import tape
+from paddle_tpu.distributed import fault, simulator
+from paddle_tpu.profiler import (alerts, flight_recorder as flight,
+                                 memory, step_phase, tensor_stats,
+                                 timeseries)
+from paddle_tpu.profiler.tensor_stats import (NonFiniteGradError,
+                                              NumericsSentinel)
+from paddle_tpu.profiler.telemetry import get_registry
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture(autouse=True)
+def _clean_observatory():
+    yield
+    tensor_stats.disable()
+    tensor_stats.reset()
+    memory.disable()
+    memory.reset()
+    step_phase.disable()
+    step_phase.reset()
+    alerts.reset_alert_engine()
+    timeseries.reset()
+    flight.disable()
+    flight.reset()
+    fault.clear()
+
+
+def _mlp(seed=0, din=4, dh=8, dout=2):
+    net = nn.Sequential(nn.Linear(din, dh), nn.Tanh(), nn.Linear(dh, dout))
+    wr = np.random.default_rng(seed)
+    for p in net.parameters():
+        p.set_value(paddle.to_tensor(
+            (wr.normal(size=p.shape) * 0.1).astype(np.float32)))
+    return net
+
+
+# ---------------------------------------------------------------------------
+# numerics sentinel
+# ---------------------------------------------------------------------------
+
+
+class TestNumericsSentinel:
+    def test_grad_stats_match_hand_computed_oracle(self):
+        """Per-parameter L2 / abs-max attribution on a 2-layer net
+        equals the hand-computed numpy values over the same grads."""
+        net = _mlp()
+        s = tensor_stats.enable(interval=1, mode="warn")
+        x = paddle.to_tensor(np.linspace(-1, 1, 12)
+                             .reshape(3, 4).astype(np.float32))
+        (net(x) ** 2).mean().backward()
+        rep = s.report()
+        params = [p for p in net.parameters()]
+        assert len(rep) == len(params)
+        for p in params:
+            g = np.asarray(p.grad.numpy(), np.float64)
+            st = rep[f"0/{p.name}"]
+            assert st["l2"] == pytest.approx(float(np.linalg.norm(g)),
+                                             rel=1e-9)
+            assert st["absmax"] == pytest.approx(float(np.abs(g).max()),
+                                                 rel=1e-9)
+            assert st["nonfinite"] == 0
+            assert st["numel"] == g.size
+
+    def test_nonfinite_raises_naming_exact_param(self):
+        """First nonfinite grad raises a structured error naming the
+        parameter, ticks paddle_numerics_nonfinite_total{param} and
+        records a flight-recorder 'numerics' event."""
+        flight.enable()
+        tensor_stats.enable(interval=1, mode="raise")
+        net = _mlp(seed=1)
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        tape.poison_next_leaf_grad()
+        with pytest.raises(NonFiniteGradError) as ei:
+            (net(x) ** 2).mean().backward()
+        err = ei.value
+        names = {p.name for p in net.parameters()}
+        assert err.param in names
+        assert err.nonfinite >= 1
+        c = get_registry().counter("paddle_numerics_nonfinite_total",
+                                   labels=("param",))
+        assert c.value(param=err.param) >= 1
+        evs = flight.get_flight_recorder().events(kind="numerics")
+        assert any(e["param"] == err.param for e in evs)
+
+    def test_warn_mode_records_and_continues(self):
+        s = tensor_stats.enable(interval=1, mode="warn")
+        net = _mlp(seed=2)
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        tape.poison_next_leaf_grad()
+        (net(x) ** 2).mean().backward()           # must NOT raise
+        off = s.offenders()
+        assert off and off[0]["nonfinite"] >= 1
+        # the gauge the built-in alert rule watches is set
+        g = get_registry().gauge("paddle_numerics_nonfinite_params")
+        assert g.value() >= 1
+
+    def test_interval_env_knob_and_sampling(self, monkeypatch):
+        """PADDLE_NUMERICS_INTERVAL / PADDLE_NUMERICS_MODE seed the
+        sentinel, and interval=2 samples every other backward."""
+        monkeypatch.setenv("PADDLE_NUMERICS_INTERVAL", "2")
+        monkeypatch.setenv("PADDLE_NUMERICS_MODE", "warn")
+        s = NumericsSentinel()
+        assert s.interval == 2 and s.mode == "warn"
+        monkeypatch.delenv("PADDLE_NUMERICS_INTERVAL")
+        monkeypatch.delenv("PADDLE_NUMERICS_MODE")
+        s = tensor_stats.enable(interval=2, mode="warn")
+        net = _mlp(seed=3)
+        n_params = len(list(net.parameters()))
+        ctr = get_registry().counter("paddle_numerics_samples_total")
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        before = ctr.value()
+        for _ in range(3):                 # steps 0,1,2 -> sampled 0 and 2
+            (net(x) ** 2).mean().backward()
+            net.clear_gradients()
+        assert ctr.value() - before == 2 * n_params
+
+    def test_activation_absmax_optional(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_NUMERICS_ACTIVATIONS", "1")
+        assert NumericsSentinel().activations
+        monkeypatch.delenv("PADDLE_NUMERICS_ACTIVATIONS")
+        s = tensor_stats.enable(interval=1, mode="warn", activations=True)
+        net = _mlp(seed=4)
+        x = paddle.to_tensor(np.ones((3, 4), np.float32) * 2.0)
+        (net(x) ** 2).mean().backward()
+        acts = s.activation_report()
+        assert acts, "no activation abs-max recorded"
+        assert all(v >= 0 for v in acts.values())
+
+    def test_env_enable_knobs_at_import(self):
+        """PADDLE_NUMERICS / PADDLE_MEMORY / PADDLE_STEP_PHASE enable
+        their layers at import (fresh interpreter)."""
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from paddle_tpu.profiler import tensor_stats, memory, "
+            "step_phase\n"
+            "assert tensor_stats.is_enabled()\n"
+            "assert memory.is_enabled()\n"
+            "assert step_phase.is_enabled()\n"
+            "print('ENABLED_OK')\n")
+        env = dict(os.environ, PADDLE_NUMERICS="1", PADDLE_MEMORY="1",
+                   PADDLE_STEP_PHASE="1", JAX_PLATFORMS="cpu")
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "ENABLED_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# nan fault directive
+# ---------------------------------------------------------------------------
+
+
+class TestNanFault:
+    def test_parse_nan_directive(self):
+        plan = fault.FaultPlan.parse("nan:rank=2,step=5")
+        (f,) = plan.faults
+        assert (f.kind, f.rank, f.step, f.seq) == ("nan", 2, 5, None)
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            fault.FaultPlan.parse("nanx:rank=0,step=1")
+        with pytest.raises(ValueError, match="exactly one trigger"):
+            fault.FaultPlan.parse("nan:rank=0")
+
+    def test_nan_poisons_next_backward_once_only(self):
+        fault.install("nan:rank=0,step=2")
+        ctr = fault.elastic_telemetry()["events"]
+        before = ctr.value(kind="nan")
+        fault.check_step(0)
+        fault.check_step(1)
+        net = _mlp(seed=5)
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        (net(x) ** 2).mean().backward()
+        assert all(np.isfinite(p.grad.numpy()).all()
+                   for p in net.parameters()), "poison fired early"
+        net.clear_gradients()
+        fault.check_step(2)                         # arms the poison
+        assert ctr.value(kind="nan") == before + 1
+        (net(x) ** 2).mean().backward()
+        bad = [p.name for p in net.parameters()
+               if not np.isfinite(p.grad.numpy()).all()]
+        assert len(bad) == 1, f"exactly one poisoned grad expected: {bad}"
+        net.clear_gradients()
+        fault.check_step(2)                         # fired=True: never again
+        (net(x) ** 2).mean().backward()
+        assert all(np.isfinite(p.grad.numpy()).all()
+                   for p in net.parameters())
+
+
+# ---------------------------------------------------------------------------
+# dp-4 acceptance + parity
+# ---------------------------------------------------------------------------
+
+
+def _dp4_nan_worker(steps=7):
+    r = dist.get_rank()
+    net = _mlp(seed=0, din=16, dh=16, dout=4)
+    strat = dist.fleet.DistributedStrategy()
+    strat.hybrid_configs = {"dp_degree": 4}
+    dp = dist.parallel.DataParallel(net, strategy=strat)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=net.parameters())
+    tensor_stats.attach()                  # per-rank: tape hooks are TLS
+    rngX = np.random.default_rng(7)
+    X = rngX.normal(size=(4 * 4 * steps, 16)).astype(np.float32)
+    names = [p.name for p in net.parameters()]
+    try:
+        for s in range(steps):
+            fault.check_step(s)
+            lo = (s * 4 + r) * 4
+            x = paddle.to_tensor(X[lo:lo + 4])
+            loss = (dp(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return ("done", None, None, names)
+    except NonFiniteGradError as e:
+        w = simulator.active_world()
+        if w is not None:
+            w.mark_dead(r)                 # unblock the survivors
+        return ("nonfinite", s, e.param, names)
+    except simulator.RankFailure as e:
+        return ("peer_failure", s, e.rank, names)
+    finally:
+        dp.shutdown()
+        tensor_stats.detach()
+
+
+class TestAcceptanceDp4:
+    def test_nan_fault_detected_alert_fires_dump_names_layer(
+            self, monkeypatch, tmp_path):
+        """ISSUE 12 acceptance: dp-4 sim with
+        PADDLE_FAULT_PLAN="nan:rank=2,step=5" — rank 2's sentinel
+        raises within step 5 naming the exact parameter, survivors
+        surface a structured RankFailure naming rank 2, the built-in
+        numerics_nonfinite alert fires with a flight-recorder event,
+        and the watchdog dump's numerics state provider carries the
+        per-param stats."""
+        monkeypatch.setenv("PADDLE_FAULT_PLAN", "nan:rank=2,step=5")
+        monkeypatch.setenv("PADDLE_COMM_OVERLAP_TIMEOUT_S", "60")
+        fault.clear()                       # re-arm lazy env parsing
+        flight.enable()
+        tensor_stats.enable(interval=1, mode="raise")
+        results = dist.spawn(_dp4_nan_worker, nprocs=4).results
+        by_rank = {i: r for i, r in enumerate(results)}
+        kind, step, param, names = by_rank[2]
+        assert kind == "nonfinite", by_rank
+        assert step == 5, "detection must land within step 5"
+        assert param in names, "error must name the exact parameter"
+        for r in (0, 1, 3):
+            k, _, failed, _ = by_rank[r]
+            assert k in ("peer_failure", "done")
+            if k == "peer_failure":
+                assert failed == 2
+        # the detection landed in the sentinel's state
+        st = tensor_stats.get_sentinel().state()
+        assert any(p["nonfinite"] for p in st["params"])
+        assert any(o["param"] == param and o["rank"] == 2
+                   for o in st["offenders"])
+        # fault firing + numerics events are on the flight ring
+        fr = flight.get_flight_recorder()
+        assert any("nan" in e.get("fault", "")
+                   for e in fr.events(kind="fault_injected"))
+        assert any(e.get("param") == param
+                   for e in fr.events(kind="numerics"))
+        # alert: one history tick evaluates the built-in threshold rule
+        eng = alerts.get_alert_engine()
+        assert "numerics_nonfinite" in eng.rules
+        timeseries.get_history().tick()
+        active = alerts.active_alerts()
+        assert "numerics_nonfinite" in active
+        assert active["numerics_nonfinite"]["severity"] == "page"
+        assert any(e.get("rule") == "numerics_nonfinite"
+                   and e.get("action") == "fired"
+                   for e in fr.events(kind="alert"))
+        # watchdog dump carries the numerics provider with per-param stats
+        out = fr.dump(reason="test", directory=str(tmp_path))
+        with open(next(iter(out["ranks"].values()))) as f:
+            dumped = json.load(f)
+        numerics = dumped["state"]["numerics"]
+        assert any(p["param"] == param and p["nonfinite"]
+                   for p in numerics["params"])
+        assert dumped["state"]["alerts"]["active"].get("numerics_nonfinite")
+
+    def test_warn_mode_sentinel_is_bit_identical_to_disabled(self):
+        """With numerics in warn mode and the fault plan off, the dp-4
+        loss trajectory AND final params are bit-identical to sensing
+        disabled (the sentinel is read-only over finalized grads)."""
+
+        def run(sense):
+            if sense:
+                tensor_stats.enable(interval=1, mode="warn")
+            else:
+                tensor_stats.disable()
+                tensor_stats.reset()
+
+            def worker():
+                r = dist.get_rank()
+                net = _mlp(seed=0, din=16, dh=16, dout=4)
+                strat = dist.fleet.DistributedStrategy()
+                strat.hybrid_configs = {"dp_degree": 4}
+                dp = dist.parallel.DataParallel(net, strategy=strat)
+                opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                           parameters=net.parameters())
+                if sense:
+                    tensor_stats.attach()
+                rngX = np.random.default_rng(7)
+                X = rngX.normal(size=(48, 16)).astype(np.float32)
+                losses = []
+                try:
+                    for s in range(3):
+                        lo = (s * 4 + r) * 4
+                        loss = (dp(paddle.to_tensor(X[lo:lo + 4])) ** 2) \
+                            .mean()
+                        loss.backward()
+                        losses.append(np.asarray(loss.numpy()).copy())
+                        opt.step()
+                        opt.clear_grad()
+                    return (losses,
+                            [np.asarray(p.numpy()).copy()
+                             for p in net.parameters()])
+                finally:
+                    dp.shutdown()
+                    if sense:
+                        tensor_stats.detach()
+
+            return dist.spawn(worker, nprocs=4).results
+
+        sensed = run(True)
+        plain = run(False)
+        for (l_a, p_a), (l_b, p_b) in zip(sensed, plain):
+            for a, b in zip(l_a, l_b):
+                np.testing.assert_array_equal(a, b)
+            for a, b in zip(p_a, p_b):
+                np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# memory timeline + module breakdown
+# ---------------------------------------------------------------------------
+
+
+class TestMemoryTimeline:
+    def test_phase_samples_and_peak_attribution(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_MEMORY_CAPACITY", "32")
+        tl = memory.MemoryTimeline()
+        assert tl.capacity == 32
+        monkeypatch.delenv("PADDLE_MEMORY_CAPACITY")
+        tl = memory.enable(capacity=64)
+        tl.step_begin(0)
+        memory.phase_sample("forward", nbytes=100)
+        memory.phase_sample("backward", nbytes=300)
+        memory.phase_sample("optimizer", nbytes=200)
+        tl.step_begin(1)
+        memory.phase_sample("forward", nbytes=150)
+        memory.phase_sample("backward", nbytes=900)
+        rep = tl.peak_report()
+        assert rep["peak_bytes"] == 900
+        assert rep["peak_step"] == 1
+        assert rep["peak_phase"] == "backward"
+        assert rep["per_phase_max"]["forward"] == 150
+        assert rep["samples"] == 5
+        # telemetry gauges carry the last sample + step peak
+        r = get_registry()
+        live = r.gauge("paddle_memory_live_bytes", labels=("phase",))
+        assert live.value(phase="backward") == 900
+        assert r.gauge("paddle_memory_step_peak_bytes").value() == 900
+        assert r.counter("paddle_memory_samples_total").value() >= 5
+
+    def test_ring_is_bounded(self):
+        tl = memory.enable(capacity=64)     # floor is 16
+        for i in range(200):
+            tl.sample("x", nbytes=i)
+        assert len(tl.samples()) == 64
+
+    def test_chrome_counter_track_merges(self):
+        tl = memory.enable(capacity=64)
+        tl.step_begin(0)
+        tl.sample("forward", nbytes=128)
+        tl.sample("backward", nbytes=256)
+        merged = flight.merge_chrome_traces({0: tl.to_chrome()})
+        counters = [e for e in merged["traceEvents"]
+                    if e.get("ph") == "C"
+                    and e["name"] == "paddle_memory_live_bytes"]
+        assert len(counters) == 2
+        assert counters[0]["pid"] == 0
+        assert counters[1]["args"]["value"] == 256
+        assert counters[1]["args"]["phase"] == "backward"
+
+    def test_module_breakdown_oracle_dtype_aware(self):
+        """Per-module param/grad/opt/comm bytes equal hand-computed
+        values, including a bf16 parameter at 2 bytes/element."""
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.comm import GradientBucketer
+
+        net = _mlp(seed=6)
+        params = list(net.parameters())
+        # make one param bf16 to prove dtype-awareness
+        params[0]._data = params[0]._data.astype(jnp.bfloat16)
+        opt = paddle.optimizer.Adam(parameters=params)
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        (net(x) ** 2).mean().backward()
+        opt.step()                          # populates Adam slots
+        bucketer = GradientBucketer(params, fuse_grad_size_in_MB=32)
+        bd = memory.module_breakdown(net, optimizer=opt,
+                                     bucketer=bucketer)
+        named = dict(net.named_parameters())
+        exp: dict = {}
+        for name, p in named.items():
+            mod = name.split(".")[0]
+            e = exp.setdefault(mod, {"param": 0, "grad": 0, "opt": 0})
+            nbytes = int(np.prod(p.shape)) * np.dtype(
+                str(p._data.dtype)).itemsize
+            e["param"] += nbytes
+            e["grad"] += int(np.prod(p.shape)) * np.dtype(
+                str(p.grad._data.dtype)).itemsize
+            slots = opt._slots[id(p)]
+            e["opt"] += sum(
+                int(np.prod(a.shape)) * np.dtype(str(a.dtype)).itemsize
+                for a in slots.values())
+        for mod, e in exp.items():
+            got = bd["modules"][mod]
+            assert got["param_bytes"] == e["param"], mod
+            assert got["grad_bytes"] == e["grad"], mod
+            assert got["opt_bytes"] == e["opt"], mod
+            assert got["comm_bytes"] > 0
+        assert bd["totals"]["param_bytes"] == sum(
+            e["param"] for e in exp.values())
+        # dtype-aware: the bf16 weight produced a bf16 grad at 2
+        # bytes/element (the Adam update itself promotes the stored
+        # param back to fp32 — the breakdown reads LIVE dtypes)
+        g0 = named["0.weight"].grad
+        assert np.dtype(str(g0._data.dtype)).itemsize == 2
+        assert bd["modules"]["0"]["grad_bytes"] < \
+            bd["modules"]["0"]["param_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# step phases + cost_table v2
+# ---------------------------------------------------------------------------
+
+
+class TestStepPhases:
+    def test_hapi_fit_records_phases_and_memory(self):
+        """One fit() with TelemetryCallback populates
+        paddle_step_phase_seconds{forward|backward|optimizer} and the
+        memory timeline samples at every phase boundary."""
+        from paddle_tpu.callbacks import TelemetryCallback
+        from paddle_tpu.hapi import Model
+        import paddle_tpu.io as io
+
+        memory.enable(capacity=256)
+        step_phase.reset()
+        net = _mlp(seed=7)
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return (np.full(4, i, np.float32),
+                        np.zeros(2, np.float32))
+
+        m = Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            0.01, parameters=net.parameters()), loss=nn.MSELoss())
+        m.fit(DS(), batch_size=4, epochs=1, verbose=0,
+              callbacks=[TelemetryCallback(track_ops=False)])
+        assert not step_phase.is_enabled(), \
+            "TelemetryCallback must disable phases after the fit"
+        bd = step_phase.breakdown()
+        for ph in ("forward", "backward", "optimizer"):
+            assert bd["phases"][ph]["seconds"] > 0, ph
+            assert bd["phases"][ph]["count"] >= 2, ph
+        assert bd["steps"] == 2
+        assert abs(sum(p["fraction"]
+                       for p in bd["phases"].values()) - 1.0) < 1e-9
+        fam = get_registry().collect()["paddle_step_phase_seconds"]
+        assert {"forward", "backward", "optimizer"} <= set(fam["series"])
+        phases_seen = {s[2] for s in memory.get_timeline().samples()}
+        assert {"forward", "backward", "optimizer", "step"} <= phases_seen
+
+    def test_hybrid_parallel_cost_table_v2(self):
+        """ISSUE 12 acceptance: cost_table() reports per-phase step
+        seconds (incl. comm_wait from the overlapped dp exchange) and
+        per-module param/grad/optimizer-state bytes for a
+        hybrid-parallel (dp-4) config."""
+        step_phase.reset()
+        step_phase.enable()
+        memory.enable(capacity=256)
+
+        def worker():
+            r = dist.get_rank()
+            net = _mlp(seed=0, din=16, dh=16, dout=4)
+            strat = dist.fleet.DistributedStrategy()
+            strat.hybrid_configs = {"dp_degree": 4}
+            inner = paddle.optimizer.Adam(
+                learning_rate=0.01, parameters=net.parameters())
+            opt = dist.fleet.HybridParallelOptimizer(inner,
+                                                     strategy=strat)
+            rngX = np.random.default_rng(7)
+            X = rngX.normal(size=(48, 16)).astype(np.float32)
+            for s in range(2):
+                lo = (s * 4 + r) * 4
+                with step_phase.span("forward"):
+                    loss = (net(paddle.to_tensor(X[lo:lo + 4])) ** 2) \
+                        .mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            if r == 0:
+                from paddle_tpu.distributed.comm import GradientBucketer
+                memory.register_model_breakdown(
+                    net, optimizer=inner,
+                    bucketer=GradientBucketer.from_strategy(
+                        list(net.parameters()), strat))
+            return True
+
+        assert all(dist.spawn(worker, nprocs=4).results)
+        table = paddle.profiler.cost_table()
+        assert table["schema"] == "paddle_cost_table/2"
+        phases = table["phases"]["phases"]
+        for ph in ("forward", "backward", "comm_wait", "optimizer"):
+            assert phases[ph]["seconds"] > 0, ph
+        mods = table["memory"]["modules"]
+        assert mods, "per-module memory table missing"
+        for ent in mods.values():
+            assert ent["param_bytes"] > 0
+            assert ent["grad_bytes"] > 0
+            assert ent["opt_bytes"] > 0       # Adam moments
+        assert table["memory"]["timeline"]["samples"] > 0
+        # the same histogram rides in the programs section too
+        assert any(k.startswith("paddle_step_phase_seconds")
+                   for k in table["programs"])
+
+    def test_disabled_observatory_adds_no_step_cost(self):
+        """Overhead guard: the full disabled-path call surface
+        (tensor_stats gate, memory phase_sample, step_phase
+        record/clock) adds no measurable per-step cost — reuses
+        bench.py's telemetry_overhead_pct machinery like the flight
+        recorder's guard."""
+        import bench
+
+        assert not tensor_stats.is_enabled()
+        assert not memory.is_enabled()
+        assert not step_phase.is_enabled()
+        x = np.random.default_rng(0).normal(size=200_000) \
+            .astype(np.float32)
+
+        def step():
+            return float(np.tanh(x).sum())
+
+        def gated_step():
+            tensor_stats.is_enabled()
+            memory.phase_sample("forward")
+            memory.step_begin(0)
+            step_phase.clock()
+            step_phase.record_phase("forward", 0.0)
+            step_phase.step_begin(0)
+            step_phase.step_end()
+            return step()
+
+        pct = min(
+            bench._telemetry_overhead_pct(step, lambda r: None, steps=30,
+                                          instrumented_step=gated_step)
+            for _ in range(3))
+        assert pct < 10.0, f"disabled observatory costs {pct}% per step"
+        assert memory.get_timeline().samples() == []   # truly recorded 0
+        assert step_phase.breakdown()["total_seconds"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# tools/bench_compare.py
+# ---------------------------------------------------------------------------
+
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _bench_records(tmp_path, regress=False):
+    old = {
+        "metric": "llama_1b_train_tokens_per_sec", "value": 1000.0,
+        "unit": "tokens/sec", "vs_baseline": None, "mfu_pct": 31.0,
+        "train_peak_bytes": 1_000_000, "numerics_overhead_pct": 2.0,
+        "train_phase_breakdown": {"forward": 0.3, "backward": 0.5,
+                                  "comm_wait": 0.05, "optimizer": 0.15},
+        "config": {"batch": 4},
+    }
+    new = json.loads(json.dumps(old))
+    if regress:
+        new["value"] = 650.0                  # tokens/s down 35%
+        new["train_peak_bytes"] = 1_600_000   # peak up 60%
+    a, b = tmp_path / "old.json", tmp_path / "new.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    return str(a), str(b)
+
+
+class TestBenchCompare:
+    def test_direction_inference(self):
+        import bench_compare as bc
+        assert bc.direction_of("llama_1b_train_tokens_per_sec") == "higher"
+        assert bc.direction_of("train_peak_bytes") == "lower"
+        assert bc.direction_of("p95_ttft_ms") == "lower"
+        assert bc.direction_of("numerics_overhead_pct") == "lower"
+        assert bc.direction_of("fleet_time_to_recover_s") == "lower"
+        assert bc.direction_of("serving_prefix_ttft_speedup") == "higher"
+        assert bc.direction_of("train_phase_breakdown.forward") is None
+
+    def test_compare_flags_regressions_only(self, tmp_path):
+        import bench_compare as bc
+        a, b = _bench_records(tmp_path, regress=True)
+        rows = bc.compare(bc.load_record(a), bc.load_record(b))
+        by = {r["metric"]: r for r in rows}
+        assert by["llama_1b_train_tokens_per_sec"]["status"] == "REGRESSED"
+        assert by["train_peak_bytes"]["status"] == "REGRESSED"
+        assert by["mfu_pct"]["status"] == "ok"
+        assert by["train_phase_breakdown.forward"]["status"] == "info"
+        # override can silence a metric
+        rows = bc.compare(bc.load_record(a), bc.load_record(b),
+                          overrides={"train_peak_bytes": ("ignore", None)})
+        by = {r["metric"]: r for r in rows}
+        assert by["train_peak_bytes"]["status"] == "info"
+
+    def test_cli_no_jax_import_exit_codes(self, tmp_path):
+        """The comparator runs with jax AND numpy poisoned out of the
+        interpreter (laptop-vs-fleet-records discipline): exit 0 on
+        parity, 1 on a synthetic regression, 2 on bad input; --html
+        writes the table."""
+        a, b = _bench_records(tmp_path, regress=True)
+        html = str(tmp_path / "diff.html")
+        tool = os.path.join(REPO, "tools", "bench_compare.py")
+
+        def run(argv):
+            code = (
+                "import sys\n"
+                "sys.modules['jax'] = None\n"
+                "sys.modules['numpy'] = None\n"
+                f"sys.argv = {argv!r}\n"
+                "import runpy\n"
+                "try:\n"
+                f"    runpy.run_path({tool!r}, run_name='__main__')\n"
+                "except SystemExit as e:\n"
+                "    raise SystemExit(e.code or 0)\n")
+            return subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=60)
+
+        proc = run(["bench_compare.py", a, b, "--html", html])
+        assert proc.returncode == 1, proc.stderr
+        assert "REGRESSED" in proc.stdout
+        assert "llama_1b_train_tokens_per_sec" in proc.stdout
+        with open(html) as f:
+            assert "REGRESSED" in f.read()
+        same = run(["bench_compare.py", a, a])
+        assert same.returncode == 0, same.stderr
+        bad = run(["bench_compare.py", a, str(tmp_path / "missing.json")])
+        assert bad.returncode == 2

@@ -336,6 +336,19 @@ INVENTORY = [
      "paddle_tpu.inference.fleet.replay",
      ["ReplayHarness", "ReplayReport", "ReplayTrace", "ReplayRequest",
       "make_trace", "load_trace", "time_to_recover", "REPLAY_PRESETS"]),
+    # -- training observatory (ISSUE 12) -------------------------------------
+    ("Numerics sentinel (per-layer grad stats)",
+     "paddle_tpu.profiler.tensor_stats",
+     ["NumericsSentinel", "NonFiniteGradError", "get_sentinel", "enable",
+      "disable", "attach", "detach", "is_enabled"]),
+    ("Step memory timeline + module breakdown",
+     "paddle_tpu.profiler.memory",
+     ["MemoryTimeline", "get_timeline", "module_breakdown",
+      "register_model_breakdown", "phase_sample", "last_breakdown"]),
+    ("Step-phase spans (fwd/bwd/comm/opt)",
+     "paddle_tpu.profiler.step_phase",
+     ["PHASES", "record_phase", "span", "breakdown", "clock",
+      "step_begin", "step_end"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -645,6 +658,69 @@ def check_alert_catalog(verbose=True):
     return violations
 
 
+def check_training_observability(verbose=True):
+    """Training-observatory inventory guard: every ``PADDLE_NUMERICS_*``
+    / ``PADDLE_MEMORY_*`` / ``PADDLE_STEP_PHASE*`` env knob and every
+    ``paddle_numerics_*`` / ``paddle_memory_*`` / ``paddle_step_phase_*``
+    metric referenced in ``paddle_tpu/`` must be (a) cataloged in
+    docs/OBSERVABILITY.md and (b) exercised by at least one test — the
+    same rule the fleet observatory lives under (check_alert_catalog):
+    a numerics guard nobody documents or tests is a guard that lies.
+    Returns a list of violation strings."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    knob_pat = re.compile(
+        r"PADDLE_(?:NUMERICS|MEMORY|STEP_PHASE)[A-Z0-9_]*")
+    metric_pat = re.compile(
+        r"paddle_(?:numerics|memory|step_phase)_[a-z0-9_]*[a-z0-9]")
+    knobs, metrics = set(), set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    text = f.read()
+                knobs.update(knob_pat.findall(text))
+                metrics.update(metric_pat.findall(text))
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              errors="replace") as f:
+        doc = f.read()
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name), errors="replace") as f:
+                tests_text += f.read()
+    violations = []
+    for k in sorted(knobs):
+        if k not in doc:
+            violations.append(
+                f"training-observability knob {k} missing from "
+                f"docs/OBSERVABILITY.md")
+        if k not in tests_text:
+            violations.append(
+                f"training-observability knob {k} not exercised by any "
+                f"test")
+    for m in sorted(metrics):
+        if m not in doc:
+            violations.append(
+                f"training-observability metric {m} missing from "
+                f"docs/OBSERVABILITY.md")
+        if m not in tests_text:
+            violations.append(
+                f"training-observability metric {m} not exercised by "
+                f"any test")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"training observability: {len(knobs)} knobs, "
+              f"{len(metrics)} metrics checked")
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -672,5 +748,6 @@ if __name__ == "__main__":
     jax.config.update("jax_platforms", "cpu")
     sys.exit(1 if (check() or check_strategy_docs() or check_env_docs()
                    or check_fleet_knobs() or check_observability_catalog()
-                   or check_alert_catalog() or check_serving_programs())
+                   or check_alert_catalog() or check_training_observability()
+                   or check_serving_programs())
              else 0)
