@@ -196,6 +196,7 @@ def bench_resnet():
         out["train_phase_breakdown"] = _phase_breakdown_probe(
             p_arrs, _fwd, _grads, _opt)
     out["numerics_overhead_pct"] = _numerics_overhead_pct()
+    out["ledger_overhead_pct"] = _ledger_overhead_pct()
     _emit_observatory_aux(out)
     return out
 
@@ -359,9 +360,48 @@ def _numerics_overhead_pct():
                                    setup=setup, teardown=teardown)
 
 
+def _ledger_overhead_pct():
+    """Per-step cost of the determinism ledger (sha1 param/grad digests
+    at every optimizer step, interval 1, warn mode) vs ledger-off, on
+    the same eager MLP step the numerics-sentinel probe uses — the
+    digest path pulls every parameter and gradient to host, so the
+    eager loop is the honest worst case for the sensing layer."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.profiler import ledger
+
+    net = nn.Sequential(nn.Linear(256, 256), nn.Tanh(),
+                        nn.Linear(256, 64))
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=net.parameters())
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(64, 256)).astype(np.float32))
+
+    def step():
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    def setup():
+        ledger.enable(mode="warn", interval=1)
+
+    def teardown():
+        ledger.disable()
+        ledger.reset()
+
+    return _telemetry_overhead_pct(step, lambda r: None, steps=10,
+                                   instrumented_step=step,
+                                   setup=setup, teardown=teardown)
+
+
 def _emit_observatory_aux(out):
     """stderr aux lines for the training-observatory record fields."""
-    for name in ("train_peak_bytes", "numerics_overhead_pct"):
+    for name in ("train_peak_bytes", "numerics_overhead_pct",
+                 "ledger_overhead_pct"):
         if name in out:
             print(json.dumps({"aux_metric": name, "value": out[name]}),
                   file=sys.stderr)
@@ -597,6 +637,7 @@ def bench_llama():
         out["train_phase_breakdown"] = _phase_breakdown_probe(
             p_arrs, _fwd, _grads, _opt)
     out["numerics_overhead_pct"] = _numerics_overhead_pct()
+    out["ledger_overhead_pct"] = _ledger_overhead_pct()
     _emit_observatory_aux(out)
     return out
 
@@ -1036,15 +1077,28 @@ def bench_serving():
                 eng.generate(p, max_new_tokens=1, timeout=1800)
                 ttfts.append(time.perf_counter() - t0)
             t0 = time.perf_counter()
-            threads = [threading.Thread(
-                target=lambda p=p: eng.generate(p, max_new_tokens=new,
-                                                timeout=1800))
-                for p in prompts[1:]]
+            outs = [None] * (n_req - 1)
+
+            def _gen(i, p):
+                outs[i] = np.asarray(
+                    eng.generate(p, max_new_tokens=new,
+                                 timeout=1800).numpy())
+
+            threads = [threading.Thread(target=_gen, args=(i, p))
+                       for i, p in enumerate(prompts[1:])]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
             dt = time.perf_counter() - t0
+            # content digest of every delivered stream, in prompt order
+            # (greedy decode is deterministic, so this is stable across
+            # runs — bench_compare flags any drift as output-content
+            # regression, not just perf regression)
+            import hashlib
+            h = hashlib.sha1()
+            for o in outs:
+                h.update(np.ascontiguousarray(o).tobytes())
             cache = eng._cache
             stats = {
                 "ttft_ms": round(float(np.mean(ttfts)) * 1e3, 2),
@@ -1052,6 +1106,7 @@ def bench_serving():
                 "prefix_hits": int(cache.prefix_hits),
                 "prefix_misses": int(cache.prefix_misses),
                 "cached_tokens": int(cache.cached_tokens_total),
+                "token_digest": h.hexdigest(),
             }
         return stats
 
@@ -1197,6 +1252,12 @@ def bench_serving():
     if kv_probe is not None:
         aux.append(("serving_kv_capacity_ratio",
                     kv_probe["capacity_ratio"]))
+    # delivered-token-stream content digest (determinism ledger's
+    # cross-run story at bench granularity): bench_compare treats
+    # *_digest fields as exact-match metrics, so output-content drift
+    # between two bench runs fails the comparison like a perf
+    # regression would
+    aux.append(("serving_token_digest", on["token_digest"]))
     for name, val in aux:
         print(json.dumps({"aux_metric": name, "value": val}),
               file=sys.stderr)
@@ -1215,6 +1276,7 @@ def bench_serving():
         "tokens_per_sec_nocache": off["tokens_per_sec"],
         "prefix_hits": on["prefix_hits"],
         "prefix_cached_tokens": on["cached_tokens"],
+        "serving_token_digest": on["token_digest"],
         # ragged-vs-legacy under mixed concurrent prefill+decode load
         "serving_ragged_tokens_per_s_ratio": ragged_ratio,
         "ragged_tokens_per_sec": round(mixed_ragged["tokens_per_sec"], 2),

@@ -389,6 +389,34 @@ def _poison_nan(g):
     return flat.reshape(arr.shape)
 
 
+def flip_bit_next_leaf_grad():
+    """Fault-injection hook (``distributed.fault`` ``bitflip:``
+    directives): arm a one-shot single-bit flip on THIS thread — the
+    first leaf gradient FINALIZED by the next accumulate-mode backward
+    gets its element 0's lowest mantissa bit flipped. Unlike the NaN
+    poison (applied pre-hooks so the comm bucketer spreads it), the
+    flip lands at the very END of backward, AFTER the post-backward
+    callbacks — i.e. after the overlap scheduler's synced-grad
+    write-back — so in data-parallel training the corruption stays
+    rank-LOCAL: exactly the silent 1-ulp hardware fault the determinism
+    ledger's cross-rank digest comparison exists to catch (a NaN would
+    trip the numerics sentinel; a low-bit flip trips nothing else).
+    Thread-local, consumed once."""
+    _post_backward_tls.bit_poison = getattr(
+        _post_backward_tls, "bit_poison", 0) + 1
+
+
+def _flip_low_bit(g):
+    """XOR the lowest bit of element 0's bit pattern (f16/bf16/f32/f64)."""
+    from jax import lax
+    arr = jnp.asarray(g)
+    flat = arr.reshape(-1)
+    uint = {2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}[arr.dtype.itemsize]
+    bits = lax.bitcast_convert_type(flat[:1], uint)
+    flipped = lax.bitcast_convert_type(bits ^ jnp.ones((1,), uint), arr.dtype)
+    return flat.at[0].set(flipped[0]).reshape(arr.shape)
+
+
 def _run_hooks(t: Tensor, g):
     if t._grad_hooks:
         for h in list(t._grad_hooks):
@@ -413,6 +441,13 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
     # on the off path, consumed by the first finalized leaf grad below
     nan_poison = (getattr(_post_backward_tls, "nan_poison", 0)
                   if accumulate else 0)
+    # armed bit flip (flip_bit_next_leaf_grad): applied to the FIRST
+    # finalized leaf at the very end of backward (post write-back), so
+    # leaf-finality tracking runs even with no ready callbacks
+    bit_poison = (getattr(_post_backward_tls, "bit_poison", 0)
+                  if accumulate else 0)
+    track_final = bool(ready_cbs) or bool(bit_poison)
+    first_final: list = []   # [leaf Tensor] — finalize order, first only
     seed_leaves = []   # root tensors that got their grad in the seed loop
     # ---- seed
     seeds = []  # (node, out_idx, grad) or leaf accumulation
@@ -430,7 +465,7 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
                 capture[id(t)] = _accum(capture[id(t)], g)
             elif accumulate and not t.stop_gradient:
                 t.grad = Tensor(_accum(t.grad._data if t.grad is not None else None, g))
-                if ready_cbs:
+                if track_final:
                     seed_leaves.append(t)
         else:
             if accumulate and t._retain_grads and not t.stop_gradient:
@@ -470,13 +505,15 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
     # final once every reachable edge pointing at it has been processed —
     # only then may the ready callbacks (comm overlap) read t.grad
     leaf_pending: dict[int, int] = {}
-    if ready_cbs:
+    if track_final:
         for nid in nodes:
             for (t, prod, _) in node_objs[nid].edges:
                 if prod is None and not t.stop_gradient:
                     leaf_pending[id(t)] = leaf_pending.get(id(t), 0) + 1
         for t in seed_leaves:
             if id(t) not in leaf_pending:
+                if not first_final:
+                    first_final.append(t)
                 for cb in ready_cbs:
                     cb(t)
 
@@ -508,10 +545,12 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
             # is symbolically zero (None/float0) — the leaf is "done" with
             # this consumer either way
             final = False
-            if ready_cbs and prod is None and not t.stop_gradient:
+            if track_final and prod is None and not t.stop_gradient:
                 c = leaf_pending[id(t)] - 1
                 leaf_pending[id(t)] = c
                 final = c == 0
+                if final and not first_final:
+                    first_final.append(t)
             if g is None or (hasattr(g, "dtype") and g.dtype == _FLOAT0):
                 if final:
                     for cb in ready_cbs:
@@ -542,6 +581,15 @@ def run_backward(tensors, grads=None, retain_graph=False, accumulate=True,
     if accumulate:
         for cb in list(getattr(_post_backward_tls, "callbacks", ())):
             cb()
+    if bit_poison and first_final:
+        # bit flip lands AFTER the post-backward flush (overlap
+        # scheduler's synced-grad write-back): rank-local corruption of
+        # the grad the optimizer is about to consume
+        t = first_final[0]
+        if t.grad is not None:
+            t.grad = Tensor(_flip_low_bit(t.grad._data))
+            _post_backward_tls.bit_poison = max(
+                getattr(_post_backward_tls, "bit_poison", 1) - 1, 0)
 
 
 def _graph_grad(outputs, inputs, grad_outputs, allow_unused):

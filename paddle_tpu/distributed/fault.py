@@ -23,6 +23,7 @@ Env grammar (``;``-separated directives, ``kind:key=value,...``)::
     PADDLE_FAULT_PLAN="kill:rank=2,step=5"
     PADDLE_FAULT_PLAN="kill:rank=2,seq=12;delay:rank=1,step=3,seconds=0.5"
     PADDLE_FAULT_PLAN="nan:rank=2,step=5"
+    PADDLE_FAULT_PLAN="bitflip:rank=2,step=5"
 
 ``nan`` faults (numerics chaos — the testable trigger for the
 ``profiler.tensor_stats`` sentinel) arm the tape's one-shot
@@ -34,9 +35,19 @@ Step triggers are the natural fit (the poison lands on the rank's own
 training thread); seq triggers arm whichever thread entered the
 collective.
 
+``bitflip`` faults (silent-corruption chaos — the testable trigger for
+the ``profiler.ledger`` determinism observatory) arm the tape's
+one-shot :func:`~paddle_tpu.autograd.tape.flip_bit_next_leaf_grad`
+through the same once-only machinery: the first leaf gradient the
+rank's next backward finalizes gets a single low bit flipped AT THE END
+of backward (after the overlap scheduler's synced-grad write-back), so
+in data-parallel training the corruption stays rank-local — too small
+for the NaN sentinel, exactly what the ledger's cross-rank digest
+comparison must catch.
+
 Every fault fires at most once. Each firing is recorded as a
 flight-recorder event and counted in
-``paddle_elastic_events_total{kind="kill"|"delay"|"nan"}``.
+``paddle_elastic_events_total{kind="kill"|"delay"|"nan"|"bitflip"}``.
 """
 from __future__ import annotations
 
@@ -85,9 +96,10 @@ class Fault:
     __slots__ = ("kind", "rank", "step", "seq", "seconds", "fired")
 
     def __init__(self, kind, rank, step=None, seq=None, seconds=0.0):
-        if kind not in ("kill", "delay", "nan"):
+        if kind not in ("kill", "delay", "nan", "bitflip"):
             raise ValueError(f"unknown fault kind {kind!r} "
-                             "(expected 'kill', 'delay' or 'nan')")
+                             "(expected 'kill', 'delay', 'nan' or "
+                             "'bitflip')")
         if (step is None) == (seq is None):
             raise ValueError("a fault needs exactly one trigger: "
                              "step=... or seq=...")
@@ -227,6 +239,14 @@ def _fire(fault: Fault, where: str):
         # the normal grad-ready → bucket path (sentinel-detectable)
         from ..autograd import tape
         tape.poison_next_leaf_grad()
+        return
+    if fault.kind == "bitflip":
+        # arm the tape's one-shot single-bit flip on THIS thread: the
+        # next backward's first finalized leaf grad gets one low bit
+        # flipped post write-back — rank-local silent corruption the
+        # determinism ledger's cross-rank comparison must name
+        from ..autograd import tape
+        tape.flip_bit_next_leaf_grad()
         return
     # kill: mark dead FIRST so blocked survivors detect immediately,
     # then unwind this rank's thread

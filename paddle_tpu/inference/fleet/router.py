@@ -45,6 +45,7 @@ import numpy as np
 from ...framework.core import Tensor
 from ...models.generation import block_hash_chain
 from ...profiler import request_trace as _rt
+from ...profiler import ledger as _ledger
 from ..serving import ContinuousServingEngine, _engine_state
 from .quota import Rejected, TenantQuotaManager
 
@@ -524,7 +525,23 @@ class ServingRouter:
                 _rt.finish_request(ctx, status="error",
                                    error=type(ticket.error).__name__)
             raise ticket.error
-        _rt.add_event(ctx, "delivered", attempt=ticket.attempt)
+        if _ledger.is_enabled():
+            # token-stream attestation: a requeued or disagg request's
+            # delivered stream must be digest-consistent across every
+            # attempt/replica that produced tokens for it — the
+            # at-most-once resume contract, checked at runtime
+            try:
+                dg = _ledger.attest_delivery(ctx.trace_id if ctx else None,
+                                             ticket.attempt)
+            except _ledger.DivergenceError as e:
+                _rt.add_event(ctx, "attestation_failed", tensor=e.tensor)
+                _rt.finish_request(ctx, status="error",
+                                   error="DivergenceError")
+                raise
+            _rt.add_event(ctx, "delivered", attempt=ticket.attempt,
+                          **({"token_digest": dg} if dg else {}))
+        else:
+            _rt.add_event(ctx, "delivered", attempt=ticket.attempt)
         _rt.finish_request(ctx, status="ok")
         return Tensor(ticket.result)
 

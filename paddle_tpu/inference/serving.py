@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..profiler import request_trace as _rt
+from ..profiler import ledger as _ledger
 
 #: default token budget of one chunked-prefill step (overridable per
 #: engine via ``prefill_chunk_tokens=`` or PADDLE_SERVING_CHUNK_TOKENS)
@@ -353,7 +354,13 @@ class ServingEngine:
                     _rt.finish_request(trace, status="error")
                 raise req.error
             if trace_owned:
-                _rt.finish_request(trace, status="ok")
+                # thread the delivered-token-stream digest into the
+                # trace's terminal span (fleet-less attestation record)
+                dg = (_ledger.stream_digest(trace.trace_id)
+                      if _ledger.is_enabled() and trace is not None
+                      else None)
+                _rt.finish_request(trace, status="ok",
+                                   **({"token_digest": dg} if dg else {}))
             return Tensor(req.result)
         finally:
             self._inflight_reqs.pop(id(req), None)
@@ -824,6 +831,12 @@ class ContinuousServingEngine:
         tele = _telemetry()
         tele["tokens"].inc(engine=self._ENGINE)
         _rt.note_token(row.req.trace)
+        if _ledger.is_enabled() and row.req.trace is not None:
+            # determinism ledger: advance this (trace, attempt) delivered
+            # token-stream chain digest — the attestation input
+            _ledger.note_stream_token(
+                row.req.trace.trace_id,
+                row.req.trace.tags.get("attempt", 0), token)
         if row.req.t_first is None:
             row.req.t_first = time.perf_counter()
             tele["ttft"].observe(row.req.t_first - row.req.t_submit,

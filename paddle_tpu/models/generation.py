@@ -595,9 +595,15 @@ class SlotPagedKVCache:
                   for ks, vs in self._scales.values()] if self.kv_quant \
             else None
         self.pages_exported += len(pages)
-        return {"page_size": self.page_size, "digests": out_digests,
+        blob = {"page_size": self.page_size, "digests": out_digests,
                 "layers": layers, "kv_dtype": self.kv_dtype,
                 "native_dtype": str(layers[0][0].dtype), "scales": scales}
+        from ..profiler import ledger as _ledger
+        if _ledger.is_enabled():
+            # determinism ledger: seal the handoff payload so the
+            # importer can verify it arrived bit-exact
+            blob["ledger_digest"] = _ledger.seal_handoff(blob)
+        return blob
 
     def import_pages(self, blob):
         """Receiver side of the disagg handoff: allocate pages for the
@@ -627,6 +633,12 @@ class SlotPagedKVCache:
                 raise ValueError(
                     f"pool dtype mismatch: exporter {blob_native} vs "
                     f"importer {pool_dtype}")
+        from ..profiler import ledger as _ledger
+        if _ledger.is_enabled():
+            # verify a sealed blob BEFORE any page registers — a
+            # corrupted handoff must never serve tokens (raise mode) or
+            # at least be on the record (warn mode)
+            _ledger.check_handoff(blob)
         blob_scales = blob.get("scales")
         imported = 0
         for j, digest in enumerate(blob["digests"]):

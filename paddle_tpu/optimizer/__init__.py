@@ -118,9 +118,15 @@ class Optimizer:
         # step-phase span ("optimizer" slice of the training-step
         # breakdown); clock() is None when the layer is off
         from ..profiler import step_phase as _step_phase
+        from ..profiler import ledger as _ledger
         _t0 = _step_phase.clock()
         try:
-            return self._step_impl()
+            r = self._step_impl()
+            # determinism ledger: digest this step's (post-sync) grads
+            # + updated params, commit the step row, compare vs peers
+            if _ledger.is_enabled():
+                _ledger.record_optimizer_step(self)
+            return r
         finally:
             if _t0 is not None:
                 import time as _time
@@ -431,9 +437,12 @@ class Lamb(Optimizer):
     @no_grad()
     def step(self):
         from ..profiler import step_phase as _step_phase
+        from ..profiler import ledger as _ledger
         _t0 = _step_phase.clock()
         try:
             self._lamb_step_impl()
+            if _ledger.is_enabled():
+                _ledger.record_optimizer_step(self)
         finally:
             if _t0 is not None:
                 import time as _time

@@ -57,6 +57,12 @@ from . import tensor_stats  # noqa: F401
 from .tensor_stats import (  # noqa: F401  (re-exported facade)
     NumericsSentinel, NonFiniteGradError, get_sentinel,
 )
+from . import ledger  # noqa: F401
+from .ledger import (  # noqa: F401  (re-exported facade)
+    StepLedger, DivergenceError, get_ledger, tensor_digest,
+    first_divergence, publish_ledger, gather_ledgers, compare_store,
+    export_golden,
+)
 
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
@@ -75,9 +81,12 @@ __all__ = [
     "MetricsHistory", "get_history", "history", "history_tick",
     "AlertEngine", "AlertRule", "ThresholdRule", "BurnRateRule",
     "get_alert_engine", "active_alerts",
-    "step_phase", "memory", "tensor_stats",
+    "step_phase", "memory", "tensor_stats", "ledger",
     "MemoryTimeline", "module_breakdown", "register_model_breakdown",
     "NumericsSentinel", "NonFiniteGradError", "get_sentinel",
+    "StepLedger", "DivergenceError", "get_ledger", "tensor_digest",
+    "first_divergence", "publish_ledger", "gather_ledgers",
+    "compare_store", "export_golden",
 ]
 
 

@@ -63,6 +63,14 @@ def test_training_observability_catalog():
     assert not violations, violations
 
 
+def test_ledger_catalog():
+    """Every PADDLE_LEDGER* knob and paddle_ledger_* metric is cataloged
+    in docs/OBSERVABILITY.md AND exercised by a test."""
+    from check_inventory import check_ledger_catalog
+    violations = check_ledger_catalog(verbose=False)
+    assert not violations, violations
+
+
 def test_serving_program_budget():
     """Compiled-program guard: a mixed prefill+decode load stays inside
     the ragged scheduler's declared token-bucket family (no per-request

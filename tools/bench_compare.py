@@ -106,7 +106,10 @@ def load_record(path: str) -> dict:
 def flatten(rec: dict, prefix="") -> dict:
     """Numeric leaves as dotted keys. The headline ``value`` is keyed
     by the record's ``metric`` name so direction inference applies to
-    what the number *is*, not to the word 'value'."""
+    what the number *is*, not to the word 'value'. String leaves named
+    ``*_digest`` (content digests, e.g. ``serving_token_digest``) are
+    kept too — they compare exact-match, so output-content drift fails
+    the diff like a perf regression would."""
     out: dict = {}
     metric = rec.get("metric") if not prefix else None
     for k, v in rec.items():
@@ -119,6 +122,8 @@ def flatten(rec: dict, prefix="") -> dict:
             continue
         if isinstance(v, (int, float)):
             out[key] = float(v)
+        elif isinstance(v, str) and k.lower().endswith("_digest"):
+            out[key] = v
         elif isinstance(v, dict):
             out.update(flatten(v, prefix=f"{key}."))
     return out
@@ -152,6 +157,15 @@ def compare(old: dict, new: dict, threshold_pct=DEFAULT_THRESHOLD_PCT,
         va, vb = a[name], b[name]
         direction, pct = overrides.get(
             name, (direction_of(name), None))
+        if isinstance(va, str) or isinstance(vb, str):
+            # content digests: exact match or regression — no threshold
+            status = ("info" if direction == "ignore"
+                      else "ok" if va == vb else "REGRESSED")
+            rows.append({"metric": name, "old": va, "new": vb,
+                         "delta_pct": 0.0 if va == vb else 100.0,
+                         "direction": "exact", "threshold_pct": 0.0,
+                         "status": status})
+            continue
         pct = threshold_pct if pct is None else pct
         if va == 0:
             delta = 0.0 if vb == 0 else float("inf") * (1 if vb > 0 else -1)

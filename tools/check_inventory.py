@@ -349,6 +349,20 @@ INVENTORY = [
      "paddle_tpu.profiler.step_phase",
      ["PHASES", "record_phase", "span", "breakdown", "clock",
       "step_begin", "step_end"]),
+    # -- determinism observatory (ISSUE 13) ----------------------------------
+    ("Determinism ledger (digest sensing + comparator)",
+     "paddle_tpu.profiler.ledger",
+     ["StepLedger", "DivergenceError", "get_ledger", "enable", "disable",
+      "attach", "detach", "is_enabled", "tensor_digest",
+      "first_divergence", "record_optimizer_step"]),
+    ("Golden ledger export + cross-process publish",
+     "paddle_tpu.profiler.ledger",
+     ["export_golden", "publish_ledger", "gather_ledgers",
+      "compare_store", "LEDGER_SCHEMA", "KV_LEDGER_PREFIX"]),
+    ("Token-stream attestation + handoff digests",
+     "paddle_tpu.profiler.ledger",
+     ["note_stream_token", "stream_digest", "attest_delivery",
+      "seal_handoff", "check_handoff", "chain_update", "blob_digest"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -721,6 +735,61 @@ def check_training_observability(verbose=True):
     return violations
 
 
+def check_ledger_catalog(verbose=True):
+    """Determinism-observatory inventory guard: every ``PADDLE_LEDGER*``
+    env knob and every ``paddle_ledger_*`` metric referenced in
+    ``paddle_tpu/`` must be (a) cataloged in docs/OBSERVABILITY.md and
+    (b) exercised by at least one test — same rule as the fleet and
+    training observatories: a divergence sensor nobody documents or
+    tests is a sensor that lies. Returns a list of violation strings."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    knob_pat = re.compile(r"PADDLE_LEDGER[A-Z0-9_]*")
+    metric_pat = re.compile(r"paddle_ledger_[a-z0-9_]*[a-z0-9]")
+    knobs, metrics = set(), set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    text = f.read()
+                knobs.update(knob_pat.findall(text))
+                metrics.update(metric_pat.findall(text))
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              errors="replace") as f:
+        doc = f.read()
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name), errors="replace") as f:
+                tests_text += f.read()
+    violations = []
+    for k in sorted(knobs):
+        if k not in doc:
+            violations.append(
+                f"ledger knob {k} missing from docs/OBSERVABILITY.md")
+        if k not in tests_text:
+            violations.append(
+                f"ledger knob {k} not exercised by any test")
+    for m in sorted(metrics):
+        if m not in doc:
+            violations.append(
+                f"ledger metric {m} missing from docs/OBSERVABILITY.md")
+        if m not in tests_text:
+            violations.append(
+                f"ledger metric {m} not exercised by any test")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"ledger catalog: {len(knobs)} knobs, {len(metrics)} "
+              f"metrics checked")
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -749,5 +818,5 @@ if __name__ == "__main__":
     sys.exit(1 if (check() or check_strategy_docs() or check_env_docs()
                    or check_fleet_knobs() or check_observability_catalog()
                    or check_alert_catalog() or check_training_observability()
-                   or check_serving_programs())
+                   or check_ledger_catalog() or check_serving_programs())
              else 0)

@@ -1,0 +1,172 @@
+"""Golden-ledger comparator: diff two determinism-ledger JSONL exports
+and report the FIRST divergent step/tensor/request.
+
+``paddle_tpu.profiler.ledger.export_golden()`` writes a deterministic
+(timestamp-free, sorted) JSONL file of content digests: per-(rank, step)
+parameter/gradient digests, per-(trace, attempt) delivered-token-stream
+digests, and KV-handoff blob digests. Two bit-identical runs produce
+byte-identical ledgers — so CI can run a seeded job, export, and diff
+against a committed golden: the first line of this tool's output names
+the exact step and tensor (or request) where a run went off the rails,
+which is precisely the bisect anchor the "silent divergence" runbook
+(docs/RUNBOOK.md) starts from.
+
+Usage::
+
+    python tools/ledger_diff.py GOLDEN.jsonl CANDIDATE.jsonl
+    python tools/ledger_diff.py --json A.jsonl B.jsonl
+
+Exit codes: 0 ledgers identical, 1 divergence(s), 2 usage/input error.
+Same import discipline as ``bench_compare.py``: stdlib-only, no
+jax/numpy — this runs on a laptop against ledgers scp'd off the fleet.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+LEDGER_SCHEMA = "paddle_ledger/1"
+
+
+def load_ledger(path: str) -> dict:
+    """Parse one JSONL ledger into ``{"steps": {(rank, step): row},
+    "streams": {(trace, attempt): row}, "handoffs": [...]}``."""
+    steps, streams, handoffs = {}, {}, []
+    schema = None
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{ln}: not JSON ({e})") from e
+            kind = row.get("kind")
+            if kind == "meta":
+                schema = row.get("schema")
+            elif kind == "step":
+                steps[(int(row["rank"]), int(row["step"]))] = row
+            elif kind == "stream":
+                streams[(str(row["trace"]), int(row["attempt"]))] = row
+            elif kind == "handoff":
+                handoffs.append(row)
+            else:
+                raise ValueError(f"{path}:{ln}: unknown row kind {kind!r}")
+    if schema is None:
+        raise ValueError(f"{path}: no meta line (is this a ledger "
+                         f"export from export_golden()?)")
+    if schema != LEDGER_SCHEMA:
+        raise ValueError(f"{path}: schema {schema!r}, expected "
+                         f"{LEDGER_SCHEMA!r}")
+    return {"steps": steps, "streams": streams, "handoffs": handoffs}
+
+
+def _name_of(row, key):
+    """Human parameter name for a positional entry key, if recorded."""
+    kind, _, pkey = key.partition(":")
+    name = (row.get("names") or {}).get(pkey)
+    return f"{kind}:{name}" if name else key
+
+
+def diff_ledgers(a: dict, b: dict) -> list:
+    """Ordered divergence records, first (= lowest step, then rank, then
+    canonical tensor order) first. Each record:
+    ``{"kind", "step"/"trace", ..., "tensor", "a", "b"}``; a row present
+    on one side only reports digests of ``None`` on the other."""
+    out = []
+    # -- training step rows, in (step, rank) order ---------------------------
+    for (rank, step) in sorted(set(a["steps"]) | set(b["steps"]),
+                               key=lambda k: (k[1], k[0])):
+        ra, rb = a["steps"].get((rank, step)), b["steps"].get((rank, step))
+        if ra is None or rb is None:
+            out.append({"kind": "step", "step": step, "rank": rank,
+                        "tensor": "(entire row)",
+                        "a": "present" if ra else None,
+                        "b": "present" if rb else None})
+            continue
+        ea, eb = ra.get("entries", {}), rb.get("entries", {})
+        for name in sorted(set(ea) | set(eb)):
+            if ea.get(name) != eb.get(name):
+                out.append({"kind": "step", "step": step, "rank": rank,
+                            "tensor": _name_of(ra, name), "entry": name,
+                            "a": ea.get(name), "b": eb.get(name)})
+                break          # first divergent tensor of this row
+    # -- delivered-token streams, in (trace, attempt) order ------------------
+    for key in sorted(set(a["streams"]) | set(b["streams"])):
+        sa, sb = a["streams"].get(key), b["streams"].get(key)
+        da = (sa or {}).get("digest"), (sa or {}).get("count")
+        db = (sb or {}).get("digest"), (sb or {}).get("count")
+        if da != db:
+            out.append({"kind": "stream", "trace": key[0],
+                        "attempt": key[1],
+                        "tensor": f"tokens:{key[0]}",
+                        "a": da[0], "b": db[0],
+                        "count_a": da[1], "count_b": db[1]})
+    # -- handoffs, positional ------------------------------------------------
+    for i in range(max(len(a["handoffs"]), len(b["handoffs"]))):
+        ha = a["handoffs"][i] if i < len(a["handoffs"]) else None
+        hb = b["handoffs"][i] if i < len(b["handoffs"]) else None
+        if (ha or {}).get("digest") != (hb or {}).get("digest"):
+            out.append({"kind": "handoff", "index": i,
+                        "tensor": f"handoff[{i}]",
+                        "a": (ha or {}).get("digest"),
+                        "b": (hb or {}).get("digest")})
+    return out
+
+
+def render_text(divs, a_path, b_path) -> str:
+    lines = [f"ledger diff: {os.path.basename(a_path)} -> "
+             f"{os.path.basename(b_path)}"]
+    if not divs:
+        lines.append("ledgers identical")
+        return "\n".join(lines) + "\n"
+    first = divs[0]
+    if first["kind"] == "step":
+        lines.append(f"FIRST DIVERGENCE: step {first['step']} rank "
+                     f"{first['rank']} tensor {first['tensor']}")
+    elif first["kind"] == "stream":
+        lines.append(f"FIRST DIVERGENCE: request {first['trace']} "
+                     f"attempt {first['attempt']}")
+    else:
+        lines.append(f"FIRST DIVERGENCE: {first['tensor']}")
+    for d in divs:
+        where = (f"step {d['step']} rank {d['rank']}"
+                 if d["kind"] == "step"
+                 else f"request {d['trace']} attempt {d['attempt']}"
+                 if d["kind"] == "stream" else f"handoff {d['index']}")
+        lines.append(f"DIVERGED   {where:<28} {d['tensor']}  "
+                     f"{d.get('a')} != {d.get('b')}")
+    lines.append(f"{len(divs)} divergent row(s)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two golden determinism ledgers; exit 1 on "
+                    "divergence")
+    ap.add_argument("golden", help="baseline ledger (JSONL)")
+    ap.add_argument("candidate", help="candidate ledger (JSONL)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the divergence list as JSON")
+    args = ap.parse_args(argv)
+    try:
+        a = load_ledger(args.golden)
+        b = load_ledger(args.candidate)
+    except (OSError, ValueError) as e:
+        print(f"ledger_diff: {e}", file=sys.stderr)
+        return 2
+    divs = diff_ledgers(a, b)
+    if args.json:
+        json.dump({"divergences": divs, "identical": not divs},
+                  sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_text(divs, args.golden, args.candidate))
+    return 1 if divs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
