@@ -1380,6 +1380,27 @@ def bench_fleet():
     # queue wait and per-token gaps from the engine spans)
     slo = rt.slo_report()
     replay_rep = _bench_fleet_replay(model, sys_len, tail, new)
+    # chaos pair: the SAME seeded burst with a replica killed mid-run,
+    # controller-off vs controller-on — the ISSUE-14 acceptance numbers
+    # (recover_ratio > 1 means the controller recovered faster)
+    kill_spec = os.environ.get("BENCH_FLEET_FAULT",
+                               "kill:replica=r1,request=4")
+    ctl_off = _bench_fleet_replay(model, sys_len, tail, new,
+                                  fault_spec=kill_spec)
+    ctl_on = _bench_fleet_replay(model, sys_len, tail, new,
+                                 fault_spec=kill_spec, controller=True)
+    ttr_on = ctl_on.get("time_to_recover_s")
+    ttr_off = ctl_off.get("time_to_recover_s")
+    if ttr_on is None:
+        recover_ratio = None
+    elif ttr_off is None:
+        # controller-off never recovered inside its observation window:
+        # credit the whole window (a floor, not a fabrication)
+        window = max(ctl_off.get("observed_s") or 0.0, ttr_on)
+        recover_ratio = round(window / max(ttr_on, 1e-6), 2)
+    else:
+        recover_ratio = round(ttr_off / max(ttr_on, 1e-6), 2)
+    n_actions = ctl_on.get("controller_actions_total", 0)
     for name, val in (
             ("fleet_affinity_ttft_speedup", speedup),
             ("fleet_affinity_cached_tokens", aff["cached_tokens"]),
@@ -1390,7 +1411,9 @@ def bench_fleet():
             ("fleet_goodput_under_burst",
              replay_rep.get("goodput_under_burst")),
             ("fleet_time_to_recover_s",
-             replay_rep.get("time_to_recover_s"))):
+             replay_rep.get("time_to_recover_s")),
+            ("fleet_controller_recover_ratio", recover_ratio),
+            ("fleet_controller_actions", n_actions)):
         print(json.dumps({"aux_metric": name, "value": val}),
               file=sys.stderr)
     return {
@@ -1412,20 +1435,30 @@ def bench_fleet():
         "affinity_hit_rate": round(
             aff["affinity_hits"] / max(aff["affinity_matchable"], 1), 3),
         "replay": replay_rep,
+        "fleet_controller_recover_ratio": recover_ratio,
+        "fleet_controller_actions": n_actions,
+        "controller_replay": {"on": ctl_on, "off": ctl_off,
+                              "fault": kill_spec},
         "config": {"requests": n_req, "sys_prompt": sys_len, "tail": tail,
                    "new_tokens": new, "replicas": 2},
     }
 
 
-def _bench_fleet_replay(model, sys_len, tail, new):
+def _bench_fleet_replay(model, sys_len, tail, new, fault_spec=None,
+                        controller=False):
     """Seeded bursty replay against a fresh 2-replica fleet: the
     goodput-under-burst / time-to-recover measurement rig (ISSUE 11;
     ROADMAP 4's controller gets judged by exactly these numbers). SLO
     TTFT target is adaptive — 2x a measured warm-path request — so the
-    burst (not host speed) decides the violation story."""
+    burst (not host speed) decides the violation story. ``fault_spec``
+    installs a fleet fault plan (e.g. ``kill:replica=r1,request=4``)
+    for the run; ``controller=True`` runs a ``FleetController`` beside
+    the replay — the ISSUE-14 chaos pair compares the same seed with
+    the controller off vs on."""
     import numpy as np
+    from paddle_tpu.distributed import fault as flt
     from paddle_tpu.distributed.fleet.elastic.tcp_kv import MemKVStore
-    from paddle_tpu.inference import ServingRouter
+    from paddle_tpu.inference import FleetController, ServingRouter
     from paddle_tpu.inference.fleet import replay as rp
     from paddle_tpu.profiler import alerts, request_trace as rt
     from paddle_tpu.profiler import timeseries
@@ -1447,6 +1480,7 @@ def _bench_fleet_replay(model, sys_len, tail, new):
         budget=0.2, fast_window_s=1.5, slow_window_s=4.5, factor=1.0))
     engine.attach(hist)
     old_ttft = os.environ.get("PADDLE_SLO_TTFT_MS")
+    ctl = None
     try:
         with router:
             warm = np.arange(16, dtype=np.int64)[None]
@@ -1457,12 +1491,34 @@ def _bench_fleet_replay(model, sys_len, tail, new):
             os.environ["PADDLE_SLO_TTFT_MS"] = str(
                 round(max(2.0 * warm_s, 0.2) * 1e3, 1))
             rt.reset_slo_monitor()
+            if fault_spec:
+                flt.install(fault_spec)
+            if controller:
+                ctl = FleetController(
+                    router, history=hist, alert_engine=engine,
+                    cooldown_s=1.0, restart_backoff_s=0.2,
+                    interval_s=0.1, degraded_max_new=0)
+                ctl.start()
             harness = rp.ReplayHarness(
                 router, trace, vocab_size=256, history=hist,
                 alert_engine=engine, tick_interval_s=0.25,
                 recover_window_s=1.5, budget=0.2, factor=1.0)
             rep = harness.run().as_dict()
+            if ctl is not None:
+                ctl.stop()
+                rep["controller_actions_total"] = len(ctl.actions)
+                rep["controller_actions_by_kind"] = {}
+                for a in ctl.actions:
+                    k = rep["controller_actions_by_kind"]
+                    k[a.action] = k.get(a.action, 0) + 1
+            if rep.get("burst_t") and rep.get("t_end") is not None:
+                rep["observed_s"] = rep["t_end"] - rep["burst_t"][1]
     finally:
+        if ctl is not None:
+            ctl.stop()
+        if fault_spec:
+            flt.clear()
+        engine.detach()
         if old_ttft is None:
             os.environ.pop("PADDLE_SLO_TTFT_MS", None)
         else:
@@ -1471,7 +1527,8 @@ def _bench_fleet_replay(model, sys_len, tail, new):
     keep = ("preset", "seed", "schedule_digest", "requests", "ok",
             "statuses", "goodput_under_burst", "p99_ttft_under_burst_s",
             "p99_latency_s", "time_to_recover_s", "burst_requests",
-            "burst_ok", "alerts")
+            "burst_ok", "alerts", "observed_s", "controller_actions_total",
+            "controller_actions_by_kind")
     return {k: rep.get(k) for k in keep if k in rep}
 
 

@@ -24,6 +24,8 @@ Env grammar (``;``-separated directives, ``kind:key=value,...``)::
     PADDLE_FAULT_PLAN="kill:rank=2,seq=12;delay:rank=1,step=3,seconds=0.5"
     PADDLE_FAULT_PLAN="nan:rank=2,step=5"
     PADDLE_FAULT_PLAN="bitflip:rank=2,step=5"
+    PADDLE_FAULT_PLAN="kill:replica=r1,request=4"
+    PADDLE_FAULT_PLAN="stall:replica=r0,seconds=0.5"
 
 ``nan`` faults (numerics chaos — the testable trigger for the
 ``profiler.tensor_stats`` sentinel) arm the tape's one-shot
@@ -45,9 +47,24 @@ in data-parallel training the corruption stays rank-local — too small
 for the NaN sentinel, exactly what the ledger's cross-rank digest
 comparison must catch.
 
+**Serving-fleet directives** (ISSUE 14 — chaos for the fleet control
+plane) target a *replica* instead of a rank and trigger on the
+replica's N-th routed request (``request=N``, default 1; the
+``ServingRouter`` calls :func:`check_fleet_route` each time it routes a
+request to a replica):
+
+* ``kill:replica=R,request=N`` — the router hard-kills replica ``R``
+  the moment its N-th request is routed (engine aborted, in-flight work
+  requeued to survivors) — the mid-burst replica death the
+  ``FleetController`` acceptance scenario injects;
+* ``stall:replica=R,seconds=T[,request=N]`` — replica ``R``'s serve
+  loop sleeps ``T`` seconds at the next tick boundary (a GC pause /
+  preempted-host straggler: the replica lives and heartbeats, it just
+  stops making progress — SLO burn, no death signal).
+
 Every fault fires at most once. Each firing is recorded as a
 flight-recorder event and counted in
-``paddle_elastic_events_total{kind="kill"|"delay"|"nan"|"bitflip"}``.
+``paddle_elastic_events_total{kind="kill"|"delay"|"nan"|"bitflip"|"stall"}``.
 """
 from __future__ import annotations
 
@@ -60,8 +77,14 @@ from .simulator import RankFailure, SimulatedRankKill  # noqa: F401 (re-export)
 
 __all__ = [
     "Fault", "FaultPlan", "RankFailure", "SimulatedRankKill",
-    "install", "clear", "active_plan", "check_step", "elastic_telemetry",
+    "install", "clear", "active_plan", "check_step", "check_fleet_route",
+    "elastic_telemetry", "FLEET_FAULT_KINDS",
 ]
+
+#: fault kinds that target a serving-fleet replica (``replica=`` key)
+#: rather than a training rank; each appears in docs/ROBUSTNESS.md and
+#: is exercised by a test (tools/check_inventory.py enforces both)
+FLEET_FAULT_KINDS = ("kill", "stall")
 
 _ELASTIC_TELEMETRY = None
 
@@ -88,18 +111,48 @@ def elastic_telemetry():
 
 
 class Fault:
-    """One directive. ``kind`` is ``"kill"`` or ``"delay"``; exactly one
-    of ``step`` (fires at that step boundary) / ``seq`` (fires before the
-    rank's seq-th tracked collective, 1-based) selects the trigger;
-    ``seconds`` is the sleep for delay faults."""
+    """One directive. Rank faults: ``kind`` is ``"kill"``/``"delay"``/
+    ``"nan"``/``"bitflip"``; exactly one of ``step`` (fires at that step
+    boundary) / ``seq`` (fires before the rank's seq-th tracked
+    collective, 1-based) selects the trigger; ``seconds`` is the sleep
+    for delay faults. Fleet faults: ``replica=`` targets a serving
+    replica instead, ``kind`` is ``"kill"`` or ``"stall"``, and the
+    trigger is the replica's ``request``-th routed request (1-based,
+    default 1); ``seconds`` is the stall duration."""
 
-    __slots__ = ("kind", "rank", "step", "seq", "seconds", "fired")
+    __slots__ = ("kind", "rank", "step", "seq", "seconds", "fired",
+                 "replica", "request")
 
-    def __init__(self, kind, rank, step=None, seq=None, seconds=0.0):
+    def __init__(self, kind, rank=None, step=None, seq=None, seconds=0.0,
+                 replica=None, request=None):
+        if replica is not None:
+            if kind not in FLEET_FAULT_KINDS:
+                raise ValueError(
+                    f"unknown fleet fault kind {kind!r} (replica faults "
+                    f"are one of {'/'.join(FLEET_FAULT_KINDS)})")
+            if rank is not None or step is not None or seq is not None:
+                raise ValueError("replica faults trigger on request=N "
+                                 "(not rank/step/seq)")
+            if kind == "stall" and seconds <= 0:
+                raise ValueError("stall fault needs seconds > 0")
+            self.kind = kind
+            self.rank = None
+            self.step = None
+            self.seq = None
+            self.seconds = float(seconds)
+            self.replica = str(replica)
+            self.request = max(int(1 if request is None else request), 1)
+            self.fired = False
+            return
         if kind not in ("kill", "delay", "nan", "bitflip"):
             raise ValueError(f"unknown fault kind {kind!r} "
                              "(expected 'kill', 'delay', 'nan' or "
                              "'bitflip')")
+        if rank is None:
+            raise ValueError("a rank fault needs rank=")
+        if request is not None:
+            raise ValueError("request= triggers need replica= (fleet "
+                             "faults)")
         if (step is None) == (seq is None):
             raise ValueError("a fault needs exactly one trigger: "
                              "step=... or seq=...")
@@ -110,9 +163,16 @@ class Fault:
         self.step = None if step is None else int(step)
         self.seq = None if seq is None else int(seq)
         self.seconds = float(seconds)
+        self.replica = None
+        self.request = None
         self.fired = False
 
     def __repr__(self):
+        if self.replica is not None:
+            extra = (f", seconds={self.seconds:g}"
+                     if self.kind == "stall" else "")
+            return (f"Fault({self.kind}:replica={self.replica},"
+                    f"request={self.request}{extra})")
         trig = (f"step={self.step}" if self.step is not None
                 else f"seq={self.seq}")
         extra = f", seconds={self.seconds:g}" if self.kind == "delay" else ""
@@ -128,6 +188,7 @@ class FaultPlan:
         self.faults = list(faults)
         self._lock = threading.Lock()
         self._coll_seq: dict = {}        # rank -> collectives entered
+        self._route_seq: dict = {}       # replica id -> requests routed
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -146,14 +207,17 @@ class FaultPlan:
                     continue
                 k, _, v = pair.partition("=")
                 k = k.strip()
-                if k not in ("rank", "step", "seq", "seconds"):
+                if k not in ("rank", "step", "seq", "seconds", "replica",
+                             "request"):
                     raise ValueError(
                         f"unknown fault key {k!r} in {directive!r} "
-                        "(expected rank/step/seq/seconds)")
-                kw[k] = float(v) if k == "seconds" else int(v)
-            if "rank" not in kw:
-                raise ValueError(f"fault {directive!r} needs rank=")
-            faults.append(Fault(kind, kw.pop("rank"), **kw))
+                        "(expected rank/step/seq/seconds/replica/request)")
+                kw[k] = (float(v) if k == "seconds"
+                         else v.strip() if k == "replica" else int(v))
+            if "rank" not in kw and "replica" not in kw:
+                raise ValueError(f"fault {directive!r} needs rank= "
+                                 "or replica=")
+            faults.append(Fault(kind, **kw))
         return cls(faults)
 
     def collective_seq(self, rank) -> int:
@@ -177,6 +241,17 @@ class FaultPlan:
             for f in self.faults:
                 if (not f.fired and f.rank == rank and f.seq is not None
                         and seq >= f.seq):
+                    f.fired = True
+                    return f
+        return None
+
+    def _due_fleet(self, replica_id):
+        with self._lock:
+            rid = str(replica_id)
+            n = self._route_seq.get(rid, 0) + 1
+            self._route_seq[rid] = n
+            for f in self.faults:
+                if (not f.fired and f.replica == rid and n >= f.request):
                     f.fired = True
                     return f
         return None
@@ -265,6 +340,24 @@ def check_step(step: int):
     f = plan._due_step(_rank(), step)
     if f is not None:
         _fire(f, where=f"step {step}")
+
+
+def check_fleet_route(replica_id):
+    """Routing hook for the serving fleet: counts one request routed to
+    ``replica_id`` and returns a due fleet fault (or None). The caller
+    (``ServingRouter._route_locked``) APPLIES the fault — killing the
+    replica or stalling its serve loop is router/engine machinery this
+    module must not depend on. No-op without an active plan."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    f = plan._due_fleet(replica_id)
+    if f is not None:
+        from ..profiler import flight_recorder as _flight
+        elastic_telemetry()["events"].inc(kind=f.kind)
+        _flight.record_event("fault_injected", fault=repr(f),
+                             where=f"route {replica_id}")
+    return f
 
 
 def _collective_hook(rank, tag):

@@ -226,4 +226,5 @@ from .speculative import (NGramDrafter, DraftModelDrafter,   # noqa: E402,F401
                           make_drafter)
 from .fleet import (ServingRouter, Rejected,                 # noqa: E402,F401
                     TenantQuotaManager, ROUTER_POLICIES,
+                    FleetController, ControllerAction,
                     ReplayHarness, ReplayTrace, make_trace)

@@ -1,16 +1,25 @@
 """Serving fleet: a router front end over N continuous-batching engine
 replicas — prefix-cache-affinity routing, prefill/decode disaggregation,
-fleet-wide per-tenant admission quotas, and replica health/drain/rejoin
-(ROADMAP item 2; see docs/SERVING.md "Serving fleet")."""
-from .quota import Rejected, TenantQuotaManager                  # noqa: F401
-from .router import (DEFAULT_FLEET_AFFINITY, ROUTER_POLICIES,    # noqa: F401
+fleet-wide per-tenant admission quotas, replica health/drain/rejoin
+(ROADMAP item 2; see docs/SERVING.md "Serving fleet"), and the
+self-healing control plane that autoscales, re-roles, sheds and
+supervises them against SLO signals (ISSUE 14; docs/SERVING.md
+"Fleet controller")."""
+from .quota import (REJECTION_REASONS, Rejected,                 # noqa: F401
+                    TenantQuotaManager)
+from .router import (DEFAULT_FLEET_AFFINITY,                     # noqa: F401
+                     DEFAULT_FLEET_MAX_ATTEMPTS, ROUTER_POLICIES,
                      Replica, ServingRouter)
+from .controller import (CONTROLLER_ACTIONS, ControllerAction,   # noqa: F401
+                         FleetController)
 from .replay import (REPLAY_PRESETS, ReplayHarness, ReplayReport,  # noqa: F401
                      ReplayRequest, ReplayTrace, load_trace,
                      make_trace, time_to_recover)
 
 __all__ = ["ServingRouter", "Replica", "Rejected", "TenantQuotaManager",
-           "ROUTER_POLICIES", "DEFAULT_FLEET_AFFINITY",
+           "ROUTER_POLICIES", "REJECTION_REASONS",
+           "DEFAULT_FLEET_AFFINITY", "DEFAULT_FLEET_MAX_ATTEMPTS",
+           "FleetController", "ControllerAction", "CONTROLLER_ACTIONS",
            "ReplayHarness", "ReplayReport", "ReplayRequest",
            "ReplayTrace", "REPLAY_PRESETS", "load_trace", "make_trace",
            "time_to_recover"]

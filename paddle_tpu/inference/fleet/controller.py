@@ -1,0 +1,617 @@
+"""Self-healing fleet control plane: the reconcile loop that watches the
+PR-11 sensing rig and ACTS (ISSUE 14; ROADMAP item 4's controller half —
+the goodput-per-chip framing of arxiv 2605.25645 says a fleet that
+cannot resize, re-role or shed load under a burst violates SLOs for
+everyone, and the mixed prefill/decode load model of arxiv 2604.15464
+is exactly the regime where a static prefill:decode split falls over).
+
+:class:`FleetController` reconciles on an interval (or an explicit,
+deterministic :meth:`~FleetController.step` in tests). Signals in:
+``paddle.profiler.history()`` series (p95 TTFT via
+``paddle_slo_latency_seconds``, ``paddle_serving_active_requests``),
+the :class:`~...profiler.alerts.AlertEngine`'s active burn-rate rules
+(or an internal :class:`~...profiler.alerts.BurnRateRule` when no
+engine is shared), and the router's live replica snapshot (alive,
+role, load tokens, queue depth). Actions out — always through the
+router's EXISTING actuators, never around them:
+
+* **autoscale** — ``router.add_replica`` joins a spare engine from the
+  warm pool under overload; sustained idleness drains the least-loaded
+  replica back into the pool (``drain`` -> ``remove_replica``).
+  Hysteresis is structural: distinct up (load/burn) and down
+  (``down_idle_s`` of observed zero load) conditions plus a per-action
+  cooldown (``PADDLE_CONTROLLER_COOLDOWN_S``) mean a steady workload
+  can never make the controller flap.
+* **role flip** — when the per-replica prefill:decode pressure ratio
+  crosses ``flip_ratio`` (disaggregated fleets), one replica from the
+  overstaffed side takes the drain -> ``rejoin(role=...)`` path; each
+  side always keeps at least one replica.
+* **graceful degradation** — under sustained SLO burn the heaviest
+  tenant's quota bucket is tightened (``TenantQuotaManager.shed``) and
+  the per-request decode budget capped (``router.max_new_cap``)
+  *before* compliant tenants miss SLO; both restore once the burn has
+  stayed clear for a cooldown. Still burning? The next-heaviest tenant
+  sheds on the following cooldown (escalation).
+* **supervision** — dead replicas restart (``rejoin``) behind an
+  exponential backoff; ``breaker_n`` deaths inside
+  ``breaker_window_s`` trips the circuit breaker: the replica is
+  quarantined (never auto-restarted again) and the
+  ``controller_quarantine`` page-severity alert fires instead of a
+  restart loop. ``release(rid)`` is the operator's reset.
+
+Every decision is a structured :class:`ControllerAction`: appended to
+the bounded action ledger, counted in
+``paddle_controller_actions_total{action,reason}``, recorded as a
+flight-recorder ``controller`` event, and carried by the
+``fleet_controller`` watchdog state provider — the ledger of *why* the
+fleet changed shape is inspectable after the fact
+(``tools/fleet_console.py`` renders it from dumps).
+
+Chaos proof: the ``PADDLE_FAULT_PLAN`` grammar's fleet directives
+(``kill:replica=R,request=N``, ``stall:replica=R,seconds=T``) inject
+the failures, and the PR-11 replay rig measures the outcome
+(``fleet_time_to_recover_s`` controller-on vs controller-off,
+``BENCH_MODEL=fleet``).
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["FleetController", "ControllerAction", "CONTROLLER_ACTIONS"]
+
+#: every action kind the controller can emit (the
+#: ``paddle_controller_actions_total{action=}`` values);
+#: tools/check_inventory.py requires each documented AND tested
+CONTROLLER_ACTIONS = ("scale_up", "scale_down", "role_flip", "restart",
+                      "quarantine", "shed", "restore")
+
+_TELEMETRY = None
+
+
+def _telemetry():
+    global _TELEMETRY
+    if _TELEMETRY is None:
+        from ...profiler.telemetry import get_registry
+        r = get_registry()
+        _TELEMETRY = {
+            "actions": r.counter(
+                "paddle_controller_actions_total",
+                "fleet-controller reconcile decisions, by action kind "
+                "and trigger reason",
+                labels=("action", "reason")),
+            "quarantined": r.gauge(
+                "paddle_controller_quarantined_replicas",
+                "replicas the circuit breaker has quarantined (page on "
+                "> 0: a replica is dying faster than restarts help)"),
+            "degraded": r.gauge(
+                "paddle_controller_degraded",
+                "1 while graceful degradation (tenant shed / decode "
+                "cap) is in force, else 0"),
+        }
+    return _TELEMETRY
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return int(default)
+
+
+class ControllerAction:
+    """One reconcile decision: what the controller did, why, to whom,
+    and the trigger metric value that justified it."""
+
+    __slots__ = ("t", "action", "reason", "target", "value", "detail",
+                 "cooldown_s")
+
+    def __init__(self, t, action, reason, target=None, value=None,
+                 detail="", cooldown_s=0.0):
+        self.t = float(t)
+        self.action = str(action)
+        self.reason = str(reason)
+        self.target = None if target is None else str(target)
+        self.value = None if value is None else float(value)
+        self.detail = str(detail)
+        self.cooldown_s = float(cooldown_s)
+
+    def as_dict(self) -> dict:
+        return {"t": round(self.t, 6), "action": self.action,
+                "reason": self.reason, "target": self.target,
+                "value": self.value, "detail": self.detail,
+                "cooldown_s": self.cooldown_s}
+
+    def __repr__(self):
+        tgt = f" target={self.target}" if self.target else ""
+        return (f"<ControllerAction {self.action}({self.reason}){tgt} "
+                f"t={self.t:.3f}>")
+
+
+class FleetController:
+    """SLO-driven reconcile loop over a :class:`~.router.ServingRouter`.
+
+    ctl = FleetController(router, warm_pool=[spare_engine],
+                          alert_engine=engine, history=hist)
+    ctl.start()              # background reconcile thread
+    ...
+    ctl.stop()
+
+    or deterministically (tests / replay): ``ctl.step(now=t)``.
+
+    Knobs (constructor kwargs win over env):
+
+    * ``interval_s`` / ``PADDLE_CONTROLLER_INTERVAL_S`` (0.5) — wall
+      seconds between background reconciles;
+    * ``cooldown_s`` / ``PADDLE_CONTROLLER_COOLDOWN_S`` (5.0) — minimum
+      spacing between two actions of the SAME kind (flap prevention);
+    * ``up_load_tokens`` / ``PADDLE_CONTROLLER_UP_LOAD_TOKENS`` (256) —
+      mean live token load per alive replica that triggers scale-up;
+    * ``down_idle_s`` / ``PADDLE_CONTROLLER_DOWN_IDLE_S`` (10.0) —
+      sustained zero-load seconds before a replica drains to the pool;
+    * ``flip_ratio`` / ``PADDLE_CONTROLLER_FLIP_RATIO`` (4.0) —
+      per-replica pressure ratio between decode and prefill sides that
+      triggers a role flip;
+    * ``breaker_n`` / ``PADDLE_CONTROLLER_BREAKER_N`` (3) and
+      ``breaker_window_s`` / ``PADDLE_CONTROLLER_BREAKER_WINDOW_S``
+      (60.0) — deaths inside the window that trip quarantine;
+    * ``restart_backoff_s`` / ``PADDLE_CONTROLLER_RESTART_BACKOFF_S``
+      (0.5) — base of the exponential restart backoff;
+    * ``degraded_max_new`` / ``PADDLE_CONTROLLER_DEGRADED_MAX_NEW``
+      (0 = off) — per-request decode cap applied while degraded;
+    * ``shed_scale`` / ``PADDLE_CONTROLLER_SHED_SCALE`` (0.5) — quota
+      scale applied to the heaviest tenant while degraded (0 rejects
+      it outright).
+    """
+
+    def __init__(self, router, history=None, alert_engine=None,
+                 warm_pool=(), min_replicas=1, max_replicas=None,
+                 interval_s=None, cooldown_s=None, up_load_tokens=None,
+                 down_idle_s=None, flip_ratio=None, breaker_n=None,
+                 breaker_window_s=None, restart_backoff_s=None,
+                 degraded_max_new=None, shed_scale=None, burn_rule=None,
+                 drain_timeout_s=10.0):
+        self.router = router
+        if history is None:
+            from ...profiler.timeseries import get_history
+            history = get_history()
+        self.history = history
+        self.alert_engine = alert_engine
+        self.warm_pool = list(warm_pool)
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = (int(max_replicas) if max_replicas is not None
+                             else len(router.replicas) + len(self.warm_pool))
+        self.interval_s = (float(interval_s) if interval_s is not None
+                           else _env_float("PADDLE_CONTROLLER_INTERVAL_S",
+                                           0.5))
+        self.cooldown_s = (float(cooldown_s) if cooldown_s is not None
+                           else _env_float("PADDLE_CONTROLLER_COOLDOWN_S",
+                                           5.0))
+        self.up_load_tokens = (
+            float(up_load_tokens) if up_load_tokens is not None
+            else _env_float("PADDLE_CONTROLLER_UP_LOAD_TOKENS", 256.0))
+        self.down_idle_s = (
+            float(down_idle_s) if down_idle_s is not None
+            else _env_float("PADDLE_CONTROLLER_DOWN_IDLE_S", 10.0))
+        self.flip_ratio = (
+            float(flip_ratio) if flip_ratio is not None
+            else _env_float("PADDLE_CONTROLLER_FLIP_RATIO", 4.0))
+        self.breaker_n = (
+            int(breaker_n) if breaker_n is not None
+            else _env_int("PADDLE_CONTROLLER_BREAKER_N", 3))
+        self.breaker_window_s = (
+            float(breaker_window_s) if breaker_window_s is not None
+            else _env_float("PADDLE_CONTROLLER_BREAKER_WINDOW_S", 60.0))
+        self.restart_backoff_s = (
+            float(restart_backoff_s) if restart_backoff_s is not None
+            else _env_float("PADDLE_CONTROLLER_RESTART_BACKOFF_S", 0.5))
+        self.degraded_max_new = (
+            int(degraded_max_new) if degraded_max_new is not None
+            else _env_int("PADDLE_CONTROLLER_DEGRADED_MAX_NEW", 0))
+        self.shed_scale = (
+            float(shed_scale) if shed_scale is not None
+            else _env_float("PADDLE_CONTROLLER_SHED_SCALE", 0.5))
+        self.drain_timeout_s = float(drain_timeout_s)
+        if burn_rule is None:
+            from ...profiler.alerts import BurnRateRule
+            burn_rule = BurnRateRule(name="controller_burn",
+                                     fast_window_s=2.0, slow_window_s=6.0)
+        self._own_burn = burn_rule
+        self._lock = threading.RLock()
+        self.actions: list = []          # bounded ControllerAction ledger
+        self._last: dict = {}            # action kind -> last fire t
+        self._was_alive: dict = {}       # rid -> last observed liveness
+        self._fails: dict = {}           # rid -> recent death times
+        self._next_restart: dict = {}    # rid -> earliest restart t
+        self._quarantined: set = set()
+        self._idle_since = None
+        self._burn_clear_since = None
+        self._degraded = False
+        self._shed_tenants: list = []
+        self._saved_cap = None
+        self._stop_evt = threading.Event()
+        self._thread = None
+        self._running = False
+        self._flight_key = None
+        self.steps = 0
+        if self.alert_engine is not None:
+            # the breaker's page: quarantining a replica must raise a
+            # page-severity alert instead of silently shrinking the
+            # fleet (evaluated on the shared history's tick timeline)
+            from ...profiler.alerts import ThresholdRule
+            self.alert_engine.add_rule(ThresholdRule(
+                name="controller_quarantine",
+                metric="paddle_controller_quarantined_replicas",
+                above=0, severity="page"))
+        _telemetry()["quarantined"].set(0)
+        _telemetry()["degraded"].set(0)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        if self._running:
+            return self
+        self._running = True
+        self._stop_evt.clear()
+        from ...profiler import flight_recorder as _flight
+        self._flight_key = "fleet_controller"
+        _flight.register_state_provider(self._flight_key, self.state)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="paddle-fleet-controller")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if not self._running:
+            return
+        self._running = False
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._flight_key is not None:
+            from ...profiler import flight_recorder as _flight
+            _flight.unregister_state_provider(self._flight_key)
+            self._flight_key = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def _loop(self):
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception:    # a bad reconcile must not kill the loop
+                pass
+
+    # -- signals -------------------------------------------------------------
+    def _burning(self, now):
+        """(is the SLO burning, trigger value): active burn-rate rule on
+        the shared alert engine, else the internal rule over the
+        history."""
+        if self.alert_engine is not None:
+            with self.alert_engine._lock:
+                for name, ent in self.alert_engine.active.items():
+                    rule = self.alert_engine.rules.get(name)
+                    if rule is not None and rule.kind == "burn_rate":
+                        return True, ent.get("value")
+            return False, None
+        try:
+            return (self._own_burn.breached(self.history, now),
+                    self._own_burn.value(self.history, now))
+        except Exception:
+            return False, None
+
+    def _ttft_over_target(self):
+        """p95 TTFT (from the history's SLO gauge series) over the
+        ``PADDLE_SLO_TTFT_MS`` target — the latency face of overload."""
+        target_ms = _env_float("PADDLE_SLO_TTFT_MS", 0.0)
+        if target_ms <= 0:
+            return False, None
+        p = self.history.latest("paddle_slo_latency_seconds", "ttft,p95")
+        if p is None:
+            return False, None
+        return p[1] * 1e3 > target_ms, p[1]
+
+    def _snapshot(self):
+        with self.router._lock:
+            return [{"rid": r.id, "alive": r.alive,
+                     "draining": r.draining, "role": r.role,
+                     "load": r.load_tokens, "queue": r.queue_depth,
+                     "inflight": len(r.inflight)}
+                    for r in self.router.replicas]
+
+    # -- the reconcile -------------------------------------------------------
+    def step(self, now=None) -> list:
+        """One reconcile pass; returns the actions taken (possibly
+        empty). Deterministic under an explicit ``now`` (the history
+        clock) — the unit tests drive it sample-aligned."""
+        if not self.router._started:
+            return []
+        now = self.history.now() if now is None else float(now)
+        out = []
+        with self._lock:
+            self.steps += 1
+        burning, burn_value = self._burning(now)
+        snap = self._snapshot()
+        out += self._supervise(now, snap)
+        out += self._degrade(now, burning, burn_value)
+        snap = self._snapshot()              # supervision may have acted
+        alive = [s for s in snap if s["alive"] and not s["draining"]]
+        total_load = sum(s["load"] for s in alive)
+        total_queue = sum(s["queue"] for s in alive)
+        out += self._scale_up(now, alive, total_load, burning, burn_value)
+        out += self._scale_down(now, alive, total_load, total_queue)
+        out += self._role_flip(now, alive)
+        return out
+
+    def _cool(self, action, now) -> bool:
+        last = self._last.get(action)
+        return last is None or (now - last) >= self.cooldown_s
+
+    def _act(self, now, action, reason, target=None, value=None,
+             detail=""):
+        rec = ControllerAction(now, action, reason, target=target,
+                               value=value, detail=detail,
+                               cooldown_s=self.cooldown_s)
+        with self._lock:
+            self.actions.append(rec)
+            del self.actions[:-128]
+            self._last[action] = now
+        _telemetry()["actions"].inc(action=action, reason=reason)
+        from ...profiler import flight_recorder as _flight
+        _flight.record_event("controller", action=action, reason=reason,
+                             target=target,
+                             value=None if value is None else float(value))
+        return rec
+
+    # -- supervision: restart / circuit breaker ------------------------------
+    def _supervise(self, now, snap) -> list:
+        out = []
+        for s in snap:
+            rid = s["rid"]
+            if s["draining"]:
+                continue
+            if s["alive"]:
+                self._was_alive[rid] = True
+                continue
+            if self._was_alive.get(rid, True):
+                # fresh death observed: one breaker strike, backoff grows
+                # with the strike count inside the window
+                self._was_alive[rid] = False
+                fails = self._fails.setdefault(rid, [])
+                fails.append(now)
+                fails[:] = [t for t in fails
+                            if now - t <= self.breaker_window_s]
+                self._next_restart[rid] = now + (
+                    self.restart_backoff_s * (2 ** max(len(fails) - 1, 0)))
+                if (len(fails) >= self.breaker_n
+                        and rid not in self._quarantined):
+                    self._quarantined.add(rid)
+                    _telemetry()["quarantined"].set(len(self._quarantined))
+                    out.append(self._act(
+                        now, "quarantine", "breaker_tripped", target=rid,
+                        value=len(fails),
+                        detail=f"{len(fails)} deaths in "
+                               f"{self.breaker_window_s:g}s"))
+                    continue
+            if rid in self._quarantined:
+                continue
+            if now >= self._next_restart.get(rid, now):
+                try:
+                    eng = self.router._replica(rid).engine
+                    th = getattr(eng, "_thread", None)
+                    if th is not None and th.is_alive():
+                        # the aborted serve loop is still winding down:
+                        # restarting now would race its queue drain —
+                        # next pass (the backoff already spaced us out)
+                        continue
+                    self.router.rejoin(rid)
+                except Exception:
+                    # engine would not come back: another strike's worth
+                    # of backoff before the next try
+                    self._next_restart[rid] = now + (
+                        self.restart_backoff_s
+                        * (2 ** len(self._fails.get(rid, []))))
+                    continue
+                self._was_alive[rid] = True
+                out.append(self._act(
+                    now, "restart", "replica_dead", target=rid,
+                    value=len(self._fails.get(rid, []))))
+        return out
+
+    def release(self, rid):
+        """Operator reset: lift a quarantine (and its breaker strikes)
+        so supervision may restart the replica again — the RUNBOOK.md
+        "fleet won't recover" escape hatch."""
+        rid = str(rid)
+        with self._lock:
+            self._quarantined.discard(rid)
+            self._fails.pop(rid, None)
+            self._next_restart.pop(rid, None)
+        _telemetry()["quarantined"].set(len(self._quarantined))
+
+    # -- graceful degradation ------------------------------------------------
+    def _degrade(self, now, burning, burn_value) -> list:
+        out = []
+        quota = self.router.quota
+        if burning:
+            self._burn_clear_since = None
+            if not self._cool("shed", now):
+                return out
+            shed = None
+            if quota is not None:
+                for tenant in quota.tenants_by_usage():
+                    if tenant not in self._shed_tenants:
+                        quota.shed(tenant, self.shed_scale)
+                        self._shed_tenants.append(tenant)
+                        shed = tenant
+                        break
+            capped = False
+            if not self._degraded and self.degraded_max_new > 0:
+                self._saved_cap = self.router.max_new_cap
+                self.router.max_new_cap = self.degraded_max_new
+                capped = True
+            if shed is not None or capped:
+                self._degraded = True
+                _telemetry()["degraded"].set(1)
+                out.append(self._act(
+                    now, "shed", "slo_burn", target=shed,
+                    value=burn_value,
+                    detail=(f"quota x{self.shed_scale:g}"
+                            if shed else "") + (
+                        f" max_new<={self.degraded_max_new}"
+                        if capped else "")))
+        else:
+            if self._burn_clear_since is None:
+                self._burn_clear_since = now
+            if (self._degraded
+                    and now - self._burn_clear_since >= self.cooldown_s
+                    and self._cool("restore", now)):
+                if quota is not None:
+                    for tenant in self._shed_tenants:
+                        quota.restore(tenant)
+                restored = list(self._shed_tenants)
+                self._shed_tenants = []
+                self.router.max_new_cap = self._saved_cap
+                self._saved_cap = None
+                self._degraded = False
+                _telemetry()["degraded"].set(0)
+                out.append(self._act(
+                    now, "restore", "recovered",
+                    target=",".join(restored) or None,
+                    detail="quota + decode cap restored"))
+        return out
+
+    # -- autoscale -----------------------------------------------------------
+    def _scale_up(self, now, alive, total_load, burning, burn_value):
+        if not self.warm_pool or len(alive) >= self.max_replicas:
+            return []
+        mean_load = total_load / max(len(alive), 1)
+        slow, ttft = self._ttft_over_target()
+        if burning:
+            reason, value = "slo_burn", burn_value
+        elif alive and mean_load >= self.up_load_tokens:
+            reason, value = "overload", mean_load
+        elif slow:
+            reason, value = "ttft_over_target", ttft
+        else:
+            return []
+        if not self._cool("scale_up", now):
+            return []
+        role = "mixed"
+        if self.router.disagg:
+            pre = [s for s in alive if s["role"] == "prefill"]
+            dec = [s for s in alive if s["role"] == "decode"]
+            pre_pr = sum(s["load"] + s["queue"] for s in pre) \
+                / max(len(pre), 1)
+            dec_pr = sum(s["load"] + s["queue"] for s in dec) \
+                / max(len(dec), 1)
+            role = "decode" if dec_pr >= pre_pr else "prefill"
+        engine = self.warm_pool.pop()
+        try:
+            rep = self.router.add_replica(engine, role=role)
+        except Exception:
+            self.warm_pool.append(engine)
+            return []
+        return [self._act(now, "scale_up", reason, target=rep.id,
+                          value=value, detail=f"role={role}")]
+
+    def _scale_down(self, now, alive, total_load, total_queue):
+        busy = total_load > 0 or total_queue > 0 \
+            or any(s["inflight"] for s in alive)
+        if busy:
+            self._idle_since = None
+            return []
+        if self._idle_since is None:
+            self._idle_since = now
+            return []
+        if (now - self._idle_since < self.down_idle_s
+                or len(alive) <= self.min_replicas
+                or not self._cool("scale_down", now)):
+            return []
+        cands = list(alive)
+        if self.router.disagg:
+            # each role keeps at least one replica
+            by_role = {}
+            for s in alive:
+                by_role.setdefault(s["role"], []).append(s)
+            cands = [s for s in alive if len(by_role[s["role"]]) > 1]
+        if not cands:
+            return []
+        victim = min(cands, key=lambda s: (s["load"], s["rid"]))
+        try:
+            self.router.drain(victim["rid"],
+                              timeout=self.drain_timeout_s)
+            engine = self.router.remove_replica(victim["rid"])
+        except Exception:
+            return []               # raced with fresh work: not idle
+        self.warm_pool.append(engine)
+        # forget supervision state for the retired identity
+        self._was_alive.pop(victim["rid"], None)
+        self._fails.pop(victim["rid"], None)
+        return [self._act(now, "scale_down", "idle",
+                          target=victim["rid"],
+                          value=now - self._idle_since)]
+
+    # -- role flipping -------------------------------------------------------
+    def _role_flip(self, now, alive):
+        if not self.router.disagg or not self._cool("role_flip", now):
+            return []
+        pre = [s for s in alive if s["role"] == "prefill"]
+        dec = [s for s in alive if s["role"] == "decode"]
+        if not pre or not dec:
+            return []
+        pre_pr = sum(s["load"] + s["queue"] for s in pre) / len(pre) + 1.0
+        dec_pr = sum(s["load"] + s["queue"] for s in dec) / len(dec) + 1.0
+        if dec_pr / pre_pr >= self.flip_ratio and len(pre) > 1:
+            donor_side, new_role, ratio = pre, "decode", dec_pr / pre_pr
+        elif pre_pr / dec_pr >= self.flip_ratio and len(dec) > 1:
+            donor_side, new_role, ratio = dec, "prefill", pre_pr / dec_pr
+        else:
+            return []
+        donor = min(donor_side, key=lambda s: (s["load"], s["rid"]))
+        try:
+            self.router.drain(donor["rid"], timeout=self.drain_timeout_s)
+            self.router.rejoin(donor["rid"], role=new_role)
+        except Exception:
+            return []               # busy donor: try again next pass
+        return [self._act(now, "role_flip", "queue_imbalance",
+                          target=donor["rid"], value=ratio,
+                          detail=f"-> {new_role}")]
+
+    # -- observability -------------------------------------------------------
+    def state(self) -> dict:
+        """The ``fleet_controller`` state-provider payload (watchdog
+        dumps, ``tools/fleet_console.py``)."""
+        now = self.history.now()
+        with self._lock:
+            return {
+                "running": self._running,
+                "steps": self.steps,
+                "interval_s": self.interval_s,
+                "cooldown_s": self.cooldown_s,
+                "cooldowns": {
+                    a: round(max(self._last[a] + self.cooldown_s - now,
+                                 0.0), 3)
+                    for a in sorted(self._last)},
+                "recent_actions": [a.as_dict()
+                                   for a in self.actions[-16:]],
+                "quarantined": sorted(self._quarantined),
+                "degraded": self._degraded,
+                "shed_tenants": list(self._shed_tenants),
+                "max_new_cap": self.router.max_new_cap,
+                "warm_pool": len(self.warm_pool),
+                "failures": {rid: len(ts)
+                             for rid, ts in sorted(self._fails.items())
+                             if ts},
+            }

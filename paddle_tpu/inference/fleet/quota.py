@@ -11,15 +11,25 @@ caller learns immediately instead of burning its timeout in a queue.
 """
 from __future__ import annotations
 
+import threading
 import time
+
+#: every structured rejection reason the fleet can emit; each must be
+#: documented in docs/SERVING.md AND exercised by a test
+#: (tools/check_inventory.py::check_controller_catalog enforces both)
+REJECTION_REASONS = ("tenant_quota", "queue_full", "no_replicas",
+                     "attempts_exhausted")
 
 
 class Rejected(RuntimeError):
     """Structured fleet admission rejection — NOT a timeout. ``reason``
     is one of ``tenant_quota`` (the tenant's fleet-wide token budget is
     spent), ``queue_full`` (every live replica is over the router's
-    queue-token backpressure bound), or ``no_replicas`` (no healthy
-    replica can take the request)."""
+    queue-token backpressure bound), ``no_replicas`` (every replica is
+    dead or draining — failed immediately, never after a timeout), or
+    ``attempts_exhausted`` (the request's requeue budget
+    ``PADDLE_FLEET_MAX_ATTEMPTS`` ran out ping-ponging across dying
+    replicas)."""
 
     def __init__(self, reason, detail="", tenant=None):
         self.reason = str(reason)
@@ -56,11 +66,45 @@ class TenantQuotaManager:
         self.refill_per_s = float(refill_per_s)
         self.ns = namespace
         self.overrides = dict(overrides or {})
+        self._lock = threading.Lock()
+        self._shed: dict = {}          # tenant -> scale in (0, 1]
+        self._seen: set = set()        # tenants this manager admitted
 
     def _limits(self, tenant):
         cap, rate = self.overrides.get(
             tenant, (self.capacity, self.refill_per_s))
-        return int(cap), float(rate)
+        with self._lock:
+            scale = self._shed.get(tenant, 1.0)
+        return int(cap * scale), float(rate * scale)
+
+    # -- graceful degradation (the FleetController's shed actuator) ----------
+    def shed(self, tenant, scale):
+        """Tighten ``tenant``'s bucket to ``scale`` x its configured
+        capacity+refill (controller-local, not fleet-wide KV state: one
+        controller owns the fleet's degradation posture). ``scale=0``
+        rejects the tenant outright until :meth:`restore`."""
+        with self._lock:
+            self._shed[str(tenant)] = min(max(float(scale), 0.0), 1.0)
+
+    def restore(self, tenant=None):
+        """Undo :meth:`shed` for one tenant (or all when None)."""
+        with self._lock:
+            if tenant is None:
+                self._shed.clear()
+            else:
+                self._shed.pop(str(tenant), None)
+
+    def shed_scales(self) -> dict:
+        with self._lock:
+            return dict(self._shed)
+
+    def tenants_by_usage(self) -> list:
+        """Tenants this manager has admitted, heaviest consumer first —
+        the controller's shed-candidate order (an unlimited tenant can
+        still be the hog)."""
+        with self._lock:
+            seen = sorted(self._seen)
+        return sorted(seen, key=lambda t: -self.usage(t))
 
     def _key(self, tenant, leaf):
         return f"{self.ns}/quota/{tenant}/{leaf}"
@@ -71,10 +115,30 @@ class TenantQuotaManager:
         for an unlimited tenant — the router's admission trace span
         records it); raises :class:`Rejected` (reason ``tenant_quota``)
         when the bucket cannot cover the cost."""
+        with self._lock:
+            self._seen.add(str(tenant))
+            scale = self._shed.get(tenant, 1.0)
         cap, rate = self._limits(tenant)
-        if cap <= 0:
-            return None
+        if scale <= 0.0:
+            # fully shed (controller degradation): reject outright even
+            # for an otherwise-unlimited tenant
+            raise Rejected("tenant_quota", tenant=tenant,
+                           detail="tenant shed by the fleet controller")
         cost = max(int(cost_tokens), 1)
+        if cap <= 0:
+            base_cap, _ = self.overrides.get(
+                tenant, (self.capacity, self.refill_per_s))
+            if int(base_cap) > 0:
+                # a configured budget scaled below one whole token:
+                # nothing can fit — same outcome as fully shed
+                raise Rejected("tenant_quota", tenant=tenant,
+                               detail="tenant shed by the fleet "
+                                      "controller")
+            # unlimited tenant: no budget check, but the consumed-token
+            # counter still advances — the controller's shed-candidate
+            # ranking (tenants_by_usage) needs the hog visible
+            self.store.incr(self._key(tenant, "used"), cost)
+            return None
         t0_key = self._key(tenant, "t0")
         t0 = self.store.get(t0_key)
         if t0 is None:

@@ -42,12 +42,18 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ...distributed import fault as _fault
 from ...framework.core import Tensor
 from ...models.generation import block_hash_chain
 from ...profiler import request_trace as _rt
 from ...profiler import ledger as _ledger
-from ..serving import ContinuousServingEngine, _engine_state
+from ..serving import ContinuousServingEngine, _Control, _engine_state
 from .quota import Rejected, TenantQuotaManager
+
+#: per-request requeue budget (PADDLE_FLEET_MAX_ATTEMPTS): a request
+#: whose replica dies under it requeues at most this many times before
+#: failing with a structured Rejected(reason="attempts_exhausted")
+DEFAULT_FLEET_MAX_ATTEMPTS = 3
 
 #: every routing-decision label the router can emit (the
 #: ``paddle_fleet_routed_total{policy=}`` values); tools/check_inventory.py
@@ -209,7 +215,8 @@ class ServingRouter:
                  affinity=None, disagg=None, prefill_replicas=1,
                  quota=None, tenant_quotas=None, max_queue_tokens=None,
                  heartbeat_interval=0.5, heartbeat_ttl=None,
-                 health_interval=None, namespace="fleet"):
+                 health_interval=None, namespace="fleet",
+                 max_attempts=None):
         if engines is None:
             if model is None:
                 raise ValueError("ServingRouter needs a model or engines=")
@@ -232,6 +239,15 @@ class ServingRouter:
             max_queue_tokens = int(os.environ.get(
                 "PADDLE_FLEET_MAX_QUEUE_TOKENS", "0"))
         self.max_queue_tokens = int(max_queue_tokens)
+        if max_attempts is None:
+            max_attempts = int(os.environ.get(
+                "PADDLE_FLEET_MAX_ATTEMPTS",
+                str(DEFAULT_FLEET_MAX_ATTEMPTS)))
+        self.max_attempts = max(int(max_attempts), 1)
+        # per-request decode cap the FleetController lowers under
+        # sustained SLO burn (graceful degradation) and restores on
+        # recovery; None = serve what the client asked for
+        self.max_new_cap = None
         if store is None:
             from ...distributed.fleet.elastic.tcp_kv import MemKVStore
             store = MemKVStore()
@@ -247,6 +263,7 @@ class ServingRouter:
         self.replicas = [Replica(f"r{i}", eng, role)
                          for i, (eng, role) in enumerate(zip(engines,
                                                              roles))]
+        self._rid_counter = len(self.replicas)   # add_replica ids
         self.page_size = int(self.replicas[0].engine.page_size)
         if quota is None:
             default_cap = int(os.environ.get("PADDLE_FLEET_TENANT_TOKENS",
@@ -311,10 +328,7 @@ class ServingRouter:
         _flight.register_state_provider(self._flight_key, self._state)
         self._started = True
         for r in self.replicas:
-            t = threading.Thread(target=self._heartbeat_loop, args=(r,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._spawn_heartbeat(r)
         t = threading.Thread(target=self._health_loop, daemon=True)
         t.start()
         self._threads.append(t)
@@ -358,9 +372,17 @@ class ServingRouter:
         _flight.publish_component_state(self.store, self._hb_key(replica),
                                         state)
 
+    def _spawn_heartbeat(self, replica):
+        t = threading.Thread(target=self._heartbeat_loop, args=(replica,),
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
+
     def _heartbeat_loop(self, replica):
         tele = _telemetry()
         while not self._stop_evt.wait(self.heartbeat_interval):
+            if replica not in self.replicas:
+                return               # removed (scaled down to warm pool)
             if replica.heartbeating and replica.alive:
                 try:
                     self._publish_heartbeat(replica)
@@ -426,12 +448,18 @@ class ServingRouter:
             r.frontier.clear()
         return r
 
-    def rejoin(self, rid):
+    def rejoin(self, rid, role=None):
         """Bring a drained (or dead-and-recovered) replica back into the
-        routable set with a fresh engine lifecycle."""
+        routable set with a fresh engine lifecycle. ``role=`` rejoins it
+        under a new role — the drain -> rejoin-with-new-role path is the
+        FleetController's role-flip actuator."""
         r = self._replica(rid)
         if r.alive:
             return r
+        if role is not None:
+            if role not in ("mixed", "prefill", "decode"):
+                raise ValueError(f"unknown replica role {role!r}")
+            r.role = role
         r.engine.start()
         with self._lock:
             r.alive = True
@@ -439,6 +467,43 @@ class ServingRouter:
             r.heartbeating = True
         self._publish_heartbeat(r)
         return r
+
+    def add_replica(self, engine, role="mixed", rid=None):
+        """Join a spare engine to the fleet (the controller's scale-up
+        actuator: warm-pool engines enter here). Started routers start
+        the engine and begin heartbeating immediately; ids are never
+        reused, so a scaled-down-then-up replica is a fresh identity."""
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown replica role {role!r}")
+        with self._lock:
+            if rid is None:
+                rid = f"r{self._rid_counter}"
+                self._rid_counter += 1
+            elif any(r.id == str(rid) for r in self.replicas):
+                raise ValueError(f"replica id {rid!r} already in fleet")
+            r = Replica(rid, engine, role)
+            self.replicas.append(r)
+        if self._started:
+            r.engine.start()
+            with self._lock:
+                r.alive = True
+                r.heartbeating = True
+            self._publish_heartbeat(r)
+            self._spawn_heartbeat(r)
+        return r
+
+    def remove_replica(self, rid):
+        """Detach a drained/dead replica from the fleet and return its
+        engine (the controller's scale-down actuator parks it back in
+        the warm pool). Refuses to remove a live replica — drain
+        first."""
+        r = self._replica(rid)
+        if r.alive:
+            raise RuntimeError(f"replica {rid} is alive: drain() before "
+                               "remove_replica()")
+        with self._lock:
+            self.replicas.remove(r)
+        return r.engine
 
     def _replica(self, rid):
         for r in self.replicas:
@@ -465,6 +530,12 @@ class ServingRouter:
                              "above the router)")
         if chain is None:
             chain = block_hash_chain(ids[0], self.page_size)
+        cap = self.max_new_cap
+        if cap is not None and int(cap) > 0:
+            # graceful degradation: under sustained burn the controller
+            # lowers the per-request decode budget before compliant
+            # tenants miss SLO (restored when the burn clears)
+            max_new_tokens = min(int(max_new_tokens), int(cap))
         cost = ids.shape[1] + int(max_new_tokens)
         tele = _telemetry()
         # the trace is minted BEFORE admission: rejections must trace too
@@ -474,6 +545,17 @@ class ServingRouter:
         try:
             with _rt.span(ctx, "admission", tenant=str(tenant),
                           cost=cost) as adm:
+                with self._lock:
+                    fleet_empty = not any(r.alive and not r.draining
+                                          for r in self.replicas)
+                if fleet_empty:
+                    # fast-fail: an empty fleet must reject NOW, not
+                    # after the client burns its whole timeout (and
+                    # before the quota charges a request that cannot
+                    # possibly run)
+                    raise Rejected("no_replicas", tenant=tenant,
+                                   detail="every replica dead or "
+                                          "draining")
                 if self.quota is not None:
                     used = self.quota.admit(tenant, cost)
                     if used is not None and adm is not None:
@@ -576,6 +658,20 @@ class ServingRouter:
                 # fast-path detection: the attempt's replica is gone even
                 # if the TTL sweep hasn't fired yet
                 self._on_replica_dead(e.replica, reason="attempt_failed")
+                if ticket.attempt >= self.max_attempts:
+                    # requeue budget spent: a request ping-ponging across
+                    # dying replicas fails with a structured terminal
+                    # rejection instead of retrying until the client
+                    # timeout (generate() finishes the trace)
+                    _rt.add_event(ticket.trace, "requeue_budget_exhausted",
+                                  attempts=ticket.attempt,
+                                  replica=e.replica.id)
+                    ticket.error = Rejected(
+                        "attempts_exhausted", tenant=ticket.tenant,
+                        detail=f"{ticket.attempt} attempts, every "
+                               f"replica died underneath")
+                    ticket.done.set()
+                    return
                 with self._lock:
                     self.requeues_total += 1
                 tele["requeues"].inc(reason="replica_dead")
@@ -732,7 +828,30 @@ class ServingRouter:
                           matched_tokens=int(matched[best.id]),
                           load_tokens=int(best.load_tokens),
                           affinity=self.affinity)
+        # fleet fault grammar (kill:replica=R,request=N / stall:...):
+        # the route itself is the trigger point — a killed replica takes
+        # this very attempt down with it (the requeue path must earn its
+        # keep), a stalled one serves it slowly
+        flt = _fault.check_fleet_route(best.id)
+        if flt is not None:
+            self._apply_fleet_fault(best, flt)
         return best
+
+    def _apply_fleet_fault(self, replica, flt):
+        """Apply a due fleet fault directive (caller holds the lock)."""
+        if flt.kind == "kill":
+            replica.heartbeating = False
+            self._on_replica_dead(replica, reason="fault_kill")
+        elif flt.kind == "stall":
+            # a straggler, not a corpse: the serve loop sleeps at its
+            # next tick boundary while heartbeats keep flowing — SLO
+            # burn with no death signal (posted fire-and-forget; the
+            # router must not wait out the stall itself)
+            try:
+                replica.engine._q.put(
+                    _Control(lambda eng, s=flt.seconds: time.sleep(s)))
+            except Exception:
+                pass
 
     # -- observability ------------------------------------------------------
     def _state(self):
@@ -742,6 +861,8 @@ class ServingRouter:
                 "policy": self.policy,
                 "affinity": self.affinity,
                 "disagg": self.disagg,
+                "max_attempts": self.max_attempts,
+                "max_new_cap": self.max_new_cap,
                 "routed_total": self.routed_total,
                 "requeues_total": self.requeues_total,
                 "rejected_total": self.rejected_total,

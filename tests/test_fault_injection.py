@@ -144,3 +144,56 @@ class TestSimulatorWiring:
         assert simulator._FAULT_HOOK[0] is not None
         fault.clear()
         assert simulator._FAULT_HOOK[0] is None
+
+
+class TestFleetDirectives:
+    """ISSUE 14: the fault grammar extended to the serving fleet —
+    ``kill:replica=R,request=N`` and ``stall:replica=R,seconds=T``
+    trigger on the replica's N-th routed request (the ServingRouter
+    calls check_fleet_route at every routing decision)."""
+
+    def test_parse_fleet_directives(self):
+        plan = FaultPlan.parse(
+            "kill:replica=r1,request=4;stall:replica=r0,seconds=0.5")
+        k, s = plan.faults
+        assert (k.kind, k.replica, k.request) == ("kill", "r1", 4)
+        assert k.rank is None and k.step is None and k.seq is None
+        assert (s.kind, s.replica, s.request, s.seconds) == (
+            "stall", "r0", 1, 0.5)           # request defaults to 1
+        assert "kill:replica=r1,request=4" in repr(k)
+        assert "stall:replica=r0" in repr(s)
+
+    @pytest.mark.parametrize("spec,match", [
+        ("nan:replica=r0,request=1", "unknown fleet fault kind"),
+        ("stall:replica=r0", "seconds > 0"),
+        ("kill:replica=r0,step=1", "request=N"),
+        ("kill:rank=0,request=3", "need replica="),
+        ("kill:replica=r0,when=1", "unknown fault key"),
+    ])
+    def test_rejects_malformed_fleet(self, spec, match):
+        with pytest.raises(ValueError, match=match):
+            FaultPlan.parse(spec)
+
+    def test_fleet_kinds_catalog(self):
+        assert fault.FLEET_FAULT_KINDS == ("kill", "stall")
+
+    def test_route_trigger_counts_per_replica_and_fires_once(self):
+        fault.install("kill:replica=r1,request=3")
+        # r0's routes never advance r1's counter
+        assert fault.check_fleet_route("r0") is None
+        assert fault.check_fleet_route("r1") is None
+        assert fault.check_fleet_route("r1") is None
+        f = fault.check_fleet_route("r1")
+        assert f is not None and f.kind == "kill" and f.fired
+        assert fault.check_fleet_route("r1") is None     # once only
+
+    def test_fleet_firing_counts_in_telemetry(self):
+        c = fault.elastic_telemetry()["events"]
+        s0 = c.value(kind="stall")
+        fault.install("stall:replica=rX,seconds=0.01")
+        assert fault.check_fleet_route("rX") is not None
+        assert c.value(kind="stall") == s0 + 1
+
+    def test_no_plan_route_check_is_none(self):
+        fault.clear()
+        assert fault.check_fleet_route("r0") is None

@@ -284,6 +284,22 @@ def _console_fixtures(tmp_path):
                            "role": "mixed", "inflight": 0,
                            "load_tokens": 0, "queue_depth": 0},
                 }},
+            "fleet_controller": {
+                "running": True,
+                "cooldowns": {"restart": 0.0, "shed": 2.5},
+                "recent_actions": [
+                    {"t": 4.5, "action": "shed", "reason": "slo_burn",
+                     "target": "hog", "value": 5.0, "cooldown_s": 0.5},
+                    {"t": 6.0, "action": "restart",
+                     "reason": "replica_dead", "target": "r1",
+                     "value": 1.0, "cooldown_s": 0.5},
+                ],
+                "quarantined": ["r2"],
+                "degraded": True,
+                "shed_tenants": ["hog"],
+                "max_new_cap": 4,
+                "warm_pool": 1,
+            },
         },
     }
     dump_path = tmp_path / "flight_rank0.json"
@@ -308,6 +324,15 @@ def test_fleet_console_text_and_html(tmp_path, capsys):
     assert "ACTIVE  slo_burn" in out
     assert "r0" in out and "role=mixed" in out
     assert "time_to_recover_s: 1.5" in out
+    # controller action timeline (action, reason, trigger value,
+    # cooldown state) renders next to the alert table
+    assert "== controller actions ==" in out
+    assert "shed" in out and "reason=slo_burn" in out
+    assert "restart" in out and "reason=replica_dead" in out
+    assert "cooldown" in out and "shed=2.5" in out
+    assert "QUARANTINED: r2" in out
+    assert "DEGRADED: shed tenants [hog] max_new_cap=4" in out
+    assert "warm pool: 1 engine(s)" in out
     # sparkline characters actually present
     assert any(ch in out for ch in con.BLOCKS)
     # --match filters series
@@ -323,6 +348,8 @@ def test_fleet_console_text_and_html(tmp_path, capsys):
     html = html_path.read_text()
     assert html.startswith("<!doctype html>")
     assert "slo_burn" in html and "replicas" in html
+    assert "controller actions" in html
+    assert "QUARANTINED: r2" in html
     # nothing usable -> exit 2
     junk = tmp_path / "junk.json"
     junk.write_text('{"hello": 1}')
@@ -352,3 +379,5 @@ def test_fleet_console_no_jax_import(tmp_path):
     assert proc.returncode == 0, proc.stderr
     assert "paddle_slo_violations_total" in proc.stdout
     assert "ACTIVE  slo_burn" in proc.stdout
+    assert "== controller actions ==" in proc.stdout
+    assert "QUARANTINED: r2" in proc.stdout

@@ -71,6 +71,15 @@ def test_ledger_catalog():
     assert not violations, violations
 
 
+def test_controller_catalog():
+    """Every PADDLE_CONTROLLER_* knob, paddle_controller_* metric,
+    controller action string, fleet fault directive and structured
+    rejection reason is documented AND exercised by a test."""
+    from check_inventory import check_controller_catalog
+    violations = check_controller_catalog(verbose=False)
+    assert not violations, violations
+
+
 def test_serving_program_budget():
     """Compiled-program guard: a mixed prefill+decode load stays inside
     the ragged scheduler's declared token-bucket family (no per-request

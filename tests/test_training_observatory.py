@@ -627,6 +627,11 @@ class TestBenchCompare:
         assert bc.direction_of("numerics_overhead_pct") == "lower"
         assert bc.direction_of("fleet_time_to_recover_s") == "lower"
         assert bc.direction_of("serving_prefix_ttft_speedup") == "higher"
+        # ISSUE 14: controller chaos-pair metrics — recovery ratio is
+        # off/on (higher = controller helps more); the action count is
+        # workload-shaped churn, informational only
+        assert bc.direction_of("fleet_controller_recover_ratio") == "higher"
+        assert bc.direction_of("fleet_controller_actions") == "ignore"
         assert bc.direction_of("train_phase_breakdown.forward") is None
 
     def test_compare_flags_regressions_only(self, tmp_path):

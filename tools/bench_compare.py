@@ -8,8 +8,10 @@ scale to the aux-metric surface (phase fractions, peak bytes, p95s,
 speedups). This tool knows which direction each metric should move:
 
 * direction is inferred from the metric name (``DIRECTION_RULES`` —
-  ``*_per_sec``/``*speedup``/``mfu*`` are higher-better,
-  ``*_ms``/``*_bytes``/``*waste*``/``*overhead*`` are lower-better);
+  ``*_per_sec``/``*speedup``/``mfu*``/``*recover_ratio*`` are
+  higher-better, ``*_ms``/``*_bytes``/``*waste*``/``*overhead*``/
+  ``*time_to_recover*`` are lower-better; ``*controller_actions*`` is
+  an action COUNT — churn is workload-shaped, so it is informational);
   unknown metrics are reported as info, never failed;
 * a metric regresses when it moves in the bad direction by more than
   the threshold (default 10%, per-metric overrides via
@@ -44,6 +46,8 @@ DIRECTION_RULES = [
     ("overhead_pct", "lower"),
     ("waste_ratio", "lower"),
     ("forwards_per_token", "lower"),
+    ("recover_ratio", "higher"),
+    ("controller_actions", "ignore"),
     ("time_to_recover", "lower"),
     ("wire_bytes", "lower"),
     ("peak_bytes", "lower"),

@@ -363,6 +363,17 @@ INVENTORY = [
      "paddle_tpu.profiler.ledger",
      ["note_stream_token", "stream_digest", "attest_delivery",
       "seal_handoff", "check_handoff", "chain_update", "blob_digest"]),
+    # -- self-healing fleet control plane (ISSUE 14) -------------------------
+    ("Fleet controller (SLO-driven reconcile loop)",
+     "paddle_tpu.inference.fleet.controller",
+     ["FleetController", "ControllerAction", "CONTROLLER_ACTIONS"]),
+    ("Fleet actuators (scale/flip/shed/supervise surface)",
+     "paddle_tpu.inference.fleet",
+     ["ServingRouter", "TenantQuotaManager", "REJECTION_REASONS",
+      "DEFAULT_FLEET_MAX_ATTEMPTS"]),
+    ("Fleet fault directives (kill/stall by routed request)",
+     "paddle_tpu.distributed.fault",
+     ["FLEET_FAULT_KINDS", "check_fleet_route", "Fault", "FaultPlan"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -790,6 +801,103 @@ def check_ledger_catalog(verbose=True):
     return violations
 
 
+def check_controller_catalog(verbose=True):
+    """Fleet-control-plane inventory guard (ISSUE 14): every
+    ``PADDLE_CONTROLLER_*`` env knob and ``paddle_controller_*`` metric
+    referenced in ``paddle_tpu/`` must be documented (knobs in
+    docs/SERVING.md's controller table, metrics in
+    docs/OBSERVABILITY.md) AND exercised by at least one test; every
+    controller action string (``CONTROLLER_ACTIONS``), fleet fault
+    directive (``kill:replica`` / ``stall:replica``) and structured
+    rejection reason (``REJECTION_REASONS``) must be documented and
+    tested too — a self-healing loop nobody can audit is a loop nobody
+    will trust. Returns a list of violation strings."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    knob_pat = re.compile(r"PADDLE_CONTROLLER_[A-Z0-9_]*[A-Z0-9]")
+    metric_pat = re.compile(r"paddle_controller_[a-z0-9_]*[a-z0-9]")
+    knobs, metrics = set(), set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    text = f.read()
+                knobs.update(knob_pat.findall(text))
+                metrics.update(metric_pat.findall(text))
+    with open(os.path.join(root, "docs", "SERVING.md"),
+              errors="replace") as f:
+        serving_doc = f.read()
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              errors="replace") as f:
+        obs_doc = f.read()
+    with open(os.path.join(root, "docs", "ROBUSTNESS.md"),
+              errors="replace") as f:
+        robust_doc = f.read()
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name), errors="replace") as f:
+                tests_text += f.read()
+    violations = []
+    for k in sorted(knobs):
+        if k not in serving_doc:
+            violations.append(
+                f"controller knob {k} missing from docs/SERVING.md")
+        if k not in tests_text:
+            violations.append(
+                f"controller knob {k} not exercised by any test")
+    for m in sorted(metrics):
+        if m not in obs_doc:
+            violations.append(
+                f"controller metric {m} missing from "
+                f"docs/OBSERVABILITY.md")
+        if m not in tests_text:
+            violations.append(
+                f"controller metric {m} not exercised by any test")
+    from paddle_tpu.distributed.fault import FLEET_FAULT_KINDS
+    from paddle_tpu.inference.fleet import (CONTROLLER_ACTIONS,
+                                            REJECTION_REASONS)
+    for action in CONTROLLER_ACTIONS:
+        if f'"{action}"' not in tests_text:
+            violations.append(
+                f"controller action {action!r} not exercised by any test")
+        if f"`{action}`" not in serving_doc:
+            violations.append(
+                f"controller action {action!r} missing from "
+                f"docs/SERVING.md")
+    for kind in FLEET_FAULT_KINDS:
+        directive = f"{kind}:replica"
+        if directive not in tests_text:
+            violations.append(
+                f"fleet fault directive {directive!r} not exercised by "
+                f"any test")
+        if directive not in robust_doc:
+            violations.append(
+                f"fleet fault directive {directive!r} missing from "
+                f"docs/ROBUSTNESS.md")
+    for reason in REJECTION_REASONS:
+        if f'"{reason}"' not in tests_text:
+            violations.append(
+                f"rejection reason {reason!r} not exercised by any test")
+        if f"`{reason}`" not in serving_doc:
+            violations.append(
+                f"rejection reason {reason!r} missing from "
+                f"docs/SERVING.md")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"controller catalog: {len(knobs)} knobs, {len(metrics)} "
+              f"metrics, {len(CONTROLLER_ACTIONS)} actions, "
+              f"{len(FLEET_FAULT_KINDS)} fleet fault kinds, "
+              f"{len(REJECTION_REASONS)} rejection reasons checked")
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -818,5 +926,6 @@ if __name__ == "__main__":
     sys.exit(1 if (check() or check_strategy_docs() or check_env_docs()
                    or check_fleet_knobs() or check_observability_catalog()
                    or check_alert_catalog() or check_training_observability()
-                   or check_ledger_catalog() or check_serving_programs())
+                   or check_ledger_catalog() or check_controller_catalog()
+                   or check_serving_programs())
              else 0)

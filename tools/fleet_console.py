@@ -7,8 +7,11 @@ Inputs, auto-detected per file (globs ok):
   ``paddle_history/1``) — one sparkline per series, with rate for
   counters and min/mean/max for gauges;
 * flight-recorder dumps (``flight_rank*.json``) — the ``alerts`` state
-  provider (active rules + recent fire/clear transitions) and every
-  fleet/engine state provider's replica table;
+  provider (active rules + recent fire/clear transitions), every
+  fleet/engine state provider's replica table, and the
+  ``fleet_controller`` provider's action timeline (action, reason,
+  trigger metric value, cooldown state, quarantine/degradation
+  posture);
 * replay reports (``ReplayReport.to_json``, schema
   ``paddle_replay_report/1``) — the goodput-under-burst /
   time-to-recover summary block.
@@ -168,12 +171,70 @@ def replica_rows(dumps):
     return rows
 
 
+def controller_sections(dumps):
+    """Controller action timeline + posture from every dump's
+    ``fleet_controller`` state provider (any provider payload carrying
+    ``recent_actions`` qualifies — the same duck-typing as the replica
+    tables). Returns (actions oldest-first, posture summary)."""
+    actions, posture = [], {}
+    for path, d in dumps:
+        for provider, payload in (d.get("state") or {}).items():
+            if not isinstance(payload, dict):
+                continue
+            acts = payload.get("recent_actions")
+            if not isinstance(acts, list):
+                continue
+            actions.extend(a for a in acts if isinstance(a, dict))
+            for key in ("cooldowns", "quarantined", "degraded",
+                        "shed_tenants", "max_new_cap", "warm_pool",
+                        "failures"):
+                if key in payload:
+                    posture[key] = payload[key]
+    actions.sort(key=lambda a: a.get("t", 0))
+    return actions[-32:], posture
+
+
+def controller_lines(actions, posture):
+    """Text lines for the controller timeline (shared by render_text)."""
+    out = []
+    if not actions and not posture:
+        return out
+    out.append("")
+    out.append("== controller actions ==")
+    if actions:
+        for a in actions:
+            out.append(
+                f"  t={fmt(a.get('t')):<10} {a.get('action', '?'):<11} "
+                f"reason={a.get('reason', '?'):<16} "
+                f"target={fmt(a.get('target'))}  "
+                f"value={fmt(a.get('value'))}  "
+                f"cooldown_s={fmt(a.get('cooldown_s'))}")
+    else:
+        out.append("  (no actions recorded)")
+    if posture:
+        cool = posture.get("cooldowns") or {}
+        cool_s = ", ".join(f"{k}={fmt(v)}s"
+                           for k, v in sorted(cool.items())) or "all ready"
+        out.append(f"  cooldowns: {cool_s}")
+        if posture.get("quarantined"):
+            out.append(f"  QUARANTINED: "
+                       f"{', '.join(posture['quarantined'])}")
+        if posture.get("degraded"):
+            shed = ", ".join(posture.get("shed_tenants") or []) or "-"
+            out.append(f"  DEGRADED: shed tenants [{shed}] "
+                       f"max_new_cap={fmt(posture.get('max_new_cap'))}")
+        if "warm_pool" in posture:
+            out.append(f"  warm pool: {posture['warm_pool']} engine(s)")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
 
 
-def render_text(rows, active, transitions, replicas, reports) -> str:
+def render_text(rows, active, transitions, replicas, reports,
+                ctl_actions=(), ctl_posture=None) -> str:
     out = []
     if rows:
         w = max(len(r["series"]) for r in rows)
@@ -207,6 +268,7 @@ def render_text(rows, active, transitions, replicas, reports) -> str:
                 f"inflight={r.get('inflight')} "
                 f"load_tokens={r.get('load_tokens')} "
                 f"queue_depth={r.get('queue_depth')}")
+    out.extend(controller_lines(ctl_actions, ctl_posture or {}))
     for path, rep in reports:
         out.append("")
         out.append(f"== replay report ({os.path.basename(path)}) ==")
@@ -219,7 +281,8 @@ def render_text(rows, active, transitions, replicas, reports) -> str:
     return "\n".join(out) + "\n"
 
 
-def render_html(rows, active, transitions, replicas, reports) -> str:
+def render_html(rows, active, transitions, replicas, reports,
+                ctl_actions=(), ctl_posture=None) -> str:
     def esc(x):
         return _html.escape(str(x))
 
@@ -277,6 +340,28 @@ def render_html(rows, active, transitions, replicas, reports) -> str:
                 f"<td>{esc(r.get('load_tokens'))}</td>"
                 f"<td>{esc(r.get('queue_depth'))}</td></tr>")
         parts.append("</table>")
+    if ctl_actions or ctl_posture:
+        parts.append("<h2>controller actions</h2><table><tr><th>t</th>"
+                     "<th>action</th><th>reason</th><th>target</th>"
+                     "<th>value</th><th>cooldown_s</th></tr>")
+        for a in ctl_actions:
+            parts.append(
+                f"<tr><td>{fmt(a.get('t'))}</td>"
+                f"<td>{esc(a.get('action'))}</td>"
+                f"<td>{esc(a.get('reason'))}</td>"
+                f"<td>{esc(fmt(a.get('target')))}</td>"
+                f"<td>{fmt(a.get('value'))}</td>"
+                f"<td>{fmt(a.get('cooldown_s'))}</td></tr>")
+        parts.append("</table>")
+        posture = ctl_posture or {}
+        if posture.get("quarantined"):
+            parts.append("<p class='active'>QUARANTINED: "
+                         f"{esc(', '.join(posture['quarantined']))}</p>")
+        if posture.get("degraded"):
+            parts.append("<p class='active'>DEGRADED: shed "
+                         f"{esc(', '.join(posture.get('shed_tenants') or []))}"
+                         f" max_new_cap={fmt(posture.get('max_new_cap'))}"
+                         "</p>")
     for path, rep in reports:
         parts.append(f"<h2>replay report ({esc(os.path.basename(path))})"
                      "</h2><table>")
@@ -311,15 +396,17 @@ def main(argv=None) -> int:
     rows = series_rows(series, match=args.match, width=args.width)
     active, transitions = alert_sections(dumps)
     replicas = replica_rows(dumps)
+    ctl_actions, ctl_posture = controller_sections(dumps)
     if args.html:
-        text = render_html(rows, active, transitions, replicas, reports)
+        text = render_html(rows, active, transitions, replicas, reports,
+                           ctl_actions, ctl_posture)
         with open(args.html, "w") as f:
             f.write(text)
         print(f"fleet_console: {len(rows)} series, {len(active)} active "
               f"alert(s) -> {args.html}")
     else:
         sys.stdout.write(render_text(rows, active, transitions, replicas,
-                                     reports))
+                                     reports, ctl_actions, ctl_posture))
     return 0
 
 
