@@ -1389,6 +1389,7 @@ def bench_fleet():
                                   fault_spec=kill_spec)
     ctl_on = _bench_fleet_replay(model, sys_len, tail, new,
                                  fault_spec=kill_spec, controller=True)
+    export_pct, scrape_age = _bench_telemetry_plane(model, sys_len, new)
     ttr_on = ctl_on.get("time_to_recover_s")
     ttr_off = ctl_off.get("time_to_recover_s")
     if ttr_on is None:
@@ -1413,7 +1414,9 @@ def bench_fleet():
             ("fleet_time_to_recover_s",
              replay_rep.get("time_to_recover_s")),
             ("fleet_controller_recover_ratio", recover_ratio),
-            ("fleet_controller_actions", n_actions)):
+            ("fleet_controller_actions", n_actions),
+            ("telemetry_export_overhead_pct", export_pct),
+            ("telemetry_scrape_age_s", scrape_age)):
         print(json.dumps({"aux_metric": name, "value": val}),
               file=sys.stderr)
     return {
@@ -1437,11 +1440,63 @@ def bench_fleet():
         "replay": replay_rep,
         "fleet_controller_recover_ratio": recover_ratio,
         "fleet_controller_actions": n_actions,
+        "telemetry_export_overhead_pct": export_pct,
+        "telemetry_scrape_age_s": scrape_age,
         "controller_replay": {"on": ctl_on, "off": ctl_off,
                               "fault": kill_spec},
         "config": {"requests": n_req, "sys_prompt": sys_len, "tail": tail,
                    "new_tokens": new, "replicas": 2},
     }
+
+
+def _bench_telemetry_plane(model, sys_len, new):
+    """(telemetry_export_overhead_pct, telemetry_scrape_age_s): the
+    serving-step cost of having a live HTTP exporter + an active
+    scraper against it (ISSUE 15), measured with the standard
+    ``_telemetry_overhead_pct`` machinery — the same engine step runs
+    bare and then with the plane fully on (server thread + 20 Hz
+    scrape), so a regression in the exporter hot path shows up as a
+    perf delta. The scrape age is the freshness of the last successful
+    scrape at teardown — a scraper that cannot keep up shows a growing
+    age long before it shows wrong numbers."""
+    import numpy as np
+    from paddle_tpu.inference import ContinuousServingEngine
+    from paddle_tpu.profiler.exporter import TelemetryServer
+    from paddle_tpu.profiler.scrape import FleetScraper
+
+    eng = ContinuousServingEngine(
+        model, max_batch_size=2, max_len=max(sys_len // 4, 16) + new + 8)
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 1000,
+                          (1, max(sys_len // 8, 4))).astype(np.int64)
+    state = {"server": None, "scraper": None, "age": None}
+    with eng:
+        eng.generate(prompt, max_new_tokens=2, timeout=1800)   # warm
+
+        def step():
+            return eng.generate(prompt, max_new_tokens=2, timeout=1800)
+
+        def setup():
+            srv = TelemetryServer(instance="bench", port=0).start()
+            sc = FleetScraper(endpoints={"bench": srv.address},
+                              interval_s=0.05, stale_s=60.0)
+            sc.start()
+            state["server"], state["scraper"] = srv, sc
+
+        def teardown():
+            sc, srv = state["scraper"], state["server"]
+            if sc is not None:
+                sc.scrape_once()
+                state["age"] = sc.last_scrape_age()
+                sc.stop()
+            if srv is not None:
+                srv.stop()
+
+        pct = _telemetry_overhead_pct(step, lambda r: None, steps=5,
+                                      instrumented_step=step,
+                                      setup=setup, teardown=teardown)
+    age = state["age"]
+    return pct, None if age is None else round(age, 4)
 
 
 def _bench_fleet_replay(model, sys_len, tail, new, fault_spec=None,

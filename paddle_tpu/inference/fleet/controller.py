@@ -240,6 +240,7 @@ class FleetController:
         self._thread = None
         self._running = False
         self._flight_key = None
+        self.exporter = None
         self.steps = 0
         if self.alert_engine is not None:
             # the breaker's page: quarantining a replica must raise a
@@ -262,6 +263,12 @@ class FleetController:
         from ...profiler import flight_recorder as _flight
         self._flight_key = "fleet_controller"
         _flight.register_state_provider(self._flight_key, self.state)
+        from ...profiler import exporter as _exp
+        # the control plane is remotely diagnosable too: its endpoint
+        # rides the same discovery prefix as the replicas (ISSUE 15)
+        self.exporter = _exp.maybe_start_exporter(
+            instance="controller", store=self.router.store,
+            key_prefix=f"{self.router.ns}/telemetry/", ephemeral=True)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="paddle-fleet-controller")
         self._thread.start()
@@ -279,6 +286,10 @@ class FleetController:
             from ...profiler import flight_recorder as _flight
             _flight.unregister_state_provider(self._flight_key)
             self._flight_key = None
+        exp = getattr(self, "exporter", None)
+        if exp is not None:
+            exp.stop()
+            self.exporter = None
 
     def __enter__(self):
         return self.start()

@@ -159,6 +159,7 @@ class Replica:
         self.alive = False
         self.draining = False
         self.heartbeating = True
+        self.exporter = None            # per-replica TelemetryServer
         self.frontier: OrderedDict = OrderedDict()   # digest -> None (LRU)
         self.inflight: dict = {}        # ticket id -> token footprint
 
@@ -263,6 +264,11 @@ class ServingRouter:
         self.replicas = [Replica(f"r{i}", eng, role)
                          for i, (eng, role) in enumerate(zip(engines,
                                                              roles))]
+        for eng in engines:
+            # the router owns each replica's telemetry exporter (named
+            # by replica id, discovered through self.store) — the
+            # engine's own standalone exporter must not double-bind
+            eng._exporter_managed = True
         self._rid_counter = len(self.replicas)   # add_replica ids
         self.page_size = int(self.replicas[0].engine.page_size)
         if quota is None:
@@ -303,6 +309,32 @@ class ServingRouter:
     def _hb_key(self, replica):
         return f"{self.ns}/replica/{replica.id}"
 
+    def _start_exporter(self, replica):
+        """Per-replica telemetry endpoint (ISSUE 15): ephemeral port,
+        announced under ``<ns>/telemetry/<rid>`` through the router's KV
+        store. A no-op (None) when PADDLE_TELEMETRY_PORT is unset."""
+        if replica.exporter is not None:
+            return replica.exporter
+        from ...profiler import exporter as _exp
+        replica.exporter = _exp.maybe_start_exporter(
+            instance=replica.id, store=self.store,
+            key_prefix=f"{self.ns}/telemetry/", ephemeral=True)
+        return replica.exporter
+
+    def _stop_exporter(self, replica, unpublish=True):
+        exp, replica.exporter = replica.exporter, None
+        if exp is None:
+            return
+        if unpublish:
+            exp.stop(unpublish=True)
+        else:
+            # hard kill: the endpoint goes dark but its discovery key
+            # stays — the FleetScraper must observe it going STALE, the
+            # way a dead process's endpoint would; run off-thread so the
+            # health loop never stalls on the server join
+            threading.Thread(target=lambda: exp.stop(unpublish=False),
+                             daemon=True).start()
+
     def start(self):
         if self._started:
             return self
@@ -321,6 +353,7 @@ class ServingRouter:
             r.engine.start()
             r.alive = True
             r.heartbeating = True
+            self._start_exporter(r)
             self._publish_heartbeat(r)     # liveness visible before the
             #                                health loop takes its first look
         from ...profiler import flight_recorder as _flight
@@ -346,6 +379,7 @@ class ServingRouter:
             if r.alive:
                 r.engine.stop()
             r.alive = False
+            self._stop_exporter(r, unpublish=True)
         if self._flight_key is not None:
             from ...profiler import flight_recorder as _flight
             _flight.unregister_state_provider(self._flight_key)
@@ -413,6 +447,9 @@ class ServingRouter:
         from ...profiler import flight_recorder as _flight
         _flight.record_event("fleet_replica_dead", replica=replica.id,
                              reason=reason)
+        # the dead replica's telemetry endpoint dies WITH it (its
+        # discovery key stays — the scraper sees staleness, not absence)
+        self._stop_exporter(replica, unpublish=False)
         # hard abort (no drain): blocked dispatch threads get their
         # requests failed NOW and requeue to survivors; run off-thread so
         # the health loop never stalls on the engine join
@@ -446,6 +483,7 @@ class ServingRouter:
         with self._lock:
             r.alive = False
             r.frontier.clear()
+        self._stop_exporter(r, unpublish=True)
         return r
 
     def rejoin(self, rid, role=None):
@@ -465,6 +503,8 @@ class ServingRouter:
             r.alive = True
             r.draining = False
             r.heartbeating = True
+        self._start_exporter(r)        # fresh endpoint (fresh ephemeral
+        #                                port), re-announced for recovery
         self._publish_heartbeat(r)
         return r
 
@@ -483,11 +523,13 @@ class ServingRouter:
                 raise ValueError(f"replica id {rid!r} already in fleet")
             r = Replica(rid, engine, role)
             self.replicas.append(r)
+        engine._exporter_managed = True
         if self._started:
             r.engine.start()
             with self._lock:
                 r.alive = True
                 r.heartbeating = True
+            self._start_exporter(r)
             self._publish_heartbeat(r)
             self._spawn_heartbeat(r)
         return r

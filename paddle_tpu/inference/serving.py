@@ -391,6 +391,14 @@ class ServingEngine:
         _flight.register_state_provider(
             self._flight_key,
             lambda: _engine_state(wr()) if wr() is not None else {})
+        if not getattr(self, "_exporter_managed", False):
+            # standalone engine: its own telemetry endpoint when the
+            # plane is on (a router-fronted engine's exporter is owned
+            # by the router, named by replica id — see _exporter_managed)
+            from ..profiler import exporter as _exp
+            self._exporter = _exp.maybe_start_exporter(
+                instance=os.environ.get("PADDLE_TELEMETRY_INSTANCE")
+                or f"{self._ENGINE}-{os.getpid()}")
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
@@ -412,6 +420,10 @@ class ServingEngine:
             from ..profiler import flight_recorder as _flight
             _flight.unregister_state_provider(key)
             self._flight_key = None
+        exp = getattr(self, "_exporter", None)
+        if exp is not None:
+            exp.stop()
+            self._exporter = None
 
     def abort(self):
         """Hard stop: fail every queued AND in-flight request instead of

@@ -63,6 +63,19 @@ from .ledger import (  # noqa: F401  (re-exported facade)
     first_divergence, publish_ledger, gather_ledgers, compare_store,
     export_golden,
 )
+from . import exporter  # noqa: F401
+from .exporter import (  # noqa: F401  (re-exported facade)
+    TelemetryServer, maybe_start_exporter, exporter_enabled,
+)
+from . import scrape  # noqa: F401
+from .scrape import (  # noqa: F401  (re-exported facade)
+    FleetScraper, fleet_metrics, fleet_metrics_text, parse_metrics_text,
+    start_fleet_scraper, stop_fleet_scraper, get_fleet_scraper,
+)
+from . import eventlog  # noqa: F401
+from .eventlog import (  # noqa: F401  (re-exported facade)
+    EventLog, log_event, get_event_log,
+)
 
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
@@ -87,6 +100,11 @@ __all__ = [
     "StepLedger", "DivergenceError", "get_ledger", "tensor_digest",
     "first_divergence", "publish_ledger", "gather_ledgers",
     "compare_store", "export_golden",
+    "exporter", "scrape", "eventlog",
+    "TelemetryServer", "maybe_start_exporter", "exporter_enabled",
+    "FleetScraper", "fleet_metrics", "fleet_metrics_text",
+    "parse_metrics_text", "start_fleet_scraper", "stop_fleet_scraper",
+    "get_fleet_scraper", "EventLog", "log_event", "get_event_log",
 ]
 
 

@@ -1,0 +1,214 @@
+"""Correlated structured event log — the missing "logs" pillar of the
+telemetry plane (ISSUE 15; metrics live in :mod:`.telemetry`, traces in
+:mod:`.request_trace`, and this module gives every lifecycle edge a
+durable, greppable line that outlives the process).
+
+One append-only JSONL file per process: flight-recorder events
+(replica deaths, controller actions, alert firings — everything routed
+through :func:`~.flight_recorder.record_event`), request-trace
+spans/edges (admission, route, requeue, delivered — teed from
+:func:`~.request_trace.add_span`), and ledger divergences, all with
+uniform correlation fields:
+
+* ``ts`` — wall-clock seconds (the cross-replica join key);
+* ``rank`` / ``replica`` — who wrote it (thread-sim rank aware);
+* ``kind`` — the event name (``route``, ``requeue``, ``delivered``,
+  ``fleet_replica_dead``, ``controller``, ``alert``,
+  ``ledger_divergence``, ...);
+* ``trace_id`` — when the event belongs to a request, so one request's
+  whole story is reconstructable across every replica's log after the
+  processes are gone (``tools/log_query.py --trace <id>``).
+
+Durability discipline: each record goes down in a **single**
+``os.write`` on an ``O_APPEND`` fd, so concurrent writers (threads here,
+processes in the one-process-per-replica future) interleave only whole
+lines, never torn ones. Size-based rotation (``PADDLE_EVENTLOG_MAX_MB``,
+default 64, 0 disables) moves the full file to ``<path>.1`` via atomic
+``os.replace`` before the append that would overflow it.
+
+Zero overhead disabled: :func:`log_event` is a plain bool check when the
+layer is off. ``PADDLE_EVENTLOG=<path>`` enables at import.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "EventLog", "get_event_log", "enable", "disable", "is_enabled",
+    "reset", "log_event", "EVENTLOG_SCHEMA", "DEFAULT_EVENTLOG_MAX_MB",
+]
+
+EVENTLOG_SCHEMA = "paddle_eventlog/1"
+DEFAULT_EVENTLOG_MAX_MB = 64.0
+
+_ENABLED = False
+_LOG: "EventLog | None" = None
+_MODULE_LOCK = threading.Lock()
+_TELE = None
+
+
+def _telemetry():
+    global _TELE
+    if _TELE is None:
+        from .telemetry import get_registry
+        r = get_registry()
+        _TELE = {
+            "records": r.counter(
+                "paddle_eventlog_records_total",
+                "structured events appended to the event log"),
+            "rotations": r.counter(
+                "paddle_eventlog_rotations_total",
+                "size-triggered event-log rotations (full file moved "
+                "to <path>.1)"),
+        }
+    return _TELE
+
+
+def _env_max_mb():
+    try:
+        return float(os.environ.get("PADDLE_EVENTLOG_MAX_MB",
+                                    str(DEFAULT_EVENTLOG_MAX_MB)))
+    except ValueError:
+        return DEFAULT_EVENTLOG_MAX_MB
+
+
+class EventLog:
+    """One append-only JSONL event log (single-``os.write`` lines on an
+    ``O_APPEND`` fd, atomic size-based rotation)."""
+
+    def __init__(self, path, max_mb=None):
+        self.path = str(path)
+        self.max_bytes = int((_env_max_mb() if max_mb is None
+                              else float(max_mb)) * (1 << 20))
+        self._lock = threading.Lock()
+        self._fd = None
+        self.records = 0
+        self.rotations = 0
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+
+    # -- internals -----------------------------------------------------------
+    def _open_locked(self):
+        if self._fd is None:
+            self._fd = os.open(self.path,
+                               os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                               0o644)
+        return self._fd
+
+    def _rotate_locked(self):
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+        try:
+            os.replace(self.path, f"{self.path}.1")
+            self.rotations += 1
+            _telemetry()["rotations"].inc()
+        except OSError:
+            pass               # raced with another rotator: append fresh
+
+    # -- API -----------------------------------------------------------------
+    def append(self, kind, trace_id=None, replica=None, rank=None,
+               **fields) -> dict:
+        """Append one structured event; returns the record written."""
+        rec = {"ts": time.time(), "kind": str(kind)}
+        if rank is None:
+            from .flight_recorder import _rank
+            rank = _rank()
+        rec["rank"] = rank
+        if replica is not None:
+            rec["replica"] = str(replica)
+        if trace_id is not None:
+            rec["trace_id"] = str(trace_id)
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        line = (json.dumps(rec, default=str) + "\n").encode()
+        with self._lock:
+            if self.max_bytes > 0:
+                try:
+                    if (os.path.getsize(self.path) + len(line)
+                            > self.max_bytes):
+                        self._rotate_locked()
+                except OSError:
+                    pass           # no file yet: the open below creates it
+            os.write(self._open_locked(), line)
+            self.records += 1
+        _telemetry()["records"].inc()
+        return rec
+
+    def close(self):
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+
+# ---------------------------------------------------------------------------
+# module facade (a plain bool check when the layer is off)
+# ---------------------------------------------------------------------------
+
+
+def get_event_log() -> "EventLog | None":
+    return _LOG
+
+
+def is_enabled() -> bool:
+    return _ENABLED
+
+
+def enable(path=None, max_mb=None) -> EventLog:
+    """Open the process event log at ``path`` (default: the
+    ``PADDLE_EVENTLOG`` env knob) and start teeing events into it."""
+    global _ENABLED, _LOG
+    if path is None:
+        path = os.environ.get("PADDLE_EVENTLOG")
+    if not path:
+        raise ValueError("eventlog.enable() needs a path (or the "
+                         "PADDLE_EVENTLOG env knob)")
+    with _MODULE_LOCK:
+        if _LOG is None or _LOG.path != str(path):
+            if _LOG is not None:
+                _LOG.close()
+            _LOG = EventLog(path, max_mb=max_mb)
+        _ENABLED = True
+    return _LOG
+
+
+def disable():
+    global _ENABLED
+    _ENABLED = False
+    with _MODULE_LOCK:
+        if _LOG is not None:
+            _LOG.close()
+
+
+def reset():
+    """Drop the global log (tests / between jobs)."""
+    global _ENABLED, _LOG
+    with _MODULE_LOCK:
+        if _LOG is not None:
+            _LOG.close()
+        _LOG = None
+        _ENABLED = False
+
+
+def log_event(kind, trace_id=None, replica=None, **fields):
+    """The wired call site: one appended record IF the layer is enabled
+    (plain bool check when off — the disabled path costs nothing)."""
+    if not _ENABLED:
+        return None
+    log = _LOG
+    if log is None:
+        return None
+    try:
+        return log.append(kind, trace_id=trace_id, replica=replica,
+                          **fields)
+    except Exception:          # a full disk must never kill the caller
+        return None
+
+
+if os.environ.get("PADDLE_EVENTLOG"):   # pragma: no cover
+    enable()

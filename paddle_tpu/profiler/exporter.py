@@ -1,0 +1,402 @@
+"""Per-process telemetry exporter: every replica a scrapeable HTTP
+endpoint (ISSUE 15; the remote face of the in-process observability
+stack — ROADMAP item 3's one-process-per-replica fleet is diagnosable
+only if each process exports what PRs 3/9/11-13 already collect).
+
+:class:`TelemetryServer` is a daemon ``ThreadingHTTPServer`` serving:
+
+| route                  | method | body                                    |
+|------------------------|--------|-----------------------------------------|
+| ``/metrics``           | GET    | Prometheus text exposition (``metrics_text()``) |
+| ``/healthz``           | GET    | watchdog heartbeat ages + component summary; 200 healthy / 503 stale |
+| ``/state``             | GET    | flight-recorder component states (JSON) |
+| ``/history``           | GET    | metric time-series window (``?window_s=&match=``, capped) |
+| ``/timeline/<trace>``  | GET    | one request's PR-9 timeline (404 unknown) |
+| ``/debug/dump``        | POST   | trigger an on-demand flight-recorder dump; returns the dump paths |
+
+Every endpoint is bounded: the history window is capped at
+``MAX_HISTORY_WINDOW_S`` / ``MAX_HISTORY_SERIES``, request bodies over
+``MAX_POST_BYTES`` are rejected with 400, and only ``/debug/dump``
+accepts POST (anything else is 405).
+
+Gating: the env knob ``PADDLE_TELEMETRY_PORT`` turns the plane on —
+unset / empty / ``0`` means **off** (zero overhead: the wired call site
+:func:`maybe_start_exporter` is one env read returning None), ``auto``
+binds an ephemeral port (the multi-replica-per-process tier always uses
+ephemeral ports to avoid collisions), an integer binds that port.
+``PADDLE_TELEMETRY_HOST`` picks the bind address (default 127.0.0.1);
+``PADDLE_TELEMETRY_INSTANCE`` names the endpoint when the owning
+component doesn't.
+
+Discovery: a started server publishes
+``<prefix><instance>`` -> ``{host, port, pid}`` through the existing
+:func:`~.flight_recorder.publish_component_state` KV path
+(``KV_TELEMETRY_PREFIX`` = ``fleet/telemetry/`` by default), so the
+:class:`~.scrape.FleetScraper` finds endpoints with the same
+``keys(prefix)`` scan on ``MemKVStore`` and ``TcpKVStore`` that replica
+heartbeats already ride.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+__all__ = [
+    "TelemetryServer", "maybe_start_exporter", "exporter_enabled",
+    "ROUTES", "KV_TELEMETRY_PREFIX", "MAX_HISTORY_WINDOW_S",
+    "MAX_HISTORY_SERIES", "MAX_POST_BYTES",
+]
+
+#: every HTTP route the exporter serves; tools/check_inventory.py
+#: requires each documented in docs/OBSERVABILITY.md AND exercised by a
+#: test
+ROUTES = ("/metrics", "/healthz", "/state", "/history", "/timeline",
+          "/debug/dump")
+
+#: discovery key prefix: ``<prefix><instance>`` -> {host, port, pid}
+KV_TELEMETRY_PREFIX = "fleet/telemetry/"
+
+#: endpoint bounds — a scrape must never be unbounded work
+MAX_HISTORY_WINDOW_S = 3600.0
+MAX_HISTORY_SERIES = 256
+MAX_POST_BYTES = 65536
+
+_TELE = None
+
+
+def _telemetry():
+    global _TELE
+    if _TELE is None:
+        from .telemetry import get_registry
+        _TELE = get_registry().counter(
+            "paddle_telemetry_http_requests_total",
+            "exporter HTTP requests served, by route",
+            labels=("route",))
+    return _TELE
+
+
+def _env_port():
+    """The gate: None = plane off; 0 = ephemeral; else the fixed port."""
+    v = os.environ.get("PADDLE_TELEMETRY_PORT")
+    if v is None:
+        return None
+    v = v.strip().lower()
+    if v in ("", "0", "off", "false", "no"):
+        return None
+    if v in ("auto", "ephemeral"):
+        return 0
+    try:
+        p = int(v)
+    except ValueError:
+        return None
+    return p if p > 0 else None
+
+
+def exporter_enabled() -> bool:
+    return _env_port() is not None
+
+
+def _default_health() -> "tuple[bool, dict]":
+    """(ok, payload): watchdog heartbeat ages vs deadline plus a small
+    per-component state summary (bounded — full state lives at
+    ``/state``)."""
+    from . import flight_recorder as fr
+    rec = fr.get_flight_recorder()
+    now = time.monotonic()
+    ages = {str(r): round(now - t, 3)
+            for r, t in dict(rec._heartbeats).items()}
+    wd = fr.get_watchdog()
+    if wd is not None:
+        deadline = wd.deadline_s
+    else:
+        try:
+            deadline = float(os.environ.get("PADDLE_FLIGHT_DEADLINE_S",
+                                            300.0))
+        except ValueError:
+            deadline = 300.0
+    stale = sorted(r for r, a in ages.items() if a > deadline)
+    comps = {}
+    for name, fn in list(fr._STATE_PROVIDERS.items()):
+        try:
+            st = fn()
+        except Exception as e:     # a probe must never 500 the healthz
+            comps[name] = {"error": repr(e)}
+            continue
+        if not isinstance(st, dict):
+            continue
+        summary = {k: st[k] for k in ("engine", "running", "queue_depth",
+                                      "replica", "role", "draining",
+                                      "steps", "oldest_request_age_s")
+                   if k in st}
+        reps = st.get("replicas")
+        if isinstance(reps, dict):
+            summary["replicas_alive"] = sum(
+                1 for v in reps.values()
+                if isinstance(v, dict) and v.get("alive"))
+            summary["replicas"] = len(reps)
+        comps[name] = summary
+    ok = not stale
+    return ok, {"ok": ok, "deadline_s": deadline,
+                "heartbeat_ages_s": ages, "stale_ranks": stale,
+                "components": comps}
+
+
+class TelemetryServer:
+    """One process's scrapeable telemetry endpoint.
+
+    srv = TelemetryServer(instance="r0", port=0).start()   # ephemeral
+    ...  curl http://{srv.host}:{srv.port}/metrics
+    srv.stop()
+
+    With ``store=``, the started server announces itself under
+    ``<key_prefix><instance>`` so a :class:`~.scrape.FleetScraper`
+    discovers it; ``stop(unpublish=False)`` models process death (the
+    key stays, the endpoint goes dark, the scraper marks it stale).
+    """
+
+    def __init__(self, instance=None, host=None, port=None, store=None,
+                 key_prefix=None, health_fn=None):
+        self.instance = str(instance
+                            or os.environ.get("PADDLE_TELEMETRY_INSTANCE")
+                            or f"proc-{os.getpid()}")
+        self.host = host or os.environ.get("PADDLE_TELEMETRY_HOST",
+                                           "127.0.0.1")
+        if port is None:
+            port = _env_port() or 0
+        self.port = int(port)
+        self._store = store
+        self._prefix = (KV_TELEMETRY_PREFIX if key_prefix is None
+                        else str(key_prefix))
+        self._health_fn = health_fn or _default_health
+        self._httpd = None
+        self._thread = None
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def kv_key(self) -> str:
+        return f"{self._prefix}{self.instance}"
+
+    def start(self):
+        if self._httpd is not None:
+            return self
+        handler = _make_handler(self)
+        try:
+            self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                              handler)
+        except OSError:
+            if self.port == 0:
+                raise
+            # fixed port taken (another exporter in this process, or a
+            # peer on the host): fall back to an ephemeral pick rather
+            # than refusing to export at all
+            self._httpd = ThreadingHTTPServer((self.host, 0), handler)
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.05}, daemon=True,
+            name=f"paddle-telemetry-{self.instance}")
+        self._thread.start()
+        self.publish()
+        return self
+
+    def publish(self):
+        """(Re-)announce this endpoint through the KV discovery path."""
+        if self._store is None:
+            return None
+        from .flight_recorder import publish_component_state
+        return publish_component_state(self._store, self.kv_key, {
+            "instance": self.instance, "host": self.host,
+            "port": self.port, "pid": os.getpid(),
+        })
+
+    def stop(self, unpublish=True):
+        """Shut the endpoint down. ``unpublish=False`` leaves the
+        discovery key in place — the hard-kill path: the scraper must
+        see the endpoint go stale, not vanish cleanly."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if unpublish and self._store is not None:
+            try:
+                self._store.delete(self.kv_key)
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- endpoint bodies (called by the handler) -----------------------------
+    def _body_metrics(self):
+        from .telemetry import metrics_text
+        return 200, metrics_text().encode(), \
+            "text/plain; version=0.0.4; charset=utf-8"
+
+    def _body_healthz(self):
+        ok, payload = self._health_fn()
+        payload["instance"] = self.instance
+        return (200 if ok else 503), _json(payload), "application/json"
+
+    def _body_state(self):
+        from . import flight_recorder as fr
+        state = fr.get_flight_recorder()._provider_state()
+        return 200, _json({"instance": self.instance, "state": state}), \
+            "application/json"
+
+    def _body_history(self, query):
+        from .timeseries import get_history
+        window = None
+        if query.get("window_s"):
+            try:
+                window = float(query["window_s"][0])
+            except ValueError:
+                return 400, _json({"error": "bad window_s"}), \
+                    "application/json"
+        window = (MAX_HISTORY_WINDOW_S if window is None
+                  else min(max(window, 0.0), MAX_HISTORY_WINDOW_S))
+        match = query.get("match", [None])[0]
+        series = get_history().snapshot(match=match, window_s=window,
+                                        max_series=MAX_HISTORY_SERIES)
+        return 200, _json({"instance": self.instance,
+                           "window_s": window, "series": series}), \
+            "application/json"
+
+    def _body_timeline(self, trace_id):
+        from .request_trace import request_timeline
+        try:
+            tl = request_timeline(unquote(trace_id))
+        except KeyError:
+            return 404, _json({"error": f"no trace {trace_id!r}"}), \
+                "application/json"
+        return 200, _json(tl), "application/json"
+
+    def _body_dump(self):
+        from . import flight_recorder as fr
+        res = fr.get_flight_recorder().dump(
+            reason=f"http_debug_dump:{self.instance}")
+        return 200, _json({"instance": self.instance, **res}), \
+            "application/json"
+
+
+def _json(obj) -> bytes:
+    return json.dumps(obj, default=str).encode()
+
+
+def _route_label(path: str) -> str:
+    if path.startswith("/timeline/"):
+        return "/timeline"
+    return path if path in ROUTES else "other"
+
+
+def _make_handler(server: TelemetryServer):
+    class _Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):      # quiet: telemetry, not access logs
+            pass
+
+        def _send(self, code, body, ctype="application/json"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _count(self, path):
+            try:
+                _telemetry().inc(route=_route_label(path))
+            except Exception:
+                pass
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            path = url.path.rstrip("/") or "/"
+            self._count(path)
+            try:
+                if path == "/metrics":
+                    code, body, ctype = server._body_metrics()
+                elif path == "/healthz":
+                    code, body, ctype = server._body_healthz()
+                elif path == "/state":
+                    code, body, ctype = server._body_state()
+                elif path == "/history":
+                    code, body, ctype = server._body_history(
+                        parse_qs(url.query))
+                elif path.startswith("/timeline/"):
+                    code, body, ctype = server._body_timeline(
+                        path[len("/timeline/"):])
+                elif path == "/debug/dump":
+                    code, body, ctype = 405, _json(
+                        {"error": "POST /debug/dump"}), "application/json"
+                else:
+                    code, body, ctype = 404, _json(
+                        {"error": f"no route {path!r}"}), "application/json"
+            except Exception as e:   # an endpoint bug must not kill serving
+                code, body, ctype = 500, _json({"error": repr(e)}), \
+                    "application/json"
+            self._send(code, body, ctype)
+
+        def do_POST(self):
+            url = urlparse(self.path)
+            path = url.path.rstrip("/") or "/"
+            self._count(path)
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            if length > MAX_POST_BYTES:
+                # bounded bodies: refuse before reading, drop the
+                # connection after answering (no unbounded drain)
+                self.close_connection = True
+                self._send(400, _json({"error": "body too large"}))
+                return
+            if length:
+                self.rfile.read(length)          # drain (bounded)
+            if path != "/debug/dump":
+                self._send(405, _json(
+                    {"error": "only POST /debug/dump"}))
+                return
+            try:
+                code, body, ctype = server._body_dump()
+            except Exception as e:
+                code, body, ctype = 500, _json({"error": repr(e)}), \
+                    "application/json"
+            self._send(code, body, ctype)
+
+    return _Handler
+
+
+def maybe_start_exporter(instance=None, store=None, key_prefix=None,
+                         ephemeral=False,
+                         health_fn=None) -> "TelemetryServer | None":
+    """The wired lifecycle call site: start (and return) an exporter IF
+    the ``PADDLE_TELEMETRY_PORT`` gate is on, else None at the cost of
+    one env read. ``ephemeral=True`` forces an ephemeral port even under
+    a fixed-port env value — the router's per-replica exporters always
+    use it (N replicas cannot share one port)."""
+    port = _env_port()
+    if port is None:
+        return None
+    if ephemeral:
+        port = 0
+    try:
+        return TelemetryServer(instance=instance, port=port, store=store,
+                               key_prefix=key_prefix,
+                               health_fn=health_fn).start()
+    except Exception:      # an unexportable process still serves traffic
+        return None

@@ -292,7 +292,14 @@ class Watchdog:
             return
         try:
             from .telemetry import metrics_text
-            tmp = self.metrics_text_path + ".tmp"
+            # write-tmp-then-replace with a WRITER-UNIQUE tmp name: two
+            # watchdogs (or a watchdog racing a manual rewrite) must
+            # never interleave writes into one tmp file and publish the
+            # torn result — a scraper tailing the path (tools/
+            # tpu_watch.sh metrics) may read a complete exposition or
+            # the previous one, never a truncated body
+            tmp = (f"{self.metrics_text_path}.tmp."
+                   f"{os.getpid()}.{threading.get_ident()}")
             with open(tmp, "w") as f:
                 f.write(metrics_text())
             os.replace(tmp, self.metrics_text_path)
@@ -393,6 +400,12 @@ def reset():
 
 
 def record_event(kind: str, **fields):
+    # tee into the structured event log (ISSUE 15) independently of the
+    # ring gate: controller actions, alert firings and replica deaths
+    # must survive the process even when the flight ring is off
+    from . import eventlog as _eventlog
+    if _eventlog.is_enabled():
+        _eventlog.log_event(kind, **fields)
     if not _ENABLED:
         return None
     return get_flight_recorder().record(kind, **fields)
@@ -556,7 +569,9 @@ def publish_component_state(store, name, state) -> dict:
     liveness checks via ``store.age`` work unchanged)."""
     payload = {"component": name, "state": state}
     if _ENABLED:
-        record_event("component_state", component=name)
+        # straight to the ring, NOT record_event: per-heartbeat publish
+        # traffic must not flood the structured event log
+        get_flight_recorder().record("component_state", component=name)
     store.put(name, payload)
     return payload
 

@@ -375,20 +375,40 @@ def get_trace_store() -> RequestTraceStore:
 # ---------------------------------------------------------------------------
 
 
+def _eventlog_tee(ctx, kind, tags):
+    """Mirror one trace edge into the structured event log (ISSUE 15)
+    with the uniform correlation fields — the cross-replica join key a
+    dead process's in-memory trace store cannot provide."""
+    from . import eventlog as _eventlog
+    if not _eventlog.is_enabled():
+        return
+    fields = {k: v for k, v in tags.items() if k != "replica"}
+    replica = tags.get("replica")
+    if replica is None:
+        replica = getattr(ctx, "tags", {}).get("replica")
+    _eventlog.log_event(kind, trace_id=getattr(ctx, "trace_id", None),
+                        replica=replica, src="trace", **fields)
+
+
 def start_request(tenant="default", source="engine", prompt_tokens=0,
                   max_new_tokens=0, parent=None, trace_id=None):
     """Mint a :class:`TraceContext` (or None when tracing is disabled)."""
     if not _ENABLED:
         return None
-    return get_trace_store().start(
+    ctx = get_trace_store().start(
         tenant=tenant, source=source, prompt_tokens=prompt_tokens,
         max_new_tokens=max_new_tokens, parent=parent, trace_id=trace_id)
+    _eventlog_tee(ctx, "admission", {"tenant": str(tenant),
+                                     "source": source,
+                                     "prompt_tokens": int(prompt_tokens)})
+    return ctx
 
 
 def add_span(ctx, name, t0=None, dur=0.0, **tags):
     """Record one completed span on ``ctx`` (no-op for ``ctx=None``)."""
     if ctx is None or not _ENABLED:
         return None
+    _eventlog_tee(ctx, name, tags)
     return get_trace_store().add_span(ctx, name, t0=t0, dur=dur, **tags)
 
 
@@ -429,6 +449,7 @@ def note_token(ctx, t=None):
 def finish_request(ctx, status="ok", **tags):
     if ctx is None or not _ENABLED:
         return None
+    _eventlog_tee(ctx, "finish", dict(tags, status=status))
     return get_trace_store().finish(ctx, status=status, **tags)
 
 

@@ -1,0 +1,489 @@
+"""Fleet-wide scrape aggregation: one merged, instance-labeled registry
+view over every replica's ``/metrics`` endpoint (ISSUE 15; the remote
+twin of :func:`~.flight_recorder.gather_metrics` — same merge shape,
+but over HTTP against live processes instead of KV snapshots).
+
+:class:`FleetScraper` discovers endpoints from the elastic KV store
+(``keys("fleet/telemetry/")`` over :class:`TelemetryServer` discovery
+records — composes with ``MemKVStore`` and ``TcpKVStore`` alike) or
+from a static ``{instance: "host:port"}`` map, scrapes each on an
+interval through :func:`parse_metrics_text` (a **strict**
+Prometheus-exposition parser — malformed bodies raise instead of
+silently merging garbage), and:
+
+* merges the per-instance families into one view with a leading
+  ``instance`` label (:meth:`~FleetScraper.merged`,
+  ``paddle.profiler.fleet_metrics()`` /
+  :func:`fleet_metrics_text`);
+* folds every scrape into a :class:`~.timeseries.MetricsHistory`
+  (tick-per-scrape), so PR-11 burn-rate alert rules evaluate over the
+  *fleet* view exactly as they do over the in-process one;
+* degrades gracefully: a dead endpoint is marked **stale** after
+  ``PADDLE_TELEMETRY_STALE_S`` seconds without a successful scrape
+  (ticking the ``paddle_telemetry_stale_instances`` gauge and dropping
+  it from the merged view), never blocks the loop (per-endpoint
+  timeout), and recovers the moment the endpoint answers again.
+
+Module-level imports here are stdlib-only on purpose:
+``tools/fleet_console.py --scrape`` loads this file standalone (no
+paddle_tpu / jax import) for its live-fleet mode.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+import urllib.request
+
+__all__ = [
+    "FleetScraper", "parse_metrics_text", "render_metrics_text",
+    "merge_instances", "fleet_metrics", "fleet_metrics_text",
+    "start_fleet_scraper", "stop_fleet_scraper", "get_fleet_scraper",
+    "DEFAULT_STALE_S", "DEFAULT_SCRAPE_INTERVAL_S",
+]
+
+DEFAULT_STALE_S = 10.0
+DEFAULT_SCRAPE_INTERVAL_S = 2.0
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def _parse_value(raw: str) -> float:
+    low = raw.lower()
+    if low in ("+inf", "inf"):
+        return float("inf")
+    if low == "-inf":
+        return float("-inf")
+    return float(raw)          # strict: ValueError propagates
+
+
+def parse_metrics_text(text: str) -> dict:
+    """STRICT Prometheus-exposition parser -> the
+    ``MetricRegistry.collect()`` shape: ``{name: {type, help,
+    label_names, series: {label_key: value | histogram_snapshot}}}``.
+
+    Strictness contract (the acceptance round-trip leans on it):
+    every sample line must parse, every sampled family must carry a
+    ``# TYPE`` declaration, label names must be consistent inside a
+    family, and histogram ``_bucket``/``_sum``/``_count`` lines must
+    belong to a declared histogram. Violations raise ``ValueError``.
+    """
+    families: dict = {}
+    types: dict = {}
+    helps: dict = {}
+
+    def base_name(name):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    types.get(name[:-len(suffix)]) == "histogram":
+                return name[:-len(suffix)], suffix
+        return name, ""
+
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3] if len(parts) > 3 else "untyped"
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        raw_name, _, raw_labels, raw_value = m.groups()
+        value = _parse_value(raw_value)
+        name, suffix = base_name(raw_name)
+        kind = types.get(name)
+        if kind is None:
+            raise ValueError(f"line {lineno}: sample {raw_name!r} has no "
+                             f"# TYPE declaration")
+        labels = []
+        if raw_labels:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(raw_labels):
+                labels.append((lm.group(1), _unescape(lm.group(2))))
+                consumed = lm.end()
+            leftover = raw_labels[consumed:].strip().strip(",")
+            if leftover:
+                raise ValueError(f"line {lineno}: malformed labels "
+                                 f"{raw_labels!r}")
+        le = None
+        if kind == "histogram" and suffix == "_bucket":
+            le_pairs = [v for k, v in labels if k == "le"]
+            if not le_pairs:
+                raise ValueError(f"line {lineno}: histogram bucket "
+                                 f"without le label")
+            le = le_pairs[0]
+            labels = [(k, v) for k, v in labels if k != "le"]
+        label_names = [k for k, _ in labels]
+        fam = families.setdefault(name, {
+            "type": kind, "help": helps.get(name, ""),
+            "label_names": label_names, "series": {},
+        })
+        if fam["label_names"] != label_names:
+            raise ValueError(
+                f"line {lineno}: inconsistent label names for {name!r}: "
+                f"{label_names} vs {fam['label_names']}")
+        key = ",".join(v for _, v in labels)
+        if kind == "histogram":
+            snap = fam["series"].setdefault(
+                key, {"count": 0, "sum": 0.0, "buckets": {}})
+            if suffix == "_bucket":
+                snap["buckets"]["+Inf" if le in ("+Inf", "inf")
+                                else le] = value
+            elif suffix == "_sum":
+                snap["sum"] = value
+            elif suffix == "_count":
+                snap["count"] = value
+            else:
+                raise ValueError(f"line {lineno}: bare sample "
+                                 f"{raw_name!r} for histogram {name!r}")
+        else:
+            fam["series"][key] = value
+    return families
+
+
+def render_metrics_text(families: dict) -> str:
+    """The inverse of :func:`parse_metrics_text`: a ``collect()``-shaped
+    dict back to Prometheus text exposition (the merged fleet view as
+    one scrapeable body)."""
+    lines = []
+    for name in sorted(families):
+        fam = families[name]
+        kind = fam.get("type", "untyped")
+        lines.append(f"# HELP {name} {fam.get('help') or name}")
+        lines.append(f"# TYPE {name} {kind}")
+        label_names = list(fam.get("label_names", []))
+        for key in sorted(fam.get("series", {})):
+            val = fam["series"][key]
+            values = key.split(",") if key else []
+            labelstr = _fmt_labels(label_names, values)
+            if isinstance(val, dict):       # histogram snapshot
+                buckets = val.get("buckets", {})
+
+                def _b(b):
+                    try:
+                        return (0, float(b))
+                    except ValueError:
+                        return (1, float("inf"))
+                for b in sorted(buckets, key=_b):
+                    ls = _fmt_labels(label_names + ["le"], values + [b])
+                    lines.append(f"{name}_bucket{ls} {buckets[b]:g}")
+                lines.append(f"{name}_sum{labelstr} "
+                             f"{val.get('sum', 0.0):g}")
+                lines.append(f"{name}_count{labelstr} "
+                             f"{val.get('count', 0):g}")
+            else:
+                lines.append(f"{name}{labelstr} {val:g}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _fmt_labels(names, values) -> str:
+    if not names:
+        return ""
+    def esc(v):
+        return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+                .replace('"', '\\"'))
+    inner = ",".join(f'{n}="{esc(v)}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+def merge_instances(by_instance: dict) -> dict:
+    """Union per-instance family dicts into ONE view: every family gains
+    a leading ``instance`` label (the
+    :func:`~.flight_recorder.merge_rank_snapshots` convention, keyed by
+    endpoint instance instead of rank)."""
+    merged: dict = {}
+    for instance in sorted(by_instance):
+        for name, fam in (by_instance[instance] or {}).items():
+            m = merged.setdefault(name, {
+                "type": fam.get("type", "untyped"),
+                "help": fam.get("help", ""),
+                "label_names": ["instance"]
+                + list(fam.get("label_names", [])),
+                "series": {},
+            })
+            for key, val in fam.get("series", {}).items():
+                m["series"][f"{instance},{key}" if key
+                            else str(instance)] = val
+    return merged
+
+
+def fetch_metrics(endpoint: str, timeout_s=2.0) -> dict:
+    """GET ``http://<endpoint>/metrics`` and strictly parse the body."""
+    with urllib.request.urlopen(f"http://{endpoint}/metrics",
+                                timeout=timeout_s) as resp:
+        body = resp.read().decode("utf-8", errors="replace")
+    return parse_metrics_text(body)
+
+
+class _MergedView:
+    """Registry shim the fold-in :class:`MetricsHistory` samples: its
+    ``collect()`` is the scraper's merged fleet view."""
+
+    def __init__(self, scraper):
+        self._scraper = scraper
+
+    def collect(self):
+        return self._scraper.merged()
+
+    def __getattr__(self, name):
+        # counter/gauge/histogram creation (the history's own
+        # bookkeeping metrics) falls through to the process registry
+        from .telemetry import get_registry
+        return getattr(get_registry(), name)
+
+
+class FleetScraper:
+    """Discover + scrape + merge + fold. ``store=`` drives KV discovery;
+    ``endpoints={instance: "host:port"}`` is the static tier (both can
+    coexist — static entries win on collision)."""
+
+    def __init__(self, store=None, key_prefix=None, endpoints=None,
+                 interval_s=None, stale_s=None, timeout_s=1.0,
+                 history=None, history_capacity=1024):
+        self.store = store
+        if key_prefix is None:
+            key_prefix = "fleet/telemetry/"
+        self.key_prefix = str(key_prefix)
+        self.static_endpoints = dict(endpoints or {})
+        if interval_s is None:
+            interval_s = _env_float("PADDLE_TELEMETRY_SCRAPE_INTERVAL_S",
+                                    DEFAULT_SCRAPE_INTERVAL_S)
+        self.interval_s = float(interval_s)
+        if stale_s is None:
+            stale_s = _env_float("PADDLE_TELEMETRY_STALE_S",
+                                 DEFAULT_STALE_S)
+        self.stale_s = float(stale_s)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.RLock()
+        self._snaps: dict = {}        # instance -> parsed families
+        self._last_ok: dict = {}      # instance -> monotonic t of last ok
+        self._errors: dict = {}       # instance -> last error repr
+        self.scrapes = 0
+        self._stop_evt = threading.Event()
+        self._thread = None
+        self._tele = None
+        if history is None:
+            from .timeseries import MetricsHistory
+            history = MetricsHistory(capacity=history_capacity,
+                                     interval_s=0,
+                                     registry=_MergedView(self))
+        self.history = history
+
+    def _telemetry(self):
+        if self._tele is None:
+            from .telemetry import get_registry
+            r = get_registry()
+            self._tele = {
+                "stale": r.gauge(
+                    "paddle_telemetry_stale_instances",
+                    "discovered telemetry endpoints with no successful "
+                    "scrape inside PADDLE_TELEMETRY_STALE_S"),
+                "scrapes": r.counter(
+                    "paddle_telemetry_scrapes_total",
+                    "endpoint scrape attempts, by outcome",
+                    labels=("outcome",)),
+            }
+        return self._tele
+
+    # -- discovery -----------------------------------------------------------
+    def discover(self) -> dict:
+        """{instance: "host:port"} from the KV store plus the static
+        map (static wins)."""
+        found: dict = {}
+        if self.store is not None:
+            try:
+                keys = self.store.keys(self.key_prefix)
+            except Exception:
+                keys = []
+            for key in keys:
+                try:
+                    v = self.store.get(key)
+                except Exception:
+                    continue
+                state = (v or {}).get("state") if isinstance(v, dict) \
+                    else None
+                if not isinstance(state, dict):
+                    continue
+                host, port = state.get("host"), state.get("port")
+                if host is None or port is None:
+                    continue
+                instance = state.get("instance") \
+                    or key[len(self.key_prefix):]
+                found[str(instance)] = f"{host}:{port}"
+        found.update(self.static_endpoints)
+        return found
+
+    # -- scraping ------------------------------------------------------------
+    def scrape_once(self, now=None) -> dict:
+        """One scrape round over every discovered endpoint. Per-endpoint
+        failures never raise (and never block past ``timeout_s``); the
+        round always finishes for the survivors. Returns
+        ``{instance: "ok" | "error"}``."""
+        now = time.monotonic() if now is None else float(now)
+        tele = self._telemetry()
+        targets = self.discover()
+        outcome = {}
+        for instance, endpoint in sorted(targets.items()):
+            try:
+                families = fetch_metrics(endpoint,
+                                         timeout_s=self.timeout_s)
+            except Exception as e:
+                outcome[instance] = "error"
+                tele["scrapes"].inc(outcome="error")
+                with self._lock:
+                    self._errors[instance] = repr(e)
+                continue
+            outcome[instance] = "ok"
+            tele["scrapes"].inc(outcome="ok")
+            with self._lock:
+                self._snaps[instance] = families
+                self._last_ok[instance] = now
+                self._errors.pop(instance, None)
+        with self._lock:
+            self.scrapes += 1
+            known = set(targets) | set(self._last_ok)
+            stale = [i for i in known
+                     if now - self._last_ok.get(i, -1e18) > self.stale_s]
+        tele["stale"].set(len(stale))
+        # fold the fleet view into the history on the scrape timeline —
+        # burn-rate rules attached to self.history now see fleet series
+        try:
+            self.history.tick(now=now)
+        except Exception:
+            pass
+        return outcome
+
+    def instances(self, now=None) -> dict:
+        """{instance: {endpoint, stale, age_s, error}} — the liveness
+        table the fleet console renders."""
+        now = time.monotonic() if now is None else float(now)
+        targets = self.discover()
+        out = {}
+        with self._lock:
+            for instance in sorted(set(targets) | set(self._last_ok)):
+                last = self._last_ok.get(instance)
+                age = None if last is None else now - last
+                out[instance] = {
+                    "endpoint": targets.get(instance),
+                    "age_s": None if age is None else round(age, 3),
+                    "stale": age is None or age > self.stale_s,
+                    "error": self._errors.get(instance),
+                }
+        return out
+
+    def last_scrape_age(self, now=None) -> "float | None":
+        """Seconds since the freshest successful scrape (the bench's
+        ``telemetry_scrape_age_s`` aux metric); None before any."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            if not self._last_ok:
+                return None
+            return max(now - max(self._last_ok.values()), 0.0)
+
+    # -- merged views --------------------------------------------------------
+    def merged(self) -> dict:
+        """Instance-labeled union of every FRESH instance's last scrape
+        (stale instances drop out — their numbers are history, not
+        state)."""
+        now = time.monotonic()
+        with self._lock:
+            fresh = {i: snap for i, snap in self._snaps.items()
+                     if now - self._last_ok.get(i, -1e18) <= self.stale_s}
+        return merge_instances(fresh)
+
+    def metrics_text(self) -> str:
+        return render_metrics_text(self.merged())
+
+    # -- background loop -----------------------------------------------------
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="paddle-fleet-scraper")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:    # a scrape round must never kill the loop
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+# ---------------------------------------------------------------------------
+# module facade — paddle.profiler.fleet_metrics() / fleet_metrics_text()
+# ---------------------------------------------------------------------------
+
+_SCRAPER: "FleetScraper | None" = None
+_SCRAPER_LOCK = threading.Lock()
+
+
+def get_fleet_scraper() -> "FleetScraper | None":
+    return _SCRAPER
+
+
+def start_fleet_scraper(store=None, **kwargs) -> FleetScraper:
+    """Build + start the process-global scraper (the one
+    :func:`fleet_metrics` reads)."""
+    global _SCRAPER
+    with _SCRAPER_LOCK:
+        if _SCRAPER is not None:
+            _SCRAPER.stop()
+        _SCRAPER = FleetScraper(store=store, **kwargs)
+        _SCRAPER.start()
+    return _SCRAPER
+
+
+def stop_fleet_scraper():
+    global _SCRAPER
+    with _SCRAPER_LOCK:
+        if _SCRAPER is not None:
+            _SCRAPER.stop()
+            _SCRAPER = None
+
+
+def fleet_metrics() -> dict:
+    """``paddle.profiler.fleet_metrics()`` — the merged instance-labeled
+    fleet view from the global scraper (empty before one runs)."""
+    s = _SCRAPER
+    return {} if s is None else s.merged()
+
+
+def fleet_metrics_text() -> str:
+    """The merged fleet view in Prometheus text exposition format."""
+    s = _SCRAPER
+    return "" if s is None else s.metrics_text()

@@ -345,6 +345,34 @@ class MetricsHistory:
             self._ticks = 0
             self._last_tick_t = None
 
+    def snapshot(self, match=None, window_s=None, now=None,
+                 max_series=None) -> list:
+        """JSON-ready ``[{name, labels, kind, points}, ...]`` view of the
+        rings — the ``/history`` telemetry-plane endpoint's body.
+        ``match=`` filters by display-name substring, ``window_s=``
+        keeps only the trailing window (newest point anchored unless
+        ``now`` is given), ``max_series=`` bounds the series count (the
+        endpoint must never return unbounded work)."""
+        with self._lock:
+            series = sorted(self._series.values(),
+                            key=lambda s: (s.name, s.key))
+            out = []
+            for s in series:
+                disp = s.display
+                if match and match not in disp:
+                    continue
+                pts = list(s.points)
+                if window_s is not None and pts:
+                    hi = pts[-1][0] if now is None else float(now)
+                    lo = hi - float(window_s)
+                    pts = [(t, v) for t, v in pts if lo <= t <= hi]
+                out.append({"name": s.name, "labels": s.key,
+                            "kind": s.kind,
+                            "points": [[round(t, 6), v] for t, v in pts]})
+                if max_series is not None and len(out) >= int(max_series):
+                    break
+        return out
+
     # -- exports -------------------------------------------------------------
     def export_jsonl(self, path) -> int:
         """Write the whole history as self-describing JSONL: one header

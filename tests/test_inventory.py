@@ -80,6 +80,15 @@ def test_controller_catalog():
     assert not violations, violations
 
 
+def test_telemetry_plane_catalog():
+    """Every PADDLE_TELEMETRY_*/PADDLE_EVENTLOG* knob,
+    paddle_telemetry_*/paddle_eventlog_* metric and exporter HTTP route
+    is cataloged in docs/OBSERVABILITY.md AND exercised by a test."""
+    from check_inventory import check_telemetry_plane
+    violations = check_telemetry_plane(verbose=False)
+    assert not violations, violations
+
+
 def test_serving_program_budget():
     """Compiled-program guard: a mixed prefill+decode load stays inside
     the ragged scheduler's declared token-bucket family (no per-request

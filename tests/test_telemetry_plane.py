@@ -1,0 +1,776 @@
+"""Telemetry plane (ISSUE 15): per-process HTTP exporters, fleet-wide
+scrape aggregation, and the correlated structured event log — endpoint
+bounds, strict exposition parsing, KV discovery, staleness/recovery,
+remote debug dumps, eventlog rotation/atomicity, the log_query join, and
+the plane-off bit-identity + zero-overhead contract."""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from urllib.error import HTTPError
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.elastic.tcp_kv import MemKVStore
+from paddle_tpu.inference import ContinuousServingEngine, ServingRouter
+from paddle_tpu.inference.fleet import replay as rp
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.profiler import eventlog, exporter, scrape, timeseries
+from paddle_tpu.profiler import flight_recorder as fr
+from paddle_tpu.profiler.exporter import TelemetryServer
+from paddle_tpu.profiler.scrape import (FleetScraper, parse_metrics_text,
+                                        render_metrics_text)
+from paddle_tpu.profiler.telemetry import get_registry
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+ENGINE_KW = dict(max_batch_size=4, max_len=96, page_size=16,
+                 prefill_chunk_tokens=16)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(num_hidden_layers=1,
+                                       max_position_embeddings=160))
+
+
+@pytest.fixture(autouse=True)
+def _eventlog_clean():
+    yield
+    eventlog.reset()
+
+
+def _get(addr, path, timeout=10):
+    try:
+        with urllib.request.urlopen(f"http://{addr}{path}",
+                                    timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except HTTPError as e:
+        return e.code, e.read()
+
+
+def _post(addr, path, data=b"", timeout=30):
+    req = urllib.request.Request(f"http://{addr}{path}", data=data,
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except HTTPError as e:
+        return e.code, e.read()
+
+
+# ---------------------------------------------------------------------------
+# exporter endpoints + bounds
+# ---------------------------------------------------------------------------
+
+
+class TestExporterEndpoints:
+    def test_metrics_healthz_state_history(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TELEMETRY_HOST", "127.0.0.1")
+        reg = get_registry()
+        reg.counter("plane_probe_total", "probe", labels=("k",)).inc(7,
+                                                                     k="a")
+        with TelemetryServer(instance="ep0", port=0) as srv:
+            assert srv.port > 0
+            code, body = _get(srv.address, "/metrics")
+            assert code == 200
+            fams = parse_metrics_text(body.decode())
+            assert fams["plane_probe_total"]["series"]["a"] == 7.0
+            # /metrics agrees exactly with the in-process registry
+            assert (reg.get("plane_probe_total").value(k="a")
+                    == fams["plane_probe_total"]["series"]["a"])
+            code, body = _get(srv.address, "/healthz")
+            assert code == 200
+            hz = json.loads(body)
+            assert hz["ok"] is True and hz["instance"] == "ep0"
+            code, body = _get(srv.address, "/state")
+            assert code == 200 and "state" in json.loads(body)
+            # /history: capped window, substring match
+            h = timeseries.get_history()
+            h.tick()
+            code, body = _get(srv.address,
+                              "/history?match=plane_probe&window_s=1e9")
+            assert code == 200
+            j = json.loads(body)
+            assert j["window_s"] == exporter.MAX_HISTORY_WINDOW_S
+            assert any(s["name"] == "plane_probe_total"
+                       for s in j["series"])
+            assert len(j["series"]) <= exporter.MAX_HISTORY_SERIES
+            # the exporter meters itself
+            assert (reg.get("paddle_telemetry_http_requests_total")
+                    .value(route="/metrics") >= 1)
+
+    def test_unknown_trace_404_and_method_bounds(self):
+        with TelemetryServer(instance="ep1", port=0) as srv:
+            code, _ = _get(srv.address, "/timeline/no-such-trace")
+            assert code == 404
+            code, _ = _get(srv.address, "/nope")
+            assert code == 404
+            code, _ = _get(srv.address, "/debug/dump")     # GET -> 405
+            assert code == 405
+            code, _ = _post(srv.address, "/metrics")       # POST -> 405
+            assert code == 405
+            # bounded bodies: oversized POST refused with 400
+            big = b"x" * (exporter.MAX_POST_BYTES + 1)
+            code, _ = _post(srv.address, "/debug/dump", data=big)
+            assert code == 400
+
+    def test_debug_dump_and_healthz_503(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path))
+        rec = fr.get_flight_recorder()
+        with TelemetryServer(instance="ep2", port=0) as srv:
+            code, body = _post(srv.address, "/debug/dump")
+            assert code == 200
+            paths = json.loads(body)["ranks"]
+            assert paths and all(os.path.exists(p)
+                                 for p in paths.values())
+            # a stale heartbeat flips /healthz to 503 (and names it)
+            rec._heartbeats["zz"] = time.monotonic() - 10_000
+            try:
+                code, body = _get(srv.address, "/healthz")
+                assert code == 503
+                assert "zz" in json.loads(body)["stale_ranks"]
+            finally:
+                rec._heartbeats.pop("zz", None)
+
+    def test_fixed_port_collision_falls_back_to_ephemeral(self):
+        a = TelemetryServer(instance="a", port=0).start()
+        try:
+            b = TelemetryServer(instance="b", port=a.port).start()
+            try:
+                assert b.port != a.port and b.port > 0
+            finally:
+                b.stop()
+        finally:
+            a.stop()
+
+    def test_instance_name_env_default(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TELEMETRY_INSTANCE", "named-by-env")
+        srv = TelemetryServer(port=0)
+        assert srv.instance == "named-by-env"
+
+
+# ---------------------------------------------------------------------------
+# gate tiers (fresh interpreters: unset/0 = off, auto = ephemeral)
+# ---------------------------------------------------------------------------
+
+
+class TestKnobTiers:
+    def test_disabled_inert_subprocess(self):
+        code = (
+            "import os, jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from paddle_tpu.profiler import exporter, eventlog\n"
+            "assert not exporter.exporter_enabled()\n"
+            "assert exporter.maybe_start_exporter('t') is None\n"
+            "os.environ['PADDLE_TELEMETRY_PORT'] = '0'\n"
+            "assert not exporter.exporter_enabled()\n"
+            "os.environ['PADDLE_TELEMETRY_PORT'] = 'auto'\n"
+            "srv = exporter.maybe_start_exporter('t')\n"
+            "assert srv is not None and srv.port > 0\n"
+            "srv.stop()\n"
+            "assert not eventlog.is_enabled()\n"
+            "assert eventlog.log_event('x') is None\n"
+            "print('GATE_OK')\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PADDLE_TELEMETRY_PORT", None)
+        env.pop("PADDLE_EVENTLOG", None)
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "GATE_OK" in proc.stdout
+
+    def test_eventlog_env_enable_at_import(self, tmp_path):
+        path = tmp_path / "boot.jsonl"
+        code = (
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "from paddle_tpu.profiler import eventlog\n"
+            "assert eventlog.is_enabled()\n"
+            "eventlog.log_event('boot', trace_id='t0')\n"
+            "print('EVENTLOG_OK')\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_EVENTLOG=str(path), PADDLE_EVENTLOG_MAX_MB="1")
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=240)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "EVENTLOG_OK" in proc.stdout
+        rec = json.loads(path.read_text().splitlines()[0])
+        assert rec["kind"] == "boot" and rec["trace_id"] == "t0"
+
+    def test_disabled_path_costs_nothing_measurable(self):
+        assert not eventlog.is_enabled()
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            eventlog.log_event("noop")
+        dt = time.perf_counter() - t0
+        # a plain bool check: generous ceiling so CI noise cannot flake
+        assert dt < 1.0, f"disabled log_event too slow: {dt:.3f}s"
+
+
+# ---------------------------------------------------------------------------
+# strict exposition parser
+# ---------------------------------------------------------------------------
+
+
+class TestStrictParser:
+    def test_round_trips_the_registry(self):
+        reg = get_registry()
+        reg.counter("rt_probe_total", "probe", labels=("k",)).inc(3, k="x")
+        reg.histogram("rt_probe_seconds", "probe").observe(0.02)
+        from paddle_tpu.profiler.telemetry import metrics_text
+        fams = parse_metrics_text(metrics_text())
+        assert fams["rt_probe_total"]["series"]["x"] == 3.0
+        snap = fams["rt_probe_seconds"]["series"][""]
+        assert snap["count"] == 1 and "+Inf" in snap["buckets"]
+        again = parse_metrics_text(render_metrics_text(fams))
+        assert again["rt_probe_total"]["series"] \
+            == fams["rt_probe_total"]["series"]
+        assert set(again) == set(fams)
+
+    def test_strictness_raises_on_garbage(self):
+        with pytest.raises(ValueError):
+            parse_metrics_text("this is not an exposition\n")
+        with pytest.raises(ValueError):
+            parse_metrics_text("undeclared_metric 1\n")   # no # TYPE
+        with pytest.raises(ValueError):
+            parse_metrics_text("# TYPE foo counter\nfoo{oops} 1\n")
+        with pytest.raises(ValueError):
+            parse_metrics_text("# TYPE foo counter\nfoo notanumber\n")
+        with pytest.raises(ValueError):
+            # inconsistent label names inside one family
+            parse_metrics_text('# TYPE foo counter\nfoo{a="1"} 1\n'
+                               'foo{b="2"} 2\n')
+
+
+# ---------------------------------------------------------------------------
+# scraper over static endpoints: merge, history fold, staleness cycle
+# ---------------------------------------------------------------------------
+
+
+def test_scraper_static_endpoints_history_fold(monkeypatch):
+    monkeypatch.setenv("PADDLE_TELEMETRY_SCRAPE_INTERVAL_S", "0.25")
+    reg = get_registry()
+    ctr = reg.counter("fold_probe_total", "probe")
+    ctr.inc(4)
+    srv = TelemetryServer(instance="s0", port=0).start()
+    sc = FleetScraper(endpoints={"s0": srv.address}, stale_s=0.5,
+                      timeout_s=5.0)
+    assert sc.interval_s == 0.25      # env knob drives the loop default
+    try:
+        assert sc.scrape_once() == {"s0": "ok"}
+        merged = sc.merged()
+        assert merged["fold_probe_total"]["series"]["s0"] == 4.0
+        # the fleet view folded into the scraper's OWN history (the
+        # series alert rules over the fleet evaluate against)
+        assert sc.history.latest("fold_probe_total", "s0")[1] == 4.0
+        ctr.inc(2)
+        sc.scrape_once()
+        assert sc.history.latest("fold_probe_total", "s0")[1] == 6.0
+        assert len(sc.history.points("fold_probe_total", "s0")) == 2
+        # dead endpoint -> stale after stale_s, survivors unaffected;
+        # answers again -> recovered
+        srv.stop()
+        time.sleep(0.6)
+        out = sc.scrape_once()
+        assert out == {"s0": "error"}
+        assert sc.instances()["s0"]["stale"] is True
+        assert "s0" not in sc.merged().get("fold_probe_total",
+                                           {}).get("series", {})
+    finally:
+        sc.stop()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# watchdog metrics-text rewrite is atomic (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_metrics_text_rewrite_atomic(tmp_path):
+    """Concurrent rewriters + a reader: the published file is ALWAYS a
+    complete exposition (write-unique-tmp-then-os.replace), never a
+    truncated body — the contract a scraper or `tpu_watch.sh metrics`
+    tailing PADDLE_METRICS_TEXT_PATH depends on."""
+    reg = get_registry()
+    reg.counter("atomic_probe_total", "probe").inc(5)
+    reg.histogram("atomic_probe_seconds", "probe").observe(0.1)
+    path = tmp_path / "metrics.prom"
+    dogs = [fr.Watchdog(fr.FlightRecorder(), deadline_s=300.0,
+                        poll_s=1000.0, metrics_text_path=str(path))
+            for _ in range(3)]
+    stop = threading.Event()
+
+    def rewrite(wd):
+        while not stop.is_set():
+            wd.write_metrics_text()
+
+    threads = [threading.Thread(target=rewrite, args=(wd,))
+               for wd in dogs]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.monotonic() + 5
+        while not path.exists() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert path.exists()
+        for _ in range(300):
+            text = path.read_text()
+            fams = parse_metrics_text(text)     # strict: torn body raises
+            assert fams["atomic_probe_total"]["series"][""] == 5.0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not list(tmp_path.glob("*.tmp.*")), \
+        "leaked tmp files from the rewrite path"
+
+
+# ---------------------------------------------------------------------------
+# event log: rotation + single-line atomicity under concurrent writers
+# ---------------------------------------------------------------------------
+
+
+class TestEventLog:
+    def test_rotation_and_concurrent_single_line_writes(self, tmp_path,
+                                                        monkeypatch):
+        monkeypatch.setenv("PADDLE_EVENTLOG_MAX_MB", "0.02")   # ~20 KiB
+        path = tmp_path / "ev.jsonl"
+        log = eventlog.EventLog(str(path))        # env knob wins
+        assert log.max_bytes == int(0.02 * (1 << 20))
+        reg = get_registry()
+        rot_before = reg.counter("paddle_eventlog_rotations_total").value()
+        rec_before = reg.counter("paddle_eventlog_records_total").value()
+        pad = "x" * 120
+
+        def writer(k):
+            for i in range(150):
+                log.append("spam", trace_id=f"t-{k}-{i}",
+                           replica=f"r{k}", pad=pad)
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert log.rotations >= 1
+        assert (path.parent / "ev.jsonl.1").exists()
+        # every surviving line is one whole JSON record — concurrent
+        # writers may interleave LINES, never bytes
+        seen = 0
+        for p in (path, path.parent / "ev.jsonl.1"):
+            for line in p.read_text().splitlines():
+                rec = json.loads(line)
+                assert rec["kind"] == "spam" and "trace_id" in rec
+                seen += 1
+        assert seen > 0
+        assert (reg.counter("paddle_eventlog_rotations_total").value()
+                - rot_before) >= 1
+        assert (reg.counter("paddle_eventlog_records_total").value()
+                - rec_before) == 8 * 150
+
+    def test_flight_and_trace_tees(self, tmp_path):
+        eventlog.enable(str(tmp_path / "tee.jsonl"))
+        from paddle_tpu.profiler import request_trace as rt
+        fr.record_event("controller", action="scale_up", reason="test")
+        ctx = rt.start_request(tenant="acme", source="test")
+        rt.add_event(ctx, "route", replica="r7", policy="affinity")
+        rt.finish_request(ctx, status="ok")
+        eventlog.disable()
+        recs = [json.loads(l) for l in
+                (tmp_path / "tee.jsonl").read_text().splitlines()]
+        kinds = [r["kind"] for r in recs]
+        assert "controller" in kinds          # flight-recorder tee
+        assert "admission" in kinds and "route" in kinds \
+            and "finish" in kinds             # request-trace tee
+        route = next(r for r in recs if r["kind"] == "route")
+        assert route["trace_id"] == ctx.trace_id
+        assert route["replica"] == "r7"
+
+
+# ---------------------------------------------------------------------------
+# log_query CLI (incl. the poisoned-interpreter discipline)
+# ---------------------------------------------------------------------------
+
+
+def _story_fixtures(tmp_path):
+    """Two per-replica logs telling one requeued request's story."""
+    t0 = 1_754_300_000.0
+    a = [
+        {"ts": t0 + 0.0, "kind": "admission", "rank": 0,
+         "trace_id": "req-abc", "tenant": "acme"},
+        {"ts": t0 + 0.1, "kind": "route", "rank": 0, "replica": "r0",
+         "trace_id": "req-abc", "policy": "affinity"},
+        {"ts": t0 + 1.0, "kind": "fleet_replica_dead", "rank": 0,
+         "replica": "r0", "reason": "killed"},
+        {"ts": t0 + 1.1, "kind": "requeue", "rank": 0, "replica": "r0",
+         "trace_id": "req-abc", "attempt": 1},
+    ]
+    b = [
+        {"ts": t0 + 1.2, "kind": "route", "rank": 0, "replica": "r1",
+         "trace_id": "req-abc", "policy": "balance"},
+        {"ts": t0 + 2.0, "kind": "delivered", "rank": 0, "replica": "r1",
+         "trace_id": "req-abc", "attempt": 2},
+        {"ts": t0 + 2.1, "kind": "finish", "rank": 0, "replica": "r1",
+         "trace_id": "req-abc", "status": "ok"},
+        {"ts": t0 + 5.0, "kind": "admission", "rank": 0,
+         "trace_id": "req-other"},
+    ]
+    pa, pb = tmp_path / "r0-events.jsonl", tmp_path / "r1-events.jsonl"
+    pa.write_text("".join(json.dumps(r) + "\n" for r in a))
+    pb.write_text("".join(json.dumps(r) + "\n" for r in b))
+    return pa, pb, t0
+
+
+def test_log_query_joins_and_filters(tmp_path, capsys):
+    import log_query as lq
+    pa, pb, t0 = _story_fixtures(tmp_path)
+    rows = lq.query([str(pa), str(pb)], trace="req-abc")
+    kinds = [r["kind"] for r in rows]
+    assert kinds == ["admission", "route", "requeue", "route",
+                     "delivered", "finish"]
+    files = {r["_file"] for r in rows}
+    assert files == {"r0-events.jsonl", "r1-events.jsonl"}
+    # replica / kind / window filters
+    assert all(r["replica"] == "r1"
+               for r in lq.query([str(pa), str(pb)], replica="r1"))
+    assert [r["kind"] for r in lq.query(
+        [str(pa), str(pb)], kinds={"requeue", "delivered"})] \
+        == ["requeue", "delivered"]
+    assert len(lq.query([str(pa), str(pb)], since=t0 + 1.0,
+                        until=t0 + 1.3)) == 3
+    # CLI: text mode prints the ordered story, exit 0
+    rc = lq.main(["--trace", "req-abc", str(pa), str(pb)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.index("admission") < out.index("requeue") \
+        < out.index("delivered")
+    assert lq.main(["--trace", "no-such", str(pa)]) == 1
+    capsys.readouterr()
+
+
+def test_log_query_no_jax_import(tmp_path):
+    """tools/log_query.py must run with jax AND numpy poisoned out of
+    the interpreter — it joins logs scp'd off the fleet on machines
+    with no accelerator stack."""
+    pa, pb, _ = _story_fixtures(tmp_path)
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "sys.modules['numpy'] = None\n"
+        "sys.argv = ['log_query.py', '--until', '1754300004', %r, %r]\n"
+        "import runpy\n"
+        "try:\n"
+        "    runpy.run_path(%r, run_name='__main__')\n"
+        "except SystemExit as e:\n"
+        "    raise SystemExit(e.code or 0)\n"
+        % (str(pa), str(pb),
+           os.path.join(REPO, "tools", "log_query.py")))
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.index("admission") \
+        < proc.stdout.index("fleet_replica_dead") \
+        < proc.stdout.index("requeue") < proc.stdout.index("delivered")
+
+
+# ---------------------------------------------------------------------------
+# fleet console --scrape (live mode, no-jax discipline)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_console_scrape_live_no_jax():
+    reg = get_registry()
+    reg.counter("console_probe_total", "probe").inc(9)
+    srv = TelemetryServer(instance="c0", port=0).start()
+    try:
+        code = (
+            "import sys\n"
+            "sys.modules['jax'] = None\n"
+            "sys.modules['numpy'] = None\n"
+            "sys.argv = ['fleet_console.py', '--scrape', %r,\n"
+            "            '--match', 'console_probe']\n"
+            "import runpy\n"
+            "try:\n"
+            "    runpy.run_path(%r, run_name='__main__')\n"
+            "except SystemExit as e:\n"
+            "    raise SystemExit(e.code or 0)\n"
+            % (f"c0={srv.address}",
+               os.path.join(REPO, "tools", "fleet_console.py")))
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert "live fleet" in proc.stdout
+        assert "console_probe_total{c0}  9" in proc.stdout
+        assert "healthy" in proc.stdout
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance: 3-replica fleet, KV discovery, exact agreement,
+# staleness + recovery, remote dump, cross-replica story
+# ---------------------------------------------------------------------------
+
+
+def _wait_for(cond, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def test_fleet_telemetry_plane_acceptance(model, tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TELEMETRY_PORT", "auto")
+    monkeypatch.setenv("PADDLE_TELEMETRY_STALE_S", "1.0")
+    monkeypatch.setenv("PADDLE_TELEMETRY_SCRAPE_INTERVAL_S", "0.1")
+    monkeypatch.setenv("PADDLE_FLIGHT_DIR", str(tmp_path / "flight"))
+    eventlog.enable(str(tmp_path / "events.jsonl"))
+    store = MemKVStore()
+    router = ServingRouter(model, num_replicas=3, policy="balance",
+                           engine_kwargs=ENGINE_KW, store=store,
+                           heartbeat_ttl=600.0)
+    reg = get_registry()
+    sc = None
+    try:
+        with router:
+            # -- discovery: each replica exports on its own ephemeral
+            # port, announced under fleet/telemetry/<rid> in the store
+            assert sorted(store.keys("fleet/telemetry/")) == [
+                "fleet/telemetry/r0", "fleet/telemetry/r1",
+                "fleet/telemetry/r2"]
+            ports = {r.id: r.exporter.port for r in router.replicas}
+            assert all(p > 0 for p in ports.values())
+            assert len(set(ports.values())) == 3
+            addrs = {r.id: r.exporter.address for r in router.replicas}
+
+            # -- PR-11 bursty replay drives seeded load through the fleet
+            trace = rp.make_trace(preset="bursty", seed=5,
+                                  duration_s=1.2, rate_rps=3.0,
+                                  burst_factor=4.0, burst_start_frac=0.3,
+                                  burst_dur_frac=0.3, prompt_len=(4, 12),
+                                  new_tokens=(2, 3))
+            harness = rp.ReplayHarness(
+                router, trace, vocab_size=128,
+                history=timeseries.MetricsHistory(capacity=512),
+                tick_interval_s=0.25, cooldown_s=0.25)
+            rep = harness.run()
+            assert rep.requests > 0
+
+            # -- fleet_metrics() agrees EXACTLY with the in-process
+            # registry on shared counters (thread-tier replicas share
+            # one registry; each instance's scrape must reproduce it)
+            sc = scrape.start_fleet_scraper(store=store, timeout_s=10.0)
+            out = sc.scrape_once()
+            assert out == {"r0": "ok", "r1": "ok", "r2": "ok"}, out
+            merged = scrape.fleet_metrics()
+            routed = reg.get("paddle_fleet_routed_total")
+            fam = merged["paddle_fleet_routed_total"]
+            assert fam["label_names"] == ["instance", "policy"]
+            checked = 0
+            for key, val in fam["series"].items():
+                inst, _, policy = key.partition(",")
+                assert val == routed.value(policy=policy), (key, val)
+                checked += 1
+            assert checked >= 3      # every instance reproduced it
+            assert reg.counter("paddle_telemetry_scrapes_total",
+                               labels=("outcome",)).value(outcome="ok") \
+                >= 3
+            # the merged text view round-trips the strict parser too
+            again = parse_metrics_text(scrape.fleet_metrics_text())
+            assert again["paddle_fleet_routed_total"]["series"] \
+                == fam["series"]
+
+            # -- every endpoint's /metrics body round-trips the strict
+            # exposition parser
+            for rid, addr in addrs.items():
+                code, body = _get(addr, "/metrics")
+                assert code == 200
+                fams = parse_metrics_text(body.decode())
+                rt2 = parse_metrics_text(render_metrics_text(fams))
+                assert rt2["paddle_fleet_routed_total"]["series"] \
+                    == fams["paddle_fleet_routed_total"]["series"]
+
+            # -- POST /debug/dump on a live replica while a request is
+            # in flight: the dump must NAME it
+            prompts = [np.random.RandomState(11 + i)
+                       .randint(0, 128, (1, 20)).astype(np.int64)
+                       for i in range(6)]
+            results = [None] * 6
+            errors = [None] * 6
+
+            def call(i):
+                try:
+                    results[i] = np.asarray(router.generate(
+                        prompts[i], max_new_tokens=24,
+                        timeout=600).numpy())
+                except Exception as e:      # noqa: BLE001
+                    errors[i] = e
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            assert _wait_for(lambda: any(r.inflight
+                                         for r in router.replicas))
+            busy = max((r for r in router.replicas if r.inflight),
+                       key=lambda r: len(r.inflight))
+            code, body = _post(addrs[busy.id], "/debug/dump")
+            assert code == 200
+            dump_paths = json.loads(body)["ranks"]
+            dump = json.load(open(next(iter(dump_paths.values()))))
+            in_flight_traces = [
+                a.get("trace")
+                for prov in dump["state"].values()
+                if isinstance(prov, dict)
+                for a in prov.get("request_ages", [])]
+            assert any(in_flight_traces), \
+                "dump did not name the in-flight requests"
+
+            # -- hard-kill the busy replica mid-flight: its requests
+            # requeue to survivors (the story the event log must tell)
+            router.kill_replica(busy.id)
+            victim = busy.id
+            for t in threads:
+                t.join()
+            assert not [e for e in errors if e], errors
+            assert router.stats()["requeues_total"] >= 1
+
+            # -- the scrape loop marks the dead endpoint stale within
+            # PADDLE_TELEMETRY_STALE_S, gauge ticks, survivors keep
+            # being served
+            sc_started_mono = time.monotonic()
+            assert _wait_for(
+                lambda: sc.instances().get(victim, {}).get("stale"))
+            stale_after = time.monotonic() - sc_started_mono
+            assert stale_after < 10.0
+            assert reg.get("paddle_telemetry_stale_instances") \
+                .value() >= 1
+            merged = scrape.fleet_metrics()
+            live_insts = {k.split(",", 1)[0] for k in
+                          merged["paddle_fleet_routed_total"]["series"]}
+            assert victim not in live_insts
+            assert live_insts == {r.id for r in router.replicas
+                                  if r.id != victim}
+            survivors = {r.id for r in router.replicas
+                         if r.id != victim}
+            out = sc.scrape_once()
+            assert all(out[s] == "ok" for s in survivors)
+            assert out.get(victim) == "error"
+
+            # -- rejoin: fresh endpoint (new ephemeral port), scraper
+            # recovers, gauge returns to 0
+            dead_engine = router._replica(victim).engine
+            assert _wait_for(lambda: dead_engine._thread is None
+                             or not dead_engine._thread.is_alive())
+            router.rejoin(victim)
+            assert router._replica(victim).exporter.port > 0
+            assert _wait_for(
+                lambda: not sc.instances().get(victim, {}).get("stale"))
+            sc.scrape_once()
+            assert reg.get("paddle_telemetry_stale_instances") \
+                .value() == 0
+            assert victim in {
+                k.split(",", 1)[0] for k in
+                scrape.fleet_metrics()["paddle_fleet_routed_total"]
+                ["series"]}
+    finally:
+        if sc is not None:
+            scrape.stop_fleet_scraper()
+        eventlog.disable()
+
+    # -- tools/log_query.py --trace reconstructs the requeued request's
+    # admission -> kill -> requeue -> delivered story ACROSS two
+    # replicas' event logs (split the process log by writing replica,
+    # exactly what per-process logs would hold)
+    import log_query as lq
+    recs = [json.loads(l) for l in
+            (tmp_path / "events.jsonl").read_text().splitlines()]
+    requeued = [r for r in recs if r["kind"] == "requeue"
+                and r.get("trace_id")]
+    assert requeued, "no requeue event reached the event log"
+    story_trace = requeued[0]["trace_id"]
+    va, vb = tmp_path / "rA.jsonl", tmp_path / "rB.jsonl"
+    with open(va, "w") as fa, open(vb, "w") as fb:
+        for r in recs:
+            tgt = fa if r.get("replica") == victim else fb
+            tgt.write(json.dumps(r) + "\n")
+    rows = lq.query([str(va), str(vb)], trace=story_trace)
+    kinds = [r["kind"] for r in rows]
+    assert kinds[0] == "admission"
+    assert "requeue" in kinds and "delivered" in kinds
+    assert kinds.index("requeue") < kinds.index("delivered")
+    assert {r["_file"] for r in rows} == {"rA.jsonl", "rB.jsonl"}
+    # the kill itself is in the joined window (replica-level event,
+    # joined by time, not trace id)
+    t_requeue = next(r["ts"] for r in rows if r["kind"] == "requeue")
+    kills = lq.query([str(va), str(vb)], kinds={"fleet_replica_dead"},
+                     until=t_requeue)
+    assert any(k.get("replica") == victim for k in kills)
+
+
+# ---------------------------------------------------------------------------
+# plane off == bit-identical outputs, zero overhead
+# ---------------------------------------------------------------------------
+
+
+def test_plane_on_off_bit_identical(model, tmp_path, monkeypatch):
+    """With PADDLE_TELEMETRY_PORT unset the plane is inert and outputs
+    match a plane-on run bit-for-bit — exporter, scraper and event log
+    observe, never steer."""
+    p = np.random.RandomState(3).randint(0, 128, (1, 12)).astype(np.int64)
+    monkeypatch.delenv("PADDLE_TELEMETRY_PORT", raising=False)
+    eng = ContinuousServingEngine(model, **ENGINE_KW)
+    with eng:
+        assert getattr(eng, "_exporter", None) is None
+        off = np.asarray(eng.generate(p, max_new_tokens=8,
+                                      timeout=600).numpy())
+    monkeypatch.setenv("PADDLE_TELEMETRY_PORT", "auto")
+    eventlog.enable(str(tmp_path / "onoff.jsonl"))
+    try:
+        eng2 = ContinuousServingEngine(model, **ENGINE_KW)
+        with eng2:
+            assert eng2._exporter is not None and eng2._exporter.port > 0
+            code, body = _get(eng2._exporter.address, "/metrics")
+            assert code == 200 and b"paddle_serving" in body
+            on = np.asarray(eng2.generate(p, max_new_tokens=8,
+                                          timeout=600).numpy())
+        assert eng2._exporter is None      # stopped with the engine
+    finally:
+        eventlog.disable()
+    np.testing.assert_array_equal(on, off)
+
+
+def test_controller_exporter_lifecycle(model, monkeypatch):
+    """The FleetController exports too, on the fleet's discovery
+    prefix, and tears its endpoint down with stop()."""
+    from paddle_tpu.inference import FleetController
+    monkeypatch.setenv("PADDLE_TELEMETRY_PORT", "auto")
+    store = MemKVStore()
+    router = ServingRouter(model, num_replicas=2, engine_kwargs=ENGINE_KW,
+                           store=store, heartbeat_ttl=600.0)
+    with router:
+        ctl = FleetController(router, interval_s=0.1)
+        ctl.start()
+        try:
+            assert ctl.exporter is not None
+            assert "fleet/telemetry/controller" in \
+                store.keys("fleet/telemetry/")
+            code, body = _get(ctl.exporter.address, "/healthz")
+            assert code in (200, 503) and json.loads(body)["instance"] \
+                == "controller"
+        finally:
+            ctl.stop()
+        assert ctl.exporter is None
+        assert "fleet/telemetry/controller" not in \
+            store.keys("fleet/telemetry/")

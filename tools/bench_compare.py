@@ -43,6 +43,8 @@ DEFAULT_THRESHOLD_PCT = 10.0
 #: (substring, direction) — first match wins, checked in order. More
 #: specific entries go first (``waste_ratio`` before ``ratio``).
 DIRECTION_RULES = [
+    ("telemetry_export_overhead", "lower"),
+    ("scrape_age", "lower"),
     ("overhead_pct", "lower"),
     ("waste_ratio", "lower"),
     ("forwards_per_token", "lower"),

@@ -374,6 +374,21 @@ INVENTORY = [
     ("Fleet fault directives (kill/stall by routed request)",
      "paddle_tpu.distributed.fault",
      ["FLEET_FAULT_KINDS", "check_fleet_route", "Fault", "FaultPlan"]),
+    # -- telemetry plane (ISSUE 15) ------------------------------------------
+    ("Per-process telemetry exporter (HTTP endpoints + KV discovery)",
+     "paddle_tpu.profiler.exporter",
+     ["TelemetryServer", "maybe_start_exporter", "exporter_enabled",
+      "ROUTES", "KV_TELEMETRY_PREFIX", "MAX_HISTORY_WINDOW_S",
+      "MAX_POST_BYTES"]),
+    ("Fleet scrape aggregation (strict parser + merged view)",
+     "paddle_tpu.profiler.scrape",
+     ["FleetScraper", "parse_metrics_text", "render_metrics_text",
+      "merge_instances", "fleet_metrics", "fleet_metrics_text",
+      "start_fleet_scraper", "stop_fleet_scraper"]),
+    ("Correlated structured event log (JSONL + rotation)",
+     "paddle_tpu.profiler.eventlog",
+     ["EventLog", "get_event_log", "log_event", "enable", "disable",
+      "is_enabled", "EVENTLOG_SCHEMA"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -898,6 +913,77 @@ def check_controller_catalog(verbose=True):
     return violations
 
 
+def check_telemetry_plane(verbose=True):
+    """Telemetry-plane inventory guard (ISSUE 15): every
+    ``PADDLE_TELEMETRY_*`` / ``PADDLE_EVENTLOG*`` env knob, every
+    ``paddle_telemetry_*`` / ``paddle_eventlog_*`` metric referenced in
+    ``paddle_tpu/`` AND every exporter HTTP route
+    (``profiler.exporter.ROUTES``) must be cataloged in
+    docs/OBSERVABILITY.md and exercised by at least one test — a remote
+    diagnosis surface nobody documents or tests is a dashboard that
+    404s during the incident. Returns a list of violation strings."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    knob_pat = re.compile(r"PADDLE_(?:TELEMETRY|EVENTLOG)[A-Z0-9_]*")
+    metric_pat = re.compile(
+        r"paddle_(?:telemetry|eventlog)_[a-z0-9_]*[a-z0-9]")
+    knobs, metrics = set(), set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    text = f.read()
+                knobs.update(knob_pat.findall(text))
+                metrics.update(metric_pat.findall(text))
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              errors="replace") as f:
+        doc = f.read()
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name), errors="replace") as f:
+                tests_text += f.read()
+    violations = []
+    for k in sorted(knobs):
+        if k not in doc:
+            violations.append(
+                f"telemetry-plane knob {k} missing from "
+                f"docs/OBSERVABILITY.md")
+        if k not in tests_text:
+            violations.append(
+                f"telemetry-plane knob {k} not exercised by any test")
+    for m in sorted(metrics):
+        if m not in doc:
+            violations.append(
+                f"telemetry-plane metric {m} missing from "
+                f"docs/OBSERVABILITY.md")
+        if m not in tests_text:
+            violations.append(
+                f"telemetry-plane metric {m} not exercised by any test")
+    from paddle_tpu.profiler.exporter import ROUTES
+    for route in ROUTES:
+        # backtick-prefix match: `/timeline/<trace_id>` documents the
+        # /timeline route
+        if f"`{route}" not in doc:
+            violations.append(
+                f"exporter route {route!r} missing from "
+                f"docs/OBSERVABILITY.md")
+        if route not in tests_text:
+            violations.append(
+                f"exporter route {route!r} not exercised by any test")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"telemetry plane: {len(knobs)} knobs, {len(metrics)} "
+              f"metrics, {len(ROUTES)} routes checked")
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -927,5 +1013,5 @@ if __name__ == "__main__":
                    or check_fleet_knobs() or check_observability_catalog()
                    or check_alert_catalog() or check_training_observability()
                    or check_ledger_catalog() or check_controller_catalog()
-                   or check_serving_programs())
+                   or check_telemetry_plane() or check_serving_programs())
              else 0)

@@ -229,6 +229,87 @@ def controller_lines(actions, posture):
 
 
 # ---------------------------------------------------------------------------
+# live scrape mode (--scrape): merged view against a RUNNING fleet
+# ---------------------------------------------------------------------------
+
+
+def _load_scrape_mod():
+    """Standalone-load ``paddle_tpu/profiler/scrape.py`` by file path —
+    its module level is stdlib-only by contract, so the console gets the
+    strict exposition parser + instance merge without importing
+    paddle_tpu (and thus without jax)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "paddle_tpu", "profiler", "scrape.py")
+    spec = importlib.util.spec_from_file_location("_paddle_scrape", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def scrape_endpoints(endpoints, timeout_s=2.0):
+    """Fetch + parse ``/metrics`` and ``/healthz`` from each
+    ``host:port``; returns (by_instance families, health rows)."""
+    import urllib.request
+    from urllib.error import HTTPError, URLError
+
+    mod = _load_scrape_mod()
+    by_instance, health = {}, []
+    for ep in endpoints:
+        instance, _, addr = ep.partition("=")
+        if not addr:
+            instance, addr = ep, ep
+        row = {"instance": instance, "endpoint": addr, "ok": False}
+        try:
+            by_instance[instance] = mod.fetch_metrics(addr,
+                                                      timeout_s=timeout_s)
+            row["ok"] = True
+        except Exception as e:
+            row["error"] = repr(e)
+        try:
+            with urllib.request.urlopen(f"http://{addr}/healthz",
+                                        timeout=timeout_s) as resp:
+                row["healthz"] = json.loads(resp.read()).get("ok")
+        except HTTPError as e:
+            try:
+                row["healthz"] = json.loads(e.read()).get("ok")
+            except ValueError:
+                row["healthz"] = False
+        except (URLError, OSError, ValueError):
+            row["healthz"] = None
+        health.append(row)
+    return mod.merge_instances(by_instance), health
+
+
+def render_scrape(merged, health, match=None) -> str:
+    out = ["== live fleet (scraped) =="]
+    for row in health:
+        status = "UP" if row["ok"] else "DOWN"
+        hz = {True: "healthy", False: "UNHEALTHY",
+              None: "no healthz"}[row.get("healthz")]
+        line = (f"{row['instance']:<12} {row['endpoint']:<22} "
+                f"{status:<5} {hz}")
+        if row.get("error"):
+            line += f"  {row['error']}"
+        out.append(line)
+    out.append("")
+    out.append("== merged metrics ==")
+    for name in sorted(merged):
+        fam = merged[name]
+        for key in sorted(fam.get("series", {})):
+            disp = f"{name}{{{key}}}" if key else name
+            if match and match not in disp:
+                continue
+            val = fam["series"][key]
+            if isinstance(val, dict):      # histogram snapshot
+                out.append(f"{disp}  count={fmt(val.get('count'))} "
+                           f"sum={fmt(val.get('sum'))}")
+            else:
+                out.append(f"{disp}  {fmt(val)}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # rendering
 # ---------------------------------------------------------------------------
 
@@ -379,7 +460,7 @@ def render_html(rows, active, transitions, replicas, reports,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="render metric history / alerts / replica state")
-    ap.add_argument("inputs", nargs="+",
+    ap.add_argument("inputs", nargs="*",
                     help="history JSONL, flight dumps, replay reports "
                          "(globs ok)")
     ap.add_argument("--match", help="filter history series by substring")
@@ -388,7 +469,30 @@ def main(argv=None) -> int:
     ap.add_argument("--html", metavar="PATH",
                     help="write a self-contained HTML page instead of "
                          "text on stdout")
+    ap.add_argument("--scrape", metavar="EP[,EP...]",
+                    help="LIVE mode: scrape running telemetry endpoints "
+                         "('host:port' or 'name=host:port', comma-"
+                         "separated) and render the merged fleet view")
+    ap.add_argument("--rounds", type=int, default=1,
+                    help="scrape rounds to render (with --scrape)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between scrape rounds")
     args = ap.parse_args(argv)
+    if args.scrape:
+        import time as _time
+        endpoints = [e.strip() for e in args.scrape.split(",")
+                     if e.strip()]
+        for i in range(max(args.rounds, 1)):
+            if i:
+                _time.sleep(args.interval)
+            merged, health = scrape_endpoints(endpoints)
+            sys.stdout.write(render_scrape(merged, health,
+                                           match=args.match))
+            sys.stdout.flush()
+        return 0
+    if not args.inputs:
+        print("fleet_console: need inputs (or --scrape)", file=sys.stderr)
+        return 2
     series, dumps, reports = load_inputs(args.inputs)
     if not series and not dumps and not reports:
         print("fleet_console: no usable inputs", file=sys.stderr)

@@ -1,0 +1,143 @@
+"""Query the structured event logs (``paddle_tpu/profiler/eventlog.py``
+JSONL): filter and JOIN by trace id, replica, kind and time window
+across any number of per-replica log files — one request's whole story
+(admission -> route -> kill -> requeue -> delivered) stays greppable
+after every process that served it is gone.
+
+Usage:
+    python tools/log_query.py events.jsonl                    # everything
+    python tools/log_query.py --trace req-1a2b-000003 r*/events.jsonl
+    python tools/log_query.py --replica r1 --kind requeue,delivered *.jsonl
+    python tools/log_query.py --since 1754300000 --until 1754300060 a.jsonl
+    python tools/log_query.py --json --trace req-... a.jsonl b.jsonl
+
+Records are merged from every input file (globs ok, rotated ``.1``
+siblings included via ``--rotated``) and printed oldest-first, each
+stamped with the file it came from — the cross-replica join is the sort.
+Same import discipline as ``fleet_console.py``: stdlib-only, no jax —
+this must run on a laptop against logs scp'd off the fleet.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def load_records(paths, include_rotated=False):
+    """[(path, record), ...] from every readable JSONL input. Torn or
+    non-JSON lines are skipped with a stderr note (a log being written
+    this instant may legitimately end mid-line only if the writer is
+    broken — the eventlog's single-write contract makes these rare)."""
+    files = []
+    for pattern in paths:
+        hits = sorted(glob.glob(pattern)) or [pattern]
+        for path in hits:
+            files.append(path)
+            if include_rotated and os.path.exists(path + ".1"):
+                files.append(path + ".1")
+    out = []
+    for path in files:
+        try:
+            f = open(path, errors="replace")
+        except OSError as e:
+            print(f"log_query: cannot read {path}: {e}", file=sys.stderr)
+            continue
+        with f:
+            for lineno, line in enumerate(f, start=1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    print(f"log_query: {path}:{lineno}: skipping "
+                          f"non-JSON line", file=sys.stderr)
+                    continue
+                if isinstance(rec, dict):
+                    out.append((path, rec))
+    return out
+
+
+def match(rec, trace=None, replica=None, kinds=None, since=None,
+          until=None):
+    if trace is not None and str(rec.get("trace_id")) != str(trace):
+        return False
+    if replica is not None and str(rec.get("replica")) != str(replica):
+        return False
+    if kinds and str(rec.get("kind")) not in kinds:
+        return False
+    ts = rec.get("ts")
+    if since is not None and (ts is None or ts < since):
+        return False
+    if until is not None and (ts is None or ts > until):
+        return False
+    return True
+
+
+def query(paths, trace=None, replica=None, kinds=None, since=None,
+          until=None, include_rotated=False):
+    """The joined, time-ordered record list (each with ``_file``)."""
+    rows = []
+    for path, rec in load_records(paths, include_rotated=include_rotated):
+        if match(rec, trace=trace, replica=replica, kinds=kinds,
+                 since=since, until=until):
+            rec = dict(rec, _file=os.path.basename(path))
+            rows.append(rec)
+    rows.sort(key=lambda r: (r.get("ts") or 0.0, r.get("kind", "")))
+    return rows
+
+
+_CORE = ("ts", "kind", "replica", "trace_id", "rank", "_file")
+
+
+def format_row(rec) -> str:
+    ts = rec.get("ts")
+    extra = {k: v for k, v in rec.items() if k not in _CORE}
+    detail = " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+    return (f"{ts:.6f}  {rec.get('kind', '?'):<22} "
+            f"replica={rec.get('replica') or '-':<10} "
+            f"trace={rec.get('trace_id') or '-':<24} "
+            f"[{rec.get('_file', '?')}]"
+            + (f"  {detail}" if detail else ""))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="filter/join structured event logs by trace id, "
+                    "replica, kind and time window")
+    ap.add_argument("inputs", nargs="+",
+                    help="eventlog JSONL files (globs ok)")
+    ap.add_argument("--trace", help="only events of this trace id")
+    ap.add_argument("--replica", help="only events of this replica")
+    ap.add_argument("--kind",
+                    help="comma-separated event kinds to keep")
+    ap.add_argument("--since", type=float,
+                    help="only events with ts >= SINCE (unix seconds)")
+    ap.add_argument("--until", type=float,
+                    help="only events with ts <= UNTIL (unix seconds)")
+    ap.add_argument("--rotated", action="store_true",
+                    help="also read each input's rotated .1 sibling")
+    ap.add_argument("--json", action="store_true",
+                    help="emit JSONL instead of aligned text")
+    args = ap.parse_args(argv)
+    kinds = (set(k.strip() for k in args.kind.split(",") if k.strip())
+             if args.kind else None)
+    rows = query(args.inputs, trace=args.trace, replica=args.replica,
+                 kinds=kinds, since=args.since, until=args.until,
+                 include_rotated=args.rotated)
+    for rec in rows:
+        if args.json:
+            print(json.dumps(rec))
+        else:
+            print(format_row(rec))
+    if not rows:
+        print("log_query: no matching events", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
