@@ -1181,6 +1181,97 @@ def bench_serving():
             "acceptance": (eng.spec_accepted_tokens
                            / max(eng.spec_drafted_tokens, 1)),
             "drafted": eng.spec_drafted_tokens,
+            # batched drafting win: draft-model forwards per drafting
+            # tick (the per-slot path pays ~slots*k forwards per tick,
+            # the batched path pays ~k)
+            "draft_forwards_per_tick": round(
+                eng.spec_draft_forwards / max(eng.spec_draft_ticks, 1),
+                3),
+        }
+
+    def qblock_step_probe():
+        """Q-block vs per-token ragged grid at a representative mixed
+        prefill+decode tick: the per-token kernel runs one grid step per
+        (token, kv_head, page); the q-block kernel runs one per
+        (q_block, kv_head, job). The step ratio is the device-tier
+        speed lever (fewer, fatter MXU launches for the same math) and
+        is exact from the schedules — no timing noise."""
+        from paddle_tpu.ops.pallas.ragged_paged_attention import (
+            qblock_schedule, _qblock_rows)
+        page, pps = 16, 8
+        # 3 decode slots mid-stream + a chunked-prefill tail + a fresh
+        # prefill: 64 packed tokens, the run_mixed regime
+        seq_slots = np.asarray([0, 1, 2, 3, 4], np.int32)
+        q_starts = np.asarray([0, 1, 2, 3, 32], np.int32)
+        q_lens = np.asarray([1, 1, 1, 29, 32], np.int32)
+        ctx = np.asarray([97, 54, 21, 29, 32], np.int32)
+        tbl = np.zeros((8, pps), np.int32)
+        tokens = 64
+        kv_heads = cfg.num_key_value_heads
+        _, _, job_page, _, _ = qblock_schedule(
+            tokens, seq_slots, q_starts, q_lens, ctx, tbl,
+            _qblock_rows(), page)
+        q_steps = job_page.shape[0] * kv_heads * job_page.shape[1]
+        t_steps = tokens * kv_heads * pps
+        return {"qblock_grid_steps": int(q_steps),
+                "token_grid_steps": int(t_steps),
+                "step_ratio": round(q_steps / t_steps, 4)}
+
+    def run_int8_weights():
+        """Fully-quantized serving config: int8 weights end-to-end
+        (``quantize_linears`` routes every Linear through the Pallas
+        int8 GEMM) + int8 KV pages, on a fresh same-seed model so the
+        shared float model above stays untouched. Emits the tokens/s
+        ratio vs the float engine and the weight-footprint win."""
+        import hashlib
+
+        from paddle_tpu.nn.layers.common import Linear
+
+        paddle.seed(0)
+        qmodel = LlamaForCausalLM(cfg)
+        eng = ContinuousServingEngine(
+            qmodel, max_batch_size=4, max_len=sys_len + tail + new + 16,
+            enable_prefix_cache=False, prefill_chunk_tokens=chunk,
+            weight_dtype="int8", kv_dtype="int8")
+        with eng:
+            eng.generate(prompts[0], max_new_tokens=new, timeout=1800)
+            t0 = time.perf_counter()
+            outs = [None] * (n_req - 1)
+
+            def _gen(i, p):
+                outs[i] = np.asarray(
+                    eng.generate(p, max_new_tokens=new,
+                                 timeout=1800).numpy())
+
+            threads = [threading.Thread(target=_gen, args=(i, p))
+                       for i, p in enumerate(prompts[1:])]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+        int8_bytes = float_bytes = 0
+
+        def visit(layer):
+            nonlocal int8_bytes, float_bytes
+            if isinstance(layer, Linear) and layer._w_int8 is not None:
+                int8_bytes += (layer._w_int8.nbytes
+                               + layer._w_scale.nbytes)
+                float_bytes += layer._w_int8.size * 4
+            for sub in layer._sub_layers.values():
+                if sub is not None:
+                    visit(sub)
+
+        visit(qmodel)
+        h = hashlib.sha1()
+        for o in outs:
+            h.update(np.ascontiguousarray(o).tobytes())
+        return {
+            "tokens_per_sec": (n_req - 1) * new / dt,
+            "quantized_linears": int(eng.quantized_linears),
+            "weight_bytes_ratio": round(int8_bytes
+                                        / max(float_bytes, 1), 4),
+            "token_digest": h.hexdigest(),
         }
 
     def kv_capacity_probe():
@@ -1225,6 +1316,10 @@ def bench_serving():
     mixed_legacy = run_mixed(False)
     spec_on = run_spec(True)
     spec_off = run_spec(False)
+    qblock = qblock_step_probe()
+    int8w = run_int8_weights()
+    int8w_ratio = round(int8w["tokens_per_sec"]
+                        / max(off["tokens_per_sec"], 1e-9), 2)
     spec_speedup = round(spec_on["tokens_per_sec"]
                          / max(spec_off["tokens_per_sec"], 1e-9), 2)
     kv_probe = (kv_capacity_probe()
@@ -1248,6 +1343,12 @@ def bench_serving():
          round(spec_on["acceptance"], 3)),
         ("serving_spec_forwards_per_token",
          round(spec_on["forwards_per_token"], 3)),
+        ("serving_qblock_step_ratio", qblock["step_ratio"]),
+        ("serving_int8_weight_tokens_per_s_ratio", int8w_ratio),
+        ("serving_int8_weight_bytes_ratio",
+         int8w["weight_bytes_ratio"]),
+        ("spec_draft_forwards_per_tick",
+         spec_on["draft_forwards_per_tick"]),
     ]
     if kv_probe is not None:
         aux.append(("serving_kv_capacity_ratio",
@@ -1291,6 +1392,16 @@ def bench_serving():
         "spec_forwards_per_token": round(spec_on["forwards_per_token"], 3),
         "nospec_forwards_per_token": round(spec_off["forwards_per_token"],
                                            3),
+        "spec_draft_forwards_per_tick": spec_on["draft_forwards_per_tick"],
+        # q-block vs per-token ragged grid (exact step counts)
+        "serving_qblock_step_ratio": qblock["step_ratio"],
+        "qblock_grid_steps": qblock["qblock_grid_steps"],
+        "token_grid_steps": qblock["token_grid_steps"],
+        # fully-quantized config: int8 weights + int8 KV pages
+        "serving_int8_weight_tokens_per_s_ratio": int8w_ratio,
+        "serving_int8_weight_bytes_ratio": int8w["weight_bytes_ratio"],
+        "int8_weight_token_digest": int8w["token_digest"],
+        "quantized_linears": int8w["quantized_linears"],
         "kv_capacity_probe": kv_probe,
         "config": {"requests": n_req, "sys_prompt": sys_len, "tail": tail,
                    "new_tokens": new, "chunk_tokens": chunk},

@@ -154,7 +154,9 @@ def _engine_state(engine) -> dict:
                  "token_budget", "ragged_prefill_tokens",
                  "ragged_decode_tokens", "padded_tokens_total",
                  "useful_tokens_total", "spec_drafted_tokens",
-                 "spec_accepted_tokens", "spec_rounds", "spec_k"):
+                 "spec_accepted_tokens", "spec_rounds", "spec_k",
+                 "spec_draft_forwards", "spec_draft_ticks",
+                 "quantized_linears"):
         v = getattr(engine, attr, None)
         if v is not None:
             state[attr] = v
@@ -187,6 +189,10 @@ def _engine_state(engine) -> dict:
         state["ragged"] = engine.enable_ragged
     if getattr(engine, "enable_spec", None) is not None:
         state["spec_decode"] = engine.enable_spec
+    if getattr(engine, "draft_batch", None) is not None:
+        state["draft_batch"] = engine.draft_batch
+    if getattr(engine, "weight_dtype", None) is not None:
+        state["weight_dtype"] = engine.weight_dtype
     cache = getattr(engine, "_cache", None)
     if cache is not None:
         # bytes, not just page counts: the int8-KV capacity win must be
@@ -627,8 +633,24 @@ class ContinuousServingEngine:
                  enable_prefix_cache=None, num_pages=None,
                  token_budget=None, enable_ragged=None, kv_dtype=None,
                  spec_decode=None, spec_k=None, drafter=None,
-                 draft_model=None):
+                 draft_model=None, weight_dtype=None, draft_batch=None):
         self.model = model
+        # end-to-end int8 weights (PADDLE_WEIGHT_DTYPE=int8): every
+        # nn.Linear swaps its weight for (int8, per-channel scale) and
+        # forwards through the Pallas int8 GEMM — composes with
+        # kv_dtype="int8" for a fully-quantized serving config
+        if weight_dtype is None:
+            weight_dtype = os.environ.get("PADDLE_WEIGHT_DTYPE") or None
+        self.weight_dtype = str(weight_dtype).lower() if weight_dtype \
+            else None
+        if self.weight_dtype not in (None, "int8"):
+            raise ValueError(f"unsupported weight_dtype "
+                             f"{self.weight_dtype!r} (expected 'int8')")
+        if self.weight_dtype == "int8":
+            from ..quantization import quantize_linears
+            self.quantized_linears = quantize_linears(model)
+        else:
+            self.quantized_linears = 0
         self.max_batch = int(max_batch_size)
         self.page_size = int(page_size)
         self.max_len = int(max_len)
@@ -682,9 +704,20 @@ class ContinuousServingEngine:
                 from .speculative import make_drafter
                 drafter = make_drafter(draft_model=draft_model)
             self._drafter = drafter
+        # batched drafting (PADDLE_SPEC_DRAFT_BATCH, default on): one
+        # padded draft forward per tick for every live decode slot
+        # instead of one forward per slot per drafted token — proposals
+        # stay bit-identical (greedy + causal right-padding), only the
+        # forward count drops
+        if draft_batch is None:
+            draft_batch = os.environ.get(
+                "PADDLE_SPEC_DRAFT_BATCH", "1") != "0"
+        self.draft_batch = bool(draft_batch)
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
         self.spec_rounds = 0           # verify spans with >= 1 draft
+        self.spec_draft_forwards = 0   # draft-model forwards observed
+        self.spec_draft_ticks = 0      # ticks that ran the drafter
         self._q: queue.Queue = queue.Queue()
         self._thread = None
         self._running = False
@@ -1018,6 +1051,36 @@ class ContinuousServingEngine:
                     tick_drafts = {}  # slot -> drafted tokens this tick
                     off = 0
                     drafter = self._drafter
+                    draft_f0 = getattr(drafter, "forwards", None)
+                    # batched drafting prepass: one padded draft forward
+                    # per STEP for every decode slot at once. Each slot
+                    # is over-asked up to an optimistic cap (>= any room
+                    # the sequential packing below can grant, since
+                    # every other slot takes at least 1 token) and the
+                    # greedy proposal — prefix-stable in k — is trimmed
+                    # to the exact sequential room, so packing is
+                    # bit-identical to the per-slot propose() path.
+                    batch_drafts = None
+                    if (drafter is not None and self.draft_batch
+                            and decode_slots
+                            and hasattr(drafter, "propose_batch")):
+                        hists, caps = [], []
+                        for i in decode_slots:
+                            row = active[i]
+                            start = int(cache.lens[i])
+                            caps.append(max(0, min(
+                                self.token_budget - len(decode_slots),
+                                self.spec_k,
+                                self.max_len - start - 1,
+                                row.req.max_new_tokens
+                                - len(row.generated) - 1)))
+                            hists.append(np.concatenate(
+                                [row.prompt,
+                                 np.asarray(row.generated,
+                                            row.prompt.dtype)]))
+                        batch_drafts = (
+                            drafter.propose_batch(hists, caps)
+                            if max(caps) > 0 else [[] for _ in caps])
                     for di, i in enumerate(decode_slots):
                         row = active[i]
                         start = int(cache.lens[i])
@@ -1035,17 +1098,26 @@ class ContinuousServingEngine:
                                 self.max_len - start - 1,
                                 row.req.max_new_tokens
                                 - len(row.generated) - 1)
-                            draft = (drafter.propose(
-                                np.concatenate(
-                                    [row.prompt,
-                                     np.asarray(row.generated,
-                                                row.prompt.dtype)]),
-                                room) if room > 0 else [])
+                            if batch_drafts is not None:
+                                draft = (batch_drafts[di][:room]
+                                         if room > 0 else [])
+                            else:
+                                draft = (drafter.propose(
+                                    np.concatenate(
+                                        [row.prompt,
+                                         np.asarray(row.generated,
+                                                    row.prompt.dtype)]),
+                                    room) if room > 0 else [])
                             if draft:
                                 tick_drafts[i] = [int(t) for t in draft]
                                 n = 1 + len(tick_drafts[i])
                         spans.append((i, off, start, n, "decode"))
                         off += n
+                    if drafter is not None and decode_slots:
+                        self.spec_draft_ticks += 1
+                        if draft_f0 is not None:
+                            self.spec_draft_forwards += (
+                                drafter.forwards - draft_f0)
                     remaining = self.token_budget - off
                     for slot in list(prefill_q):
                         if remaining <= 0:

@@ -77,13 +77,31 @@ class NGramDrafter:
         return []
 
 
+def _pow2_bucket(n, cap=None):
+    """Smallest power of two >= n (>= 1), optionally clamped to ``cap``
+    — batched draft forwards quantize their shapes to these buckets so
+    the compiled-program family stays bounded."""
+    b = 1 << max(int(n) - 1, 0).bit_length()
+    if cap is not None:
+        b = min(b, int(cap))
+    return max(b, 1)
+
+
 class DraftModelDrafter:
     """Tier-2 drafter: a small causal LM (same tokenizer as the target)
     greedily decodes ``k`` tokens as the proposal. The draft forward
     runs on the trailing ``window`` tokens of the history — a drafter
     needs recency, not the full context, and the window bounds its
     cost. Proposals are suggestions only: the target model's verify
-    forward decides every emitted token."""
+    forward decides every emitted token.
+
+    ``propose_batch`` drafts for EVERY live sequence in one padded
+    forward per draft step instead of one forward per sequence per step
+    — rows are right-padded to a power-of-two width (causal attention
+    makes the pad positions invisible to each row's own logits, so the
+    proposals are bit-identical to per-sequence :meth:`propose`).
+    ``self.forwards`` counts draft-model forwards for both paths (the
+    engine's ``spec_draft_forwards_per_tick`` metric)."""
 
     def __init__(self, model, window=64):
         if model is None:
@@ -92,6 +110,7 @@ class DraftModelDrafter:
                              "engine's draft_model= kwarg)")
         self.model = model
         self.window = max(int(window), 1)
+        self.forwards = 0
 
     def propose(self, history, k):
         import jax.numpy as jnp
@@ -110,6 +129,7 @@ class DraftModelDrafter:
             with no_grad():
                 for _ in range(k):
                     logits = self.model.forward(Tensor(ids[None]))
+                    self.forwards += 1
                     nxt = int(np.asarray(
                         jnp.argmax(logits._data[0, -1])))
                     out.append(nxt)
@@ -118,6 +138,56 @@ class DraftModelDrafter:
             if was_training:
                 self.model.train()
         return out
+
+    def propose_batch(self, histories, ks):
+        """Draft up to ``ks[i]`` tokens for every ``histories[i]`` with
+        ONE padded forward per draft step (not one per sequence): rows
+        still drafting at a step are right-padded to a power-of-two
+        (rows, width) bucket and each row's next token reads from its
+        own last valid position. Greedy proposals are bit-identical to
+        calling :meth:`propose` per sequence, and a row's proposal list
+        is prefix-stable in ``k`` — callers may over-ask and trim."""
+        import jax.numpy as jnp
+        from ..framework.core import Tensor
+        from ..autograd.tape import no_grad
+
+        ks = [int(k) for k in ks]
+        rows = [np.asarray(h).reshape(-1)[-self.window:].astype(np.int64)
+                for h in histories]
+        outs = [[] for _ in rows]
+        todo = [i for i, (r, k) in enumerate(zip(rows, ks))
+                if k > 0 and r.size > 0]
+        if not todo:
+            return outs
+        kmax = max(ks[i] for i in todo)
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            with no_grad():
+                for step in range(kmax):
+                    act = [i for i in todo if ks[i] > step]
+                    if not act:
+                        break
+                    lens = [rows[i].shape[0] for i in act]
+                    width = _pow2_bucket(max(lens), cap=self.window)
+                    batch = np.zeros((_pow2_bucket(len(act)), width),
+                                     np.int64)
+                    for r, i in enumerate(act):
+                        batch[r, :lens[r]] = rows[i]
+                    logits = self.model.forward(Tensor(batch))
+                    self.forwards += 1
+                    last = np.asarray(jnp.argmax(
+                        logits._data[np.arange(len(act)),
+                                     np.asarray(lens) - 1], axis=-1))
+                    for r, i in enumerate(act):
+                        nxt = int(last[r])
+                        outs[i].append(nxt)
+                        rows[i] = np.concatenate(
+                            [rows[i], [nxt]])[-self.window:]
+        finally:
+            if was_training:
+                self.model.train()
+        return outs
 
 
 def make_drafter(kind=None, draft_model=None, max_ngram=None, window=64):

@@ -14,6 +14,12 @@ import math
 class Linear(Layer):
     """weight shape [in_features, out_features] (paddle convention)."""
 
+    #: set by ``quantization.quantize_linears()``: when present, eval
+    #: forwards stream the int8 weight through the Pallas GEMM instead
+    #: of the float master (which holds the dequantized values)
+    _w_int8 = None
+    _w_scale = None
+
     def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None,
                  name=None):
         super().__init__()
@@ -30,6 +36,9 @@ class Linear(Layer):
                 is_bias=True)
 
     def forward(self, x):
+        if self._w_int8 is not None and not self.training:
+            from ...quantization import int8_linear
+            return int8_linear(x, self._w_int8, self._w_scale, self.bias)
         return F.linear(x, self.weight, self.bias)
 
     def extra_repr(self):

@@ -15,6 +15,7 @@ persists across k steps, output written at the last k step.
 from __future__ import annotations
 
 import functools
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +30,36 @@ _CompilerParams = getattr(pltpu, "CompilerParams",
 
 def _cdiv(a, b):
     return (a + b - 1) // b
+
+
+#: (id(w), id(scale)) -> (weakref(w), weakref(scale), dequant array).
+#: The guarded-off fallback below used to dequantize the FULL weight on
+#: every call — per decode step, per layer — which regressed eager
+#: serving whenever the canary said no. Weights are long-lived (a model
+#:  holds them for the process lifetime), so one dequant per weight
+#: identity amortizes to zero; the weakrefs guard against id() reuse
+#: after garbage collection.
+_DEQUANT_CACHE: dict = {}
+_DEQUANT_CACHE_MAX = 64
+
+
+def _dequant_weight(w_int8, scale):
+    key = (id(w_int8), id(scale))
+    hit = _DEQUANT_CACHE.get(key)
+    if hit is not None:
+        w_ref, s_ref, dq = hit
+        if w_ref() is w_int8 and s_ref() is scale:
+            return dq
+        del _DEQUANT_CACHE[key]
+    dq = w_int8.astype(jnp.float32) * scale[None, :]
+    try:
+        entry = (weakref.ref(w_int8), weakref.ref(scale), dq)
+    except TypeError:                       # non-weakrefable operands
+        entry = ((lambda o=w_int8: o), (lambda o=scale: o), dq)
+    if len(_DEQUANT_CACHE) >= _DEQUANT_CACHE_MAX:
+        _DEQUANT_CACHE.clear()
+    _DEQUANT_CACHE[key] = entry
+    return dq
 
 
 def _kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, k_steps):
@@ -58,8 +89,9 @@ def int8_matmul(x, w_int8, scale, block_m=128, block_n=128, block_k=128,
     if not interpret and jax.default_backend() == "tpu":
         from ...utils.guarded_compile import kernel_allowed
         if not kernel_allowed("quant_matmul", "int8 matmul kernel"):
-            # XLA fallback: dequantize + plain matmul (safe, more HBM)
-            w = w_int8.astype(jnp.float32) * scale[None, :]
+            # XLA fallback: dequantize + plain matmul (safe, more HBM);
+            # dequant cached per weight identity — see _dequant_weight
+            w = _dequant_weight(w_int8, scale)
             return (x.astype(jnp.float32) @ w).astype(out_dtype or x.dtype)
     m, kdim = x.shape
     _, n = w_int8.shape

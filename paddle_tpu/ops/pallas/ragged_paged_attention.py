@@ -24,15 +24,34 @@ by the caller — identical to the decode kernel's inactive-slot story.
 
 Three tiers, mirroring ``ops/pallas/paged_attention.py``:
 
-* on real TPU the in-repo kernel is the default once its canary has
+* on real TPU an in-repo kernel is the default once its canary has
   been proven in a disposable subprocess (``utils.guarded_compile``);
 * ``PADDLE_TPU_RAGGED_IMPL=xla`` (or an unproven kernel) delegates to a
   plain-XLA gather+softmax fallback — zero Mosaic, wedge-free;
-* CPU tests / ``interpret=True`` run the in-repo kernel in interpret
-  mode: grid ``(tokens, kv_head, pages)``, block-table-steered dynamic
-  BlockSpec index maps (scalar prefetch in SMEM), online-softmax
-  scratch accumulation — the decode kernel's streaming recurrence with
-  per-TOKEN (not per-row) context bounds and table rows.
+* CPU tests / ``interpret=True`` run the in-repo kernels in interpret
+  mode: block-table-steered dynamic BlockSpec index maps (scalar
+  prefetch in SMEM), online-softmax scratch accumulation — the decode
+  kernel's streaming recurrence with per-TOKEN (not per-row) context
+  bounds and table rows.
+
+Two in-repo grids. The default **q-block** grid ``(q_blocks, kv_head,
+jobs)`` tiles the flat batch into fixed ``PADDLE_TPU_RAGGED_QBLOCK``-row
+blocks over the cumulative span offsets and walks a host-built job list
+(one (page, owner-slot, kv-offset) per KV page any sequence in the
+block needs) — one grid step covers a whole block of tokens against one
+page, so a mixed tick runs far fewer, fatter MXU steps. A block may
+straddle span boundaries: rows past a span's causal bound mask with
+-inf exactly like the per-token kernel, and cross-span keys are steered
+out with a finite ``BIG_NEG`` so alien jobs are bitwise no-ops (see
+``BIG_NEG``). The historical **per-token** grid ``(tokens, kv_head,
+pages)`` remains as the escape hatch (``PADDLE_TPU_RAGGED_IMPL=token``)
+and is used automatically under jit tracing, where the q-block
+schedule's host-side job build cannot run. The two grids run the SAME
+online-softmax recurrence in the same per-row page order — the masking
+is an exact no-op on alien jobs, so outputs agree to ~1 ulp (the only
+reorder is the dot shape itself: ``[q_block*group, d]`` vs
+``[group, d]`` MXU tiles accumulate in different orders) and greedy
+token streams through the serving engine are bit-identical.
 
 Unused block-table entries MUST be 0 (a valid page): their scores are
 masked by the per-token context bound but the DMA address must be in
@@ -49,6 +68,30 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .paged_attention import _CompilerParams, NEG_INF
+
+#: finite cross-span mask for the q-block kernel. The causal bound keeps
+#: NEG_INF (= -inf, matching the per-token kernel bit for bit on a row's
+#: own pages); keys belonging to ANOTHER sequence's job must stay finite:
+#: a row whose first visited job is alien would otherwise accumulate
+#: m = -inf and hit exp(-inf - -inf) = NaN, which no later correction
+#: can wash out. With -1e30, the first own-slot job's rescale factor
+#: exp(-1e30 - m_real) underflows to exactly 0.0, erasing the alien
+#: garbage bitwise; alien jobs after it are exact no-ops (weights
+#: exp(-1e30 - m_real) = 0.0, correction exp(0) = 1.0).
+BIG_NEG = -1e30
+
+#: default q-block rows (tokens per grid step); PADDLE_TPU_RAGGED_QBLOCK
+DEFAULT_QBLOCK = 8
+
+
+def _qblock_rows():
+    import os
+    try:
+        qb = int(os.environ.get("PADDLE_TPU_RAGGED_QBLOCK",
+                                str(DEFAULT_QBLOCK)))
+    except ValueError:
+        qb = DEFAULT_QBLOCK
+    return max(qb, 1)
 
 
 def _token_descriptors(num_tokens, seq_slots, q_starts, q_lens,
@@ -74,6 +117,273 @@ def _token_descriptors(num_tokens, seq_slots, q_starts, q_lens,
     tok_ctx = jnp.where(
         valid, context_lens[seq_of] - q_lens[seq_of] + off + 1, 1)
     return tok_slot, tok_ctx
+
+
+def qblock_schedule(num_tokens, seq_slots, q_starts, q_lens, context_lens,
+                    block_tables, q_block, page_size):
+    """Host-side (numpy, concrete-value) schedule for the q-block grid.
+
+    Tiles the flat packed batch into fixed ``q_block``-row blocks over
+    the cumulative span offsets and enumerates, per block, the "jobs"
+    its grid steps execute: one (physical page, owner slot, kv offset)
+    triple per KV page any sequence appearing in the block still needs.
+    Pages of one slot are listed ascending, slots in first-appearance
+    order, so each row sees its own pages in exactly the per-token
+    kernel's order. The job count is padded to a power of two so the
+    compiled-program family stays bounded (grid = (blocks, kv_heads, J)
+    with J from a small bucket set, vs (tokens, kv_heads, pages)).
+
+    Sentinels: rows past ``num_tokens`` (block padding) get slot -1 /
+    ctx 0; padding jobs get slot -2 / page 0. They can never match each
+    other, so every row's score matrix keeps at least one finite entry
+    (BIG_NEG) and the online softmax never sees an all--inf row.
+
+    Returns ``(row_slot [B*q_block], row_ctx [B*q_block],
+    job_page [B, J], job_slot [B, J], job_kv [B, J])`` int32 numpy.
+    """
+    import numpy as np
+
+    ss = np.asarray(seq_slots, np.int32).reshape(-1)
+    qs = np.asarray(q_starts, np.int32).reshape(-1)
+    ql = np.asarray(q_lens, np.int32).reshape(-1)
+    cl = np.asarray(context_lens, np.int32).reshape(-1)
+    tbl = np.asarray(block_tables, np.int32)
+    pages_per_seq = tbl.shape[1]
+    T = int(num_tokens)
+    q_block = max(int(q_block), 1)
+
+    tok = np.arange(T, dtype=np.int32)
+    nseq = qs.shape[0]
+    seq_of = np.clip(
+        np.searchsorted(qs, tok, side="right").astype(np.int32) - 1,
+        0, max(nseq - 1, 0))
+    off = tok - qs[seq_of]
+    valid = (off >= 0) & (off < ql[seq_of])
+    ts = np.where(valid, ss[seq_of], 0).astype(np.int32)
+    tc = np.where(valid, cl[seq_of] - ql[seq_of] + off + 1, 1).astype(
+        np.int32)
+
+    nblocks = -(-T // q_block)
+    t_pad = nblocks * q_block
+    row_slot = np.full(t_pad, -1, np.int32)
+    row_ctx = np.zeros(t_pad, np.int32)
+    row_slot[:T] = ts
+    row_ctx[:T] = tc
+    bs = row_slot.reshape(nblocks, q_block)
+    bc = row_ctx.reshape(nblocks, q_block)
+
+    jobs = []
+    max_jobs = 1
+    for b in range(nblocks):
+        block_jobs = []
+        seen = []
+        for r in range(q_block):
+            slot = int(bs[b, r])
+            if slot < 0 or slot in seen:
+                continue
+            seen.append(slot)
+            cmax = int(bc[b][bs[b] == slot].max())
+            n_pages = min(max(-(-cmax // page_size), 1), pages_per_seq)
+            for p in range(n_pages):
+                block_jobs.append((int(tbl[slot, p]), slot, p * page_size))
+        if not block_jobs:
+            block_jobs.append((0, -2, 0))
+        jobs.append(block_jobs)
+        max_jobs = max(max_jobs, len(block_jobs))
+
+    num_jobs = 1 << (max_jobs - 1).bit_length()
+    job_page = np.zeros((nblocks, num_jobs), np.int32)
+    job_slot = np.full((nblocks, num_jobs), -2, np.int32)
+    job_kv = np.zeros((nblocks, num_jobs), np.int32)
+    for b, block_jobs in enumerate(jobs):
+        for j, (page, slot, kv) in enumerate(block_jobs):
+            job_page[b, j] = page
+            job_slot[b, j] = slot
+            job_kv[b, j] = kv
+    return row_slot, row_ctx, job_page, job_slot, job_kv
+
+
+def _qblock_masked_scores(s, kv_start, jslot, row_slot, row_ctx):
+    """Causal bound with NEG_INF (bitwise the per-token kernel's mask on
+    a row's own pages), then the whole row to finite BIG_NEG wherever
+    the row's sequence does not own this job's page."""
+    pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(pos < row_ctx, s, NEG_INF)
+    return jnp.where(row_slot == jslot, s, BIG_NEG)
+
+
+def _qblock_kernel(jp_ref, js_ref, jk_ref, rs_ref, rc_ref, q_ref, k_ref,
+                   v_ref, o_ref, m_ref, l_ref, acc_ref, *, sm_scale,
+                   num_jobs):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    jslot = js_ref[b, j]
+    jkv = jk_ref[b, j]
+    row_slot = rs_ref[0][:, :1]                    # [Qg, 1]
+    row_ctx = rc_ref[0][:, :1]
+    q = q_ref[0, 0].astype(jnp.float32)            # [Qg, d]
+    k = k_ref[0, 0].astype(jnp.float32)            # [page_size, d]
+    v = v_ref[0, 0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    s = _qblock_masked_scores(s, jkv, jslot, row_slot, row_ctx)
+
+    m_prev = m_ref[...][:, :1]                     # [Qg, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    w = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_ref[...][:, :1] * corr + jnp.sum(w, -1, keepdims=True)
+    pv = jax.lax.dot_general(                      # [Qg, d]
+        w, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * corr + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_jobs - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _qblock_kernel_quant(jp_ref, js_ref, jk_ref, rs_ref, rc_ref, q_ref,
+                         k_ref, v_ref, ks_ref, vs_ref, o_ref, m_ref,
+                         l_ref, acc_ref, *, sm_scale, num_jobs):
+    """int8-KV q-block variant: same job walk, pages dequantized from
+    int8 rows + per-row fp32 scales right before the MXU dots."""
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    jslot = js_ref[b, j]
+    jkv = jk_ref[b, j]
+    row_slot = rs_ref[0][:, :1]
+    row_ctx = rc_ref[0][:, :1]
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0][:, None]
+    v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0][:, None]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    s = _qblock_masked_scores(s, jkv, jslot, row_slot, row_ctx)
+
+    m_prev = m_ref[...][:, :1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    w = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_ref[...][:, :1] * corr + jnp.sum(w, -1, keepdims=True)
+    pv = jax.lax.dot_general(
+        w, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[...] = acc_ref[...] * corr + pv
+    m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_jobs - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...][:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _ragged_paged_attention_pallas_qblock(q, k_pages, v_pages,
+                                          block_tables, seq_slots,
+                                          q_starts, q_lens, context_lens,
+                                          *, sm_scale, interpret,
+                                          k_scales=None, v_scales=None,
+                                          q_block=None):
+    """Q-block tier: grid ``(q_blocks, kv_heads, jobs)`` over the flat
+    packed batch — one grid step covers ``q_block`` tokens against one
+    KV page, so a mixed prefill+decode tick runs far fewer (and fatter)
+    MXU steps than the per-token grid. Requires concrete descriptors
+    (the job schedule is built host-side)."""
+    import numpy as np
+
+    tokens, heads, d = q.shape
+    kv_heads, _, page_size, _ = k_pages.shape
+    group = heads // kv_heads
+    qb = q_block or _qblock_rows()
+    row_slot, row_ctx, job_page, job_slot, job_kv = qblock_schedule(
+        tokens, seq_slots, q_starts, q_lens, context_lens, block_tables,
+        qb, page_size)
+    nblocks, num_jobs = job_page.shape
+    t_pad = nblocks * qb
+    qg_rows = qb * group
+
+    qp = jnp.pad(q, ((0, t_pad - tokens), (0, 0), (0, 0)))
+    qg = qp.reshape(nblocks, qb, kv_heads, group, d).transpose(
+        0, 2, 1, 3, 4).reshape(nblocks, kv_heads, qg_rows, d)
+    # per-ROW metadata rides as [B, Qg, 128] VMEM lanes so the kernel
+    # can slice [:, :1] — the same layout trick the softmax scratch uses
+    rows = np.repeat(row_slot.reshape(nblocks, qb), group, axis=1)
+    rowc = np.repeat(row_ctx.reshape(nblocks, qb), group, axis=1)
+    rs = jnp.asarray(np.broadcast_to(rows[:, :, None],
+                                     (nblocks, qg_rows, 128)))
+    rc = jnp.asarray(np.broadcast_to(rowc[:, :, None],
+                                     (nblocks, qg_rows, 128)))
+
+    quant = k_scales is not None
+    kernel = functools.partial(
+        _qblock_kernel_quant if quant else _qblock_kernel,
+        sm_scale=sm_scale, num_jobs=num_jobs)
+    page_spec = pl.BlockSpec((1, 1, page_size, d),
+                             lambda b, h, j, jp, js, jk:
+                             (h, jp[b, j], 0, 0))
+    scale_spec = pl.BlockSpec((1, 1, page_size),
+                              lambda b, h, j, jp, js, jk:
+                              (h, jp[b, j], 0))
+    row_spec = pl.BlockSpec((1, qg_rows, 128),
+                            lambda b, h, j, jp, js, jk: (b, 0, 0))
+    in_specs = [
+        row_spec, row_spec,
+        pl.BlockSpec((1, 1, qg_rows, d),
+                     lambda b, h, j, jp, js, jk: (b, h, 0, 0)),
+        page_spec, page_spec,
+    ]
+    operands = [rs, rc, qg, k_pages, v_pages]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        operands += [jnp.asarray(k_scales, jnp.float32),
+                     jnp.asarray(v_scales, jnp.float32)]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(nblocks, kv_heads, num_jobs),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, qg_rows, d),
+                               lambda b, h, j, jp, js, jk: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((qg_rows, 128), jnp.float32),
+            pltpu.VMEM((qg_rows, 128), jnp.float32),
+            pltpu.VMEM((qg_rows, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nblocks, kv_heads, qg_rows, d),
+                                       q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(jnp.asarray(job_page), jnp.asarray(job_slot), jnp.asarray(job_kv),
+      *operands)
+    out = out.reshape(nblocks, kv_heads, qb, group, d).transpose(
+        0, 2, 1, 3, 4).reshape(t_pad, heads, d)
+    return out[:tokens]
 
 
 def _ragged_kernel(slots_ref, ctx_ref, tables_ref, q_ref, k_ref, v_ref,
@@ -257,6 +567,20 @@ def _ragged_paged_attention_pallas(q, k_pages, v_pages, block_tables,
     return out.reshape(tokens, heads, d)
 
 
+def _ragged_impl():
+    import os
+    return os.environ.get("PADDLE_TPU_RAGGED_IMPL", "auto").lower()
+
+
+def _qblock_eligible(impl, *values):
+    """The q-block schedule is built host-side, so it needs concrete
+    descriptor/block-table values — under jit tracing the per-token grid
+    (whose index maps trace fine) is the escape hatch."""
+    if impl in ("token", "pertoken", "xla"):
+        return False
+    return not any(isinstance(v, jax.core.Tracer) for v in values)
+
+
 def _ragged_paged_attention_xla(q, k_pages, v_pages, block_tables,
                                 tok_slot, tok_ctx, *, sm_scale,
                                 k_scales=None, v_scales=None):
@@ -309,25 +633,49 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, seq_slots,
     tokens, heads, d = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    tok_slot, tok_ctx = _token_descriptors(tokens, seq_slots, q_starts,
-                                           q_lens, context_lens)
+    impl = _ragged_impl()
+    qblock_ok = _qblock_eligible(impl, seq_slots, q_starts, q_lens,
+                                 context_lens, block_tables)
     if k_scales is not None:
-        # int8 KV pages: same wedge-proof ladder, own canary — the quant
-        # kernel's Mosaic lowering (int8 loads + row-scale multiplies)
-        # is distinct from the native kernel's proven one.
+        # int8 KV pages: same wedge-proof ladder, own canaries — the
+        # quant kernels' Mosaic lowerings (int8 loads + row-scale
+        # multiplies) are distinct from the native kernels' proven ones.
         if not interpret and jax.default_backend() == "tpu":
-            import os
-            impl = os.environ.get("PADDLE_TPU_RAGGED_IMPL", "auto").lower()
             if impl != "xla":
                 from ...utils.guarded_compile import kernel_allowed
+                if qblock_ok and (impl == "inrepo" or kernel_allowed(
+                        "ragged_paged_attention_qblock_int8",
+                        "int8-KV q-block ragged attention kernel",
+                        fallback="the per-token ragged kernel")):
+                    return _ragged_paged_attention_pallas_qblock(
+                        q, k_pages, v_pages, block_tables, seq_slots,
+                        q_starts, q_lens, context_lens,
+                        sm_scale=sm_scale, interpret=False,
+                        k_scales=k_scales, v_scales=v_scales)
                 if impl == "inrepo" or kernel_allowed(
                         "ragged_paged_attention_int8",
                         "int8-KV ragged paged attention kernel",
                         fallback="the XLA dequant-gather tier"):
+                    tok_slot, tok_ctx = _token_descriptors(
+                        tokens, seq_slots, q_starts, q_lens, context_lens)
                     return _ragged_paged_attention_pallas_quant(
                         q, k_pages, v_pages, k_scales, v_scales,
                         block_tables, tok_slot, tok_ctx,
                         sm_scale=sm_scale, interpret=False)
+            tok_slot, tok_ctx = _token_descriptors(
+                tokens, seq_slots, q_starts, q_lens, context_lens)
+            return _ragged_paged_attention_xla(
+                q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
+                sm_scale=sm_scale, k_scales=k_scales, v_scales=v_scales)
+        if qblock_ok:
+            return _ragged_paged_attention_pallas_qblock(
+                q, k_pages, v_pages, block_tables, seq_slots, q_starts,
+                q_lens, context_lens, sm_scale=sm_scale,
+                interpret=interpret, k_scales=k_scales, v_scales=v_scales)
+        tok_slot, tok_ctx = _token_descriptors(tokens, seq_slots,
+                                               q_starts, q_lens,
+                                               context_lens)
+        if impl == "xla":
             return _ragged_paged_attention_xla(
                 q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
                 sm_scale=sm_scale, k_scales=k_scales, v_scales=v_scales)
@@ -336,18 +684,41 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables, seq_slots,
             tok_slot, tok_ctx, sm_scale=sm_scale, interpret=interpret)
     if not interpret and jax.default_backend() == "tpu":
         # Impl choice on real TPU: same wedge-proof ladder as
-        # paged_attention — the in-repo kernel only after its canary is
-        # proven in a disposable subprocess; otherwise zero-Mosaic XLA.
-        import os
-        impl = os.environ.get("PADDLE_TPU_RAGGED_IMPL", "auto").lower()
+        # paged_attention — an in-repo kernel only after its canary is
+        # proven in a disposable subprocess; the q-block grid first
+        # (fewer, fatter steps), the per-token grid as escape hatch
+        # (PADDLE_TPU_RAGGED_IMPL=token), zero-Mosaic XLA at the bottom.
         if impl != "xla":
             from ...utils.guarded_compile import kernel_allowed
+            if qblock_ok and (impl == "inrepo" or kernel_allowed(
+                    "ragged_paged_attention_qblock",
+                    "q-block ragged paged attention kernel",
+                    fallback="the per-token ragged kernel")):
+                return _ragged_paged_attention_pallas_qblock(
+                    q, k_pages, v_pages, block_tables, seq_slots,
+                    q_starts, q_lens, context_lens, sm_scale=sm_scale,
+                    interpret=False)
             if impl == "inrepo" or kernel_allowed(
                     "ragged_paged_attention", "ragged paged attention kernel",
                     fallback="the XLA gather-attention tier"):
+                tok_slot, tok_ctx = _token_descriptors(
+                    tokens, seq_slots, q_starts, q_lens, context_lens)
                 return _ragged_paged_attention_pallas(
                     q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
                     sm_scale=sm_scale, interpret=False)
+        tok_slot, tok_ctx = _token_descriptors(tokens, seq_slots,
+                                               q_starts, q_lens,
+                                               context_lens)
+        return _ragged_paged_attention_xla(
+            q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
+            sm_scale=sm_scale)
+    if qblock_ok:
+        return _ragged_paged_attention_pallas_qblock(
+            q, k_pages, v_pages, block_tables, seq_slots, q_starts,
+            q_lens, context_lens, sm_scale=sm_scale, interpret=interpret)
+    tok_slot, tok_ctx = _token_descriptors(tokens, seq_slots, q_starts,
+                                           q_lens, context_lens)
+    if impl == "xla":
         return _ragged_paged_attention_xla(
             q, k_pages, v_pages, block_tables, tok_slot, tok_ctx,
             sm_scale=sm_scale)

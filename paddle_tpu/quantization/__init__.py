@@ -19,7 +19,8 @@ from ..autograd.tape import apply
 from ..nn.layer import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMaxObserver",
-           "AbsmaxObserver", "quanted_layers", "QuantedLinear", "calibrate"]
+           "AbsmaxObserver", "quanted_layers", "QuantedLinear", "calibrate",
+           "quantize_linears", "int8_linear"]
 
 
 # ---------------------------------------------------------------------------
@@ -283,6 +284,65 @@ def convert(model):
         else:
             convert(sub)
     return model
+
+
+def int8_linear(x, w_int8, w_scale, bias=None):
+    """Weight-only int8 linear for layers carrying quantized weights:
+    flatten leading dims, run the Pallas int8 GEMM (int8 weight stream
+    in HBM, per-output-channel dequant in VMEM), restore the shape, add
+    bias. Inference-only — no VJP on the int8 kernel, so the op stays
+    off the tape even when a caller forgot ``no_grad()``."""
+    from ..ops.pallas.quant_matmul import int8_matmul
+    from ..autograd.tape import no_grad
+
+    def fn(a, w_q, s, *b):
+        shape = a.shape
+        out = int8_matmul(a.reshape(-1, shape[-1]), w_q, s)
+        out = out.reshape(*shape[:-1], out.shape[-1])
+        return out + b[0] if b else out
+
+    args = (x,
+            w_int8 if isinstance(w_int8, Tensor) else Tensor(w_int8),
+            w_scale if isinstance(w_scale, Tensor) else Tensor(w_scale))
+    if bias is not None:
+        args = args + (bias,)
+    with no_grad():
+        return apply(fn, *args, op_name="int8_linear")
+
+
+def quantize_linears(model):
+    """End-to-end int8 weight entry point (``PADDLE_WEIGHT_DTYPE=int8``
+    routes the serving engine here): swap every ``nn.Linear``'s weight
+    for ``(int8, per-output-channel scale)`` via ``quantize_weight`` so
+    its forward runs through the Pallas int8 GEMM. The float master
+    weight is replaced by the dequantized int8 values (``convert()``'s
+    idiom), so any path still reading ``layer.weight`` — the XLA
+    fallback, ``paddle.flops`` — sees numerics consistent with the
+    kernel. Composes with int8 KV pages (``kv_dtype="int8"``) for a
+    fully-quantized serving config. Returns the number of Linear layers
+    quantized."""
+    from ..nn.layers.common import Linear
+    from ..ops.pallas.quant_matmul import quantize_weight
+
+    count = 0
+
+    def visit(layer):
+        nonlocal count
+        if isinstance(layer, Linear) and getattr(layer, "_w_int8",
+                                                 None) is None:
+            w = layer.weight
+            q, scale = quantize_weight(w._data)
+            layer._w_int8 = np.asarray(q)
+            layer._w_scale = np.asarray(scale, np.float32)
+            w._data = (jnp.asarray(q, jnp.float32)
+                       * scale[None, :]).astype(w._data.dtype)
+            count += 1
+        for sub in layer._sub_layers.values():
+            if sub is not None:
+                visit(sub)
+
+    visit(model)
+    return count
 
 
 def calibrate(model, data, steps=None):

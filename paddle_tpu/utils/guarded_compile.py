@@ -211,6 +211,66 @@ for s, qs, ql in ((0, 0, 1), (1, 1, 5), (2, 6, 9)):
     assert err < 5e-2, ("numeric mismatch", s, err)
 print("PROOF_OK")
 """,
+    "ragged_paged_attention_qblock": _REQUIRE_TPU + """
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.ragged_paged_attention import (
+    _ragged_paged_attention_pallas_qblock, ragged_paged_attention_reference)
+rs = np.random.RandomState(0)
+kv_heads, group, d, page, npages, pps = 2, 4, 128, 16, 12, 4
+kp = jnp.asarray(rs.randn(kv_heads, npages, page, d), jnp.bfloat16)
+vp = jnp.asarray(rs.randn(kv_heads, npages, page, d), jnp.bfloat16)
+tbl = jnp.asarray(rs.randint(0, npages, (3, pps)), jnp.int32)
+# mixed spans chosen so q-blocks straddle span boundaries (q_block=8:
+# block 0 holds the decode token + 7 prefill rows, block 1 the prefill
+# tail + the fresh prefill head) and the last block is half padding
+slots = jnp.asarray([0, 1, 2], jnp.int32)
+q_starts = jnp.asarray([0, 1, 10], jnp.int32)
+q_lens = jnp.asarray([1, 9, 6], jnp.int32)
+ctx = jnp.asarray([33, 25, 6], jnp.int32)
+q = jnp.asarray(rs.randn(16, kv_heads * group, d), jnp.bfloat16)
+out = _ragged_paged_attention_pallas_qblock(
+    q, kp, vp, tbl, slots, q_starts, q_lens, ctx,
+    sm_scale=d ** -0.5, interpret=False, q_block=8)
+ref = ragged_paged_attention_reference(q, kp, vp, tbl, slots, q_starts,
+                                       q_lens, ctx)
+for s, qs, ql in ((0, 0, 1), (1, 1, 9), (2, 10, 6)):
+    err = float(jnp.max(jnp.abs(
+        out[qs:qs + ql].astype(jnp.float32)
+        - ref[qs:qs + ql].astype(jnp.float32))))
+    assert err < 5e-2, ("numeric mismatch", s, err)
+print("PROOF_OK")
+""",
+    "ragged_paged_attention_qblock_int8": _REQUIRE_TPU + """
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.ops.pallas.ragged_paged_attention import (
+    _ragged_paged_attention_pallas_qblock, ragged_paged_attention_reference)
+from paddle_tpu.models.generation import quantize_kv_rows, \
+    dequantize_kv_rows
+rs = np.random.RandomState(0)
+kv_heads, group, d, page, npages, pps = 2, 4, 128, 16, 12, 4
+kq, ks = quantize_kv_rows(rs.randn(kv_heads, npages, page, d))
+vq, vs = quantize_kv_rows(rs.randn(kv_heads, npages, page, d))
+tbl = jnp.asarray(rs.randint(0, npages, (3, pps)), jnp.int32)
+# mixed spans incl. a q_len=5 speculative verify span straddling blocks
+slots = jnp.asarray([0, 1, 2], jnp.int32)
+q_starts = jnp.asarray([0, 1, 6], jnp.int32)
+q_lens = jnp.asarray([1, 5, 9], jnp.int32)
+ctx = jnp.asarray([33, 25, 9], jnp.int32)
+q = jnp.asarray(rs.randn(16, kv_heads * group, d), jnp.float32)
+out = _ragged_paged_attention_pallas_qblock(
+    q, kq, vq, tbl, slots, q_starts, q_lens, ctx,
+    sm_scale=d ** -0.5, interpret=False, k_scales=ks, v_scales=vs,
+    q_block=8)
+ref = ragged_paged_attention_reference(
+    q, dequantize_kv_rows(kq, ks), dequantize_kv_rows(vq, vs), tbl,
+    slots, q_starts, q_lens, ctx)
+for s, qs, ql in ((0, 0, 1), (1, 1, 5), (2, 6, 9)):
+    err = float(jnp.max(jnp.abs(
+        out[qs:qs + ql].astype(jnp.float32)
+        - ref[qs:qs + ql].astype(jnp.float32))))
+    assert err < 5e-2, ("numeric mismatch", s, err)
+print("PROOF_OK")
+""",
     "quant_matmul": _REQUIRE_TPU + """
 import numpy as np, jax, jax.numpy as jnp
 from paddle_tpu.ops.pallas.quant_matmul import int8_matmul, quantize_weight
@@ -274,9 +334,14 @@ def _fa_kernel_id() -> str:
 
 def bench_kernels(mode: str):
     """Kernel ids a bench mode must prove before spawning its child."""
-    serving = [_fa_kernel_id(), "paged_attention", "ragged_paged_attention"]
+    serving = [_fa_kernel_id(), "paged_attention", "ragged_paged_attention",
+               "ragged_paged_attention_qblock"]
     if os.environ.get("BENCH_KV_DTYPE", "").lower() == "int8":
-        serving += ["paged_attention_int8", "ragged_paged_attention_int8"]
+        serving += ["paged_attention_int8", "ragged_paged_attention_int8",
+                    "ragged_paged_attention_qblock_int8"]
+    if os.environ.get("BENCH_WEIGHT_DTYPE", "").lower() == "int8" \
+            or os.environ.get("PADDLE_WEIGHT_DTYPE", "").lower() == "int8":
+        serving += ["quant_matmul"]
     return {
         "resnet": [],
         "llama": [_fa_kernel_id()],

@@ -1,0 +1,523 @@
+"""Device-tier decode speed (ISSUE 16): q-block ragged attention grid,
+int8 weights end-to-end, and batched drafting.
+
+Three layers, one bar each:
+
+* the fixed-q-block ragged kernel replays the per-token kernel's exact
+  online-softmax recurrence on every descriptor layout (straddling
+  spans, pure decode, shared-prefix page aliasing, int8-KV pages,
+  padded tail blocks): outputs agree to ~1 ulp — the only reorder is
+  the MXU dot shape itself — and greedy token streams through the
+  engine are BIT-identical between the two grids;
+* ``quantize_linears`` + ``weight_dtype="int8"`` routes Linear forwards
+  through the int8 GEMM and the fully-quantized serving config is
+  bit-stable across same-seed runs (ledger token-stream attestation);
+* ``DraftModelDrafter.propose_batch`` drafts for every live sequence in
+  one padded forward per step, bit-identical to per-sequence
+  ``propose``, inside a power-of-two compiled-program family.
+"""
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import ContinuousServingEngine
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.models.generation import quantize_kv_rows
+from paddle_tpu.ops.pallas.ragged_paged_attention import (
+    ragged_paged_attention, ragged_paged_attention_reference,
+    qblock_schedule, DEFAULT_QBLOCK, _qblock_rows, _token_descriptors,
+    _ragged_paged_attention_pallas, _ragged_paged_attention_pallas_quant,
+    _ragged_paged_attention_pallas_qblock)
+
+
+# ---------------------------------------------------------------------------
+# kernel parity: q-block grid vs per-token grid (bitwise) vs dense oracle
+# ---------------------------------------------------------------------------
+
+def _pool(nslots=4, pages_per_seq=4, page=8, kv_heads=2, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    npages = nslots * pages_per_seq + 1          # page 0 = scratch
+    kp = jnp.asarray(rng.randn(kv_heads, npages, page, d), jnp.float32)
+    vp = jnp.asarray(rng.randn(kv_heads, npages, page, d), jnp.float32)
+    tbl = np.zeros((nslots, pages_per_seq), np.int32)
+    for s in range(nslots):
+        tbl[s] = np.arange(1 + s * pages_per_seq,
+                           1 + (s + 1) * pages_per_seq)
+    return kp, vp, tbl
+
+
+#: q-block vs per-token kernel tolerance: the grids run the SAME
+#: recurrence in the same per-row page order, but the q-block MXU dot is
+#: [q_block*group, d] where the per-token dot is [group, d] — different
+#: tile shapes accumulate the d-reduction in different orders, worth ~1
+#: ulp (<1e-7 observed). A masking bug would be O(1), int8-KV error
+#: ~1e-2, so 1e-6 still proves the recurrence is the same one.
+KERNEL_TOL = dict(rtol=1e-6, atol=1e-6)
+
+
+def _parity(layout, tokens=None, q_block=8, heads=4, d=32, seed=0,
+            tbl_edit=None, quant=False):
+    """Run the SAME descriptors through the q-block and per-token
+    interpret kernels: span rows must agree to KERNEL_TOL (~1 ulp — the
+    q-block grid replays the per-token online-softmax recurrence
+    job-by-job in the same order; see KERNEL_TOL for why not bitwise)
+    and match the dense reference to float tolerance."""
+    kp, vp, tbl = _pool(nslots=max(x[0] for x in layout) + 1, d=d,
+                        seed=seed)
+    if tbl_edit is not None:
+        tbl_edit(tbl)
+    seq_slots = np.asarray([x[0] for x in layout], np.int32)
+    q_starts = np.asarray([x[1] for x in layout], np.int32)
+    q_lens = np.asarray([x[2] for x in layout], np.int32)
+    ctx = np.asarray([x[3] for x in layout], np.int32)
+    T = tokens or int((q_starts + q_lens).max())
+    rng = np.random.RandomState(seed + 1)
+    q = jnp.asarray(rng.randn(T, heads, d), jnp.float32)
+    sm = d ** -0.5
+    if quant:
+        kq, ks = quantize_kv_rows(np.asarray(kp))
+        vq, vs = quantize_kv_rows(np.asarray(vp))
+        kq, ks = jnp.asarray(kq), jnp.asarray(ks)
+        vq, vs = jnp.asarray(vq), jnp.asarray(vs)
+        qb = np.asarray(_ragged_paged_attention_pallas_qblock(
+            q, kq, vq, jnp.asarray(tbl), seq_slots, q_starts, q_lens, ctx,
+            sm_scale=sm, interpret=True, k_scales=ks, v_scales=vs,
+            q_block=q_block))
+        ts, tc = _token_descriptors(T, seq_slots, q_starts, q_lens, ctx)
+        tok = np.asarray(_ragged_paged_attention_pallas_quant(
+            q, kq, vq, ks, vs, jnp.asarray(tbl), ts, tc,
+            sm_scale=sm, interpret=True))
+        ref_tol = dict(rtol=5e-2, atol=5e-2)    # int8 quantization error
+    else:
+        qb = np.asarray(_ragged_paged_attention_pallas_qblock(
+            q, kp, vp, jnp.asarray(tbl), seq_slots, q_starts, q_lens, ctx,
+            sm_scale=sm, interpret=True, q_block=q_block))
+        ts, tc = _token_descriptors(T, seq_slots, q_starts, q_lens, ctx)
+        tok = np.asarray(_ragged_paged_attention_pallas(
+            q, kp, vp, jnp.asarray(tbl), ts, tc, sm_scale=sm,
+            interpret=True))
+        ref_tol = dict(rtol=2e-5, atol=2e-5)
+    ref = np.asarray(ragged_paged_attention_reference(
+        q, kp, vp, tbl, seq_slots, q_starts, q_lens, ctx))
+    for slot, qs, ql, _ in layout:               # pad rows are garbage
+        np.testing.assert_allclose(qb[qs:qs + ql], tok[qs:qs + ql],
+                                   **KERNEL_TOL)
+        assert np.isfinite(qb[qs:qs + ql]).all()
+        np.testing.assert_allclose(qb[qs:qs + ql], ref[qs:qs + ql],
+                                   **ref_tol)
+    return qb, tok
+
+
+def test_qblock_straddling_spans_parity():
+    # spans crossing q-block boundaries: a 9-token prefill straddles
+    # blocks 0→1, a 6-token chunk straddles 1→2 — each block mixes rows
+    # of different owners, the masking worst case
+    _parity([(0, 0, 1, 31), (1, 1, 9, 25), (2, 10, 6, 6), (3, 16, 1, 4)],
+            q_block=8)
+
+
+def test_qblock_pure_decode_parity():
+    # the continuous-batching steady state: every span is one token, so
+    # one q block carries up to q_block distinct owners
+    _parity([(0, 0, 1, 7), (1, 1, 1, 19), (2, 2, 1, 32), (3, 3, 1, 1)],
+            q_block=8)
+
+
+def test_qblock_shared_prefix_aliased_pages():
+    # slot 1's table aliases slot 0's leading pages (a prefix-cache
+    # hit): the job list must walk the aliased page once per owner
+    def alias(tbl):
+        tbl[1, :2] = tbl[0, :2]
+    _parity([(0, 0, 1, 20), (1, 1, 3, 19)], tbl_edit=alias, seed=7)
+
+
+def test_qblock_padded_tail_blocks():
+    # tokens=24 with spans ending at 10: blocks 1..2 are pure padding
+    # (row slot -1, one sentinel job) — they must stay finite and never
+    # poison the valid rows
+    _parity([(0, 0, 4, 12), (1, 4, 6, 6)], tokens=24, q_block=8)
+
+
+def test_qblock_int8_kv_parity():
+    # int8 KV pages: the q-block quant kernel dequantizes per row-scale
+    # exactly like the per-token quant kernel — same KERNEL_TOL parity
+    _parity([(0, 0, 1, 12), (1, 1, 5, 25), (2, 6, 9, 9)], quant=True)
+
+
+def test_qblock_small_block_size():
+    # q_block smaller than most spans: every span straddles
+    _parity([(0, 0, 7, 15), (1, 7, 5, 5), (2, 12, 1, 30)], q_block=2)
+
+
+def test_qblock_schedule_contract():
+    """Sentinels, ordering, and pow2 job padding of the host schedule."""
+    kp, vp, tbl = _pool(nslots=3, page=8)
+    seq_slots = np.asarray([0, 1, 2], np.int32)
+    q_starts = np.asarray([0, 1, 10], np.int32)
+    q_lens = np.asarray([1, 9, 6], np.int32)
+    ctx = np.asarray([33, 25, 6], np.int32)
+    row_slot, row_ctx, job_page, job_slot, job_kv = qblock_schedule(
+        17, seq_slots, q_starts, q_lens, ctx, tbl, 8, 8)
+    assert row_slot.shape == (24,)               # ceil(17/8)*8
+    # block-pad rows (slot -1 / ctx 0) differ from pad jobs (slot -2)
+    np.testing.assert_array_equal(row_slot[17:], -1)
+    np.testing.assert_array_equal(row_ctx[17:], 0)
+    B, J = job_page.shape
+    assert B == 3 and J & (J - 1) == 0           # pow2 job bucket
+    # pad jobs use the sentinel slot -2 and the scratch page 0
+    assert (job_page[job_slot == -2] == 0).all()
+    # every real job's page comes from its owner's block table, kv
+    # offsets ascend per owner in page order
+    for b in range(B):
+        for j in range(J):
+            s = int(job_slot[b, j])
+            if s < 0:
+                continue
+            p = int(job_kv[b, j]) // 8
+            assert job_page[b, j] == tbl[s, p]
+    # decode-only blocks stop at each owner's context, not the table end
+    _, _, jp2, js2, _ = qblock_schedule(
+        3, np.arange(3, dtype=np.int32), np.arange(3, dtype=np.int32),
+        np.ones(3, np.int32), np.asarray([7, 19, 30], np.int32), tbl, 8, 8)
+    real = int((js2[0] >= 0).sum())
+    assert real == 1 + 3 + 4                     # ceil(7/8)+ceil(19/8)+ceil(30/8)
+
+
+def test_qblock_rows_env_knob(monkeypatch):
+    """PADDLE_TPU_RAGGED_QBLOCK tunes the block size; junk values fall
+    back to DEFAULT_QBLOCK; the public entry keeps KERNEL_TOL parity
+    with the per-token grid at any block size."""
+    assert _qblock_rows() == DEFAULT_QBLOCK == 8
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_QBLOCK", "4")
+    assert _qblock_rows() == 4
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_QBLOCK", "notanint")
+    assert _qblock_rows() == DEFAULT_QBLOCK
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_QBLOCK", "5")   # odd size
+    kp, vp, tbl = _pool(nslots=3)
+    seq_slots = np.asarray([0, 1, 2], np.int32)
+    q_starts = np.asarray([0, 1, 8], np.int32)
+    q_lens = np.asarray([1, 7, 4], np.int32)
+    ctx = np.asarray([17, 22, 4], np.int32)
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(12, 4, 32), jnp.float32)
+    out = np.asarray(ragged_paged_attention(
+        q, kp, vp, jnp.asarray(tbl), seq_slots, q_starts, q_lens, ctx,
+        interpret=True))
+    ts, tc = _token_descriptors(12, seq_slots, q_starts, q_lens, ctx)
+    tok = np.asarray(_ragged_paged_attention_pallas(
+        q, kp, vp, jnp.asarray(tbl), ts, tc, sm_scale=32 ** -0.5,
+        interpret=True))
+    np.testing.assert_allclose(out, tok, **KERNEL_TOL)
+
+
+def test_ragged_impl_env_dispatch(monkeypatch):
+    """PADDLE_TPU_RAGGED_IMPL selects the grid: "qblock" (the default
+    under "auto") and "token" (per-token escape hatch) agree to
+    KERNEL_TOL through the public entry; "xla" to float tolerance."""
+    kp, vp, tbl = _pool(nslots=3)
+    seq_slots = np.asarray([0, 1, 2], np.int32)
+    q_starts = np.asarray([0, 1, 6], np.int32)
+    q_lens = np.asarray([1, 5, 9], np.int32)
+    ctx = np.asarray([19, 25, 9], np.int32)
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(15, 4, 32), jnp.float32)
+
+    def run():
+        return np.asarray(ragged_paged_attention(
+            q, kp, vp, jnp.asarray(tbl), seq_slots, q_starts, q_lens,
+            ctx, interpret=True))
+
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_IMPL", "qblock")
+    out_qb = run()
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_IMPL", "token")
+    out_tok = run()
+    monkeypatch.setenv("PADDLE_TPU_RAGGED_IMPL", "xla")
+    out_xla = run()
+    np.testing.assert_allclose(out_qb, out_tok, **KERNEL_TOL)
+    np.testing.assert_allclose(out_qb[:12], out_xla[:12],
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_qblock_traced_descriptors_fall_back():
+    """The q-block schedule needs concrete descriptor values (host-side
+    numpy); under jit tracing the public entry must quietly fall back to
+    the per-token grid and stay correct."""
+    kp, vp, tbl = _pool(nslots=2)
+    seq_slots = np.asarray([0, 1], np.int32)
+    q_starts = np.asarray([0, 4], np.int32)
+    q_lens = np.asarray([4, 3], np.int32)
+    ctx = np.asarray([12, 3], np.int32)
+    rng = np.random.RandomState(9)
+    q = jnp.asarray(rng.randn(7, 4, 32), jnp.float32)
+
+    @jax.jit
+    def f(q, ss, qs, ql, cx):
+        return ragged_paged_attention(q, kp, vp, jnp.asarray(tbl),
+                                      ss, qs, ql, cx, interpret=True)
+
+    out = np.asarray(f(q, seq_slots, q_starts, q_lens, ctx))
+    ref = np.asarray(ragged_paged_attention_reference(
+        q, kp, vp, tbl, seq_slots, q_starts, q_lens, ctx))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# engine acceptance: q-block grid == per-token grid, bit for bit
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(llama_tiny(num_hidden_layers=2,
+                                       max_position_embeddings=256))
+
+
+def _oracle(model, p, n):
+    return np.asarray(model.generate(paddle.to_tensor(p),
+                                     max_new_tokens=n)._data)
+
+
+def _drive(eng, prompts, new_tokens):
+    results = [None] * len(prompts)
+    with eng:
+        threads = [threading.Thread(
+            target=lambda i=i, p=p: results.__setitem__(
+                i, np.asarray(eng.generate(p, max_new_tokens=new_tokens,
+                                           timeout=300).numpy())))
+            for i, p in enumerate(prompts)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    return results
+
+
+def test_engine_qblock_vs_token_bit_identical(model, monkeypatch):
+    """Acceptance bar: a mixed chunked-prefill + decode workload under
+    the q-block grid produces greedy outputs bit-identical to the
+    per-token grid — and matches the dense oracle."""
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 128, (1, n)).astype(np.int64)
+               for n in (23, 5, 37, 11)]
+
+    def run(impl):
+        monkeypatch.setenv("PADDLE_TPU_RAGGED_IMPL", impl)
+        eng = ContinuousServingEngine(
+            model, max_batch_size=4, max_len=64, token_budget=16,
+            prefill_chunk_tokens=16)
+        out = _drive(eng, prompts, 5)
+        assert eng.ragged_steps > 0
+        return out
+
+    got_qb = run("qblock")
+    got_tok = run("token")
+    for a, b in zip(got_qb, got_tok):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(got_qb[0], _oracle(model, prompts[0], 5))
+
+
+# ---------------------------------------------------------------------------
+# int8 weights end-to-end
+# ---------------------------------------------------------------------------
+
+def test_quantize_linears_routes_and_bounds_error():
+    """quantize_linears snapshots every Linear's int8 weights, keeps the
+    master copy consistent (dequantized), and eval-mode forwards route
+    through int8_linear with bounded quantization error."""
+    from paddle_tpu import nn
+    from paddle_tpu.quantization import quantize_linears, int8_linear
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(32, 48), nn.ReLU(), nn.Linear(48, 16))
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.randn(4, 32).astype(np.float32))
+    net.eval()
+    ref = np.asarray(net(x)._data)               # float forward
+    lin0 = net[0]
+    w_before = np.asarray(lin0.weight._data).copy()
+    n = quantize_linears(net)
+    assert n == 2
+    assert lin0._w_int8 is not None and lin0._w_int8.dtype == np.int8
+    # per-column absmax quantization: error <= scale/2 per element
+    w_after = np.asarray(lin0.weight._data)
+    assert np.abs(w_after - w_before).max() <= lin0._w_scale.max() * 0.5 + 1e-6
+    # eval forward now routes through the int8 GEMM and equals the
+    # explicit int8_linear call bit-for-bit
+    out = np.asarray(net(x)._data)
+    manual = np.asarray(net[2].forward(
+        paddle.nn.functional.relu(int8_linear(
+            x, lin0._w_int8, lin0._w_scale, lin0.bias)))._data)
+    np.testing.assert_array_equal(out, manual)
+    # quantization moves the output by at most the int8 error budget
+    np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.1)
+    # idempotent: a second call quantizes nothing new
+    assert quantize_linears(net) == 0
+    # deterministic: repeat forward is bit-identical
+    np.testing.assert_array_equal(out, np.asarray(net(x)._data))
+
+
+def test_engine_weight_dtype_knob(monkeypatch):
+    """PADDLE_WEIGHT_DTYPE=int8 quantizes at engine construction; junk
+    values are rejected up front."""
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+    monkeypatch.setenv("PADDLE_WEIGHT_DTYPE", "int8")
+    eng = ContinuousServingEngine(m, max_batch_size=2, max_len=48)
+    assert eng.weight_dtype == "int8"
+    assert eng.quantized_linears > 0
+    monkeypatch.setenv("PADDLE_WEIGHT_DTYPE", "int4")
+    with pytest.raises(ValueError):
+        ContinuousServingEngine(m, max_batch_size=2, max_len=48)
+
+
+def test_fully_int8_serving_bit_stable_with_attestation():
+    """The fully-quantized device-tier config — int8 weights AND int8 KV
+    pages on the q-block grid — is bit-stable: two same-seed engine runs
+    deliver identical tokens, attested by identical ledger token-stream
+    digests."""
+    from paddle_tpu.profiler import ledger, request_trace as rt
+
+    def run_once():
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        eng = ContinuousServingEngine(
+            m, max_batch_size=2, max_len=48, token_budget=16,
+            prefill_chunk_tokens=16, weight_dtype="int8", kv_dtype="int8")
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 128, (1, n)).astype(np.int64)
+                   for n in (13, 21)]
+        traces = [rt.start_request(prompt_tokens=p.shape[1],
+                                   max_new_tokens=4) for p in prompts]
+        outs = [None] * len(prompts)
+        with eng:
+            threads = [threading.Thread(
+                target=lambda i=i: outs.__setitem__(
+                    i, np.asarray(eng.generate(
+                        prompts[i], max_new_tokens=4, timeout=300,
+                        trace=traces[i]).numpy())))
+                for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        digs = [ledger.stream_digest(t.trace_id, 0) for t in traces]
+        assert eng.quantized_linears > 0
+        assert eng.ragged_buckets_used <= eng.declared_token_buckets()
+        return outs, digs
+
+    ledger.enable(mode="warn")
+    try:
+        outs_a, digs_a = run_once()
+        outs_b, digs_b = run_once()
+    finally:
+        ledger.disable()
+    for a, b in zip(outs_a, outs_b):
+        np.testing.assert_array_equal(a, b)
+    assert all(d is not None for d in digs_a)
+    assert digs_a == digs_b
+
+
+# ---------------------------------------------------------------------------
+# batched drafting
+# ---------------------------------------------------------------------------
+
+def _draft_model(seed=7):
+    paddle.seed(seed)
+    return LlamaForCausalLM(llama_tiny(num_hidden_layers=1,
+                                       vocab_size=97, hidden_size=32,
+                                       intermediate_size=64))
+
+
+def test_propose_batch_bit_identical_fewer_forwards():
+    """propose_batch == per-sequence propose, bit for bit, with one
+    forward per draft STEP instead of one per sequence per step."""
+    from paddle_tpu.inference.speculative import DraftModelDrafter
+
+    m = _draft_model()
+    rng = np.random.RandomState(4)
+    hists = [rng.randint(0, 97, n).tolist() for n in (9, 3, 17, 1)]
+    ks = [3, 0, 2, 4]
+    solo = DraftModelDrafter(m, window=16)
+    want = [solo.propose(h, k) for h, k in zip(hists, ks)]
+    batch = DraftModelDrafter(m, window=16)
+    got = batch.propose_batch(hists, ks)
+    assert got == want
+    assert len(got[3]) == 4 and got[1] == []
+    assert solo.forwards == sum(ks)              # 9
+    assert batch.forwards == max(ks)             # 4: one per step
+
+
+def test_propose_batch_prefix_stable():
+    """Over-asking then trimming equals asking exactly — the engine
+    over-asks with an optimistic cap and trims to sequential room."""
+    from paddle_tpu.inference.speculative import DraftModelDrafter
+
+    m = _draft_model(seed=42)
+    rng = np.random.RandomState(8)
+    hists = [rng.randint(0, 97, n).tolist() for n in (7, 12)]
+    d = DraftModelDrafter(m, window=16)
+    long = d.propose_batch(hists, [5, 5])
+    short = d.propose_batch(hists, [2, 3])
+    assert long[0][:2] == short[0] and long[1][:3] == short[1]
+
+
+def test_propose_batch_pow2_program_family():
+    """Every draft forward runs a power-of-two (rows, width) shape with
+    width capped at the drafter window — a bounded compiled-program
+    family, not per-(batch, length) shapes."""
+    from paddle_tpu.inference.speculative import DraftModelDrafter
+
+    m = _draft_model(seed=1)
+    shapes = []
+    orig = m.forward
+    m.forward = lambda x: (shapes.append(tuple(x.shape)), orig(x))[1]
+    try:
+        d = DraftModelDrafter(m, window=16)
+        rng = np.random.RandomState(2)
+        hists = [rng.randint(0, 97, n).tolist() for n in (30, 5, 11)]
+        d.propose_batch(hists, [3, 3, 3])
+    finally:
+        m.forward = orig
+    assert shapes, "no draft forward ran"
+    for r, w in shapes:
+        assert r & (r - 1) == 0 and w & (w - 1) == 0, (r, w)
+        assert w <= 16
+
+
+def test_engine_draft_batch_bit_parity(model, monkeypatch):
+    """Speculative decode with batched drafting on vs off: identical
+    greedy outputs, fewer draft forwards, and the env knob
+    (PADDLE_SPEC_DRAFT_BATCH=0) restores the per-sequence path."""
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(0, 128, (1, n)).astype(np.int64)
+               for n in (19, 9)]
+
+    def run(batched):
+        eng = ContinuousServingEngine(
+            model, max_batch_size=2, max_len=64, token_budget=16,
+            prefill_chunk_tokens=16, spec_decode=True, spec_k=3,
+            draft_model=model, draft_batch=batched)
+        out = _drive(eng, prompts, 6)
+        assert eng.spec_drafted_tokens > 0
+        return out, eng
+
+    got_on, eng_on = run(True)
+    got_off, eng_off = run(False)
+    for a, b in zip(got_on, got_off):
+        np.testing.assert_array_equal(a, b)
+    assert eng_on.spec_draft_ticks > 0
+    # batched: at most spec_k forwards per tick regardless of rows; the
+    # per-sequence path pays forwards ~= drafted tokens
+    assert eng_on.spec_draft_forwards <= eng_off.spec_draft_forwards
+    assert eng_on.spec_draft_forwards <= eng_on.spec_draft_ticks * 3
+    monkeypatch.setenv("PADDLE_SPEC_DRAFT_BATCH", "0")
+    eng = ContinuousServingEngine(model, spec_decode=True, spec_k=3,
+                                  draft_model=model)
+    assert eng.draft_batch is False
+    monkeypatch.setenv("PADDLE_SPEC_DRAFT_BATCH", "1")
+    assert ContinuousServingEngine(model, spec_decode=True, spec_k=3,
+                                   draft_model=model).draft_batch is True

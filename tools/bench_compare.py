@@ -47,7 +47,10 @@ DIRECTION_RULES = [
     ("scrape_age", "lower"),
     ("overhead_pct", "lower"),
     ("waste_ratio", "lower"),
+    ("qblock_step_ratio", "lower"),
+    ("weight_bytes_ratio", "lower"),
     ("forwards_per_token", "lower"),
+    ("forwards_per_tick", "lower"),
     ("recover_ratio", "higher"),
     ("controller_actions", "ignore"),
     ("time_to_recover", "lower"),

@@ -389,6 +389,16 @@ INVENTORY = [
      "paddle_tpu.profiler.eventlog",
      ["EventLog", "get_event_log", "log_event", "enable", "disable",
       "is_enabled", "EVENTLOG_SCHEMA"]),
+    # -- device-tier decode speed (ISSUE 16) ---------------------------------
+    ("Q-block ragged attention (fixed-q-block grid)",
+     "paddle_tpu.ops.pallas.ragged_paged_attention",
+     ["qblock_schedule", "DEFAULT_QBLOCK", "ragged_paged_attention"]),
+    ("Int8 weight serving path (quantize + fused forward)",
+     "paddle_tpu.quantization",
+     ["quantize_linears", "int8_linear"]),
+    ("Batched drafting (one padded draft forward per tick)",
+     "paddle_tpu.inference.speculative",
+     ["DraftModelDrafter", "NGramDrafter"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -468,7 +478,12 @@ def check_serving_programs(verbose=True):
     ragged program family, and (second pass) that speculative-decode
     verify spans (q_len = 1 + k drafted tokens) stay inside the SAME
     declared family — spec decode must not explode the compiled-program
-    set. Returns a list of violation strings."""
+    set — and (third pass) that the fixed-q-block ragged grid
+    (``PADDLE_TPU_RAGGED_IMPL=qblock``, the ISSUE-16 default decode
+    path) keeps the identical bucket discipline: the q-block schedule
+    re-tiles the flat token batch but the engine still pads the token
+    dimension to declared buckets. Returns a list of violation
+    strings."""
     import threading
 
     import numpy as np
@@ -525,6 +540,29 @@ def check_serving_programs(verbose=True):
             f"(declared {sorted(spec.declared_token_buckets())})")
     if not spec.spec_drafted_tokens:
         violations.append("speculative pass drafted no tokens")
+    # q-block pass: the same mixed load with the fixed-q-block ragged
+    # grid forced — the new default decode grid must not grow the
+    # compiled-program family
+    prev_impl = os.environ.get("PADDLE_TPU_RAGGED_IMPL")
+    os.environ["PADDLE_TPU_RAGGED_IMPL"] = "qblock"
+    try:
+        qb = ContinuousServingEngine(model, max_batch_size=2, max_len=48,
+                                     token_budget=16,
+                                     prefill_chunk_tokens=16)
+        drive(qb, prompts)
+    finally:
+        if prev_impl is None:
+            os.environ.pop("PADDLE_TPU_RAGGED_IMPL", None)
+        else:
+            os.environ["PADDLE_TPU_RAGGED_IMPL"] = prev_impl
+    qb_stray = qb.ragged_buckets_used - qb.declared_token_buckets()
+    if qb_stray:
+        violations.append(
+            f"q-block serving ran shapes outside the declared bucket set: "
+            f"{sorted(qb_stray)} (declared "
+            f"{sorted(qb.declared_token_buckets())})")
+    if not qb.ragged_steps:
+        violations.append("q-block pass never reached the ragged scheduler")
     if verbose:
         for v in violations:
             print(f"FAIL {v}")
@@ -534,7 +572,119 @@ def check_serving_programs(verbose=True):
               f"decode={eng.ragged_decode_tokens} tokens; spec buckets "
               f"{sorted(spec.ragged_buckets_used)} drafted="
               f"{spec.spec_drafted_tokens} accepted="
-              f"{spec.spec_accepted_tokens}")
+              f"{spec.spec_accepted_tokens}; qblock buckets "
+              f"{sorted(qb.ragged_buckets_used)}")
+    return violations
+
+
+def check_quantized_config(verbose=True):
+    """Quantized-config inventory guard (ISSUE 16): every device-tier
+    decode-speed knob (int8 weights, q-block ragged grid, batched
+    drafting) must be documented in ``docs/*.md`` AND exercised by at
+    least one test, and the fully-quantized serving config
+    (``weight_dtype="int8"`` + ``kv_dtype="int8"`` under the default
+    q-block ragged grid) must be BIT-STABLE: two same-seed runs produce
+    byte-identical token streams (sha1 attestation) while staying
+    inside the declared bucket family. A quantized path that drifts
+    run-to-run is a silent-accuracy incident, not a speed win. Returns
+    a list of violation strings."""
+    import hashlib
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ContinuousServingEngine
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    docs_text = ""
+    docs_dir = os.path.join(root, "docs")
+    for name in sorted(os.listdir(docs_dir)):
+        if name.endswith(".md"):
+            with open(os.path.join(docs_dir, name), errors="replace") as f:
+                docs_text += f.read()
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name), errors="replace") as f:
+                tests_text += f.read()
+    violations = []
+    knobs = ["PADDLE_WEIGHT_DTYPE", "PADDLE_TPU_RAGGED_QBLOCK",
+             "PADDLE_SPEC_DRAFT_BATCH", "PADDLE_TPU_RAGGED_IMPL",
+             "PADDLE_KV_DTYPE"]
+    for k in knobs:
+        if k not in docs_text:
+            violations.append(
+                f"quantized-config knob {k} missing from docs/*.md")
+        if k not in tests_text:
+            violations.append(
+                f"quantized-config knob {k} not exercised by any test")
+    # impl selector values a user must be able to discover (the quoted
+    # form keeps prose mentions of the word "token" from matching)
+    for value in ('"qblock"', '"token"'):
+        if value.strip('"') not in docs_text:
+            violations.append(
+                f"ragged impl value {value} missing from docs/*.md")
+        if value not in tests_text:
+            violations.append(
+                f"ragged impl value {value} not exercised by any test")
+
+    def run_once():
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 128, (1, n)).astype(np.int64)
+                   for n in (13, 3, 21)]
+        eng = ContinuousServingEngine(
+            model, max_batch_size=2, max_len=48, token_budget=16,
+            prefill_chunk_tokens=16, weight_dtype="int8", kv_dtype="int8")
+        outs = [None] * len(prompts)
+
+        def gen(i, p):
+            outs[i] = np.asarray(
+                eng.generate(p, max_new_tokens=3, timeout=300).numpy())
+
+        with eng:
+            threads = [threading.Thread(target=gen, args=(i, p))
+                       for i, p in enumerate(prompts)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        h = hashlib.sha1()
+        for o in outs:
+            if o is not None:
+                h.update(np.ascontiguousarray(o).tobytes())
+        return eng, outs, h.hexdigest()
+
+    eng_a, outs_a, dig_a = run_once()
+    eng_b, outs_b, dig_b = run_once()
+    if not eng_a.quantized_linears:
+        violations.append("fully-int8 config quantized no Linear layers")
+    stray = eng_a.ragged_buckets_used - eng_a.declared_token_buckets()
+    if stray:
+        violations.append(
+            f"fully-int8 serving ran shapes outside the declared bucket "
+            f"set: {sorted(stray)} "
+            f"(declared {sorted(eng_a.declared_token_buckets())})")
+    for i, (a, b) in enumerate(zip(outs_a, outs_b)):
+        if a is None or b is None:
+            violations.append(f"fully-int8 request {i} produced no output")
+        elif a.shape != b.shape or not (a == b).all():
+            violations.append(
+                f"fully-int8 config is not bit-stable: request {i} "
+                f"diverged between two same-seed runs")
+    if dig_a != dig_b:
+        violations.append(
+            f"fully-int8 token digests differ across same-seed runs: "
+            f"{dig_a} vs {dig_b}")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"quantized config: {len(knobs)} knobs checked, "
+              f"{eng_a.quantized_linears} Linear(s) quantized, "
+              f"token digest {dig_a[:12]} stable across 2 runs")
     return violations
 
 
@@ -1013,5 +1163,6 @@ if __name__ == "__main__":
                    or check_fleet_knobs() or check_observability_catalog()
                    or check_alert_catalog() or check_training_observability()
                    or check_ledger_catalog() or check_controller_catalog()
-                   or check_telemetry_plane() or check_serving_programs())
+                   or check_telemetry_plane() or check_serving_programs()
+                   or check_quantized_config())
              else 0)
