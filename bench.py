@@ -197,6 +197,8 @@ def bench_resnet():
             p_arrs, _fwd, _grads, _opt)
     out["numerics_overhead_pct"] = _numerics_overhead_pct()
     out["ledger_overhead_pct"] = _ledger_overhead_pct()
+    out["compile_observatory_overhead_pct"] = \
+        _compile_observatory_overhead_pct()
     _emit_observatory_aux(out)
     return out
 
@@ -398,10 +400,43 @@ def _ledger_overhead_pct():
                                    setup=setup, teardown=teardown)
 
 
+def _compile_observatory_overhead_pct():
+    """Per-call cost of the compile observatory (signature build +
+    trace-cache accounting at every ``to_static`` entry) vs
+    observatory-off, on a jitted MLP forward — the to_static entry
+    builds a full per-leaf signature on every call when the observatory
+    is on, so the cached-program hot loop is the honest worst case for
+    the sensing layer. Disabled must cost one bool check."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.profiler import compile_observatory as co
+
+    net = nn.Sequential(nn.Linear(256, 256), nn.Tanh(),
+                        nn.Linear(256, 64))
+    static_net = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .normal(size=(64, 256)).astype(np.float32))
+
+    def step():
+        return static_net(x)
+
+    co.disable()                       # bare path: observatory off
+    try:
+        return _telemetry_overhead_pct(step, lambda r: None, steps=10,
+                                       instrumented_step=step,
+                                       setup=co.enable,
+                                       teardown=co.disable)
+    finally:
+        co.reset()                     # back to the env-gated default
+
+
 def _emit_observatory_aux(out):
     """stderr aux lines for the training-observatory record fields."""
     for name in ("train_peak_bytes", "numerics_overhead_pct",
-                 "ledger_overhead_pct"):
+                 "ledger_overhead_pct",
+                 "compile_observatory_overhead_pct"):
         if name in out:
             print(json.dumps({"aux_metric": name, "value": out[name]}),
                   file=sys.stderr)
@@ -638,6 +673,8 @@ def bench_llama():
             p_arrs, _fwd, _grads, _opt)
     out["numerics_overhead_pct"] = _numerics_overhead_pct()
     out["ledger_overhead_pct"] = _ledger_overhead_pct()
+    out["compile_observatory_overhead_pct"] = \
+        _compile_observatory_overhead_pct()
     _emit_observatory_aux(out)
     return out
 
@@ -1189,6 +1226,46 @@ def bench_serving():
                 3),
         }
 
+    def compile_probe():
+        """Compile-observatory steady-state probe: warm every declared
+        program bucket via ``warmup_programs()``, then replay the mixed
+        prefill+decode workload — post-warmup trace-cache misses must
+        be ZERO (``serving_recompiles_per_1k_ticks == 0`` is the
+        recompile-storm acceptance gate), and the warmup wall seconds
+        are the cold-start compile budget a fleet pays per process."""
+        from paddle_tpu.profiler import compile_observatory as co
+        co.reset()
+        co.enable()
+        mix_rng = np.random.default_rng(3)
+        lens = [sys_len // 2 + int(mix_rng.integers(1, sys_len // 2 + 8))
+                for _ in range(n_req)]
+        mix = [mix_rng.integers(0, cfg.vocab_size, n)
+               .astype(np.int64)[None] for n in lens]
+        eng = ContinuousServingEngine(
+            model, max_batch_size=4, max_len=max(lens) + new + 16,
+            enable_prefix_cache=False, prefill_chunk_tokens=chunk,
+            token_budget=chunk, enable_ragged=True)
+        warmup_s = sum(eng.warmup_programs().values())
+        base = co.snapshot()["totals"]["misses"]
+        with eng:
+            ticks0 = eng.ragged_steps
+            threads = [threading.Thread(
+                target=lambda p=p, i=i: (time.sleep(0.002 * i),
+                                         eng.generate(p,
+                                                      max_new_tokens=new,
+                                                      timeout=1800)))
+                for i, p in enumerate(mix)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            misses = co.snapshot()["totals"]["misses"] - base
+            ticks = max(eng.ragged_steps - ticks0, 1)
+        return {"warmup_compile_s": round(warmup_s, 3),
+                "post_warmup_misses": int(misses),
+                "recompiles_per_1k_ticks": round(misses / ticks * 1e3,
+                                                 3)}
+
     def qblock_step_probe():
         """Q-block vs per-token ragged grid at a representative mixed
         prefill+decode tick: the per-token kernel runs one grid step per
@@ -1317,6 +1394,7 @@ def bench_serving():
     spec_on = run_spec(True)
     spec_off = run_spec(False)
     qblock = qblock_step_probe()
+    compile_obs = compile_probe()
     int8w = run_int8_weights()
     int8w_ratio = round(int8w["tokens_per_sec"]
                         / max(off["tokens_per_sec"], 1e-9), 2)
@@ -1349,6 +1427,9 @@ def bench_serving():
          int8w["weight_bytes_ratio"]),
         ("spec_draft_forwards_per_tick",
          spec_on["draft_forwards_per_tick"]),
+        ("serving_recompiles_per_1k_ticks",
+         compile_obs["recompiles_per_1k_ticks"]),
+        ("serving_warmup_compile_s", compile_obs["warmup_compile_s"]),
     ]
     if kv_probe is not None:
         aux.append(("serving_kv_capacity_ratio",
@@ -1393,6 +1474,13 @@ def bench_serving():
         "nospec_forwards_per_token": round(spec_off["forwards_per_token"],
                                            3),
         "spec_draft_forwards_per_tick": spec_on["draft_forwards_per_tick"],
+        # compile observatory: cold-start warmup cost + steady-state
+        # recompile rate (must be 0 — misses after warmup mean shapes
+        # are churning past the declared buckets)
+        "serving_recompiles_per_1k_ticks":
+            compile_obs["recompiles_per_1k_ticks"],
+        "serving_warmup_compile_s": compile_obs["warmup_compile_s"],
+        "compile_post_warmup_misses": compile_obs["post_warmup_misses"],
         # q-block vs per-token ragged grid (exact step counts)
         "serving_qblock_step_ratio": qblock["step_ratio"],
         "qblock_grid_steps": qblock["qblock_grid_steps"],

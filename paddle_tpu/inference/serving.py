@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..profiler import request_trace as _rt
 from ..profiler import ledger as _ledger
+from ..profiler import compile_observatory as _co
 
 #: default token budget of one chunked-prefill step (overridable per
 #: engine via ``prefill_chunk_tokens=`` or PADDLE_SERVING_CHUNK_TOKENS)
@@ -744,6 +745,7 @@ class ContinuousServingEngine:
         # slot, n_valid, done) and ("decode", n_active) events in order
         # (the ragged scheduler emits both per packed tick)
         self.events: deque = deque(maxlen=4096)
+        self._declare_programs()
 
     def declared_token_buckets(self):
         """The ragged scheduler's full compiled-shape family: every tick's
@@ -756,6 +758,195 @@ class ContinuousServingEngine:
             out.add(b)
             b *= 2
         out.add(self.token_budget)
+        return out
+
+    def declared_chunk_buckets(self):
+        """The legacy prefill path's compiled-shape family: every chunk
+        pads to one of these widths (:func:`_chunk_bucket`, pow2 min 8
+        capped at ``chunk_tokens``)."""
+        out, b = set(), 8
+        while b < self.chunk_tokens:
+            out.add(b)
+            b *= 2
+        out.add(self.chunk_tokens)
+        return out
+
+    def declared_draft_buckets(self):
+        """The batched drafter's compiled-shape family: (rows, width)
+        both pow2-bucketed (:func:`speculative._pow2_bucket`), rows up
+        to the engine's slot count, width capped at the draft window.
+        Returns ``(rows_buckets, width_buckets)`` or None when batched
+        drafting is off / the drafter has no batch path."""
+        if not (self.enable_spec and self.draft_batch
+                and hasattr(self._drafter, "propose_batch")):
+            return None
+        from .speculative import _pow2_bucket
+        rows, b = set(), 1
+        while b < _pow2_bucket(self.max_batch):
+            rows.add(b)
+            b *= 2
+        rows.add(_pow2_bucket(self.max_batch))
+        window = int(getattr(self._drafter, "window", 64))
+        widths, b = set(), 1
+        while b < window:
+            widths.add(b)
+            b *= 2
+        widths.add(window)
+        return rows, widths
+
+    def _static_args(self):
+        """Static (non-shape) parts of every serving program signature:
+        a dtype flip recompiles the whole family, and the observatory's
+        cause string must say so (``static arg `weight_dtype`
+        int8→native``)."""
+        kv = self.kv_dtype
+        if kv is None:
+            kv = os.environ.get("PADDLE_KV_DTYPE", "auto")
+        kv = "native" if str(kv).lower() == "auto" else str(kv).lower()
+        return {"weight_dtype": _co.static_arg(self.weight_dtype
+                                               or "native"),
+                "kv_dtype": _co.static_arg(kv)}
+
+    def _ragged_signature(self, padded):
+        sig = {"tokens": _co.tensor_arg((int(padded),), "int64")}
+        sig.update(self._static_args())
+        return sig
+
+    def _chunk_signature(self, padded):
+        sig = {"tokens": _co.tensor_arg((int(padded),), "int64")}
+        sig.update(self._static_args())
+        return sig
+
+    def _decode_signature(self):
+        sig = {"tokens": _co.tensor_arg((self.max_batch, 1), "int64")}
+        sig.update(self._static_args())
+        return sig
+
+    def _declare_programs(self):
+        """Declare this engine's program families (bucket sets + warmup
+        entries) with the compile observatory, so serve-time observations
+        can be checked against the inventory and causes can name the
+        offending bucket. Declaration is construction-time bookkeeping —
+        the hot-path gate stays :func:`compile_observatory.is_enabled`."""
+        import weakref
+        ref = weakref.ref(self)
+
+        def warm(names):
+            eng = ref()
+            return eng.warmup_programs(families=names) if eng else {}
+
+        if self.enable_ragged:
+            _co.declare_family(
+                "serving.ragged",
+                buckets={"tokens": sorted(self.declared_token_buckets())},
+                warmup=lambda: warm(("serving.ragged",)))
+        else:
+            _co.declare_family(
+                "serving.prefill_chunk",
+                buckets={"tokens": sorted(self.declared_chunk_buckets())},
+                warmup=lambda: warm(("serving.prefill_chunk",)))
+            _co.declare_family(
+                "serving.decode",
+                buckets={"tokens": [self.max_batch]},
+                warmup=lambda: warm(("serving.decode",)))
+        draft = self.declared_draft_buckets()
+        if draft is not None:
+            rows, widths = draft
+            _co.declare_family(
+                "spec.draft_batch",
+                buckets={"tokens": {0: sorted(rows), 1: sorted(widths)}},
+                warmup=lambda: warm(("spec.draft_batch",)))
+
+    def warmup_programs(self, families=None):
+        """Pre-compile every declared signature of this engine's program
+        families and record the observations, so steady-state traffic
+        sees ZERO observatory misses (and pays no first-request compile
+        tax). Runs each declared bucket shape once through the real
+        forward path on a scratch KV cache; call before :meth:`start`
+        (or through :meth:`run_on_loop` on a live engine). Returns
+        ``{family: wall_seconds}``."""
+        from ..autograd.tape import no_grad
+        from ..models.generation import SlotPagedKVCache
+        names = None if families is None else set(families)
+
+        def want(n):
+            return names is None or n in names
+
+        out = {}
+        was_training = self.model.training
+        self.model.eval()
+        try:
+            with no_grad():
+                cache = SlotPagedKVCache(
+                    self.max_batch, page_size=self.page_size,
+                    max_len=self.max_len, num_pages=self.num_pages,
+                    enable_prefix_cache=False, kv_dtype=self.kv_dtype)
+                if self.enable_ragged and want("serving.ragged"):
+                    t0 = time.perf_counter()
+                    for b in sorted(self.declared_token_buckets()):
+                        flat = np.full(b, self.pad_token_id, np.int64)
+                        pos = np.zeros(b, np.int32)
+                        cache.begin_ragged([(0, 0, 1)])
+                        t_run = time.perf_counter()
+                        self.model.forward(Tensor(flat[None]), cache=cache,
+                                           position_ids=pos)
+                        _co.observe("serving.ragged",
+                                    self._ragged_signature(b),
+                                    seconds=time.perf_counter() - t_run)
+                        cache.free(0)
+                    out["serving.ragged"] = time.perf_counter() - t0
+                if not self.enable_ragged and want("serving.prefill_chunk"):
+                    t0 = time.perf_counter()
+                    for b in sorted(self.declared_chunk_buckets()):
+                        cache.assign(0, np.zeros(1, np.int64))
+                        cache.begin_prefill(0, 1)
+                        chunk = np.full(b, self.pad_token_id, np.int64)
+                        pos = np.zeros(b, np.int32)
+                        t_run = time.perf_counter()
+                        self.model.forward(Tensor(chunk[None]), cache=cache,
+                                           position_ids=pos)
+                        _co.observe("serving.prefill_chunk",
+                                    self._chunk_signature(b),
+                                    seconds=time.perf_counter() - t_run)
+                        cache.free(0)
+                    out["serving.prefill_chunk"] = time.perf_counter() - t0
+                if not self.enable_ragged and want("serving.decode"):
+                    t0 = time.perf_counter()
+                    cache.assign(0, np.zeros(1, np.int64))
+                    cache.begin_prefill(0, 1)
+                    self.model.forward(
+                        Tensor(np.zeros((1, 8), np.int64)), cache=cache,
+                        position_ids=np.zeros(8, np.int32))
+                    mask = np.zeros(self.max_batch, bool)
+                    mask[0] = True
+                    cache.begin_decode(mask)
+                    cur = np.full((self.max_batch, 1), self.pad_token_id,
+                                  np.int64)
+                    pos = cache.lens.astype(np.int32)[:, None]
+                    t_run = time.perf_counter()
+                    self.model.forward(Tensor(cur), cache=cache,
+                                       position_ids=pos)
+                    _co.observe("serving.decode", self._decode_signature(),
+                                seconds=time.perf_counter() - t_run)
+                    cache.free(0)
+                    out["serving.decode"] = time.perf_counter() - t0
+                draft = self.declared_draft_buckets()
+                if draft is not None and want("spec.draft_batch"):
+                    rows, widths = draft
+                    t0 = time.perf_counter()
+                    for r in sorted(rows):
+                        for w in sorted(widths):
+                            batch = np.zeros((r, w), np.int64)
+                            t_run = time.perf_counter()
+                            self._drafter.model.forward(Tensor(batch))
+                            _co.observe(
+                                "spec.draft_batch",
+                                {"tokens": _co.tensor_arg((r, w), "int64")},
+                                seconds=time.perf_counter() - t_run)
+                    out["spec.draft_batch"] = time.perf_counter() - t0
+        finally:
+            if was_training:
+                self.model.train()
         return out
 
     def generate(self, input_ids, max_new_tokens=32, max_length=None,
@@ -854,8 +1045,17 @@ class ContinuousServingEngine:
         tele["chunk_util"].observe(n_valid / max(padded, 1))
         done = start + n_valid >= row.prompt.shape[0]
         self.events.append(("chunk", slot, n_valid, done))
+        chunk_dt = time.perf_counter() - t_chunk
+        if _co.is_enabled():
+            ev = _co.observe("serving.prefill_chunk",
+                             self._chunk_signature(padded),
+                             seconds=chunk_dt)
+            if ev is not None and ev["miss"]:
+                _rt.add_span(row.req.trace, "compile", t0=t_chunk,
+                             dur=chunk_dt, family="serving.prefill_chunk",
+                             cause=ev["cause"])
         _rt.add_span(row.req.trace, "prefill_chunk", t0=t_chunk,
-                     dur=time.perf_counter() - t_chunk, slot=slot,
+                     dur=chunk_dt, slot=slot,
                      tokens=n_valid, start=start, last=done)
         if not done:
             return
@@ -1170,6 +1370,17 @@ class ContinuousServingEngine:
                     step_dt = time.perf_counter() - t_step
                     self.ragged_steps += 1
                     self.ragged_buckets_used.add(padded)
+                    # compile observatory: one program-boundary record
+                    # per packed tick; on a miss every participating
+                    # request gets a "compile" span so its TTFT
+                    # decomposes into queue/compile/prefill
+                    compile_ev = None
+                    if _co.is_enabled():
+                        ev = _co.observe("serving.ragged",
+                                         self._ragged_signature(padded),
+                                         seconds=step_dt)
+                        if ev is not None and ev["miss"]:
+                            compile_ev = ev
                     self.padded_tokens_total += padded
                     self.useful_tokens_total += total
                     tele["budget_util"].observe(total / max(padded, 1))
@@ -1189,6 +1400,12 @@ class ContinuousServingEngine:
                         row = active[slot]
                         if row is None:
                             continue
+                        if compile_ev is not None:
+                            _rt.add_span(row.req.trace, "compile",
+                                         t0=t_step, dur=step_dt,
+                                         family="serving.ragged",
+                                         cause=compile_ev["cause"],
+                                         tick=self.ragged_steps)
                         name = ("prefill_chunk" if kind == "prefill"
                                 else "decode")
                         _rt.add_span(
@@ -1422,10 +1639,22 @@ class ContinuousServingEngine:
                     # every active slot earned one token this step
                     for _ in range(n_active):
                         tele["token"].observe(step_dt / max(n_active, 1))
+                    compile_ev = None
+                    if _co.is_enabled():
+                        ev = _co.observe("serving.decode",
+                                         self._decode_signature(),
+                                         seconds=step_dt)
+                        if ev is not None and ev["miss"]:
+                            compile_ev = ev
                     greedy = np.asarray(jnp.argmax(lg, axis=-1))
                     for i, r in enumerate(list(active)):
                         if r is None or r.state != "decode":
                             continue
+                        if compile_ev is not None:
+                            _rt.add_span(r.req.trace, "compile", t0=t_step,
+                                         dur=step_dt,
+                                         family="serving.decode",
+                                         cause=compile_ev["cause"])
                         _rt.add_span(r.req.trace, "decode", t0=t_step,
                                      dur=step_dt, slot=i, tokens=1,
                                      tick=self.decode_steps)

@@ -28,6 +28,7 @@ Two tiers:
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -150,6 +151,7 @@ class DraftModelDrafter:
         import jax.numpy as jnp
         from ..framework.core import Tensor
         from ..autograd.tape import no_grad
+        from ..profiler import compile_observatory as _co
 
         ks = [int(k) for k in ks]
         rows = [np.asarray(h).reshape(-1)[-self.window:].astype(np.int64)
@@ -174,8 +176,16 @@ class DraftModelDrafter:
                                      np.int64)
                     for r, i in enumerate(act):
                         batch[r, :lens[r]] = rows[i]
+                    t_fwd = (time.perf_counter()
+                             if _co.is_enabled() else None)
                     logits = self.model.forward(Tensor(batch))
                     self.forwards += 1
+                    if t_fwd is not None:
+                        _co.observe(
+                            "spec.draft_batch",
+                            {"tokens": _co.tensor_arg(batch.shape,
+                                                      "int64")},
+                            seconds=time.perf_counter() - t_fwd)
                     last = np.asarray(jnp.argmax(
                         logits._data[np.arange(len(act)),
                                      np.asarray(lens) - 1], axis=-1))

@@ -24,6 +24,7 @@ from ..framework import dtype as dtypes
 from ..framework import random as prandom
 from ..autograd.tape import apply, no_grad
 from ..nn.layer import Layer
+from ..profiler import compile_observatory as _co
 
 _static_mode = [False]  # paddle.enable_static (legacy static-graph mode flag)
 _TRACING = [False]
@@ -321,6 +322,26 @@ class StaticFunction:
         entry = self._cache.get(key)
         tm["cache"].inc(event="hit" if entry is not None else "miss")
         t_miss = None if entry is not None else time.perf_counter()
+        # compile observatory: to_static IS a training-step jit boundary;
+        # record the full input spec as a program signature so a retrace
+        # gets a cause string ("arg `arg0` dim0 13→16", "static arg
+        # `training` True→False") instead of a silent cache miss
+        co_sig = None
+        if _co.is_enabled():
+            fam = f"jit.{getattr(self._orig_fn, '__name__', 'fn')}"
+            if t_miss is not None:
+                _co.declare_family(
+                    fam, warmup=lambda: "warmed by first traced call")
+            co_sig = {"training": _co.static_arg(training)}
+            for i, l in enumerate(leaves):
+                if isinstance(l, Tensor):
+                    co_sig[f"arg{i}"] = _co.tensor_arg(
+                        l._data.shape, l.dtype)
+                elif isinstance(l, np.ndarray):
+                    co_sig[f"arg{i}"] = _co.tensor_arg(l.shape, l.dtype)
+                elif isinstance(l, (int, float, str, bool, bytes,
+                                    type(None))):
+                    co_sig[f"arg{i}"] = _co.static_arg(l)
         if entry is None:
             sg_flags = [t.stop_gradient for t in tensor_leaves]
             # a spec that already needed control-flow conversion tells us
@@ -408,6 +429,11 @@ class StaticFunction:
             # this spec are pure cache dispatch — the spread between this
             # histogram and steady-state step time IS the compile cost
             tm["compile"].observe(time.perf_counter() - t_miss)
+        if co_sig is not None:
+            _co.observe(f"jit.{getattr(self._orig_fn, '__name__', 'fn')}",
+                        co_sig,
+                        seconds=(time.perf_counter() - t_miss
+                                 if t_miss is not None else None))
         with no_grad():
             for b, nb in zip(bufs, new_bufs):
                 b._data = nb._data if isinstance(nb, Tensor) else nb

@@ -76,6 +76,10 @@ from . import eventlog  # noqa: F401
 from .eventlog import (  # noqa: F401  (re-exported facade)
     EventLog, log_event, get_event_log,
 )
+from . import compile_observatory  # noqa: F401
+from .compile_observatory import (  # noqa: F401  (re-exported facade)
+    CompileObservatory, get_observatory,
+)
 
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "make_scheduler",
@@ -105,6 +109,7 @@ __all__ = [
     "FleetScraper", "fleet_metrics", "fleet_metrics_text",
     "parse_metrics_text", "start_fleet_scraper", "stop_fleet_scraper",
     "get_fleet_scraper", "EventLog", "log_event", "get_event_log",
+    "compile_observatory", "CompileObservatory", "get_observatory",
 ]
 
 

@@ -46,6 +46,8 @@ __all__ = [
     "AlertRule", "ThresholdRule", "BurnRateRule", "AlertEngine",
     "parse_rules", "get_alert_engine", "reset_alert_engine",
     "active_alerts", "DEFAULT_SLO_BUDGET",
+    "recompile_storm_rule", "family_drift_rule",
+    "DEFAULT_RECOMPILE_BUDGET",
 ]
 
 #: default SLO error budget (fraction of requests allowed to violate)
@@ -190,12 +192,49 @@ class BurnRateRule(AlertRule):
         return d
 
 
+#: default tolerated steady-state trace-cache miss fraction for the
+#: recompile-storm burn rate: >2% of program lookups missing (over both
+#: windows) means shapes are churning past the declared buckets
+DEFAULT_RECOMPILE_BUDGET = 0.02
+
+
+def recompile_storm_rule(budget=None, fast_window_s=60.0,
+                         slow_window_s=300.0, factor=1.0,
+                         severity="page", name="recompile_storm",
+                         **_ignored):
+    """Burn-rate rule over the compile observatory's hit/miss counters
+    (the ``family="all"`` rollup series): fires when trace-cache misses
+    eat the recompile budget in both windows — a recompile storm. The
+    offending argument/dimension is in the miss events' ``cause``
+    strings (``tools/compile_report.py`` or the ``/compile`` scrape)."""
+    if budget is None:
+        budget = DEFAULT_RECOMPILE_BUDGET
+    return BurnRateRule(
+        name=name, slo="all", budget=budget,
+        fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+        factor=factor, severity=severity,
+        good_metric="paddle_compile_hits_total",
+        bad_metric="paddle_compile_misses_total")
+
+
+def family_drift_rule(for_s=0.0, severity="warn",
+                      name="compile_family_drift", **_ignored):
+    """Threshold rule on ``paddle_compile_undeclared_families``: any
+    serve-time program family never declared in the inventory (a code
+    path compiling programs the fleet doesn't account for) is drift."""
+    return ThresholdRule(name=name,
+                         metric="paddle_compile_undeclared_families",
+                         above=0.0, for_s=for_s, severity=severity)
+
+
 # ---------------------------------------------------------------------------
 # env grammar (PADDLE_ALERT_RULES — same directive shape as the
 # PADDLE_FAULT_PLAN grammar from PR 6)
 # ---------------------------------------------------------------------------
 
-_RULE_KINDS = {"threshold": ThresholdRule, "burn_rate": BurnRateRule}
+_RULE_KINDS = {"threshold": ThresholdRule, "burn_rate": BurnRateRule,
+               "recompile_storm": recompile_storm_rule,
+               "family_drift": family_drift_rule}
 
 #: grammar key -> constructor kwarg (+ coercion)
 _KEY_MAP = {
@@ -206,6 +245,12 @@ _KEY_MAP = {
                                                         float),
                   "slow": ("slow_window_s", float), "factor": float,
                   "name": str, "severity": str},
+    "recompile_storm": {"budget": float, "fast": ("fast_window_s",
+                                                  float),
+                        "slow": ("slow_window_s", float),
+                        "factor": float, "name": str, "severity": str},
+    "family_drift": {"for": ("for_s", float), "name": str,
+                     "severity": str},
 }
 
 

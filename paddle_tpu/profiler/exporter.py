@@ -12,6 +12,7 @@ only if each process exports what PRs 3/9/11-13 already collect).
 | ``/state``             | GET    | flight-recorder component states (JSON) |
 | ``/history``           | GET    | metric time-series window (``?window_s=&match=``, capped) |
 | ``/timeline/<trace>``  | GET    | one request's PR-9 timeline (404 unknown) |
+| ``/compile``           | GET    | compile-observatory snapshot: per-family hit/miss/seconds + retrace causes (JSON) |
 | ``/debug/dump``        | POST   | trigger an on-demand flight-recorder dump; returns the dump paths |
 
 Every endpoint is bounded: the history window is capped at
@@ -55,7 +56,7 @@ __all__ = [
 #: requires each documented in docs/OBSERVABILITY.md AND exercised by a
 #: test
 ROUTES = ("/metrics", "/healthz", "/state", "/history", "/timeline",
-          "/debug/dump")
+          "/compile", "/debug/dump")
 
 #: discovery key prefix: ``<prefix><instance>`` -> {host, port, pid}
 KV_TELEMETRY_PREFIX = "fleet/telemetry/"
@@ -285,6 +286,11 @@ class TelemetryServer:
                 "application/json"
         return 200, _json(tl), "application/json"
 
+    def _body_compile(self):
+        from . import compile_observatory as co
+        return 200, _json({"instance": self.instance,
+                           **co.snapshot()}), "application/json"
+
     def _body_dump(self):
         from . import flight_recorder as fr
         res = fr.get_flight_recorder().dump(
@@ -340,6 +346,8 @@ def _make_handler(server: TelemetryServer):
                 elif path.startswith("/timeline/"):
                     code, body, ctype = server._body_timeline(
                         path[len("/timeline/"):])
+                elif path == "/compile":
+                    code, body, ctype = server._body_compile()
                 elif path == "/debug/dump":
                     code, body, ctype = 405, _json(
                         {"error": "POST /debug/dump"}), "application/json"

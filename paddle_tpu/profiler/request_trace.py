@@ -738,6 +738,13 @@ def cost_table(path=None) -> dict:
         table["memory"] = mem
     except Exception:
         table["memory"] = {}
+    # compile observatory: per-family compile counts + wall seconds (the
+    # retrace tax a planner must charge against any shape-churning plan)
+    try:
+        from . import compile_observatory as _co
+        table["compile"] = _co.cost_section()
+    except Exception:
+        table["compile"] = {}
     table["slo"] = slo_report()
     table["wire_model"] = {
         "sim_lat_us": float(os.environ.get("PADDLE_SIM_WIRE_LAT_US", "0")),

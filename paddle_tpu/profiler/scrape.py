@@ -30,6 +30,7 @@ paddle_tpu / jax import) for its live-fleet mode.
 """
 from __future__ import annotations
 
+import json
 import os
 import re
 import threading
@@ -39,6 +40,7 @@ import urllib.request
 __all__ = [
     "FleetScraper", "parse_metrics_text", "render_metrics_text",
     "merge_instances", "fleet_metrics", "fleet_metrics_text",
+    "fetch_compile", "merge_compile_snapshots",
     "start_fleet_scraper", "stop_fleet_scraper", "get_fleet_scraper",
     "DEFAULT_STALE_S", "DEFAULT_SCRAPE_INTERVAL_S",
 ]
@@ -228,6 +230,52 @@ def fetch_metrics(endpoint: str, timeout_s=2.0) -> dict:
     return parse_metrics_text(body)
 
 
+def fetch_compile(endpoint: str, timeout_s=2.0) -> dict:
+    """GET ``http://<endpoint>/compile`` — one instance's
+    compile-observatory snapshot (per-family hit/miss/compile-seconds
+    plus recent retrace causes)."""
+    with urllib.request.urlopen(f"http://{endpoint}/compile",
+                                timeout=timeout_s) as resp:
+        body = resp.read().decode("utf-8", errors="replace")
+    return json.loads(body)
+
+
+def merge_compile_snapshots(by_instance: dict) -> dict:
+    """Fold per-instance ``/compile`` snapshots into one fleet rollup:
+    per-family hits/misses/compile seconds summed across instances,
+    recent causes and undeclared families unioned (with the reporting
+    instances attached — a family drifting on ONE replica must stay
+    visible in the fleet view)."""
+    families: dict = {}
+    undeclared: dict = {}
+    totals = {"hits": 0, "misses": 0, "compile_s": 0.0}
+    for instance in sorted(by_instance):
+        snap = by_instance[instance] or {}
+        for fam in snap.get("undeclared", ()):
+            undeclared.setdefault(str(fam), []).append(str(instance))
+        for name, f in (snap.get("families") or {}).items():
+            m = families.setdefault(name, {
+                "hits": 0, "misses": 0, "compile_s": 0.0,
+                "signatures": 0, "instances": [], "last_causes": [],
+            })
+            m["hits"] += int(f.get("hits", 0))
+            m["misses"] += int(f.get("misses", 0))
+            m["compile_s"] += float(f.get("compile_s", 0.0))
+            m["signatures"] += int(f.get("signatures", 0))
+            m["instances"].append(str(instance))
+            for c in (f.get("last_causes") or [])[-4:]:
+                m["last_causes"].append(
+                    {"instance": str(instance), **c}
+                    if isinstance(c, dict)
+                    else {"instance": str(instance), "cause": c})
+        t = snap.get("totals") or {}
+        totals["hits"] += int(t.get("hits", 0))
+        totals["misses"] += int(t.get("misses", 0))
+        totals["compile_s"] += float(t.get("compile_s", 0.0))
+    return {"instances": sorted(by_instance), "families": families,
+            "undeclared": undeclared, "totals": totals}
+
+
 class _MergedView:
     """Registry shim the fold-in :class:`MetricsHistory` samples: its
     ``collect()`` is the scraper's merged fleet view."""
@@ -406,6 +454,26 @@ class FleetScraper:
 
     def metrics_text(self) -> str:
         return render_metrics_text(self.merged())
+
+    def compile_snapshots(self, now=None) -> dict:
+        """Scrape every discovered endpoint's ``/compile`` route NOW
+        (on demand — compile state changes on trace events, not on the
+        metrics cadence). Returns ``{instance: snapshot}``; endpoints
+        that fail to answer are skipped, never raise."""
+        out = {}
+        for instance, endpoint in sorted(self.discover().items()):
+            try:
+                out[instance] = fetch_compile(endpoint,
+                                              timeout_s=self.timeout_s)
+            except Exception as e:
+                with self._lock:
+                    self._errors[instance] = repr(e)
+        return out
+
+    def compile_merged(self) -> dict:
+        """Fleet-wide compile rollup: :meth:`compile_snapshots` folded
+        through :func:`merge_compile_snapshots`."""
+        return merge_compile_snapshots(self.compile_snapshots())
 
     # -- background loop -----------------------------------------------------
     def start(self):

@@ -19,9 +19,12 @@ Two guards:
 """
 from __future__ import annotations
 
+import time
+
 import jax
 
 from ..framework.core import Tensor
+from ..profiler import compile_observatory as _co
 
 
 class DonatedTensorError(RuntimeError):
@@ -64,16 +67,39 @@ def donated_jit(fn, donate_argnums=(), **jit_kwargs):
     """
     donate = tuple(donate_argnums)
     jitted = jax.jit(fn, donate_argnums=donate, **jit_kwargs)
+    # compile observatory: the donated train step is a jit boundary; a
+    # shape/dtype churn in the step inputs is a silent retrace the
+    # observatory must attribute (family "train.<fn>")
+    family = f"train.{getattr(fn, '__name__', 'fn')}"
+    _co.declare_family(family,
+                       warmup=lambda: "warmed by first donated step")
 
     def unwrap(x):
         return x._data if isinstance(x, Tensor) else x
+
+    def signature(raw, raw_kw):
+        sig = {"donate_argnums": _co.static_arg(str(donate))}
+        leaves = jax.tree.leaves((raw, raw_kw))
+        for i, leaf in enumerate(leaves[:32]):
+            if hasattr(leaf, "shape"):
+                sig[f"leaf{i}"] = _co.tensor_arg(
+                    leaf.shape, getattr(leaf, "dtype", "?"))
+            else:
+                sig[f"leaf{i}"] = _co.static_arg(leaf)
+        if len(leaves) > 32:
+            sig["extra_leaves"] = _co.static_arg(len(leaves) - 32)
+        return sig
 
     def call(*args, **kwargs):
         is_t = lambda t: isinstance(t, Tensor)     # noqa: E731
         raw = [jax.tree.map(unwrap, a, is_leaf=is_t) for a in args]
         raw_kw = {k: jax.tree.map(unwrap, v, is_leaf=is_t)
                   for k, v in kwargs.items()}
+        t_step = time.perf_counter() if _co.is_enabled() else None
         out = jitted(*raw, **raw_kw)
+        if t_step is not None:
+            _co.observe(family, signature(raw, raw_kw),
+                        seconds=time.perf_counter() - t_step)
         for i in donate:
             msg = (f"argument {i} of {getattr(fn, '__name__', 'fn')} was "
                    f"DONATED to XLA (its HBM now backs the outputs); "

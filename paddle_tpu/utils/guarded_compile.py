@@ -50,6 +50,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
 import warnings
 
 _OK, _BAD, _UNKNOWN = "ok", "bad", "unknown"
@@ -437,6 +438,7 @@ def prove(kernel_id: str, timeout: float = 420.0, src: str | None = None,
     # Mosaic kernel. The child process is disposable by construction.
     child_env["PADDLE_TPU_KERNEL_GUARD"] = "trust"
     note = ""
+    t_prove = time.perf_counter()
     try:
         proc = subprocess.run(
             [sys.executable, "-c", src], env=child_env,
@@ -461,6 +463,18 @@ def prove(kernel_id: str, timeout: float = 420.0, src: str | None = None,
     with open(_marker(kernel_id, _OK if ok else _BAD), "w") as f:
         f.write(note or "proved")
     _STATUS_CACHE[(_proof_dir(), kernel_id)] = _OK if ok else _BAD
+    # compile observatory: a canary run IS a compile event for the
+    # kernel's program family (re-proofs after clear() are re-observed;
+    # latched short-circuits above never reach here)
+    try:
+        from ..profiler import compile_observatory as _co
+        if _co.is_enabled():
+            fam = f"kernel.{kernel_id}"
+            _co.declare_family(fam, warmup=lambda kid=kernel_id: prove(kid))
+            _co.observe(fam, {"canary": _co.static_arg(kernel_id)},
+                        seconds=time.perf_counter() - t_prove)
+    except Exception:
+        pass
     if not ok:
         print(f"guarded_compile: kernel '{kernel_id}' QUARANTINED: "
               f"{note.splitlines()[0] if note else 'failed'}",

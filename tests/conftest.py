@@ -97,9 +97,10 @@ def requires_spmd_pipeline(fn):
 # ---------------------------------------------------------------------------
 # fast/slow tiers (VERDICT round-3 item 9): the full suite is ~50 min on the
 # 8-virtual-device CPU mesh, so per-commit signal needs a fast tier —
-# `pytest tests/ -m "not slow"` runs in ~2 min. Files measured >15 s in the
-# round-4 full run are marked slow here (file-level: coarse but maintainable;
-# re-measure with `pytest --durations=0` when adding suites).
+# `pytest tests/ -m "not slow"` runs in ~15 min on the 1-core CPU box
+# (PR-18 measurement). Files measured >15 s in the round-4 full run are
+# marked slow here (file-level: coarse but maintainable; re-measure with
+# `pytest --durations=0` when adding suites).
 # ---------------------------------------------------------------------------
 
 _SLOW_FILES = {

@@ -393,6 +393,7 @@ class TestPersistentJitCache:
         from paddle_tpu.jit import api as jit_api
 
         cache_dir = str(tmp_path / "jitcache")
+        prev = jit_api._PERSISTENT_CACHE[0]
         assert jit_api.enable_persistent_cache(cache_dir)
         try:
             c = jit_api._jit_metrics()["cache"]
@@ -406,8 +407,14 @@ class TestPersistentJitCache:
             f(jnp.ones((4, 4))).block_until_ready()
             assert c.value(event="disk_hit") > before
         finally:
-            jax.config.update("jax_compilation_cache_dir", None)
-            jit_api._PERSISTENT_CACHE[0] = False
+            # restore the suite-wide cache (conftest enables one) rather
+            # than leaving the plane disabled for every later test
+            if isinstance(prev, str):
+                jit_api._PERSISTENT_CACHE[0] = None
+                jit_api.enable_persistent_cache(prev)
+            else:
+                jax.config.update("jax_compilation_cache_dir", None)
+                jit_api._PERSISTENT_CACHE[0] = False
 
     def test_disabled_without_env(self, monkeypatch):
         from paddle_tpu.jit import api as jit_api

@@ -113,6 +113,17 @@ def test_quantized_config_catalog():
     assert not violations, violations
 
 
+def test_compile_observatory_catalog():
+    """Compile-observatory guard (ISSUE 18): every PADDLE_COMPILE* knob
+    and paddle_compile_* metric is cataloged in docs/OBSERVABILITY.md
+    AND exercised by a test; a warmed engine's mixed replay observes
+    only declared program families, every declared family has a warmup
+    entry, and zero post-warmup trace-cache misses occur."""
+    from check_inventory import check_compile_observatory
+    violations = check_compile_observatory(verbose=False)
+    assert not violations, violations
+
+
 def test_paddle_flops():
     import numpy as np
     import paddle_tpu as paddle

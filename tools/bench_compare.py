@@ -46,6 +46,11 @@ DIRECTION_RULES = [
     ("telemetry_export_overhead", "lower"),
     ("scrape_age", "lower"),
     ("overhead_pct", "lower"),
+    # steady-state serving recompiles must be ZERO; any rise is shape
+    # churn past the declared buckets (warmup compile seconds are the
+    # cold-start budget — also lower-better, via the _s suffix rule)
+    ("recompiles_per_1k", "lower"),
+    ("post_warmup_misses", "lower"),
     ("waste_ratio", "lower"),
     ("qblock_step_ratio", "lower"),
     ("weight_bytes_ratio", "lower"),

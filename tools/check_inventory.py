@@ -399,6 +399,21 @@ INVENTORY = [
     ("Batched drafting (one padded draft forward per tick)",
      "paddle_tpu.inference.speculative",
      ["DraftModelDrafter", "NGramDrafter"]),
+    # -- compile observatory (ISSUE 18) --------------------------------------
+    ("Compile observatory (retrace-cause attribution)",
+     "paddle_tpu.profiler.compile_observatory",
+     ["CompileObservatory", "get_observatory", "observe",
+      "declare_family", "register_warmup", "run_warmup",
+      "declared_families", "undeclared_families", "snapshot",
+      "cost_section", "tensor_arg", "static_arg", "format_signature",
+      "SCHEMA"]),
+    ("Recompile-storm + family-drift alert rules",
+     "paddle_tpu.profiler.alerts",
+     ["recompile_storm_rule", "family_drift_rule",
+      "DEFAULT_RECOMPILE_BUDGET"]),
+    ("Fleet compile scrape (/compile merge)",
+     "paddle_tpu.profiler.scrape",
+     ["fetch_compile", "merge_compile_snapshots"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -1134,6 +1149,132 @@ def check_telemetry_plane(verbose=True):
     return violations
 
 
+def check_compile_observatory(verbose=True):
+    """Compile-observatory inventory guard (ISSUE 18). Two halves:
+
+    Catalog: every ``PADDLE_COMPILE*`` env knob and every
+    ``paddle_compile_*`` metric referenced in ``paddle_tpu/`` must be
+    documented in docs/OBSERVABILITY.md AND exercised by at least one
+    test — the same contract every other observability layer lives
+    under.
+
+    Runtime drift: a short mixed prefill+decode replay through a warmed
+    engine must (a) observe ONLY program families that were declared in
+    the inventory (a serve-time family the fleet doesn't account for is
+    drift), (b) find a registered warmup entry for every declared
+    family, and (c) record ZERO trace-cache misses after
+    ``warmup_programs()`` — steady-state serving must never recompile.
+    Returns a list of violation strings."""
+    import re
+    import threading
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ContinuousServingEngine
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.profiler import compile_observatory as co
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    knob_pat = re.compile(r"PADDLE_COMPILE[A-Z0-9_]*")
+    metric_pat = re.compile(r"paddle_compile_[a-z0-9_]*[a-z0-9]")
+    knobs, metrics = set(), set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    text = f.read()
+                knobs.update(knob_pat.findall(text))
+                metrics.update(metric_pat.findall(text))
+    # the snapshot schema token ("paddle_compile_observatory/1") matches
+    # the metric pattern but is not a metric family
+    metrics.discard("paddle_compile_observatory")
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              errors="replace") as f:
+        doc = f.read()
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name), errors="replace") as f:
+                tests_text += f.read()
+    violations = []
+    for k in sorted(knobs):
+        if k not in doc:
+            violations.append(
+                f"compile-observatory knob {k} missing from "
+                f"docs/OBSERVABILITY.md")
+        if k not in tests_text:
+            violations.append(
+                f"compile-observatory knob {k} not exercised by any test")
+    for m in sorted(metrics):
+        if m not in doc:
+            violations.append(
+                f"compile-observatory metric {m} missing from "
+                f"docs/OBSERVABILITY.md")
+        if m not in tests_text:
+            violations.append(
+                f"compile-observatory metric {m} not exercised by any "
+                f"test")
+    # runtime drift pass: warmed engine + mixed replay, observed ⊆
+    # declared, warmup entry per declared family, zero post-warmup misses
+    co.reset()
+    co.enable()
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny(num_hidden_layers=1))
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 128, (1, n)).astype(np.int64)
+                   for n in (13, 3, 21)]
+        eng = ContinuousServingEngine(model, max_batch_size=2, max_len=48,
+                                      token_budget=16,
+                                      prefill_chunk_tokens=16)
+        with eng:
+            eng.warmup_programs()
+            base = co.snapshot()["totals"]["misses"]
+            threads = [threading.Thread(
+                target=lambda p=p: eng.generate(p, max_new_tokens=3,
+                                                timeout=300))
+                for p in prompts]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        snap = co.snapshot()
+        if snap["undeclared"]:
+            violations.append(
+                f"runtime-observed program families never declared: "
+                f"{snap['undeclared']} (declared "
+                f"{sorted(co.declared_families())})")
+        missing_warmup = sorted(set(co.declared_families())
+                                - set(co.warmup_entries()))
+        if missing_warmup:
+            violations.append(
+                f"declared families without a registered warmup entry: "
+                f"{missing_warmup}")
+        post = snap["totals"]["misses"] - base
+        if post:
+            causes = [c["cause"]
+                      for f in snap["families"].values()
+                      for c in f.get("last_causes", [])]
+            violations.append(
+                f"{post} post-warmup trace-cache miss(es) in the mixed "
+                f"replay (steady state must be 0); causes: "
+                f"{causes[-int(post):]}")
+        if verbose:
+            for v in violations:
+                print(f"FAIL {v}")
+            print(f"compile observatory: {len(knobs)} knobs, "
+                  f"{len(metrics)} metrics checked; families "
+                  f"{sorted(snap['families'])} warmed, "
+                  f"{post} post-warmup misses")
+    finally:
+        co.reset()
+    return violations
+
+
 def check(verbose=True):
     failures = []
     for item, mod_path, symbols in INVENTORY:
@@ -1164,5 +1305,6 @@ if __name__ == "__main__":
                    or check_alert_catalog() or check_training_observability()
                    or check_ledger_catalog() or check_controller_catalog()
                    or check_telemetry_plane() or check_serving_programs()
-                   or check_quantized_config())
+                   or check_quantized_config()
+                   or check_compile_observatory())
              else 0)

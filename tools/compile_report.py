@@ -1,0 +1,256 @@
+"""Compile-observatory reporter: per-family compile counts / wall
+seconds / retrace causes from the structured event log, live ``/compile``
+scrapes, or a diff of two runs.
+
+The compile observatory (``paddle_tpu/profiler/compile_observatory.py``)
+appends one event-log record per trace-cache **miss** (``kind:
+"compile"``, ``src: "compile_observatory"``) carrying the program
+family, the structured retrace cause ("arg `tokens` dim0 136∉{128,256}:
+bucket miss", "static arg `weight_dtype` 'int8'→'bf16'", ...), the
+compile wall seconds and the full argument signature. This tool folds
+those records into the answers a recompile-storm page needs:
+
+* which family is recompiling, how often, and how much wall time it ate;
+* WHY — the top retrace causes, verbatim (the cause string names the
+  exact argument and offending dimension, so it maps directly to the
+  bucket/knob to fix);
+* whether a change regressed compile counts (``--diff OLD NEW``: any
+  family compiling more in NEW than OLD is a regression — steady-state
+  serving recompiles must be zero).
+
+Usage::
+
+    python tools/compile_report.py EVENTS.jsonl              # one run
+    python tools/compile_report.py --fleet HOST:P1,HOST:P2   # live scrape
+    python tools/compile_report.py --diff OLD.jsonl NEW.jsonl
+    python tools/compile_report.py --json EVENTS.jsonl
+
+Exit codes: 0 ok (and --diff found no regression), 1 --diff regression,
+2 usage/input error. Same import discipline as ``ledger_diff.py`` /
+``bench_compare.py``: stdlib-only, no jax/numpy — this runs on a laptop
+against logs scp'd off the fleet.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+#: how many distinct cause strings to print per family
+TOP_CAUSES = 5
+
+
+def load_events(path: str) -> list:
+    """Observatory compile records (``kind == "compile"`` and ``src ==
+    "compile_observatory"``) from one event-log JSONL file. Records the
+    request tracer *tees* (``src: "trace"``) are span copies of the same
+    misses and are deliberately skipped — counting both would double
+    every miss."""
+    out = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{ln}: not JSON ({e})") from e
+            if row.get("kind") != "compile":
+                continue
+            if row.get("src") != "compile_observatory":
+                continue
+            out.append(row)
+    return out
+
+
+def fold(records: list) -> dict:
+    """``{family: {compiles, compile_s, causes: {cause: count}}}``."""
+    fams: dict = {}
+    for r in records:
+        fam = str(r.get("family", "?"))
+        d = fams.setdefault(fam, {"compiles": 0, "compile_s": 0.0,
+                                  "causes": {}})
+        d["compiles"] += 1
+        try:
+            d["compile_s"] += float(r.get("seconds") or 0.0)
+        except (TypeError, ValueError):
+            pass
+        cause = str(r.get("cause", "?"))
+        d["causes"][cause] = d["causes"].get(cause, 0) + 1
+    return fams
+
+
+def fetch_fleet(endpoints: list, timeout_s=3.0) -> dict:
+    """Scrape every ``host:port`` endpoint's ``/compile`` route and fold
+    the snapshots into the same per-family shape (plus undeclared-family
+    drift). Endpoints that fail to answer are reported, not fatal."""
+    fams: dict = {}
+    undeclared: dict = {}
+    errors: dict = {}
+    for ep in endpoints:
+        try:
+            with urllib.request.urlopen(f"http://{ep}/compile",
+                                        timeout=timeout_s) as resp:
+                snap = json.loads(resp.read().decode(
+                    "utf-8", errors="replace"))
+        except Exception as e:
+            errors[ep] = repr(e)
+            continue
+        inst = str(snap.get("instance", ep))
+        for fam in snap.get("undeclared", ()):
+            undeclared.setdefault(str(fam), []).append(inst)
+        for name, f in (snap.get("families") or {}).items():
+            d = fams.setdefault(name, {"compiles": 0, "compile_s": 0.0,
+                                       "hits": 0, "causes": {},
+                                       "instances": []})
+            d["compiles"] += int(f.get("misses", 0))
+            d["hits"] += int(f.get("hits", 0))
+            d["compile_s"] += float(f.get("compile_s", 0.0))
+            d["instances"].append(inst)
+            for c in f.get("last_causes") or ():
+                cause = (c.get("cause", "?") if isinstance(c, dict)
+                         else str(c))
+                d["causes"][cause] = d["causes"].get(cause, 0) + 1
+    return {"families": fams, "undeclared": undeclared, "errors": errors}
+
+
+def diff_folds(old: dict, new: dict) -> list:
+    """Per-family compile-count regressions (NEW compiled more than
+    OLD), worst first. Each: ``{family, old, new, delta, causes}`` with
+    NEW's top causes attached — the storm's attribution."""
+    out = []
+    for fam in sorted(set(old) | set(new)):
+        o = old.get(fam, {}).get("compiles", 0)
+        n = new.get(fam, {}).get("compiles", 0)
+        if n > o:
+            causes = new.get(fam, {}).get("causes", {})
+            top = sorted(causes.items(), key=lambda kv: -kv[1])
+            out.append({"family": fam, "old": o, "new": n,
+                        "delta": n - o,
+                        "causes": [c for c, _ in top[:TOP_CAUSES]]})
+    out.sort(key=lambda d: -d["delta"])
+    return out
+
+
+def _fmt_family_block(name, d, lines):
+    lines.append(f"{name:<28} compiles={d['compiles']:<5} "
+                 f"compile_s={d['compile_s']:.3f}"
+                 + (f" hits={d['hits']}" if "hits" in d else ""))
+    top = sorted(d.get("causes", {}).items(), key=lambda kv: -kv[1])
+    for cause, count in top[:TOP_CAUSES]:
+        lines.append(f"    {count:>4}x {cause}")
+    extra = len(top) - TOP_CAUSES
+    if extra > 0:
+        lines.append(f"    ... {extra} more cause(s)")
+
+
+def render_report(fams: dict, title: str) -> str:
+    lines = [f"compile report: {title}"]
+    if not fams:
+        lines.append("no compile records")
+        return "\n".join(lines) + "\n"
+    total_c = sum(d["compiles"] for d in fams.values())
+    total_s = sum(d["compile_s"] for d in fams.values())
+    lines.append(f"{len(fams)} family(ies), {total_c} compile(s), "
+                 f"{total_s:.3f}s compile wall time")
+    for name in sorted(fams, key=lambda n: -fams[n]["compiles"]):
+        _fmt_family_block(name, fams[name], lines)
+    return "\n".join(lines) + "\n"
+
+
+def render_fleet(view: dict) -> str:
+    lines = [render_report(view["families"], "fleet /compile scrape")
+             .rstrip("\n")]
+    for fam, insts in sorted(view.get("undeclared", {}).items()):
+        lines.append(f"DRIFT: family {fam!r} never declared "
+                     f"(seen on {', '.join(insts)})")
+    for ep, err in sorted(view.get("errors", {}).items()):
+        lines.append(f"UNREACHABLE: {ep}: {err}")
+    return "\n".join(lines) + "\n"
+
+
+def render_diff(regs: list, a_path, b_path) -> str:
+    lines = [f"compile diff: {os.path.basename(a_path)} -> "
+             f"{os.path.basename(b_path)}"]
+    if not regs:
+        lines.append("no compile-count regressions")
+        return "\n".join(lines) + "\n"
+    for r in regs:
+        lines.append(f"REGRESSED  {r['family']:<28} "
+                     f"{r['old']} -> {r['new']} (+{r['delta']})")
+        for cause in r["causes"]:
+            lines.append(f"    cause: {cause}")
+    lines.append(f"{len(regs)} regressed family(ies)")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-family compile counts/seconds/causes from the "
+                    "event log, a live fleet, or a two-run diff")
+    ap.add_argument("paths", nargs="*",
+                    help="event-log JSONL file(s); with --diff exactly "
+                         "two (OLD NEW)")
+    ap.add_argument("--fleet", metavar="EP1,EP2",
+                    help="scrape live host:port /compile endpoints "
+                         "instead of reading logs")
+    ap.add_argument("--diff", action="store_true",
+                    help="diff two runs; exit 1 if any family compiled "
+                         "more in NEW than OLD")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the folded report as JSON")
+    args = ap.parse_args(argv)
+
+    if args.fleet:
+        if args.paths or args.diff:
+            print("compile_report: --fleet takes no log paths",
+                  file=sys.stderr)
+            return 2
+        eps = [e.strip() for e in args.fleet.split(",") if e.strip()]
+        view = fetch_fleet(eps)
+        if args.json:
+            json.dump(view, sys.stdout, indent=1, default=str)
+            sys.stdout.write("\n")
+        else:
+            sys.stdout.write(render_fleet(view))
+        return 0
+
+    try:
+        if args.diff:
+            if len(args.paths) != 2:
+                print("compile_report: --diff needs exactly OLD NEW",
+                      file=sys.stderr)
+                return 2
+            old = fold(load_events(args.paths[0]))
+            new = fold(load_events(args.paths[1]))
+            regs = diff_folds(old, new)
+            if args.json:
+                json.dump({"regressions": regs, "ok": not regs},
+                          sys.stdout, indent=1)
+                sys.stdout.write("\n")
+            else:
+                sys.stdout.write(render_diff(regs, args.paths[0],
+                                             args.paths[1]))
+            return 1 if regs else 0
+        if len(args.paths) != 1:
+            print("compile_report: need one event-log path "
+                  "(or --fleet / --diff)", file=sys.stderr)
+            return 2
+        fams = fold(load_events(args.paths[0]))
+    except (OSError, ValueError) as e:
+        print(f"compile_report: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump({"families": fams}, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_report(fams,
+                                       os.path.basename(args.paths[0])))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
