@@ -304,6 +304,7 @@ class ServingRouter:
         self.affinity_matchable = 0
         self.affinity_hits = 0
         self.handoff_pages = 0
+        self.handoff_host_pages = 0    # served from the exporter's host tier
 
     # -- lifecycle ----------------------------------------------------------
     def _hb_key(self, replica):
@@ -796,12 +797,15 @@ class ServingRouter:
                               replica=dec.id, source_replica=pre.id):
                     n = dec.engine.run_on_loop(
                         lambda eng: eng._cache.import_pages(blob))
+                hp = int(blob.get("host_pages", 0))
                 if n:
                     with self._lock:
                         self.handoff_pages += n
+                        self.handoff_host_pages += hp
                     tele["handoff"].inc(n)
                 _rt.add_event(ticket.trace, "handoff", pages=int(n or 0),
-                              replica=dec.id, source_replica=pre.id)
+                              host_pages=hp, replica=dec.id,
+                              source_replica=pre.id)
             except Exception:
                 pass                         # full prefill fallback
         else:
@@ -911,6 +915,7 @@ class ServingRouter:
                 "affinity_hits": self.affinity_hits,
                 "affinity_matchable": self.affinity_matchable,
                 "handoff_pages": self.handoff_pages,
+                "handoff_host_pages": self.handoff_host_pages,
                 "replicas": {
                     r.id: {"alive": r.alive, "draining": r.draining,
                            "role": r.role, "inflight": len(r.inflight),

@@ -33,6 +33,12 @@ DEFAULT_PREFILL_CHUNK_TOKENS = 256
 #: live decode slot contributes 1 token, prefill spans fill the rest
 DEFAULT_SERVING_TOKEN_BUDGET = 256
 
+#: default stripe length of the sep-parallel long-context prefill
+#: (``sep_stripe_tokens=`` / PADDLE_SEP_STRIPE_TOKENS): every chunk of a
+#: long prompt pads to exactly this many tokens, so the ring-prefill
+#: program family has ONE chunk shape
+DEFAULT_SEP_STRIPE_TOKENS = 512
+
 _TELEMETRY = None      # lazily bound registry families
 
 
@@ -141,6 +147,25 @@ def _telemetry():
                 "paddle_spec_acceptance_ratio",
                 "accepted/drafted fraction of each verified span",
                 buckets=DEFAULT_RATIO_BUCKETS),
+            "prefix_evictions": r.counter(
+                "paddle_serving_prefix_evictions_total",
+                "prefix-cache evictions by tier (tier=device: LRU "
+                "reclaim of an index page, demoted to host when the "
+                "tier is on; tier=host: second-level LRU drop — the "
+                "prefix is gone and will re-prefill)",
+                labels=("tier",)),
+            "host_pool_bytes": r.gauge(
+                "paddle_kv_host_pool_bytes",
+                "host-RAM KV tier bytes (kind=used: resident demoted "
+                "pages; kind=capacity: PADDLE_KV_HOST_POOL_MB bound)",
+                labels=("kind",)),
+            "host_demotions": r.counter(
+                "paddle_kv_host_demotions_total",
+                "device prefix pages demoted into the host tier"),
+            "host_promotions": r.counter(
+                "paddle_kv_host_promotions_total",
+                "host-tier pages promoted back to device on an "
+                "admission hit (prefill work avoided)"),
         }
     return _TELEMETRY
 
@@ -157,7 +182,7 @@ def _engine_state(engine) -> dict:
                  "useful_tokens_total", "spec_drafted_tokens",
                  "spec_accepted_tokens", "spec_rounds", "spec_k",
                  "spec_draft_forwards", "spec_draft_ticks",
-                 "quantized_linears"):
+                 "quantized_linears", "sep_requests"):
         v = getattr(engine, attr, None)
         if v is not None:
             state[attr] = v
@@ -214,6 +239,26 @@ def _engine_state(engine) -> dict:
             "rollbacks": cache.rollbacks,
             "tokens_rolled_back": cache.tokens_rolled_back,
         }
+        hp = getattr(cache, "host_pool", None)
+        if hp is not None:
+            state["kv_host_tier"] = {
+                "enabled": hp.enabled,
+                "used_bytes": hp.used_bytes,
+                "capacity_bytes": hp.max_bytes,
+                "entries": len(hp),
+                "demotions": hp.demotions,
+                "promotions": hp.promotions,
+                "evictions": hp.evictions,
+                "device_evictions": cache.prefix_evictions_device,
+                "promote_rejects": cache.host_promote_rejects,
+            }
+        if getattr(cache, "sep_stripes_stored", 0) or \
+                getattr(engine, "sep_requests", 0):
+            state["sep_prefill"] = {
+                "stripes_stored": cache.sep_stripes_stored,
+                "chunks": cache.sep_chunks,
+                "decode_steps": cache.sep_decode_steps,
+            }
     return state
 
 
@@ -582,6 +627,7 @@ class _Row:
         self.generated: list = []
         self.done = False
         self.state = "queued"                # queued -> prefill -> decode
+        self.sep = False                     # long-context sep-ring row
         self._key_base = None                # seeded-sampling PRNG base
 
 
@@ -634,7 +680,9 @@ class ContinuousServingEngine:
                  enable_prefix_cache=None, num_pages=None,
                  token_budget=None, enable_ragged=None, kv_dtype=None,
                  spec_decode=None, spec_k=None, drafter=None,
-                 draft_model=None, weight_dtype=None, draft_batch=None):
+                 draft_model=None, weight_dtype=None, draft_batch=None,
+                 host_pool_mb=None, sep_prefill=None,
+                 sep_stripe_tokens=None, sep_threshold_tokens=None):
         self.model = model
         # end-to-end int8 weights (PADDLE_WEIGHT_DTYPE=int8): every
         # nn.Linear swaps its weight for (int8, per-channel scale) and
@@ -714,6 +762,51 @@ class ContinuousServingEngine:
             draft_batch = os.environ.get(
                 "PADDLE_SPEC_DRAFT_BATCH", "1") != "0"
         self.draft_batch = bool(draft_batch)
+        # tiered KV: the engine owns ONE host pool across cache rebuilds
+        # (a serve-loop crash must not flush the warm tier); 0 MB keeps
+        # the tier off and eviction behavior exactly legacy
+        from ..models.generation import HostKVPool
+        if host_pool_mb is None:
+            host_pool_mb = float(os.environ.get(
+                "PADDLE_KV_HOST_POOL_MB", "0") or 0)
+        self.host_pool_mb = float(host_pool_mb)
+        if self.host_pool_mb < 0:
+            raise ValueError(f"host_pool_mb must be >= 0, got "
+                             f"{self.host_pool_mb}")
+        self._host_pool = HostKVPool(self.host_pool_mb)
+        self._kv_tier_seen: dict = {}   # counter baselines for telemetry
+        # sep-parallel long-context prefill (PADDLE_SEP_PREFILL=1):
+        # prompts past the threshold are chunked into fixed
+        # PADDLE_SEP_STRIPE_TOKENS stripes attended with the
+        # ring-attention schedule — the device page pool only ever holds
+        # the decode tail, so a prompt far larger than the pool serves
+        if sep_prefill is None:
+            sep_prefill = os.environ.get("PADDLE_SEP_PREFILL", "0") == "1"
+        self.sep_prefill_enabled = bool(sep_prefill)
+        if sep_stripe_tokens is None:
+            sep_stripe_tokens = int(os.environ.get(
+                "PADDLE_SEP_STRIPE_TOKENS", str(DEFAULT_SEP_STRIPE_TOKENS)))
+        self.sep_stripe = int(sep_stripe_tokens)
+        if sep_threshold_tokens is None:
+            sep_threshold_tokens = int(os.environ.get(
+                "PADDLE_SEP_THRESHOLD_TOKENS", "0"))
+        self.sep_threshold = int(sep_threshold_tokens)
+        self.sep_requests = 0
+        if self.sep_prefill_enabled:
+            if not self.enable_ragged:
+                raise ValueError(
+                    "sep prefill needs the ragged scheduler "
+                    "(enable_ragged=True / PADDLE_SERVING_RAGGED=1)")
+            if self.sep_stripe <= 0 or self.sep_stripe % self.page_size:
+                raise ValueError(
+                    f"sep_stripe_tokens {self.sep_stripe} must be a "
+                    f"positive multiple of page_size {self.page_size}")
+            kv = self.kv_dtype
+            if kv is None:
+                kv = os.environ.get("PADDLE_KV_DTYPE", "auto")
+            if str(kv).lower() == "int8":
+                raise ValueError("sep prefill requires native KV pages "
+                                 "(kv_dtype=int8 is unsupported)")
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
         self.spec_rounds = 0           # verify spans with >= 1 draft
@@ -822,6 +915,42 @@ class ContinuousServingEngine:
         sig.update(self._static_args())
         return sig
 
+    def _sep_max_stripes(self):
+        return self.max_len // max(self.sep_stripe, 1)
+
+    def _sep_tail_buckets(self):
+        """pow2 tail-page windows a sep decode step can compile with
+        (the cache always gathers the pure power of two)."""
+        import math as _math
+        pages_per_seq = -(-self.max_len // self.page_size)
+        out, b = set(), 1
+        while b < pages_per_seq:
+            out.add(b)
+            b *= 2
+        out.add(b)
+        return out
+
+    def _sep_prefill_signature(self, n_stripes):
+        # the chunk shape is fixed at the stripe length; the unrolled
+        # ring loop makes the STRIPE COUNT part of the program identity
+        sig = {"tokens": _co.tensor_arg((self.sep_stripe,), "int64"),
+               "stripes": _co.tensor_arg((int(n_stripes),), "int32")}
+        sig.update(self._static_args())
+        return sig
+
+    def _sep_decode_signature(self, n_stripes, tail_pages):
+        sig = {"tokens": _co.tensor_arg((1,), "int64"),
+               "stripes": _co.tensor_arg((int(n_stripes),), "int32"),
+               "tail_pages": _co.tensor_arg((int(tail_pages),), "int32")}
+        sig.update(self._static_args())
+        return sig
+
+    def _host_promote_signature(self):
+        # one page's writeback is the compiled unit (fixed page shape)
+        sig = {"pages": _co.tensor_arg((1,), "int32")}
+        sig.update(self._static_args())
+        return sig
+
     def _declare_programs(self):
         """Declare this engine's program families (bucket sets + warmup
         entries) with the compile observatory, so serve-time observations
@@ -856,6 +985,23 @@ class ContinuousServingEngine:
                 "spec.draft_batch",
                 buckets={"tokens": {0: sorted(rows), 1: sorted(widths)}},
                 warmup=lambda: warm(("spec.draft_batch",)))
+        if self.sep_prefill_enabled:
+            max_stripes = self._sep_max_stripes()
+            _co.declare_family(
+                "serving.sep_prefill",
+                buckets={"tokens": [self.sep_stripe],
+                         "stripes": list(range(max_stripes + 1))},
+                warmup=lambda: warm(("serving.sep_prefill",)))
+            _co.declare_family(
+                "serving.sep_decode",
+                buckets={"tokens": [1],
+                         "stripes": list(range(max_stripes + 1)),
+                         "tail_pages": sorted(self._sep_tail_buckets())},
+                warmup=lambda: warm(("serving.sep_decode",)))
+        if self._host_pool.enabled:
+            _co.declare_family(
+                "kv.host_promote", buckets={"pages": [1]},
+                warmup=lambda: warm(("kv.host_promote",)))
 
     def warmup_programs(self, families=None):
         """Pre-compile every declared signature of this engine's program
@@ -880,7 +1026,8 @@ class ContinuousServingEngine:
                 cache = SlotPagedKVCache(
                     self.max_batch, page_size=self.page_size,
                     max_len=self.max_len, num_pages=self.num_pages,
-                    enable_prefix_cache=False, kv_dtype=self.kv_dtype)
+                    enable_prefix_cache=False, kv_dtype=self.kv_dtype,
+                    allow_page_overcommit=self.sep_prefill_enabled)
                 if self.enable_ragged and want("serving.ragged"):
                     t0 = time.perf_counter()
                     for b in sorted(self.declared_token_buckets()):
@@ -944,6 +1091,91 @@ class ContinuousServingEngine:
                                 {"tokens": _co.tensor_arg((r, w), "int64")},
                                 seconds=time.perf_counter() - t_run)
                     out["spec.draft_batch"] = time.perf_counter() - t0
+                if self.sep_prefill_enabled and (
+                        want("serving.sep_prefill")
+                        or want("serving.sep_decode")):
+                    # one full long-context span walks the ring-prefill
+                    # family through every stripe count, then one decode
+                    # step compiles the stripes+tail read
+                    t0 = time.perf_counter()
+                    sep_cache = SlotPagedKVCache(
+                        self.max_batch, page_size=self.page_size,
+                        max_len=self.max_len, num_pages=self.num_pages,
+                        enable_prefix_cache=False, kv_dtype=self.kv_dtype,
+                        allow_page_overcommit=True)
+                    stripe = self.sep_stripe
+                    n = min(self.max_len - 2,
+                            self._sep_max_stripes() * stripe
+                            + max(stripe // 2, 1))
+                    sep_cache.assign_sep(0, n, stripe)
+                    pos0 = 0
+                    while pos0 < n:
+                        nv = min(stripe, n - pos0)
+                        ns = len(sep_cache._sep[0]["stripes"])
+                        chunk = np.full(stripe, self.pad_token_id,
+                                        np.int64)
+                        pos = np.minimum(
+                            np.arange(pos0, pos0 + stripe,
+                                      dtype=np.int32), pos0 + nv - 1)
+                        sep_cache.begin_sep_prefill(0, nv)
+                        t_run = time.perf_counter()
+                        self.model.forward(Tensor(chunk[None]),
+                                           cache=sep_cache,
+                                           position_ids=pos)
+                        if want("serving.sep_prefill"):
+                            _co.observe(
+                                "serving.sep_prefill",
+                                self._sep_prefill_signature(ns),
+                                seconds=time.perf_counter() - t_run)
+                        pos0 += nv
+                    if want("serving.sep_prefill"):
+                        out["serving.sep_prefill"] = \
+                            time.perf_counter() - t0
+                    if want("serving.sep_decode"):
+                        t0 = time.perf_counter()
+                        view = sep_cache.sep_view(0)
+                        sep_cache.begin_sep_decode(0)
+                        cur = np.full((1, 1), self.pad_token_id, np.int64)
+                        dpos = np.asarray([[int(sep_cache.lens[0])]],
+                                          np.int32)
+                        t_run = time.perf_counter()
+                        self.model.forward(Tensor(cur), cache=sep_cache,
+                                           position_ids=dpos)
+                        _co.observe(
+                            "serving.sep_decode",
+                            self._sep_decode_signature(
+                                view["stripes"], view["tail_pages"]),
+                            seconds=time.perf_counter() - t_run)
+                        out["serving.sep_decode"] = \
+                            time.perf_counter() - t0
+                    sep_cache.free(0)
+                if self._host_pool.enabled and want("kv.host_promote"):
+                    # demote -> promote roundtrip on a scratch cache and
+                    # a scratch pool (the live tier must stay untouched)
+                    from ..models.generation import HostKVPool
+                    t0 = time.perf_counter()
+                    hcache = SlotPagedKVCache(
+                        1, page_size=self.page_size, max_len=self.max_len,
+                        enable_prefix_cache=True, kv_dtype=self.kv_dtype,
+                        host_pool=HostKVPool(max(self.host_pool_mb, 64)))
+                    n = 2 * self.page_size
+                    prompt = np.zeros(n, np.int64)
+                    hcache.assign(0, prompt)
+                    hcache.begin_prefill(0, n)
+                    self.model.forward(
+                        Tensor(prompt[None]), cache=hcache,
+                        position_ids=np.arange(n, dtype=np.int32))
+                    hcache.commit_prefix(0)
+                    hcache.free(0)
+                    while hcache._evict_lru():
+                        pass
+                    t_run = time.perf_counter()
+                    hcache.assign(0, prompt)   # host hit -> promotion
+                    _co.observe("kv.host_promote",
+                                self._host_promote_signature(),
+                                seconds=time.perf_counter() - t_run)
+                    hcache.free(0)
+                    out["kv.host_promote"] = time.perf_counter() - t0
         finally:
             if was_training:
                 self.model.train()
@@ -979,11 +1211,13 @@ class ContinuousServingEngine:
     __exit__ = ServingEngine.__exit__
 
     # -- scheduler ----------------------------------------------------------
-    def _admit(self, cache, free, active, pending, prefill_q):
+    def _admit(self, cache, free, active, pending, prefill_q, sep_q=None):
         """Non-blocking admission: map waiting rows onto free slots and
         match their prompts against the prefix index — NO model work
         happens here (the prefill itself runs chunk-by-chunk in the main
-        loop, interleaved with decode steps)."""
+        loop, interleaved with decode steps). Prompts past the sep
+        threshold route to the sep-parallel ring-prefill queue instead
+        of the paged prefix path."""
         tele = _telemetry()
         while free and pending:
             row = pending.popleft()
@@ -1000,7 +1234,30 @@ class ContinuousServingEngine:
                          engine=self._ENGINE)
             if row.prompt.shape[0] < 1:
                 raise ValueError("cannot serve an empty prompt")
+            if sep_q is not None and \
+                    self._sep_engaged(cache, row.prompt.shape[0]):
+                cache.assign_sep(slot, row.prompt.shape[0],
+                                 self.sep_stripe)
+                row.sep = True
+                row.state = "prefill"
+                active[slot] = row
+                sep_q.append(slot)
+                self.prefills += 1
+                self.sep_requests += 1
+                _rt.add_event(row.req.trace, "admit_sep", slot=slot,
+                              tokens=int(row.prompt.shape[0]),
+                              stripe=self.sep_stripe,
+                              engine=self._ENGINE)
+                continue
+            p0 = self._host_pool.promotions
+            t_assign = time.perf_counter()
             cached, hits, misses = cache.assign(slot, row.prompt)
+            if _co.is_enabled() and self._host_pool.promotions > p0:
+                # the promote path stages host blobs onto device pages —
+                # a distinct program family (H2D copies + dequant)
+                _co.observe("kv.host_promote",
+                            self._host_promote_signature(),
+                            seconds=time.perf_counter() - t_assign)
             tele["prefix_hits"].inc(hits)
             tele["prefix_misses"].inc(misses)
             tele["prefix_cached"].inc(cached)
@@ -1124,9 +1381,52 @@ class ContinuousServingEngine:
                                  max_len=self.max_len,
                                  num_pages=self.num_pages,
                                  enable_prefix_cache=self.enable_prefix_cache,
-                                 kv_dtype=self.kv_dtype)
+                                 kv_dtype=self.kv_dtype,
+                                 host_pool=self._host_pool,
+                                 allow_page_overcommit=(
+                                     self.sep_prefill_enabled))
+        # cache-scoped counter baselines reset with the cache (a rebuilt
+        # cache restarts them at 0; pool-scoped baselines persist with
+        # the engine-owned host pool)
+        self._kv_tier_seen.pop("dev_evict", None)
         self._cache = cache           # flight-recorder / test introspection
         return cache
+
+    def _mirror_kv_tier(self, tele, cache):
+        """Per-tick telemetry mirror for the tiered-KV counters: inc the
+        registry by the delta since the last mirror (counters must never
+        regress even when the cache — and its counters — rebuild after a
+        serve-loop error)."""
+        seen = self._kv_tier_seen
+        hp = self._host_pool
+
+        def bump(key, cur, metric, **labels):
+            prev = seen.get(key, 0)
+            if cur > prev:
+                metric.inc(cur - prev, **labels)
+            seen[key] = cur
+
+        bump("dev_evict", cache.prefix_evictions_device,
+             tele["prefix_evictions"], tier="device")
+        bump("host_evict", hp.evictions,
+             tele["prefix_evictions"], tier="host")
+        bump("demote", hp.demotions, tele["host_demotions"])
+        bump("promote", hp.promotions, tele["host_promotions"])
+        tele["host_pool_bytes"].set(hp.used_bytes, kind="used")
+        tele["host_pool_bytes"].set(hp.max_bytes, kind="capacity")
+
+    def _sep_engaged(self, cache, prompt_tokens):
+        """Route a prompt to sep-parallel prefill? Explicit threshold
+        wins; the 0 default engages when the prompt would consume more
+        than half the device page pool (long-context territory — the
+        pool may not even hold it)."""
+        if not self.sep_prefill_enabled:
+            return False
+        thr = self.sep_threshold
+        if thr <= 0:
+            cap = (cache.num_pages - 1) * self.page_size
+            thr = max(cap // 2, self.sep_stripe)
+        return int(prompt_tokens) >= thr
 
     @staticmethod
     def _row_key(row, token_idx):
@@ -1166,6 +1466,7 @@ class ContinuousServingEngine:
             active: list = [None] * self.max_batch
             pending: deque = deque()
             prefill_q: deque = deque()    # slots mid-prefill, FIFO
+            sep_q: deque = deque()        # slots mid sep-ring prefill
 
             def enqueue(item):
                 """False = stop token; otherwise split into rows."""
@@ -1184,6 +1485,8 @@ class ContinuousServingEngine:
                 cache.free(i)
                 if i in prefill_q:
                     prefill_q.remove(i)
+                if i in sep_q:
+                    sep_q.remove(i)
                 free.append(i)
 
             while True:
@@ -1240,13 +1543,17 @@ class ContinuousServingEngine:
                 tele = _telemetry()
                 try:
                     if self._running:
-                        self._admit(cache, free, active, pending, prefill_q)
+                        self._admit(cache, free, active, pending, prefill_q,
+                                    sep_q=sep_q)
                     # ---- pack the tick: decode tokens first (each
                     # optionally extended into a speculative verify span
                     # of 1 current + up to spec_k drafted tokens), then
                     # as many prefill tokens as the budget admits ------
+                    # (sep rows run their own stripe-shaped programs in
+                    # _sep_tick and never join the ragged pack)
                     decode_slots = [i for i, r in enumerate(active)
-                                    if r is not None and r.state == "decode"]
+                                    if r is not None and r.state == "decode"
+                                    and not r.sep]
                     spans = []        # (slot, q_start, start, n, kind)
                     tick_drafts = {}  # slot -> drafted tokens this tick
                     off = 0
@@ -1341,6 +1648,8 @@ class ContinuousServingEngine:
                                            kind="used")
                     tele["pool_bytes"].set((cache.num_pages - 1) * page_nb,
                                            kind="capacity")
+                    self._mirror_kv_tier(tele, cache)
+                    self._sep_tick(cache, free, active, sep_q)
                     if not spans:
                         continue
                     total = off
@@ -1500,12 +1809,115 @@ class ContinuousServingEngine:
                         req.done.set()
                     pending.clear()
                     prefill_q.clear()
+                    sep_q.clear()
                     active = [None] * self.max_batch
                     free = deque(range(self.max_batch))
                     cache = self._new_cache()
         finally:
             if was_training:
                 self.model.train()
+
+    def _sep_tick(self, cache, free, active, sep_q):
+        """One sep-parallel step per tick: a single ring-prefill stripe
+        chunk for the longest-waiting sep slot, then one decode token
+        for every sep row already decoding. Sep programs are stripe- or
+        tail-shaped — never part of the ragged pack — so interleaving
+        at tick granularity keeps paged traffic flowing underneath a
+        100k-token prefill."""
+        if sep_q:
+            slot = sep_q[0]
+            if self._sep_prefill_chunk(cache, free, active, slot,
+                                       active[slot]):
+                sep_q.popleft()
+        for i, r in enumerate(active):
+            if r is not None and r.sep and r.state == "decode":
+                self._sep_decode_step(cache, free, active, i)
+
+    def _sep_prefill_chunk(self, cache, free, active, slot, row):
+        """Advance one stripe-sized ring-prefill chunk; on the final
+        chunk sample the first token and flip the row to sep decode.
+        Returns True when the prompt is fully consumed."""
+        from ..models.generation import _sample_logits
+        tele = _telemetry()
+        stripe = self.sep_stripe
+        start = int(cache.lens[slot])
+        n_valid = min(stripe, row.prompt.shape[0] - start)
+        chunk = np.full(stripe, self.pad_token_id, row.prompt.dtype)
+        chunk[:n_valid] = row.prompt[start:start + n_valid]
+        pos = np.minimum(np.arange(start, start + stripe, dtype=np.int32),
+                         start + n_valid - 1)
+        n_stripes = cache.sep_view(slot)["stripes"]
+        cache.begin_sep_prefill(slot, n_valid)
+        t_chunk = time.perf_counter()
+        logits = self.model.forward(Tensor(chunk[None]), cache=cache,
+                                    position_ids=pos)
+        chunk_dt = time.perf_counter() - t_chunk
+        self.prefill_chunks += 1
+        self.padded_tokens_total += stripe
+        self.useful_tokens_total += n_valid
+        tele["chunk_util"].observe(n_valid / max(stripe, 1))
+        done = start + n_valid >= row.prompt.shape[0]
+        self.events.append(("sep_chunk", slot, n_valid, done))
+        if _co.is_enabled():
+            ev = _co.observe("serving.sep_prefill",
+                             self._sep_prefill_signature(n_stripes),
+                             seconds=chunk_dt)
+            if ev is not None and ev["miss"]:
+                _rt.add_span(row.req.trace, "compile", t0=t_chunk,
+                             dur=chunk_dt, family="serving.sep_prefill",
+                             cause=ev["cause"])
+        _rt.add_span(row.req.trace, "sep_prefill_chunk", t0=t_chunk,
+                     dur=chunk_dt, slot=slot, tokens=n_valid,
+                     start=start, stripes=n_stripes, last=done)
+        if not done:
+            return False
+        kw = row.req.kwargs
+        nxt = int(np.asarray(_sample_logits(
+            logits._data[:, n_valid - 1].astype(jnp.float32),
+            kw.get("do_sample", False), kw.get("top_k", 0),
+            kw.get("top_p", 1.0), kw.get("temperature", 1.0),
+            key=self._row_key(row, len(row.generated))))[0])
+        row.state = "decode"
+        self._push_token(cache, free, active, slot, nxt)
+        return True
+
+    def _sep_decode_step(self, cache, free, active, slot):
+        """One decode token for a sep row: the ring merge reads every
+        stored stripe plus the pow2-padded device tail window."""
+        from ..models.generation import _sample_logits
+        tele = _telemetry()
+        row = active[slot]
+        view = cache.sep_view(slot)
+        cur = np.asarray([[row.generated[-1] if row.generated
+                           else row.prompt[-1]]], np.int64)
+        pos = np.asarray([[int(cache.lens[slot])]], np.int32)
+        cache.begin_sep_decode(slot)
+        t_step = time.perf_counter()
+        logits = self.model.forward(Tensor(cur), cache=cache,
+                                    position_ids=pos)
+        step_dt = time.perf_counter() - t_step
+        self.decode_steps += 1
+        tele["decode_step"].observe(step_dt)
+        tele["token"].observe(step_dt)
+        if _co.is_enabled():
+            ev = _co.observe("serving.sep_decode",
+                             self._sep_decode_signature(
+                                 view["stripes"], view["tail_pages"]),
+                             seconds=step_dt)
+            if ev is not None and ev["miss"]:
+                _rt.add_span(row.req.trace, "compile", t0=t_step,
+                             dur=step_dt, family="serving.sep_decode",
+                             cause=ev["cause"])
+        _rt.add_span(row.req.trace, "decode", t0=t_step, dur=step_dt,
+                     slot=slot, tokens=1, sep=True,
+                     tick=self.decode_steps)
+        kw = row.req.kwargs
+        tok = int(np.asarray(_sample_logits(
+            logits._data[:, -1].astype(jnp.float32),
+            kw.get("do_sample", False), kw.get("top_k", 0),
+            kw.get("top_p", 1.0), kw.get("temperature", 1.0),
+            key=self._row_key(row, len(row.generated))))[0])
+        self._push_token(cache, free, active, slot, tok)
 
     def _serve_legacy(self):
         from ..models.generation import _sample_logits
@@ -1612,6 +2024,7 @@ class ContinuousServingEngine:
                                            kind="used")
                     tele["pool_bytes"].set((cache.num_pages - 1) * page_nb,
                                            kind="capacity")
+                    self._mirror_kv_tier(tele, cache)
                     if not mask.any():
                         continue
                     t_step = time.perf_counter()

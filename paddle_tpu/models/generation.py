@@ -18,9 +18,9 @@ from ..framework.core import Tensor
 from ..autograd.tape import no_grad
 from ..framework import random as prandom
 
-__all__ = ["KVCache", "PagedKVCache", "SlotPagedKVCache", "GenerationMixin",
-           "block_hash_chain", "quantize_kv_rows", "dequantize_kv_rows",
-           "kv_page_nbytes"]
+__all__ = ["KVCache", "PagedKVCache", "SlotPagedKVCache", "HostKVPool",
+           "GenerationMixin", "block_hash_chain", "quantize_kv_rows",
+           "dequantize_kv_rows", "kv_page_nbytes"]
 
 #: kv_dtype values SlotPagedKVCache understands (PADDLE_KV_DTYPE)
 KV_DTYPES = ("auto", "int8", "native")
@@ -78,6 +78,103 @@ def block_hash_chain(tokens, page_size, parent=b""):
         parent = h.digest()
         out.append(parent)
     return out
+
+
+class HostKVPool:
+    """Host-RAM second tier under the device prefix index (ROADMAP item 4;
+    arxiv 2604.15464's HBM-capacity argument taken to its conclusion). At
+    fleet scale the shared-prefix working set dwarfs device HBM: today an
+    LRU-evicted prefix page is simply gone and the next tenant re-prefills
+    it from scratch. This pool catches those evictions — a demoted page is
+    one single-page blob in the :meth:`SlotPagedKVCache.export_pages`
+    codec (int8 pools demote their quantized ints + fp32 row scales as-is,
+    ~4x less copy traffic than fp32) — and promotion on an admission hit
+    writes the bytes back verbatim, so the roundtrip is bit-exact.
+
+    Capacity is bounded by ``PADDLE_KV_HOST_POOL_MB`` (0 = tier disabled,
+    exact legacy eviction behavior) with its own second-level LRU: when a
+    demotion would exceed the bound, the least-recently-touched host
+    entries fall off the end of the world. The pool is deliberately
+    cache-agnostic — the serving engine owns ONE pool across cache
+    rebuilds (crash recovery keeps the warm tier) and hands it to every
+    :class:`SlotPagedKVCache` it constructs."""
+
+    def __init__(self, max_mb=None):
+        if max_mb is None:
+            max_mb = float(os.environ.get("PADDLE_KV_HOST_POOL_MB", "0")
+                           or 0)
+        self.max_bytes = int(float(max_mb) * 2 ** 20)
+        from collections import OrderedDict
+        self._entries = OrderedDict()     # digest -> page blob (LRU order)
+        self.used_bytes = 0
+        self.demotions = 0        # accepted puts
+        self.promotions = 0       # takes that moved a page back to device
+        self.hits = 0             # lookups that found an entry
+        self.misses = 0           # lookups that came back empty
+        self.evictions = 0        # second-level LRU drops
+
+    @property
+    def enabled(self):
+        return self.max_bytes > 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    def __contains__(self, digest):
+        return bytes(digest) in self._entries
+
+    @staticmethod
+    def entry_nbytes(entry):
+        total = sum(k.nbytes + v.nbytes for k, v in entry["layers"])
+        if entry.get("scales"):
+            total += sum(ks.nbytes + vs.nbytes
+                         for ks, vs in entry["scales"])
+        return total
+
+    def put(self, digest, entry):
+        """Admit a demoted page under ``digest``, evicting LRU entries
+        until the byte bound holds again (an entry bigger than the whole
+        pool is admitted then immediately evicted — same contract).
+        Returns True when the entry is resident after the call."""
+        if not self.enabled:
+            return False
+        digest = bytes(digest)
+        old = self._entries.pop(digest, None)
+        if old is not None:
+            self.used_bytes -= self.entry_nbytes(old)
+        self._entries[digest] = entry
+        self.used_bytes += self.entry_nbytes(entry)
+        self.demotions += 1
+        while self.used_bytes > self.max_bytes and self._entries:
+            _, dropped = self._entries.popitem(last=False)
+            self.used_bytes -= self.entry_nbytes(dropped)
+            self.evictions += 1
+        return digest in self._entries
+
+    def get(self, digest):
+        """Peek (LRU touch, entry stays resident) — used by read-only
+        consumers like the disagg exporter."""
+        entry = self._entries.get(bytes(digest))
+        if entry is None:
+            self.misses += 1
+            return None
+        self._entries.move_to_end(bytes(digest))
+        self.hits += 1
+        return entry
+
+    def take(self, digest):
+        """Remove and return the entry (promotion path: once the page is
+        device-resident and index-registered, the device index is
+        authoritative — keeping the host copy would double-count bytes;
+        a later eviction demotes it again)."""
+        entry = self._entries.pop(bytes(digest), None)
+        if entry is not None:
+            self.used_bytes -= self.entry_nbytes(entry)
+        return entry
+
+    def clear(self):
+        self._entries.clear()
+        self.used_bytes = 0
 
 
 class KVCache:
@@ -282,7 +379,8 @@ class SlotPagedKVCache:
     """
 
     def __init__(self, max_batch, page_size=16, max_len=2048,
-                 num_pages=None, enable_prefix_cache=True, kv_dtype=None):
+                 num_pages=None, enable_prefix_cache=True, kv_dtype=None,
+                 host_pool=None, allow_page_overcommit=False):
         self.max_batch = int(max_batch)
         self.page_size = int(page_size)
         self.max_len = int(max_len)
@@ -305,7 +403,13 @@ class SlotPagedKVCache:
         # max_batch full-length sequences survives even with zero sharing
         self.num_pages = (int(num_pages) if num_pages is not None
                           else self.max_batch * self.pages_per_seq + 1)
-        if self.num_pages < self.pages_per_seq + 1:
+        if allow_page_overcommit:
+            # sep-parallel long-context serving deliberately overcommits:
+            # the bulk of a 100k+ prompt's KV lives in host-side stripes,
+            # only the decode tail needs device pages
+            if self.num_pages < 2:
+                raise ValueError("num_pages must be >= 2")
+        elif self.num_pages < self.pages_per_seq + 1:
             raise ValueError("num_pages must cover one full sequence")
         from collections import deque, OrderedDict
         self._free = deque(range(1, self.num_pages))
@@ -337,6 +441,27 @@ class SlotPagedKVCache:
         # speculative-decode rejection accounting (rollback())
         self.rollbacks = 0
         self.tokens_rolled_back = 0
+        # tiered KV: host-RAM second level under the prefix index.
+        # ``host_pool=None`` builds a private pool from the env knob
+        # (PADDLE_KV_HOST_POOL_MB=0 keeps the tier off); the serving
+        # engine passes its own long-lived pool so the warm tier
+        # survives cache rebuilds.
+        self.host_pool = host_pool if host_pool is not None else HostKVPool()
+        self.prefix_evictions_device = 0   # device-index LRU evictions
+        self.host_demotions = 0            # evictions caught by the tier
+        self.host_promotions = 0           # host hits moved back to device
+        self.host_promote_rejects = 0      # dtype/geometry mismatch drops
+        # sep-parallel long-context prefill: per-slot stripe state — the
+        # prompt span is chunked into fixed ``stripe`` token blocks whose
+        # K/V lives as host-side stripes (the single-host stand-in for
+        # pages striped across the sep ring's replicas), only the decode
+        # tail occupies device pages
+        self._sep = [None] * self.max_batch
+        self._sep_pending = None     # per-layer K/V of the in-flight chunk
+        self._sep_layer_i = 0        # forward-order layer cursor
+        self.sep_stripes_stored = 0
+        self.sep_chunks = 0
+        self.sep_decode_steps = 0
 
     # -- page allocator ------------------------------------------------------
     def _alloc_page(self):
@@ -352,16 +477,99 @@ class SlotPagedKVCache:
 
     def _evict_lru(self):
         """Reclaim the least-recently-used prefix-index entry whose page
-        has no live slot mapping (refcount 1 == the index's own ref)."""
+        has no live slot mapping (refcount 1 == the index's own ref).
+        With the host tier enabled the page's bytes are demoted there
+        before the device page frees — the prefix survives device churn
+        and a later :meth:`assign` promotes it back."""
         for digest in list(self._index):
             page = self._index[digest]
             if self._ref[page] == 1:
+                self._demote(digest, page)
                 del self._index[digest]
                 del self._page_digest[page]
                 self._ref[page] = 0
                 self._free.append(page)
+                self.prefix_evictions_device += 1
                 return True
         return False
+
+    def _page_entry(self, page):
+        """Single-page host blob in the export_pages codec layout: one
+        ``[kv, page_size, d]`` K/V pair per layer (pool/forward order)
+        plus the int8 row scales — np copies, device-independent."""
+        layers = [(np.asarray(kp[:, page]), np.asarray(vp[:, page]))
+                  for kp, vp in self._pools.values()]
+        scales = ([(np.asarray(ks[:, page]), np.asarray(vs[:, page]))
+                   for ks, vs in self._scales.values()]
+                  if self.kv_quant else None)
+        return {"page_size": self.page_size, "kv_dtype": self.kv_dtype,
+                "native_dtype": str(layers[0][0].dtype),
+                "layers": layers, "scales": scales}
+
+    def _demote(self, digest, page):
+        """Eviction hook: copy the page into the host tier (no-op when
+        the tier is off, or before the first forward materializes the
+        pools — there is nothing to copy yet)."""
+        hp = self.host_pool
+        if hp is None or not hp.enabled or not self._pools:
+            return False
+        if hp.put(bytes(digest), self._page_entry(int(page))):
+            self.host_demotions += 1
+            return True
+        return False
+
+    def _promote(self, digest):
+        """Admission hook: move a host-tier entry back onto a device page
+        and register it in the prefix index (the index's own ref, like
+        :meth:`commit_prefix`). Returns the page, or None on miss /
+        mismatch / device pool exhaustion (entry stays host-resident in
+        the last case so a later admission can retry)."""
+        hp = self.host_pool
+        if hp is None or not hp.enabled:
+            return None
+        entry = hp.get(bytes(digest))
+        if entry is None:
+            return None
+        ok = (int(entry["page_size"]) == self.page_size
+              and entry["kv_dtype"] == self.kv_dtype)
+        if ok and self._pools:
+            pool_dtype = str(next(iter(self._pools.values()))[0].dtype)
+            ok = (entry["native_dtype"] == pool_dtype
+                  and len(entry["layers"]) == len(self._pools))
+        if not ok:
+            # a stale entry from a differently-configured cache can never
+            # land bit-exactly — drop it rather than poison the pool
+            hp.take(bytes(digest))
+            self.host_promote_rejects += 1
+            return None
+        entry = hp.take(bytes(digest))
+        try:
+            # may recursively _evict_lru -> _demote colder digests; our
+            # entry is already off the host LRU so it cannot be a victim
+            page = self._alloc_page()
+        except RuntimeError:
+            hp.put(bytes(digest), entry)
+            return None
+        if self._pools:
+            scales = entry["scales"]
+            for li, key in enumerate(list(self._pools)):
+                kp, vp = self._pools[key]
+                kb, vb = entry["layers"][li]
+                self._pools[key] = (kp.at[:, page].set(kb),
+                                    vp.at[:, page].set(vb))
+                if self.kv_quant and scales is not None:
+                    ks, vs = self._scales[key]
+                    ksb, vsb = scales[li]
+                    self._scales[key] = (ks.at[:, page].set(ksb),
+                                         vs.at[:, page].set(vsb))
+        else:
+            self._import_backlog.append(
+                (page, entry["layers"], entry["scales"]))
+        self._index[bytes(digest)] = page     # MRU end, ref=1 = index's
+        self._page_digest[page] = bytes(digest)
+        self.host_promotions += 1
+        hp.promotions += 1
+        return page
 
     def _decref(self, page):
         page = int(page)
@@ -471,9 +679,14 @@ class SlotPagedKVCache:
         matched = 0
         for i in range(matchable):
             page = self._index.get(chain[i])
+            if page is not None:
+                self._index.move_to_end(chain[i])      # LRU touch
+            else:
+                # device miss: the block may have been demoted to the
+                # host tier — promote it back and keep matching
+                page = self._promote(chain[i])
             if page is None:
                 break
-            self._index.move_to_end(chain[i])          # LRU touch
             self._ref[page] += 1
             self._tables[slot, i] = page
             matched += 1
@@ -558,6 +771,10 @@ class SlotPagedKVCache:
 
     def free(self, slot):
         slot = int(slot)
+        # sep slots own no device pages below their tail block — those
+        # table entries stay 0 and _decref(0) is a no-op, so one loop
+        # covers both lifecycles
+        self._sep[slot] = None
         for i in range(int(self._n_blocks[slot])):
             self._decref(self._tables[slot, i])
         self._tables[slot, :] = 0
@@ -574,30 +791,52 @@ class SlotPagedKVCache:
         exported and one host-side ``[kv, blocks, page_size, d]`` K/V
         array pair per attention layer (layer order == pool creation
         order == forward order, the cross-replica identity). On device
-        tiers the ``np.asarray`` copies ARE the wire transfer."""
-        pages, out_digests = [], []
+        tiers the ``np.asarray`` copies ARE the wire transfer.
+
+        Tiered KV: a digest missing from the device index is looked up
+        in the host tier — a demoted block still hands off (read-only,
+        no promotion), so the disagg path survives device churn. The
+        blob reports how many blocks came from host as ``host_pages``."""
+        entries, out_digests, host_pages = [], [], 0
+        hp = self.host_pool
         for d in digests:
             page = self._index.get(d)
-            if page is None:
-                break
-            self._index.move_to_end(d)              # LRU touch
-            pages.append(int(page))
+            if page is not None:
+                if not self._pools:
+                    break                 # device KV not materialized yet
+                self._index.move_to_end(d)          # LRU touch
+                entries.append(self._page_entry(int(page)))
+            else:
+                he = (hp.get(bytes(d))
+                      if hp is not None and hp.enabled else None)
+                if (he is None or int(he["page_size"]) != self.page_size
+                        or he["kv_dtype"] != self.kv_dtype
+                        or (entries and len(he["layers"]) !=
+                            len(entries[0]["layers"]))):
+                    break
+                entries.append(he)
+                host_pages += 1
             out_digests.append(bytes(d))
-        if not out_digests or not self._pools:
+        if not entries:
             return None
-        idx = jnp.asarray(pages)
-        layers = [(np.asarray(kp[:, idx]), np.asarray(vp[:, idx]))
-                  for kp, vp in self._pools.values()]
-        # int8 pools ship their quantized ints AS-IS plus the per-row
-        # scales — the handoff blob shrinks with the pages and the
-        # receiver re-registers bit-exactly (no requantization step)
-        scales = [(np.asarray(ks[:, idx]), np.asarray(vs[:, idx]))
-                  for ks, vs in self._scales.values()] if self.kv_quant \
-            else None
-        self.pages_exported += len(pages)
+        n_layers = len(entries[0]["layers"])
+        if any(len(e["layers"]) != n_layers for e in entries):
+            return None
+        # stack per-page blobs into the [kv, blocks, page_size, d] wire
+        # layout; int8 pools ship their quantized ints AS-IS plus the
+        # per-row scales — the handoff blob shrinks with the pages and
+        # the receiver re-registers bit-exactly (no requantization step)
+        layers = [(np.stack([e["layers"][li][0] for e in entries], axis=1),
+                   np.stack([e["layers"][li][1] for e in entries], axis=1))
+                  for li in range(n_layers)]
+        scales = ([(np.stack([e["scales"][li][0] for e in entries], axis=1),
+                    np.stack([e["scales"][li][1] for e in entries], axis=1))
+                   for li in range(n_layers)] if self.kv_quant else None)
+        self.pages_exported += len(entries)
         blob = {"page_size": self.page_size, "digests": out_digests,
                 "layers": layers, "kv_dtype": self.kv_dtype,
-                "native_dtype": str(layers[0][0].dtype), "scales": scales}
+                "native_dtype": str(layers[0][0].dtype), "scales": scales,
+                "host_pages": host_pages}
         from ..profiler import ledger as _ledger
         if _ledger.is_enabled():
             # determinism ledger: seal the handoff payload so the
@@ -671,22 +910,206 @@ class SlotPagedKVCache:
         self.pages_imported += imported
         return imported
 
+    # -- sep-parallel long-context prefill -----------------------------------
+    def assign_sep(self, slot, prompt_tokens, stripe_tokens):
+        """Arm ``slot`` for sep-parallel long-context serving: the prompt
+        is prefilled in fixed ``stripe_tokens`` chunks whose K/V is kept
+        as host-side stripes (ring order — stripe ``i``'s home replica is
+        ``i % sep_ways``; see :meth:`export_stripes`) instead of device
+        pages, so a prompt far larger than the page pool still serves.
+        Only the trailing partial chunk and the decode tail land in
+        device pages. No prefix-index interaction: a striped span is not
+        page-granular shareable."""
+        slot = int(slot)
+        self.free(slot)
+        n = int(prompt_tokens)
+        stripe = int(stripe_tokens)
+        if stripe <= 0 or stripe % self.page_size:
+            raise ValueError(f"stripe_tokens {stripe} must be a positive "
+                             f"multiple of page_size {self.page_size}")
+        if self.kv_quant:
+            raise ValueError("sep prefill requires native KV pages "
+                             "(PADDLE_KV_DTYPE=int8 is unsupported)")
+        if n > self.max_len:
+            raise ValueError(f"prompt {n} > max_len {self.max_len}")
+        self._sep[slot] = {"stripe": stripe, "base": 0, "len": n,
+                           "stripes": []}
+        return -(-n // stripe)          # chunks the engine will drive
+
+    def begin_sep_prefill(self, slot, n_valid=None):
+        """Arm the next forward as one fixed-shape sep prefill chunk for
+        ``slot`` (chunk length == stripe length; ``n_valid`` marks the
+        real tokens of the trailing partial chunk)."""
+        slot = int(slot)
+        if self._sep[slot] is None:
+            raise RuntimeError(f"slot {slot} is not sep-assigned")
+        self._mode = ("sep_prefill", slot)
+        self._idx = None
+        self._prefill_valid = None if n_valid is None else int(n_valid)
+        self._sep_pending = []
+        self._sep_layer_i = 0
+        self.sep_chunks += 1
+
+    def begin_sep_decode(self, slot):
+        """Arm the next forward as a [1, 1] decode step of a sep slot:
+        the token's K/V lands in a device tail page; attention reads the
+        stripes plus the tail through the same block table."""
+        slot = int(slot)
+        sep = self._sep[slot]
+        if sep is None:
+            raise RuntimeError(f"slot {slot} is not sep-assigned")
+        self._mode = ("sep_decode", slot)
+        self._idx = None
+        self._sep_layer_i = 0
+        blk0 = sep["base"] // self.page_size
+        if int(self._n_blocks[slot]) < blk0:
+            # blocks below the tail stay unallocated (stripes cover those
+            # positions); start the allocator at the tail's first block
+            self._n_blocks[slot] = blk0
+        self._ensure_blocks(slot, int(self.lens[slot]) + 1)
+        self._make_writable(slot, int(self.lens[slot]) // self.page_size)
+        self.sep_decode_steps += 1
+
+    def export_stripes(self, slot, sep_ways=None):
+        """Striped-page disagg payload for a live sep slot: each stripe
+        is tagged with its home replica on the sep ring (``i % ways``,
+        ``PADDLE_SEP_WAYS``) — the layout a multi-process fleet shards
+        by, and the single-host blob a migration ships whole."""
+        slot = int(slot)
+        sep = self._sep[slot]
+        if sep is None:
+            return None
+        ways = int(sep_ways if sep_ways is not None
+                   else os.environ.get("PADDLE_SEP_WAYS", "1") or 1)
+        stripes = [{"home": j % max(ways, 1),
+                    "layers": [(np.asarray(k), np.asarray(v))
+                               for k, v in st]}
+                   for j, st in enumerate(sep["stripes"])]
+        native = (str(stripes[0]["layers"][0][0].dtype) if stripes
+                  else None)
+        # the decode tail [base, pos) lives in device pages — ship it as
+        # raw [kv, n_tail, d] rows so the importer can resume mid-span
+        base, pos = int(sep["base"]), int(self.lens[slot])
+        tail = None
+        if pos > base and self._pools:
+            blk0 = base // self.page_size
+            n_pages = -(-(pos - base) // self.page_size)
+            tb = jnp.asarray(self._tables[slot, blk0:blk0 + n_pages])
+            tail = [(np.asarray(kp[:, tb].reshape(
+                         kp.shape[0], -1, kp.shape[-1])[:, :pos - base]),
+                     np.asarray(vp[:, tb].reshape(
+                         vp.shape[0], -1, vp.shape[-1])[:, :pos - base]))
+                    for kp, vp in self._pools.values()]
+        return {"page_size": self.page_size, "stripe": sep["stripe"],
+                "base": base, "len": int(sep["len"]), "pos": pos,
+                "native_dtype": native, "sep_ways": max(ways, 1),
+                "stripes": stripes, "tail": tail}
+
+    def import_stripes(self, slot, blob):
+        """Receiver side of a striped handoff: arm ``slot`` with the
+        exported stripes and resume at the exporter's position — the
+        importer continues prefilling from ``pos`` (or decoding, if the
+        span completed). Returns the number of stripes imported."""
+        slot = int(slot)
+        if not blob:
+            return 0
+        if int(blob["page_size"]) != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: exporter {blob['page_size']} vs "
+                f"importer {self.page_size}")
+        stripe = int(blob["stripe"])
+        if self.kv_quant:
+            raise ValueError("sep stripes require a native KV pool")
+        if self._pools and blob.get("native_dtype"):
+            pool_dtype = str(next(iter(self._pools.values()))[0].dtype)
+            if blob["native_dtype"] != pool_dtype:
+                raise ValueError(
+                    f"pool dtype mismatch: exporter "
+                    f"{blob['native_dtype']} vs importer {pool_dtype}")
+        base, pos = int(blob["base"]), int(blob["pos"])
+        tail = blob.get("tail")
+        if pos > base and tail is None:
+            raise ValueError("striped blob resumes mid-span but carries "
+                             "no tail rows")
+        if tail is not None and not self._pools:
+            # landing tail rows needs per-layer pools; stripes alone
+            # (pos == base) import anywhere. Engines materialize pools
+            # at warmup, so this only bites bare caches.
+            raise ValueError("import_stripes needs materialized pools "
+                             "to land a mid-span tail")
+        if tail is not None and len(tail) != len(self._pools):
+            raise ValueError(f"layer count mismatch: exporter "
+                             f"{len(tail)} vs importer {len(self._pools)}")
+        self.free(slot)
+        self._sep[slot] = {
+            "stripe": stripe, "base": base, "len": int(blob["len"]),
+            "stripes": [[(np.asarray(k), np.asarray(v))
+                         for k, v in st["layers"]]
+                        for st in blob["stripes"]]}
+        self.lens[slot] = pos
+        if tail is not None:
+            blk0 = base // self.page_size
+            self._n_blocks[slot] = blk0
+            self._ensure_blocks(slot, pos)
+            n_pages = -(-(pos - base) // self.page_size)
+            tb = jnp.asarray(self._tables[slot, blk0:blk0 + n_pages])
+            pad = n_pages * self.page_size - (pos - base)
+            for li, key in enumerate(list(self._pools)):
+                kp, vp = self._pools[key]
+                kb = jnp.pad(jnp.asarray(tail[li][0]),
+                             ((0, 0), (0, pad), (0, 0)))
+                vb = jnp.pad(jnp.asarray(tail[li][1]),
+                             ((0, 0), (0, pad), (0, 0)))
+                shape = (kp.shape[0], n_pages, self.page_size,
+                         kp.shape[-1])
+                self._pools[key] = (
+                    kp.at[:, tb].set(kb.reshape(shape)),
+                    vp.at[:, tb].set(vb.reshape(shape)))
+        self.sep_stripes_stored += len(blob["stripes"])
+        return len(blob["stripes"])
+
+    def sep_view(self, slot):
+        """Shape-relevant sep state for the engine's observatory
+        signatures: the stripe count and the pow2 tail-page window the
+        NEXT decode step would compile with."""
+        sep = self._sep[int(slot)]
+        if sep is None:
+            return None
+        n_tail = int(self.lens[slot]) + 1 - sep["base"]
+        n_tp = -(-max(n_tail, 1) // self.page_size)
+        return {"stripes": len(sep["stripes"]),
+                "tail_pages": 1 << max(n_tp - 1, 0).bit_length(),
+                "base": int(sep["base"]), "len": int(sep["len"])}
+
     @property
     def pos(self):
         # models read cache.pos for default position ids; the engine
         # always passes explicit per-slot positions instead
         m = self._mode
-        return int(self.lens[m[1]]) if m and m[0] == "prefill" else 0
+        if m and m[0] in ("prefill", "sep_prefill"):
+            return int(self.lens[m[1]])
+        return 0
 
     def advance(self, s):
         mode, arg = self._mode
         if mode == "prefill":
             n = self._prefill_valid
             self.lens[arg] += int(s) if n is None else min(int(s), n)
+        elif mode == "sep_prefill":
+            sep = self._sep[arg]
+            n = self._prefill_valid
+            n = int(s) if n is None else min(int(s), n)
+            if self._sep_pending:
+                # a full chunk becomes the next stripe on the ring
+                sep["stripes"].append(list(self._sep_pending))
+                sep["base"] += sep["stripe"]
+                self.sep_stripes_stored += 1
+            self._sep_pending = None
+            self.lens[arg] += n
         elif mode == "ragged":
             for slot, _, n_new in arg:
                 self.lens[slot] += n_new
-        else:
+        else:                   # "decode" mask or "sep_decode" slot
             self.lens[arg] += 1
 
     def _pool(self, layer, kv_heads, d, dtype):
@@ -830,6 +1253,104 @@ class SlotPagedKVCache:
             return F.scaled_dot_product_attention(
                 q, kf, vf, attn_mask=None, is_causal=True,
                 training=training)
+
+        if mode in ("sep_prefill", "sep_decode"):
+            # long-context serving: attention over the slot's host-side
+            # stripes (the ring-attention schedule run block-by-block —
+            # each stripe is one ring step; see ops/pallas/ring_attention
+            # .blockwise_causal_attention for the tiering) plus the
+            # device-resident tail, online-softmax merged.
+            assert b == 1, "sep serving admits one request at a time"
+            slot = arg
+            sep = self._sep[slot]
+            stripe = sep["stripe"]
+            li = self._sep_layer_i        # forward-order stripe index
+            self._sep_layer_i += 1
+            blocks = [(jnp.asarray(st[li][0])[None],
+                       jnp.asarray(st[li][1])[None], j * stripe)
+                      for j, st in enumerate(sep["stripes"])]
+            kt = jnp.moveaxis(ka[0], 1, 0)          # [kv, s, d]
+            vt = jnp.moveaxis(va[0], 1, 0)
+            if mode == "sep_prefill":
+                if s != stripe:
+                    raise ValueError(f"sep chunk must be padded to the "
+                                     f"stripe length: got {s}, expected "
+                                     f"{stripe}")
+                start = int(self.lens[slot])        # == sep["base"]
+                n_valid = s if self._prefill_valid is None \
+                    else min(self._prefill_valid, s)
+                if start + n_valid > self.max_len:
+                    raise ValueError(f"slot overflow: {start}+{n_valid} "
+                                     f"> {self.max_len}")
+                # the chunk itself: pad keys sit past every valid query's
+                # causal window, so attending the raw [kv, s, d] is safe
+                blocks.append((jnp.swapaxes(ka, 1, 2),
+                               jnp.swapaxes(va, 1, 2), start))
+                if n_valid == s:
+                    # full chunk -> staged as the next ring stripe
+                    # (host-side np copy) at advance()
+                    self._sep_pending.append((np.asarray(kt),
+                                              np.asarray(vt)))
+                else:
+                    # trailing partial chunk -> device tail pages, read
+                    # by decode through the block table
+                    if self._idx is None:
+                        blk0 = start // self.page_size
+                        if int(self._n_blocks[slot]) < blk0:
+                            self._n_blocks[slot] = blk0
+                        self._ensure_blocks(slot, start + n_valid)
+                        pos = np.arange(start, start + s)
+                        valid = pos < start + n_valid
+                        blk_ids = np.minimum(pos // self.page_size,
+                                             self.pages_per_seq - 1)
+                        self._idx = (
+                            jnp.asarray(np.where(
+                                valid, self._tables[slot, blk_ids], 0)),
+                            jnp.asarray(np.where(
+                                valid, pos % self.page_size, 0)))
+                    page_ids, slot_ids = self._idx
+                    self._scatter(layer, k_pages, v_pages, kt, vt,
+                                  page_ids, slot_ids)
+                q_offset = start
+            else:                          # sep_decode
+                assert s == 1
+                pos_tok = int(self.lens[slot])
+                if self._idx is None:
+                    self._idx = (
+                        jnp.asarray(
+                            [self._tables[slot,
+                                          pos_tok // self.page_size]]),
+                        jnp.asarray([pos_tok % self.page_size]))
+                page_ids, slot_ids = self._idx
+                new_kp, new_vp = self._scatter(layer, k_pages, v_pages,
+                                               kt, vt, page_ids, slot_ids)
+                base = sep["base"]
+                blk0 = base // self.page_size
+                n_tail = pos_tok + 1 - base
+                n_tp = -(-n_tail // self.page_size)
+                # pow2-bucketed tail window keeps the compiled-shape set
+                # bounded (and declarable: always the pure power of two,
+                # zero-padded past the table's end); entries past the
+                # allocated tail are the scratch page, causally masked
+                # (their positions exceed the query's)
+                npp = 1 << max(n_tp - 1, 0).bit_length()
+                tbl = self._tables[slot, blk0:blk0 + npp]
+                if tbl.shape[0] < npp:
+                    tbl = np.pad(tbl, (0, npp - tbl.shape[0]))
+                tb = jnp.asarray(tbl)
+                kf = new_kp[:, tb].reshape(kv_heads, -1, d)[None]
+                vf = new_vp[:, tb].reshape(kv_heads, -1, d)[None]
+                blocks.append((kf, vf, base))
+                q_offset = pos_tok
+
+            from ..ops.pallas.ring_attention import (
+                blockwise_causal_attention)
+
+            def fn(qa):
+                out = blockwise_causal_attention(
+                    jnp.swapaxes(qa, 1, 2), q_offset, blocks)
+                return jnp.swapaxes(out, 1, 2)
+            return apply(fn, q, op_name="sep_ring_attention")
 
         if mode == "ragged":
             # ONE program for the whole tick: decode tokens and prefill

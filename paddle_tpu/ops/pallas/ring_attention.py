@@ -25,11 +25,26 @@ caller; masking here stays exact for any offsets.
 from __future__ import annotations
 
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 
 from .flash_attention import flash_attention_with_lse, mha_reference, NEG_INF
+
+#: PADDLE_SEP_RING_IMPL values (mirrors PADDLE_TPU_RAGGED_IMPL): "auto"
+#: picks the kernel tier — interpret-pallas off-TPU, guarded Mosaic on a
+#: real TPU (flash_attention_with_lse's canary falls back to XLA when the
+#: subprocess proof is missing) — and "xla" forces the pure reference.
+SEP_RING_IMPLS = ("auto", "kernel", "xla")
+
+
+def sep_ring_impl():
+    v = os.environ.get("PADDLE_SEP_RING_IMPL", "auto").lower()
+    if v not in SEP_RING_IMPLS:
+        raise ValueError(f"PADDLE_SEP_RING_IMPL {v!r} not in "
+                         f"{SEP_RING_IMPLS}")
+    return v
 
 
 def _merge(out, lse, out_i, lse_i):
@@ -38,6 +53,52 @@ def _merge(out, lse, out_i, lse_i):
     w = jnp.exp(lse - new_lse)[..., None]
     w_i = jnp.exp(lse_i - new_lse)[..., None]
     return out * w + out_i * w_i, new_lse
+
+
+def ring_partial(q, k, v, q_offset, kv_offset, sm_scale, impl=None,
+                 interpret=None):
+    """One ring step: normalized partial + lse for q (kernel layout
+    [b, h, sq, d], global position ``q_offset``) against one KV block at
+    global position ``kv_offset``, causal. Tiering matches
+    ragged_paged_attention: ``auto``/``kernel`` route through
+    ``flash_attention_with_lse`` (interpret-pallas off-TPU, Mosaic behind
+    the guarded-compile canary with its own XLA fallback on TPU);
+    ``xla`` is the zero-Pallas reference."""
+    if impl is None:
+        impl = sep_ring_impl()
+    if impl == "xla":
+        return mha_reference(q, k, v, causal=True, sm_scale=sm_scale,
+                             q_offset=q_offset, kv_offset=kv_offset,
+                             with_lse=True)
+    return flash_attention_with_lse(q, k, v, causal=True,
+                                    sm_scale=sm_scale, q_offset=q_offset,
+                                    kv_offset=kv_offset,
+                                    interpret=interpret)
+
+
+def blockwise_causal_attention(q, q_offset, kv_blocks, sm_scale=None,
+                               impl=None, interpret=None):
+    """The ring-attention schedule run block-sequentially on one host:
+    causal attention of ``q`` (kernel layout [b, h, sq, d] at global
+    position ``q_offset``) over ``kv_blocks`` — a list of ``(k, v,
+    kv_offset)`` tuples, each one ring step — merged with the
+    online-softmax combine. Fully-masked blocks contribute lse=-inf and
+    drop out of the merge exactly. This is the single-process stand-in
+    for the sep-ring: block ``i`` is what replica ``i % sep_ways`` would
+    compute, and because every block partial is a fixed-shape kernel
+    call, the compiled-program set stays bounded by the stripe shape."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    out = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    if impl is None:
+        impl = sep_ring_impl()
+    for k, v, kv_offset in kv_blocks:
+        out_i, lse_i = ring_partial(q, k, v, q_offset, kv_offset,
+                                    sm_scale, impl=impl,
+                                    interpret=interpret)
+        out, lse = _merge(out, lse, out_i.astype(jnp.float32), lse_i)
+    return out.astype(q.dtype)
 
 
 def ring_flash_attention(q, k, v, axis_name="sep", causal=True, sm_scale=None,

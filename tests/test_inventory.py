@@ -124,6 +124,17 @@ def test_compile_observatory_catalog():
     assert not violations, violations
 
 
+def test_kv_tier_catalog():
+    """Tiered-KV guard (ISSUE 19): every PADDLE_KV_HOST_* / PADDLE_SEP_*
+    knob is documented in docs/SERVING.md AND exercised by a test, and
+    every paddle_kv_* metric (plus the tier-labelled prefix-eviction
+    counter) is cataloged in docs/OBSERVABILITY.md AND exercised by a
+    test."""
+    from check_inventory import check_kv_tier
+    violations = check_kv_tier(verbose=False)
+    assert not violations, violations
+
+
 def test_paddle_flops():
     import numpy as np
     import paddle_tpu as paddle

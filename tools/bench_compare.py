@@ -57,6 +57,13 @@ DIRECTION_RULES = [
     ("forwards_per_token", "lower"),
     ("forwards_per_tick", "lower"),
     ("recover_ratio", "higher"),
+    # tiered KV / long-context serving: host-tier TTFT win on prefix
+    # re-admission and sep-prefill prompt throughput are the point of
+    # the tier — both must not sink (explicit entries so they never
+    # fall through to a suffix rule)
+    ("kv_tier_hit_speedup", "higher"),
+    ("long_context_tokens_per_s", "higher"),
+    ("kv_tier_ttft", "lower"),
     ("controller_actions", "ignore"),
     ("time_to_recover", "lower"),
     ("wire_bytes", "lower"),

@@ -414,6 +414,14 @@ INVENTORY = [
     ("Fleet compile scrape (/compile merge)",
      "paddle_tpu.profiler.scrape",
      ["fetch_compile", "merge_compile_snapshots"]),
+    # -- tiered KV + long-context sep prefill (ISSUE 19) ---------------------
+    ("Host-RAM KV tier (prefix spill pool)",
+     "paddle_tpu.models.generation",
+     ["HostKVPool", "SlotPagedKVCache"]),
+    ("Sep-ring blockwise prefill kernel tier",
+     "paddle_tpu.ops.pallas.ring_attention",
+     ["blockwise_causal_attention", "ring_partial", "sep_ring_impl",
+      "SEP_RING_IMPLS"]),
 ]
 
 # DistributedStrategy fields exempt from the docs/PERF.md mention rule
@@ -1149,6 +1157,69 @@ def check_telemetry_plane(verbose=True):
     return violations
 
 
+def check_kv_tier(verbose=True):
+    """Tiered-KV / long-context inventory guard (ISSUE 19): every
+    ``PADDLE_KV_HOST_*`` and ``PADDLE_SEP_*`` env knob referenced in
+    ``paddle_tpu/`` must be documented in docs/SERVING.md's tiered-KV
+    knob table AND exercised by at least one test, and every
+    ``paddle_kv_*`` metric (plus the tier-labelled prefix-eviction
+    counter) must be cataloged in docs/OBSERVABILITY.md AND exercised
+    by a test — eviction was silent before this layer existed; an
+    undocumented spill knob or counter would make it silent again.
+    Returns a list of violation strings."""
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    knob_pat = re.compile(r"PADDLE_(?:KV_HOST|SEP)_[A-Z0-9_]*")
+    metric_pat = re.compile(r"paddle_kv_[a-z0-9_]*[a-z0-9]")
+    knobs, metrics = set(), set()
+    for dirpath, dirnames, filenames in os.walk(
+            os.path.join(root, "paddle_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name),
+                          errors="replace") as f:
+                    text = f.read()
+                knobs.update(knob_pat.findall(text))
+                metrics.update(metric_pat.findall(text))
+    metrics.add("paddle_serving_prefix_evictions_total")
+    with open(os.path.join(root, "docs", "SERVING.md"),
+              errors="replace") as f:
+        serving_doc = f.read()
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              errors="replace") as f:
+        obs_doc = f.read()
+    tests_text = ""
+    tests_dir = os.path.join(root, "tests")
+    for name in sorted(os.listdir(tests_dir)):
+        if name.startswith("test_") and name.endswith(".py"):
+            with open(os.path.join(tests_dir, name),
+                      errors="replace") as f:
+                tests_text += f.read()
+    violations = []
+    for k in sorted(knobs):
+        if k not in serving_doc:
+            violations.append(
+                f"kv-tier knob {k} missing from docs/SERVING.md")
+        if k not in tests_text:
+            violations.append(
+                f"kv-tier knob {k} not exercised by any test")
+    for m in sorted(metrics):
+        if m not in obs_doc:
+            violations.append(
+                f"kv-tier metric {m} missing from docs/OBSERVABILITY.md")
+        if m not in tests_text:
+            violations.append(
+                f"kv-tier metric {m} not exercised by any test")
+    if verbose:
+        for v in violations:
+            print(f"FAIL {v}")
+        print(f"kv tier: {len(knobs)} knobs, {len(metrics)} metrics "
+              f"checked")
+    return violations
+
+
 def check_compile_observatory(verbose=True):
     """Compile-observatory inventory guard (ISSUE 18). Two halves:
 
@@ -1306,5 +1377,5 @@ if __name__ == "__main__":
                    or check_ledger_catalog() or check_controller_catalog()
                    or check_telemetry_plane() or check_serving_programs()
                    or check_quantized_config()
-                   or check_compile_observatory())
+                   or check_compile_observatory() or check_kv_tier())
              else 0)
